@@ -1,0 +1,45 @@
+"""apex_tpu — a TPU-native training-utilities framework.
+
+A brand-new implementation of the capabilities of NVIDIA Apex (reference:
+SunDoge/apex snapshot, see SURVEY.md) designed for TPUs from the ground up:
+
+- ``apex_tpu.amp``: automatic mixed precision (O0-O3 optimization levels,
+  fp32 master weights, dynamic loss scaling carried *inside* jit — no host
+  syncs; overflow -> skip-step via ``lax`` selects).
+- ``apex_tpu.parallel``: data-parallel training over ``jax.sharding.Mesh``
+  axes (``psum``/``pmean`` over ICI), synchronized BatchNorm with exact
+  Welford/Chan stat merges, LARC.
+- ``apex_tpu.optimizers``: fused optimizers (FusedAdam, FusedLAMB, FusedSGD)
+  over flat parameter buffers, with Pallas TPU kernels on the hot path.
+- ``apex_tpu.normalization``: FusedLayerNorm backed by Pallas kernels.
+- ``apex_tpu.ops``: multi-tensor primitives (scale/axpby/l2norm) returning
+  carried overflow flags, the TPU equivalent of the reference's ``amp_C``
+  CUDA extension.
+- ``apex_tpu.fp16_utils``: manual mixed-precision toolkit (legacy API).
+- ``apex_tpu.RNN``, ``apex_tpu.reparameterization``: auxiliary model utils.
+
+Unlike the reference (a PyTorch extension), models here are flax/JAX pytrees
+and the training step is a pure function compiled once by XLA. The apex API
+names are kept so users of the reference can map concepts 1:1; the internals
+are idiomatic JAX (see SURVEY.md section 7 for the design mapping).
+"""
+
+from apex_tpu import ops
+from apex_tpu import amp
+from apex_tpu import optimizers
+from apex_tpu import normalization
+from apex_tpu import parallel
+from apex_tpu import fp16_utils
+from apex_tpu import multi_tensor_apply
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "amp",
+    "fp16_utils",
+    "multi_tensor_apply",
+    "normalization",
+    "ops",
+    "optimizers",
+    "parallel",
+]

@@ -1,0 +1,12 @@
+"""apex_tpu.amp — automatic mixed precision for TPU.
+
+Public surface mirrors the reference ``apex/amp`` (``frontend.py``,
+``handle.py``, ``scaler.py``): ``initialize`` with O0-O3 optimization
+levels, the ``scale_loss`` protocol, and master-weight management — built on
+a functional core (state pytrees, branch-free scale updates) so the whole
+train step compiles under ``jax.jit``.
+"""
+
+from apex_tpu.amp.scaler import LossScaler, LossScalerState
+
+__all__ = ["LossScaler", "LossScalerState"]

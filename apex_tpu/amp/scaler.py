@@ -1,0 +1,134 @@
+"""Dynamic loss scaling as a carried state pytree.
+
+Re-design of the reference ``apex/amp/scaler.py`` (``LossScaler`` at :34).
+Semantics preserved exactly:
+
+- dynamic scale starts at 2**16, halves on overflow, doubles after 2000
+  consecutive overflow-free steps, capped at 2**24
+  (reference ``scaler.py:39-45,190-210``);
+- ``unscale`` multiplies grads by ``1/scale`` and reports overflow
+  (``scaler.py:95-116``);
+- ``unscale_with_stashed`` accumulates ``stashed + grads/scale`` where only
+  the incoming grads can trip the overflow flag (``scaler.py:149-180``).
+
+Re-designed for XLA: the scaler state is an immutable NamedTuple carried
+through the jitted train step, and ``update`` is branch-free ``jnp.where``
+arithmetic. The reference's one mandatory device->host sync per step
+(``_overflow_buf.item()`` at ``scaler.py:193``) disappears: overflow is a
+traced boolean consumed by ``lax``-select skip-step logic, so the entire
+train step — including "skip this step" — stays on device.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.multi_tensor import (
+    multi_tensor_axpby,
+    multi_tensor_unscale,
+    tree_any_nonfinite,
+)
+
+Pytree = Any
+
+
+class LossScalerState(NamedTuple):
+    """Carried scaler state. A valid leaf of any checkpointable pytree.
+
+    (The reference never checkpointed amp scaler state under the new API —
+    SURVEY.md section 5 flags this as a gap; here it is a plain pytree so it
+    serializes with the rest of the train state.)
+    """
+
+    loss_scale: jax.Array   # f32 scalar, current scale
+    unskipped: jax.Array    # i32 scalar, overflow-free steps since last change
+    overflow: jax.Array     # bool scalar, did the *last* step overflow
+
+
+class LossScaler:
+    """Static hyperparameters + pure functions over :class:`LossScalerState`.
+
+    ``loss_scale``: "dynamic" or a fixed float (the reference accepts the
+    same two via ``amp.initialize(loss_scale=...)``, ``frontend.py:244-254``).
+    """
+
+    def __init__(
+        self,
+        loss_scale: Union[str, float, int] = "dynamic",
+        init_scale: float = 2.0 ** 16,
+        scale_factor: float = 2.0,
+        scale_window: int = 2000,
+        min_loss_scale: Optional[float] = None,
+        max_loss_scale: float = 2.0 ** 24,
+    ):
+        if loss_scale == "dynamic":
+            self.dynamic = True
+            self._init_scale = float(init_scale)
+        else:
+            self.dynamic = False
+            self._init_scale = float(loss_scale)
+        self.scale_factor = float(scale_factor)
+        self.scale_window = int(scale_window)
+        self.min_loss_scale = min_loss_scale
+        self.max_loss_scale = float(max_loss_scale)
+
+    # -- state -----------------------------------------------------------
+    def init(self) -> LossScalerState:
+        return LossScalerState(
+            loss_scale=jnp.asarray(self._init_scale, jnp.float32),
+            unskipped=jnp.asarray(0, jnp.int32),
+            overflow=jnp.asarray(False),
+        )
+
+    # -- per-iteration protocol ------------------------------------------
+    def scale_loss(self, loss: jax.Array, state: LossScalerState) -> jax.Array:
+        """``loss.float() * scale`` (reference ``handle.py:116``)."""
+        return loss.astype(jnp.float32) * state.loss_scale
+
+    def unscale(self, grads: Pytree, state: LossScalerState, *, out_dtype=None):
+        """Grads/scale + overflow flag (reference ``scaler.py:95-116``)."""
+        return multi_tensor_unscale(grads, state.loss_scale, out_dtype=out_dtype)
+
+    def unscale_with_stashed(self, grads: Pytree, stashed: Pytree,
+                             state: LossScalerState):
+        """``stashed + grads/scale``; only ``grads`` can trip the flag.
+
+        Gradient-accumulation path (reference ``scaler.py:149-180`` using
+        ``multi_tensor_axpby`` with ``arg_to_check`` = the incoming grads).
+        """
+        inv = 1.0 / state.loss_scale
+        return multi_tensor_axpby(inv, grads, 1.0, stashed, arg_to_check=0)
+
+    def check_overflow(self, grads: Pytree) -> jax.Array:
+        """Standalone overflow probe (reference ``scaler.py:6-17``)."""
+        return tree_any_nonfinite(grads)
+
+    def update(self, state: LossScalerState, overflow: jax.Array) -> LossScalerState:
+        """Post-step scale adjustment (reference ``scaler.py:190-210``).
+
+        Branch-free: on overflow halve the scale (clamped to
+        ``min_loss_scale``) and reset the window counter; otherwise count up
+        and double the scale (clamped to ``max_loss_scale``) every
+        ``scale_window`` clean steps.
+        """
+        overflow = jnp.asarray(overflow)
+        if not self.dynamic:
+            return state._replace(overflow=overflow)
+        scale = state.loss_scale
+        down = scale / self.scale_factor
+        if self.min_loss_scale is not None:
+            down = jnp.maximum(down, self.min_loss_scale)
+        unskipped = jnp.where(overflow, 0, state.unskipped + 1)
+        grow = unskipped >= self.scale_window
+        up = jnp.minimum(scale * self.scale_factor, self.max_loss_scale)
+        new_scale = jnp.where(overflow, down, jnp.where(grow, up, scale))
+        unskipped = jnp.where(grow, 0, unskipped)
+        return LossScalerState(loss_scale=new_scale, unskipped=unskipped,
+                               overflow=overflow)
+
+    # -- convenience -----------------------------------------------------
+    def loss_scale(self, state: LossScalerState) -> jax.Array:
+        return state.loss_scale

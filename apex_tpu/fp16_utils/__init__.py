@@ -1,0 +1,7 @@
+"""apex_tpu.fp16_utils — manual mixed-precision toolkit (legacy API).
+
+Mirrors the reference ``apex/fp16_utils``: model half-conversion helpers,
+master-param copies, legacy loss scalers, and the general FP16_Optimizer.
+"""
+
+__all__ = []

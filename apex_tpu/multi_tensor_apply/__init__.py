@@ -1,0 +1,16 @@
+"""apex_tpu.multi_tensor_apply — API-parity shim.
+
+The reference exposes a ``multi_tensor_applier`` singleton that chunks
+tensor lists and launches ``amp_C`` kernels
+(``apex/multi_tensor_apply/multi_tensor_apply.py:3-30``, chunk 2048*32).
+On TPU there is no user-visible chunking — XLA tiles — so this module
+exists purely so reference code patterns keep working: the applier simply
+calls the given apex_tpu op on its pytree arguments.
+"""
+
+from apex_tpu.multi_tensor_apply.multi_tensor_apply import (
+    MultiTensorApply,
+    multi_tensor_applier,
+)
+
+__all__ = ["MultiTensorApply", "multi_tensor_applier"]

@@ -1,0 +1,26 @@
+"""Compatibility applier (reference ``apex/multi_tensor_apply``).
+
+In the reference, ``multi_tensor_applier(op, noop_flag, tensor_lists,
+*args)`` dispatches a chunked CUDA kernel into a caller-provided overflow
+buffer. The TPU ops have a different (functional) signature — they take a
+pytree and *return* ``(out, overflow)`` — so this applier is a thin
+dispatcher, not a drop-in for reference call sites: ``__call__`` simply
+forwards its arguments to ``op``. ``chunk_size`` is kept for constructor
+parity but ignored (XLA handles tiling). ``available`` is always True —
+there is no optional native extension to probe (the reference probes
+``import amp_C`` at ``multi_tensor_apply.py:8-14``).
+"""
+
+
+class MultiTensorApply:
+    available = True
+    warned = False
+
+    def __init__(self, chunk_size: int = 2048 * 32):
+        self.chunk_size = chunk_size  # kept for API parity; unused on TPU
+
+    def __call__(self, op, *args, **kwargs):
+        return op(*args, **kwargs)
+
+
+multi_tensor_applier = MultiTensorApply()

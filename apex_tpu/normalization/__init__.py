@@ -1,0 +1,3 @@
+"""apex_tpu.normalization — fused normalization layers (Pallas-backed)."""
+
+__all__ = []

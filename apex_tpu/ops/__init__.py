@@ -1,0 +1,29 @@
+"""apex_tpu.ops — multi-tensor primitives (TPU equivalent of apex's amp_C).
+
+The reference implements these as CUDA kernels launched over chunked tensor
+lists (``csrc/multi_tensor_*.cu`` via ``multi_tensor_apply.cuh``). On TPU the
+same operations are expressed as jit-compiled pytree transformations: XLA
+fuses the per-tensor elementwise work, and the CUDA ``noop_flag`` overflow
+buffer becomes a carried boolean scalar — no device->host sync is needed
+until the user explicitly asks for the value.
+"""
+
+from apex_tpu.ops.multi_tensor import (
+    multi_tensor_scale,
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_unscale,
+    tree_any_nonfinite,
+)
+from apex_tpu.ops.flatten import flatten, unflatten, flatten_like
+
+__all__ = [
+    "multi_tensor_scale",
+    "multi_tensor_axpby",
+    "multi_tensor_l2norm",
+    "multi_tensor_unscale",
+    "tree_any_nonfinite",
+    "flatten",
+    "unflatten",
+    "flatten_like",
+]

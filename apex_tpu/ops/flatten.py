@@ -1,0 +1,83 @@
+"""Pytree flatten/unflatten into contiguous 1-D buffers.
+
+TPU-native equivalent of the reference's ``apex_C`` C++ extension
+(``csrc/flatten_unflatten.cpp:5-17`` wrapping
+``torch::utils::flatten_dense_tensors``), used there by DDP bucketing
+(``apex/parallel/distributed.py:13-33``) and by the flat-master
+``FP16_Optimizer`` (``apex/optimizers/fp16_optimizer.py:61-67``).
+
+Here flattening serves the fused optimizers: a whole parameter pytree becomes
+one (or a few, per-dtype) contiguous 1-D buffers so a single Pallas kernel
+can update every parameter in one launch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Pytree = Any
+
+
+class FlatSpec(NamedTuple):
+    """Static metadata needed to invert :func:`flatten`."""
+
+    treedef: Any
+    shapes: Tuple[Tuple[int, ...], ...]
+    dtypes: Tuple[Any, ...]
+    offsets: Tuple[int, ...]  # start offset of each leaf in the flat buffer
+    total: int
+
+
+def _spec_for(leaves: Sequence[jax.Array]) -> Tuple[tuple, list, tuple]:
+    shapes = tuple(tuple(x.shape) for x in leaves)
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+    offsets = tuple(int(o) for o in np.cumsum([0] + sizes[:-1]))
+    return shapes, sizes, offsets
+
+
+def flatten(tree: Pytree, dtype=None):
+    """Concatenate all leaves of ``tree`` into one 1-D array.
+
+    Returns ``(flat, spec)``. If ``dtype`` is None the leaves are cast to the
+    widest leaf dtype (mirroring apex's requirement that flattened lists are
+    same-dtype — ``split_half_float_double`` at ``distributed.py:51`` exists
+    precisely because torch's flatten can't mix; here we just promote).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        return jnp.zeros((0,), dtype or jnp.float32), FlatSpec(treedef, (), (), (), 0)
+    if dtype is None:
+        dtype = jnp.result_type(*[x.dtype for x in leaves])
+    shapes, sizes, offsets = _spec_for(leaves)
+    flat = jnp.concatenate([x.astype(dtype).reshape(-1) for x in leaves])
+    spec = FlatSpec(treedef, shapes, tuple(x.dtype for x in leaves), offsets,
+                    int(sum(sizes)))
+    return flat, spec
+
+
+def flatten_like(tree: Pytree, spec: FlatSpec, dtype=None) -> jax.Array:
+    """Flatten ``tree`` (matching ``spec``'s structure) without rebuilding spec."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((0,), dtype or jnp.float32)
+    if dtype is None:
+        dtype = jnp.result_type(*[x.dtype for x in leaves])
+    return jnp.concatenate([x.astype(dtype).reshape(-1) for x in leaves])
+
+
+def unflatten(flat: jax.Array, spec: FlatSpec, *, cast_back: bool = True) -> Pytree:
+    """Invert :func:`flatten`: slice ``flat`` back into the original pytree.
+
+    ``cast_back=False`` keeps the flat buffer's dtype (used when the flat
+    buffer holds fp32 master values for bf16 model params).
+    """
+    leaves = []
+    for shape, dt, off in zip(spec.shapes, spec.dtypes, spec.offsets):
+        size = int(np.prod(shape)) if shape else 1
+        piece = jax.lax.dynamic_slice_in_dim(flat, off, size).reshape(shape)
+        leaves.append(piece.astype(dt) if cast_back else piece)
+    return jax.tree_util.tree_unflatten(spec.treedef, leaves)

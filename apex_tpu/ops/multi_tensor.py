@@ -1,0 +1,167 @@
+"""Multi-tensor ops with carried overflow flags.
+
+TPU-native re-design of the reference's ``amp_C`` CUDA extension
+(``csrc/multi_tensor_scale_kernel.cu``, ``multi_tensor_axpby_kernel.cu``,
+``multi_tensor_l2norm_kernel.cu``; dispatch harness
+``csrc/multi_tensor_apply.cuh``). Semantics are preserved:
+
+- ``multi_tensor_scale``: ``out = in * scale``; the overflow flag is set if
+  any *scaled output* element is non-finite (matches ScaleFunctor, reference
+  ``multi_tensor_scale_kernel.cu:70-71``).
+- ``multi_tensor_axpby``: ``out = a*x + b*y``; ``arg_to_check`` selects which
+  input's non-finite values raise the flag (-1 both inputs, 0 x only, 1 y
+  only; reference ``multi_tensor_axpby_kernel.cu:176-181``).
+- ``multi_tensor_l2norm``: global L2 norm in fp32, optionally per-tensor
+  norms (reference ``multi_tensor_l2norm_kernel.cu``).
+
+Differences by design (not omissions):
+
+- Inputs are arbitrary JAX pytrees, not flat lists-of-lists; chunking is
+  XLA's job, so there is no ``chunk_size``/``TensorListMetadata`` machinery.
+- The CUDA ``noop_flag`` GPU buffer becomes a traced ``bool`` scalar that can
+  be carried through ``lax.cond``/``jnp.where`` without synchronizing.
+- All overflow math is done in fp32 regardless of input dtype.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+def _any_flag(flags):
+    if not flags:
+        return jnp.asarray(False)
+    return functools.reduce(jnp.logical_or, flags)
+
+
+def tree_any_nonfinite(tree: Pytree) -> jax.Array:
+    """True iff any leaf of ``tree`` contains a non-finite value.
+
+    TPU equivalent of the python overflow check at reference
+    ``apex/amp/scaler.py:6-17`` and the in-kernel ``isfinite`` checks —
+    computed on device, returned as a traced scalar (no host sync).
+    """
+    flags = []
+    for x in jax.tree_util.tree_leaves(tree):
+        x = jnp.asarray(x)
+        if not jnp.issubdtype(x.dtype, jnp.floating) and not jnp.issubdtype(
+            x.dtype, jnp.complexfloating
+        ):
+            continue  # integer/bool leaves cannot be non-finite
+        flags.append(~jnp.all(jnp.isfinite(x.astype(jnp.float32))))
+    return _any_flag(flags)
+
+
+def _dtype_leaves(out_dtype, tree, treedef):
+    """Resolve ``out_dtype`` (None | single dtype | pytree of dtypes) into a
+    per-leaf list aligned with ``treedef``."""
+    n = treedef.num_leaves
+    if out_dtype is None:
+        return [None] * n
+    try:
+        jnp.dtype(out_dtype)  # single dtype-like?
+        return [out_dtype] * n
+    except TypeError:
+        leaves = jax.tree_util.tree_leaves(
+            out_dtype, is_leaf=lambda d: d is not None and not isinstance(d, (dict, list, tuple))
+        )
+        if len(leaves) != n:
+            raise ValueError(
+                f"out_dtype pytree has {len(leaves)} leaves; expected {n}")
+        return leaves
+
+
+def multi_tensor_scale(tree: Pytree, scale, *, out_dtype=None):
+    """``out = tree * scale`` with overflow detection on the scaled output.
+
+    Returns ``(out_tree, overflow)``. ``out_dtype`` optionally casts each
+    output leaf (a single dtype, or a pytree of dtypes matching ``tree``);
+    the overflow check runs on the fp32 intermediate so fp16/bf16 rounding
+    cannot mask an inf.
+
+    Reference: ``multi_tensor_scale`` (``csrc/amp_C_frontend.cpp:44``,
+    ``csrc/multi_tensor_scale_kernel.cu:18-76``).
+    """
+    scale = jnp.asarray(scale, jnp.float32)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    dtypes = _dtype_leaves(out_dtype, tree, treedef)
+    outs, flags = [], []
+    for x, dt in zip(leaves, dtypes):
+        x = jnp.asarray(x)
+        y32 = x.astype(jnp.float32) * scale
+        flags.append(~jnp.all(jnp.isfinite(y32)))
+        outs.append(y32.astype(dt if dt is not None else x.dtype))
+    return jax.tree_util.tree_unflatten(treedef, outs), _any_flag(flags)
+
+
+def multi_tensor_unscale(tree: Pytree, scale, *, out_dtype=None):
+    """``out = tree / scale`` — the gradient-unscale specialization.
+
+    Matches ``LossScaler.unscale``'s use of ``multi_tensor_scale`` with
+    ``1/loss_scale`` (reference ``apex/amp/scaler.py:113-116``).
+    """
+    inv = 1.0 / jnp.asarray(scale, jnp.float32)
+    return multi_tensor_scale(tree, inv, out_dtype=out_dtype)
+
+
+def multi_tensor_axpby(a, x_tree: Pytree, b, y_tree: Pytree, *,
+                       arg_to_check: int = -1, out_dtype=None):
+    """``out = a*x + b*y`` leafwise, with selectable overflow source.
+
+    ``arg_to_check``: -1 checks both inputs, 0 checks only ``x``, 1 checks
+    only ``y`` (reference ``multi_tensor_axpby_kernel.cu:117-188``; used by
+    ``unscale_with_stashed`` where only the incoming scaled grads should be
+    able to trip the flag, ``apex/amp/scaler.py:167-180``).
+
+    Returns ``(out_tree, overflow)``.
+    """
+    if arg_to_check not in (-1, 0, 1):
+        raise ValueError(f"arg_to_check must be -1, 0 or 1; got {arg_to_check}")
+    a = jnp.asarray(a, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+    x_leaves, treedef = jax.tree_util.tree_flatten(x_tree)
+    y_leaves = jax.tree_util.tree_leaves(y_tree)
+    if len(y_leaves) != len(x_leaves):
+        raise ValueError("x and y pytrees must have the same structure")
+    outs, flags = [], []
+    for x, y in zip(x_leaves, y_leaves):
+        x32 = jnp.asarray(x).astype(jnp.float32)
+        y32 = jnp.asarray(y).astype(jnp.float32)
+        out32 = a * x32 + b * y32
+        if arg_to_check == 0:
+            flags.append(~jnp.all(jnp.isfinite(x32)))
+        elif arg_to_check == 1:
+            flags.append(~jnp.all(jnp.isfinite(y32)))
+        else:
+            flags.append(~jnp.all(jnp.isfinite(x32)) | ~jnp.all(jnp.isfinite(y32)))
+        dt = out_dtype if out_dtype is not None else jnp.result_type(
+            jnp.asarray(x).dtype, jnp.asarray(y).dtype)
+        outs.append(out32.astype(dt))
+    return jax.tree_util.tree_unflatten(treedef, outs), _any_flag(flags)
+
+
+def multi_tensor_l2norm(tree: Pytree, *, per_tensor: bool = False):
+    """Global L2 norm of all leaves in fp32.
+
+    Returns ``norm`` or ``(norm, per_tensor_norms)`` where
+    ``per_tensor_norms`` is a pytree matching ``tree`` of scalar norms
+    (reference ``multi_tensor_l2norm_kernel.cu``, per-tensor output enabled
+    by the ``per_tensor`` flag used by LAMB).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        z = jnp.asarray(0.0, jnp.float32)
+        return (z, tree) if per_tensor else z
+    sqs = [jnp.sum(jnp.square(jnp.asarray(x).astype(jnp.float32)))
+           for x in leaves]
+    total = jnp.sqrt(functools.reduce(jnp.add, sqs))
+    if not per_tensor:
+        return total
+    return total, jax.tree_util.tree_unflatten(
+        treedef, [jnp.sqrt(s) for s in sqs])

@@ -1,0 +1,8 @@
+"""apex_tpu.optimizers — fused optimizers over flat parameter buffers.
+
+Mirrors the reference ``apex/optimizers`` (FusedAdam + the cut-down
+FP16_Optimizer) and adds the LAMB optimizer class the reference shipped
+kernels for but never wrapped (``csrc/multi_tensor_lamb_stage_{1,2}.cu``).
+"""
+
+__all__ = []

@@ -1,0 +1,8 @@
+"""apex_tpu.parallel — distributed training over jax.sharding meshes.
+
+Mirrors the reference ``apex/parallel`` (DistributedDataParallel, Reducer,
+SyncBatchNorm, LARC, multiproc) with ``jax.lax`` collectives over mesh axes
+in place of torch.distributed/NCCL.
+"""
+
+__all__ = []

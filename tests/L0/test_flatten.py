@@ -1,0 +1,56 @@
+"""flatten/unflatten round-trip tests (apex_C equivalent)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.ops import flatten, flatten_like, unflatten
+
+
+def test_roundtrip():
+    tree = {"a": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+            "b": {"c": jnp.ones((4,), jnp.float32),
+                  "d": jnp.asarray(5.0)}}
+    flat, spec = flatten(tree)
+    assert flat.shape == (6 + 4 + 1,)
+    back = unflatten(flat, spec)
+    for l1, l2 in zip(jax.tree_util.tree_leaves(tree),
+                      jax.tree_util.tree_leaves(back)):
+        np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+
+
+def test_dtype_promotion_and_cast_back():
+    tree = {"h": jnp.ones((3,), jnp.bfloat16), "f": jnp.ones((3,), jnp.float32)}
+    flat, spec = flatten(tree)
+    assert flat.dtype == jnp.float32
+    back = unflatten(flat, spec)
+    assert back["h"].dtype == jnp.bfloat16
+    back32 = unflatten(flat, spec, cast_back=False)
+    assert back32["h"].dtype == jnp.float32
+
+
+def test_flatten_like_reuses_spec():
+    tree = {"a": jnp.ones((2, 2)), "b": jnp.zeros((3,))}
+    flat, spec = flatten(tree)
+    tree2 = jax.tree_util.tree_map(lambda x: x * 2, tree)
+    flat2 = flatten_like(tree2, spec)
+    np.testing.assert_array_equal(np.asarray(flat2), np.asarray(flat) * 2)
+
+
+def test_empty_tree():
+    flat, spec = flatten({})
+    assert flat.shape == (0,)
+    assert unflatten(flat, spec) == {}
+
+
+def test_jit_roundtrip():
+    tree = {"a": jnp.ones((7,)), "b": jnp.full((5,), 2.0)}
+    _, spec = flatten(tree)
+
+    @jax.jit
+    def f(t):
+        fl = flatten_like(t, spec)
+        return unflatten(fl * 2, spec)
+
+    out = f(tree)
+    np.testing.assert_array_equal(np.asarray(out["b"]), 4.0)

@@ -1,0 +1,111 @@
+"""LossScaler semantics tests (vs reference apex/amp/scaler.py behavior)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.amp import LossScaler
+
+
+def grads(fill=1.0, bad=None):
+    g = {"w": jnp.full((4, 4), fill, jnp.float32),
+         "b": jnp.full((4,), fill, jnp.float32)}
+    if bad is not None:
+        g["w"] = g["w"].at[0, 0].set(bad)
+    return g
+
+
+def test_dynamic_defaults():
+    s = LossScaler("dynamic")
+    st = s.init()
+    assert float(st.loss_scale) == 2.0 ** 16
+    assert int(st.unskipped) == 0
+
+
+def test_static_scale_never_changes():
+    s = LossScaler(128.0, scale_window=1)
+    st = s.init()
+    for _ in range(3):
+        st = s.update(st, jnp.asarray(False))
+    assert float(st.loss_scale) == 128.0
+    st = s.update(st, jnp.asarray(True))
+    assert float(st.loss_scale) == 128.0
+    assert bool(st.overflow)
+
+
+def test_overflow_halves_clean_window_doubles():
+    s = LossScaler("dynamic", init_scale=1024.0, scale_window=3)
+    st = s.init()
+    st = s.update(st, jnp.asarray(True))
+    assert float(st.loss_scale) == 512.0
+    for _ in range(3):
+        st = s.update(st, jnp.asarray(False))
+    assert float(st.loss_scale) == 1024.0
+    assert int(st.unskipped) == 0
+
+
+def test_max_loss_scale_cap():
+    s = LossScaler("dynamic", init_scale=2.0 ** 24, scale_window=1)
+    st = s.init()
+    st = s.update(st, jnp.asarray(False))
+    assert float(st.loss_scale) == 2.0 ** 24  # capped (reference max 2^24)
+
+
+def test_min_loss_scale_floor():
+    s = LossScaler("dynamic", init_scale=2.0, min_loss_scale=1.0)
+    st = s.init()
+    for _ in range(4):
+        st = s.update(st, jnp.asarray(True))
+    assert float(st.loss_scale) == 1.0
+
+
+def test_scale_unscale_roundtrip():
+    s = LossScaler("dynamic", init_scale=4.0)
+    st = s.init()
+    loss = jnp.asarray(2.0)
+    scaled = s.scale_loss(loss, st)
+    assert float(scaled) == 8.0
+    g, overflow = s.unscale(grads(fill=4.0), st)
+    assert not bool(overflow)
+    np.testing.assert_allclose(np.asarray(g["w"]), 1.0)
+
+
+def test_unscale_detects_overflow():
+    s = LossScaler("dynamic")
+    st = s.init()
+    _, overflow = s.unscale(grads(bad=jnp.inf), st)
+    assert bool(overflow)
+
+
+def test_unscale_with_stashed_accumulates():
+    s = LossScaler("dynamic", init_scale=2.0)
+    st = s.init()
+    new, overflow = s.unscale_with_stashed(grads(fill=4.0), grads(fill=1.0), st)
+    assert not bool(overflow)
+    np.testing.assert_allclose(np.asarray(new["w"]), 3.0)  # 4/2 + 1
+    # stashed inf must NOT trip the flag (only incoming grads checked)
+    stashed = grads(fill=1.0, bad=jnp.inf)
+    _, overflow = s.unscale_with_stashed(grads(fill=4.0), stashed, st)
+    assert not bool(overflow)
+
+
+def test_full_protocol_inside_jit():
+    """Whole scale->backward->unscale->update protocol under one jit."""
+    s = LossScaler("dynamic", init_scale=2.0 ** 8, scale_window=2)
+
+    @jax.jit
+    def step(st, x):
+        def loss_fn(p):
+            return s.scale_loss(jnp.sum(p * x), st)
+        g = jax.grad(loss_fn)(jnp.ones((4,)))
+        g, overflow = s.unscale({"p": g}, st)
+        st = s.update(st, overflow)
+        return st, g["p"]
+
+    st = s.init()
+    st, g = step(st, jnp.full((4,), 3.0))
+    np.testing.assert_allclose(np.asarray(g), 3.0, rtol=1e-6)
+    assert not bool(st.overflow)
+    st, _ = step(st, jnp.full((4,), jnp.inf))
+    assert bool(st.overflow)
+    assert float(st.loss_scale) == 2.0 ** 7

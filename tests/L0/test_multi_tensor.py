@@ -1,0 +1,174 @@
+"""Multi-tensor op tests.
+
+Ported test strategy from reference ``tests/L0/run_amp/test_multi_tensor_scale.py``
+/ ``_axpby`` / ``_l2norm``: odd sizes, dtype cross products, inf/nan injection
+at first/last element, overflow-flag correctness.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops import (
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_scale,
+    multi_tensor_unscale,
+    tree_any_nonfinite,
+)
+
+SIZES = [27, 55, 34, 35, 29, 19]  # odd sizes as in the reference fuzz tests
+DTYPES = [jnp.float32, jnp.bfloat16, jnp.float16]
+
+
+def make_tree(sizes, dtype, fill=1.0):
+    return {f"t{i}": jnp.full((n,), fill, dtype) for i, n in enumerate(sizes)}
+
+
+@pytest.mark.parametrize("in_dt", DTYPES)
+@pytest.mark.parametrize("out_dt", DTYPES)
+def test_scale_dtype_cross_product(in_dt, out_dt):
+    tree = make_tree(SIZES, in_dt, fill=4.0)
+    out, overflow = jax.jit(
+        lambda t: multi_tensor_scale(t, 0.5, out_dtype=out_dt)
+    )(tree)
+    assert not bool(overflow)
+    for k, v in out.items():
+        assert v.dtype == out_dt
+        np.testing.assert_allclose(np.asarray(v, np.float32), 2.0, rtol=1e-2)
+
+
+@pytest.mark.parametrize("bad", [jnp.inf, -jnp.inf, jnp.nan])
+@pytest.mark.parametrize("pos", ["first", "last"])
+def test_scale_overflow_injection(bad, pos):
+    tree = make_tree(SIZES, jnp.float32)
+    key = "t3"
+    idx = 0 if pos == "first" else SIZES[3] - 1
+    tree[key] = tree[key].at[idx].set(bad)
+    out, overflow = multi_tensor_scale(tree, 2.0)
+    assert bool(overflow)
+    # clean tensors still scaled correctly
+    np.testing.assert_allclose(np.asarray(out["t0"]), 2.0)
+
+
+def test_scale_overflow_from_scaling_itself():
+    # finite input whose scaled fp32 value overflows must trip the flag
+    # (the reference checks isfinite on the *scaled* value).
+    tree = {"t": jnp.full((8,), 1e38, jnp.float32)}
+    _, overflow = multi_tensor_scale(tree, 1e10)
+    assert bool(overflow)
+
+
+def test_unscale_matches_division():
+    tree = make_tree(SIZES, jnp.float32, fill=6.0)
+    out, overflow = multi_tensor_unscale(tree, 3.0)
+    assert not bool(overflow)
+    np.testing.assert_allclose(np.asarray(out["t1"]), 2.0)
+
+
+@pytest.mark.parametrize("arg_to_check,bad_in,expect", [
+    (-1, "x", True), (-1, "y", True),
+    (0, "x", True), (0, "y", False),
+    (1, "x", False), (1, "y", True),
+])
+def test_axpby_arg_to_check(arg_to_check, bad_in, expect):
+    x = make_tree(SIZES, jnp.float32, fill=1.0)
+    y = make_tree(SIZES, jnp.float32, fill=2.0)
+    tgt = x if bad_in == "x" else y
+    tgt["t2"] = tgt["t2"].at[5].set(jnp.nan)
+    out, overflow = multi_tensor_axpby(2.0, x, 3.0, y,
+                                       arg_to_check=arg_to_check)
+    assert bool(overflow) == expect
+    np.testing.assert_allclose(np.asarray(out["t0"]), 2.0 * 1.0 + 3.0 * 2.0)
+
+
+def test_axpby_values_mixed_dtype():
+    x = make_tree(SIZES, jnp.bfloat16, fill=1.0)
+    y = make_tree(SIZES, jnp.float32, fill=2.0)
+    out, overflow = multi_tensor_axpby(0.5, x, 0.25, y, out_dtype=jnp.float32)
+    assert not bool(overflow)
+    np.testing.assert_allclose(np.asarray(out["t4"]), 1.0)
+    assert out["t0"].dtype == jnp.float32
+
+
+def test_l2norm_global_and_per_tensor():
+    tree = {"a": jnp.full((3,), 2.0), "b": jnp.full((4,), 1.0)}
+    total = multi_tensor_l2norm(tree)
+    np.testing.assert_allclose(float(total), np.sqrt(3 * 4 + 4 * 1), rtol=1e-6)
+    total2, per = multi_tensor_l2norm(tree, per_tensor=True)
+    np.testing.assert_allclose(float(total2), float(total))
+    np.testing.assert_allclose(float(per["a"]), np.sqrt(12), rtol=1e-6)
+    np.testing.assert_allclose(float(per["b"]), 2.0, rtol=1e-6)
+
+
+def test_l2norm_bf16_accumulates_fp32():
+    # 2048 bf16 ones: naive bf16 accumulation would lose precision badly.
+    tree = {"a": jnp.ones((2048,), jnp.bfloat16)}
+    total = multi_tensor_l2norm(tree)
+    np.testing.assert_allclose(float(total), np.sqrt(2048.0), rtol=1e-5)
+
+
+def test_tree_any_nonfinite():
+    clean = make_tree(SIZES, jnp.float32)
+    assert not bool(tree_any_nonfinite(clean))
+    clean["t5"] = clean["t5"].at[0].set(jnp.inf)
+    assert bool(tree_any_nonfinite(clean))
+    assert not bool(tree_any_nonfinite({}))
+
+
+def test_tuple_pytrees_not_corrupted():
+    # regression: tuple containers must be treated as structure, not leaves
+    tree = (jnp.ones((3,)), jnp.full((4,), 2.0))
+    out, overflow = multi_tensor_scale(tree, 2.0)
+    assert isinstance(out, tuple) and len(out) == 2
+    np.testing.assert_allclose(np.asarray(out[0]), 2.0)
+    np.testing.assert_allclose(np.asarray(out[1]), 4.0)
+    assert overflow.dtype == jnp.bool_ and not bool(overflow)
+    out2, _ = multi_tensor_axpby(1.0, tree, 1.0, tree)
+    np.testing.assert_allclose(np.asarray(out2[1]), 4.0)
+
+
+def test_python_scalar_leaves():
+    # regression: python float/int leaves must not crash
+    assert not bool(tree_any_nonfinite({"a": 1.0, "b": 2}))
+    assert bool(tree_any_nonfinite({"a": float("inf")}))
+    out, f = multi_tensor_scale({"a": 3.0}, 2.0)
+    assert float(out["a"]) == 6.0 and not bool(f)
+
+
+def test_axpby_minus1_checks_inputs_not_output():
+    # -1 semantics: both *inputs* finite => no overflow even if sum overflows
+    x = {"a": jnp.full((4,), 3e38, jnp.float32)}
+    y = {"a": jnp.full((4,), 3e38, jnp.float32)}
+    _, overflow = multi_tensor_axpby(1.0, x, 1.0, y, arg_to_check=-1)
+    assert not bool(overflow)
+
+
+def test_axpby_bad_arg_to_check_raises():
+    with pytest.raises(ValueError):
+        multi_tensor_axpby(1.0, {"a": jnp.ones(3)}, 1.0, {"a": jnp.ones(3)},
+                           arg_to_check=7)
+
+
+def test_per_leaf_out_dtype():
+    tree = {"a": jnp.ones((4,), jnp.float32), "b": jnp.ones((4,), jnp.float32)}
+    out, _ = multi_tensor_scale(
+        tree, 1.0, out_dtype={"a": jnp.bfloat16, "b": jnp.float32})
+    assert out["a"].dtype == jnp.bfloat16
+    assert out["b"].dtype == jnp.float32
+
+
+def test_int_leaves_never_flag_overflow():
+    assert not bool(tree_any_nonfinite({"i": jnp.arange(4, dtype=jnp.int32)}))
+
+
+def test_ops_jit_and_grad_safe():
+    # the ops must be jittable and differentiable-through (scale path).
+    def f(t):
+        out, _ = multi_tensor_scale(t, 2.0)
+        return sum(jnp.sum(v) for v in out.values())
+
+    tree = make_tree([8, 16], jnp.float32)
+    g = jax.jit(jax.grad(f))(tree)
+    np.testing.assert_allclose(np.asarray(g["t0"]), 2.0)
