@@ -6,17 +6,19 @@ SunDoge/apex snapshot, see SURVEY.md) designed for TPUs from the ground up:
 - ``apex_tpu.amp``: automatic mixed precision (O0-O3 optimization levels,
   fp32 master weights, dynamic loss scaling carried *inside* jit — no host
   syncs; overflow -> skip-step via ``lax`` selects).
-- ``apex_tpu.parallel``: data-parallel training over ``jax.sharding.Mesh``
-  axes (``psum``/``pmean`` over ICI), synchronized BatchNorm with exact
-  Welford/Chan stat merges, LARC.
-- ``apex_tpu.optimizers``: fused optimizers (FusedAdam, FusedLAMB, FusedSGD)
-  over flat parameter buffers, with Pallas TPU kernels on the hot path.
-- ``apex_tpu.normalization``: FusedLayerNorm backed by Pallas kernels.
+- ``apex_tpu.optimizers``: fused optimizers (FusedAdam, FusedLAMB) over
+  flat parameter buffers, with Pallas TPU kernels on the hot path.
 - ``apex_tpu.ops``: multi-tensor primitives (scale/axpby/l2norm) returning
   carried overflow flags, the TPU equivalent of the reference's ``amp_C``
   CUDA extension.
+- ``apex_tpu.parallel``: data-parallel training over ``jax.sharding.Mesh``
+  axes (``psum``/``pmean`` over ICI), synchronized BatchNorm, LARC.
+  [in progress — currently stubs]
+- ``apex_tpu.normalization``: FusedLayerNorm backed by Pallas kernels.
+  [in progress — currently stubs]
 - ``apex_tpu.fp16_utils``: manual mixed-precision toolkit (legacy API).
-- ``apex_tpu.RNN``, ``apex_tpu.reparameterization``: auxiliary model utils.
+  [in progress — currently stubs]
+- Planned: ``apex_tpu.RNN``, ``apex_tpu.reparameterization``.
 
 Unlike the reference (a PyTorch extension), models here are flax/JAX pytrees
 and the training step is a pure function compiled once by XLA. The apex API

@@ -1,12 +1,61 @@
 """apex_tpu.amp — automatic mixed precision for TPU.
 
-Public surface mirrors the reference ``apex/amp`` (``frontend.py``,
-``handle.py``, ``scaler.py``): ``initialize`` with O0-O3 optimization
-levels, the ``scale_loss`` protocol, and master-weight management — built on
-a functional core (state pytrees, branch-free scale updates) so the whole
-train step compiles under ``jax.jit``.
+Public surface mirrors the reference ``apex/amp``: ``initialize`` with
+O0-O3 optimization levels, the ``scale_loss`` protocol, precision
+decorators, and master-weight management — built on a functional core
+(state pytrees, branch-free scale updates) so the whole train step
+compiles under ``jax.jit``.
+
+Canonical usage::
+
+    model, optimizer = amp.initialize(model, optax.sgd(1e-3), opt_level="O2")
+    params = model.init(rng, x)
+    opt_state = optimizer.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, batch):
+        def loss_fn(p):
+            logits = model.apply(p, batch["x"])
+            loss = cross_entropy(logits, batch["y"])
+            with amp.scale_loss(loss, opt_state) as scaled_loss:
+                return scaled_loss, loss
+        (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        params, opt_state = optimizer.step(params, grads, opt_state)
+        return params, opt_state, loss
 """
 
 from apex_tpu.amp.scaler import LossScaler, LossScalerState
+from apex_tpu.amp.properties import Properties, opt_levels, AmpOptimizationError
+from apex_tpu.amp.model import AmpModel, applier, cast_tree
+from apex_tpu.amp.optimizer import AmpOptimizer, AmpOptimizerState
+from apex_tpu.amp.frontend import initialize
+from apex_tpu.amp.handle import scale_loss, scale, disable_casts
+from apex_tpu.amp.functional import (
+    half_function,
+    float_function,
+    promote_function,
+    master_params,
+)
+from apex_tpu.amp._amp_state import _amp_state, maybe_print
 
-__all__ = ["LossScaler", "LossScalerState"]
+__all__ = [
+    "AmpModel",
+    "AmpOptimizer",
+    "AmpOptimizerState",
+    "AmpOptimizationError",
+    "LossScaler",
+    "LossScalerState",
+    "Properties",
+    "applier",
+    "cast_tree",
+    "disable_casts",
+    "float_function",
+    "half_function",
+    "initialize",
+    "master_params",
+    "maybe_print",
+    "opt_levels",
+    "promote_function",
+    "scale",
+    "scale_loss",
+]

@@ -1,0 +1,51 @@
+"""Cross-module amp state + rank-0-aware printing.
+
+Port of reference ``apex/amp/_amp_state.py``. The mutable global here only
+holds *trace-time* configuration (verbosity, casts_disabled, the active
+Properties) — all numeric state (loss scales, overflow flags) lives in
+explicit state pytrees, unlike the reference where loss_scalers hang off
+this singleton.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+
+class AmpState:
+    def __init__(self):
+        self.hard_override = False
+        self.allow_incoming_model_not_fp32 = False
+        self.verbosity = 1
+        self.casts_disabled = False
+        self.opt_properties = None
+
+
+_amp_state = AmpState()
+
+
+def warn_or_err(msg: str):
+    """Reference ``_amp_state.py:28``: hard_override downgrades errors."""
+    if _amp_state.hard_override:
+        warnings.warn(msg)
+    else:
+        raise RuntimeError(
+            msg + "  If you're sure you know what you're doing, supply "
+            "hard_override=True to amp.initialize.")
+
+
+def _is_rank0() -> bool:
+    try:
+        import jax
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
+def maybe_print(msg: str, rank0: bool = False):
+    """Verbosity-gated print, optionally only on process 0 (reference
+    ``_amp_state.py:43-52``, WORLD_SIZE detection replaced by
+    ``jax.process_index``)."""
+    if _amp_state.verbosity > 0:
+        if not rank0 or _is_rank0():
+            print(msg)

@@ -1,0 +1,133 @@
+"""amp.initialize — the mixed-precision entry point.
+
+Port of reference ``apex/amp/frontend.py:194-396``: validates the opt_level,
+applies its preset Properties, applies user overrides (with the reference's
+"Processing user overrides" prints), and wraps the model(s)/optimizer(s).
+
+Differences from the reference, by TPU design:
+
+- models are flax modules (or apply_fn callables); optimizers are optax
+  ``GradientTransformation``s or apex_tpu fused optimizers. The returned
+  ``AmpModel``/``AmpOptimizer`` are *stateless wrappers* — params and
+  optimizer state are created by ``model.init`` / ``optimizer.init`` and
+  threaded through the user's (jit-compiled) train step.
+- ``patch_torch_functions`` is accepted as an alias for ``cast_ops``.
+- default half dtype is bfloat16 (override with
+  ``cast_model_type=jnp.float16``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from apex_tpu.amp import _amp_state
+from apex_tpu.amp._amp_state import maybe_print
+from apex_tpu.amp.model import AmpModel
+from apex_tpu.amp.optimizer import AmpOptimizer
+from apex_tpu.amp.properties import Properties, opt_levels
+from apex_tpu.amp.scaler import LossScaler
+
+
+def initialize(
+    models,
+    optimizers=None,
+    enabled: bool = True,
+    opt_level: str = "O1",
+    cast_model_type=None,
+    cast_ops: Optional[bool] = None,
+    patch_torch_functions: Optional[bool] = None,
+    keep_batchnorm_fp32=None,
+    master_weights: Optional[bool] = None,
+    loss_scale=None,
+    min_loss_scale: Optional[float] = None,
+    max_loss_scale: float = 2.0 ** 24,
+    num_losses: int = 1,
+    verbosity: int = 1,
+    keep_fp32_patterns: Optional[Sequence[str]] = None,
+):
+    """Initialize models and optimizers for mixed-precision training.
+
+    Returns the same shape as its inputs: ``(model,)``-like single values if
+    singles were passed, lists if lists were passed; ``(models, optimizers)``
+    pair when optimizers is not None, else just models — matching the
+    reference's return-shape restoration (``_initialize.py:253-268``).
+    """
+    _amp_state._amp_state.verbosity = verbosity
+
+    if not enabled:
+        properties = Properties()
+        properties.enabled = False
+        _amp_state._amp_state.opt_properties = properties
+        if optimizers is None:
+            return _wrap_disabled_models(models, properties)
+        return (_wrap_disabled_models(models, properties),
+                _wrap_optimizers(optimizers, properties, num_losses,
+                                 min_loss_scale, max_loss_scale))
+
+    if opt_level not in opt_levels:
+        raise RuntimeError(
+            f"Unexpected optimization level {opt_level}. Options are 'O0', "
+            "'O1', 'O2', 'O3'. Note the prefix is the capital letter O, "
+            "not the number zero.")
+
+    properties = opt_levels[opt_level](Properties())
+    maybe_print(f"Selected optimization level {opt_level}", True)
+    maybe_print(f"Defaults for this optimization level are:", True)
+    for k, v in properties.options.items():
+        maybe_print(f"{k:24} : {v}", True)
+
+    if patch_torch_functions is not None and cast_ops is None:
+        cast_ops = patch_torch_functions
+    overrides = dict(cast_model_type=cast_model_type, cast_ops=cast_ops,
+                     keep_batchnorm_fp32=keep_batchnorm_fp32,
+                     master_weights=master_weights, loss_scale=loss_scale)
+    explicit = {k: v for k, v in overrides.items() if v is not None}
+    if explicit:
+        maybe_print("Processing user overrides (additional kwargs that are "
+                    "not None)...", True)
+        for k, v in explicit.items():
+            setattr(properties, k, v)
+    maybe_print("After processing overrides, optimization options are:", True)
+    for k, v in properties.options.items():
+        maybe_print(f"{k:24} : {v}", True)
+
+    _amp_state._amp_state.opt_properties = properties
+
+    single_model = not isinstance(models, list)
+    model_list = [models] if single_model else models
+    wrapped_models = [AmpModel(m, properties, keep_fp32_patterns)
+                      for m in model_list]
+    models_out = wrapped_models[0] if single_model else wrapped_models
+
+    if optimizers is None:
+        return models_out
+
+    optimizers_out = _wrap_optimizers(optimizers, properties, num_losses,
+                                      min_loss_scale, max_loss_scale)
+    return models_out, optimizers_out
+
+
+def _make_scaler(properties, min_loss_scale, max_loss_scale) -> LossScaler:
+    ls = properties.loss_scale
+    kwargs = dict(min_loss_scale=min_loss_scale,
+                  max_loss_scale=max_loss_scale)
+    if ls == "dynamic":
+        return LossScaler("dynamic", **kwargs)
+    return LossScaler(float(ls) if ls is not None else 1.0, **kwargs)
+
+
+def _wrap_optimizers(optimizers, properties, num_losses, min_loss_scale,
+                     max_loss_scale):
+    single = not isinstance(optimizers, list)
+    opt_list = [optimizers] if single else optimizers
+    scaler = _make_scaler(properties, min_loss_scale, max_loss_scale)
+    wrapped = [AmpOptimizer(o, scaler, num_losses=num_losses)
+               for o in opt_list]
+    return wrapped[0] if single else wrapped
+
+
+def _wrap_disabled_models(models, properties):
+    single = not isinstance(models, list)
+    model_list = [models] if single else models
+    wrapped = [AmpModel(m, properties) for m in model_list]
+    return wrapped[0] if single else wrapped

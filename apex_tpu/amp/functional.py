@@ -1,0 +1,104 @@
+"""User-facing precision decorators + master_params.
+
+The reference lets users register their own functions into the O1 casting
+machinery via ``amp.half_function`` / ``float_function`` /
+``promote_function`` (``apex/amp/amp.py:30-64``). Here the decorators wrap
+the function directly (no registry/monkey-patching): float array arguments
+are cast on the way in, at trace time, honoring ``disable_casts``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import _amp_state
+from apex_tpu.amp.model import applier
+from apex_tpu.amp.optimizer import AmpOptimizerState
+
+
+def _active_half_dtype():
+    props = _amp_state._amp_state.opt_properties
+    if props is None or not props.enabled or \
+            _amp_state._amp_state.casts_disabled:
+        return None
+    if props.cast_model_type not in (None, False):
+        return props.cast_model_type
+    if props.cast_ops:
+        return jnp.bfloat16
+    return None
+
+
+def _cast_args(args, kwargs, dtype):
+    args = tuple(applier(a, lambda x: x.astype(dtype)) for a in args)
+    kwargs = {k: applier(v, lambda x: x.astype(dtype))
+              for k, v in kwargs.items()}
+    return args, kwargs
+
+
+def half_function(fn):
+    """Run ``fn`` with float args cast to the active half dtype
+    (reference ``amp.py:30``)."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        dtype = _active_half_dtype()
+        if dtype is not None:
+            args, kwargs = _cast_args(args, kwargs, dtype)
+        return fn(*args, **kwargs)
+    return wrapper
+
+
+def float_function(fn):
+    """Run ``fn`` with float args cast to fp32 (reference ``amp.py:34``)."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        props = _amp_state._amp_state.opt_properties
+        if props is not None and props.enabled and not \
+                _amp_state._amp_state.casts_disabled:
+            args, kwargs = _cast_args(args, kwargs, jnp.float32)
+        return fn(*args, **kwargs)
+    return wrapper
+
+
+def promote_function(fn):
+    """Run ``fn`` with float args promoted to the widest float dtype among
+    them (reference ``amp.py:38``; widest-type promotion ``wrap.py:65-90``)."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        props = _amp_state._amp_state.opt_properties
+        if props is None or not props.enabled or \
+                _amp_state._amp_state.casts_disabled:
+            return fn(*args, **kwargs)
+        dtypes = []
+
+        def collect(x):
+            dtypes.append(x.dtype)
+            return x
+
+        applier(args, collect)
+        applier(kwargs, collect)
+        float_dtypes = [d for d in dtypes if jnp.issubdtype(d, jnp.floating)]
+        if not float_dtypes:
+            return fn(*args, **kwargs)
+        widest = jnp.result_type(*float_dtypes)
+        args, kwargs = _cast_args(args, kwargs, widest)
+        return fn(*args, **kwargs)
+    return wrapper
+
+
+def master_params(params):
+    """Iterate the fp32 master parameters (reference ``_amp_state.py:61``).
+
+    Under apex_tpu's design the canonical params *are* the masters for
+    O0-O2 (see ``apex_tpu/amp/model.py``), so this simply yields the leaves
+    of the given params pytree. Pass the params, not the optimizer state —
+    the optimizer state holds moments, not masters.
+    """
+    if isinstance(params, AmpOptimizerState):
+        raise TypeError(
+            "master_params takes the params pytree, not AmpOptimizerState "
+            "(the state holds optimizer moments; the canonical params are "
+            "the fp32 masters).")
+    yield from jax.tree_util.tree_leaves(params)

@@ -1,0 +1,206 @@
+"""amp option bag and O0-O3 optimization-level presets.
+
+Port of the validated ``Properties`` object and preset classes from the
+reference ``apex/amp/frontend.py:6-190``. The option semantics map to TPU
+as follows:
+
+- ``cast_model_type``: the "half" dtype. On TPU the default half type is
+  ``bfloat16`` (MXU-native, no loss scaling strictly required); ``float16``
+  is honored if the user asks for it.
+- ``patch_torch_functions`` (O1's torch-namespace monkey-patching) has no
+  honest analog in traced JAX; the equivalent knob here is ``cast_ops``:
+  compute runs in half via cast-at-apply while canonical params stay fp32,
+  with norm-layer params excluded by a module-path policy
+  (see ``apex_tpu/amp/model.py``). The attribute name is kept as an alias
+  so reference-style ``properties.patch_torch_functions`` reads work.
+- ``keep_batchnorm_fp32``, ``master_weights``, ``loss_scale``: same meaning
+  as the reference.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import jax.numpy as jnp
+
+
+class AmpOptimizationError(ValueError):
+    pass
+
+
+_OPTIONS = (
+    "enabled",
+    "opt_level",
+    "cast_model_type",
+    "cast_ops",
+    "keep_batchnorm_fp32",
+    "master_weights",
+    "loss_scale",
+)
+
+
+class Properties:
+    """Mutable, validated option bag (reference ``frontend.py:6-96``).
+
+    Options start unset (None) and are filled by an opt-level preset, then
+    optionally overridden one-by-one by ``amp.initialize`` kwargs —
+    overrides after the preset print a warning, matching the reference's
+    "Processing user overrides" flow (``frontend.py:334-347``).
+    """
+
+    def __init__(self):
+        self.options = {
+            "enabled": False,
+            "opt_level": None,
+            "cast_model_type": None,
+            "cast_ops": None,
+            "keep_batchnorm_fp32": None,
+            "master_weights": None,
+            "loss_scale": 1.0,
+        }
+
+    def _update_options_dict(self, new_options):
+        for k, v in new_options.items():
+            if k in self.options:
+                self.options[k] = v
+            else:
+                raise AmpOptimizationError(f"Tried to set unexpected option {k}")
+
+    def __getattr__(self, name):
+        if "options" in self.__dict__ and name in self.__dict__["options"]:
+            return self.options[name]
+        if name == "patch_torch_functions":  # reference-name alias
+            return self.options["cast_ops"]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if "options" not in self.__dict__:
+            super().__setattr__(name, value)
+            return
+        if name == "patch_torch_functions":
+            name = "cast_ops"
+        if name not in self.options:
+            super().__setattr__(name, value)
+            return
+        # validated setters (reference frontend.py:50-96)
+        if name == "cast_model_type":
+            if self.opt_level == "O1" and value is not None:
+                if value is not False and value != jnp.float32:
+                    warnings.warn(
+                        "O1 inserts casts around ops, not the model weights "
+                        "themselves, so with O1 cast_model_type is normally "
+                        "left None.")
+            value = _canonical_dtype(value)
+        elif name == "keep_batchnorm_fp32":
+            if isinstance(value, str):
+                if value not in ("True", "False"):
+                    raise AmpOptimizationError(
+                        f"keep_batchnorm_fp32 string must be 'True' or "
+                        f"'False'; got {value!r}")
+                value = value == "True"
+        elif name == "loss_scale":
+            if value != "dynamic" and value is not None:
+                value = float(value)
+        self.options[name] = value
+
+    def __repr__(self):
+        return "\n".join(f"{k:24}: {v}" for k, v in self.options.items())
+
+
+def _canonical_dtype(value):
+    """Accept torch-style strings/dtypes and map to jnp dtypes."""
+    if value is None or value is False:
+        return value
+    if isinstance(value, str):
+        value = {
+            "float16": jnp.float16, "fp16": jnp.float16, "half": jnp.float16,
+            "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+            "float32": jnp.float32, "fp32": jnp.float32, "float": jnp.float32,
+        }.get(value.lower(), value)
+        if isinstance(value, str):
+            raise AmpOptimizationError(f"Unrecognized dtype string {value!r}")
+    return value
+
+
+# TPU's native half type. The reference hardcodes torch.float16; on TPU the
+# MXU computes natively in bf16 and fp16 has no hardware advantage.
+HALF = jnp.bfloat16
+FLOAT = jnp.float32
+
+
+class O3:
+    """Pure half. "Speed of light" baseline (reference ``frontend.py:101``)."""
+
+    brief = "O3: Pure half-precision (speed-of-light baseline)."
+    more = ("Calls .astype(half) on the whole model and input data; no "
+            "master weights; static loss scale 1.0. On TPU half defaults to "
+            "bfloat16, so this is usually numerically fine, unlike fp16 O3 "
+            "on GPU. Try keep_batchnorm_fp32=True for stats stability.")
+
+    def __call__(self, properties):
+        properties.enabled = True
+        properties.opt_level = "O3"
+        properties.cast_model_type = HALF
+        properties.cast_ops = False
+        properties.keep_batchnorm_fp32 = False
+        properties.master_weights = False
+        properties.loss_scale = 1.0
+        return properties
+
+
+class O2:
+    """Half model + fp32 masters + dynamic scale (reference ``frontend.py:123``)."""
+
+    brief = "O2: Insert casts at the model boundary; fp32 master weights."
+    more = ("Model params and inputs run in half except batchnorm; the "
+            "canonical optimizer-side params are fp32 masters; dynamic loss "
+            "scaling by default.")
+
+    def __call__(self, properties):
+        properties.enabled = True
+        properties.opt_level = "O2"
+        properties.cast_model_type = HALF
+        properties.cast_ops = False
+        properties.keep_batchnorm_fp32 = True
+        properties.master_weights = True
+        properties.loss_scale = "dynamic"
+        return properties
+
+
+class O1:
+    """Op-policy mixed precision + dynamic scale (reference ``frontend.py:146``)."""
+
+    brief = "O1: Insert casts around MXU-bound ops (op-level policy)."
+    more = ("Canonical params stay fp32; compute is cast to half per the "
+            "module policy (norm layers and reductions in fp32). The TPU "
+            "re-design of the reference's torch-namespace patching.")
+
+    def __call__(self, properties):
+        properties.enabled = True
+        properties.opt_level = "O1"
+        properties.cast_model_type = None
+        properties.cast_ops = True
+        properties.keep_batchnorm_fp32 = None
+        properties.master_weights = None
+        properties.loss_scale = "dynamic"
+        return properties
+
+
+class O0:
+    """Pure fp32 baseline (reference ``frontend.py:168``)."""
+
+    brief = "O0: Pure fp32 training."
+    more = "Everything fp32; a useful accuracy baseline."
+
+    def __call__(self, properties):
+        properties.enabled = True
+        properties.opt_level = "O0"
+        properties.cast_model_type = jnp.float32
+        properties.cast_ops = False
+        properties.keep_batchnorm_fp32 = None
+        properties.master_weights = False
+        properties.loss_scale = 1.0
+        return properties
+
+
+opt_levels = {"O3": O3(), "O2": O2(), "O1": O1(), "O0": O0()}

@@ -126,9 +126,11 @@ def multi_tensor_axpby(a, x_tree: Pytree, b, y_tree: Pytree, *,
     a = jnp.asarray(a, jnp.float32)
     b = jnp.asarray(b, jnp.float32)
     x_leaves, treedef = jax.tree_util.tree_flatten(x_tree)
-    y_leaves = jax.tree_util.tree_leaves(y_tree)
-    if len(y_leaves) != len(x_leaves):
-        raise ValueError("x and y pytrees must have the same structure")
+    y_leaves, y_treedef = jax.tree_util.tree_flatten(y_tree)
+    if y_treedef != treedef:
+        raise ValueError(
+            f"x and y pytrees must have the same structure; got {treedef} "
+            f"vs {y_treedef}")
     outs, flags = [], []
     for x, y in zip(x_leaves, y_leaves):
         x32 = jnp.asarray(x).astype(jnp.float32)

@@ -1,0 +1,39 @@
+"""Shared helpers for Pallas TPU kernels: platform probing and 1-D tiling."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+# VPU lane width; last dim of every tile must be 128.
+LANES = 128
+# Default sublane rows per program for elementwise kernels: 512 rows x 128
+# lanes x 4 B = 256 KiB per fp32 buffer, comfortably inside 16 MB VMEM even
+# with several operands.
+DEFAULT_ROWS = 512
+
+
+def on_tpu() -> bool:
+    """True when the default backend lowers to a real TPU (incl. plugins
+    that canonicalize to tpu, e.g. 'axon')."""
+    try:
+        plat = jax.devices()[0].platform.lower()
+    except Exception:
+        return False
+    return plat not in ("cpu", "gpu", "cuda", "rocm")
+
+
+def pad_to_tiles(flat: jax.Array, rows: int = DEFAULT_ROWS):
+    """Pad a 1-D array to a multiple of rows*LANES and reshape to
+    (n_tiles*rows, LANES). Returns (tiled, original_length)."""
+    n = flat.shape[0]
+    tile = rows * LANES
+    padded = math.ceil(max(n, 1) / tile) * tile
+    flat = jnp.pad(flat, (0, padded - n))
+    return flat.reshape(padded // LANES, LANES), n
+
+
+def untile(tiled: jax.Array, n: int) -> jax.Array:
+    return tiled.reshape(-1)[:n]

@@ -5,4 +5,18 @@ FP16_Optimizer) and adds the LAMB optimizer class the reference shipped
 kernels for but never wrapped (``csrc/multi_tensor_lamb_stage_{1,2}.cu``).
 """
 
-__all__ = []
+from apex_tpu.optimizers.fused_adam import FusedAdam, FusedAdamState
+from apex_tpu.optimizers.fused_lamb import FusedLAMB, FusedLAMBState
+from apex_tpu.optimizers.fp16_optimizer import (
+    FP16_Optimizer,
+    FP16OptimizerState,
+)
+
+__all__ = [
+    "FP16_Optimizer",
+    "FP16OptimizerState",
+    "FusedAdam",
+    "FusedAdamState",
+    "FusedLAMB",
+    "FusedLAMBState",
+]

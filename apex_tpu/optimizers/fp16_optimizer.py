@@ -1,0 +1,114 @@
+"""FP16_Optimizer (cut-down, for FusedAdam) — flat fp32 master weights.
+
+Re-design of reference ``apex/optimizers/fp16_optimizer.py``: a wrapper
+designed only for FusedAdam that flattens each group's half params into one
+tensor and keeps a flat fp32 master copy (:61-67), computes the grad norm
+with -1 signalling overflow (:103-128), skips the step and adjusts its own
+dynamic scale on overflow (2^16 init, window 1000, factor 2, :73-86), and
+otherwise calls ``optimizer.step(grads=..., output_params=...)`` (:130-152).
+
+Functional form: the half params live in the train state; the flat fp32
+master + FusedAdam moments + scaler state live in ``FP16OptimizerState``.
+The overflow path is a branch-free select, so the whole step jits.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import LossScaler, LossScalerState
+from apex_tpu.ops.flatten import FlatSpec, flatten, flatten_like, unflatten
+from apex_tpu.optimizers.fused_adam import FusedAdam, FusedAdamState
+
+Pytree = Any
+
+
+class FP16OptimizerState(NamedTuple):
+    master: jax.Array            # f32 flat master weights
+    inner: FusedAdamState        # FusedAdam moments over the flat master
+    scaler: LossScalerState
+    spec: FlatSpec               # layout of the half-param pytree
+
+
+jax.tree_util.register_pytree_node(
+    FP16OptimizerState,
+    lambda s: ((s.master, s.inner, s.scaler), s.spec),
+    lambda spec, kids: FP16OptimizerState(kids[0], kids[1], kids[2], spec),
+)
+
+
+class _FlatParams(NamedTuple):
+    """Single-leaf pytree so FusedAdam can run directly on a flat buffer."""
+    flat: jax.Array
+
+
+class FP16_Optimizer:
+    def __init__(self, init_optimizer: FusedAdam,
+                 static_loss_scale: float = 1.0,
+                 dynamic_loss_scale: bool = False,
+                 dynamic_loss_args: dict | None = None,
+                 verbose: bool = False):
+        if not isinstance(init_optimizer, FusedAdam):
+            raise TypeError(
+                "apex_tpu.optimizers.FP16_Optimizer wraps FusedAdam only "
+                "(matching the reference's design); for general optimizers "
+                "use apex_tpu.fp16_utils.FP16_Optimizer or amp.initialize.")
+        self.optimizer = init_optimizer
+        args = dynamic_loss_args or {}
+        if dynamic_loss_scale:
+            # reference optimizers/fp16_optimizer.py:73-86
+            self.loss_scaler = LossScaler(
+                "dynamic", init_scale=args.get("init_scale", 2.0 ** 16),
+                scale_factor=args.get("scale_factor", 2.0),
+                scale_window=args.get("scale_window", 1000))
+        else:
+            self.loss_scaler = LossScaler(static_loss_scale)
+        self.verbose = verbose
+
+    def init(self, params_half: Pytree) -> FP16OptimizerState:
+        master, spec = flatten(params_half, dtype=jnp.float32)
+        return FP16OptimizerState(
+            master=master,
+            inner=self.optimizer.init(_FlatParams(master)),
+            scaler=self.loss_scaler.init(),
+            spec=spec)
+
+    # -- reference API ----------------------------------------------------
+    def scale_loss(self, loss, state: FP16OptimizerState):
+        """Replaces ``optimizer.backward(loss)``: scale the loss inside the
+        function being differentiated (reference ``backward`` :161-178)."""
+        return self.loss_scaler.scale_loss(loss, state.scaler)
+
+    def compute_grad_norm(self, grads: Pytree, state: FP16OptimizerState):
+        """fp32 grad norm; -1 flags overflow (reference :103-128)."""
+        g = flatten_like(grads, state.spec, dtype=jnp.float32)
+        norm = jnp.linalg.norm(g)
+        return jnp.where(jnp.isfinite(norm), norm, -1.0)
+
+    def step(self, params_half: Pytree, grads: Pytree,
+             state: FP16OptimizerState):
+        """Scaled half grads in; new half params out (reference :130-152)."""
+        g = flatten_like(grads, state.spec, dtype=jnp.float32)
+        norm = jnp.linalg.norm(g)
+        overflow = ~jnp.isfinite(norm)
+        new_scaler = self.loss_scaler.update(state.scaler, overflow)
+
+        new_master_p, new_inner = self.optimizer.step(
+            _FlatParams(state.master), _FlatParams(g), state.inner,
+            scale=state.scaler.loss_scale,
+            grad_norm=norm)
+        keep = ~overflow
+        sel = lambda t, f: jax.tree_util.tree_map(
+            lambda a, b: jnp.where(keep, a, b), t, f)
+        master = jnp.where(keep, new_master_p.flat, state.master)
+        inner = sel(new_inner, state.inner)
+        new_half = unflatten(master, state.spec)  # cast back to half dtypes
+        params_out = sel(new_half, params_half)
+        return params_out, FP16OptimizerState(
+            master=master, inner=inner, scaler=new_scaler, spec=state.spec)
+
+    def loss_scale(self, state: FP16OptimizerState):
+        return state.scaler.loss_scale

@@ -1,0 +1,127 @@
+"""FusedLAMB — layer-wise adaptive moments (LAMB) for large-batch training.
+
+The reference ships the two CUDA kernel stages
+(``csrc/multi_tensor_lamb_stage_1.cu``, ``_stage_2.cu``) but no Python
+optimizer class (SURVEY.md section 2.2) — BERT downstream code wires them
+up. This module provides the complete optimizer with the same math:
+
+stage 1 (``multi_tensor_lamb_stage_1.cu:84-116``):
+    clipped = global_grad_norm > max_grad_norm
+                  ? global_grad_norm / max_grad_norm : 1.0
+    g      = grad / clipped
+    m      = beta1*m + (1-beta1)*g ;  v = beta2*v + (1-beta2)*g^2
+    m_hat  = m / (1-beta1^t) ;        v_hat = v / (1-beta2^t)
+    update = m_hat / (sqrt(v_hat) + eps) + weight_decay * p
+
+stage 2 (``multi_tensor_lamb_stage_2.cu:139-187``):
+    ratio  = (||p|| > 0 and ||update|| > 0) ? ||p|| / ||update|| : 1.0
+    p     -= lr * ratio * update
+
+The trust ratio is per parameter *tensor*, so this operates on the pytree
+directly (per-leaf fused arithmetic — XLA fuses each leaf's chain; the
+norms come from ``multi_tensor_l2norm(per_tensor=True)`` exactly like the
+reference's l2norm kernel feeds stage 2).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.multi_tensor import multi_tensor_l2norm
+
+Pytree = Any
+
+
+class FusedLAMBState(NamedTuple):
+    step: jax.Array  # i32
+    m: Pytree        # f32, like params
+    v: Pytree        # f32, like params
+
+
+class FusedLAMB:
+    def __init__(self, lr: float = 1e-3, bias_correction: bool = True,
+                 betas: Tuple[float, float] = (0.9, 0.999),
+                 eps: float = 1e-6, weight_decay: float = 0.01,
+                 max_grad_norm: float = 1.0,
+                 trust_clip: Optional[float] = None,
+                 exclude_from_layer_adaptation=None):
+        """``exclude_from_layer_adaptation``: optional predicate
+        ``f(path) -> bool``; matching tensors use ratio 1.0 (the usual
+        BERT practice for bias/LayerNorm params)."""
+        self.lr = lr
+        self.bias_correction = bias_correction
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.max_grad_norm = max_grad_norm
+        self.trust_clip = trust_clip
+        self.exclude_from_layer_adaptation = exclude_from_layer_adaptation
+
+    def init(self, params: Pytree) -> FusedLAMBState:
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params)
+        return FusedLAMBState(step=jnp.asarray(0, jnp.int32), m=zeros,
+                              v=jax.tree_util.tree_map(jnp.copy, zeros))
+
+    def update(self, grads: Pytree, state: FusedLAMBState,
+               params: Optional[Pytree] = None):
+        if params is None:
+            raise ValueError("FusedLAMB.update requires params")
+        step = state.step + 1
+        beta1, beta2 = self.betas
+        t = step.astype(jnp.float32)
+        bc1 = 1.0 - beta1 ** t if self.bias_correction else 1.0
+        bc2 = 1.0 - beta2 ** t if self.bias_correction else 1.0
+
+        # stage 0: global grad-norm clipping
+        gnorm = multi_tensor_l2norm(grads)
+        clip = jnp.where(gnorm > self.max_grad_norm,
+                         gnorm / self.max_grad_norm, 1.0)
+
+        # stage 1: per-leaf adam-style update tensor
+        def stage1(g, m, v, p):
+            g = jnp.asarray(g, jnp.float32) / clip
+            p = jnp.asarray(p, jnp.float32)
+            m2 = beta1 * m + (1.0 - beta1) * g
+            v2 = beta2 * v + (1.0 - beta2) * g * g
+            upd = (m2 / bc1) / (jnp.sqrt(v2 / bc2) + self.eps) \
+                + self.weight_decay * p
+            return upd, m2, v2
+
+        triples = jax.tree_util.tree_map(stage1, grads, state.m, state.v,
+                                         params)
+        is_triple = lambda x: isinstance(x, tuple) and len(x) == 3 and \
+            all(hasattr(e, "dtype") for e in x)
+        leaves, treedef = jax.tree_util.tree_flatten(triples,
+                                                     is_leaf=is_triple)
+        updates = jax.tree_util.tree_unflatten(treedef,
+                                               [l[0] for l in leaves])
+        new_m = jax.tree_util.tree_unflatten(treedef, [l[1] for l in leaves])
+        new_v = jax.tree_util.tree_unflatten(treedef, [l[2] for l in leaves])
+
+        # stage 2: per-tensor trust ratio
+        _, p_norms = multi_tensor_l2norm(params, per_tensor=True)
+        _, u_norms = multi_tensor_l2norm(updates, per_tensor=True)
+
+        def stage2(path, upd, pn, un):
+            ratio = jnp.where((pn > 0) & (un > 0), pn / un, 1.0)
+            if self.trust_clip is not None:
+                ratio = jnp.minimum(ratio, self.trust_clip)
+            if self.exclude_from_layer_adaptation is not None and \
+                    self.exclude_from_layer_adaptation(path):
+                ratio = jnp.asarray(1.0, jnp.float32)
+            return -self.lr * ratio * upd
+
+        deltas = jax.tree_util.tree_map_with_path(stage2, updates, p_norms,
+                                                  u_norms)
+        deltas = jax.tree_util.tree_map(
+            lambda d, p: d.astype(jnp.asarray(p).dtype), deltas, params)
+        return deltas, FusedLAMBState(step=step, m=new_m, v=new_v)
+
+    def step(self, params: Pytree, grads: Pytree, state: FusedLAMBState):
+        import optax
+        deltas, new_state = self.update(grads, state, params)
+        return optax.apply_updates(params, deltas), new_state

@@ -1,0 +1,79 @@
+"""Benchmark entry point: prints ONE JSON line with the headline metric.
+
+Current benchmark: amp O2 train-step throughput on the flagship model
+(MLP placeholder until ResNet-50 lands). vs_baseline is the ratio against
+the fp32 (O0) throughput measured in the same run — the reference defines
+its baseline methodology the same way ("speed of light" O3 vs O1/O2
+comparisons, examples/imagenet/README.md) rather than publishing numbers.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def build_step(opt_level, batch=1024, d=784, hidden=1024, n_classes=10):
+    import flax.linen as nn
+    from apex_tpu import amp
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(hidden)(x)
+            x = nn.relu(x)
+            x = nn.Dense(hidden)(x)
+            x = nn.relu(x)
+            return nn.Dense(n_classes)(x)
+
+    model, optimizer = amp.initialize(
+        MLP(), optax.sgd(0.05), opt_level=opt_level, verbosity=0)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, d)))
+    opt_state = optimizer.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, x, y):
+        def loss_fn(p):
+            logits = model.apply(p, x).astype(jnp.float32)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+            with amp.scale_loss(loss, opt_state) as scaled:
+                return scaled, loss
+        (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        params, opt_state = optimizer.step(params, grads, opt_state)
+        return params, opt_state, loss
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch, d))
+    y = jnp.zeros((batch,), jnp.int32)
+    return train_step, params, opt_state, x, y, batch
+
+
+def measure(opt_level, iters=50):
+    step, params, opt_state, x, y, batch = build_step(opt_level)
+    # warmup/compile
+    params, opt_state, loss = step(params, opt_state, x, y)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, x, y)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return iters * batch / dt
+
+
+def main():
+    amp_ips = measure("O2")
+    fp32_ips = measure("O0")
+    print(json.dumps({
+        "metric": "amp_O2_train_throughput",
+        "value": round(amp_ips, 1),
+        "unit": "samples/sec",
+        "vs_baseline": round(amp_ips / fp32_ips, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,151 @@
+"""Opt-level policy conformance (reference tests/L0/run_amp/test_basic_casts.py).
+
+Checks each O-level produces the expected canonical (optimizer-side) and
+compute dtype layouts, and that frontend validation matches the reference.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from apex_tpu import amp
+
+
+class Net(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Dense(16)(x)
+        x = nn.BatchNorm(use_running_average=not train)(x)
+        x = nn.relu(x)
+        x = nn.LayerNorm()(x)
+        return nn.Dense(4)(x)
+
+
+def make(opt_level, **kw):
+    model, optimizer = amp.initialize(Net(), optax.sgd(0.1),
+                                      opt_level=opt_level, verbosity=0, **kw)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((2, 8)))
+    return model, optimizer, params
+
+
+def leaf_dtypes(tree):
+    return {jax.tree_util.keystr(p): l.dtype
+            for p, l in jax.tree_util.tree_flatten_with_path(tree)[0]}
+
+
+def test_O0_everything_fp32():
+    model, _, params = make("O0")
+    assert all(d == jnp.float32 for d in leaf_dtypes(params).values())
+    out = model.apply(params, jnp.ones((2, 8), jnp.bfloat16))
+    assert out.dtype == jnp.float32
+
+
+def test_O1_canonical_fp32_compute_mixed():
+    model, _, params = make("O1")
+    assert all(d == jnp.float32 for d in leaf_dtypes(params).values())
+    cv = leaf_dtypes(model.compute_variables(params))
+    for path, dt in cv.items():
+        if "BatchNorm" in path or "LayerNorm" in path:
+            assert dt == jnp.float32, path
+        else:
+            assert dt == jnp.bfloat16, path
+
+
+def test_O2_canonical_fp32_masters_compute_half_except_bn():
+    model, _, params = make("O2")
+    assert all(d == jnp.float32 for d in leaf_dtypes(params).values())
+    cv = leaf_dtypes(model.compute_variables(params))
+    for path, dt in cv.items():
+        if "BatchNorm" in path:
+            assert dt == jnp.float32, path
+        else:
+            assert dt == jnp.bfloat16, path
+
+
+def test_O3_params_half_no_masters():
+    model, _, params = make("O3")
+    assert all(d == jnp.bfloat16 for d in leaf_dtypes(params).values())
+
+
+def test_O3_keep_batchnorm_override():
+    model, _, params = make("O3", keep_batchnorm_fp32=True)
+    for path, dt in leaf_dtypes(params).items():
+        if "BatchNorm" in path:
+            assert dt == jnp.float32, path
+        else:
+            assert dt == jnp.bfloat16, path
+
+
+def test_fp16_override():
+    model, _, params = make("O2", cast_model_type=jnp.float16)
+    cv = leaf_dtypes(model.compute_variables(params))
+    assert any(d == jnp.float16 for d in cv.values())
+
+
+def test_bad_opt_level_raises():
+    with pytest.raises(RuntimeError, match="capital letter O"):
+        amp.initialize(Net(), optax.sgd(0.1), opt_level="02", verbosity=0)
+
+
+def test_keep_batchnorm_string_accepted():
+    make("O2", keep_batchnorm_fp32="True")
+    with pytest.raises(amp.AmpOptimizationError):
+        make("O2", keep_batchnorm_fp32="Yes")
+
+
+def test_loss_scale_numeric_static():
+    _, optimizer, params = make("O2", loss_scale=128.0)
+    st = optimizer.init(params)
+    assert float(st.loss_scalers[0].loss_scale) == 128.0
+    assert not optimizer.loss_scaler.dynamic
+
+
+def test_patch_torch_functions_alias():
+    model, _, _ = make("O1", patch_torch_functions=True)
+    assert model.properties.cast_ops is True
+    assert model.properties.patch_torch_functions is True
+
+
+def test_disabled_passthrough():
+    model, optimizer = amp.initialize(Net(), optax.sgd(0.1), enabled=False,
+                                      verbosity=0)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((2, 8)))
+    assert all(d == jnp.float32 for d in leaf_dtypes(params).values())
+    out = model.apply(params, jnp.ones((2, 8)))
+    assert out.dtype == jnp.float32
+
+
+def test_input_casting_only_floats():
+    model, _, params = make("O2")
+    x = jnp.ones((2, 8))
+    labels = jnp.zeros((2,), jnp.int32)
+    args, kwargs = model.cast_inputs((x, labels), {"y": jnp.ones((3,))})
+    assert args[0].dtype == jnp.bfloat16
+    assert args[1].dtype == jnp.int32  # int labels untouched
+    assert kwargs["y"].dtype == jnp.bfloat16
+
+
+def test_decorators():
+    amp.initialize(Net(), optax.sgd(0.1), opt_level="O1", verbosity=0)
+
+    @amp.half_function
+    def h(x):
+        return x
+
+    @amp.float_function
+    def f(x):
+        return x
+
+    @amp.promote_function
+    def p(x, y):
+        return x.astype(jnp.result_type(x, y))
+
+    x32 = jnp.ones((4,), jnp.float32)
+    x16 = jnp.ones((4,), jnp.bfloat16)
+    assert h(x32).dtype == jnp.bfloat16
+    assert f(x16).dtype == jnp.float32
+    assert p(x16, x32).dtype == jnp.float32
+    with amp.disable_casts():
+        assert h(x32).dtype == jnp.float32

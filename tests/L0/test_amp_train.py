@@ -1,0 +1,187 @@
+"""End-to-end amp training protocol tests.
+
+Mini version of the reference's de facto fault-injection suite
+(``tests/L0/run_amp/test_multiple_models_optimizers_losses.py``): opt-level
+cross product, injected-inf iterations vs fp32 reference, skip-step
+verification, per-loss scalers.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu import amp
+
+
+class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(32)(x)
+        x = nn.relu(x)
+        x = nn.Dense(10)(x)
+        return x
+
+
+def data(n=16, d=8, seed=0):
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    x = jax.random.normal(k1, (n, d))
+    y = jax.random.randint(k2, (n,), 0, 10)
+    return x, y
+
+
+def build(opt_level, **kw):
+    model, optimizer = amp.initialize(MLP(), optax.sgd(0.05),
+                                      opt_level=opt_level, verbosity=0, **kw)
+    params = model.init(jax.random.PRNGKey(1), jnp.ones((2, 8)))
+    opt_state = optimizer.init(params)
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        def loss_fn(p):
+            logits = model.apply(p, x).astype(jnp.float32)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+            with amp.scale_loss(loss, opt_state) as scaled:
+                return scaled, loss
+        (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        params, opt_state = optimizer.step(params, grads, opt_state)
+        return params, opt_state, loss
+
+    return model, optimizer, params, opt_state, step
+
+
+@pytest.mark.parametrize("opt_level", ["O0", "O1", "O2", "O3"])
+def test_loss_decreases(opt_level):
+    _, _, params, opt_state, step = build(opt_level)
+    x, y = data()
+    losses = []
+    for _ in range(20):
+        params, opt_state, loss = step(params, opt_state, x, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    assert np.isfinite(losses).all()
+
+
+@pytest.mark.parametrize("opt_level", ["O1", "O2"])
+def test_tracks_fp32_reference(opt_level):
+    """Mixed-precision loss trajectory must track the O0 trajectory."""
+    _, _, p0, s0, step0 = build("O0")
+    _, _, p1, s1, step1 = build(opt_level)
+    x, y = data()
+    for i in range(10):
+        p0, s0, l0 = step0(p0, s0, x, y)
+        p1, s1, l1 = step1(p1, s1, x, y)
+        assert abs(float(l0) - float(l1)) < 0.05, (i, float(l0), float(l1))
+
+
+def test_inf_injection_skips_step_and_halves_scale():
+    _, optimizer, params, opt_state, step = build("O2")
+    x, y = data()
+    params, opt_state, _ = step(params, opt_state, x, y)
+    scale_before = float(optimizer.loss_scale(opt_state))
+    p_before = jax.tree_util.tree_map(np.asarray, params)
+    x_bad = x.at[0, 0].set(jnp.inf)
+    params, opt_state, _ = step(params, opt_state, x_bad, y)
+    # skip-step: params unchanged, scale halved, skip counted
+    for a, b in zip(jax.tree_util.tree_leaves(p_before),
+                    jax.tree_util.tree_leaves(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert float(optimizer.loss_scale(opt_state)) == scale_before / 2
+    assert int(opt_state.skipped_steps) == 1
+    assert int(opt_state.applied_steps) == 1
+    # recovery: next clean step applies
+    params, opt_state, loss = step(params, opt_state, x, y)
+    assert int(opt_state.applied_steps) == 2
+    assert np.isfinite(float(loss))
+
+
+def test_scale_growth_after_window():
+    model, optimizer = amp.initialize(MLP(), optax.sgd(0.05), opt_level="O2",
+                                      verbosity=0)
+    optimizer.loss_scaler.scale_window = 3
+    params = model.init(jax.random.PRNGKey(1), jnp.ones((2, 8)))
+    opt_state = optimizer.init(params)
+    x, y = data()
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        def loss_fn(p):
+            logits = model.apply(p, x).astype(jnp.float32)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+            return amp.scale(loss, opt_state)
+        grads = jax.grad(loss_fn)(params)
+        return optimizer.step(params, grads, opt_state)
+
+    s0 = float(optimizer.loss_scale(opt_state))
+    for _ in range(3):
+        params, opt_state = step(params, opt_state, x, y)
+    assert float(optimizer.loss_scale(opt_state)) == s0 * 2
+
+
+def test_two_losses_independent_scalers():
+    model, optimizer = amp.initialize(MLP(), optax.sgd(0.05), opt_level="O2",
+                                      num_losses=2, verbosity=0)
+    params = model.init(jax.random.PRNGKey(1), jnp.ones((2, 8)))
+    opt_state = optimizer.init(params)
+    x, y = data()
+    assert len(opt_state.loss_scalers) == 2
+
+    @jax.jit
+    def step(params, opt_state, x0, x1, y):
+        def loss0(p):
+            logits = model.apply(p, x0).astype(jnp.float32)
+            return amp.scale(
+                optax.softmax_cross_entropy_with_integer_labels(
+                    logits, y).mean(), opt_state, loss_id=0)
+
+        def loss1(p):
+            logits = model.apply(p, x1).astype(jnp.float32)
+            return amp.scale(
+                optax.softmax_cross_entropy_with_integer_labels(
+                    logits, y).mean(), opt_state, loss_id=1)
+
+        g0 = jax.grad(loss0)(params)
+        g1 = jax.grad(loss1)(params)
+        g0, ov0, opt_state2 = optimizer.unscale_grads(g0, opt_state, 0)
+        g1, ov1, opt_state2 = optimizer.unscale_grads(g1, opt_state2, 1)
+        merged = jax.tree_util.tree_map(lambda a, b: a + b, g0, g1)
+        return optimizer.apply_gradients(params, merged, opt_state2,
+                                         ov0 | ov1)
+
+    x_bad = x.at[0, 0].set(jnp.inf)
+    params, opt_state = step(params, opt_state, x, x_bad, y)
+    # loss 1 overflowed: its scaler halved, loss 0's did not; step skipped
+    assert float(opt_state.loss_scalers[0].loss_scale) == 2.0 ** 16
+    assert float(opt_state.loss_scalers[1].loss_scale) == 2.0 ** 15
+    assert int(opt_state.skipped_steps) == 1
+
+
+def test_O2_grads_match_fp32_reference():
+    """Unscaled O2 grads approximately equal pure-fp32 grads (bf16 tol)."""
+    model, optimizer = amp.initialize(MLP(), optax.sgd(0.05), opt_level="O2",
+                                      verbosity=0)
+    params = model.init(jax.random.PRNGKey(1), jnp.ones((2, 8)))
+    opt_state = optimizer.init(params)
+    x, y = data()
+
+    def amp_loss(p):
+        logits = model.apply(p, x).astype(jnp.float32)
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        return amp.scale(loss, opt_state)
+
+    def ref_loss(p):
+        logits = model.unwrapped.apply(p, x)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+    g_amp = jax.grad(amp_loss)(params)
+    g_amp, overflow, _ = optimizer.unscale_grads(g_amp, opt_state)
+    assert not bool(overflow)
+    g_ref = jax.grad(ref_loss)(params)
+    for a, r in zip(jax.tree_util.tree_leaves(g_amp),
+                    jax.tree_util.tree_leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=0.05, atol=0.01)

@@ -1,0 +1,216 @@
+"""FusedAdam parity tests (reference tests/L0/run_mixed_adam/test_mixed_adam.py).
+
+Oracles: (1) an exact numpy replica of the reference CUDA kernel math
+(``fused_adam_cuda_kernel.cu:48-84``), tight tolerance; (2) optax.adam,
+loose tolerance (formulation differs by an eps-scale term, same as the
+reference's FusedAdam-vs-torch.optim.Adam comparison).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu.optimizers import FusedAdam, FP16_Optimizer
+
+
+def numpy_apex_adam(p, m, v, g, lr, beta1, beta2, eps, step, scale=1.0,
+                    wd=0.0, eps_inside=False, bias_correction=True):
+    g = g / scale
+    m = beta1 * m + (1 - beta1) * g
+    v = beta2 * v + (1 - beta2) * g * g
+    denom = np.sqrt(v + eps) if eps_inside else np.sqrt(v) + eps
+    if bias_correction:
+        step_size = lr * np.sqrt(1 - beta2 ** step) / (1 - beta1 ** step)
+    else:
+        step_size = lr
+    p = p - step_size * (m / denom + wd * p)
+    return p, m, v
+
+
+def params_tree(seed=0, n=1000):
+    rng = np.random.RandomState(seed)
+    return {"w": jnp.asarray(rng.randn(37, 13), jnp.float32),
+            "b": jnp.asarray(rng.randn(n), jnp.float32)}
+
+
+@pytest.mark.parametrize("eps_inside", [False, True])
+@pytest.mark.parametrize("wd", [0.0, 0.01])
+def test_matches_numpy_reference(eps_inside, wd):
+    params = params_tree()
+    opt = FusedAdam(lr=1e-2, eps_inside_sqrt=eps_inside, weight_decay=wd,
+                    use_pallas=False)
+    state = opt.init(params)
+    rng = np.random.RandomState(1)
+
+    np_p = {k: np.asarray(v, np.float64) for k, v in params.items()}
+    np_m = {k: np.zeros_like(v) for k, v in np_p.items()}
+    np_v = {k: np.zeros_like(v) for k, v in np_p.items()}
+
+    for step in range(1, 4):
+        grads = {k: jnp.asarray(rng.randn(*np.shape(v)), jnp.float32)
+                 for k, v in params.items()}
+        params, state = opt.step(params, grads, state)
+        for k in np_p:
+            np_p[k], np_m[k], np_v[k] = numpy_apex_adam(
+                np_p[k], np_m[k], np_v[k], np.asarray(grads[k], np.float64),
+                1e-2, 0.9, 0.999, 1e-8, step, wd=wd, eps_inside=eps_inside)
+    for k in np_p:
+        np.testing.assert_allclose(np.asarray(params[k]), np_p[k],
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_close_to_optax_adam():
+    params = params_tree()
+    opt = FusedAdam(lr=1e-3, use_pallas=False)
+    state = opt.init(params)
+    ox = optax.adam(1e-3)
+    ox_state = ox.init(params)
+    ox_params = params
+    rng = np.random.RandomState(2)
+    for _ in range(5):
+        grads = {k: jnp.asarray(rng.randn(*np.shape(v)), jnp.float32)
+                 for k, v in params.items()}
+        params, state = opt.step(params, grads, state)
+        upd, ox_state = ox.update(grads, ox_state, ox_params)
+        ox_params = optax.apply_updates(ox_params, upd)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(params[k]),
+                                   np.asarray(ox_params[k]),
+                                   rtol=1e-3, atol=1e-5)
+
+
+def test_pallas_interpret_matches_jnp():
+    """Fused (Pallas) vs pure-jnp within tight tolerance — the TPU version
+    of the reference's L1 'with/without extensions' parity gate (bitwise is
+    only required between interpret and compiled runs of the *same* kernel;
+    differently-fused XLA programs legitimately differ in the last ulp)."""
+    params = params_tree(n=5000)
+    grads = {k: jnp.asarray(np.random.RandomState(3).randn(*np.shape(v)),
+                            jnp.float32) for k, v in params.items()}
+    outs = {}
+    for use_pallas in (False, True):
+        opt = FusedAdam(lr=1e-2, weight_decay=0.01, use_pallas=use_pallas)
+        state = opt.init(params)
+        p, state = opt.step(params, grads, state)
+        p, state = opt.step(p, grads, state)
+        outs[use_pallas] = p
+    for k in params:
+        np.testing.assert_allclose(np.asarray(outs[False][k]),
+                                   np.asarray(outs[True][k]),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_scale_divides_grads():
+    params = params_tree()
+    grads = {k: jnp.ones_like(v) * 8.0 for k, v in params.items()}
+    opt = FusedAdam(lr=1e-2, use_pallas=False)
+    s1 = opt.init(params)
+    p_scaled, _ = opt.step(params, grads, s1, scale=8.0)
+    s2 = opt.init(params)
+    unit = {k: jnp.ones_like(v) for k, v in params.items()}
+    p_unit, _ = opt.step(params, unit, s2, scale=1.0)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(p_scaled[k]),
+                                   np.asarray(p_unit[k]), rtol=1e-6)
+
+
+def test_max_grad_norm_clips():
+    """Clipping folds into combined_scale: a step with max_grad_norm=M on
+    grads of norm N>M must equal a step with scale=N/M and no clipping
+    (reference fused_adam.py:98-104)."""
+    params = {"w": jnp.ones((4,), jnp.float32)}
+    grads = {"w": jnp.full((4,), 100.0)}  # norm 200
+    opt = FusedAdam(lr=0.1, bias_correction=False, max_grad_norm=1.0,
+                    use_pallas=False)
+    state = opt.init(params)
+    p_clip, _ = opt.step(params, grads, state)
+
+    opt2 = FusedAdam(lr=0.1, bias_correction=False, use_pallas=False)
+    st2 = opt2.init(params)
+    p_scaled, _ = opt2.step(params, grads, st2, scale=200.0)
+    np.testing.assert_allclose(np.asarray(p_clip["w"]),
+                               np.asarray(p_scaled["w"]), rtol=1e-6)
+
+    # norm below the threshold: no clipping, matches scale=1
+    small = {"w": jnp.full((4,), 0.001)}
+    st3 = opt.init(params)
+    p3, _ = opt.step(params, small, st3)
+    st4 = opt2.init(params)
+    p4, _ = opt2.step(params, small, st4)
+    np.testing.assert_allclose(np.asarray(p3["w"]), np.asarray(p4["w"]),
+                               rtol=1e-6)
+
+
+def test_amsgrad_rejected():
+    with pytest.raises(RuntimeError, match="AMSGrad"):
+        FusedAdam(amsgrad=True)
+
+
+def test_output_params_dtype():
+    params = params_tree()
+    grads = {k: jnp.ones_like(v) for k, v in params.items()}
+    opt = FusedAdam(use_pallas=False)
+    state = opt.init(params)
+    p_half, _ = opt.step(params, grads, state,
+                         output_params_dtype=jnp.bfloat16)
+    assert all(v.dtype == jnp.bfloat16
+               for v in jax.tree_util.tree_leaves(p_half))
+
+
+def test_optax_protocol_with_amp():
+    """FusedAdam slots into amp.initialize as the inner optimizer."""
+    import flax.linen as nn
+    from apex_tpu import amp
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4)(x)
+
+    model, optimizer = amp.initialize(Tiny(), FusedAdam(lr=0.05,
+                                                        use_pallas=False),
+                                      opt_level="O2", verbosity=0)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((2, 8)))
+    opt_state = optimizer.init(params)
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        def loss_fn(p):
+            out = model.apply(p, x).astype(jnp.float32)
+            return amp.scale(jnp.mean((out - y) ** 2), opt_state)
+        grads = jax.grad(loss_fn)(params)
+        return optimizer.step(params, grads, opt_state)
+
+    x = jnp.ones((2, 8))
+    y = jnp.ones((2, 4))
+    losses = []
+    for _ in range(10):
+        params, opt_state = step(params, opt_state, x, y)
+        out = model.apply(params, x).astype(jnp.float32)
+        losses.append(float(jnp.mean((out - y) ** 2)))
+    assert losses[-1] < losses[0]
+
+
+def test_fp16_optimizer_protocol():
+    """FP16_Optimizer: half params, flat fp32 masters, overflow skip."""
+    half = {"w": jnp.ones((8, 8), jnp.bfloat16),
+            "b": jnp.zeros((8,), jnp.bfloat16)}
+    fp16_opt = FP16_Optimizer(FusedAdam(lr=0.1, use_pallas=False),
+                              dynamic_loss_scale=True)
+    state = fp16_opt.init(half)
+    assert state.master.dtype == jnp.float32
+    scale0 = float(fp16_opt.loss_scale(state))
+
+    grads = {"w": jnp.full((8, 8), scale0, jnp.bfloat16),
+             "b": jnp.full((8,), scale0, jnp.bfloat16)}
+    new_half, state = fp16_opt.step(half, grads, state)
+    assert new_half["w"].dtype == jnp.bfloat16
+    assert not np.allclose(np.asarray(new_half["w"], np.float32), 1.0)
+
+    bad = {"w": grads["w"].at[0, 0].set(jnp.inf), "b": grads["b"]}
+    frozen, state = fp16_opt.step(new_half, bad, state)
+    np.testing.assert_array_equal(np.asarray(frozen["w"], np.float32),
+                                  np.asarray(new_half["w"], np.float32))
+    assert float(fp16_opt.loss_scale(state)) == scale0 / 2

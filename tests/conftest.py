@@ -3,18 +3,35 @@
 Multi-chip TPU hardware is not available in CI; sharding/collective tests
 run against XLA's host-platform device partitioning instead (the driver
 separately dry-runs the multi-chip path via __graft_entry__.dryrun_multichip).
-Env must be set before jax is first imported, hence module scope here.
+
+Note: this environment may auto-register an experimental TPU plugin at
+interpreter startup (sitecustomize) and programmatically override
+jax_platforms, so setting env vars is not enough — we must also win the
+jax.config fight before any backend initializes.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax._src import xla_bridge as _xb
+    if _xb.backends_are_initialized():  # a plugin touched backends already
+        from jax.extend.backend import clear_backends
+        clear_backends()
+except Exception:
+    pass
+
+assert len(jax.devices()) >= 8, (
+    f"test harness expected >=8 CPU devices, got {jax.devices()}")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
