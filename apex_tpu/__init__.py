@@ -12,10 +12,12 @@ SunDoge/apex snapshot, see SURVEY.md) designed for TPUs from the ground up:
   carried overflow flags, the TPU equivalent of the reference's ``amp_C``
   CUDA extension.
 - ``apex_tpu.parallel``: data-parallel training over ``jax.sharding.Mesh``
-  axes (``psum``/``pmean`` over ICI), synchronized BatchNorm, LARC.
-  [in progress — currently stubs]
-- ``apex_tpu.normalization``: FusedLayerNorm backed by Pallas kernels.
-  [in progress — currently stubs]
+  axes (``psum``/``pmean`` over ICI), DistributedDataParallel/Reducer with
+  the reference's numeric policy knobs, synchronized BatchNorm with exact
+  parallel-variance stat merges and process groups, LARC, multi-host
+  bootstrap.
+- ``apex_tpu.normalization``: FusedLayerNorm backed by Pallas forward and
+  backward kernels (jnp fallback on CPU).
 - ``apex_tpu.fp16_utils``: manual mixed-precision toolkit (legacy API).
   [in progress — currently stubs]
 - Planned: ``apex_tpu.RNN``, ``apex_tpu.reparameterization``.

@@ -90,8 +90,6 @@ def applier(value, cast_fn: Callable):
         return type(value)(*(applier(v, cast_fn) for v in value))
     if isinstance(value, (list, tuple)):
         return type(value)(applier(v, cast_fn) for v in value)
-    if isinstance(value, float):
-        return cast_fn(jnp.asarray(value))
     return value
 
 

@@ -1,3 +1,13 @@
 """apex_tpu.normalization — fused normalization layers (Pallas-backed)."""
 
-__all__ = []
+from apex_tpu.normalization.fused_layer_norm import (
+    FusedLayerNorm,
+    fused_layer_norm,
+    fused_layer_norm_affine,
+)
+
+__all__ = [
+    "FusedLayerNorm",
+    "fused_layer_norm",
+    "fused_layer_norm_affine",
+]

@@ -19,6 +19,7 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.amp.optimizer import _tree_select
 from apex_tpu.amp.scaler import LossScaler, LossScalerState
 from apex_tpu.ops.flatten import FlatSpec, flatten, flatten_like, unflatten
 from apex_tpu.optimizers.fused_adam import FusedAdam, FusedAdamState
@@ -101,12 +102,10 @@ class FP16_Optimizer:
             scale=state.scaler.loss_scale,
             grad_norm=norm)
         keep = ~overflow
-        sel = lambda t, f: jax.tree_util.tree_map(
-            lambda a, b: jnp.where(keep, a, b), t, f)
         master = jnp.where(keep, new_master_p.flat, state.master)
-        inner = sel(new_inner, state.inner)
+        inner = _tree_select(keep, new_inner, state.inner)
         new_half = unflatten(master, state.spec)  # cast back to half dtypes
-        params_out = sel(new_half, params_half)
+        params_out = _tree_select(keep, new_half, params_half)
         return params_out, FP16OptimizerState(
             master=master, inner=inner, scaler=new_scaler, spec=state.spec)
 

@@ -1,0 +1,89 @@
+"""LARC — Layer-wise Adaptive Rate Control.
+
+Port of reference ``apex/parallel/LARC.py:133-224``: wraps any optimizer;
+before the inner step, each parameter tensor's gradient is rescaled by an
+adaptive local learning rate
+
+    local_lr = trust_coefficient * ||p|| / (||g|| + weight_decay*||p|| + eps)
+
+In ``clip`` mode the effective lr is ``min(local_lr, base_lr)`` — realized,
+as in the reference (:214-216), by scaling the gradient by
+``min(local_lr/base_lr, 1)`` and letting the inner optimizer apply base_lr.
+In scale mode the gradient is scaled by ``local_lr`` directly. Weight decay
+is absorbed into the gradient before scaling (:200-218) so the inner
+optimizer must not apply its own.
+
+The math is framework-agnostic; this class follows the optax
+GradientTransformation protocol (init/update) and also provides the
+apex-style ``step``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+class LARC:
+    def __init__(self, optimizer, trust_coefficient: float = 0.02,
+                 clip: bool = True, eps: float = 1e-8,
+                 weight_decay: float = 0.0,
+                 base_lr: Optional[float] = None):
+        """``base_lr`` is needed for clip mode; defaults to
+        ``optimizer.lr`` / ``optimizer.learning_rate`` when present."""
+        self.optimizer = optimizer
+        self.trust_coefficient = trust_coefficient
+        self.clip = clip
+        self.eps = eps
+        self.weight_decay = weight_decay
+        if base_lr is None:
+            base_lr = getattr(optimizer, "lr",
+                              getattr(optimizer, "learning_rate", None))
+        if self.clip and base_lr is None:
+            raise ValueError("LARC clip mode needs base_lr (could not infer "
+                             "from the wrapped optimizer)")
+        self.base_lr = base_lr
+
+    def _adapt(self, grads: Pytree, params: Pytree) -> Pytree:
+        def one(g, p):
+            g32 = jnp.asarray(g, jnp.float32)
+            p32 = jnp.asarray(p, jnp.float32)
+            pn = jnp.linalg.norm(p32)
+            gn = jnp.linalg.norm(g32)
+            safe = (pn > 0) & (gn > 0)
+            local_lr = self.trust_coefficient * pn / (
+                gn + self.weight_decay * pn + self.eps)
+            if self.clip:
+                scale = jnp.minimum(local_lr / self.base_lr, 1.0)
+            else:
+                scale = local_lr
+            adjusted = (g32 + self.weight_decay * p32) * scale
+            # reference skips the whole adaptation when either norm is 0
+            # (apex/parallel/LARC.py:82-92): grad passes through untouched
+            out = jnp.where(safe, adjusted, g32)
+            return out.astype(jnp.asarray(g).dtype)
+
+        return jax.tree_util.tree_map(one, grads, params)
+
+    # -- optax protocol ----------------------------------------------------
+    def init(self, params: Pytree):
+        return self.optimizer.init(params)
+
+    def update(self, grads: Pytree, state, params: Optional[Pytree] = None):
+        if params is None:
+            raise ValueError("LARC.update requires params")
+        return self.optimizer.update(self._adapt(grads, params), state,
+                                     params)
+
+    # -- apex-style --------------------------------------------------------
+    def step(self, params: Pytree, grads: Pytree, state):
+        import optax
+        if hasattr(self.optimizer, "step"):
+            return self.optimizer.step(params, self._adapt(grads, params),
+                                       state)
+        updates, state = self.update(grads, state, params)
+        return optax.apply_updates(params, updates), state

@@ -2,7 +2,47 @@
 
 Mirrors the reference ``apex/parallel`` (DistributedDataParallel, Reducer,
 SyncBatchNorm, LARC, multiproc) with ``jax.lax`` collectives over mesh axes
-in place of torch.distributed/NCCL.
+in place of torch.distributed/NCCL. See ``distributed.py`` for the mapping
+of the reference's overlap machinery onto XLA's scheduler.
 """
 
-__all__ = []
+from apex_tpu.parallel.mesh import ProcessGroup, create_process_group
+from apex_tpu.parallel.distributed import (
+    DistributedDataParallel,
+    Reducer,
+    all_gather_tree,
+    all_reduce_tree,
+    broadcast_params,
+)
+from apex_tpu.parallel.sync_batchnorm import (
+    SyncBatchNorm,
+    convert_syncbn_model,
+    merge_stats,
+    welford_combine,
+)
+from apex_tpu.parallel.LARC import LARC
+from apex_tpu.parallel.multiproc import initialize_distributed
+
+
+def create_syncbn_process_group(group_size: int, axis_name: str = "data",
+                                world_size=None) -> ProcessGroup:
+    """Reference-named alias (``apex/parallel/__init__.py:55``)."""
+    return create_process_group(axis_name, group_size, world_size)
+
+
+__all__ = [
+    "DistributedDataParallel",
+    "LARC",
+    "ProcessGroup",
+    "Reducer",
+    "SyncBatchNorm",
+    "all_gather_tree",
+    "all_reduce_tree",
+    "broadcast_params",
+    "convert_syncbn_model",
+    "create_process_group",
+    "create_syncbn_process_group",
+    "initialize_distributed",
+    "merge_stats",
+    "welford_combine",
+]

@@ -1,0 +1,80 @@
+"""Grouped collective primitives portable across TPU and CPU backends.
+
+XLA TPU supports replica groups (``axis_index_groups``) natively; the CPU
+host-platform backend in this JAX version hangs compiling grouped psum
+under shard_map. These wrappers use native replica groups on TPU and an
+equivalent all_gather+mask formulation elsewhere, so process-group code
+(SyncBN groups, grouped DDP) tests on the virtual CPU mesh and runs native
+on hardware.
+
+Group partitions must be equal-sized (guaranteed by
+``create_process_group``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from apex_tpu.ops.pallas_utils import on_tpu
+
+
+def _group_maps(groups) -> Tuple[np.ndarray, np.ndarray]:
+    """(rank->group id, group id -> member ranks) as static arrays."""
+    n_ranks = sum(len(g) for g in groups)
+    rank_to_group = np.zeros((n_ranks,), np.int32)
+    members = np.asarray(groups, np.int32)
+    for gid, g in enumerate(groups):
+        for r in g:
+            rank_to_group[r] = gid
+    return rank_to_group, members
+
+
+def psum_g(x, axis_name: str, groups: Optional[Sequence[Sequence[int]]] = None):
+    """psum over the axis, or within equal-sized groups of it."""
+    if groups is None:
+        return lax.psum(x, axis_name)
+    if on_tpu():
+        return lax.psum(x, axis_name, axis_index_groups=groups)
+    rank_to_group, _ = _group_maps(groups)
+    idx = lax.axis_index(axis_name)
+    my_gid = jnp.asarray(rank_to_group)[idx]
+    gathered = lax.all_gather(x, axis_name)           # (W, ...)
+    mask = (jnp.asarray(rank_to_group) == my_gid)
+    mask = mask.reshape((-1,) + (1,) * (gathered.ndim - 1))
+    return jnp.sum(jnp.where(mask, gathered, 0), axis=0)
+
+
+def pmean_g(x, axis_name: str, groups=None):
+    if groups is None:
+        return lax.pmean(x, axis_name)
+    return psum_g(x, axis_name, groups) / len(groups[0])
+
+
+def all_gather_g(x, axis_name: str, groups=None, *, axis: int = 0,
+                 tiled: bool = False):
+    """all_gather over the axis or within groups; group results stack the
+    group's members in group order."""
+    if groups is None:
+        return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+    if on_tpu():
+        return lax.all_gather(x, axis_name, axis=axis, tiled=tiled,
+                              axis_index_groups=groups)
+    rank_to_group, members = _group_maps(groups)
+    idx = lax.axis_index(axis_name)
+    my_gid = jnp.asarray(rank_to_group)[idx]
+    my_members = jnp.asarray(members)[my_gid]         # (G,) dynamic row
+    # gather untiled (one entry per rank on a new axis), select the group's
+    # members, then collapse the rank axis into `axis` if tiled output was
+    # requested — taking raw rank indices out of a tiled (concatenated)
+    # gather would pick shard rows, not rank blocks.
+    gathered = lax.all_gather(x, axis_name, axis=axis, tiled=False)
+    picked = jnp.take(gathered, my_members, axis=axis)  # (..., G, d, ...)
+    if not tiled:
+        return picked
+    shape = list(picked.shape)
+    shape[axis:axis + 2] = [shape[axis] * shape[axis + 1]]
+    return picked.reshape(shape)

@@ -1,0 +1,57 @@
+"""Process groups over mesh axes.
+
+The reference uses ``torch.distributed`` process groups; the TPU equivalent
+of a group is a mesh axis name plus an optional partition of that axis's
+indices (``axis_index_groups`` in ``jax.lax`` collectives). This module
+provides the group abstraction and the partition helper matching
+``create_syncbn_process_group`` (reference ``apex/parallel/__init__.py:55-92``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+import jax
+
+
+class ProcessGroup(NamedTuple):
+    """A collective scope: a mesh axis, optionally partitioned.
+
+    ``axis_index_groups=None`` means the whole axis (the default world
+    group). Pass to any apex_tpu collective helper or SyncBatchNorm.
+    """
+
+    axis_name: str = "data"
+    axis_index_groups: Optional[Tuple[Tuple[int, ...], ...]] = None
+
+    @property
+    def group_size(self) -> Optional[int]:
+        if self.axis_index_groups is None:
+            return None
+        return len(self.axis_index_groups[0])
+
+
+def create_process_group(axis_name: str = "data",
+                         group_size: Optional[int] = None,
+                         world_size: Optional[int] = None) -> ProcessGroup:
+    """Partition ``axis_name`` into contiguous groups of ``group_size``.
+
+    Mirrors ``create_syncbn_process_group(group_size)`` (reference
+    ``parallel/__init__.py:55``): requires world_size divisible by
+    group_size; rank r belongs to group r // group_size.
+
+    ``world_size`` defaults to the current global device count — pass it
+    explicitly when building groups for a mesh axis smaller than the world.
+    """
+    if group_size is None:
+        return ProcessGroup(axis_name, None)
+    if world_size is None:
+        world_size = jax.device_count()
+    if group_size <= 0 or world_size % group_size != 0:
+        raise ValueError(
+            f"group_size {group_size} must evenly divide world size "
+            f"{world_size} (reference requires the same)")
+    groups = tuple(
+        tuple(range(g * group_size, (g + 1) * group_size))
+        for g in range(world_size // group_size))
+    return ProcessGroup(axis_name, groups)

@@ -1,0 +1,112 @@
+"""FusedLayerNorm tests (reference tests/L0/run_fused_layer_norm/).
+
+Oracle: flax nn.LayerNorm / manual jnp math, forward and backward, with
+and without affine params, odd shapes, bf16 inputs, pallas-interpret path.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.normalization import (
+    FusedLayerNorm,
+    fused_layer_norm,
+    fused_layer_norm_affine,
+)
+
+
+@pytest.mark.parametrize("use_pallas", [False, True])
+@pytest.mark.parametrize("shape,norm_shape", [
+    ((4, 16), 16),
+    ((3, 5, 40), 40),        # odd rows, non-128 cols
+    ((2, 3, 4, 8), (4, 8)),  # multi-dim normalized_shape
+    ((7, 300), 300),         # cols > 2 lanes, odd
+])
+def test_forward_matches_reference(use_pallas, shape, norm_shape):
+    x = jnp.asarray(np.random.RandomState(0).randn(*shape), jnp.float32)
+    y = fused_layer_norm(x, norm_shape, use_pallas=use_pallas)
+    ns = (norm_shape,) if isinstance(norm_shape, int) else norm_shape
+    axes = tuple(range(x.ndim - len(ns), x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    ref = (x - mean) / jnp.sqrt(var + 1e-5)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-4,
+                               atol=1e-5)
+
+
+@pytest.mark.parametrize("use_pallas", [False, True])
+def test_affine_forward_and_grads(use_pallas):
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(6, 32), jnp.float32)
+    w = jnp.asarray(rng.rand(32) + 0.5, jnp.float32)
+    b = jnp.asarray(rng.randn(32), jnp.float32)
+
+    def fused(x, w, b):
+        return jnp.sum(
+            fused_layer_norm_affine(x, w, b, 32,
+                                    use_pallas=use_pallas) ** 2)
+
+    def ref(x, w, b):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return jnp.sum(((x - mean) / jnp.sqrt(var + 1e-5) * w + b) ** 2)
+
+    np.testing.assert_allclose(float(fused(x, w, b)), float(ref(x, w, b)),
+                               rtol=1e-4)
+    g_fused = jax.grad(fused, argnums=(0, 1, 2))(x, w, b)
+    g_ref = jax.grad(ref, argnums=(0, 1, 2))(x, w, b)
+    for a, r in zip(g_fused, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r), rtol=1e-3,
+                                   atol=1e-4)
+
+
+def test_bf16_input_fp32_stats():
+    x = jnp.asarray(np.random.RandomState(2).randn(8, 128), jnp.bfloat16)
+    y = fused_layer_norm(x, 128, use_pallas=True)
+    assert y.dtype == jnp.bfloat16
+    row = np.asarray(y[0], np.float32)
+    assert abs(row.mean()) < 0.05
+    assert abs(row.std() - 1.0) < 0.05
+
+
+def test_module_matches_flax_layernorm():
+    x = jnp.asarray(np.random.RandomState(3).randn(4, 10, 64), jnp.float32)
+    m = FusedLayerNorm(normalized_shape=64)
+    variables = m.init(jax.random.PRNGKey(0), x)
+    y = m.apply(variables, x)
+    ref_m = nn.LayerNorm(epsilon=1e-5)
+    ref_vars = ref_m.init(jax.random.PRNGKey(0), x)
+    y_ref = ref_m.apply(ref_vars, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_module_no_affine():
+    x = jnp.ones((2, 8))
+    m = FusedLayerNorm(normalized_shape=8, elementwise_affine=False)
+    variables = m.init(jax.random.PRNGKey(0), x)
+    assert "params" not in variables
+    y = m.apply(variables, x)
+    np.testing.assert_allclose(np.asarray(y), 0.0, atol=1e-3)
+
+
+def test_shape_mismatch_raises():
+    m = FusedLayerNorm(normalized_shape=16)
+    with pytest.raises(ValueError, match="normalized_shape"):
+        m.init(jax.random.PRNGKey(0), jnp.ones((2, 8)))
+
+
+def test_pallas_matches_jnp_path():
+    x = jnp.asarray(np.random.RandomState(4).randn(13, 200), jnp.float32)
+    y_p = fused_layer_norm(x, 200, use_pallas=True)
+    y_j = fused_layer_norm(x, 200, use_pallas=False)
+    np.testing.assert_allclose(np.asarray(y_p), np.asarray(y_j), rtol=1e-5,
+                               atol=1e-6)
+    g_p = jax.grad(lambda x: jnp.sum(
+        fused_layer_norm(x, 200, use_pallas=True) ** 3))(x)
+    g_j = jax.grad(lambda x: jnp.sum(
+        fused_layer_norm(x, 200, use_pallas=False) ** 3))(x)
+    np.testing.assert_allclose(np.asarray(g_p), np.asarray(g_j), rtol=1e-4,
+                               atol=1e-5)

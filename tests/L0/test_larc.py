@@ -1,0 +1,69 @@
+"""LARC tests vs numpy replica of reference apex/parallel/LARC.py math."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu.parallel import LARC
+from apex_tpu.optimizers import FusedAdam
+
+
+def test_clip_mode_matches_numpy():
+    lr = 0.1
+    tc = 0.02
+    wd = 0.01
+    p = np.array([3.0, 4.0], np.float32)          # ||p|| = 5
+    g = np.array([0.6, 0.8], np.float32)          # ||g|| = 1
+    local_lr = tc * 5 / (1 + wd * 5 + 1e-8)
+    scale = min(local_lr / lr, 1.0)
+    expected_g = (g + wd * p) * scale
+
+    larc = LARC(optax.sgd(lr), trust_coefficient=tc, weight_decay=wd,
+                base_lr=lr)
+    state = larc.init({"w": jnp.asarray(p)})
+    updates, _ = larc.update({"w": jnp.asarray(g)}, state,
+                             {"w": jnp.asarray(p)})
+    np.testing.assert_allclose(np.asarray(updates["w"]), -lr * expected_g,
+                               rtol=1e-5)
+
+
+def test_scale_mode():
+    tc = 0.02
+    p = np.array([3.0, 4.0], np.float32)
+    g = np.array([0.6, 0.8], np.float32)
+    local_lr = tc * 5 / 1.0
+    larc = LARC(optax.sgd(1.0), trust_coefficient=tc, clip=False,
+                base_lr=1.0)
+    state = larc.init({"w": jnp.asarray(p)})
+    updates, _ = larc.update({"w": jnp.asarray(g)}, state,
+                             {"w": jnp.asarray(p)})
+    np.testing.assert_allclose(np.asarray(updates["w"]), -local_lr * g,
+                               rtol=1e-4)
+
+
+def test_zero_norms_safe():
+    larc = LARC(optax.sgd(0.1), base_lr=0.1)
+    state = larc.init({"w": jnp.zeros((3,))})
+    updates, _ = larc.update({"w": jnp.zeros((3,))}, state,
+                             {"w": jnp.zeros((3,))})
+    assert np.all(np.isfinite(np.asarray(updates["w"])))
+
+
+def test_wraps_fused_adam_step():
+    p = {"w": jnp.ones((16,), jnp.float32)}
+    larc = LARC(FusedAdam(lr=0.05, use_pallas=False), base_lr=0.05)
+    state = larc.init(p)
+    g = {"w": jnp.full((16,), 0.1)}
+    p2, state = larc.step(p, g, state)
+    assert not np.allclose(np.asarray(p2["w"]), 1.0)
+    assert np.all(np.isfinite(np.asarray(p2["w"])))
+
+
+def test_clip_without_base_lr_raises():
+    class NoLR:
+        def init(self, p):
+            return None
+
+    with pytest.raises(ValueError, match="base_lr"):
+        LARC(NoLR())
