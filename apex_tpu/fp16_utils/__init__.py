@@ -1,7 +1,43 @@
 """apex_tpu.fp16_utils — manual mixed-precision toolkit (legacy API).
 
-Mirrors the reference ``apex/fp16_utils``: model half-conversion helpers,
-master-param copies, legacy loss scalers, and the general FP16_Optimizer.
+Mirrors the reference ``apex/fp16_utils`` (``__init__.py:1-16``): model
+half-conversion helpers, master-param copies, legacy loss scalers, and the
+general FP16_Optimizer — re-designed as pure functions over variable
+pytrees (see each module's docstring for the mapping). The amp API
+(``apex_tpu.amp``) supersedes this toolkit, exactly as in the reference.
 """
 
-__all__ = []
+from apex_tpu.fp16_utils.fp16util import (
+    BN_convert_float,
+    FP16Model,
+    clip_grad_norm,
+    convert_network,
+    convert_tree,
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    network_to_half,
+    prep_param_lists,
+    tofp16,
+)
+from apex_tpu.fp16_utils.loss_scaler import DynamicLossScaler, LossScaler
+from apex_tpu.fp16_utils.fp16_optimizer import (
+    FP16OptimizerState,
+    FP16_Optimizer,
+)
+
+__all__ = [
+    "BN_convert_float",
+    "DynamicLossScaler",
+    "FP16Model",
+    "FP16OptimizerState",
+    "FP16_Optimizer",
+    "LossScaler",
+    "clip_grad_norm",
+    "convert_network",
+    "convert_tree",
+    "master_params_to_model_params",
+    "model_grads_to_master_grads",
+    "network_to_half",
+    "prep_param_lists",
+    "tofp16",
+]

@@ -1,0 +1,152 @@
+"""General FP16_Optimizer: fp32 master weights around any optimizer.
+
+Re-design of reference ``apex/fp16_utils/fp16_optimizer.py`` (:13-643),
+the manual/explicit counterpart of amp O2. The reference splits params into
+fp16 / fp32-from-fp16 / fp32 groups (:126-157) and mutates
+``optimizer.param_groups``; here the model params stay one pytree (possibly
+mixed bf16/fp16/fp32 leaves) and the master copy is simply the fp32 cast of
+that tree — fp32 leaves get a same-value master, exactly matching the
+reference's "fp32_from_fp32" group semantics with zero bookkeeping.
+
+API mapping (reference -> here):
+
+- ``optimizer.backward(loss)`` (:462)        -> ``scale_loss(loss, state)``
+  inside the function being differentiated; autodiff produces scaled grads.
+- ``update_master_grads()`` (:525)           -> ``update_master_grads(grads,
+  state)`` returning fp32 master grads + overflow + new state.
+- ``clip_master_grads(max_norm)`` (:274)     -> ``clip_master_grads(...)``
+  pure function returning (clipped, norm).
+- ``step()`` (:361)                          -> ``step(params, grads, state)``
+  (runs the whole protocol; skip-on-overflow is a branch-free select).
+- ``state_dict``/``load_state_dict`` (:298-359, "option 2": masters saved
+  separately from the wrapped optimizer) -> pytree in/out helpers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.amp.optimizer import _tree_select
+from apex_tpu.amp.scaler import LossScaler, LossScalerState
+from apex_tpu.fp16_utils.fp16util import clip_grad_norm
+from apex_tpu.ops.multi_tensor import multi_tensor_unscale
+
+Pytree = Any
+
+
+class FP16OptimizerState(NamedTuple):
+    master: Pytree             # fp32 master params (same tree as model)
+    inner: Any                 # wrapped optimizer state (over masters)
+    scaler: LossScalerState
+
+
+class FP16_Optimizer:
+    """Master-weight wrapper for any optax ``GradientTransformation``.
+
+    ``static_loss_scale`` may be a float or the string ``"dynamic"``
+    (reference accepts both spellings, :83-124); or pass
+    ``dynamic_loss_scale=True``. Legacy dynamic defaults (2**16 init,
+    window 1000) follow the reference's FP16_Optimizer ctor.
+    """
+
+    def __init__(self, init_optimizer, static_loss_scale=1.0,
+                 dynamic_loss_scale: bool = False,
+                 dynamic_loss_args: Optional[dict] = None,
+                 verbose: bool = False):
+        self.optimizer = init_optimizer
+        if static_loss_scale == "dynamic":
+            dynamic_loss_scale = True
+        args = dynamic_loss_args or {}
+        if dynamic_loss_scale:
+            # legacy DynamicLossScaler defaults (reference loss_scaler.py:47:
+            # init 2**32, factor 2, window 1000) — NOT amp's 2**16/2000
+            self.loss_scaler = LossScaler(
+                "dynamic",
+                init_scale=args.get("init_scale", 2.0 ** 32),
+                scale_factor=args.get("scale_factor", 2.0),
+                scale_window=args.get("scale_window", 1000),
+                max_loss_scale=args.get("max_loss_scale", 2.0 ** 32))
+        else:
+            self.loss_scaler = LossScaler(float(static_loss_scale))
+        self.verbose = verbose
+
+    # -- state ------------------------------------------------------------
+    def init(self, params: Pytree) -> FP16OptimizerState:
+        master = jax.tree_util.tree_map(
+            lambda p: jnp.asarray(p).astype(jnp.float32), params)
+        return FP16OptimizerState(
+            master=master,
+            inner=self.optimizer.init(master),
+            scaler=self.loss_scaler.init())
+
+    # -- per-iteration protocol -------------------------------------------
+    def scale_loss(self, loss, state: FP16OptimizerState):
+        """Scaled loss to differentiate (replaces ``backward(loss)``)."""
+        return self.loss_scaler.scale_loss(loss, state.scaler)
+
+    def update_master_grads(self, grads: Pytree, state: FP16OptimizerState):
+        """Unscale model grads into fp32 master grads; detect overflow
+        (reference :525-580). Returns (master_grads, overflow, state with
+        updated scaler)."""
+        g, overflow = multi_tensor_unscale(
+            grads, state.scaler.loss_scale, out_dtype=jnp.float32)
+        new_scaler = self.loss_scaler.update(state.scaler, overflow)
+        return g, overflow, state._replace(scaler=new_scaler)
+
+    def clip_master_grads(self, master_grads: Pytree, max_norm: float,
+                          norm_type: float = 2.0):
+        """Clip fp32 master grads by global norm (reference :274-296).
+        Returns (clipped_grads, total_norm)."""
+        return clip_grad_norm(master_grads, max_norm, norm_type)
+
+    def step(self, params: Pytree, grads: Pytree, state: FP16OptimizerState,
+             *, max_grad_norm: Optional[float] = None
+             ) -> Tuple[Pytree, FP16OptimizerState]:
+        """Full protocol: unscale -> (clip) -> inner step on masters ->
+        skip-select -> cast masters back to model dtypes (reference
+        :361-460; the master->model copy is :452-457)."""
+        g, overflow, state = self.update_master_grads(grads, state)
+        if max_grad_norm is not None:
+            g, _ = self.clip_master_grads(g, max_grad_norm)
+        updates, new_inner = self.optimizer.update(g, state.inner,
+                                                   state.master)
+        new_master = optax.apply_updates(state.master, updates)
+        keep = ~overflow
+        master = _tree_select(keep, new_master, state.master)
+        inner = _tree_select(keep, new_inner, state.inner)
+        new_params = jax.tree_util.tree_map(
+            lambda p, m: m.astype(jnp.asarray(p).dtype), params, master)
+        params_out = _tree_select(keep, new_params, params)
+        return params_out, FP16OptimizerState(master=master, inner=inner,
+                                              scaler=state.scaler)
+
+    # -- checkpointing ----------------------------------------------------
+    def state_dict(self, state: FP16OptimizerState) -> dict:
+        """Serializable dict: masters + scaler saved alongside the inner
+        state — the reference's "option 2" layout (:298-317) where fp32
+        masters are first-class checkpoint content."""
+        return {
+            "master_params": state.master,
+            "optimizer_state": state.inner,
+            "loss_scaler": state.scaler._asdict(),
+        }
+
+    def load_state_dict(self, d: dict) -> FP16OptimizerState:
+        """Invert :meth:`state_dict` (reference :319-359)."""
+        return FP16OptimizerState(
+            master=d["master_params"],
+            inner=d["optimizer_state"],
+            scaler=LossScalerState(**d["loss_scaler"]))
+
+    # -- introspection ----------------------------------------------------
+    def loss_scale(self, state: FP16OptimizerState):
+        return state.scaler.loss_scale
+
+    def inspect_master_grad_data(self, master_grads: Pytree):
+        """Flat list of master-grad arrays (reference :582-615's debugging
+        aid)."""
+        return jax.tree_util.tree_leaves(master_grads)

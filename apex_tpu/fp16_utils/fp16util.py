@@ -1,0 +1,182 @@
+"""Manual mixed-precision conversion helpers (legacy toolkit).
+
+TPU re-design of reference ``apex/fp16_utils/fp16util.py``. The reference
+mutates ``nn.Module`` objects in place (``network_to_half`` :35,
+``convert_module``/``convert_network`` :44-71, ``prep_param_lists`` :90,
+``model_grads_to_master_grads`` :136, ``master_params_to_model_params``
+:158); here models are immutable variable pytrees, so every helper is a
+pure function over pytrees. Defaults use bfloat16 — the TPU half type —
+but fp16 works by passing ``dtype=jnp.float16``.
+
+The batchnorm-stays-fp32 rule (reference ``BN_convert_float`` :22,
+``convert_module`` skipping ``_BatchNorm`` :65-66) is expressed as a
+module-path pattern policy shared with ``apex_tpu.amp``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.model import (
+    BATCHNORM_PATTERNS,
+    applier,
+    cast_tree,
+    _path_matches,
+)
+from apex_tpu.ops.flatten import FlatSpec, flatten, flatten_like, unflatten
+from apex_tpu.ops.multi_tensor import multi_tensor_l2norm
+
+Pytree = Any
+
+DEFAULT_HALF = jnp.bfloat16
+
+
+def tofp16(value, dtype=DEFAULT_HALF):
+    """Cast float arrays inside any nested container to the half dtype.
+
+    The input-casting stage of the reference's ``tofp16`` module (:7-19),
+    as a function usable on batches/args rather than an nn.Module layer.
+    """
+    return applier(value, lambda x: x.astype(dtype))
+
+
+def BN_convert_float(variables: Pytree) -> Pytree:
+    """Return ``variables`` with leaves on BatchNorm module paths cast to
+    fp32, everything else untouched (reference ``BN_convert_float`` :22-32:
+    BN is numerically unstable in fp16).
+    """
+
+    def one(path, x):
+        x = jnp.asarray(x)
+        if (jnp.issubdtype(x.dtype, jnp.floating)
+                and _path_matches(path, BATCHNORM_PATTERNS)):
+            return x.astype(jnp.float32)
+        return x
+
+    return jax.tree_util.tree_map_with_path(one, variables)
+
+
+def convert_tree(variables: Pytree, dtype) -> Pytree:
+    """Cast every float leaf (params, buffers alike) to ``dtype`` —
+    the reference's ``convert_module`` (:44-57) without the BN exemption."""
+    return cast_tree(variables, dtype)
+
+
+def convert_network(variables: Pytree, dtype=DEFAULT_HALF) -> Pytree:
+    """BN-safe whole-network cast (reference ``convert_network`` :60-71):
+    float leaves go to ``dtype`` except those on BatchNorm paths, which
+    stay fp32. (The reference also re-flattens RNN params here :68-69; flax
+    RNN params are ordinary leaves so nothing extra is needed.)
+    """
+    return cast_tree(variables, dtype, except_patterns=BATCHNORM_PATTERNS)
+
+
+def network_to_half(variables: Pytree, dtype=DEFAULT_HALF) -> Pytree:
+    """Reference ``network_to_half`` (:35-41): BN-safe half conversion.
+    (Input casting, done there by prepending a ``tofp16`` layer, is the
+    caller's job here — or use :class:`FP16Model`.)"""
+    return convert_network(variables, dtype)
+
+
+class FP16Model:
+    """Half-precision wrapper around a flax module (reference ``FP16Model``
+    :73-87): converts the network BN-safely to the half dtype and casts
+    float inputs at apply time.
+    """
+
+    def __init__(self, network, dtype=DEFAULT_HALF):
+        self.network = network
+        self.dtype = dtype
+
+    def init(self, rngs, *args, **kwargs) -> Pytree:
+        args = tuple(tofp16(a, self.dtype) for a in args)
+        kwargs = {k: tofp16(v, self.dtype) for k, v in kwargs.items()}
+        return convert_network(self.network.init(rngs, *args, **kwargs),
+                               self.dtype)
+
+    def apply(self, variables: Pytree, *args, **kwargs):
+        args = tuple(tofp16(a, self.dtype) for a in args)
+        kwargs = {k: tofp16(v, self.dtype) for k, v in kwargs.items()}
+        return self.network.apply(variables, *args, **kwargs)
+
+    def __call__(self, variables: Pytree, *args, **kwargs):
+        return self.apply(variables, *args, **kwargs)
+
+
+def prep_param_lists(params: Pytree, flat_master: bool = False):
+    """Create fp32 master copies of ``params`` (reference :90-133).
+
+    Returns ``(model_params, master_params)`` where ``model_params`` is the
+    input pytree unchanged and ``master_params`` is an fp32 copy — either a
+    matching pytree, or, with ``flat_master=True``, a tuple
+    ``(flat_fp32, FlatSpec)`` holding one contiguous buffer (the reference
+    requires a single dtype for the flat path :99-104; here mixed dtypes are
+    simply promoted into the fp32 buffer).
+    """
+    if flat_master:
+        flat, spec = flatten(params, dtype=jnp.float32)
+        return params, (flat, spec)
+    return params, cast_tree(params, jnp.float32)
+
+
+def model_grads_to_master_grads(model_grads: Pytree,
+                                master_params=None,
+                                flat_master: bool = False):
+    """Cast model-layout grads to fp32 master layout (reference :136-155).
+
+    With ``flat_master=True``, ``master_params`` must be the
+    ``(flat, spec)`` pair from :func:`prep_param_lists` and a flat fp32 grad
+    buffer is returned; otherwise an fp32 grad pytree.
+    """
+    if flat_master:
+        if master_params is None:
+            raise ValueError(
+                "flat_master=True needs the (flat, spec) master pair")
+        _, spec = master_params
+        return flatten_like(model_grads, spec, dtype=jnp.float32)
+    return cast_tree(model_grads, jnp.float32)
+
+
+def master_params_to_model_params(model_params: Pytree, master_params,
+                                  flat_master: bool = False) -> Pytree:
+    """Copy master values back into the model's dtypes (reference :158-179).
+
+    Pure version: returns the new model-param pytree (leafwise cast of the
+    fp32 masters to each model leaf's dtype).
+    """
+    if flat_master:
+        flat, spec = master_params
+        return unflatten(flat, spec)
+    return jax.tree_util.tree_map(
+        lambda p, m: jnp.asarray(m).astype(jnp.asarray(p).dtype),
+        model_params, master_params)
+
+
+def clip_grad_norm(grads: Pytree, max_norm: float,
+                   norm_type: float = 2.0) -> Tuple[Pytree, jax.Array]:
+    """Global-norm gradient clipping (the reference re-exports torch's
+    ``clip_grad_norm`` with a version shim, :182-187; used by
+    ``FP16_Optimizer.clip_master_grads``).
+
+    Returns ``(clipped_grads, total_norm)``. Norm math in fp32; the clip
+    coefficient is branch-free so it jits.
+    """
+    leaves = jax.tree_util.tree_leaves(grads)
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(jnp.asarray(g).astype(jnp.float32)))
+             for g in leaves])) if leaves else jnp.asarray(0.0, jnp.float32)
+    elif norm_type == 2.0:
+        total = multi_tensor_l2norm(grads)
+    else:
+        p = float(norm_type)
+        acc = sum(jnp.sum(jnp.abs(jnp.asarray(g).astype(jnp.float32)) ** p)
+                  for g in leaves)
+        total = acc ** (1.0 / p)
+    coef = jnp.minimum(1.0, max_norm / (total + 1e-6))
+    clipped = jax.tree_util.tree_map(
+        lambda g: (jnp.asarray(g) * coef.astype(jnp.result_type(g))), grads)
+    return clipped, total

@@ -1,0 +1,90 @@
+"""Legacy loss scalers for the manual FP16_Optimizer API.
+
+Re-design of reference ``apex/fp16_utils/loss_scaler.py``: ``LossScaler``
+(static scale, :10-44) and ``DynamicLossScaler`` (:47-140; init 2**32,
+halve on overflow, double after 1000 clean steps). Unlike the jit-carried
+``apex_tpu.amp.LossScaler``, these are deliberately *stateful host-side
+objects* — the legacy API contract is eager: ``has_overflow`` inspects real
+gradient values (one device->host sync, mirroring the reference's per-param
+CPU check :84-110) and ``update_scale`` mutates the object. Use the amp
+scaler for fully-on-device training; use these for the legacy
+``fp16_utils.FP16_Optimizer`` workflow and for tests that need eager
+overflow probes.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.multi_tensor import tree_any_nonfinite
+
+Pytree = Any
+
+
+class LossScaler:
+    """Static loss scaler (reference :10-44): scale never changes; overflow
+    never reported."""
+
+    def __init__(self, scale: float = 1.0):
+        self.cur_scale = float(scale)
+
+    @property
+    def loss_scale(self) -> float:
+        return self.cur_scale
+
+    def has_overflow(self, grads: Pytree) -> bool:  # reference :21-23
+        return False
+
+    def update_scale(self, overflow: bool) -> None:  # reference :28-29
+        pass
+
+    def scale_gradient(self, grads: Pytree) -> Pytree:
+        """Multiply grads by the scale (reference ``scale_gradient`` :25-26
+        — a backward hook there; a pure tree map here)."""
+        return jax.tree_util.tree_map(
+            lambda g: g * jnp.asarray(self.cur_scale, g.dtype), grads)
+
+    def unscale_gradient(self, grads: Pytree) -> Pytree:
+        inv = 1.0 / self.cur_scale
+        return jax.tree_util.tree_map(
+            lambda g: (jnp.asarray(g).astype(jnp.float32) * inv), grads)
+
+    def backward(self, loss):
+        """Return the scaled loss (the reference calls
+        ``loss*scale; .backward()`` :31-44 — differentiation is the caller's
+        job in JAX)."""
+        return loss.astype(jnp.float32) * self.cur_scale
+
+
+class DynamicLossScaler(LossScaler):
+    """Dynamic loss scaler (reference :47-140): starts huge and backs off.
+
+    ``init_scale=2**32``, ``scale_factor=2``, ``scale_window=1000`` — note
+    these legacy defaults differ from amp's (2**16 / window 2000).
+    """
+
+    def __init__(self, init_scale: float = 2.0 ** 32,
+                 scale_factor: float = 2.0, scale_window: int = 1000):
+        super().__init__(init_scale)
+        self.scale_factor = float(scale_factor)
+        self.scale_window = int(scale_window)
+        self.last_overflow_iter = -1
+        self.iter = 0
+
+    def has_overflow(self, grads: Pytree) -> bool:
+        """Eager non-finite probe over all grads (reference
+        ``has_overflow``/``_has_inf_or_nan`` :84-110). One host sync."""
+        return bool(tree_any_nonfinite(grads))
+
+    def update_scale(self, overflow: bool) -> None:
+        """Reference :115-127: halve on overflow; double after
+        ``scale_window`` clean iterations."""
+        if overflow:
+            self.cur_scale = max(self.cur_scale / self.scale_factor, 1.0)
+            self.last_overflow_iter = self.iter
+        elif (self.iter - self.last_overflow_iter) % self.scale_window == 0:
+            self.cur_scale *= self.scale_factor
+        self.iter += 1

@@ -64,6 +64,10 @@ def all_gather_g(x, axis_name: str, groups=None, *, axis: int = 0,
         return lax.all_gather(x, axis_name, axis=axis, tiled=tiled,
                               axis_index_groups=groups)
     rank_to_group, members = _group_maps(groups)
+    # normalize negative axes against the *output* rank (tiled keeps the
+    # input rank; untiled inserts a new axis) so the slice arithmetic below
+    # can't wrap around
+    axis = axis % (jnp.ndim(x) if tiled else jnp.ndim(x) + 1)
     idx = lax.axis_index(axis_name)
     my_gid = jnp.asarray(rank_to_group)[idx]
     my_members = jnp.asarray(members)[my_gid]         # (G,) dynamic row

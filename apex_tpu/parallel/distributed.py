@@ -177,6 +177,7 @@ class DistributedDataParallel:
             n = len(pg.axis_index_groups[0])
         else:
             n = lax.psum(1, pg.axis_name)
+        n_world = lax.psum(1, pg.axis_name)
 
         def one(g):
             orig_dtype = g.dtype
@@ -187,8 +188,12 @@ class DistributedDataParallel:
             except AttributeError:
                 already_summed = False
             if already_summed:
+                # autodiff's implicit psum ran over the FULL axis, so the
+                # average divides by the world size — a sub-group mean is
+                # not recoverable from a world sum (grouped semantics need
+                # varying-typed grads, i.e. params passed through in_specs)
                 if self.gradient_average:
-                    g = g / n
+                    g = g / n_world
             else:
                 if self.gradient_predivide_factor != 1.0:
                     g = g / self.gradient_predivide_factor

@@ -195,12 +195,23 @@ def convert_syncbn_model(module: nn.Module,
                                process_group=process_group)
         if isinstance(obj, _ft.partial) and obj.func is nn.BatchNorm:
             kw = dict(obj.keywords)
+            if kw.get("axis", -1) != -1:
+                raise NotImplementedError(
+                    "SyncBatchNorm normalizes the last (channel-last) axis; "
+                    f"cannot convert BatchNorm(axis={kw['axis']})")
+            kw.pop("axis", None)
             if "momentum" in kw:
                 kw["momentum"] = 1.0 - kw["momentum"]
+            else:
+                kw["momentum"] = 1.0 - 0.99  # flax default -> torch 0.01
             kw.setdefault("axis_name", axis_name)
             kw.setdefault("process_group", process_group)
             return _ft.partial(SyncBatchNorm, *obj.args, **kw)
         if isinstance(obj, nn.BatchNorm):
+            if obj.axis != -1:
+                raise NotImplementedError(
+                    "SyncBatchNorm normalizes the last (channel-last) axis; "
+                    f"cannot convert BatchNorm(axis={obj.axis})")
             # flax momentum convention: running = m*running + (1-m)*batch
             return SyncBatchNorm(
                 use_running_average=obj.use_running_average,

@@ -1,0 +1,272 @@
+"""fp16_utils tests (model of reference tests/L0/run_fp16util/test_fp16util.py
+plus coverage for the legacy scalers and general FP16_Optimizer)."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu import fp16_utils
+from apex_tpu.fp16_utils import (
+    BN_convert_float,
+    DynamicLossScaler,
+    FP16Model,
+    FP16_Optimizer,
+    LossScaler,
+    clip_grad_norm,
+    convert_network,
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    network_to_half,
+    prep_param_lists,
+    tofp16,
+)
+
+
+class ConvBN(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = nn.Conv(8, (3, 3), name="conv1")(x)
+        x = nn.BatchNorm(use_running_average=not train, name="BatchNorm_0")(x)
+        x = nn.relu(x)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(4, name="head")(x)
+
+
+def make_variables():
+    m = ConvBN()
+    v = m.init(jax.random.PRNGKey(0), jnp.ones((2, 8, 8, 3)))
+    return m, v
+
+
+def leaf_dtypes(tree):
+    return {jax.tree_util.keystr(p): jnp.asarray(x).dtype
+            for p, x in jax.tree_util.tree_flatten_with_path(tree)[0]}
+
+
+# -- conversion helpers ----------------------------------------------------
+
+def test_convert_network_keeps_bn_fp32():
+    _, v = make_variables()
+    half = convert_network(v, jnp.bfloat16)
+    for path, dt in leaf_dtypes(half).items():
+        if "BatchNorm" in path:
+            assert dt == jnp.float32, path
+        else:
+            assert dt == jnp.bfloat16, path
+
+
+def test_network_to_half_fp16():
+    _, v = make_variables()
+    half = network_to_half(v, jnp.float16)
+    assert leaf_dtypes(half)["['params']['conv1']['kernel']"] == jnp.float16
+
+
+def test_bn_convert_float_restores_bn_only():
+    _, v = make_variables()
+    all_half = fp16_utils.convert_tree(v, jnp.bfloat16)
+    fixed = BN_convert_float(all_half)
+    dts = leaf_dtypes(fixed)
+    assert dts["['params']['BatchNorm_0']['scale']"] == jnp.float32
+    assert dts["['params']['conv1']['kernel']"] == jnp.bfloat16
+
+
+def test_tofp16_casts_only_floats():
+    batch = {"x": jnp.ones((2, 3), jnp.float32),
+             "y": jnp.zeros((2,), jnp.int32), "name": "b0"}
+    out = tofp16(batch, jnp.bfloat16)
+    assert out["x"].dtype == jnp.bfloat16
+    assert out["y"].dtype == jnp.int32
+    assert out["name"] == "b0"
+
+
+def test_fp16model_wrapper():
+    m, _ = make_variables()
+    fm = FP16Model(m, jnp.bfloat16)
+    v = fm.init(jax.random.PRNGKey(0), jnp.ones((2, 8, 8, 3)))
+    dts = leaf_dtypes(v)
+    assert dts["['params']['conv1']['kernel']"] == jnp.bfloat16
+    assert dts["['params']['BatchNorm_0']['scale']"] == jnp.float32
+    # BN params stay fp32, so post-BN activations promote to fp32 — fine.
+    out = fm.apply(v, jnp.ones((2, 8, 8, 3), jnp.float32))
+    assert np.isfinite(np.asarray(out, np.float32)).all()
+    # a norm-free model stays half end-to-end
+    dense = nn.Dense(4)
+    fd = FP16Model(dense, jnp.bfloat16)
+    vd = fd.init(jax.random.PRNGKey(0), jnp.ones((2, 3)))
+    assert fd.apply(vd, jnp.ones((2, 3), jnp.float32)).dtype == jnp.bfloat16
+
+
+# -- master-param helpers --------------------------------------------------
+
+def test_prep_param_lists_tree_master():
+    _, v = make_variables()
+    half = convert_network(v["params"], jnp.bfloat16)
+    model_p, master_p = prep_param_lists(half)
+    assert all(d == jnp.float32 for d in leaf_dtypes(master_p).values())
+    # values preserved up to the half rounding
+    a = jax.tree_util.tree_leaves(model_p)[0]
+    b = jax.tree_util.tree_leaves(master_p)[0]
+    np.testing.assert_allclose(np.asarray(a, np.float32), np.asarray(b),
+                               rtol=1e-2)
+
+
+def test_flat_master_roundtrip():
+    _, v = make_variables()
+    half = convert_network(v["params"], jnp.bfloat16)
+    model_p, (flat, spec) = prep_param_lists(half, flat_master=True)
+    assert flat.dtype == jnp.float32
+    assert flat.ndim == 1
+    back = master_params_to_model_params(model_p, (flat, spec),
+                                         flat_master=True)
+    for a, b in zip(jax.tree_util.tree_leaves(model_p),
+                    jax.tree_util.tree_leaves(back)):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                      np.asarray(b, np.float32))
+
+
+def test_model_grads_to_master_grads():
+    g = {"w": jnp.ones((3, 3), jnp.bfloat16)}
+    mg = model_grads_to_master_grads(g)
+    assert mg["w"].dtype == jnp.float32
+    _, master = prep_param_lists(g, flat_master=True)
+    flat_g = model_grads_to_master_grads(g, master, flat_master=True)
+    assert flat_g.shape == (9,) and flat_g.dtype == jnp.float32
+
+
+def test_master_params_to_model_params_casts_down():
+    model_p = {"w": jnp.zeros((2, 2), jnp.bfloat16),
+               "b": jnp.zeros((2,), jnp.float32)}
+    master = {"w": jnp.full((2, 2), 1.5, jnp.float32),
+              "b": jnp.full((2,), 2.5, jnp.float32)}
+    out = master_params_to_model_params(model_p, master)
+    assert out["w"].dtype == jnp.bfloat16
+    assert out["b"].dtype == jnp.float32
+    np.testing.assert_array_equal(np.asarray(out["b"]), 2.5)
+
+
+def test_clip_grad_norm():
+    g = {"a": jnp.full((4,), 3.0), "b": jnp.full((4,), 4.0)}
+    clipped, total = clip_grad_norm(g, max_norm=1.0)
+    np.testing.assert_allclose(float(total), 10.0, rtol=1e-6)
+    _, new_norm = clip_grad_norm(clipped, max_norm=1e9)
+    np.testing.assert_allclose(float(new_norm), 1.0, rtol=1e-4)
+    # under the max: unchanged
+    same, _ = clip_grad_norm(g, max_norm=100.0)
+    np.testing.assert_allclose(np.asarray(same["a"]), 3.0, rtol=1e-6)
+
+
+def test_clip_grad_norm_inf_norm():
+    g = {"a": jnp.asarray([-5.0, 2.0])}
+    _, total = clip_grad_norm(g, 1.0, norm_type=float("inf"))
+    assert float(total) == 5.0
+
+
+# -- legacy scalers --------------------------------------------------------
+
+def test_static_scaler_noop():
+    s = LossScaler(128.0)
+    assert s.loss_scale == 128.0
+    assert s.has_overflow({"g": jnp.asarray([jnp.inf])}) is False
+    s.update_scale(True)
+    assert s.loss_scale == 128.0
+
+
+def test_dynamic_scaler_legacy_defaults():
+    s = DynamicLossScaler()
+    assert s.loss_scale == 2.0 ** 32
+    assert s.scale_window == 1000
+
+
+def test_dynamic_scaler_overflow_and_growth():
+    s = DynamicLossScaler(init_scale=1024.0, scale_window=2)
+    assert s.has_overflow({"g": jnp.asarray([1.0, jnp.nan])})
+    s.update_scale(True)
+    assert s.loss_scale == 512.0
+    s.update_scale(False)   # iter 1 since overflow
+    s.update_scale(False)   # iter 2 -> doubles
+    assert s.loss_scale == 1024.0
+
+
+def test_dynamic_scaler_scale_gradient():
+    s = DynamicLossScaler(init_scale=4.0)
+    g = s.scale_gradient({"w": jnp.ones((2,))})
+    np.testing.assert_array_equal(np.asarray(g["w"]), 4.0)
+
+
+# -- general FP16_Optimizer ------------------------------------------------
+
+def quad_setup(dtype=jnp.bfloat16, **kw):
+    params = {"w": jnp.full((8,), 2.0, dtype)}
+    opt = FP16_Optimizer(optax.sgd(0.1), **kw)
+    state = opt.init(params)
+    return params, opt, state
+
+
+def quad_grads(params, opt, state, scale=True):
+    def loss_fn(p):
+        loss = jnp.sum(jnp.square(p["w"].astype(jnp.float32))) / 2
+        return opt.scale_loss(loss, state) if scale else loss
+    return jax.grad(loss_fn)(params)
+
+
+def test_fp16_optimizer_matches_fp32_sgd():
+    params, opt, state = quad_setup(static_loss_scale=128.0)
+    ref = np.full((8,), 2.0, np.float32)
+    for _ in range(5):
+        grads = quad_grads(params, opt, state)
+        params, state = opt.step(params, grads, state)
+        ref = ref - 0.1 * ref
+    # grads come from the bf16 model params, so the master trajectory tracks
+    # the fp32 one to bf16 resolution (the point of master weights is that
+    # *updates* accumulate in fp32, not that grads gain precision)
+    np.testing.assert_allclose(np.asarray(state.master["w"]), ref, rtol=1e-2)
+    np.testing.assert_allclose(np.asarray(params["w"], np.float32), ref,
+                               rtol=1e-2)
+    assert params["w"].dtype == jnp.bfloat16
+
+
+def test_fp16_optimizer_skips_on_overflow():
+    params, opt, state = quad_setup(dynamic_loss_scale=True)
+    scale0 = float(opt.loss_scale(state))
+    bad = {"w": jnp.full((8,), jnp.inf, jnp.bfloat16)}
+    params2, state2 = opt.step(params, bad, state)
+    np.testing.assert_array_equal(np.asarray(params2["w"], np.float32),
+                                  np.asarray(params["w"], np.float32))
+    assert float(opt.loss_scale(state2)) == scale0 / 2
+
+
+def test_fp16_optimizer_grad_clip():
+    params, opt, state = quad_setup(static_loss_scale=1.0)
+    big = {"w": jnp.full((8,), 100.0, jnp.bfloat16)}
+    p2, _ = opt.step(params, big, state, max_grad_norm=1.0)
+    moved = np.abs(np.asarray(p2["w"], np.float32)
+                   - np.asarray(params["w"], np.float32))
+    assert np.all(moved <= 0.1 * (1.0 / np.sqrt(8) + 1e-3) + 1e-2)
+
+
+def test_fp16_optimizer_state_dict_roundtrip():
+    params, opt, state = quad_setup(dynamic_loss_scale=True)
+    grads = quad_grads(params, opt, state)
+    params, state = opt.step(params, grads, state)
+    d = opt.state_dict(state)
+    restored = opt.load_state_dict(jax.tree_util.tree_map(lambda x: x, d))
+    for a, b in zip(jax.tree_util.tree_leaves(state),
+                    jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fp16_optimizer_step_jits():
+    params, opt, state = quad_setup(dynamic_loss_scale=True)
+
+    @jax.jit
+    def train_step(params, state):
+        grads = quad_grads(params, opt, state)
+        return opt.step(params, grads, state)
+
+    p2, s2 = train_step(params, state)
+    assert p2["w"].dtype == jnp.bfloat16
+    assert np.isfinite(np.asarray(s2.master["w"])).all()
