@@ -1,0 +1,208 @@
+"""RNN layer/stack machinery: lax.scan over time, layers composed in space.
+
+Re-design of reference ``apex/RNN/RNNBackend.py``. The reference runs a
+Python double loop — timestep outer, layer inner (``stackedRNN.forward``
+:122-148) — with hidden state stored *inside* the module. Neither survives
+contact with XLA: a Python loop over T unrolls into a huge graph, and
+module-held state breaks jit purity. Here:
+
+- each layer is one ``lax.scan`` over the time axis (compiles to a single
+  fused loop; the MXU sees one (B, in)x(in, gate) matmul per step);
+- layers run sequentially outside the scan — for stacked RNNs this is
+  mathematically identical to the reference's interleaved order;
+- hidden state is explicit: ``__call__`` takes and returns it. Pass the
+  previous window's final hidden to continue a sequence (the reference's
+  persistent ``self.hidden`` / ``detach_hidden`` protocol).
+
+Output conventions match the reference: input is time-major
+``(T, B, features)`` ("Always assumes input is NOT batch_first",
+``RNNBackend.py:236``); forward returns ``(output, hiddens)`` where
+``hiddens`` is a tuple over hidden-state slots of ``(layers, B, features)``
+stacks (``stackedRNN.forward`` docstring :114-120); with
+``collect_hidden=True`` each slot gains a leading time axis.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _uniform_init(scale: float):
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, -scale, scale)
+    return init
+
+
+class RNNCell(nn.Module):
+    """One recurrent layer: parameters + a time-axis scan.
+
+    Mirrors the reference ``RNNCell`` (``RNNBackend.py:232-268``):
+    ``gate_multiplier`` (4 LSTM-like, 3 GRU-like, 1 vanilla),
+    ``n_hidden_states`` (2 for (h, c), 1 for h), optional recurrent
+    projection when ``output_size != hidden_size`` (``w_ho``), uniform
+    ±1/sqrt(hidden_size) init (:283-290). ``cell`` is a pure function from
+    ``apex_tpu.RNN.cells``.
+    """
+
+    gate_multiplier: int
+    input_size: int
+    hidden_size: int
+    cell: Callable
+    n_hidden_states: int = 2
+    bias: bool = False
+    output_size: Optional[int] = None
+    param_dtype: Any = jnp.float32
+
+    @property
+    def out_size(self) -> int:
+        return self.output_size or self.hidden_size
+
+    def _params(self):
+        gate_size = self.gate_multiplier * self.hidden_size
+        stdev = 1.0 / math.sqrt(self.hidden_size)
+        u = _uniform_init(stdev)
+        p = {
+            "w_ih": self.param("w_ih", u, (gate_size, self.input_size),
+                               self.param_dtype),
+            "w_hh": self.param("w_hh", u, (gate_size, self.out_size),
+                               self.param_dtype),
+        }
+        if self.out_size != self.hidden_size:
+            p["w_ho"] = self.param("w_ho", u,
+                                   (self.out_size, self.hidden_size),
+                                   self.param_dtype)
+        if self.bias:
+            p["b_ih"] = self.param("b_ih", u, (gate_size,), self.param_dtype)
+            p["b_hh"] = self.param("b_hh", u, (gate_size,), self.param_dtype)
+        return p
+
+    def extra_params(self, p):
+        """Hook for subclasses adding parameters (mLSTM)."""
+        return p
+
+    def init_hidden(self, bsz: int, dtype) -> Tuple[jax.Array, ...]:
+        """Zero hidden state; slot 0 is the (possibly projected) output
+        size, the rest are hidden_size (reference ``init_hidden``
+        :305-320)."""
+        sizes = [self.out_size] + [self.hidden_size] * (self.n_hidden_states - 1)
+        return tuple(jnp.zeros((bsz, s), dtype) for s in sizes)
+
+    def step(self, p, x, hidden):
+        new = self.cell(x, hidden, p)
+        if self.out_size != self.hidden_size:
+            new = (new[0] @ p["w_ho"].T,) + new[1:]
+        return new
+
+    @nn.compact
+    def __call__(self, xs: jax.Array,
+                 hidden: Optional[Tuple[jax.Array, ...]] = None,
+                 reverse: bool = False, collect: bool = False):
+        """``xs (T, B, input)`` -> ``(ys (T, B, out), hidden)``.
+
+        ``hidden`` out is the final state tuple, or with ``collect=True``
+        every step's states, each ``(T, B, feat)``.
+        """
+        p = self.extra_params(self._params())
+        p = {k: v.astype(xs.dtype) for k, v in p.items()}
+        if hidden is None:
+            hidden = self.init_hidden(xs.shape[1], xs.dtype)
+
+        def body(carry, x):
+            new = self.step(p, x, carry)
+            return new, (new if collect else new[0])
+
+        final, out = lax.scan(body, hidden, xs, reverse=reverse)
+        if collect:
+            return out[0], out
+        return out, final
+
+
+class mLSTMRNNCell(RNNCell):
+    """Multiplicative-LSTM layer (reference ``apex/RNN/cells.py:12-53``):
+    an LSTM-like cell with extra multiplicative weights w_mih/w_mhh."""
+
+    def extra_params(self, p):
+        stdev = 1.0 / math.sqrt(self.hidden_size)
+        u = _uniform_init(stdev)
+        p["w_mih"] = self.param("w_mih", u,
+                                (self.out_size, self.input_size),
+                                self.param_dtype)
+        p["w_mhh"] = self.param("w_mhh", u,
+                                (self.out_size, self.out_size),
+                                self.param_dtype)
+        return p
+
+
+def _stack_hiddens(hiddens: Sequence[Tuple[jax.Array, ...]]):
+    """list over layers of per-layer hidden tuples -> tuple over slots of
+    (layers, B, feat) arrays (the reference's return layout)."""
+    n_slots = len(hiddens[0])
+    return tuple(jnp.stack([h[i] for h in hiddens]) for i in range(n_slots))
+
+
+class stackedRNN(nn.Module):
+    """Stack of recurrent layers (reference ``stackedRNN``,
+    ``RNNBackend.py:90-200``).
+
+    ``cells`` is a sequence of per-layer ``RNNCell`` module instances
+    (layer 0 takes the input size; later layers take the previous layer's
+    output size — the reference's ``new_like`` cloning :100-103).
+
+    Note: the reference *accepts* a ``dropout`` arg but never applies it in
+    ``forward``; here inter-layer dropout is actually applied when
+    ``deterministic=False`` (pass a ``"dropout"`` rng).
+    """
+
+    cells: Sequence[RNNCell]
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, xs, hidden=None, collect_hidden: bool = False,
+                 reverse: bool = False, deterministic: bool = True):
+        n_layers = len(self.cells)
+        if hidden is None:
+            hidden = [None] * n_layers
+        finals, out = [], xs
+        for i, cell in enumerate(self.cells):
+            ys, fin = cell(out, hidden[i], reverse=reverse,
+                           collect=collect_hidden)
+            finals.append(fin)
+            out = ys
+            if self.dropout > 0 and i < n_layers - 1:
+                out = nn.Dropout(self.dropout, deterministic=deterministic)(out)
+        if collect_hidden:
+            # per-layer tuples of (T, B, F) -> slot tuples of (T, L, B, F)
+            n_slots = len(finals[0])
+            hiddens = tuple(
+                jnp.stack([f[s] for f in finals], axis=1)
+                for s in range(n_slots))
+        else:
+            hiddens = _stack_hiddens(finals)
+        return out, hiddens
+
+
+class bidirectionalRNN(nn.Module):
+    """Forward + reverse stacks, features concatenated (reference
+    ``bidirectionalRNN``, ``RNNBackend.py:25-87``)."""
+
+    fwd: stackedRNN
+    bwd: stackedRNN
+
+    @nn.compact
+    def __call__(self, xs, hidden=None, collect_hidden: bool = False,
+                 deterministic: bool = True):
+        h_f, h_b = hidden if hidden is not None else (None, None)
+        out_f, hid_f = self.fwd(xs, h_f, collect_hidden=collect_hidden,
+                                deterministic=deterministic)
+        out_b, hid_b = self.bwd(xs, h_b, collect_hidden=collect_hidden,
+                                reverse=True, deterministic=deterministic)
+        out = jnp.concatenate([out_f, out_b], axis=-1)
+        hiddens = tuple(jnp.concatenate([f, b], axis=-1)
+                        for f, b in zip(hid_f, hid_b))
+        return out, hiddens

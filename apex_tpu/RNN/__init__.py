@@ -1,0 +1,29 @@
+"""apex_tpu.RNN — recurrent network library (reference ``apex/RNN``).
+
+Factories ``LSTM/GRU/ReLU/Tanh/mLSTM`` build flax RNN stacks whose layers
+compile to single ``lax.scan`` loops (vs the reference's per-timestep
+Python loop, ``RNNBackend.py:133-148``). Hidden state is explicit
+(functional) rather than stored in the module.
+"""
+
+from apex_tpu.RNN.models import GRU, LSTM, ReLU, Tanh, mLSTM
+from apex_tpu.RNN.RNNBackend import (
+    RNNCell,
+    bidirectionalRNN,
+    mLSTMRNNCell,
+    stackedRNN,
+)
+from apex_tpu.RNN import cells
+
+__all__ = [
+    "GRU",
+    "LSTM",
+    "RNNCell",
+    "ReLU",
+    "Tanh",
+    "bidirectionalRNN",
+    "cells",
+    "mLSTM",
+    "mLSTMRNNCell",
+    "stackedRNN",
+]

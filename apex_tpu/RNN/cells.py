@@ -1,0 +1,90 @@
+"""Pure RNN cell functions.
+
+The reference's cells (torch ``LSTMCell``/``GRUCell``/``RNNReLUCell``/
+``RNNTanhCell`` imported at ``apex/RNN/models.py:3`` plus the multiplicative
+``mLSTMCell`` at ``apex/RNN/cells.py:55``) are re-designed as pure
+``(x, hidden, params) -> hidden`` functions suitable for ``lax.scan``.
+Parameter layout matches the reference's ``RNNCell`` module
+(``RNNBackend.py:232-268``): ``w_ih (gate_size, input)``,
+``w_hh (gate_size, output)``, optional biases ``(gate_size,)``, and for
+mLSTM the multiplicative pair ``w_mih (output, input)``,
+``w_mhh (output, output)``. Gate order is torch's (i, f, g, o for LSTM;
+r, z, n for GRU) so weights port 1:1.
+
+All gate math runs in the input dtype (bf16 under amp) except the additive
+state update, which follows the inputs — XLA fuses the pointwise chain into
+the two matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, jax.Array]
+
+
+def _linear(x, w, b=None):
+    y = x @ w.T
+    return y + b if b is not None else y
+
+
+def rnn_tanh_cell(x, hidden, p: Params) -> Tuple[jax.Array]:
+    """Vanilla tanh RNN: ``h' = tanh(W_ih x + b_ih + W_hh h + b_hh)``."""
+    (h,) = hidden
+    return (jnp.tanh(_linear(x, p["w_ih"], p.get("b_ih"))
+                     + _linear(h, p["w_hh"], p.get("b_hh"))),)
+
+
+def rnn_relu_cell(x, hidden, p: Params) -> Tuple[jax.Array]:
+    (h,) = hidden
+    return (jax.nn.relu(_linear(x, p["w_ih"], p.get("b_ih"))
+                        + _linear(h, p["w_hh"], p.get("b_hh"))),)
+
+
+def lstm_cell(x, hidden, p: Params) -> Tuple[jax.Array, jax.Array]:
+    """Torch-order LSTM cell: gates chunk to (input, forget, cell, out)."""
+    h, c = hidden
+    gates = (_linear(x, p["w_ih"], p.get("b_ih"))
+             + _linear(h, p["w_hh"], p.get("b_hh")))
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f)
+    g = jnp.tanh(g)
+    o = jax.nn.sigmoid(o)
+    cy = f * c + i * g
+    hy = o * jnp.tanh(cy)
+    return hy, cy
+
+
+def gru_cell(x, hidden, p: Params) -> Tuple[jax.Array]:
+    """Torch GRU: ``n = tanh(i_n + r*h_n); h' = n + z*(h - n)``."""
+    (h,) = hidden
+    gi = _linear(x, p["w_ih"], p.get("b_ih"))
+    gh = _linear(h, p["w_hh"], p.get("b_hh"))
+    i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+    h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(i_r + h_r)
+    z = jax.nn.sigmoid(i_z + h_z)
+    n = jnp.tanh(i_n + r * h_n)
+    return (n + z * (h - n),)
+
+
+def mlstm_cell(x, hidden, p: Params) -> Tuple[jax.Array, jax.Array]:
+    """Multiplicative LSTM (reference ``apex/RNN/cells.py:55-84``):
+    the hidden-side gate input is computed from the multiplicative
+    intermediate ``m = (W_mih x) * (W_mhh h)`` instead of ``h`` itself."""
+    h, c = hidden
+    m = _linear(x, p["w_mih"]) * _linear(h, p["w_mhh"])
+    gates = (_linear(x, p["w_ih"], p.get("b_ih"))
+             + _linear(m, p["w_hh"], p.get("b_hh")))
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f)
+    g = jnp.tanh(g)
+    o = jax.nn.sigmoid(o)
+    cy = f * c + i * g
+    hy = o * jnp.tanh(cy)
+    return hy, cy

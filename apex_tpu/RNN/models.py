@@ -1,0 +1,90 @@
+"""RNN model factories: LSTM, GRU, ReLU, Tanh, mLSTM.
+
+Mirror of reference ``apex/RNN/models.py:19-52`` — each factory builds a
+per-layer cell stack and wraps it in ``stackedRNN`` or ``bidirectionalRNN``
+(``toRNNBackend`` :8-16). Returned objects are flax modules:
+
+    rnn = LSTM(input_size=32, hidden_size=64, num_layers=2)
+    vars_ = rnn.init(rng, xs)          # xs: (T, B, 32) time-major
+    out, (h, c) = rnn.apply(vars_, xs)
+
+``batch_first`` transposes input/output at the boundary (the reference
+accepts but ignores it — its RNNCell "Always assumes input is NOT
+batch_first", ``RNNBackend.py:236``; here it works).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.RNN.RNNBackend import (
+    RNNCell,
+    bidirectionalRNN,
+    mLSTMRNNCell,
+    stackedRNN,
+)
+from apex_tpu.RNN import cells as _cells
+
+
+class _BatchFirst(nn.Module):
+    """Transpose (B, T, F) <-> (T, B, F) around a time-major RNN."""
+
+    inner: nn.Module
+
+    @nn.compact
+    def __call__(self, xs, hidden=None, **kwargs):
+        out, hiddens = self.inner(jnp.swapaxes(xs, 0, 1), hidden, **kwargs)
+        return jnp.swapaxes(out, 0, 1), hiddens
+
+
+def _make_cells(cell_cls, gate_multiplier, input_size, hidden_size, cell_fn,
+                n_hidden_states, bias, output_size, num_layers):
+    """Layer 0 reads ``input_size``; deeper layers read the previous
+    layer's output size (reference ``new_like`` cloning,
+    ``RNNBackend.py:100-103``)."""
+    out_size = output_size or hidden_size
+    sizes = [input_size] + [out_size] * (num_layers - 1)
+    kwargs = dict(gate_multiplier=gate_multiplier, hidden_size=hidden_size,
+                  n_hidden_states=n_hidden_states, bias=bias,
+                  output_size=output_size)
+    if cell_fn is not None:
+        kwargs["cell"] = cell_fn
+    return tuple(cell_cls(input_size=s, **kwargs) for s in sizes)
+
+
+def _to_backend(cells_fwd, cells_bwd, bidirectional, dropout, batch_first):
+    if bidirectional:
+        rnn = bidirectionalRNN(fwd=stackedRNN(cells=cells_fwd, dropout=dropout),
+                               bwd=stackedRNN(cells=cells_bwd, dropout=dropout))
+    else:
+        rnn = stackedRNN(cells=cells_fwd, dropout=dropout)
+    return _BatchFirst(inner=rnn) if batch_first else rnn
+
+
+def _factory(gate_multiplier, cell_fn, n_hidden_states,
+             cell_cls=RNNCell):
+    def build(input_size, hidden_size, num_layers, bias=True,
+              batch_first=False, dropout=0, bidirectional=False,
+              output_size: Optional[int] = None):
+        mk = lambda: _make_cells(cell_cls, gate_multiplier, input_size,
+                                 hidden_size, cell_fn, n_hidden_states,
+                                 bias, output_size, num_layers)
+        return _to_backend(mk(), mk() if bidirectional else None,
+                           bidirectional, dropout, batch_first)
+    return build
+
+
+LSTM = _factory(4, _cells.lstm_cell, 2)
+GRU = _factory(3, _cells.gru_cell, 1)
+ReLU = _factory(1, _cells.rnn_relu_cell, 1)
+Tanh = _factory(1, _cells.rnn_tanh_cell, 1)
+mLSTM = _factory(4, _cells.mlstm_cell, 2, cell_cls=mLSTMRNNCell)
+
+LSTM.__doc__ = "LSTM stack (reference apex/RNN/models.py:19)."
+GRU.__doc__ = "GRU stack (reference apex/RNN/models.py:26)."
+ReLU.__doc__ = "ReLU RNN stack (reference apex/RNN/models.py:33)."
+Tanh.__doc__ = "Tanh RNN stack (reference apex/RNN/models.py:40)."
+mLSTM.__doc__ = "Multiplicative-LSTM stack (reference apex/RNN/models.py:47)."

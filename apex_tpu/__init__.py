@@ -18,9 +18,13 @@ SunDoge/apex snapshot, see SURVEY.md) designed for TPUs from the ground up:
   bootstrap.
 - ``apex_tpu.normalization``: FusedLayerNorm backed by Pallas forward and
   backward kernels (jnp fallback on CPU).
-- ``apex_tpu.fp16_utils``: manual mixed-precision toolkit (legacy API).
-  [in progress — currently stubs]
-- Planned: ``apex_tpu.RNN``, ``apex_tpu.reparameterization``.
+- ``apex_tpu.fp16_utils``: manual mixed-precision toolkit (legacy API):
+  BN-safe half conversion, fp32 master-param helpers, legacy loss scalers,
+  general FP16_Optimizer.
+- ``apex_tpu.RNN``: LSTM/GRU/ReLU/Tanh/mLSTM stacks compiled as
+  ``lax.scan`` loops.
+- ``apex_tpu.reparameterization``: weight normalization as pure pytree
+  transforms.
 
 Unlike the reference (a PyTorch extension), models here are flax/JAX pytrees
 and the training step is a pure function compiled once by XLA. The apex API
@@ -35,10 +39,13 @@ from apex_tpu import normalization
 from apex_tpu import parallel
 from apex_tpu import fp16_utils
 from apex_tpu import multi_tensor_apply
+from apex_tpu import RNN
+from apex_tpu import reparameterization
 
 __version__ = "0.1.0"
 
 __all__ = [
+    "RNN",
     "amp",
     "fp16_utils",
     "multi_tensor_apply",
@@ -46,4 +53,5 @@ __all__ = [
     "ops",
     "optimizers",
     "parallel",
+    "reparameterization",
 ]

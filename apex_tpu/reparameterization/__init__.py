@@ -1,0 +1,81 @@
+"""apex_tpu.reparameterization — weight reparameterizations as tree
+transforms (reference ``apex/reparameterization``).
+
+Canonical usage with the model wrapper (the hook equivalent)::
+
+    model = WeightNormModel(Net())
+    variables = model.init(rng, x)      # holds kernel_g / kernel_v leaves
+    y = model.apply(variables, x)       # recomputes w = g*v/||v|| inline
+
+or purely functionally::
+
+    wn_params = apply_weight_norm(variables, name="kernel")
+    plain = remove_weight_norm(wn_params)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from apex_tpu.reparameterization.reparameterization import (
+    Reparameterization,
+    apply_reparameterization,
+    remove_reparameterization,
+)
+from apex_tpu.reparameterization.weight_norm import WeightNorm
+
+
+def apply_weight_norm(params, name: str = "", dim: Optional[int] = -1):
+    """Split selected weights into ``_g``/``_v`` pairs (reference
+    ``apply_weight_norm``, ``__init__.py:4-49``; with ``name=''`` all
+    params except 1-d vectors and scalars are reparameterized).
+
+    ``dim`` is the kept dimension; -1 = per-output-channel for flax's
+    channels-last kernels (the analog of the reference's torch dim=0).
+    """
+    return apply_reparameterization(params, WeightNorm, name=name, dim=dim)
+
+
+def remove_weight_norm(params, name: str = "", dim: Optional[int] = -1):
+    """Collapse ``_g``/``_v`` pairs back into plain weights (reference
+    ``remove_weight_norm``, ``__init__.py:50-61``)."""
+    return remove_reparameterization(params, WeightNorm(dim=dim), name=name)
+
+
+class WeightNormModel:
+    """Flax-module wrapper that stores weight-normed parameters and
+    recomputes plain weights at every apply — the functional equivalent of
+    the reference's forward_pre_hook (``reparameterization.py:95``).
+    """
+
+    def __init__(self, module, name: str = "", dim: Optional[int] = -1):
+        self.module = module
+        self.rep = WeightNorm(dim=dim)
+        self.name = name
+
+    @property
+    def unwrapped(self):
+        return self.module
+
+    def init(self, rngs, *args, **kwargs):
+        variables = self.module.init(rngs, *args, **kwargs)
+        return apply_reparameterization(variables, self.rep, name=self.name)
+
+    def apply(self, variables, *args, **kwargs):
+        variables = remove_reparameterization(variables, self.rep,
+                                              name=self.name)
+        return self.module.apply(variables, *args, **kwargs)
+
+    def __call__(self, variables, *args, **kwargs):
+        return self.apply(variables, *args, **kwargs)
+
+
+__all__ = [
+    "Reparameterization",
+    "WeightNorm",
+    "WeightNormModel",
+    "apply_reparameterization",
+    "apply_weight_norm",
+    "remove_reparameterization",
+    "remove_weight_norm",
+]

@@ -1,0 +1,119 @@
+"""Generic parameter reparameterization over pytrees.
+
+Re-design of reference ``apex/reparameterization/reparameterization.py``:
+there, ``Reparameterization.apply`` mutates an nn.Module — removes the
+weight Parameter, registers derived Parameters, and installs a
+forward_pre_hook that recomputes the weight before every forward (:57-125).
+Here params are immutable pytrees, so a reparameterization is a pair of
+pure tree transforms:
+
+- ``reparameterize_tree``: replace each selected leaf ``name`` with derived
+  leaves ``name_<suffix>`` (e.g. ``kernel`` -> ``kernel_g``/``kernel_v``);
+- ``compute_tree``: invert it, recomputing the original leaf from the
+  derived ones — called at apply time (the hook equivalent), so autodiff
+  routes gradients to the derived parameters automatically (the reference
+  needs a manual backward hook for this, :98).
+
+Note: importing the reference's package raises ImportError (it pulls a
+``Fused_Weight_Norm`` that does not exist in the snapshot —
+``weight_norm.py:3``; SURVEY.md §2.1). The API is ported, not the bug.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+class Reparameterization:
+    """Base class: subclasses define ``suffixes``, ``reparameterize`` (leaf
+    -> dict of derived leaves) and ``compute`` (derived leaves -> leaf)."""
+
+    suffixes = ()
+
+    def reparameterize(self, weight: jax.Array) -> Dict[str, jax.Array]:
+        raise NotImplementedError
+
+    def compute(self, derived: Dict[str, jax.Array]) -> jax.Array:
+        raise NotImplementedError
+
+    # -- tree transforms ---------------------------------------------------
+    def _selects(self, key: str, leaf, name: str) -> bool:
+        if name:
+            return key == name
+        # default: all except 1-d vectors and scalars (reference
+        # apply_weight_norm docstring: "except 1-d vectors and scalars")
+        return hasattr(leaf, "ndim") and leaf.ndim >= 2
+
+    def reparameterize_tree(self, params: Pytree, name: str = "") -> Pytree:
+        """Walk nested dicts; split each selected leaf into derived ones."""
+        if not isinstance(params, dict):
+            return params
+        out = {}
+        for k, v in params.items():
+            if isinstance(v, dict):
+                out[k] = self.reparameterize_tree(v, name)
+            elif self._selects(k, v, name):
+                for sfx, dv in self.reparameterize(jnp.asarray(v)).items():
+                    out[f"{k}_{sfx}"] = dv
+            else:
+                out[k] = v
+        return out
+
+    def compute_tree(self, params: Pytree) -> Pytree:
+        """Invert :meth:`reparameterize_tree`: recombine derived leaves."""
+        if not isinstance(params, dict):
+            return params
+        out = {}
+        done = set()
+        for k in params:
+            if k in done:
+                continue
+            v = params[k]
+            if isinstance(v, dict):
+                out[k] = self.compute_tree(v)
+                continue
+            base = None
+            for sfx in self.suffixes:
+                if k.endswith(f"_{sfx}"):
+                    base = k[: -(len(sfx) + 1)]
+                    break
+            if base is not None:
+                keys = [f"{base}_{sfx}" for sfx in self.suffixes]
+                if all(kk in params for kk in keys):
+                    out[base] = self.compute(
+                        {sfx: params[f"{base}_{sfx}"] for sfx in self.suffixes})
+                    done.update(keys)
+                    continue
+            out[k] = v
+        return out
+
+    def remove(self, params: Pytree) -> Pytree:
+        """Collapse back to plain weights (reference ``remove`` :127-136)."""
+        return self.compute_tree(params)
+
+
+def apply_reparameterization(params: Pytree, reparameterization,
+                             name: str = "", **kwargs) -> Pytree:
+    """Reference ``apply_reparameterization`` (``__init__.py:62-101``) as a
+    tree transform; ``reparameterization`` is a class or instance."""
+    rep = (reparameterization(**kwargs)
+           if isinstance(reparameterization, type) else reparameterization)
+    if isinstance(params, dict) and "params" in params:
+        return {**params,
+                "params": rep.reparameterize_tree(params["params"], name)}
+    return rep.reparameterize_tree(params, name)
+
+
+def remove_reparameterization(params: Pytree, reparameterization,
+                              name: str = "", **kwargs) -> Pytree:
+    """Reference ``remove_reparameterization`` (``__init__.py:104-127``)."""
+    rep = (reparameterization(**kwargs)
+           if isinstance(reparameterization, type) else reparameterization)
+    if isinstance(params, dict) and "params" in params:
+        return {**params, "params": rep.compute_tree(params["params"])}
+    return rep.compute_tree(params)

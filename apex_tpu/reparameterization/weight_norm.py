@@ -1,0 +1,55 @@
+"""Weight normalization: ``w = g * v / ||v||``.
+
+Re-design of reference ``apex/reparameterization/weight_norm.py``
+(Salimans & Kingma 2016). The magnitude/direction split and the
+norm-except-one-dim math (reference ``_norm`` :8-18) are preserved; the
+fused CUDA kernel the reference *tried* to use (broken import, see
+``reparameterization.py`` docstring) is unnecessary — XLA fuses the norm +
+scale chain into the consuming matmul.
+
+Dim convention: ``dim`` is the dimension *kept* (norm taken over all
+others), like torch. The reference's default ``dim=0`` means
+"per output channel" for torch's (out, in) weight layout; flax kernels are
+(..., in, out) with output channels LAST, so the equivalent default here is
+``dim=-1``. Pass ``dim=None`` for a single norm over the whole tensor.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.reparameterization.reparameterization import Reparameterization
+
+
+def _norm_except_dim(v: jax.Array, dim: Optional[int]) -> jax.Array:
+    """Norm over all dimensions except ``dim``, kept broadcastable
+    (reference ``_norm``, ``weight_norm.py:8-18``)."""
+    if dim is None:
+        return jnp.sqrt(jnp.sum(jnp.square(v)))
+    d = dim % v.ndim
+    axes = tuple(i for i in range(v.ndim) if i != d)
+    return jnp.sqrt(jnp.sum(jnp.square(v), axis=axes, keepdims=True))
+
+
+class WeightNorm(Reparameterization):
+    """Splits a weight into magnitude ``_g`` and direction ``_v``
+    (reference ``WeightNorm``, ``weight_norm.py:22-78``)."""
+
+    suffixes = ("g", "v")
+
+    def __init__(self, dim: Optional[int] = -1):
+        self.dim = dim
+
+    def reparameterize(self, weight):
+        return {"g": _norm_except_dim(weight, self.dim), "v": weight}
+
+    def compute(self, derived):
+        g, v = derived["g"], derived["v"]
+        # norm in fp32 for half/bf16 weights (the reference's fused kernel
+        # computed fp32 norms for fp16 inputs for the same reason)
+        n = _norm_except_dim(v.astype(jnp.float32), self.dim)
+        return (g.astype(jnp.float32) * v.astype(jnp.float32) / n).astype(
+            v.dtype)

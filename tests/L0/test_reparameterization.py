@@ -1,0 +1,112 @@
+"""Weight-norm reparameterization tests (the reference ships no tests for
+this package — and its import is broken there; parity is vs torch's
+weight_norm math)."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import torch
+
+from apex_tpu.reparameterization import (
+    WeightNormModel,
+    apply_weight_norm,
+    remove_weight_norm,
+)
+from apex_tpu.reparameterization.weight_norm import WeightNorm, _norm_except_dim
+
+
+def test_roundtrip_identity():
+    w = jnp.asarray(np.random.RandomState(0).randn(4, 6), jnp.float32)
+    params = {"layer": {"kernel": w, "bias": jnp.zeros((6,))}}
+    wn = apply_weight_norm(params)
+    assert set(wn["layer"].keys()) == {"kernel_g", "kernel_v", "bias"}
+    assert wn["layer"]["kernel_g"].shape == (1, 6)   # per-output-channel
+    back = remove_weight_norm(wn)
+    np.testing.assert_allclose(np.asarray(back["layer"]["kernel"]),
+                               np.asarray(w), rtol=1e-6)
+
+
+def test_name_selection():
+    params = {"a": {"kernel": jnp.ones((3, 3)), "other": jnp.ones((3, 3))}}
+    wn = apply_weight_norm(params, name="kernel")
+    assert "kernel_g" in wn["a"] and "other" in wn["a"]
+
+
+def test_skips_vectors_by_default():
+    params = {"bias": jnp.ones((5,)), "scalar": jnp.asarray(1.0)}
+    wn = apply_weight_norm(params)
+    assert set(wn.keys()) == {"bias", "scalar"}
+
+
+def test_dim_none_single_norm():
+    w = jnp.asarray(np.random.RandomState(1).randn(3, 4), jnp.float32)
+    wn = WeightNorm(dim=None)
+    d = wn.reparameterize(w)
+    assert d["g"].shape == ()
+    np.testing.assert_allclose(np.asarray(wn.compute(d)), np.asarray(w),
+                               rtol=1e-6)
+
+
+def test_matches_torch_weight_norm():
+    """g*v/||v|| per output channel must match torch.nn.utils.weight_norm.
+    Torch Linear weight is (out, in) with dim=0; flax kernel is (in, out)
+    with dim=-1 — same semantics, transposed layout."""
+    rs = np.random.RandomState(2)
+    w = rs.randn(8, 5).astype(np.float32)   # torch layout (out, in)
+    lin = torch.nn.Linear(5, 8, bias=False)
+    lin.weight.data = torch.tensor(w)
+    tw = torch.nn.utils.weight_norm(lin, dim=0)
+    # perturb g so w != original v
+    tw.weight_g.data = tw.weight_g.data * 2.0
+    with torch.no_grad():
+        # the pre-hook recomputes .weight only on forward; drive it with an
+        # identity batch so y = I @ W.T = W.T
+        torch_w = tw(torch.eye(5)).numpy().T
+
+    wn = WeightNorm(dim=-1)
+    d = wn.reparameterize(jnp.asarray(w.T))   # flax layout (in, out)
+    d["g"] = d["g"] * 2.0
+    ours = np.asarray(wn.compute(d)).T
+    np.testing.assert_allclose(ours, torch_w, rtol=1e-5)
+
+
+def test_weight_norm_model_trains():
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(16)(x)
+            x = nn.relu(x)
+            return nn.Dense(1)(x)
+
+    model = WeightNormModel(Net())
+    x = jnp.asarray(np.random.RandomState(3).randn(16, 4), jnp.float32)
+    y = jnp.sum(x, axis=1, keepdims=True)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    flat = jax.tree_util.tree_flatten_with_path(variables)[0]
+    names = {jax.tree_util.keystr(p) for p, _ in flat}
+    assert any("kernel_g" in n for n in names)
+
+    def loss_fn(v):
+        return jnp.mean((model.apply(v, x) - y) ** 2)
+
+    l0 = float(loss_fn(variables))
+    for _ in range(30):
+        g = jax.grad(loss_fn)(variables)
+        variables = jax.tree_util.tree_map(
+            lambda p, gr: p - 0.05 * gr, variables, g)
+    assert float(loss_fn(variables)) < l0 * 0.5
+
+
+def test_grads_flow_to_g_and_v():
+    w = jnp.asarray(np.random.RandomState(4).randn(4, 4), jnp.float32)
+    wn = WeightNorm(dim=-1)
+    d = wn.reparameterize(w)
+
+    def f(d):
+        return jnp.sum(wn.compute(d) ** 2)
+
+    g = jax.grad(f)(d)
+    assert np.abs(np.asarray(g["g"])).max() > 0
+    # direction grads are orthogonal-ish projections; still nonzero generally
+    assert np.isfinite(np.asarray(g["v"])).all()

@@ -1,0 +1,149 @@
+"""RNN package tests (model of reference tests/L0/run_amp/test_rnn.py, but
+checking numerics against torch's reference cells rather than cast policy)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+import torch
+
+from apex_tpu import RNN as apexrnn
+
+T, B, IN, HID = 5, 3, 4, 6
+
+
+def init_and_run(model, xs, **kw):
+    vars_ = model.init(jax.random.PRNGKey(0), xs)
+    out, hid = model.apply(vars_, xs, **kw)
+    return vars_, out, hid
+
+
+@pytest.mark.parametrize("factory,n_slots,gate_mult", [
+    (apexrnn.LSTM, 2, 4), (apexrnn.GRU, 1, 3),
+    (apexrnn.ReLU, 1, 1), (apexrnn.Tanh, 1, 1), (apexrnn.mLSTM, 2, 4)])
+def test_shapes(factory, n_slots, gate_mult):
+    xs = jnp.ones((T, B, IN))
+    model = factory(IN, HID, num_layers=2)
+    _, out, hid = init_and_run(model, xs)
+    assert out.shape == (T, B, HID)
+    assert len(hid) == n_slots
+    assert hid[0].shape == (2, B, HID)
+
+
+def test_bidirectional_concat():
+    xs = jnp.ones((T, B, IN))
+    model = apexrnn.LSTM(IN, HID, num_layers=1, bidirectional=True)
+    _, out, hid = init_and_run(model, xs)
+    assert out.shape == (T, B, 2 * HID)
+    assert hid[0].shape == (1, B, 2 * HID)
+
+
+def test_batch_first():
+    xs = jnp.ones((B, T, IN))
+    model = apexrnn.GRU(IN, HID, num_layers=1, batch_first=True)
+    _, out, _ = init_and_run(model, xs)
+    assert out.shape == (B, T, HID)
+
+
+def test_output_projection():
+    out_size = 3
+    xs = jnp.ones((T, B, IN))
+    model = apexrnn.LSTM(IN, HID, num_layers=2, output_size=out_size)
+    _, out, hid = init_and_run(model, xs)
+    assert out.shape == (T, B, out_size)
+    assert hid[0].shape == (2, B, out_size)   # h is projected
+    assert hid[1].shape == (2, B, HID)        # c is not
+
+
+def test_collect_hidden():
+    xs = jnp.ones((T, B, IN))
+    model = apexrnn.LSTM(IN, HID, num_layers=2)
+    _, out, hid = init_and_run(model, xs, collect_hidden=True)
+    assert hid[0].shape == (T, 2, B, HID)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(hid[0][:, -1]))
+
+
+def _set_torch_params(tmod, jparams, layers, bias, suffix=""):
+    for l in range(layers):
+        lp = jparams[f"cells_{l}"]
+        getattr(tmod, f"weight_ih_l{l}{suffix}").data = torch.tensor(
+            np.asarray(lp["w_ih"]))
+        getattr(tmod, f"weight_hh_l{l}{suffix}").data = torch.tensor(
+            np.asarray(lp["w_hh"]))
+        if bias:
+            getattr(tmod, f"bias_ih_l{l}{suffix}").data = torch.tensor(
+                np.asarray(lp["b_ih"]))
+            getattr(tmod, f"bias_hh_l{l}{suffix}").data = torch.tensor(
+                np.asarray(lp["b_hh"]))
+
+
+@pytest.mark.parametrize("kind", ["LSTM", "GRU", "RNN_TANH", "RNN_RELU"])
+def test_matches_torch(kind):
+    """Stacked RNN output must match torch's reference implementation with
+    identical weights (the torch cells are what the reference wraps)."""
+    xs = np.random.RandomState(0).randn(T, B, IN).astype(np.float32)
+    factories = {"LSTM": apexrnn.LSTM, "GRU": apexrnn.GRU,
+                 "RNN_TANH": apexrnn.Tanh, "RNN_RELU": apexrnn.ReLU}
+    model = factories[kind](IN, HID, num_layers=2, bias=True)
+    vars_, out, hid = init_and_run(model, jnp.asarray(xs))
+
+    if kind in ("LSTM", "GRU"):
+        tmod = getattr(torch.nn, kind)(IN, HID, num_layers=2, bias=True)
+    else:
+        tmod = torch.nn.RNN(IN, HID, num_layers=2, bias=True,
+                            nonlinearity="tanh" if kind == "RNN_TANH" else "relu")
+    _set_torch_params(tmod, vars_["params"], 2, True)
+    with torch.no_grad():
+        tout, thid = tmod(torch.tensor(xs))
+
+    np.testing.assert_allclose(np.asarray(out), tout.numpy(),
+                               rtol=1e-5, atol=1e-5)
+    th = thid[0] if isinstance(thid, tuple) else thid
+    np.testing.assert_allclose(np.asarray(hid[0]), th.numpy(),
+                               rtol=1e-5, atol=1e-5)
+    if kind == "LSTM":
+        np.testing.assert_allclose(np.asarray(hid[1]), thid[1].numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_hidden_continuation():
+    """Running two half-sequences with carried hidden == one full run."""
+    xs = jnp.asarray(np.random.RandomState(1).randn(T * 2, B, IN),
+                     jnp.float32)
+    model = apexrnn.LSTM(IN, HID, num_layers=2)
+    vars_ = model.init(jax.random.PRNGKey(0), xs[:T])
+    full, _ = model.apply(vars_, xs)
+    first, h1 = model.apply(vars_, xs[:T])
+    # final hiddens come back stacked (L, B, F); feed back per layer
+    carried = [tuple(h[i] for h in h1) for i in range(2)]
+    second, _ = model.apply(vars_, xs[T:], carried)
+    np.testing.assert_allclose(np.asarray(full),
+                               np.asarray(jnp.concatenate([first, second])),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_mlstm_grads_finite_and_multiplicative():
+    xs = jnp.asarray(np.random.RandomState(2).randn(T, B, IN), jnp.float32)
+    model = apexrnn.mLSTM(IN, HID, num_layers=1, bias=True)
+    vars_ = model.init(jax.random.PRNGKey(0), xs)
+    assert "w_mih" in vars_["params"]["cells_0"]
+
+    def loss(v):
+        out, _ = model.apply(v, xs)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(loss)(vars_)
+    assert all(np.isfinite(np.asarray(x)).all()
+               for x in jax.tree_util.tree_leaves(g))
+    # multiplicative weights actually participate
+    gm = np.asarray(g["params"]["cells_0"]["w_mih"])
+    assert np.abs(gm).max() > 0
+
+
+def test_rnn_jits_and_scans():
+    """The whole stack must be jittable (static-shape lax.scan inside)."""
+    xs = jnp.ones((T, B, IN))
+    model = apexrnn.GRU(IN, HID, num_layers=2)
+    vars_ = model.init(jax.random.PRNGKey(0), xs)
+    out = jax.jit(lambda v, x: model.apply(v, x)[0])(vars_, xs)
+    assert out.shape == (T, B, HID)
