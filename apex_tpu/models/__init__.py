@@ -1,0 +1,48 @@
+"""apex_tpu.models — the benchmark model zoo.
+
+The reference library ships no models (its examples pull torchvision);
+this package provides the models its headline workloads train — ResNet for
+``examples/imagenet`` (amp O2 + DDP + SyncBN), the MNIST MLP for
+``examples/simple``, DCGAN for the multi-model/multi-optimizer exercise,
+and a BERT encoder for the FusedLAMB + FusedLayerNorm config — all NHWC /
+static-shape / bf16-friendly for TPU.
+"""
+
+from apex_tpu.models.mlp import MLP
+from apex_tpu.models.resnet import (
+    BasicBlock,
+    Bottleneck,
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+)
+from apex_tpu.models.dcgan import Discriminator, Generator
+from apex_tpu.models.bert import (
+    BertConfig,
+    BertEncoder,
+    BertForPreTraining,
+    bert_base,
+    bert_large,
+)
+
+__all__ = [
+    "BasicBlock",
+    "BertConfig",
+    "BertEncoder",
+    "BertForPreTraining",
+    "Bottleneck",
+    "Discriminator",
+    "Generator",
+    "MLP",
+    "ResNet",
+    "ResNet18",
+    "ResNet34",
+    "ResNet50",
+    "ResNet101",
+    "ResNet152",
+    "bert_base",
+    "bert_large",
+]

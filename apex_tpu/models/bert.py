@@ -1,0 +1,195 @@
+"""BERT encoder — the FusedLayerNorm + FusedLAMB workload.
+
+The reference's LayerNorm and LAMB kernels exist to serve BERT pretraining
+(SURVEY.md §2.2: the LAMB CUDA kernels ship with no Python wrapper, used by
+NVIDIA's BERT recipes downstream; BASELINE.json config 4 is "BERT-large
+pretraining, FusedLAMB + FusedLayerNorm + amp O2 + DDP"). This is that
+model, TPU-first:
+
+- post-LN transformer encoder (original BERT) built on
+  ``normalization.FusedLayerNorm`` (Pallas kernels on TPU);
+- attention as batched einsum -> one fused softmax -> einsum, all
+  MXU-shaped (no per-head Python loops);
+- optional sequence-parallel attention: pass ``attention_fn`` to swap in
+  ``parallel.ring_attention`` for long sequences;
+- static shapes; masking via additive -inf biases (no dynamic slicing).
+
+``BertConfig`` mirrors the standard hyperparameter names so configs port
+directly; ``bert_base``/``bert_large`` builders match the published sizes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.normalization import FusedLayerNorm
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+
+
+def bert_base() -> "BertConfig":
+    return BertConfig()
+
+
+def bert_large() -> "BertConfig":
+    return BertConfig(hidden_size=1024, num_hidden_layers=24,
+                      num_attention_heads=16, intermediate_size=4096)
+
+
+def _dense_init(cfg):
+    return nn.initializers.normal(cfg.initializer_range)
+
+
+def dot_product_attention(q, k, v, bias=None, dropout_fn=None):
+    """(B, S, H, D) q/k/v -> (B, S, H, D); softmax in fp32."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(d)
+    scores = scores.astype(jnp.float32)
+    if bias is not None:
+        scores = scores + bias
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if dropout_fn is not None:
+        probs = dropout_fn(probs)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class BertSelfAttention(nn.Module):
+    cfg: BertConfig
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, attn_bias, deterministic: bool = True):
+        cfg = self.cfg
+        h, nh = cfg.hidden_size, cfg.num_attention_heads
+        dh = h // nh
+        init = _dense_init(cfg)
+
+        def proj(name):
+            return nn.DenseGeneral((nh, dh), kernel_init=init,
+                                   name=name)(x)
+
+        q, k, v = proj("query"), proj("key"), proj("value")
+        dropout_fn = None
+        if cfg.attention_probs_dropout_prob > 0 and not deterministic:
+            drop = nn.Dropout(cfg.attention_probs_dropout_prob,
+                              deterministic=False)
+            dropout_fn = lambda p: drop(p)
+        attn = self.attention_fn or dot_product_attention
+        ctx = attn(q, k, v, bias=attn_bias, dropout_fn=dropout_fn)
+        return nn.DenseGeneral(h, axis=(-2, -1), kernel_init=init,
+                               name="output")(ctx)
+
+
+class BertLayer(nn.Module):
+    cfg: BertConfig
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, attn_bias, deterministic: bool = True):
+        cfg = self.cfg
+        init = _dense_init(cfg)
+        drop = nn.Dropout(cfg.hidden_dropout_prob,
+                          deterministic=deterministic)
+
+        attn_out = BertSelfAttention(cfg, self.attention_fn,
+                                     name="attention")(
+            x, attn_bias, deterministic)
+        x = FusedLayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps,
+                           name="attention_ln")(x + drop(attn_out))
+
+        y = nn.Dense(cfg.intermediate_size, kernel_init=init,
+                     name="intermediate")(x)
+        y = nn.gelu(y, approximate=False)
+        y = nn.Dense(cfg.hidden_size, kernel_init=init, name="output")(y)
+        return FusedLayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps,
+                              name="output_ln")(x + drop(y))
+
+
+class BertEncoder(nn.Module):
+    """input_ids/token_type_ids (B, S) int32, attention_mask (B, S)
+    {0,1} -> sequence output (B, S, H)."""
+
+    cfg: BertConfig
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        cfg = self.cfg
+        b, s = input_ids.shape
+        init = _dense_init(cfg)
+
+        emb = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                       embedding_init=init, name="word_embeddings")(input_ids)
+        pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+                       embedding_init=init, name="position_embeddings")(
+            jnp.arange(s)[None, :])
+        emb = emb + pos
+        if token_type_ids is not None:
+            emb = emb + nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
+                                 embedding_init=init,
+                                 name="token_type_embeddings")(token_type_ids)
+        x = FusedLayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps,
+                           name="embeddings_ln")(emb)
+        x = nn.Dropout(cfg.hidden_dropout_prob,
+                       deterministic=deterministic)(x)
+
+        attn_bias = None
+        if attention_mask is not None:
+            attn_bias = jnp.where(attention_mask[:, None, None, :] > 0,
+                                  0.0, -1e9).astype(jnp.float32)
+
+        for i in range(cfg.num_hidden_layers):
+            x = BertLayer(cfg, self.attention_fn, name=f"layer_{i}")(
+                x, attn_bias, deterministic)
+        return x
+
+
+class BertForPreTraining(nn.Module):
+    """Encoder + MLM head + NSP head (untied decoder matrix)."""
+
+    cfg: BertConfig
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic: bool = True):
+        cfg = self.cfg
+        init = _dense_init(cfg)
+        enc = BertEncoder(cfg, self.attention_fn, name="encoder")
+        seq = enc(input_ids, attention_mask, token_type_ids, deterministic)
+
+        # MLM: transform -> tied decoder
+        h = nn.Dense(cfg.hidden_size, kernel_init=init,
+                     name="mlm_transform")(seq)
+        h = nn.gelu(h, approximate=False)
+        h = FusedLayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps,
+                           name="mlm_ln")(h)
+        mlm_logits = nn.Dense(cfg.vocab_size, kernel_init=init,
+                              name="mlm_decoder")(h).astype(jnp.float32)
+
+        # NSP: [CLS] pooled
+        cls = jnp.tanh(nn.Dense(cfg.hidden_size, kernel_init=init,
+                                name="pooler")(seq[:, 0]))
+        nsp_logits = nn.Dense(2, kernel_init=init,
+                              name="nsp_classifier")(cls).astype(jnp.float32)
+        return mlm_logits, nsp_logits

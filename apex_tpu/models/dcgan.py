@@ -1,0 +1,74 @@
+"""DCGAN generator/discriminator — the multi-model/multi-optimizer workload.
+
+The reference's ``examples/dcgan`` is an empty README promising "an example
+showing use of multiple models/optimizers/losses" with amp
+(``examples/dcgan/README.md``; the API hooks are ``num_losses`` and
+``loss_id``, reference ``frontend.py:248-254``). This supplies the actual
+models so that exercise is runnable: standard DCGAN (Radford et al. 2016)
+in NHWC for TPU.
+
+BatchNorm uses the norm-factory pattern so SyncBN conversion works on GANs
+too. Generator maps (B, 1, 1, z_dim) noise to (B, 64, 64, C) images in
+[-1, 1]; discriminator mirrors it down to per-image logits.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+default_norm = functools.partial(nn.BatchNorm, momentum=0.9, epsilon=1e-5)
+
+# DCGAN init: N(0, 0.02)
+dcgan_init = nn.initializers.normal(0.02)
+
+
+class Generator(nn.Module):
+    z_dim: int = 100
+    base_features: int = 64
+    out_channels: int = 3
+    norm: ModuleDef = default_norm
+
+    @nn.compact
+    def __call__(self, z, train: bool = True):
+        f = self.base_features
+        x = z.reshape((z.shape[0], 1, 1, self.z_dim))
+        # 1x1 -> 4x4 -> 8 -> 16 -> 32 -> 64
+        x = nn.ConvTranspose(f * 8, (4, 4), (1, 1), padding="VALID",
+                             use_bias=False, kernel_init=dcgan_init)(x)
+        x = self.norm(use_running_average=not train)(x)
+        x = nn.relu(x)
+        for mult in (4, 2, 1):
+            x = nn.ConvTranspose(f * mult, (4, 4), (2, 2), padding="SAME",
+                                 use_bias=False, kernel_init=dcgan_init)(x)
+            x = self.norm(use_running_average=not train)(x)
+            x = nn.relu(x)
+        x = nn.ConvTranspose(self.out_channels, (4, 4), (2, 2),
+                             padding="SAME", use_bias=False,
+                             kernel_init=dcgan_init)(x)
+        return jnp.tanh(x)
+
+
+class Discriminator(nn.Module):
+    base_features: int = 64
+    norm: ModuleDef = default_norm
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        f = self.base_features
+        x = nn.Conv(f, (4, 4), (2, 2), padding=1, use_bias=False,
+                    kernel_init=dcgan_init)(x)
+        x = nn.leaky_relu(x, 0.2)
+        for mult in (2, 4, 8):
+            x = nn.Conv(f * mult, (4, 4), (2, 2), padding=1, use_bias=False,
+                        kernel_init=dcgan_init)(x)
+            x = self.norm(use_running_average=not train)(x)
+            x = nn.leaky_relu(x, 0.2)
+        x = nn.Conv(1, (4, 4), (1, 1), padding="VALID", use_bias=False,
+                    kernel_init=dcgan_init)(x)
+        return x.reshape((x.shape[0],)).astype(jnp.float32)

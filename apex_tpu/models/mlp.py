@@ -1,0 +1,25 @@
+"""MNIST-scale MLP — the minimal end-to-end amp exercise.
+
+The reference's ``examples/simple`` tier trains toy models to demo the amp
+API (SURVEY.md §7 stage 2 milestone; BASELINE.json config 1 is an
+"examples/simple amp O1 MNIST MLP"). This is that model.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (1024, 1024)
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        for f in self.features:
+            x = nn.Dense(f)(x)
+            x = nn.relu(x)
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,138 @@
+"""ResNet family (v1.5) — the framework's flagship benchmark model.
+
+The reference library has no model zoo; its headline workload is torchvision
+ResNet-50 driven by ``examples/imagenet/main_amp.py`` (reference :141-148)
+under amp + DDP + SyncBN. This is that workload's model, built TPU-first:
+
+- NHWC layout (TPU conv native), channels-last BatchNorm;
+- the norm layer is a *factory attribute*, so
+  ``parallel.convert_syncbn_model`` can swap ``nn.BatchNorm`` for
+  ``SyncBatchNorm`` from outside (the flax analog of the reference's
+  recursive module surgery, ``apex/parallel/__init__.py:21-53``);
+- v1.5 stride placement (stride on the 3x3, not the 1x1 — torchvision's
+  layout, which the reference's example trains);
+- all shapes static, compiles to MXU-tiled convs under jit; amp handles
+  bf16 casting with BN kept fp32 (pattern match on "BatchNorm").
+
+Matches torchvision structurally: 7x7 stem, maxpool, 4 stages, global avg
+pool, fc — so checkpoints map 1:1 modulo NCHW->NHWC transposition.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+# torch BN defaults: momentum 0.1 (flax: 0.9), eps 1e-5
+default_norm = functools.partial(nn.BatchNorm, momentum=0.9, epsilon=1e-5)
+
+conv_init = nn.initializers.variance_scaling(2.0, "fan_out",
+                                             "truncated_normal")
+
+
+class BasicBlock(nn.Module):
+    """2-conv residual block (resnet18/34)."""
+
+    filters: int
+    norm: ModuleDef
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), self.strides, padding=1,
+                    use_bias=False, kernel_init=conv_init)(x)
+        y = self.norm(use_running_average=not train)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding=1, use_bias=False,
+                    kernel_init=conv_init)(y)
+        # zero-init the last BN scale (torchvision zero_init_residual
+        # improves early training; harmless either way)
+        y = self.norm(use_running_average=not train,
+                      scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1), self.strides,
+                               use_bias=False, kernel_init=conv_init,
+                               name="downsample_conv")(residual)
+            residual = self.norm(use_running_average=not train,
+                                 name="downsample_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class Bottleneck(nn.Module):
+    """1x1 -> 3x3 -> 1x1 block with 4x expansion (resnet50/101/152),
+    v1.5: stride lives on the 3x3."""
+
+    filters: int
+    norm: ModuleDef
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False,
+                    kernel_init=conv_init)(x)
+        y = self.norm(use_running_average=not train)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), self.strides, padding=1,
+                    use_bias=False, kernel_init=conv_init)(y)
+        y = self.norm(use_running_average=not train)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters * 4, (1, 1), use_bias=False,
+                    kernel_init=conv_init)(y)
+        y = self.norm(use_running_average=not train,
+                      scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters * 4, (1, 1), self.strides,
+                               use_bias=False, kernel_init=conv_init,
+                               name="downsample_conv")(residual)
+            residual = self.norm(use_running_average=not train,
+                                 name="downsample_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """Input NHWC, output (B, num_classes) logits."""
+
+    stage_sizes: Sequence[int]
+    block: ModuleDef
+    num_classes: int = 1000
+    width: int = 64
+    norm: ModuleDef = default_norm
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.width, (7, 7), (2, 2), padding=3, use_bias=False,
+                    kernel_init=conv_init, name="stem_conv")(x)
+        x = self.norm(use_running_average=not train, name="stem_bn")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), padding=((1, 1), (1, 1)))
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block(filters=self.width * 2 ** i, norm=self.norm,
+                               strides=strides)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        # classifier in fp32: the matmul is tiny and logits feed a softmax
+        x = nn.Dense(self.num_classes, name="fc")(x.astype(jnp.float32))
+        return x
+
+
+def _resnet(stages, block):
+    def build(num_classes: int = 1000, norm: ModuleDef = default_norm,
+              width: int = 64) -> ResNet:
+        return ResNet(stage_sizes=stages, block=block,
+                      num_classes=num_classes, norm=norm, width=width)
+    return build
+
+
+ResNet18 = _resnet([2, 2, 2, 2], BasicBlock)
+ResNet34 = _resnet([3, 4, 6, 3], BasicBlock)
+ResNet50 = _resnet([3, 4, 6, 3], Bottleneck)
+ResNet101 = _resnet([3, 4, 23, 3], Bottleneck)
+ResNet152 = _resnet([3, 8, 36, 3], Bottleneck)

@@ -98,6 +98,8 @@ class SyncBatchNorm(nn.Module):
     param_dtype: Any = jnp.float32
     use_bias: bool = True
     use_scale: bool = True
+    scale_init: Any = nn.initializers.ones
+    bias_init: Any = nn.initializers.zeros
     axis_name: Optional[str] = None
     process_group: Optional[ProcessGroup] = None
 
@@ -156,11 +158,11 @@ class SyncBatchNorm(nn.Module):
 
         y = (x.astype(jnp.float32) - mean) * lax.rsqrt(var + self.epsilon)
         if self.use_scale:
-            scale = self.param("scale", nn.initializers.ones,
+            scale = self.param("scale", self.scale_init,
                                (features,), self.param_dtype)
             y = y * scale.astype(jnp.float32)
         if self.use_bias:
-            bias = self.param("bias", nn.initializers.zeros,
+            bias = self.param("bias", self.bias_init,
                               (features,), self.param_dtype)
             y = y + bias.astype(jnp.float32)
         out_dtype = self.dtype or x.dtype
@@ -221,6 +223,8 @@ def convert_syncbn_model(module: nn.Module,
                 param_dtype=obj.param_dtype,
                 use_bias=obj.use_bias,
                 use_scale=obj.use_scale,
+                scale_init=obj.scale_init,
+                bias_init=obj.bias_init,
                 axis_name=axis_name,
                 process_group=process_group,
                 name=obj.name)

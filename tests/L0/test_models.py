@@ -1,0 +1,195 @@
+"""Model zoo tests: shapes, param counts, amp compatibility, SyncBN
+conversion, and trainability on tiny shapes."""
+
+import functools
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu import amp, models
+from apex_tpu.parallel import convert_syncbn_model
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
+
+
+def n_params(tree):
+    return sum(np.prod(x.shape) for x in jax.tree_util.tree_leaves(tree))
+
+
+def test_resnet50_param_count():
+    model = models.ResNet50(num_classes=1000)
+    v = model.init(jax.random.PRNGKey(0), jnp.ones((1, 32, 32, 3)),
+                   train=False)
+    # torchvision resnet50: 25,557,032 params
+    assert n_params(v["params"]) == 25_557_032
+
+
+def test_resnet18_param_count():
+    model = models.ResNet18(num_classes=1000)
+    v = model.init(jax.random.PRNGKey(0), jnp.ones((1, 32, 32, 3)),
+                   train=False)
+    # torchvision resnet18: 11,689,512 params
+    assert n_params(v["params"]) == 11_689_512
+
+
+def test_resnet_forward_shapes():
+    model = models.ResNet50(num_classes=10, width=16)
+    x = jnp.ones((2, 64, 64, 3))
+    v = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(v, x, train=False)
+    assert out.shape == (2, 10)
+    assert out.dtype == jnp.float32
+
+
+def test_resnet_train_updates_batch_stats():
+    model = models.ResNet18(num_classes=4, width=8)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
+    v = model.init(jax.random.PRNGKey(0), x)
+    out, mutated = model.apply(v, x, train=True, mutable=["batch_stats"])
+    before = jax.tree_util.tree_leaves(v["batch_stats"])
+    after = jax.tree_util.tree_leaves(mutated["batch_stats"])
+    assert any(not np.allclose(np.asarray(a), np.asarray(b))
+               for a, b in zip(before, after))
+
+
+def test_resnet_syncbn_conversion():
+    model = models.ResNet18(num_classes=4, width=8)
+    conv = convert_syncbn_model(model)
+    assert isinstance(conv.norm, functools.partial)
+    assert conv.norm.func is SyncBatchNorm
+    x = jnp.ones((2, 32, 32, 3))
+    v = conv.init(jax.random.PRNGKey(0), x, train=False)
+    out = conv.apply(v, x, train=False)
+    assert out.shape == (2, 4)
+
+
+def test_resnet_amp_o2_bn_stays_fp32():
+    model, _ = amp.initialize(models.ResNet18(num_classes=4, width=8),
+                              optax.sgd(0.1), opt_level="O2", verbosity=0)
+    x = jnp.ones((2, 32, 32, 3))
+    v = model.init(jax.random.PRNGKey(0), x, train=False)
+    # canonical variables: fp32 masters everywhere
+    for p, leaf in jax.tree_util.tree_flatten_with_path(v["params"])[0]:
+        assert jnp.asarray(leaf).dtype == jnp.float32, p
+
+
+def test_resnet_amp_o2_train_step():
+    model, optimizer = amp.initialize(
+        models.ResNet18(num_classes=4, width=8), optax.sgd(0.1),
+        opt_level="O2", verbosity=0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+    y = jnp.asarray([0, 1, 2, 3])
+    variables = model.init(jax.random.PRNGKey(0), x)
+    params, bstats = variables["params"], variables["batch_stats"]
+    opt_state = optimizer.init(params)
+
+    @jax.jit
+    def step(params, bstats, opt_state, x, y):
+        def loss_fn(p):
+            logits, mut = model.apply(
+                {"params": p, "batch_stats": bstats}, x, train=True,
+                mutable=["batch_stats"])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), y).mean()
+            with amp.scale_loss(loss, opt_state) as scaled:
+                return scaled, (loss, mut["batch_stats"])
+        (_, (loss, bstats2)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        params2, opt_state2 = optimizer.step(params, grads, opt_state)
+        return params2, bstats2, opt_state2, loss
+
+    l0 = None
+    for _ in range(3):
+        params, bstats, opt_state, loss = step(params, bstats, opt_state, x, y)
+        l0 = l0 if l0 is not None else float(loss)
+    assert np.isfinite(float(loss))
+
+
+def test_mlp():
+    m = models.MLP(features=(32,), num_classes=10)
+    v = m.init(jax.random.PRNGKey(0), jnp.ones((2, 28, 28, 1)))
+    assert m.apply(v, jnp.ones((2, 28, 28, 1))).shape == (2, 10)
+
+
+def test_dcgan_shapes():
+    g = models.Generator(z_dim=16, base_features=8)
+    d = models.Discriminator(base_features=8)
+    z = jax.random.normal(jax.random.PRNGKey(0), (2, 16))
+    gv = g.init(jax.random.PRNGKey(1), z, train=False)
+    img = g.apply(gv, z, train=False)
+    assert img.shape == (2, 64, 64, 3)
+    assert float(jnp.max(jnp.abs(img))) <= 1.0
+    dv = d.init(jax.random.PRNGKey(2), img, train=False)
+    logits = d.apply(dv, img, train=False)
+    assert logits.shape == (2,)
+
+
+def test_bert_encoder_shapes():
+    cfg = models.BertConfig(vocab_size=100, hidden_size=32,
+                            num_hidden_layers=2, num_attention_heads=4,
+                            intermediate_size=64,
+                            max_position_embeddings=16)
+    enc = models.BertEncoder(cfg)
+    ids = jnp.ones((2, 8), jnp.int32)
+    mask = jnp.ones((2, 8), jnp.int32)
+    v = enc.init(jax.random.PRNGKey(0), ids, mask)
+    out = enc.apply(v, ids, mask)
+    assert out.shape == (2, 8, 32)
+
+
+def test_bert_mask_blocks_attention():
+    cfg = models.BertConfig(vocab_size=50, hidden_size=16,
+                            num_hidden_layers=1, num_attention_heads=2,
+                            intermediate_size=32,
+                            max_position_embeddings=8)
+    enc = models.BertEncoder(cfg)
+    ids = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    v = enc.init(jax.random.PRNGKey(0), ids)
+    mask = jnp.asarray([[1, 1, 0, 0]], jnp.int32)
+    out1 = enc.apply(v, ids, mask)
+    ids2 = ids.at[0, 3].set(9)  # change a masked-out token
+    out2 = enc.apply(v, ids2, mask)
+    # visible positions unaffected by masked-token change
+    np.testing.assert_allclose(np.asarray(out1[:, :2]),
+                               np.asarray(out2[:, :2]), atol=1e-6)
+
+
+def test_bert_pretraining_heads():
+    cfg = models.BertConfig(vocab_size=60, hidden_size=16,
+                            num_hidden_layers=1, num_attention_heads=2,
+                            intermediate_size=32,
+                            max_position_embeddings=8)
+    m = models.BertForPreTraining(cfg)
+    ids = jnp.ones((2, 6), jnp.int32)
+    v = m.init(jax.random.PRNGKey(0), ids)
+    mlm, nsp = m.apply(v, ids)
+    assert mlm.shape == (2, 6, 60)
+    assert nsp.shape == (2, 2)
+
+
+def test_bert_trains_with_fused_lamb():
+    from apex_tpu.optimizers import FusedLAMB
+    cfg = models.BertConfig(vocab_size=40, hidden_size=16,
+                            num_hidden_layers=1, num_attention_heads=2,
+                            intermediate_size=32,
+                            max_position_embeddings=8)
+    m = models.BertEncoder(cfg)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 40, (4, 8)),
+                      jnp.int32)
+    v = m.init(jax.random.PRNGKey(0), ids)
+    opt = FusedLAMB(lr=1e-2)
+    state = opt.init(v["params"])
+
+    def loss_fn(p):
+        out = m.apply({"params": p}, ids)
+        return jnp.mean(out ** 2)
+
+    l0 = float(loss_fn(v["params"]))
+    params = v["params"]
+    for _ in range(3):
+        g = jax.grad(loss_fn)(params)
+        params, state = opt.step(params, g, state)
+    assert float(loss_fn(params)) < l0
