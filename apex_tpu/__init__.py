@@ -34,6 +34,9 @@ are idiomatic JAX (see SURVEY.md section 7 for the design mapping).
 
 from apex_tpu import ops
 from apex_tpu import amp
+from apex_tpu import data
+from apex_tpu import models
+from apex_tpu import utils
 from apex_tpu import optimizers
 from apex_tpu import normalization
 from apex_tpu import parallel
@@ -47,6 +50,9 @@ __version__ = "0.1.0"
 __all__ = [
     "RNN",
     "amp",
+    "data",
+    "models",
+    "utils",
     "fp16_utils",
     "multi_tensor_apply",
     "normalization",

@@ -46,9 +46,11 @@ Pytree = Any
 # includes layer_norm/group_norm — functional_overrides.py:29-65).
 # Patterns are matched against individual path components; the short names
 # are anchored so e.g. "subnet"/"normal_init" don't accidentally match.
-BATCHNORM_PATTERNS = (r"BatchNorm", r"SyncBatchNorm", r"^bn(_|\d|$)")
+BATCHNORM_PATTERNS = (r"BatchNorm", r"SyncBatchNorm", r"^bn(_|\d|$)",
+                      r"_bn$")
 NORM_PATTERNS = BATCHNORM_PATTERNS + (r"LayerNorm", r"GroupNorm", r"RMSNorm",
-                                      r"^norm(_|\d|$)", r"_norm$")
+                                      r"^norm(_|\d|$)", r"_norm$",
+                                      r"^ln(_|\d|$)", r"_ln$")
 
 
 def _path_matches(path, patterns) -> bool:

@@ -1,0 +1,99 @@
+// apex_tpu native host runtime: multi-threaded buffer ops.
+//
+// The TPU-native counterpart of the reference's apex_C extension
+// (csrc/flatten_unflatten.cpp — torch flatten/unflatten of dense tensor
+// lists) plus the host side of its data pipeline (worker-process loaders).
+// On TPU the *device* compute belongs to XLA, but host-side byte shuffling
+// (checkpoint staging, batch assembly, flat-buffer packing for IO) is still
+// memory-bandwidth work that benefits from native parallel memcpy — Python
+// loops and even numpy fancy-indexing are single-threaded here.
+//
+// Exposed via a C ABI for ctypes (no pybind11 in this environment).
+
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Run fn(i) for i in [0, n) across up to n_threads threads.
+template <typename F>
+void parallel_for(int64_t n, int n_threads, F fn) {
+  if (n <= 0) return;
+  int hw = static_cast<int>(std::thread::hardware_concurrency());
+  if (n_threads <= 0) n_threads = hw > 0 ? hw : 4;
+  n_threads = static_cast<int>(std::min<int64_t>(n_threads, n));
+  if (n_threads <= 1) {
+    for (int64_t i = 0; i < n; ++i) fn(i);
+    return;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(n_threads);
+  int64_t chunk = (n + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = std::min(n, lo + chunk);
+    if (lo >= hi) break;
+    threads.emplace_back([=]() {
+      for (int64_t i = lo; i < hi; ++i) fn(i);
+    });
+  }
+  for (auto& th : threads) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// dst[i, :] = src[idx[i], :] — row gather over contiguous row_bytes rows.
+// Batch-assembly hot path for the data loader.
+void apex_gather_rows(const uint8_t* src, int64_t row_bytes,
+                      const int64_t* idx, int64_t n_idx, uint8_t* dst,
+                      int n_threads) {
+  parallel_for(n_idx, n_threads, [=](int64_t i) {
+    std::memcpy(dst + i * row_bytes, src + idx[i] * row_bytes,
+                static_cast<size_t>(row_bytes));
+  });
+}
+
+// Pack n buffers (sizes[i] bytes each) back-to-back into dst.
+// apex_C `flatten` analog over raw host buffers.
+void apex_flatten(const uint8_t** srcs, const int64_t* sizes, int64_t n,
+                  uint8_t* dst, int n_threads) {
+  std::vector<int64_t> offsets(n + 1, 0);
+  for (int64_t i = 0; i < n; ++i) offsets[i + 1] = offsets[i] + sizes[i];
+  parallel_for(n, n_threads, [&](int64_t i) {
+    std::memcpy(dst + offsets[i], srcs[i], static_cast<size_t>(sizes[i]));
+  });
+}
+
+// Split src back into n buffers. apex_C `unflatten` analog.
+void apex_unflatten(const uint8_t* src, uint8_t** dsts, const int64_t* sizes,
+                    int64_t n, int n_threads) {
+  std::vector<int64_t> offsets(n + 1, 0);
+  for (int64_t i = 0; i < n; ++i) offsets[i + 1] = offsets[i] + sizes[i];
+  parallel_for(n, n_threads, [&](int64_t i) {
+    std::memcpy(dsts[i], src + offsets[i], static_cast<size_t>(sizes[i]));
+  });
+}
+
+// uint8 HWC -> float32 normalized (x - mean[c]) / std[c], fused with the
+// host->float conversion the imagenet pipeline otherwise does in numpy.
+void apex_normalize_u8(const uint8_t* src, int64_t n_pixels, int64_t channels,
+                       const float* mean, const float* std_, float* dst,
+                       int n_threads) {
+  std::vector<float> inv(channels);
+  for (int64_t c = 0; c < channels; ++c) inv[c] = 1.0f / std_[c];
+  parallel_for(n_pixels, n_threads, [&, src, dst](int64_t p) {
+    const uint8_t* s = src + p * channels;
+    float* d = dst + p * channels;
+    for (int64_t c = 0; c < channels; ++c)
+      d[c] = (static_cast<float>(s[c]) - mean[c]) * inv[c];
+  });
+}
+
+int apex_native_abi_version() { return 1; }
+
+}  // extern "C"

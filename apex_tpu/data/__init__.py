@@ -1,0 +1,23 @@
+"""apex_tpu.data — host-side input pipelines with device prefetch.
+
+The reference's examples lean on torchvision/DALI loaders with pinned
+memory and ``--workers`` processes (``examples/imagenet/main_amp.py``).
+The TPU equivalents here:
+
+- :func:`npz_loader` — stream ``.npz`` shards (``x`` NHWC uint8, ``y``
+  int) from a directory;
+- :func:`synthetic_loader` — zero-IO random batches for benchmarking;
+- :func:`prefetch_to_device` — background-thread host→device transfer so
+  step N+1's batch is already on-chip when step N finishes (the pinned-
+  memory/non_blocking-copy analog);
+- the native fast path (``apex_tpu.ops.native``) accelerates host-side
+  batch assembly (gather + layout) in C++ when the extension is built.
+"""
+
+from apex_tpu.data.loaders import (
+    npz_loader,
+    prefetch_to_device,
+    synthetic_loader,
+)
+
+__all__ = ["npz_loader", "prefetch_to_device", "synthetic_loader"]

@@ -10,8 +10,9 @@ model, TPU-first:
   ``normalization.FusedLayerNorm`` (Pallas kernels on TPU);
 - attention as batched einsum -> one fused softmax -> einsum, all
   MXU-shaped (no per-head Python loops);
-- optional sequence-parallel attention: pass ``attention_fn`` to swap in
-  ``parallel.ring_attention`` for long sequences;
+- pluggable attention: pass ``attention_fn`` (same signature as
+  :func:`dot_product_attention`) to swap in a sequence-parallel kernel
+  such as ring attention for long sequences;
 - static shapes; masking via additive -inf biases (no dynamic slicing).
 
 ``BertConfig`` mirrors the standard hyperparameter names so configs port
@@ -143,11 +144,14 @@ class BertEncoder(nn.Module):
         pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
                        embedding_init=init, name="position_embeddings")(
             jnp.arange(s)[None, :])
-        emb = emb + pos
-        if token_type_ids is not None:
-            emb = emb + nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
-                                 embedding_init=init,
-                                 name="token_type_embeddings")(token_type_ids)
+        # segment table always exists (standard BERT: ids default to 0)
+        # so init-without-segments checkpoints still apply with them
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        typ = nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
+                       embedding_init=init,
+                       name="token_type_embeddings")(token_type_ids)
+        emb = emb + pos + typ
         x = FusedLayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps,
                            name="embeddings_ln")(emb)
         x = nn.Dropout(cfg.hidden_dropout_prob,
@@ -178,7 +182,7 @@ class BertForPreTraining(nn.Module):
         enc = BertEncoder(cfg, self.attention_fn, name="encoder")
         seq = enc(input_ids, attention_mask, token_type_ids, deterministic)
 
-        # MLM: transform -> tied decoder
+        # MLM: transform -> untied decoder projection
         h = nn.Dense(cfg.hidden_size, kernel_init=init,
                      name="mlm_transform")(seq)
         h = nn.gelu(h, approximate=False)

@@ -16,8 +16,10 @@ from apex_tpu.ops.multi_tensor import (
     tree_any_nonfinite,
 )
 from apex_tpu.ops.flatten import flatten, unflatten, flatten_like
+from apex_tpu.ops import native
 
 __all__ = [
+    "native",
     "multi_tensor_scale",
     "multi_tensor_axpby",
     "multi_tensor_l2norm",

@@ -1,0 +1,180 @@
+"""ctypes bindings for the native host runtime (``csrc/host_ops.cpp``).
+
+The reference ships apex_C (``csrc/flatten_unflatten.cpp``) as a C++
+extension built by setup.py with graceful degradation when absent
+(``apex/parallel/distributed.py:13-33`` falls back to torch's python
+path). Same contract here: the shared library is compiled on first use
+with g++ (no pip involved), cached next to this file, and every entry
+point has a numpy fallback — ``available`` tells you which path is live.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import List, Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(os.path.dirname(_HERE), "csrc", "host_ops.cpp")
+_LIB_PATH = os.path.join(_HERE, "_libapex_tpu_host.so")
+
+_lib: Optional[ctypes.CDLL] = None
+available = False
+
+
+def _build() -> bool:
+    try:
+        # build into a temp file then atomic-rename so concurrent imports
+        # never load a half-written .so
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+        os.close(fd)
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+               _SRC, "-o", tmp]
+        r = subprocess.run(cmd, capture_output=True, timeout=120)
+        if r.returncode != 0:
+            os.unlink(tmp)
+            return False
+        os.replace(tmp, _LIB_PATH)
+        return True
+    except Exception:
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, available
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH) and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.apex_native_abi_version.restype = ctypes.c_int
+        if lib.apex_native_abi_version() != 1:
+            return None
+    except OSError:
+        # stale .so (e.g. different arch) — rebuild once
+        try:
+            os.unlink(_LIB_PATH)
+        except OSError:
+            pass
+        if not _build():
+            return None
+        lib = ctypes.CDLL(_LIB_PATH)
+
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    lib.apex_gather_rows.argtypes = [u8p, ctypes.c_int64, i64p,
+                                     ctypes.c_int64, u8p, ctypes.c_int]
+    lib.apex_flatten.argtypes = [ctypes.POINTER(u8p), i64p, ctypes.c_int64,
+                                 u8p, ctypes.c_int]
+    lib.apex_unflatten.argtypes = [u8p, ctypes.POINTER(u8p), i64p,
+                                   ctypes.c_int64, ctypes.c_int]
+    lib.apex_normalize_u8.argtypes = [u8p, ctypes.c_int64, ctypes.c_int64,
+                                      f32p, f32p, f32p, ctypes.c_int]
+    _lib = lib
+    available = True
+    return lib
+
+
+def _u8(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray, *,
+                n_threads: int = 0) -> np.ndarray:
+    """``out[i] = src[idx[i]]`` along axis 0, multi-threaded memcpy.
+
+    Contiguous ``src`` of any dtype; ``idx`` int64. Falls back to numpy
+    fancy indexing when the native library is unavailable.
+    """
+    lib = _load()
+    src = np.ascontiguousarray(src)
+    idx = np.ascontiguousarray(idx, np.int64)
+    if lib is None:
+        return src[idx]
+    out = np.empty((idx.shape[0],) + src.shape[1:], src.dtype)
+    row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
+    lib.apex_gather_rows(
+        _u8(src), row_bytes,
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        idx.shape[0], _u8(out), n_threads)
+    return out
+
+
+def flatten(arrays: List[np.ndarray], *, n_threads: int = 0) -> np.ndarray:
+    """Pack host arrays into one flat byte-compatible 1-D array of the
+    common dtype (apex_C ``flatten`` analog; reference
+    ``csrc/flatten_unflatten.cpp:5-10``)."""
+    if not arrays:
+        return np.empty((0,), np.float32)
+    dtype = arrays[0].dtype
+    if any(a.dtype != dtype for a in arrays):
+        raise ValueError("flatten requires a uniform dtype across arrays")
+    arrays = [np.ascontiguousarray(a) for a in arrays]
+    lib = _load()
+    if lib is None:
+        return np.concatenate([a.reshape(-1) for a in arrays])
+    total = sum(a.size for a in arrays)
+    out = np.empty((total,), dtype)
+    n = len(arrays)
+    srcs = (ctypes.POINTER(ctypes.c_uint8) * n)(*[_u8(a) for a in arrays])
+    sizes = (ctypes.c_int64 * n)(*[a.nbytes for a in arrays])
+    lib.apex_flatten(srcs, ctypes.cast(sizes, ctypes.POINTER(ctypes.c_int64)),
+                     n, _u8(out), n_threads)
+    return out
+
+
+def unflatten(flat: np.ndarray, like: List[np.ndarray], *,
+              n_threads: int = 0) -> List[np.ndarray]:
+    """Split ``flat`` back into arrays shaped like ``like`` (apex_C
+    ``unflatten`` analog; reference ``csrc/flatten_unflatten.cpp:12-17``)."""
+    flat = np.ascontiguousarray(flat)
+    total = sum(a.size for a in like)
+    if flat.size != total:
+        raise ValueError(f"flat has {flat.size} elems; expected {total}")
+    lib = _load()
+    if lib is None:
+        outs, off = [], 0
+        for a in like:
+            outs.append(flat[off:off + a.size].reshape(a.shape).astype(
+                a.dtype, copy=True))
+            off += a.size
+        return outs
+    outs = [np.empty(a.shape, flat.dtype) for a in like]
+    n = len(like)
+    dsts = (ctypes.POINTER(ctypes.c_uint8) * n)(*[_u8(o) for o in outs])
+    sizes = (ctypes.c_int64 * n)(*[o.nbytes for o in outs])
+    lib.apex_unflatten(_u8(flat), dsts,
+                       ctypes.cast(sizes, ctypes.POINTER(ctypes.c_int64)),
+                       n, n_threads)
+    return outs
+
+
+def normalize_u8(x: np.ndarray, mean, std, *, n_threads: int = 0) -> np.ndarray:
+    """uint8 NHWC -> fp32 ``(x - mean[c]) / std[c]`` fused on the host
+    (the imagenet pipeline's normalize step; falls back to numpy)."""
+    x = np.ascontiguousarray(x, np.uint8)
+    c = x.shape[-1]
+    mean = np.ascontiguousarray(mean, np.float32)
+    std = np.ascontiguousarray(std, np.float32)
+    lib = _load()
+    if lib is None:
+        return (x.astype(np.float32) - mean) / std
+    out = np.empty(x.shape, np.float32)
+    lib.apex_normalize_u8(
+        _u8(x), x.size // c, c,
+        mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n_threads)
+    return out
+
+
+# trigger a build eagerly so `available` reflects reality at import time,
+# mirroring the reference's import-time extension probe
+# (apex/multi_tensor_apply/multi_tensor_apply.py:8-14)
+_load()

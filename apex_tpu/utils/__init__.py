@@ -1,0 +1,37 @@
+"""apex_tpu.utils — observability and training-loop utilities.
+
+The reference scatters these across examples and test harnesses (no utils
+package of its own): ``AverageMeter`` (reference
+``examples/imagenet/main_amp.py:445-460``), nvtx range annotations
+(``apex/parallel/sync_batchnorm.py:66`` and friends), rank0-aware printing
+(``apex/amp/_amp_state.py:43-52``), and torch ``state_dict`` checkpointing
+conventions. Here they are first-class:
+
+- :class:`AverageMeter` — running value/average tracker;
+- :func:`trace_annotation` / :func:`annotate_function` — xprof trace
+  annotations (the TPU analog of nvtx push/pop);
+- :func:`maybe_print` — verbosity- and rank-gated printing;
+- :mod:`apex_tpu.utils.checkpoint` — one-call save/restore of a full
+  train-state pytree including amp loss-scaler state (fixes the
+  reference's amp-state checkpoint gap, SURVEY.md §5).
+"""
+
+from apex_tpu.amp._amp_state import maybe_print
+from apex_tpu.utils.meters import AverageMeter
+from apex_tpu.utils.profiling import (
+    annotate_function,
+    trace_annotation,
+    start_trace,
+    stop_trace,
+)
+from apex_tpu.utils import checkpoint
+
+__all__ = [
+    "AverageMeter",
+    "annotate_function",
+    "checkpoint",
+    "maybe_print",
+    "start_trace",
+    "stop_trace",
+    "trace_annotation",
+]

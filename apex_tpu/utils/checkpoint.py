@@ -1,0 +1,85 @@
+"""Checkpoint/resume for full train states (params + opt + amp scaler).
+
+The reference delegates checkpointing to torch ``state_dict`` conventions
+and its FP16 optimizers serialize fp32 masters + scaler state separately
+(``apex/fp16_utils/fp16_optimizer.py:298-359`` "option 2";
+``apex/optimizers/fp16_optimizer.py:211-274``) — but the new amp API has
+no ``amp.state_dict`` at all, so O1/O2 loss-scale state is silently lost
+on resume (SURVEY.md §5). Here the whole train state — params,
+batch_stats, optimizer state *including* ``AmpOptimizerState`` with its
+loss-scaler pytrees — is one pytree and checkpointing is one call.
+
+Backend: orbax-checkpoint when importable (async-capable, multi-host
+aware), else a numpy ``.npz`` + structure-pickle fallback with the same
+API. Restore always takes a ``target`` pytree so namedtuple/custom-node
+structure (AmpOptimizerState, optax states) round-trips exactly.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+Pytree = Any
+
+try:  # pragma: no cover - environment probe
+    import orbax.checkpoint as _ocp
+except Exception:  # pragma: no cover
+    _ocp = None
+
+
+def _is_orbax_dir(path: str) -> bool:
+    return os.path.isdir(path) and not os.path.exists(
+        os.path.join(path, "train_state.npz"))
+
+
+def save(path: str, state: Pytree, *, force: bool = True) -> None:
+    """Save ``state`` (any pytree) to ``path`` (a directory)."""
+    path = os.path.abspath(path)
+    state = jax.device_get(state)
+    if _ocp is not None:
+        ckptr = _ocp.PyTreeCheckpointer()
+        ckptr.save(path, state, force=force)
+        return
+    os.makedirs(path, exist_ok=True)
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    np.savez(os.path.join(path, "train_state.npz"),
+             **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)})
+    with open(os.path.join(path, "treedef.pkl"), "wb") as f:
+        pickle.dump(treedef, f)
+
+
+def restore(path: str, target: Optional[Pytree] = None) -> Pytree:
+    """Restore the pytree saved at ``path``.
+
+    ``target`` (an example pytree of the right structure, e.g. the freshly
+    initialized train state) restores custom node types and dtypes
+    faithfully; without it, containers come back as plain dict/lists.
+    """
+    path = os.path.abspath(path)
+    if _ocp is not None and _is_orbax_dir(path):
+        ckptr = _ocp.PyTreeCheckpointer()
+        if target is not None:
+            restored = ckptr.restore(path, item=jax.device_get(target))
+        else:
+            restored = ckptr.restore(path)
+        return restored
+    with open(os.path.join(path, "treedef.pkl"), "rb") as f:
+        treedef = pickle.load(f)
+    with np.load(os.path.join(path, "train_state.npz")) as z:
+        leaves = [z[f"leaf_{i}"] for i in range(len(z.files))]
+    state = jax.tree_util.tree_unflatten(treedef, leaves)
+    if target is not None:
+        # re-shape onto the target structure (validates compatibility)
+        t_leaves, t_def = jax.tree_util.tree_flatten(target)
+        s_leaves = jax.tree_util.tree_leaves(state)
+        if len(t_leaves) != len(s_leaves):
+            raise ValueError(
+                f"checkpoint has {len(s_leaves)} leaves; target expects "
+                f"{len(t_leaves)}")
+        state = jax.tree_util.tree_unflatten(t_def, s_leaves)
+    return state

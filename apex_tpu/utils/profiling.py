@@ -1,0 +1,51 @@
+"""Trace annotations + profiler control — the TPU analog of nvtx.
+
+The reference marks hot phases with ``torch.cuda.nvtx.range_push/pop``
+(``apex/parallel/sync_batchnorm.py:66,84,129``,
+``optimized_sync_batchnorm_kernel.py:11,66,72,109``) and drives nsight via
+``cudaProfilerStart/Stop`` (``tests/distributed/DDP/ddp_race_condition_test.py:44,66``).
+On TPU the equivalents are ``jax.profiler.TraceAnnotation`` (shows up in
+xprof/tensorboard timelines) and ``jax.profiler.start_trace/stop_trace``.
+
+Annotations are named at trace time; inside jit they label the traced
+region rather than per-step execution — which is exactly what xprof
+needs (ops carry the annotation through compilation).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+
+@contextlib.contextmanager
+def trace_annotation(name: str, **kwargs):
+    """``with trace_annotation("forward"):`` — nvtx range_push/pop analog."""
+    import jax.profiler
+
+    with jax.profiler.TraceAnnotation(name, **kwargs):
+        yield
+
+
+def annotate_function(fn=None, *, name: str = None):
+    """Decorator form (nvtx ``@annotate`` analog); labels the wrapped
+    function's ops in profiler timelines."""
+    if fn is None:
+        return functools.partial(annotate_function, name=name)
+    import jax.profiler
+
+    return jax.profiler.annotate_function(fn, name=name)
+
+
+def start_trace(log_dir: str, **kwargs):
+    """Begin an xprof trace (``cudaProfilerStart`` analog)."""
+    import jax.profiler
+
+    jax.profiler.start_trace(log_dir, **kwargs)
+
+
+def stop_trace():
+    """End the xprof trace (``cudaProfilerStop`` analog)."""
+    import jax.profiler
+
+    jax.profiler.stop_trace()

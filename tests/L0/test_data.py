@@ -1,0 +1,57 @@
+"""Data-pipeline tests: loaders, device prefetch, native batch assembly."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.data import npz_loader, prefetch_to_device, synthetic_loader
+
+
+def test_synthetic_loader_shapes():
+    it = synthetic_loader(batch_size=4, image_size=8, num_classes=5)
+    x, y = next(it)
+    assert x.shape == (4, 8, 8, 3) and x.dtype == np.uint8
+    assert y.shape == (4,) and y.dtype == np.int32
+    assert y.max() < 5
+
+
+def test_npz_loader_covers_data(tmp_path):
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 256, (10, 4, 4, 3), dtype=np.uint8)
+    y = np.arange(10).astype(np.int32)
+    np.savez(tmp_path / "shard0.npz", x=x, y=y)
+    it = npz_loader(str(tmp_path), batch_size=5, shuffle=False)
+    xb, yb = next(it)
+    assert xb.shape == (5, 4, 4, 3)
+    np.testing.assert_array_equal(yb, y[:5])
+    xb2, yb2 = next(it)
+    np.testing.assert_array_equal(yb2, y[5:])
+    # deterministic re-iteration over the shard
+    xb3, yb3 = next(it)
+    np.testing.assert_array_equal(yb3, y[:5])
+    np.testing.assert_array_equal(xb3, x[:5])
+
+
+def test_prefetch_to_device_yields_device_arrays():
+    def host_iter():
+        for i in range(3):
+            yield (np.full((2, 2), i, np.float32), np.array([i], np.int32))
+
+    out = list(prefetch_to_device(host_iter(), size=2))
+    assert len(out) == 3
+    for i, (x, y) in enumerate(out):
+        assert isinstance(x, jax.Array)
+        np.testing.assert_array_equal(np.asarray(x), np.full((2, 2), i))
+
+
+def test_prefetch_with_sharding():
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    shard = NamedSharding(mesh, P("data"))
+
+    def host_iter():
+        yield np.arange(16, dtype=np.float32)
+
+    (x,) = list(prefetch_to_device(host_iter(), sharding=shard))
+    assert x.sharding == shard
+    np.testing.assert_array_equal(np.asarray(x), np.arange(16))

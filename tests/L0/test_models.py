@@ -76,6 +76,50 @@ def test_resnet_amp_o2_bn_stays_fp32():
         assert jnp.asarray(leaf).dtype == jnp.float32, p
 
 
+def test_resnet_amp_o2_named_bns_stay_fp32_in_compute():
+    """Explicitly-named norms (stem_bn, downsample_bn, *_ln) must match the
+    keep-fp32 patterns, not just auto-named BatchNorm_N (review regression)."""
+    model, _ = amp.initialize(models.ResNet18(num_classes=4, width=8),
+                              optax.sgd(0.1), opt_level="O2", verbosity=0)
+    x = jnp.ones((2, 32, 32, 3))
+    v = model.init(jax.random.PRNGKey(0), x, train=False)
+    cv = model.compute_variables(v)
+    for p, leaf in jax.tree_util.tree_flatten_with_path(cv)[0]:
+        names = "/".join(str(getattr(k, "key", k)) for k in p)
+        if "bn" in names.lower() or "batchnorm" in names.lower():
+            assert jnp.asarray(leaf).dtype == jnp.float32, names
+
+
+def test_bert_named_lns_stay_fp32_under_o1():
+    cfg = models.BertConfig(vocab_size=50, hidden_size=32,
+                            num_hidden_layers=1, num_attention_heads=2,
+                            intermediate_size=64,
+                            max_position_embeddings=16)
+    model, _ = amp.initialize(models.BertEncoder(cfg), optax.sgd(0.1),
+                              opt_level="O1", verbosity=0)
+    ids = jnp.zeros((2, 8), jnp.int32)
+    v = model.init(jax.random.PRNGKey(0), ids)
+    cv = model.compute_variables(v)
+    for p, leaf in jax.tree_util.tree_flatten_with_path(cv)[0]:
+        names = "/".join(str(getattr(k, "key", k)) for k in p)
+        if "_ln" in names or "LayerNorm" in names:
+            assert jnp.asarray(leaf).dtype == jnp.float32, names
+
+
+def test_bert_token_type_table_exists_without_segments():
+    """init without token_type_ids, apply with them (review regression)."""
+    cfg = models.BertConfig(vocab_size=50, hidden_size=32,
+                            num_hidden_layers=1, num_attention_heads=2,
+                            intermediate_size=64,
+                            max_position_embeddings=16)
+    enc = models.BertEncoder(cfg)
+    ids = jnp.zeros((2, 8), jnp.int32)
+    v = enc.init(jax.random.PRNGKey(0), ids)
+    seg = jnp.ones((2, 8), jnp.int32)
+    out = enc.apply(v, ids, token_type_ids=seg)
+    assert out.shape == (2, 8, 32)
+
+
 def test_resnet_amp_o2_train_step():
     model, optimizer = amp.initialize(
         models.ResNet18(num_classes=4, width=8), optax.sgd(0.1),
@@ -106,6 +150,7 @@ def test_resnet_amp_o2_train_step():
         params, bstats, opt_state, loss = step(params, bstats, opt_state, x, y)
         l0 = l0 if l0 is not None else float(loss)
     assert np.isfinite(float(loss))
+    assert float(loss) < l0
 
 
 def test_mlp():

@@ -1,0 +1,58 @@
+"""Native host-runtime ops (csrc/host_ops.cpp via ctypes) vs numpy.
+
+Mirrors the reference's approach of testing extension kernels against a
+pure reference implementation (e.g. ``tests/L0/run_amp/test_multi_tensor_scale.py``)
+— here the oracle is numpy and sizes are odd on purpose.
+"""
+
+import numpy as np
+import pytest
+
+from apex_tpu.ops import native
+
+
+pytestmark = pytest.mark.skipif(
+    not native.available, reason="native host library failed to build")
+
+
+@pytest.mark.parametrize("dtype", [np.uint8, np.float32, np.float16, np.int64])
+@pytest.mark.parametrize("shape", [(37, 5), (64, 3, 7), (128,)])
+def test_gather_rows(dtype, shape):
+    rng = np.random.RandomState(0)
+    src = (rng.rand(*shape) * 100).astype(dtype)
+    idx = rng.randint(0, shape[0], 53).astype(np.int64)
+    np.testing.assert_array_equal(native.gather_rows(src, idx), src[idx])
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float16])
+def test_flatten_unflatten_roundtrip(dtype):
+    rng = np.random.RandomState(1)
+    arrs = [rng.randn(*s).astype(dtype)
+            for s in [(27,), (55, 2), (34, 1, 3), (1,), (35,)]]
+    flat = native.flatten(arrs)
+    assert flat.shape == (sum(a.size for a in arrs),)
+    np.testing.assert_array_equal(
+        flat, np.concatenate([a.ravel() for a in arrs]))
+    outs = native.unflatten(flat, arrs)
+    for a, b in zip(outs, arrs):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_flatten_dtype_mismatch():
+    with pytest.raises(ValueError):
+        native.flatten([np.zeros(3, np.float32), np.zeros(3, np.float16)])
+
+
+def test_unflatten_size_mismatch():
+    with pytest.raises(ValueError):
+        native.unflatten(np.zeros(10, np.float32), [np.zeros(3, np.float32)])
+
+
+def test_normalize_u8():
+    rng = np.random.RandomState(2)
+    x = rng.randint(0, 256, (4, 7, 7, 3), dtype=np.uint8)
+    mean = np.array([100.0, 120.0, 140.0], np.float32)
+    std = np.array([50.0, 55.0, 60.0], np.float32)
+    got = native.normalize_u8(x, mean, std)
+    want = (x.astype(np.float32) - mean) / std
+    np.testing.assert_allclose(got, want, rtol=1e-6)
