@@ -37,8 +37,15 @@ from apex_tpu.amp.functional import (
     master_params,
 )
 from apex_tpu.amp._amp_state import _amp_state, maybe_print
+from apex_tpu.amp import lists
+from apex_tpu.amp.compat_api import AmpHandle, NoOpHandle, OptimWrapper, init
 
 __all__ = [
+    "AmpHandle",
+    "NoOpHandle",
+    "OptimWrapper",
+    "init",
+    "lists",
     "AmpModel",
     "AmpOptimizer",
     "AmpOptimizerState",

@@ -22,6 +22,12 @@ from apex_tpu.parallel.sync_batchnorm import (
 )
 from apex_tpu.parallel.LARC import LARC
 from apex_tpu.parallel.multiproc import initialize_distributed
+from apex_tpu.parallel.sequence import (
+    make_ring_attention,
+    make_ulysses_attention,
+    ring_attention,
+    ulysses_attention,
+)
 
 
 def create_syncbn_process_group(group_size: int, axis_name: str = "data",
@@ -43,6 +49,10 @@ __all__ = [
     "create_process_group",
     "create_syncbn_process_group",
     "initialize_distributed",
+    "make_ring_attention",
+    "make_ulysses_attention",
     "merge_stats",
+    "ring_attention",
+    "ulysses_attention",
     "welford_combine",
 ]

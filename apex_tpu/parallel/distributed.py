@@ -179,14 +179,22 @@ class DistributedDataParallel:
             n = lax.psum(1, pg.axis_name)
         n_world = lax.psum(1, pg.axis_name)
 
+        # vma tracking is only meaningful when shard_map's varying-axis
+        # checking is on; under check_rep/check_vma=False EVERY value has
+        # an empty vma set and "not in vma" would wrongly skip the psum.
+        # Probe with axis_index, which is varying by construction.
+        try:
+            probe = lax.axis_index(pg.axis_name)
+            vma_tracked = pg.axis_name in jax.typeof(probe).vma
+        except AttributeError:
+            vma_tracked = False
+
         def one(g):
             orig_dtype = g.dtype
             if self.allreduce_always_fp32:
                 g = g.astype(jnp.float32)
-            try:
-                already_summed = pg.axis_name not in jax.typeof(g).vma
-            except AttributeError:
-                already_summed = False
+            already_summed = (vma_tracked
+                              and pg.axis_name not in jax.typeof(g).vma)
             if already_summed:
                 # autodiff's implicit psum ran over the FULL axis, so the
                 # average divides by the world size — a sub-group mean is

@@ -1,0 +1,231 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference (a 2019 library) predates long-context training and has
+nothing here (SURVEY.md §2.3); this module makes sequence parallelism a
+first-class part of the TPU framework, designed for ICI:
+
+- :func:`ring_attention` — blockwise attention with the KV shards rotating
+  around the mesh axis via ``lax.ppermute`` (one neighbor hop per step, so
+  comm rides the ICI ring) and a flash-style online-softmax accumulator in
+  fp32. Memory per chip is O(S_local^2 / n_ring) score blocks; sequence
+  length scales linearly with the number of chips. (Pattern: Liu et al.,
+  "Ring Attention with Blockwise Transformers"; built from scratch here.)
+- :func:`ulysses_attention` — all-to-all sequence parallelism: reshard
+  from sequence-sharded to head-sharded with ``lax.all_to_all``, run local
+  full attention over the complete sequence, reshard back. Two collectives
+  per call, best when heads >= n_devices and the sequence fits one chip's
+  memory after the swap.
+- :func:`make_ring_attention` / :func:`make_ulysses_attention` — adapters
+  with the ``attention_fn(q, k, v, bias, dropout_fn)`` signature that
+  ``models.bert`` accepts, so the encoder becomes sequence-parallel by
+  swapping one callable.
+
+All functions run inside ``shard_map``/``pmap`` where ``axis_name`` is
+bound; tensors are the local sequence shards (B, S_local, H, D).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30  # large-negative fp32 (not -inf: keeps exp/where NaN-free)
+
+
+def _online_block_update(m, den, acc, scores, v):
+    """One online-softmax accumulation step, all fp32.
+
+    m: (B, H, Sq) running max; den: (B, H, Sq) running denominator;
+    acc: (B, Sq, H, D) running numerator; scores: (B, H, Sq, Sk) this
+    block's logits; v: (B, Sk, H, D) this block's values.
+    """
+    m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+    # renormalize previous accumulators to the new max
+    correction = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new[..., None])            # (B, H, Sq, Sk)
+    den = den * correction + jnp.sum(p, axis=-1)
+    acc = acc * jnp.transpose(correction, (0, 2, 1))[..., None] \
+        + jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return m_new, den, acc
+
+
+def ring_attention(q, k, v, *, axis_name: str,
+                   kv_mask: Optional[jax.Array] = None,
+                   causal: bool = False,
+                   scale: Optional[float] = None):
+    """Exact attention over a sequence sharded on ``axis_name``.
+
+    Args:
+      q, k, v: local shards (B, S_local, H, D). The global sequence is the
+        concatenation of shards in axis-index order.
+      kv_mask: optional (B, S_local) additive fp32 mask for *this shard's*
+        keys (0 keep, large-negative drop) — the sequence-sharded form of
+        BERT's key padding mask. It travels the ring with its KV shard.
+      causal: apply causal masking using global positions (shard offsets
+        from ``lax.axis_index``).
+      scale: logit scale; defaults to 1/sqrt(D).
+
+    Returns (B, S_local, H, D) in q's dtype. Gradients flow through the
+    ppermute rotations, so the backward pass is itself a ring program.
+    """
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+
+    q32 = q.astype(jnp.float32) * scale
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    if kv_mask is None:
+        kv_mask = jnp.zeros((b, s_local), jnp.float32)
+    kv_mask = kv_mask.astype(jnp.float32)
+
+    def _vary(x):
+        # the scan carry must be varying-typed on the mesh axis (ppermute
+        # outputs are); under check_vma, unvaried literals in the init
+        # carry would make carry-in/carry-out types disagree. No-op for
+        # inputs that are already varying (e.g. sharded-in masks).
+        try:
+            if axis_name in jax.typeof(x).vma:
+                return x
+            return lax.pvary(x, axis_name)
+        except AttributeError:
+            return x
+
+    q_pos = my_idx * s_local + jnp.arange(s_local)    # global q positions
+
+    def body(carry, step):
+        k_blk, v_blk, mask_blk, m, den, acc = carry
+        # the block we hold at `step` originated at rank (my_idx - step)
+        src = (my_idx - step) % n
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q32,
+                            k_blk.astype(jnp.float32))
+        scores = scores + mask_blk[:, None, None, :]
+        if causal:
+            k_pos = src * s_local + jnp.arange(s_local)
+            allowed = q_pos[:, None] >= k_pos[None, :]   # (Sq, Sk)
+            scores = jnp.where(allowed[None, None], scores, NEG_INF)
+        m, den, acc = _online_block_update(m, den, acc, scores, v_blk)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        mask_blk = lax.ppermute(mask_blk, axis_name, perm)
+        return (k_blk, v_blk, mask_blk, m, den, acc), None
+
+    m0 = _vary(jnp.full((b, h, s_local), NEG_INF, jnp.float32))
+    den0 = _vary(jnp.zeros((b, h, s_local), jnp.float32))
+    acc0 = _vary(jnp.zeros((b, s_local, h, d), jnp.float32))
+    (_, _, _, m, den, acc), _ = lax.scan(
+        body, (k, v, _vary(kv_mask), m0, den0, acc0), jnp.arange(n))
+
+    # a row whose every key is masked (or causally excluded) never saw a
+    # score above ~NEG_INF: its running max stays < NEG_INF/2. Emit zeros
+    # for such rows instead of a softmax over the mask offsets.
+    valid = jnp.transpose(m > NEG_INF / 2, (0, 2, 1))[..., None]
+    den = jnp.transpose(den, (0, 2, 1))[..., None]    # (B, Sq, H, 1)
+    out = jnp.where(valid, acc / jnp.maximum(den, 1e-30), 0.0)
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, *, axis_name: str,
+                      kv_mask: Optional[jax.Array] = None,
+                      causal: bool = False,
+                      scale: Optional[float] = None,
+                      attention_impl: Optional[Callable] = None):
+    """All-to-all sequence parallelism (the "Ulysses" pattern).
+
+    Input shards (B, S_local, H, D) with H divisible by the axis size.
+    ``lax.all_to_all`` swaps the sharded dimension: each chip ends up with
+    the FULL sequence for H/n heads, runs ordinary full attention locally
+    (``attention_impl`` hook, default exact softmax attention), and swaps
+    back. ``kv_mask`` is the local (B, S_local) additive key mask.
+    """
+    n = lax.psum(1, axis_name)
+    b, s_local, h, d = q.shape
+    if attention_impl is not None and scale is not None:
+        raise ValueError(
+            "scale and attention_impl are mutually exclusive: a custom "
+            "attention_impl owns its own logit scaling")
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+
+    def to_heads(x):
+        # (B, S_local, H, D) -> (B, S_global, H/n, D)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qg, kg, vg = to_heads(q), to_heads(k), to_heads(v)
+    s_global = s_local * n
+
+    bias = None
+    if kv_mask is not None:
+        bias = lax.all_gather(kv_mask.astype(jnp.float32), axis_name,
+                              axis=1, tiled=True)      # (B, S_global)
+        bias = bias[:, None, None, :]
+    if causal:
+        pos = jnp.arange(s_global)
+        cmask = jnp.where(pos[:, None] >= pos[None, :], 0.0, NEG_INF)
+        bias = cmask[None, None] if bias is None else bias + cmask[None, None]
+
+    if attention_impl is not None:
+        out = attention_impl(qg, kg, vg, bias=bias)
+    else:
+        scores = jnp.einsum("bqhd,bkhd->bhqk",
+                            qg.astype(jnp.float32) * scale,
+                            kg.astype(jnp.float32))
+        if bias is not None:
+            scores = scores + bias
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                         vg.astype(jnp.float32)).astype(q.dtype)
+    return to_seq(out)
+
+
+def _bias_to_kv_mask(bias):
+    """Collapse a (B, 1|H, 1|Sq, Sk) additive bias that depends only on the
+    key position (BERT padding masks) to (B, Sk)."""
+    if bias is None:
+        return None
+    return bias[:, 0, 0, :].astype(jnp.float32)
+
+
+def make_ring_attention(axis_name: str, *, causal: bool = False) -> Callable:
+    """Adapter with the ``attention_fn(q, k, v, bias, dropout_fn)``
+    signature of :func:`apex_tpu.models.bert.dot_product_attention`: drop
+    it into ``BertEncoder(attention_fn=...)`` inside shard_map and the
+    encoder runs sequence-parallel. ``bias`` must be key-position-only
+    (padding mask for the local KV shard); attention dropout is not
+    supported under sequence parallelism (matches common practice)."""
+
+    def attention_fn(q, k, v, bias=None, dropout_fn=None):
+        if dropout_fn is not None:
+            raise NotImplementedError(
+                "attention-probability dropout is not supported under ring "
+                "attention; set attention_probs_dropout_prob=0")
+        return ring_attention(q, k, v, axis_name=axis_name,
+                              kv_mask=_bias_to_kv_mask(bias), causal=causal)
+
+    return attention_fn
+
+
+def make_ulysses_attention(axis_name: str, *, causal: bool = False) -> Callable:
+    """Like :func:`make_ring_attention` but via all-to-all head resharding."""
+
+    def attention_fn(q, k, v, bias=None, dropout_fn=None):
+        if dropout_fn is not None:
+            raise NotImplementedError(
+                "attention-probability dropout is not supported under "
+                "sequence parallelism; set attention_probs_dropout_prob=0")
+        return ulysses_attention(q, k, v, axis_name=axis_name,
+                                 kv_mask=_bias_to_kv_mask(bias),
+                                 causal=causal)
+
+    return attention_fn

@@ -1,0 +1,50 @@
+"""Precision-policy tables + legacy handle API."""
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.amp import lists
+
+
+def test_policy_classification():
+    assert lists.policy_for("conv2d") == "half"
+    assert lists.policy_for("dot_general") == "half"
+    assert lists.policy_for("softmax") == "fp32"
+    assert lists.policy_for("layer_norm") == "fp32"
+    assert lists.policy_for("add") == "promote"
+    assert lists.policy_for("cat") == "sequence_promote"
+    assert lists.policy_for("binary_cross_entropy") == "banned"
+    assert lists.policy_for("relu") == "passthrough"
+    # namespaced names resolve on the last component
+    assert lists.policy_for("torch.nn.functional.softmax") == "fp32"
+
+
+def test_banned_raises():
+    with pytest.raises(RuntimeError, match="logits"):
+        lists.check_banned("binary_cross_entropy")
+    lists.check_banned("mse_loss")  # fine
+
+
+def test_legacy_handle_roundtrip():
+    with pytest.warns(DeprecationWarning):
+        handle = amp.init(enabled=True)
+    assert handle.is_active
+    optimizer = handle.wrap_optimizer(optax.sgd(0.1))
+    params = {"w": jnp.ones((4,))}
+    state = optimizer.init(params)
+    with handle.scale_loss(jnp.asarray(1.0), state) as scaled:
+        assert float(scaled) == float(state.loss_scalers[0].loss_scale)
+    g = {"w": jnp.ones((4,)) * float(scaled)}  # "scaled" grads
+    params2, state2 = optimizer.step(params, g, state)
+    # unscaled grad of 1.0 with lr 0.1 -> 0.9
+    assert jnp.allclose(params2["w"], 0.9)
+
+
+def test_noop_handle():
+    handle = amp.init(enabled=False)
+    assert not handle.is_active
+    with handle.scale_loss(jnp.asarray(2.5), None) as s:
+        assert float(s) == 2.5

@@ -1,0 +1,116 @@
+"""L1 harness: short deterministic training runs that record loss
+trajectories for cross-install comparison.
+
+Port of the reference's L1 design (``tests/L1/common/main_amp.py:386-396``
+records ``{Iteration, Loss, Speed}``; ``compare.py:35-46`` asserts the
+Python-only install and the CUDA-extension install produce bitwise-equal
+losses). The TPU analog of "with/without extensions" is the fused-kernel
+path (Pallas, interpret-mode on CPU) vs the pure-jnp fallback —
+``use_pallas`` below — exercised end-to-end through amp + FusedAdam +
+FusedLayerNorm + BatchNorm on a small conv net.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import flax.linen as nn
+
+from apex_tpu import amp
+from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.optimizers import FusedAdam
+
+
+class ConvBNNet(nn.Module):
+    """Tiny conv net with BatchNorm + FusedLayerNorm: touches every amp
+    policy surface (conv/matmul fp16 list, BN keep-fp32, fused LN)."""
+
+    use_pallas: Optional[bool] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(16, (3, 3), use_bias=False)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        x = nn.relu(x)
+        x = nn.Conv(16, (3, 3), (2, 2), use_bias=False)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(32)(x)
+        x = FusedLayerNorm(32, use_pallas=self.use_pallas)(x)
+        x = nn.relu(x)
+        return nn.Dense(10)(x)
+
+
+_MAX_STEPS = 32
+
+
+def make_data(steps: int, batch: int = 16, seed: int = 0):
+    """Learnable data (class-dependent means) so loss trajectories are
+    decreasing; drawn at fixed size then sliced, so runs with different
+    ``steps`` see the same leading batches."""
+    assert steps <= _MAX_STEPS
+    rng = np.random.RandomState(seed)
+    ys = rng.randint(0, 10, (_MAX_STEPS, batch)).astype(np.int32)
+    centers = rng.randn(10, 8, 8, 3).astype(np.float32) * 2.0
+    xs = centers[ys] + rng.randn(
+        _MAX_STEPS, batch, 8, 8, 3).astype(np.float32)
+    return jnp.asarray(xs[:steps]), jnp.asarray(ys[:steps])
+
+
+def run_training(opt_level: str = "O1", loss_scale=None,
+                 keep_batchnorm_fp32=None, use_pallas: Optional[bool] = False,
+                 steps: int = 8, lr: float = 1e-2, seed: int = 0,
+                 inject_inf_step: Optional[int] = None):
+    """Train ConvBNNet for ``steps`` and return the run record.
+
+    ``inject_inf_step``: poison that step's input with an inf (the
+    reference's fault-injection pattern,
+    ``test_multiple_models_optimizers_losses.py:73-88``).
+    """
+    model, optimizer = amp.initialize(
+        ConvBNNet(use_pallas=use_pallas),
+        FusedAdam(lr=lr, use_pallas=use_pallas),
+        opt_level=opt_level, loss_scale=loss_scale,
+        keep_batchnorm_fp32=keep_batchnorm_fp32, verbosity=0)
+
+    xs, ys = make_data(steps, seed=seed)
+    variables = model.init(jax.random.PRNGKey(seed), xs[0], train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    opt_state = optimizer.init(params)
+
+    @jax.jit
+    def train_step(params, batch_stats, opt_state, x, y):
+        def loss_fn(p):
+            logits, mut = model.apply(
+                {"params": p, "batch_stats": batch_stats}, x, train=True,
+                mutable=["batch_stats"])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), y).mean()
+            with amp.scale_loss(loss, opt_state) as scaled:
+                return scaled, (loss, mut["batch_stats"])
+        grads, (loss, new_stats) = jax.grad(loss_fn, has_aux=True)(params)
+        params, opt_state = optimizer.step(params, grads, opt_state)
+        return params, new_stats, opt_state, loss
+
+    losses, scales = [], []
+    for i in range(steps):
+        x = xs[i]
+        if inject_inf_step is not None and i == inject_inf_step:
+            x = x.at[0, 0, 0, 0].set(jnp.inf)
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, x, ys[i])
+        losses.append(float(loss))
+        scales.append(float(optimizer.loss_scale(opt_state)))
+
+    return {
+        "losses": np.asarray(losses),
+        "loss_scales": np.asarray(scales),
+        "applied_steps": int(opt_state.applied_steps),
+        "skipped_steps": int(opt_state.skipped_steps),
+        "params": jax.device_get(params),
+    }
