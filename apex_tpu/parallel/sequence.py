@@ -81,31 +81,41 @@ def ring_attention(q, k, v, *, axis_name: str,
     q32 = q.astype(jnp.float32) * scale
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    if kv_mask is None:
-        kv_mask = jnp.zeros((b, s_local), jnp.float32)
-    kv_mask = kv_mask.astype(jnp.float32)
+    has_mask = kv_mask is not None  # static: shapes the carry + hot loop
+    if has_mask:
+        kv_mask = kv_mask.astype(jnp.float32)
+
+    # Under check_vma, the scan carry must enter with the same varying-axes
+    # type its outputs will have: the accumulators inherit the union of the
+    # inputs' varying axes (e.g. `data` AND the ring axis on a hybrid
+    # DP x SP mesh), plus the ring axis itself from ppermute.
+    try:
+        _target_vma = set(jax.typeof(q).vma) | set(jax.typeof(k).vma) \
+            | set(jax.typeof(v).vma) | {axis_name}
+        if has_mask:
+            _target_vma |= set(jax.typeof(kv_mask).vma)
+    except AttributeError:
+        _target_vma = None
 
     def _vary(x):
-        # the scan carry must be varying-typed on the mesh axis (ppermute
-        # outputs are); under check_vma, unvaried literals in the init
-        # carry would make carry-in/carry-out types disagree. No-op for
-        # inputs that are already varying (e.g. sharded-in masks).
-        try:
-            if axis_name in jax.typeof(x).vma:
-                return x
-            return lax.pvary(x, axis_name)
-        except AttributeError:
+        if _target_vma is None:
             return x
+        missing = tuple(sorted(_target_vma - set(jax.typeof(x).vma)))
+        return lax.pvary(x, missing) if missing else x
 
     q_pos = my_idx * s_local + jnp.arange(s_local)    # global q positions
 
     def body(carry, step):
-        k_blk, v_blk, mask_blk, m, den, acc = carry
+        if has_mask:
+            k_blk, v_blk, mask_blk, m, den, acc = carry
+        else:
+            k_blk, v_blk, m, den, acc = carry
         # the block we hold at `step` originated at rank (my_idx - step)
         src = (my_idx - step) % n
         scores = jnp.einsum("bqhd,bkhd->bhqk", q32,
                             k_blk.astype(jnp.float32))
-        scores = scores + mask_blk[:, None, None, :]
+        if has_mask:
+            scores = scores + mask_blk[:, None, None, :]
         if causal:
             k_pos = src * s_local + jnp.arange(s_local)
             allowed = q_pos[:, None] >= k_pos[None, :]   # (Sq, Sk)
@@ -113,14 +123,18 @@ def ring_attention(q, k, v, *, axis_name: str,
         m, den, acc = _online_block_update(m, den, acc, scores, v_blk)
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
-        mask_blk = lax.ppermute(mask_blk, axis_name, perm)
-        return (k_blk, v_blk, mask_blk, m, den, acc), None
+        if has_mask:
+            mask_blk = lax.ppermute(mask_blk, axis_name, perm)
+            return (k_blk, v_blk, mask_blk, m, den, acc), None
+        return (k_blk, v_blk, m, den, acc), None
 
     m0 = _vary(jnp.full((b, h, s_local), NEG_INF, jnp.float32))
     den0 = _vary(jnp.zeros((b, h, s_local), jnp.float32))
     acc0 = _vary(jnp.zeros((b, s_local, h, d), jnp.float32))
-    (_, _, _, m, den, acc), _ = lax.scan(
-        body, (k, v, _vary(kv_mask), m0, den0, acc0), jnp.arange(n))
+    init = ((k, v, _vary(kv_mask), m0, den0, acc0) if has_mask
+            else (k, v, m0, den0, acc0))
+    carry_out, _ = lax.scan(body, init, jnp.arange(n))
+    m, den, acc = carry_out[-3:]
 
     # a row whose every key is masked (or causally excluded) never saw a
     # score above ~NEG_INF: its running max stays < NEG_INF/2. Emit zeros
@@ -190,10 +204,18 @@ def ulysses_attention(q, k, v, *, axis_name: str,
 
 
 def _bias_to_kv_mask(bias):
-    """Collapse a (B, 1|H, 1|Sq, Sk) additive bias that depends only on the
-    key position (BERT padding masks) to (B, Sk)."""
+    """Collapse a (B, 1, 1, Sk) additive key-position bias (BERT padding
+    masks) to (B, Sk). Rejects query- or head-dependent biases — silently
+    keeping only head 0 / query row 0 would corrupt the attention."""
     if bias is None:
         return None
+    if bias.ndim != 4 or bias.shape[1] != 1 or bias.shape[2] != 1:
+        raise ValueError(
+            "sequence-parallel adapters support key-position-only biases "
+            f"of shape (B, 1, 1, Sk); got {bias.shape}. Query-/head-"
+            "dependent biases (relative position, custom causal) need the "
+            "explicit ring_attention/ulysses_attention API (use `causal=` "
+            "for causal masking).")
     return bias[:, 0, 0, :].astype(jnp.float32)
 
 

@@ -156,7 +156,8 @@ def main():
     mean = jnp.asarray(MEAN)
     std = jnp.asarray(STD)
 
-    @jax.jit
+    import functools
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
     def train_step(params, batch_stats, opt_state, x, y):
         x = (x.astype(jnp.float32) - mean) / std
 

@@ -145,6 +145,29 @@ def test_ring_attention_fully_masked_rows_emit_zeros(mesh):
                                rtol=2e-5, atol=2e-5)
 
 
+def test_ring_attention_hybrid_dp_sp_mesh():
+    """Ring attention on a 2D (data=4, sp=2) mesh: batch sharded on data,
+    sequence on sp — the carry must adopt the union vma (regression for
+    the hybrid DP x SP path the BERT example uses)."""
+    mesh2 = Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("data", "sp"))
+    bsz = 4  # must divide the data axis
+    ks = jax.random.split(jax.random.PRNGKey(9), 3)
+    q, k, v = (jax.random.normal(kk, (bsz, S, H, D)) for kk in ks)
+    kv_mask = jnp.where(jnp.arange(S)[None, :] < S - 12, 0.0, -1e30)
+    kv_mask = jnp.broadcast_to(kv_mask, (bsz, S)) * jnp.ones((bsz, 1))
+    f = jax.jit(shard_map(
+        lambda q, k, v, m: ring_attention(q, k, v, axis_name="sp",
+                                          kv_mask=m),
+        mesh=mesh2,
+        in_specs=(P("data", "sp"), P("data", "sp"), P("data", "sp"),
+                  P("data", "sp")),
+        out_specs=P("data", "sp")))
+    got = f(q, k, v, kv_mask)
+    want = reference_attention(q, k, v, kv_mask=kv_mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_bert_encoder_with_ring_attention(mesh):
     """End-to-end: BertEncoder with a ring-attention ``attention_fn`` (the
     adapter internally shard_maps q/k/v and the key-mask bias over the
