@@ -16,9 +16,12 @@ from apex_tpu.ops.multi_tensor import (
     tree_any_nonfinite,
 )
 from apex_tpu.ops.flatten import flatten, unflatten, flatten_like
+from apex_tpu.ops.flash_attention import flash_attention, make_flash_attention
 from apex_tpu.ops import native
 
 __all__ = [
+    "flash_attention",
+    "make_flash_attention",
     "native",
     "multi_tensor_scale",
     "multi_tensor_axpby",

@@ -1,0 +1,419 @@
+"""Flash attention — blockwise fused attention Pallas kernels for TPU.
+
+The reference library predates flash attention entirely; this is part of
+apex_tpu's first-class long-context support (SURVEY.md §5 notes the gap):
+:func:`apex_tpu.parallel.ring_attention` scales sequence length across
+chips, and this kernel makes each chip's local attention O(S) in memory —
+scores are produced block-by-block in VMEM and never materialized in HBM.
+
+Algorithm (Dao et al. flash attention 2, re-derived for the TPU grid):
+
+forward, grid (B*H, Sq/bq, Sk/bk), k innermost so VMEM scratch carries
+across k steps::
+
+    s    = (q_blk @ k_blk^T) * scale + mask        # (bq, bk) fp32 on MXU
+    m'   = max(m, rowmax(s));  corr = exp(m - m')
+    p    = exp(s - m')
+    l    = l * corr + rowsum(p)
+    acc  = acc * corr + p @ v_blk
+    out  = acc / l          (written at the last k step)
+    lse  = m + log(l)       (saved for backward)
+
+backward (custom VJP), two kernels over the same block structure::
+
+    p   = exp(s - lse)                  # recomputed, never stored
+    dv += p^T @ do
+    ds  = p * (do @ v^T - delta),  delta = rowsum(do * out)
+    dq += ds @ k * scale    (grid q-major)
+    dk += ds^T @ q * scale  (grid k-major)
+
+Key-position masks (additive, (B, Sk)) and causal masking are supported;
+fully-masked query rows emit zeros. A pure-jnp path (``use_pallas=False``)
+is the parity oracle and CPU fallback; on CPU the kernels run in
+interpret mode inside the tests.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.ops.pallas_utils import on_tpu
+
+NEG_INF = -1e30
+
+
+def _cdiv(a, b):
+    return (a + b - 1) // b
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, causal, bq, bk, nk):
+    ik = pl.program_id(2)
+    iq = pl.program_id(1)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0]                                   # (bq, D)
+    k = k_ref[0]                                   # (bk, D)
+    v = v_ref[0]                                   # (bk, D)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale          # (bq, bk)
+    s = s + mask_ref[0][None, :]
+    if causal:
+        rows = lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
+        cols = lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ik * bk
+        s = jnp.where(rows >= cols, s, NEG_INF)
+
+    m_prev = m_ref[:, 0]                           # (bq,)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+    corr = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[:, None])
+    l_new = l_ref[:, 0] * corr + jnp.sum(p, axis=1)
+    acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
+        p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    @pl.when(ik == nk - 1)
+    def _writeout():
+        m_fin = m_ref[:, 0]
+        l_fin = l_ref[:, 0]
+        valid = m_fin > NEG_INF / 2
+        out = acc_ref[:] / jnp.maximum(l_fin, 1e-30)[:, None]
+        o_ref[0] = jnp.where(valid[:, None], out, 0.0).astype(o_ref.dtype)
+        lse_ref[0] = jnp.where(
+            valid, m_fin + jnp.log(jnp.maximum(l_fin, 1e-30)), NEG_INF)
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+def _recompute_p(q, k, mask_row, lse_col, scale, causal, iq, ik, bq, bk):
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    s = s + mask_row[None, :]
+    if causal:
+        rows = lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
+        cols = lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ik * bk
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    # fully-masked rows need an explicit zero: their saved lse is NEG_INF
+    # and s rounds to exactly NEG_INF in fp32 (the mask offset absorbs any
+    # finite score), so exp(s - lse) would be exp(0) == 1, not 0
+    valid = (lse_col > NEG_INF / 2)[:, None]
+    return jnp.where(valid, jnp.exp(s - lse_col[:, None]), 0.0)
+
+
+def _bwd_dq_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, dq_acc, *, scale, causal, bq, bk, nk):
+    ik = pl.program_id(2)
+    iq = pl.program_id(1)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    p = _recompute_p(q_ref[0], k_ref[0], mask_ref[0], lse_ref[0],
+                     scale, causal, iq, ik, bq, bk)
+    dov = jax.lax.dot_general(
+        do_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    ds = p * (dov - delta_ref[0][:, None])
+    dq_acc[:] += jax.lax.dot_general(
+        ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+
+    @pl.when(ik == nk - 1)
+    def _writeout():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale, causal, bq, bk, nq):
+    iq = pl.program_id(2)
+    ik = pl.program_id(1)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    p = _recompute_p(q_ref[0], k_ref[0], mask_ref[0], lse_ref[0],
+                     scale, causal, iq, ik, bq, bk)    # (bq, bk)
+    do32 = do_ref[0].astype(jnp.float32)
+    dv_acc[:] += jax.lax.dot_general(
+        p, do32, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)            # (bk, D)
+    dov = jax.lax.dot_general(
+        do32, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dov - delta_ref[0][:, None])             # (bq, bk)
+    dk_acc[:] += jax.lax.dot_general(
+        ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+
+    @pl.when(iq == nq - 1)
+    def _writeout():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# host-side drivers
+# ---------------------------------------------------------------------------
+
+try:  # pallas is optional at import time (pure-jnp path works without it)
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+
+def _layout(x):
+    """(B, S, H, D) -> (B*H, S, D)."""
+    b, s, h, d = x.shape
+    return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
+
+
+def _unlayout(x, b, h):
+    bh, s, d = x.shape
+    return jnp.transpose(x.reshape(b, h, s, d), (0, 2, 1, 3))
+
+
+def _pad_seq(x, block):
+    s = x.shape[1]
+    pad = _cdiv(s, block) * block - s
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+    return x
+
+
+def _specs(bq, bk, d, h):
+    """Common BlockSpecs for (BH, S, D)-laid-out operands; per-row scalars
+    (lse, delta) travel as 2-D (BH, S) so HBM holds one float per row."""
+    q_spec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
+    k_spec = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0))
+    mask_spec = pl.BlockSpec((1, bk), lambda b, i, j: (b // h, j))
+    row_spec = pl.BlockSpec((1, bq), lambda b, i, j: (b, i))
+    return q_spec, k_spec, mask_spec, row_spec
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "causal", "bq", "bk",
+                                             "h", "interpret"))
+def _fwd_pallas(q3, k3, v3, mask, *, scale, causal, bq, bk, h, interpret):
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    nq, nk = sq // bq, sk // bk
+    lanes = 128
+    q_spec, k_spec, mask_spec, row_spec = _specs(bq, bk, d, h)
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nk=nk),
+        grid=(bh, nq, nk),
+        in_specs=[mask_spec, q_spec, k_spec, k_spec],
+        out_specs=[q_spec, row_spec],
+        out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+                   jax.ShapeDtypeStruct((bh, sq), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32),
+                        pltpu.VMEM((bq, lanes), jnp.float32),
+                        pltpu.VMEM((bq, lanes), jnp.float32)],
+        interpret=interpret,
+    )(mask, q3, k3, v3)
+    return o, lse                                    # (BH, Sq)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "causal", "bq", "bk",
+                                             "h", "interpret"))
+def _bwd_pallas(q3, k3, v3, do3, o3, lse, mask, *, scale, causal, bq, bk,
+                h, interpret):
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    nq, nk = sq // bq, sk // bk
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1)                         # (BH, Sq)
+    q_spec, k_spec, mask_spec, row_spec = _specs(bq, bk, d, h)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nk=nk),
+        grid=(bh, nq, nk),
+        in_specs=[mask_spec, q_spec, k_spec, k_spec, q_spec, row_spec,
+                  row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(mask, q3, k3, v3, do3, lse, delta)
+
+    dkv_kspec = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0))
+    dkv_qspec = pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0))
+    dkv_mask = pl.BlockSpec((1, bk), lambda b, j, i: (b // h, j))
+    dkv_row = pl.BlockSpec((1, bq), lambda b, j, i: (b, i))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nq=nq),
+        grid=(bh, nk, nq),
+        in_specs=[dkv_mask, dkv_qspec, dkv_kspec, dkv_kspec, dkv_qspec,
+                  dkv_row, dkv_row],
+        out_specs=[dkv_kspec, dkv_kspec],
+        out_shape=[jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
+                   jax.ShapeDtypeStruct((bh, sk, d), v3.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )(mask, q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+def _reference(q, k, v, kv_mask, causal, scale):
+    """Pure-jnp oracle (fp32 softmax), shapes (B, S, H, D)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if kv_mask is not None:
+        s = s + kv_mask[:, None, None, :].astype(jnp.float32)
+    if causal:
+        pos_q = jnp.arange(q.shape[1])
+        pos_k = jnp.arange(k.shape[1])
+        s = jnp.where((pos_q[:, None] >= pos_k[None, :])[None, None],
+                      s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    valid = m > NEG_INF / 2
+    p = jnp.exp(s - m)
+    den = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p / jnp.maximum(den, 1e-30),
+                     v.astype(jnp.float32))
+    out = out * jnp.transpose(valid, (0, 2, 1, 3)).astype(out.dtype)
+    return out.astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, mask, causal, scale, bq, bk, interpret):
+    """``mask`` is always a concrete (B, Sk) fp32 array here (zeros when
+    the caller had none) so the VJP can return a well-typed cotangent."""
+    out, _ = _flash_fwd(q, k, v, mask, causal, scale, bq, bk, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, mask, causal, scale, bq, bk, interpret):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    q3 = _pad_seq(_layout(q), bq)
+    k3 = _pad_seq(_layout(k), bk)
+    v3 = _pad_seq(_layout(v), bk)
+    sk_pad = k3.shape[1]
+    mask_p = mask
+    if sk_pad != sk:  # padded keys must never win the softmax
+        mask_p = jnp.pad(mask, ((0, 0), (0, sk_pad - sk)),
+                         constant_values=NEG_INF)
+    o3, lse = _fwd_pallas(q3, k3, v3, mask_p, scale=scale, causal=causal,
+                          bq=bq, bk=bk, h=h, interpret=interpret)
+    out = _unlayout(o3[:, :sq], b, h)
+    return out, (q3, k3, v3, o3, lse, mask_p, b, h, sq, sk)
+
+
+def _flash_bwd(causal, scale, bq, bk, interpret, res, g):
+    q3, k3, v3, o3, lse, mask_p, b, h, sq, sk = res
+    do3 = _pad_seq(_layout(g), bq)
+    dq3, dk3, dv3 = _bwd_pallas(q3, k3, v3, do3, o3, lse, mask_p,
+                                scale=scale, causal=causal, bq=bq, bk=bk,
+                                h=h, interpret=interpret)
+    dq = _unlayout(dq3[:, :sq], b, h)
+    dk = _unlayout(dk3[:, :sk], b, h)
+    dv = _unlayout(dv3[:, :sk], b, h)
+    dmask = jnp.zeros((b, sk), jnp.float32)  # masks are not trained
+    return dq, dk, dv, dmask
+
+
+_flash.defvjp(lambda q, k, v, m, causal, scale, bq, bk, interp:
+              _flash_fwd(q, k, v, m, causal, scale, bq, bk, interp),
+              _flash_bwd)
+
+
+def flash_attention(q, k, v, *, kv_mask: Optional[jax.Array] = None,
+                    causal: bool = False, scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    use_pallas: Optional[bool] = None,
+                    interpret: Optional[bool] = None):
+    """Memory-efficient exact attention.
+
+    Args:
+      q, k, v: (B, S, H, D); q and k/v sequence lengths may differ.
+      kv_mask: optional (B, Sk) additive key mask (0 keep / NEG_INF drop).
+      causal: causal masking on global positions.
+      scale: logit scale, default 1/sqrt(D).
+      block_q, block_k: VMEM tile sizes (multiples of 128 recommended).
+      use_pallas: None = auto (Pallas kernels on TPU, jnp oracle off-TPU).
+      interpret: force Pallas interpret mode (defaults to not-on-TPU).
+
+    Differentiable (custom VJP with recompute — no (Sq, Sk) tensor ever
+    hits HBM in either pass).
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    use = on_tpu() if use_pallas is None else use_pallas
+    if not use or not _HAS_PALLAS:
+        return _reference(q, k, v, kv_mask, causal, scale)
+    if interpret is None:
+        interpret = not on_tpu()
+    mask = (jnp.zeros((q.shape[0], k.shape[1]), jnp.float32)
+            if kv_mask is None else kv_mask.astype(jnp.float32))
+    return _flash(q, k, v, mask, causal, float(scale), int(block_q),
+                  int(block_k), bool(interpret))
+
+
+def bias_to_kv_mask(bias):
+    """Collapse a (B, 1, 1, Sk) additive key-position bias (BERT padding
+    masks) to (B, Sk). Rejects query- or head-dependent biases — silently
+    keeping only head 0 / query row 0 would corrupt the attention.
+
+    Shared contract of every fused-attention adapter (flash, ring,
+    Ulysses)."""
+    if bias is None:
+        return None
+    if bias.ndim != 4 or bias.shape[1] != 1 or bias.shape[2] != 1:
+        raise ValueError(
+            "fused-attention adapters support key-position-only biases "
+            f"of shape (B, 1, 1, Sk); got {bias.shape}. Query-/head-"
+            "dependent biases (relative position, custom causal) need the "
+            "explicit attention API (use `causal=` for causal masking).")
+    return bias[:, 0, 0, :].astype(jnp.float32)
+
+
+def make_flash_attention(*, causal: bool = False, **kwargs):
+    """Adapter with the ``attention_fn(q, k, v, bias, dropout_fn)``
+    signature of ``models.bert.dot_product_attention``; bias must be a
+    key-position-only (B, 1, 1, Sk) additive mask."""
+
+    def attention_fn(q, k, v, bias=None, dropout_fn=None):
+        if dropout_fn is not None:
+            raise NotImplementedError(
+                "attention-probability dropout is not supported by the "
+                "fused kernel; set attention_probs_dropout_prob=0")
+        return flash_attention(q, k, v, kv_mask=bias_to_kv_mask(bias),
+                               causal=causal, **kwargs)
+
+    return attention_fn

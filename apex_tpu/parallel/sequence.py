@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.ops.flash_attention import bias_to_kv_mask as _bias_to_kv_mask
+
 NEG_INF = -1e30  # large-negative fp32 (not -inf: keeps exp/where NaN-free)
 
 
@@ -201,22 +203,6 @@ def ulysses_attention(q, k, v, *, axis_name: str,
         out = jnp.einsum("bhqk,bkhd->bqhd", probs,
                          vg.astype(jnp.float32)).astype(q.dtype)
     return to_seq(out)
-
-
-def _bias_to_kv_mask(bias):
-    """Collapse a (B, 1, 1, Sk) additive key-position bias (BERT padding
-    masks) to (B, Sk). Rejects query- or head-dependent biases — silently
-    keeping only head 0 / query row 0 would corrupt the attention."""
-    if bias is None:
-        return None
-    if bias.ndim != 4 or bias.shape[1] != 1 or bias.shape[2] != 1:
-        raise ValueError(
-            "sequence-parallel adapters support key-position-only biases "
-            f"of shape (B, 1, 1, Sk); got {bias.shape}. Query-/head-"
-            "dependent biases (relative position, custom causal) need the "
-            "explicit ring_attention/ulysses_attention API (use `causal=` "
-            "for causal masking).")
-    return bias[:, 0, 0, :].astype(jnp.float32)
 
 
 def make_ring_attention(axis_name: str, *, causal: bool = False) -> Callable:

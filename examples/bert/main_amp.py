@@ -160,7 +160,9 @@ def main():
         params, opt_state, loss = train_step(params, opt_state, *batch)
         if i % args.print_freq == 0:
             losses.update(float(loss))
-            batch_time.update(time.time() - end)
+            # the interval spans print_freq steps (1 for the compile step)
+            batch_time.update((time.time() - end) / (args.print_freq
+                                                     if i else 1))
             seq_per_s = args.b / batch_time.val if batch_time.val else 0.0
             maybe_print(
                 f"step {i}/{args.steps}  Loss {losses.val:.4f} "

@@ -1,0 +1,158 @@
+"""Flash attention Pallas kernels vs the jnp oracle (interpret mode).
+
+Follows the reference's kernel-test pattern (fuzz over odd sizes and
+option cross products vs a pure reference, e.g.
+``tests/L0/run_amp/test_multi_tensor_scale.py``).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops.flash_attention import (
+    _reference,
+    flash_attention,
+    make_flash_attention,
+)
+
+BQ = BK = 32  # small blocks so tiny shapes exercise multi-block grids
+
+
+def _qkv(b, s, h, d, seed=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, (b, s, h, d), dtype) for k in ks)
+
+
+def _flash(q, k, v, **kw):
+    return flash_attention(q, k, v, use_pallas=True, interpret=True,
+                           block_q=BQ, block_k=BK, **kw)
+
+
+@pytest.mark.parametrize("s", [32, 64, 100, 33])  # exact, multiple, ragged
+@pytest.mark.parametrize("causal", [False, True])
+def test_matches_reference(s, causal):
+    q, k, v = _qkv(2, s, 2, 16, seed=s)
+    got = _flash(q, k, v, causal=causal)
+    want = _reference(q, k, v, None, causal, 1.0 / math.sqrt(16))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_key_mask_and_fully_masked_rows():
+    s = 64
+    q, k, v = _qkv(2, s, 2, 16, seed=1)
+    kv_mask = jnp.broadcast_to(
+        jnp.where(jnp.arange(s)[None] < s - 9, 0.0, -1e30), (2, s))
+    kv_mask = kv_mask.at[1].set(-1e30)  # batch row 1 fully masked
+    got = np.asarray(_flash(q, k, v, kv_mask=kv_mask))
+    want = np.asarray(_reference(q, k, v, kv_mask, False,
+                                 1.0 / math.sqrt(16)))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+    assert np.all(got[1] == 0.0)
+    # masked keys must not influence the output
+    got2 = np.asarray(_flash(q, k, v.at[:, s - 4:].set(77.0),
+                             kv_mask=kv_mask))
+    np.testing.assert_allclose(got, got2, rtol=1e-6, atol=1e-6)
+
+
+def test_cross_attention_lengths():
+    q, _, _ = _qkv(2, 48, 2, 16, seed=2)
+    _, k, v = _qkv(2, 80, 2, 16, seed=3)
+    got = _flash(q, k, v)
+    want = _reference(q, k, v, None, False, 1.0 / math.sqrt(16))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_gradients_fully_masked_rows_are_zero():
+    """Backward for an all-masked batch row must be exactly zero — the
+    recompute path p = exp(s - lse) evaluates to 1 there without an
+    explicit guard (review regression)."""
+    s = 64
+    q, k, v = _qkv(2, s, 2, 16, seed=11)
+    kv_mask = jnp.zeros((2, s)).at[1].set(-1e30)
+
+    def lf(q, k, v):
+        return jnp.sum(_flash(q, k, v, kv_mask=kv_mask)
+                       .astype(jnp.float32) ** 2)
+
+    dq, dk, dv = jax.grad(lf, (0, 1, 2))(q, k, v)
+    assert np.all(np.asarray(dq)[1] == 0.0)
+    assert np.all(np.asarray(dk)[1] == 0.0)
+    assert np.all(np.asarray(dv)[1] == 0.0)
+    # the live row still gets correct gradients
+    def lr(q, k, v):
+        return jnp.sum(_reference(q, k, v, kv_mask, False,
+                                  1.0 / math.sqrt(16))
+                       .astype(jnp.float32) ** 2)
+    gr = jax.grad(lr, (0, 1, 2))(q, k, v)
+    for a, b in zip((dq, dk, dv), gr):
+        np.testing.assert_allclose(np.asarray(a)[0], np.asarray(b)[0],
+                                   rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_match_reference(causal):
+    s = 64
+    q, k, v = _qkv(2, s, 2, 16, seed=4)
+    kv_mask = jnp.broadcast_to(
+        jnp.where(jnp.arange(s)[None] < s - 7, 0.0, -1e30), (2, s))
+
+    def lf(q, k, v):
+        return jnp.sum(_flash(q, k, v, kv_mask=kv_mask, causal=causal)
+                       .astype(jnp.float32) ** 2)
+
+    def lr(q, k, v):
+        return jnp.sum(_reference(q, k, v, kv_mask, causal,
+                                  1.0 / math.sqrt(16))
+                       .astype(jnp.float32) ** 2)
+
+    gf = jax.grad(lf, (0, 1, 2))(q, k, v)
+    gr = jax.grad(lr, (0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_bf16_io_fp32_math():
+    q, k, v = _qkv(1, 64, 2, 16, seed=5, dtype=jnp.bfloat16)
+    got = _flash(q, k, v)
+    assert got.dtype == jnp.bfloat16
+    want = _reference(q.astype(jnp.float32), k.astype(jnp.float32),
+                      v.astype(jnp.float32), None, False,
+                      1.0 / math.sqrt(16))
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want), rtol=2e-2, atol=2e-2)
+
+
+def test_adapter_in_bert():
+    from apex_tpu import models
+
+    cfg = models.BertConfig(vocab_size=64, hidden_size=32,
+                            num_hidden_layers=1, num_attention_heads=2,
+                            intermediate_size=64,
+                            max_position_embeddings=64,
+                            hidden_dropout_prob=0.0,
+                            attention_probs_dropout_prob=0.0)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (2, 64), 0, 64)
+    mask = jnp.ones((2, 64), jnp.int32).at[:, 50:].set(0)
+    plain = models.BertEncoder(cfg)
+    flash = models.BertEncoder(cfg, attention_fn=make_flash_attention(
+        use_pallas=True, interpret=True, block_q=BQ, block_k=BK))
+    variables = plain.init(jax.random.PRNGKey(1), ids, mask)
+    want = plain.apply(variables, ids, mask)
+    got = flash.apply(variables, ids, mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_adapter_rejects_bad_bias_and_dropout():
+    fn = make_flash_attention()
+    q = jnp.ones((1, 32, 2, 16))
+    with pytest.raises(ValueError, match="key-position-only"):
+        fn(q, q, q, bias=jnp.zeros((1, 2, 32, 32)))
+    with pytest.raises(NotImplementedError):
+        fn(q, q, q, dropout_fn=lambda p: p)
