@@ -67,28 +67,36 @@ def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    q = q_ref[0]                                   # (bq, D)
-    k = k_ref[0]                                   # (bk, D)
-    v = v_ref[0]                                   # (bk, D)
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale          # (bq, bk)
-    s = s + mask_ref[0][None, :]
-    if causal:
-        rows = lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
-        cols = lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ik * bk
-        s = jnp.where(rows >= cols, s, NEG_INF)
+    def _compute():
+        q = q_ref[0]                               # (bq, D)
+        k = k_ref[0]                               # (bk, D)
+        v = v_ref[0]                               # (bk, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale      # (bq, bk)
+        s = s + mask_ref[0][None, :]
+        if causal:
+            rows = lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
+            cols = lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ik * bk
+            s = jnp.where(rows >= cols, s, NEG_INF)
 
-    m_prev = m_ref[:, 0]                           # (bq,)
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
-    corr = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new[:, None])
-    l_new = l_ref[:, 0] * corr + jnp.sum(p, axis=1)
-    acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
-        p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
-    l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+        m_prev = m_ref[:, 0]                       # (bq,)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l_ref[:, 0] * corr + jnp.sum(p, axis=1)
+        acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
+            p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    if causal:
+        # skip fully-future k blocks (~2x FLOPs saved); init/writeout
+        # above/below stay unconditional
+        pl.when(iq * bq + bq - 1 >= ik * bk)(_compute)
+    else:
+        _compute()
 
     @pl.when(ik == nk - 1)
     def _writeout():
@@ -130,15 +138,21 @@ def _bwd_dq_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    p = _recompute_p(q_ref[0], k_ref[0], mask_ref[0], lse_ref[0],
-                     scale, causal, iq, ik, bq, bk)
-    dov = jax.lax.dot_general(
-        do_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
-        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-    ds = p * (dov - delta_ref[0][:, None])
-    dq_acc[:] += jax.lax.dot_general(
-        ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale
+    def _compute():
+        p = _recompute_p(q_ref[0], k_ref[0], mask_ref[0], lse_ref[0],
+                         scale, causal, iq, ik, bq, bk)
+        dov = jax.lax.dot_general(
+            do_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
+            (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dov - delta_ref[0][:, None])
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        pl.when(iq * bq + bq - 1 >= ik * bk)(_compute)
+    else:
+        _compute()
 
     @pl.when(ik == nk - 1)
     def _writeout():
@@ -156,19 +170,25 @@ def _bwd_dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    p = _recompute_p(q_ref[0], k_ref[0], mask_ref[0], lse_ref[0],
-                     scale, causal, iq, ik, bq, bk)    # (bq, bk)
-    do32 = do_ref[0].astype(jnp.float32)
-    dv_acc[:] += jax.lax.dot_general(
-        p, do32, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)            # (bk, D)
-    dov = jax.lax.dot_general(
-        do32, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    ds = p * (dov - delta_ref[0][:, None])             # (bq, bk)
-    dk_acc[:] += jax.lax.dot_general(
-        ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale
+    def _compute():
+        p = _recompute_p(q_ref[0], k_ref[0], mask_ref[0], lse_ref[0],
+                         scale, causal, iq, ik, bq, bk)  # (bq, bk)
+        do32 = do_ref[0].astype(jnp.float32)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do32, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # (bk, D)
+        dov = jax.lax.dot_general(
+            do32, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dov - delta_ref[0][:, None])           # (bq, bk)
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        pl.when(iq * bq + bq - 1 >= ik * bk)(_compute)
+    else:
+        _compute()
 
     @pl.when(iq == nq - 1)
     def _writeout():

@@ -116,6 +116,9 @@ def _adam_flat_pallas(p, m, v, g, scalars, *, eps_inside_sqrt: bool,
         ],
         out_specs=[tile_spec, tile_spec, tile_spec],
         out_shape=[out_shape, out_shape, out_shape],
+        # update p/m/v in place (reference kernel mutates in place too,
+        # fused_adam_cuda_kernel.cu): halves the HBM footprint of the step
+        input_output_aliases={1: 0, 2: 1, 3: 2},
         interpret=interpret,
     )(scalars, pt, mt, vt, gt)
     return untile(p2, n), untile(m2, n), untile(v2, n)
