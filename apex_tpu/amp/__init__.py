@@ -35,6 +35,9 @@ from apex_tpu.amp.functional import (
     float_function,
     promote_function,
     master_params,
+    register_float_function,
+    register_half_function,
+    register_promote_function,
 )
 from apex_tpu.amp._amp_state import _amp_state, maybe_print
 from apex_tpu.amp import lists
@@ -63,6 +66,9 @@ __all__ = [
     "maybe_print",
     "opt_levels",
     "promote_function",
+    "register_float_function",
+    "register_half_function",
+    "register_promote_function",
     "scale",
     "scale_loss",
 ]

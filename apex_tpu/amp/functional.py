@@ -88,6 +88,31 @@ def promote_function(fn):
     return wrapper
 
 
+def _register(module, fn_name: str, wrapper):
+    fn = getattr(module, fn_name)
+    setattr(module, fn_name, wrapper(fn))
+
+
+def register_half_function(module, fn_name: str) -> None:
+    """Patch ``module.fn_name`` to run with half-cast float args
+    (reference ``amp.py:46-50``). Unlike the decorators, this mutates the
+    module attribute — call before tracing (e.g. right after imports),
+    matching the reference's requirement to register before
+    ``amp.init``."""
+    _register(module, fn_name, half_function)
+
+
+def register_float_function(module, fn_name: str) -> None:
+    """Patch ``module.fn_name`` to run in fp32 (reference ``amp.py:52``)."""
+    _register(module, fn_name, float_function)
+
+
+def register_promote_function(module, fn_name: str) -> None:
+    """Patch ``module.fn_name`` to promote mixed float args (reference
+    ``amp.py:58``)."""
+    _register(module, fn_name, promote_function)
+
+
 def master_params(params):
     """Iterate the fp32 master parameters (reference ``_amp_state.py:61``).
 

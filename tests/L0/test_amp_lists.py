@@ -43,6 +43,24 @@ def test_legacy_handle_roundtrip():
     assert jnp.allclose(params2["w"], 0.9)
 
 
+def test_register_functions_patch_module():
+    import types
+    mod = types.SimpleNamespace(
+        f=lambda x: x.dtype, g=lambda x: x.dtype)
+    amp.register_half_function(mod, "f")
+    amp.register_float_function(mod, "g")
+    # activate an O2-like policy so casts are live
+    from apex_tpu.amp import _amp_state as st_obj
+    from apex_tpu.amp.properties import Properties, opt_levels
+    old = st_obj.opt_properties
+    st_obj.opt_properties = opt_levels["O2"](Properties())
+    try:
+        assert mod.f(jnp.ones((2,), jnp.float32)) == jnp.bfloat16
+        assert mod.g(jnp.ones((2,), jnp.bfloat16)) == jnp.float32
+    finally:
+        st_obj.opt_properties = old
+
+
 def test_noop_handle():
     handle = amp.init(enabled=False)
     assert not handle.is_active
