@@ -41,6 +41,7 @@ from apex_tpu.amp.functional import (
 )
 from apex_tpu.amp._amp_state import _amp_state, maybe_print
 from apex_tpu.amp import lists
+from apex_tpu.amp.patch import install_o1_patches, remove_o1_patches
 from apex_tpu.amp.compat_api import AmpHandle, NoOpHandle, OptimWrapper, init
 
 __all__ = [

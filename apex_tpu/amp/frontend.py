@@ -93,6 +93,12 @@ def initialize(
 
     _amp_state._amp_state.opt_properties = properties
 
+    if properties.enabled and properties.cast_ops:
+        # O1: enforce the per-op precision policy by patching the traced
+        # namespaces (reference amp.init, apex/amp/amp.py:68-171)
+        from apex_tpu.amp.patch import install_o1_patches
+        install_o1_patches()
+
     single_model = not isinstance(models, list)
     model_list = [models] if single_model else models
     wrapped_models = [AmpModel(m, properties, keep_fp32_patterns)

@@ -1,0 +1,173 @@
+"""O1 per-op precision enforcement: trace-time namespace patching.
+
+The reference enforces its op lists by monkey-patching every whitelisted
+function on ``torch`` / ``torch.Tensor`` / ``torch.nn.functional``
+(``apex/amp/amp.py:90-148``, ``wrap.py:10-29``): a user calling
+``softmax`` gets fp32 no matter what their model code says.  JAX has the
+same honest analog available because *tracing is Python execution*: a
+wrapper installed on ``jax.nn.softmax`` runs at trace time, and the casts
+it inserts become part of the jaxpr that XLA compiles.  No graph
+rewriting, no interceptors — the same design as the reference, one layer
+up.
+
+What is patched (from ``apex_tpu.amp.lists``):
+
+- ``FP32_OPS``  — softmax family, losses, pointwise transcendentals,
+  reductions: half-precision float args are upcast to fp32 before the
+  call (reference ``FP32_FUNCS``);
+- ``FP16_OPS``  — user-facing matmul entry points (``jnp.matmul`` etc.):
+  fp32 args are cast to the half compute dtype (reference
+  ``FP16_FUNCS``).  Library matmuls (flax Dense/Conv) are already half
+  via AmpModel's module-boundary casting, so only direct calls need it;
+- ``PROMOTE_OPS`` need no patch: jax's type promotion already computes
+  ``bf16 op f32`` in f32 (the reference needed ``CASTS`` because torch
+  *errors* on mixed dtypes).
+
+The wrappers are installed once (``amp.initialize`` with an O1-style
+``cast_ops`` property) and stay inert unless the *currently active*
+properties enable op casting and ``disable_casts`` is not in effect —
+mirroring the reference's handle-is-active check (``handle.py:20-40``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# bind the singleton instance straight from the submodule: the package
+# __init__ rebinds its `_amp_state` attribute to this same instance, so
+# attribute-style module imports are ambiguous here
+from apex_tpu.amp._amp_state import _amp_state as _STATE
+from apex_tpu.amp.lists import FP16_OPS, FP32_OPS
+
+_HALF_DTYPES = (jnp.float16, jnp.bfloat16)
+
+# (module, attribute) -> original function, for every installed patch
+_originals = {}
+
+
+def _props():
+    return _STATE.opt_properties
+
+
+def _active() -> bool:
+    p = _props()
+    return (p is not None and bool(p.enabled) and bool(p.cast_ops)
+            and not _STATE.casts_disabled)
+
+
+def _half_dtype():
+    p = _props()
+    cmt = getattr(p, "cast_model_type", None) if p is not None else None
+    return cmt if cmt not in (None, False) else jnp.bfloat16
+
+
+def _is_float_array(x) -> bool:
+    return hasattr(x, "dtype") and hasattr(x, "ndim") and \
+        jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def _cast_args(args, kwargs, cast: Callable):
+    from apex_tpu.amp.model import applier
+    args = tuple(applier(a, cast) for a in args)
+    kwargs = {k: applier(v, cast) for k, v in kwargs.items()}
+    return args, kwargs
+
+
+def _maybe_float(x):
+    if _is_float_array(x) and x.dtype in _HALF_DTYPES:
+        return x.astype(jnp.float32)
+    return x
+
+
+def _maybe_half(x):
+    if _is_float_array(x) and x.dtype == jnp.float32:
+        return x.astype(_half_dtype())
+    return x
+
+
+def _wrap(fn: Callable, mode: str) -> Callable:
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if _active():
+            cast = _maybe_float if mode == "fp32" else _maybe_half
+            args, kwargs = _cast_args(args, kwargs, cast)
+        return fn(*args, **kwargs)
+
+    wrapper.__amp_original__ = fn
+    return wrapper
+
+
+def _targets() -> List[Tuple[Any, str, str]]:
+    """(module, attr, mode) for every function to patch.  Names follow the
+    policy tables in ``lists.py``; jnp spellings differ from the torch
+    names (arccos vs acos etc.)."""
+    import jax.scipy.special as jsp
+
+    fp32_jnp = (
+        "exp", "expm1", "log", "log10", "log1p", "log2", "power",
+        "cosh", "sinh", "tan", "arccos", "arcsin", "arctan",
+        "cumsum", "cumprod", "mean", "sum", "prod", "std", "var",
+    )
+    fp32_nn = ("softmax", "log_softmax", "standardize")
+    fp32_jsp = ("logsumexp", "erf", "erfc")
+    half_jnp = ("matmul", "dot", "vdot", "inner", "tensordot", "einsum")
+
+    out = []
+    out += [(jnp, n, "fp32") for n in fp32_jnp if hasattr(jnp, n)]
+    out += [(jax.nn, n, "fp32") for n in fp32_nn if hasattr(jax.nn, n)]
+    out += [(jsp, n, "fp32") for n in fp32_jsp if hasattr(jsp, n)]
+    out += [(jnp.linalg, "norm", "fp32")]
+    out += [(jnp, n, "half") for n in half_jnp if hasattr(jnp, n)]
+
+    try:
+        import optax
+        fp32_optax = (
+            "softmax_cross_entropy",
+            "softmax_cross_entropy_with_integer_labels",
+            "sigmoid_binary_cross_entropy", "l2_loss", "huber_loss",
+            "kl_divergence", "log_cosh",
+        )
+        for mod in (optax, getattr(optax, "losses", None)):
+            if mod is None:
+                continue
+            out += [(mod, n, "fp32") for n in fp32_optax
+                    if hasattr(mod, n)]
+    except Exception:  # pragma: no cover
+        pass
+
+    # sanity: every patched name must be covered by the policy tables
+    known = FP32_OPS | FP16_OPS | {
+        "arccos", "arcsin", "arctan", "standardize", "power", "vdot",
+        "inner", "tensordot", "l2_loss", "huber_loss", "kl_divergence",
+        "log_cosh",
+    }
+    assert all(n in known for _, n, _m in out), \
+        [n for _, n, _m in out if n not in known]
+    return out
+
+
+def install_o1_patches() -> None:
+    """Install the op-policy wrappers (idempotent).  Called by
+    ``amp.initialize`` when the chosen opt level enables op casting; the
+    wrappers check the active amp state at trace time, so installation is
+    permanent and cheap (reference installs at ``amp.init``, ``amp.py:68``)."""
+    for mod, name, mode in _targets():
+        key = (id(mod), name)
+        if key in _originals:
+            continue
+        fn = getattr(mod, name)
+        if hasattr(fn, "__amp_original__"):
+            continue
+        _originals[key] = (mod, name, fn)
+        setattr(mod, name, _wrap(fn, mode))
+
+
+def remove_o1_patches() -> None:
+    """Restore every patched function (used by tests)."""
+    for mod, name, fn in list(_originals.values()):
+        setattr(mod, name, fn)
+    _originals.clear()

@@ -123,7 +123,12 @@ def _ln_fwd_pallas(x2: jax.Array, eps: float, interpret: bool):
         in_specs=[row_spec],
         out_specs=[row_spec, stat_spec, stat_spec],
         out_shape=[
-            jax.ShapeDtypeStruct(xp.shape, x2.dtype),
+            # xhat stays fp32: it is the backward residual and feeds the
+            # affine scale — rounding it to a half dtype here would inject
+            # O(eps_bf16) error that the dweight row-sum amplifies (the
+            # reference keeps fp32 stats for the same reason,
+            # layer_norm_cuda_kernel.cu accumulation dtype)
+            jax.ShapeDtypeStruct(xp.shape, jnp.float32),
             jax.ShapeDtypeStruct((n1p, LANES), jnp.float32),
             jax.ShapeDtypeStruct((n1p, LANES), jnp.float32),
         ],
@@ -201,7 +206,6 @@ def _fla_fwd(x, weight, bias, normalized_shape, eps, use_pallas):
     x2 = x.reshape(-1, n2)
     if _use_pallas(use_pallas):
         xhat2, mean, invvar = _ln_fwd_pallas(x2, eps, not on_tpu())
-        xhat2 = xhat2.astype(jnp.float32)
     else:
         x32 = x2.astype(jnp.float32)
         xhat2, mean, invvar = _ln_forward_jnp(x32, eps)
@@ -254,7 +258,6 @@ def _fl_fwd(x, normalized_shape, eps, use_pallas):
     x2 = x.reshape(-1, n2)
     if _use_pallas(use_pallas):
         xhat2, mean, invvar = _ln_fwd_pallas(x2, eps, not on_tpu())
-        xhat2 = xhat2.astype(jnp.float32)
     else:
         xhat2, mean, invvar = _ln_forward_jnp(x2.astype(jnp.float32), eps)
     return xhat2.astype(x.dtype).reshape(lead + ns), (xhat2, invvar)

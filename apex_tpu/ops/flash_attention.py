@@ -43,9 +43,13 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from apex_tpu.ops.pallas_utils import on_tpu
+from apex_tpu.ops.pallas_utils import on_tpu, unpatched
 
 NEG_INF = -1e30
+
+# fp32-accumulation einsum, immune to amp O1's half-list patch (the
+# upcasts around these calls are deliberate numerics, not user policy)
+_einsum = unpatched(jnp.einsum)
 
 
 def _cdiv(a, b):
@@ -74,7 +78,7 @@ def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale      # (bq, bk)
-        s = s + mask_ref[0][None, :]
+        s = s + mask_ref[0, 0][None, :]
         if causal:
             rows = lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
             cols = lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ik * bk
@@ -100,13 +104,16 @@ def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(ik == nk - 1)
     def _writeout():
-        m_fin = m_ref[:, 0]
-        l_fin = l_ref[:, 0]
-        valid = m_fin > NEG_INF / 2
-        out = acc_ref[:] / jnp.maximum(l_fin, 1e-30)[:, None]
-        o_ref[0] = jnp.where(valid[:, None], out, 0.0).astype(o_ref.dtype)
-        lse_ref[0] = jnp.where(
-            valid, m_fin + jnp.log(jnp.maximum(l_fin, 1e-30)), NEG_INF)
+        # keep bool tensors 2-D throughout: Mosaic cannot insert a minor
+        # dim on i1 vectors, so compare after broadcasting the f32 column
+        m2 = m_ref[:, :1]                          # (bq, 1) f32
+        l2 = l_ref[:, :1]
+        valid2 = m2 > NEG_INF / 2
+        out = acc_ref[:] / jnp.maximum(l2, 1e-30)
+        o_ref[0] = jnp.where(valid2, out, 0.0).astype(o_ref.dtype)
+        lse2 = jnp.where(valid2,
+                         m2 + jnp.log(jnp.maximum(l2, 1e-30)), NEG_INF)
+        lse_ref[0, 0] = lse2[:, 0]
 
 
 # ---------------------------------------------------------------------------
@@ -124,9 +131,13 @@ def _recompute_p(q, k, mask_row, lse_col, scale, causal, iq, ik, bq, bk):
         s = jnp.where(rows >= cols, s, NEG_INF)
     # fully-masked rows need an explicit zero: their saved lse is NEG_INF
     # and s rounds to exactly NEG_INF in fp32 (the mask offset absorbs any
-    # finite score), so exp(s - lse) would be exp(0) == 1, not 0
-    valid = (lse_col > NEG_INF / 2)[:, None]
-    return jnp.where(valid, jnp.exp(s - lse_col[:, None]), 0.0)
+    # finite score), so exp(s - lse) would be exp(0) == 1, not 0.
+    # NB: broadcast the f32 column FIRST — Mosaic cannot insert a minor
+    # dim on an i1 (bool) vector ("Insertion of minor dim ... only
+    # supported for 32-bit types")
+    lse2 = lse_col[:, None]
+    valid = lse2 > NEG_INF / 2
+    return jnp.where(valid, jnp.exp(s - lse2), 0.0)
 
 
 def _bwd_dq_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
@@ -139,12 +150,12 @@ def _bwd_dq_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     def _compute():
-        p = _recompute_p(q_ref[0], k_ref[0], mask_ref[0], lse_ref[0],
+        p = _recompute_p(q_ref[0], k_ref[0], mask_ref[0, 0], lse_ref[0, 0],
                          scale, causal, iq, ik, bq, bk)
         dov = jax.lax.dot_general(
             do_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
             (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dov - delta_ref[0][:, None])
+        ds = p * (dov - delta_ref[0, 0][:, None])
         dq_acc[:] += jax.lax.dot_general(
             ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -171,7 +182,7 @@ def _bwd_dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     def _compute():
-        p = _recompute_p(q_ref[0], k_ref[0], mask_ref[0], lse_ref[0],
+        p = _recompute_p(q_ref[0], k_ref[0], mask_ref[0, 0], lse_ref[0, 0],
                          scale, causal, iq, ik, bq, bk)  # (bq, bk)
         do32 = do_ref[0].astype(jnp.float32)
         dv_acc[:] += jax.lax.dot_general(
@@ -180,7 +191,7 @@ def _bwd_dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dov = jax.lax.dot_general(
             do32, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dov - delta_ref[0][:, None])           # (bq, bk)
+        ds = p * (dov - delta_ref[0, 0][:, None])        # (bq, bk)
         dk_acc[:] += jax.lax.dot_general(
             ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -228,12 +239,17 @@ def _pad_seq(x, block):
 
 
 def _specs(bq, bk, d, h):
-    """Common BlockSpecs for (BH, S, D)-laid-out operands; per-row scalars
-    (lse, delta) travel as 2-D (BH, S) so HBM holds one float per row."""
+    """Common BlockSpecs for (BH, S, D)-laid-out operands.
+
+    Per-row scalars (mask, lse, delta) travel as 3-D (B|BH, 1, S): TPU
+    lowering requires the block's last two dims to be (divisible by
+    (8, 128)) or equal to the array dims, so the singleton must sit in the
+    penultimate *array* dim — a 2-D (BH, S) array with block (1, bq)
+    fails that check on hardware (it passed silently in interpret mode)."""
     q_spec = pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))
     k_spec = pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0))
-    mask_spec = pl.BlockSpec((1, bk), lambda b, i, j: (b // h, j))
-    row_spec = pl.BlockSpec((1, bq), lambda b, i, j: (b, i))
+    mask_spec = pl.BlockSpec((1, 1, bk), lambda b, i, j: (b // h, 0, j))
+    row_spec = pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i))
     return q_spec, k_spec, mask_spec, row_spec
 
 
@@ -252,13 +268,13 @@ def _fwd_pallas(q3, k3, v3, mask, *, scale, causal, bq, bk, h, interpret):
         in_specs=[mask_spec, q_spec, k_spec, k_spec],
         out_specs=[q_spec, row_spec],
         out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
-                   jax.ShapeDtypeStruct((bh, sq), jnp.float32)],
+                   jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32)],
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32),
                         pltpu.VMEM((bq, lanes), jnp.float32),
                         pltpu.VMEM((bq, lanes), jnp.float32)],
         interpret=interpret,
-    )(mask, q3, k3, v3)
-    return o, lse                                    # (BH, Sq)
+    )(mask[:, None, :], q3, k3, v3)
+    return o, lse[:, 0, :]                           # (BH, Sq)
 
 
 @functools.partial(jax.jit, static_argnames=("scale", "causal", "bq", "bk",
@@ -271,6 +287,9 @@ def _bwd_pallas(q3, k3, v3, do3, o3, lse, mask, *, scale, causal, bq, bk,
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1)                         # (BH, Sq)
     q_spec, k_spec, mask_spec, row_spec = _specs(bq, bk, d, h)
+    mask3 = mask[:, None, :]
+    lse3 = lse[:, None, :]
+    delta3 = delta[:, None, :]
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
@@ -282,12 +301,12 @@ def _bwd_pallas(q3, k3, v3, do3, o3, lse, mask, *, scale, causal, bq, bk,
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(mask, q3, k3, v3, do3, lse, delta)
+    )(mask3, q3, k3, v3, do3, lse3, delta3)
 
     dkv_kspec = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0))
     dkv_qspec = pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0))
-    dkv_mask = pl.BlockSpec((1, bk), lambda b, j, i: (b // h, j))
-    dkv_row = pl.BlockSpec((1, bq), lambda b, j, i: (b, i))
+    dkv_mask = pl.BlockSpec((1, 1, bk), lambda b, j, i: (b // h, 0, j))
+    dkv_row = pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nq=nq),
@@ -300,7 +319,7 @@ def _bwd_pallas(q3, k3, v3, do3, o3, lse, mask, *, scale, causal, bq, bk,
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
-    )(mask, q3, k3, v3, do3, lse, delta)
+    )(mask3, q3, k3, v3, do3, lse3, delta3)
     return dq, dk, dv
 
 
@@ -310,7 +329,7 @@ def _bwd_pallas(q3, k3, v3, do3, o3, lse, mask, *, scale, causal, bq, bk,
 
 def _reference(q, k, v, kv_mask, causal, scale):
     """Pure-jnp oracle (fp32 softmax), shapes (B, S, H, D)."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+    s = _einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     if kv_mask is not None:
         s = s + kv_mask[:, None, None, :].astype(jnp.float32)
@@ -323,7 +342,7 @@ def _reference(q, k, v, kv_mask, causal, scale):
     valid = m > NEG_INF / 2
     p = jnp.exp(s - m)
     den = jnp.sum(p, axis=-1, keepdims=True)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p / jnp.maximum(den, 1e-30),
+    out = _einsum("bhqk,bkhd->bqhd", p / jnp.maximum(den, 1e-30),
                      v.astype(jnp.float32))
     out = out * jnp.transpose(valid, (0, 2, 1, 3)).astype(out.dtype)
     return out.astype(q.dtype)

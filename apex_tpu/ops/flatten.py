@@ -23,13 +23,21 @@ Pytree = Any
 
 
 class FlatSpec(NamedTuple):
-    """Static metadata needed to invert :func:`flatten`."""
+    """Static metadata needed to invert :func:`flatten`.
+
+    ``perm``/``group_bounds`` support grouped layouts (param groups): the
+    buffer holds leaves in ``perm`` order so that each group occupies one
+    contiguous ``(start, size)`` slice.  Empty perm = tree order, one
+    implicit group.
+    """
 
     treedef: Any
     shapes: Tuple[Tuple[int, ...], ...]
     dtypes: Tuple[Any, ...]
     offsets: Tuple[int, ...]  # start offset of each leaf in the flat buffer
     total: int
+    perm: Tuple[int, ...] = ()                      # buffer order of leaves
+    group_bounds: Tuple[Tuple[int, int], ...] = ()  # (start, size) per group
 
 
 def _spec_for(leaves: Sequence[jax.Array]) -> Tuple[tuple, list, tuple]:
@@ -59,13 +67,53 @@ def flatten(tree: Pytree, dtype=None):
     return flat, spec
 
 
+def flatten_grouped(tree: Pytree, group_ids: Sequence[int], dtype=None):
+    """Like :func:`flatten`, but lay the buffer out group-by-group so each
+    group is one contiguous slice (see ``FlatSpec.perm``/``group_bounds``).
+
+    ``group_ids``: group index per leaf in tree-flatten order; groups are
+    numbered 0..max contiguously.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    assert len(group_ids) == len(leaves), (len(group_ids), len(leaves))
+    if not leaves:
+        return jnp.zeros((0,), dtype or jnp.float32), FlatSpec(
+            treedef, (), (), (), 0, (), ())
+    if dtype is None:
+        dtype = jnp.result_type(*[x.dtype for x in leaves])
+    n_groups = max(group_ids) + 1
+    perm = tuple(sorted(range(len(leaves)),
+                        key=lambda i: (group_ids[i], i)))
+    shapes = tuple(tuple(x.shape) for x in leaves)
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+    # offsets indexed by tree position, laid out in perm order
+    offsets = [0] * len(leaves)
+    group_bounds = []
+    cursor = 0
+    for g in range(n_groups):
+        start = cursor
+        for i in perm:
+            if group_ids[i] == g:
+                offsets[i] = cursor
+                cursor += sizes[i]
+        group_bounds.append((start, cursor - start))
+    flat = jnp.concatenate(
+        [leaves[i].astype(dtype).reshape(-1) for i in perm])
+    spec = FlatSpec(treedef, shapes, tuple(x.dtype for x in leaves),
+                    tuple(offsets), cursor, perm, tuple(group_bounds))
+    return flat, spec
+
+
 def flatten_like(tree: Pytree, spec: FlatSpec, dtype=None) -> jax.Array:
-    """Flatten ``tree`` (matching ``spec``'s structure) without rebuilding spec."""
+    """Flatten ``tree`` (matching ``spec``'s structure) without rebuilding
+    spec, honoring the spec's (possibly grouped) buffer layout."""
     leaves = jax.tree_util.tree_leaves(tree)
     if not leaves:
         return jnp.zeros((0,), dtype or jnp.float32)
     if dtype is None:
         dtype = jnp.result_type(*[x.dtype for x in leaves])
+    if spec.perm:
+        leaves = [leaves[i] for i in spec.perm]
     return jnp.concatenate([x.astype(dtype).reshape(-1) for x in leaves])
 
 

@@ -15,6 +15,18 @@ LANES = 128
 DEFAULT_ROWS = 512
 
 
+def unpatched(fn):
+    """Return the pre-amp-O1 original of a possibly-patched function.
+
+    ``amp.patch`` installs trace-time precision wrappers on ``jnp``
+    namespaces (O1 op policy).  Library internals that upcast to fp32 ON
+    PURPOSE (flash-attention oracle scores, ring-attention accumulation)
+    must call through this so the O1 half-list patch cannot silently
+    downcast their operands — the analog of the reference keeping raw
+    function handles in ``utils.get_func`` (apex/amp/utils.py:131-158)."""
+    return getattr(fn, "__amp_original__", fn)
+
+
 def on_tpu() -> bool:
     """True when the default backend lowers to a real TPU (incl. plugins
     that canonicalize to tpu, e.g. 'axon')."""

@@ -11,6 +11,7 @@ from apex_tpu.optimizers.fp16_optimizer import (
     FP16_Optimizer,
     FP16OptimizerState,
 )
+from apex_tpu.optimizers import param_groups
 
 __all__ = [
     "FP16_Optimizer",
@@ -19,4 +20,5 @@ __all__ = [
     "FusedAdamState",
     "FusedLAMB",
     "FusedLAMBState",
+    "param_groups",
 ]

@@ -36,9 +36,11 @@ from typing import Any, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.ops.flatten import FlatSpec, flatten, flatten_like, unflatten
-from apex_tpu.ops.multi_tensor import multi_tensor_l2norm
+from apex_tpu.ops.flatten import (FlatSpec, flatten, flatten_grouped,
+                                  flatten_like, unflatten)
 from apex_tpu.ops.pallas_utils import LANES, on_tpu, pad_to_tiles, untile
+from apex_tpu.optimizers.param_groups import (group_hparams,
+                                              resolve_group_ids)
 
 Pytree = Any
 
@@ -132,6 +134,14 @@ class FusedAdam:
     (folded into the combined scale at step time), ``amsgrad`` rejected
     exactly like the reference (:46).
 
+    ``param_groups``: optional list of path-predicate group specs
+    (``optimizers.param_groups``) with per-group ``lr`` / ``weight_decay``
+    / ``eps`` / ``betas`` / ``max_grad_norm`` overrides — the pytree
+    analog of the reference's per-group loop (``fused_adam.py:50-146``).
+    At ``init`` each group's leaves are laid out as one contiguous slice
+    of the flat buffer, so the grouped step is still one Pallas launch per
+    group over flat memory (no per-leaf launches, no extra HBM traffic).
+
     ``use_pallas``: None = auto (Pallas on TPU, jnp elsewhere).
     """
 
@@ -139,7 +149,7 @@ class FusedAdam:
                  betas: Tuple[float, float] = (0.9, 0.999), eps: float = 1e-8,
                  eps_inside_sqrt: bool = False, weight_decay: float = 0.0,
                  max_grad_norm: float = 0.0, amsgrad: bool = False,
-                 use_pallas: Optional[bool] = None):
+                 use_pallas: Optional[bool] = None, param_groups=None):
         if amsgrad:
             raise RuntimeError("FusedAdam does not support the AMSGrad "
                                "variant.")
@@ -151,13 +161,87 @@ class FusedAdam:
         self.weight_decay = weight_decay
         self.max_grad_norm = max_grad_norm
         self.use_pallas = use_pallas
+        self.param_groups = list(param_groups) if param_groups else []
+        if self.param_groups:
+            from apex_tpu.optimizers.param_groups import validate_specs
+            validate_specs(self.param_groups, self._defaults().keys(),
+                           "FusedAdam")
+
+    def _defaults(self):
+        return {"lr": self.lr, "betas": self.betas, "eps": self.eps,
+                "weight_decay": self.weight_decay,
+                "max_grad_norm": self.max_grad_norm}
 
     # -- optax GradientTransformation protocol ---------------------------
     def init(self, params: Pytree) -> FusedAdamState:
-        flat, spec = flatten(params, dtype=jnp.float32)
+        if self.param_groups:
+            ids = resolve_group_ids(params, self.param_groups)
+            # number groups densely 0..n_specs even if some are empty so
+            # group_bounds aligns with group_hparams
+            ids = tuple(ids)
+            flat, spec = flatten_grouped(
+                params, ids, dtype=jnp.float32)
+            n_groups = len(self.param_groups) + 1
+            if len(spec.group_bounds) < n_groups:  # trailing empty groups
+                bounds = list(spec.group_bounds)
+                while len(bounds) < n_groups:
+                    bounds.append((spec.total, 0))
+                spec = spec._replace(group_bounds=tuple(bounds))
+        else:
+            flat, spec = flatten(params, dtype=jnp.float32)
         return FusedAdamState(step=jnp.asarray(0, jnp.int32),
                               m=jnp.zeros_like(flat),
                               v=jnp.zeros_like(flat), spec=spec)
+
+    # -- runtime group surgery -------------------------------------------
+    def add_param_group(self, state: FusedAdamState, params: Pytree,
+                        match, **overrides):
+        """Mid-training group addition (reference
+        ``_process_optimizer.py:333-407`` / ``test_add_param_group``):
+        returns ``(new_optimizer, new_state)`` where leaves matching
+        ``match`` now use ``overrides`` and every leaf keeps its Adam
+        moments.  ``params`` may also contain NEW leaves (the reference's
+        actual use: unfreezing fresh params) — their moments start at
+        zero."""
+        from apex_tpu.optimizers.param_groups import leaf_paths
+
+        # PREPEND: group resolution is first-match-wins, so the newest
+        # declaration must come first to actually override leaves an
+        # earlier group already matched
+        new_opt = FusedAdam(
+            lr=self.lr, bias_correction=self.bias_correction,
+            betas=self.betas, eps=self.eps,
+            eps_inside_sqrt=self.eps_inside_sqrt,
+            weight_decay=self.weight_decay,
+            max_grad_norm=self.max_grad_norm, use_pallas=self.use_pallas,
+            param_groups=[dict(match=match, **overrides)]
+            + self.param_groups)
+        new_state = new_opt.init(params)
+        # carry over moments by leaf path (old layout -> new layout)
+        old_m = unflatten(state.m, state.spec, cast_back=False)
+        old_v = unflatten(state.v, state.spec, cast_back=False)
+        old = {}
+        for path, m_leaf, v_leaf in zip(
+                leaf_paths(old_m), jax.tree_util.tree_leaves(old_m),
+                jax.tree_util.tree_leaves(old_v)):
+            old[path] = (m_leaf, v_leaf)
+
+        new_paths = leaf_paths(params)
+        m_leaves = list(jax.tree_util.tree_leaves(
+            unflatten(new_state.m, new_state.spec, cast_back=False)))
+        v_leaves = list(jax.tree_util.tree_leaves(
+            unflatten(new_state.v, new_state.spec, cast_back=False)))
+        for i, path in enumerate(new_paths):
+            if path in old and old[path][0].shape == m_leaves[i].shape:
+                m_leaves[i], v_leaves[i] = old[path]
+        treedef = new_state.spec.treedef
+        m_tree = jax.tree_util.tree_unflatten(treedef, m_leaves)
+        v_tree = jax.tree_util.tree_unflatten(treedef, v_leaves)
+        return new_opt, FusedAdamState(
+            step=state.step,
+            m=flatten_like(m_tree, new_state.spec, dtype=jnp.float32),
+            v=flatten_like(v_tree, new_state.spec, dtype=jnp.float32),
+            spec=new_state.spec)
 
     def update(self, grads: Pytree, state: FusedAdamState,
                params: Optional[Pytree] = None, *, scale=1.0,
@@ -195,20 +279,19 @@ class FusedAdam:
         return new_params, new_state
 
     # -- core -------------------------------------------------------------
-    def _step_flat(self, params, grads, state: FusedAdamState, scale,
-                   grad_norm):
-        p = flatten_like(params, state.spec, dtype=jnp.float32)
-        g = flatten_like(grads, state.spec, dtype=jnp.float32)
-        step = state.step + 1
-        beta1, beta2 = self.betas
+    def _step_group(self, p, m, v, g, hp, step, scale, grad_norm,
+                    use_pallas):
+        """One (contiguous) group's fused update."""
+        beta1, beta2 = hp["betas"]
 
         combined_scale = jnp.asarray(scale, jnp.float32)
-        if self.max_grad_norm > 0:
+        if hp["max_grad_norm"] > 0:
             if grad_norm is None:
-                grad_norm = multi_tensor_l2norm(grads)
+                grad_norm = jnp.sqrt(
+                    jnp.sum(jnp.square(g)))  # this group's grads only
             # reference fused_adam.py:98-104
             clip = (grad_norm / jnp.asarray(scale, jnp.float32)) / \
-                self.max_grad_norm
+                hp["max_grad_norm"]
             combined_scale = jnp.where(clip > 1,
                                        clip * scale, combined_scale)
 
@@ -216,27 +299,64 @@ class FusedAdam:
             t = step.astype(jnp.float32)
             bc1 = 1.0 - beta1 ** t
             bc2 = 1.0 - beta2 ** t
-            step_size = self.lr * jnp.sqrt(bc2) / bc1
+            step_size = hp["lr"] * jnp.sqrt(bc2) / bc1
         else:
-            step_size = jnp.asarray(self.lr, jnp.float32)
+            step_size = jnp.asarray(hp["lr"], jnp.float32)
 
-        use_pallas = self.use_pallas if self.use_pallas is not None \
-            else on_tpu()
         if use_pallas:
             scalars = jnp.stack([
                 jnp.asarray(step_size, jnp.float32),
                 jnp.asarray(beta1, jnp.float32),
                 jnp.asarray(beta2, jnp.float32),
-                jnp.asarray(self.eps, jnp.float32),
+                jnp.asarray(hp["eps"], jnp.float32),
                 combined_scale,
-                jnp.asarray(self.weight_decay, jnp.float32),
+                jnp.asarray(hp["weight_decay"], jnp.float32),
             ])
-            p2, m2, v2 = _adam_flat_pallas(
-                p, state.m, state.v, g, scalars,
+            return _adam_flat_pallas(
+                p, m, v, g, scalars,
                 eps_inside_sqrt=self.eps_inside_sqrt,
                 interpret=not on_tpu())
+        return _adam_math(
+            p, m, v, g, step_size, beta1, beta2, hp["eps"],
+            combined_scale, hp["weight_decay"], self.eps_inside_sqrt)
+
+    def _step_flat(self, params, grads, state: FusedAdamState, scale,
+                   grad_norm):
+        p = flatten_like(params, state.spec, dtype=jnp.float32)
+        g = flatten_like(grads, state.spec, dtype=jnp.float32)
+        step = state.step + 1
+        use_pallas = self.use_pallas if self.use_pallas is not None \
+            else on_tpu()
+
+        bounds = state.spec.group_bounds or ((0, state.spec.total),)
+        hps = group_hparams(self._defaults(), self.param_groups)
+        if len(hps) == 1 and len(bounds) > 1:
+            # state carries a grouped layout but this optimizer declares no
+            # groups (e.g. layout-only restore): every group uses defaults
+            hps = hps * len(bounds)
+        elif len(hps) != len(bounds):
+            raise ValueError(
+                f"optimizer declares {len(hps)} groups but the state's "
+                f"flat layout has {len(bounds)} — param_groups must match "
+                "the specs the state was init'd (or add_param_group'd) "
+                "with")
+        if len(bounds) == 1:
+            p2, m2, v2 = self._step_group(
+                p, state.m, state.v, g, hps[0], step, scale, grad_norm,
+                use_pallas)
         else:
-            p2, m2, v2 = _adam_math(
-                p, state.m, state.v, g, step_size, beta1, beta2, self.eps,
-                combined_scale, self.weight_decay, self.eps_inside_sqrt)
+            # write each group's slice back into the full buffers with
+            # dynamic_update_slice (alias-friendly under donation) rather
+            # than concatenating fresh full-size arrays
+            p2, m2, v2 = p, state.m, state.v
+            for (start, size), hp in zip(bounds, hps):
+                if size == 0:
+                    continue
+                sl = slice(start, start + size)
+                pp, mm, vv = self._step_group(
+                    p[sl], state.m[sl], state.v[sl], g[sl], hp, step,
+                    scale, grad_norm, use_pallas)
+                p2 = jax.lax.dynamic_update_slice(p2, pp, (start,))
+                m2 = jax.lax.dynamic_update_slice(m2, mm, (start,))
+                v2 = jax.lax.dynamic_update_slice(v2, vv, (start,))
         return p2, FusedAdamState(step=step, m=m2, v=v2, spec=state.spec), p

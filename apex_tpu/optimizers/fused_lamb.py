@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.ops.multi_tensor import multi_tensor_l2norm
+from apex_tpu.optimizers.param_groups import hparam_for_path
 
 Pytree = Any
 
@@ -47,10 +48,17 @@ class FusedLAMB:
                  eps: float = 1e-6, weight_decay: float = 0.01,
                  max_grad_norm: float = 1.0,
                  trust_clip: Optional[float] = None,
-                 exclude_from_layer_adaptation=None):
+                 exclude_from_layer_adaptation=None, param_groups=None):
         """``exclude_from_layer_adaptation``: optional predicate
         ``f(path) -> bool``; matching tensors use ratio 1.0 (the usual
-        BERT practice for bias/LayerNorm params)."""
+        BERT practice for bias/LayerNorm params).
+
+        ``param_groups``: optional path-predicate group specs
+        (``optimizers.param_groups``) with per-group ``lr`` /
+        ``weight_decay`` / ``eps`` overrides, resolved per leaf (the
+        trust ratio is per-tensor already, so grouping needs no layout
+        change here).  ``betas``/``max_grad_norm`` remain global: the
+        grad-norm clip is a single global norm by construction."""
         self.lr = lr
         self.bias_correction = bias_correction
         self.betas = betas
@@ -59,6 +67,54 @@ class FusedLAMB:
         self.max_grad_norm = max_grad_norm
         self.trust_clip = trust_clip
         self.exclude_from_layer_adaptation = exclude_from_layer_adaptation
+        self.param_groups = list(param_groups) if param_groups else []
+        if self.param_groups:
+            from apex_tpu.optimizers.param_groups import validate_specs
+            validate_specs(self.param_groups, ("lr", "weight_decay", "eps"),
+                           "FusedLAMB")
+
+    def _hp(self, path) -> dict:
+        return hparam_for_path(
+            jax.tree_util.keystr(path),
+            {"lr": self.lr, "weight_decay": self.weight_decay,
+             "eps": self.eps}, self.param_groups)
+
+    def add_param_group(self, state: "FusedLAMBState", params: Pytree,
+                        match, **overrides):
+        """Returns ``(new_optimizer, new_state)`` with ``match``-ed leaves
+        using ``overrides`` from now on; moments carry over by leaf path
+        (new leaves, if any, start at zero)."""
+        from apex_tpu.optimizers.param_groups import leaf_paths
+
+        # PREPEND: first-match-wins resolution — newest declaration must
+        # precede older groups to override leaves they already match
+        new_opt = FusedLAMB(
+            lr=self.lr, bias_correction=self.bias_correction,
+            betas=self.betas, eps=self.eps,
+            weight_decay=self.weight_decay,
+            max_grad_norm=self.max_grad_norm, trust_clip=self.trust_clip,
+            exclude_from_layer_adaptation=self.exclude_from_layer_adaptation,
+            param_groups=[dict(match=match, **overrides)]
+            + self.param_groups)
+        old_paths = leaf_paths(state.m)
+        old_m = dict(zip(old_paths, jax.tree_util.tree_leaves(state.m)))
+        old_v = dict(zip(old_paths, jax.tree_util.tree_leaves(state.v)))
+        fresh = new_opt.init(params)
+        leaves, treedef = jax.tree_util.tree_flatten(fresh.m)
+        v_leaves = jax.tree_util.tree_leaves(fresh.v)
+        m_out, v_out = [], []
+        for path, m_leaf, v_leaf in zip(leaf_paths(fresh.m), leaves,
+                                        v_leaves):
+            if path in old_m and old_m[path].shape == m_leaf.shape:
+                m_out.append(old_m[path])
+                v_out.append(old_v[path])
+            else:
+                m_out.append(m_leaf)
+                v_out.append(v_leaf)
+        return new_opt, FusedLAMBState(
+            step=state.step,
+            m=jax.tree_util.tree_unflatten(treedef, m_out),
+            v=jax.tree_util.tree_unflatten(treedef, v_out))
 
     def init(self, params: Pytree) -> FusedLAMBState:
         zeros = jax.tree_util.tree_map(
@@ -81,18 +137,20 @@ class FusedLAMB:
         clip = jnp.where(gnorm > self.max_grad_norm,
                          gnorm / self.max_grad_norm, 1.0)
 
-        # stage 1: per-leaf adam-style update tensor
-        def stage1(g, m, v, p):
+        # stage 1: per-leaf adam-style update tensor (weight_decay/eps
+        # resolved per group via the leaf's path)
+        def stage1(path, g, m, v, p):
+            hp = self._hp(path)
             g = jnp.asarray(g, jnp.float32) / clip
             p = jnp.asarray(p, jnp.float32)
             m2 = beta1 * m + (1.0 - beta1) * g
             v2 = beta2 * v + (1.0 - beta2) * g * g
-            upd = (m2 / bc1) / (jnp.sqrt(v2 / bc2) + self.eps) \
-                + self.weight_decay * p
+            upd = (m2 / bc1) / (jnp.sqrt(v2 / bc2) + hp["eps"]) \
+                + hp["weight_decay"] * p
             return upd, m2, v2
 
-        triples = jax.tree_util.tree_map(stage1, grads, state.m, state.v,
-                                         params)
+        triples = jax.tree_util.tree_map_with_path(
+            stage1, grads, state.m, state.v, params)
         is_triple = lambda x: isinstance(x, tuple) and len(x) == 3 and \
             all(hasattr(e, "dtype") for e in x)
         leaves, treedef = jax.tree_util.tree_flatten(triples,
@@ -113,7 +171,7 @@ class FusedLAMB:
             if self.exclude_from_layer_adaptation is not None and \
                     self.exclude_from_layer_adaptation(path):
                 ratio = jnp.asarray(1.0, jnp.float32)
-            return -self.lr * ratio * upd
+            return -self._hp(path)["lr"] * ratio * upd
 
         deltas = jax.tree_util.tree_map_with_path(stage2, updates, p_norms,
                                                   u_norms)

@@ -32,14 +32,26 @@ class LARC:
     def __init__(self, optimizer, trust_coefficient: float = 0.02,
                  clip: bool = True, eps: float = 1e-8,
                  weight_decay: float = 0.0,
-                 base_lr: Optional[float] = None):
+                 base_lr: Optional[float] = None, param_groups=None):
         """``base_lr`` is needed for clip mode; defaults to
-        ``optimizer.lr`` / ``optimizer.learning_rate`` when present."""
+        ``optimizer.lr`` / ``optimizer.learning_rate`` when present.
+
+        ``param_groups``: optional path-predicate group specs
+        (``optimizers.param_groups``) with per-group
+        ``trust_coefficient`` / ``weight_decay`` / ``eps`` overrides,
+        resolved per parameter tensor (the adaptation is per-tensor
+        already)."""
         self.optimizer = optimizer
         self.trust_coefficient = trust_coefficient
         self.clip = clip
         self.eps = eps
         self.weight_decay = weight_decay
+        self.param_groups = list(param_groups) if param_groups else []
+        if self.param_groups:
+            from apex_tpu.optimizers.param_groups import validate_specs
+            validate_specs(self.param_groups,
+                           ("trust_coefficient", "weight_decay", "eps"),
+                           "LARC")
         if base_lr is None:
             base_lr = getattr(optimizer, "lr",
                               getattr(optimizer, "learning_rate", None))
@@ -49,25 +61,32 @@ class LARC:
         self.base_lr = base_lr
 
     def _adapt(self, grads: Pytree, params: Pytree) -> Pytree:
-        def one(g, p):
+        from apex_tpu.optimizers.param_groups import hparam_for_path
+
+        defaults = {"trust_coefficient": self.trust_coefficient,
+                    "weight_decay": self.weight_decay, "eps": self.eps}
+
+        def one(path, g, p):
+            hp = hparam_for_path(jax.tree_util.keystr(path), defaults,
+                                 self.param_groups)
             g32 = jnp.asarray(g, jnp.float32)
             p32 = jnp.asarray(p, jnp.float32)
             pn = jnp.linalg.norm(p32)
             gn = jnp.linalg.norm(g32)
             safe = (pn > 0) & (gn > 0)
-            local_lr = self.trust_coefficient * pn / (
-                gn + self.weight_decay * pn + self.eps)
+            local_lr = hp["trust_coefficient"] * pn / (
+                gn + hp["weight_decay"] * pn + hp["eps"])
             if self.clip:
                 scale = jnp.minimum(local_lr / self.base_lr, 1.0)
             else:
                 scale = local_lr
-            adjusted = (g32 + self.weight_decay * p32) * scale
+            adjusted = (g32 + hp["weight_decay"] * p32) * scale
             # reference skips the whole adaptation when either norm is 0
             # (apex/parallel/LARC.py:82-92): grad passes through untouched
             out = jnp.where(safe, adjusted, g32)
             return out.astype(jnp.asarray(g).dtype)
 
-        return jax.tree_util.tree_map(one, grads, params)
+        return jax.tree_util.tree_map_with_path(one, grads, params)
 
     # -- optax protocol ----------------------------------------------------
     def init(self, params: Pytree):

@@ -34,8 +34,13 @@ import jax.numpy as jnp
 from jax import lax
 
 from apex_tpu.ops.flash_attention import bias_to_kv_mask as _bias_to_kv_mask
+from apex_tpu.ops.pallas_utils import unpatched
 
 NEG_INF = -1e30  # large-negative fp32 (not -inf: keeps exp/where NaN-free)
+
+# fp32-accumulation einsum, immune to amp O1's half-list patch (ring
+# attention upcasts scores/probabilities to fp32 deliberately)
+_einsum = unpatched(jnp.einsum)
 
 
 def _online_block_update(m, den, acc, scores, v):
@@ -51,7 +56,7 @@ def _online_block_update(m, den, acc, scores, v):
     p = jnp.exp(scores - m_new[..., None])            # (B, H, Sq, Sk)
     den = den * correction + jnp.sum(p, axis=-1)
     acc = acc * jnp.transpose(correction, (0, 2, 1))[..., None] \
-        + jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+        + _einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
     return m_new, den, acc
 
 
@@ -114,7 +119,7 @@ def ring_attention(q, k, v, *, axis_name: str,
             k_blk, v_blk, m, den, acc = carry
         # the block we hold at `step` originated at rank (my_idx - step)
         src = (my_idx - step) % n
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q32,
+        scores = _einsum("bqhd,bkhd->bhqk", q32,
                             k_blk.astype(jnp.float32))
         if has_mask:
             scores = scores + mask_blk[:, None, None, :]
@@ -194,13 +199,13 @@ def ulysses_attention(q, k, v, *, axis_name: str,
     if attention_impl is not None:
         out = attention_impl(qg, kg, vg, bias=bias)
     else:
-        scores = jnp.einsum("bqhd,bkhd->bhqk",
+        scores = _einsum("bqhd,bkhd->bhqk",
                             qg.astype(jnp.float32) * scale,
                             kg.astype(jnp.float32))
         if bias is not None:
             scores = scores + bias
         probs = jax.nn.softmax(scores, axis=-1)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs,
+        out = _einsum("bhqk,bkhd->bqhd", probs,
                          vg.astype(jnp.float32)).astype(q.dtype)
     return to_seq(out)
 

@@ -1,0 +1,169 @@
+"""O1 per-op precision policy is ENFORCED inside arbitrary user models.
+
+Port of the reference's policy-conformance tests
+(``tests/L0/run_amp/test_basic_casts.py``: whitelisted ops yield half,
+blacklisted yield fp32 regardless of the inputs the model hands them) to
+the trace-time patching design of ``apex_tpu.amp.patch``.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu import amp
+
+PROBES = {}
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    PROBES.clear()
+    yield
+    amp.remove_o1_patches()
+    amp._amp_state.opt_properties = None
+    amp._amp_state.casts_disabled = False
+
+
+class UserModel(nn.Module):
+    """A model written with NO amp awareness: calls jax.nn.softmax, jnp.exp
+    and jnp.log on whatever dtype flows through."""
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(16)(x)
+        PROBES["dense_out"] = h.dtype
+        s = jax.nn.softmax(h)
+        PROBES["softmax_out"] = s.dtype
+        e = jnp.exp(h * 1e-2)
+        PROBES["exp_out"] = e.dtype
+        l = jnp.log(jnp.abs(h) + 1.0)
+        PROBES["log_out"] = l.dtype
+        m = jnp.mean(h, axis=-1)
+        PROBES["mean_out"] = m.dtype
+        return (s + e + l).sum(axis=-1) + m
+
+
+def init_o1(model):
+    m, o = amp.initialize(model, optax.sgd(0.1), opt_level="O1",
+                          verbosity=0)
+    return m, o
+
+
+class TestO1Enforcement:
+    def test_fp32_ops_run_fp32_while_matmuls_run_half(self):
+        model, _ = init_o1(UserModel())
+        x = jnp.ones((4, 8), jnp.float32)
+        variables = model.init(jax.random.PRNGKey(0), x)
+        y = model.apply(variables, x)
+        # matmul path: half (module-boundary cast under O1)
+        assert PROBES["dense_out"] == jnp.bfloat16
+        # FP32_OPS on a half input: upcast before the op
+        assert PROBES["softmax_out"] == jnp.float32
+        assert PROBES["exp_out"] == jnp.float32
+        assert PROBES["log_out"] == jnp.float32
+        assert PROBES["mean_out"] == jnp.float32
+        assert np.isfinite(np.asarray(y, np.float32)).all()
+
+    def test_enforced_under_jit_and_grad(self):
+        """The casts are trace-time patches, so they must appear inside
+        jit-compiled training steps too (the hot path)."""
+        model, _ = init_o1(UserModel())
+        x = jnp.ones((4, 8), jnp.float32)
+        variables = model.init(jax.random.PRNGKey(0), x)
+
+        @jax.jit
+        def step(v, x):
+            return jax.grad(
+                lambda v: model.apply(v, x).sum())(v)
+
+        g = step(variables, x)
+        assert PROBES["softmax_out"] == jnp.float32
+        assert PROBES["dense_out"] == jnp.bfloat16
+        # master grads arrive fp32 (canonical params are fp32)
+        assert jax.tree_util.tree_leaves(g)[0].dtype == jnp.float32
+
+    def test_direct_user_matmul_cast_to_half(self):
+        """FP16_OPS: a user's direct jnp.matmul on fp32 args runs half
+        under O1 (reference FP16_FUNCS behavior)."""
+        init_o1(UserModel())
+        a = jnp.ones((4, 8), jnp.float32)
+        b = jnp.ones((8, 4), jnp.float32)
+        out = jnp.matmul(a, b)
+        assert out.dtype == jnp.bfloat16
+
+    def test_disable_casts_suspends_policy(self):
+        init_o1(UserModel())
+        h = jnp.ones((4,), jnp.bfloat16)
+        with amp.disable_casts():
+            assert jnp.exp(h).dtype == jnp.bfloat16
+        assert jnp.exp(h).dtype == jnp.float32
+
+    def test_inert_without_o1(self):
+        """Patches stay installed but must be no-ops under O2 (cast_ops
+        False) and after state reset."""
+        init_o1(UserModel())
+        amp.initialize(UserModel(), optax.sgd(0.1), opt_level="O2",
+                       verbosity=0)
+        h = jnp.ones((4,), jnp.bfloat16)
+        assert jnp.exp(h).dtype == jnp.bfloat16
+        a = jnp.ones((4, 8), jnp.float32)
+        assert jnp.matmul(a, a.T).dtype == jnp.float32
+
+    def test_removal_restores_originals(self):
+        init_o1(UserModel())
+        amp.remove_o1_patches()
+        assert not hasattr(jnp.exp, "__amp_original__")
+        h = jnp.ones((4,), jnp.bfloat16)
+        assert jnp.exp(h).dtype == jnp.bfloat16
+
+    def test_integer_and_python_args_untouched(self):
+        """Casting must not disturb non-float args (axis ints, integer
+        label arrays) — the applier contract."""
+        init_o1(UserModel())
+        labels = jnp.zeros((4,), jnp.int32)
+        logits = jnp.ones((4, 8), jnp.bfloat16)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels)
+        assert loss.dtype == jnp.float32
+        assert jnp.sum(jnp.ones((3,), jnp.int32)).dtype == jnp.int32
+
+    def test_internal_fp32_attention_immune_to_half_patch(self):
+        """Library internals that upcast to fp32 on purpose (flash oracle,
+        ring attention) must bypass the O1 half-list patch: results under
+        active O1 match the unpatched computation bitwise."""
+        from apex_tpu.ops.flash_attention import flash_attention
+
+        ks = jax.random.split(jax.random.PRNGKey(3), 3)
+        q, k, v = (jax.random.normal(kk, (2, 64, 2, 16), jnp.float32)
+                   for kk in ks)
+        ref = np.asarray(flash_attention(q, k, v, use_pallas=False))
+        init_o1(UserModel())
+        got = np.asarray(flash_attention(q, k, v, use_pallas=False))
+        np.testing.assert_array_equal(got, ref)
+
+    def test_o1_training_trajectory_finite(self):
+        """End-to-end O1 step with the enforced policy stays finite and
+        updates params."""
+        model, opt = init_o1(UserModel())
+        x = jnp.ones((4, 8), jnp.float32)
+        variables = model.init(jax.random.PRNGKey(0), x)
+        state = opt.init(variables["params"])
+
+        @jax.jit
+        def step(params, state, x):
+            def loss_fn(p):
+                out = model.apply({"params": p}, x)
+                loss = (out ** 2).mean()
+                with amp.scale_loss(loss, state) as scaled:
+                    return scaled
+            grads = jax.grad(loss_fn)(params)
+            return opt.step(params, grads, state)
+
+        params = variables["params"]
+        for _ in range(3):
+            params, state = step(params, state, x)
+        leaf = np.asarray(jax.tree_util.tree_leaves(params)[0], np.float32)
+        assert np.isfinite(leaf).all()

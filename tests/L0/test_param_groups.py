@@ -1,0 +1,322 @@
+"""Parameter groups: per-group hyperparameters + add_param_group.
+
+Ports the reference's param-group semantics — per-group lr/weight_decay
+in the optimizer loop (``apex/optimizers/fused_adam.py:50-146``) and
+mid-training ``add_param_group``
+(``apex/amp/_process_optimizer.py:333-407``, covered by
+``tests/L0/run_amp/test_add_param_group.py``) — onto the path-predicate
+group design of ``apex_tpu.optimizers.param_groups``.
+
+The trajectory oracle re-implements the documented apex Adam math per
+leaf with that leaf's group hyperparameters (the same oracle style as the
+reference's fused-vs-python parity tests).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.optimizers import FusedAdam, FusedLAMB, param_groups
+
+
+def make_params():
+    k = jax.random.split(jax.random.PRNGKey(0), 4)
+    return {
+        "dense": {"kernel": jax.random.normal(k[0], (8, 16)),
+                  "bias": jax.random.normal(k[1], (16,))},
+        "norm": {"scale": jax.random.normal(k[2], (16,)) * 0.1 + 1.0,
+                 "bias": jax.random.normal(k[3], (16,)) * 0.1},
+    }
+
+
+def make_grads(params, seed=1):
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    ks = jax.random.split(jax.random.PRNGKey(seed), len(leaves))
+    return jax.tree_util.tree_unflatten(
+        treedef, [jax.random.normal(kk, l.shape) for kk, l in
+                  zip(ks, leaves)])
+
+
+def adam_oracle_step(p, m, v, g, t, lr, beta1, beta2, eps, wd):
+    """The documented apex FusedAdam math (fused_adam_cuda_kernel.cu:71-83)
+    for one leaf."""
+    m = beta1 * m + (1 - beta1) * g
+    v = beta2 * v + (1 - beta2) * g * g
+    denom = jnp.sqrt(v) + eps
+    step_size = lr * jnp.sqrt(1 - beta2 ** t) / (1 - beta1 ** t)
+    p = p - step_size * (m / denom + wd * p)
+    return p, m, v
+
+
+NO_DECAY = r"(bias|norm)"
+
+
+class TestResolution:
+    def test_group_ids_first_match_wins(self):
+        params = make_params()
+        ids = param_groups.resolve_group_ids(
+            params, [{"match": r"bias"}, {"match": r"norm"}])
+        paths = param_groups.leaf_paths(params)
+        for path, gid in zip(paths, ids):
+            if "bias" in path:
+                assert gid == 1
+            elif "norm" in path:
+                assert gid == 2
+            else:
+                assert gid == 0
+
+    def test_callable_match(self):
+        params = make_params()
+        ids = param_groups.resolve_group_ids(
+            params, [{"match": lambda p: p.endswith("['kernel']")}])
+        paths = param_groups.leaf_paths(params)
+        assert all((gid == 1) == path.endswith("['kernel']")
+                   for path, gid in zip(paths, ids))
+
+    def test_masks_partition(self):
+        params = make_params()
+        ms = param_groups.masks(params, [{"match": NO_DECAY}])
+        merged = jax.tree_util.tree_map(lambda a, b: a ^ b, *ms)
+        assert all(jax.tree_util.tree_leaves(merged)), \
+            "masks must partition the tree"
+
+    def test_labels_for_multi_transform(self):
+        params = make_params()
+        lb = param_groups.labels(params, [{"match": NO_DECAY}])
+        vals = set(jax.tree_util.tree_leaves(lb))
+        assert vals == {"group0", "group1"}
+
+
+class TestFusedAdamGroups:
+    @pytest.mark.parametrize("use_pallas", [False, True])
+    def test_two_group_trajectory_vs_oracle(self, use_pallas):
+        params = make_params()
+        lr0, lr1, wd0 = 1e-2, 1e-3, 0.01
+        opt = FusedAdam(lr=lr0, weight_decay=wd0,
+                        param_groups=[{"match": NO_DECAY, "lr": lr1,
+                                       "weight_decay": 0.0}],
+                        use_pallas=use_pallas)
+        state = opt.init(params)
+
+        ref = {path: (np.asarray(p, np.float32), np.zeros(p.shape, np.float32),
+                      np.zeros(p.shape, np.float32))
+               for path, p in zip(param_groups.leaf_paths(params),
+                                  jax.tree_util.tree_leaves(params))}
+
+        p_cur = params
+        for t in range(1, 5):
+            grads = make_grads(params, seed=t)
+            p_cur, state = opt.step(p_cur, grads, state)
+            import re
+            for path, g in zip(param_groups.leaf_paths(grads),
+                               jax.tree_util.tree_leaves(grads)):
+                lr, wd = ((lr1, 0.0) if re.search(NO_DECAY, path)
+                          else (lr0, wd0))
+                p, m, v = ref[path]
+                p, m, v = adam_oracle_step(
+                    jnp.asarray(p), jnp.asarray(m), jnp.asarray(v),
+                    jnp.asarray(g, jnp.float32), float(t),
+                    lr, 0.9, 0.999, 1e-8, wd)
+                ref[path] = (np.asarray(p), np.asarray(m), np.asarray(v))
+
+        for path, got in zip(param_groups.leaf_paths(p_cur),
+                             jax.tree_util.tree_leaves(p_cur)):
+            np.testing.assert_allclose(np.asarray(got), ref[path][0],
+                                       rtol=2e-5, atol=2e-6,
+                                       err_msg=path)
+
+    def test_single_group_unchanged(self):
+        """No param_groups -> identical behavior to the ungrouped layout."""
+        params = make_params()
+        grads = make_grads(params)
+        a = FusedAdam(lr=1e-2, use_pallas=False)
+        b = FusedAdam(lr=1e-2, use_pallas=False,
+                      param_groups=[{"match": r"$^"}])  # matches nothing
+        pa, sa = a.step(params, grads, a.init(params))
+        pb, sb = b.step(params, grads, b.init(params))
+        jax.tree_util.tree_map(
+            lambda x, y: np.testing.assert_allclose(
+                np.asarray(x), np.asarray(y), rtol=1e-6), pa, pb)
+
+    def test_grouped_jits_and_donates(self):
+        params = make_params()
+        opt = FusedAdam(lr=1e-2, use_pallas=False,
+                        param_groups=[{"match": NO_DECAY,
+                                       "weight_decay": 0.0}])
+        state = opt.init(params)
+
+        @jax.jit
+        def step(p, g, s):
+            return opt.step(p, g, s)
+
+        p2, s2 = step(params, make_grads(params), state)
+        p3, s3 = step(p2, make_grads(params, 2), s2)
+        assert np.isfinite(
+            np.asarray(jax.tree_util.tree_leaves(p3)[0])).all()
+
+
+class TestAddParamGroup:
+    def test_add_group_mid_training_preserves_moments(self):
+        """test_add_param_group semantics: train, add a group with its own
+        lr mid-training, keep training; trajectory matches the oracle that
+        switches hyperparameters at the same step WITHOUT resetting m/v."""
+        params = make_params()
+        lr0, lr1 = 1e-2, 5e-4
+        opt = FusedAdam(lr=lr0, use_pallas=False)
+        state = opt.init(params)
+
+        ref = {path: (np.asarray(p, np.float32),
+                      np.zeros(p.shape, np.float32),
+                      np.zeros(p.shape, np.float32))
+               for path, p in zip(param_groups.leaf_paths(params),
+                                  jax.tree_util.tree_leaves(params))}
+
+        import re
+        p_cur = params
+        for t in range(1, 7):
+            if t == 4:
+                opt, state = opt.add_param_group(state, p_cur,
+                                                 match=NO_DECAY, lr=lr1)
+            grads = make_grads(params, seed=t)
+            p_cur, state = opt.step(p_cur, grads, state)
+            for path, g in zip(param_groups.leaf_paths(grads),
+                               jax.tree_util.tree_leaves(grads)):
+                lr = lr1 if (t >= 4 and re.search(NO_DECAY, path)) else lr0
+                p, m, v = ref[path]
+                p, m, v = adam_oracle_step(
+                    jnp.asarray(p), jnp.asarray(m), jnp.asarray(v),
+                    jnp.asarray(g, jnp.float32), float(t),
+                    lr, 0.9, 0.999, 1e-8, 0.0)
+                ref[path] = (np.asarray(p), np.asarray(m), np.asarray(v))
+
+        for path, got in zip(param_groups.leaf_paths(p_cur),
+                             jax.tree_util.tree_leaves(p_cur)):
+            np.testing.assert_allclose(np.asarray(got), ref[path][0],
+                                       rtol=2e-5, atol=2e-6, err_msg=path)
+
+    def test_add_group_overrides_previously_matched_leaves(self):
+        """First-match-wins resolution + PREPEND on add_param_group: the
+        newest declaration must win for leaves an older group matched."""
+        params = {"w": jnp.ones((4, 4)), "bias": jnp.ones((4,))}
+        opt = FusedAdam(lr=1e-2, use_pallas=False,
+                        param_groups=[{"match": r"bias", "lr": 1e-3}])
+        state = opt.init(params)
+        opt2, state2 = opt.add_param_group(state, params, match=r"bias",
+                                           lr=0.0)
+        g = {"w": jnp.ones((4, 4)), "bias": jnp.ones((4,))}
+        p2, _ = opt2.step(params, g, state2)
+        # lr 0.0 for bias now wins: bias unchanged, w moved
+        np.testing.assert_allclose(np.asarray(p2["bias"]), 1.0)
+        assert not np.allclose(np.asarray(p2["w"]), 1.0)
+
+    def test_add_group_with_new_leaves(self):
+        """The reference's actual use: params appear that were not being
+        optimized before (unfreezing); their moments start at zero, old
+        leaves keep theirs."""
+        params = {"a": jnp.ones((4, 4))}
+        opt = FusedAdam(lr=1e-2, use_pallas=False)
+        state = opt.init(params)
+        p_cur, state = opt.step(params, {"a": jnp.ones((4, 4))}, state)
+        grown = {"a": p_cur["a"], "b": jnp.ones((2, 2))}
+        opt2, state2 = opt.add_param_group(state, grown, match=r"\['b'\]",
+                                           lr=1e-3)
+        # old moments preserved
+        m_tree = jax.tree_util.tree_unflatten(
+            state2.spec.treedef,
+            [np.asarray(x) for x in jax.tree_util.tree_leaves(
+                {"a": np.ones((4, 4)), "b": np.zeros((2, 2))})])
+        from apex_tpu.ops.flatten import unflatten
+        got_m = unflatten(state2.m, state2.spec, cast_back=False)
+        assert np.abs(np.asarray(got_m["a"])).sum() > 0
+        np.testing.assert_allclose(np.asarray(got_m["b"]), 0.0)
+        p2, _ = opt2.step(grown, jax.tree_util.tree_map(jnp.ones_like,
+                                                        grown), state2)
+        assert set(p2) == {"a", "b"}
+
+
+class TestFusedLAMBGroups:
+    def test_group_override_matches_defaults_changed(self):
+        """A group whose overrides equal the ctor defaults is a no-op; a
+        real override changes only the matched leaves."""
+        params = make_params()
+        grads = make_grads(params)
+        base = FusedLAMB(lr=1e-2, weight_decay=0.01)
+        noop = FusedLAMB(lr=1e-2, weight_decay=0.01,
+                         param_groups=[{"match": NO_DECAY,
+                                        "weight_decay": 0.01}])
+        nodecay = FusedLAMB(lr=1e-2, weight_decay=0.01,
+                            param_groups=[{"match": NO_DECAY,
+                                           "weight_decay": 0.0}])
+        pb, _ = base.step(params, grads, base.init(params))
+        pn, _ = noop.step(params, grads, noop.init(params))
+        pd, _ = nodecay.step(params, grads, nodecay.init(params))
+        jax.tree_util.tree_map(
+            lambda x, y: np.testing.assert_allclose(
+                np.asarray(x), np.asarray(y), rtol=1e-6), pb, pn)
+        # matched leaves changed, unmatched identical
+        np.testing.assert_allclose(np.asarray(pb["dense"]["kernel"]),
+                                   np.asarray(pd["dense"]["kernel"]),
+                                   rtol=1e-6)
+        assert not np.allclose(np.asarray(pb["dense"]["bias"]),
+                               np.asarray(pd["dense"]["bias"]))
+
+    def test_add_param_group(self):
+        params = make_params()
+        opt = FusedLAMB(lr=1e-2)
+        state = opt.init(params)
+        p1, state = opt.step(params, make_grads(params), state)
+        opt2, state2 = opt.add_param_group(state, p1, match=NO_DECAY,
+                                           lr=1e-4)
+        assert int(state2.step) == int(state.step)
+        # moments preserved
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(np.asarray(a),
+                                                    np.asarray(b)),
+            state.m, state2.m)
+        p2, _ = opt2.step(p1, make_grads(params, 2), state2)
+        assert np.isfinite(
+            np.asarray(jax.tree_util.tree_leaves(p2)[0])).all()
+
+
+class TestLARCGroups:
+    def test_trust_coefficient_override(self):
+        import optax
+        from apex_tpu.parallel import LARC
+
+        params = make_params()
+        grads = make_grads(params)
+        base = LARC(optax.sgd(1e-2), trust_coefficient=0.02, base_lr=1e-2)
+        grouped = LARC(optax.sgd(1e-2), trust_coefficient=0.02,
+                       base_lr=1e-2,
+                       param_groups=[{"match": NO_DECAY,
+                                      "trust_coefficient": 1e-4}])
+        ub, _ = base.update(grads, base.init(params), params)
+        ug, _ = grouped.update(grads, grouped.init(params), params)
+        np.testing.assert_allclose(np.asarray(ub["dense"]["kernel"]),
+                                   np.asarray(ug["dense"]["kernel"]))
+        assert not np.allclose(np.asarray(ub["dense"]["bias"]),
+                               np.asarray(ug["dense"]["bias"]))
+
+
+class TestMultiTransform:
+    def test_optax_param_groups(self):
+        """param groups for ANY optax optimizer via multi_transform — the
+        amp wrapped-optimizer path."""
+        import optax
+
+        params = make_params()
+        grads = make_grads(params)
+        opt = param_groups.multi_transform(
+            optax.adamw, {"learning_rate": 1e-3, "weight_decay": 0.01},
+            [{"match": NO_DECAY, "weight_decay": 0.0}], params)
+        state = opt.init(params)
+        updates, _ = opt.update(grads, state, params)
+        base = optax.adamw(learning_rate=1e-3, weight_decay=0.01)
+        ub, _ = base.update(grads, base.init(params), params)
+        # kernel leaf identical to plain adamw; bias differs (no decay)
+        np.testing.assert_allclose(
+            np.asarray(updates["dense"]["kernel"]),
+            np.asarray(ub["dense"]["kernel"]), rtol=1e-6)
+        assert not np.allclose(np.asarray(updates["dense"]["bias"]),
+                               np.asarray(ub["dense"]["bias"]))

@@ -27,17 +27,27 @@ from apex_tpu.optimizers import FusedAdam
 
 class ConvBNNet(nn.Module):
     """Tiny conv net with BatchNorm + FusedLayerNorm: touches every amp
-    policy surface (conv/matmul fp16 list, BN keep-fp32, fused LN)."""
+    policy surface (conv/matmul fp16 list, BN keep-fp32, fused LN).
+
+    ``norm``: optional norm-layer factory (called with
+    ``use_running_average=``) so the distributed harness can swap in
+    SyncBatchNorm — the same factory pattern as the model zoo."""
 
     use_pallas: Optional[bool] = None
+    norm: Optional[object] = None
+
+    def _norm(self, train):
+        if self.norm is not None:
+            return self.norm(use_running_average=not train)
+        return nn.BatchNorm(use_running_average=not train, momentum=0.9)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         x = nn.Conv(16, (3, 3), use_bias=False)(x)
-        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        x = self._norm(train)(x)
         x = nn.relu(x)
         x = nn.Conv(16, (3, 3), (2, 2), use_bias=False)(x)
-        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        x = self._norm(train)(x)
         x = nn.relu(x)
         x = x.reshape((x.shape[0], -1))
         x = nn.Dense(32)(x)
@@ -62,29 +72,12 @@ def make_data(steps: int, batch: int = 16, seed: int = 0):
     return jnp.asarray(xs[:steps]), jnp.asarray(ys[:steps])
 
 
-def run_training(opt_level: str = "O1", loss_scale=None,
-                 keep_batchnorm_fp32=None, use_pallas: Optional[bool] = False,
-                 steps: int = 8, lr: float = 1e-2, seed: int = 0,
-                 inject_inf_step: Optional[int] = None):
-    """Train ConvBNNet for ``steps`` and return the run record.
+def _make_grad_fn(model, optimizer):
+    """Shared per-step forward+backward: returns (grads, loss, new_stats).
+    Both the single-device and distributed runners build on this so the
+    cross-product comparison can never diverge for harness reasons."""
 
-    ``inject_inf_step``: poison that step's input with an inf (the
-    reference's fault-injection pattern,
-    ``test_multiple_models_optimizers_losses.py:73-88``).
-    """
-    model, optimizer = amp.initialize(
-        ConvBNNet(use_pallas=use_pallas),
-        FusedAdam(lr=lr, use_pallas=use_pallas),
-        opt_level=opt_level, loss_scale=loss_scale,
-        keep_batchnorm_fp32=keep_batchnorm_fp32, verbosity=0)
-
-    xs, ys = make_data(steps, seed=seed)
-    variables = model.init(jax.random.PRNGKey(seed), xs[0], train=True)
-    params, batch_stats = variables["params"], variables["batch_stats"]
-    opt_state = optimizer.init(params)
-
-    @jax.jit
-    def train_step(params, batch_stats, opt_state, x, y):
+    def grad_fn(params, batch_stats, opt_state, x, y):
         def loss_fn(p):
             logits, mut = model.apply(
                 {"params": p, "batch_stats": batch_stats}, x, train=True,
@@ -94,15 +87,22 @@ def run_training(opt_level: str = "O1", loss_scale=None,
             with amp.scale_loss(loss, opt_state) as scaled:
                 return scaled, (loss, mut["batch_stats"])
         grads, (loss, new_stats) = jax.grad(loss_fn, has_aux=True)(params)
-        params, opt_state = optimizer.step(params, grads, opt_state)
-        return params, new_stats, opt_state, loss
+        return grads, loss, new_stats
 
+    return grad_fn
+
+
+def _run_loop(run_one, optimizer, params, batch_stats, opt_state, xs, ys,
+              steps, inject_inf_step):
+    """Shared train loop + record assembly (incl. the reference's
+    inf-injection poison pattern,
+    ``test_multiple_models_optimizers_losses.py:73-88``)."""
     losses, scales = [], []
     for i in range(steps):
         x = xs[i]
         if inject_inf_step is not None and i == inject_inf_step:
             x = x.at[0, 0, 0, 0].set(jnp.inf)
-        params, batch_stats, opt_state, loss = train_step(
+        params, batch_stats, opt_state, loss = run_one(
             params, batch_stats, opt_state, x, ys[i])
         losses.append(float(loss))
         scales.append(float(optimizer.loss_scale(opt_state)))
@@ -114,3 +114,118 @@ def run_training(opt_level: str = "O1", loss_scale=None,
         "skipped_steps": int(opt_state.skipped_steps),
         "params": jax.device_get(params),
     }
+
+
+def run_training(opt_level: str = "O1", loss_scale=None,
+                 keep_batchnorm_fp32=None, use_pallas: Optional[bool] = False,
+                 steps: int = 8, lr: float = 1e-2, seed: int = 0,
+                 inject_inf_step: Optional[int] = None):
+    """Train ConvBNNet for ``steps`` and return the run record."""
+    model, optimizer = amp.initialize(
+        ConvBNNet(use_pallas=use_pallas),
+        FusedAdam(lr=lr, use_pallas=use_pallas),
+        opt_level=opt_level, loss_scale=loss_scale,
+        keep_batchnorm_fp32=keep_batchnorm_fp32, verbosity=0)
+
+    xs, ys = make_data(steps, seed=seed)
+    variables = model.init(jax.random.PRNGKey(seed), xs[0], train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    opt_state = optimizer.init(params)
+    grad_fn = _make_grad_fn(model, optimizer)
+
+    @jax.jit
+    def train_step(params, batch_stats, opt_state, x, y):
+        grads, loss, new_stats = grad_fn(params, batch_stats, opt_state,
+                                         x, y)
+        params, opt_state = optimizer.step(params, grads, opt_state)
+        return params, new_stats, opt_state, loss
+
+    return _run_loop(train_step, optimizer, params, batch_stats, opt_state,
+                     xs, ys, steps, inject_inf_step)
+
+
+def run_training_distributed(opt_level: str = "O1", loss_scale=None,
+                             mode: str = "gspmd",
+                             use_pallas: Optional[bool] = False,
+                             steps: int = 8, lr: float = 1e-2, seed: int = 0,
+                             inject_inf_step: Optional[int] = None,
+                             ndev: int = 8):
+    """The distributed half of the L1 cross product (reference
+    ``tests/L1/cross_product_distributed/run.sh``): the SAME model, data
+    and option cross product as :func:`run_training`, trained data-parallel
+    over an ``ndev``-device mesh in one of two styles:
+
+    - ``gspmd``: batch sharded via NamedSharding under plain jit — XLA
+      inserts the cross-replica reductions (BatchNorm stats become global
+      automatically, which is the single-device math exactly);
+    - ``shard_map``: explicit SPMD with the DDP wrapper reducing grads and
+      SyncBatchNorm syncing stats on the named axis — the literal port of
+      the reference's torch.distributed.launch 2-process run.
+
+    Because every step consumes the same global batch, the returned loss
+    trajectory is directly comparable with the single-device run's.
+    """
+    import functools
+
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from apex_tpu import parallel
+
+    mesh = Mesh(np.asarray(jax.devices()[:ndev]), ("data",))
+
+    if mode == "shard_map":
+        import functools as _ft
+        norm = _ft.partial(parallel.SyncBatchNorm, axis_name="data",
+                           momentum=0.1)  # torch convention == flax 0.9
+        net = ConvBNNet(use_pallas=use_pallas, norm=norm)
+    else:
+        net = ConvBNNet(use_pallas=use_pallas)
+
+    model, optimizer = amp.initialize(
+        net, FusedAdam(lr=lr, use_pallas=use_pallas),
+        opt_level=opt_level, loss_scale=loss_scale, verbosity=0)
+
+    xs, ys = make_data(steps, seed=seed)
+    variables = model.init(jax.random.PRNGKey(seed), xs[0], train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    opt_state = optimizer.init(params)
+    step_fn = _make_grad_fn(model, optimizer)
+
+    if mode == "gspmd":
+        repl = NamedSharding(mesh, P())
+        shard = NamedSharding(mesh, P("data"))
+        params, batch_stats, opt_state = jax.device_put(
+            (params, batch_stats, opt_state), repl)
+
+        @jax.jit
+        def train_step(params, batch_stats, opt_state, x, y):
+            grads, loss, new_stats = step_fn(params, batch_stats,
+                                             opt_state, x, y)
+            params, opt_state = optimizer.step(params, grads, opt_state)
+            return params, new_stats, opt_state, loss
+
+        def run_one(params, batch_stats, opt_state, x, y):
+            x = jax.device_put(x, shard)
+            y = jax.device_put(y, shard)
+            with mesh:
+                return train_step(params, batch_stats, opt_state, x, y)
+    else:
+        ddp = parallel.DistributedDataParallel(process_group="data")
+
+        @jax.jit
+        @functools.partial(
+            jax.shard_map, mesh=mesh,
+            in_specs=(P(), P(), P(), P("data"), P("data")),
+            out_specs=(P(), P(), P(), P()))
+        def train_step(params, batch_stats, opt_state, x, y):
+            grads, loss, new_stats = step_fn(params, batch_stats,
+                                             opt_state, x, y)
+            grads = ddp.reduce_gradients(grads)
+            params, opt_state = optimizer.step(params, grads, opt_state)
+            loss = jax.lax.pmean(loss, "data")
+            return params, new_stats, opt_state, loss
+
+        def run_one(params, batch_stats, opt_state, x, y):
+            return train_step(params, batch_stats, opt_state, x, y)
+
+    return _run_loop(run_one, optimizer, params, batch_stats, opt_state,
+                     xs, ys, steps, inject_inf_step)
