@@ -6,6 +6,9 @@ The TPU equivalents here:
 
 - :func:`npz_loader` — stream ``.npz`` shards (``x`` NHWC uint8, ``y``
   int) from a directory;
+- :func:`image_folder_loader` — real-image path: torchvision-ImageFolder
+  directory layout decoded by a PIL thread pool, with the reference's
+  train (RandomResizedCrop+flip) and eval (Resize+CenterCrop) transforms;
 - :func:`synthetic_loader` — zero-IO random batches for benchmarking;
 - :func:`prefetch_to_device` — background-thread host→device transfer so
   step N+1's batch is already on-chip when step N finishes (the pinned-
@@ -15,9 +18,11 @@ The TPU equivalents here:
 """
 
 from apex_tpu.data.loaders import (
+    image_folder_loader,
     npz_loader,
     prefetch_to_device,
     synthetic_loader,
 )
 
-__all__ = ["npz_loader", "prefetch_to_device", "synthetic_loader"]
+__all__ = ["image_folder_loader", "npz_loader", "prefetch_to_device",
+           "synthetic_loader"]

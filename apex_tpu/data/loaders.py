@@ -68,6 +68,134 @@ def npz_loader(data_dir: str, batch_size: int,
                     pass  # epoch boundaries are the caller's loop's job
 
 
+def _list_image_folder(root: str):
+    """torchvision-ImageFolder convention: ``root/<class_name>/*.{jpg,...}``;
+    classes sorted alphabetically -> contiguous label ids."""
+    exts = (".jpg", ".jpeg", ".png", ".bmp", ".webp")
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    if not classes:
+        raise FileNotFoundError(f"no class directories under {root}")
+    samples = []
+    for label, cls in enumerate(classes):
+        for path in sorted(glob.glob(os.path.join(root, cls, "*"))):
+            if path.lower().endswith(exts):
+                samples.append((path, label))
+    if not samples:
+        raise FileNotFoundError(f"no images under {root}")
+    return samples, classes
+
+
+def _decode_train(path: str, image_size: int, rng: np.random.RandomState):
+    """RandomResizedCrop(scale 0.08-1.0) + horizontal flip — the
+    reference's training transform (``examples/imagenet/main_amp.py``
+    torchvision pipeline), PIL-only."""
+    from PIL import Image
+
+    with Image.open(path) as im:
+        im = im.convert("RGB")
+        w, h = im.size
+        area = w * h
+        for _ in range(10):
+            target = area * rng.uniform(0.08, 1.0)
+            ar = np.exp(rng.uniform(np.log(3 / 4), np.log(4 / 3)))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                x0 = rng.randint(0, w - cw + 1)
+                y0 = rng.randint(0, h - ch + 1)
+                im = im.resize((image_size, image_size), Image.BILINEAR,
+                               box=(x0, y0, x0 + cw, y0 + ch))
+                break
+        else:  # fallback: center crop of the short side
+            s = min(w, h)
+            x0, y0 = (w - s) // 2, (h - s) // 2
+            im = im.resize((image_size, image_size), Image.BILINEAR,
+                           box=(x0, y0, x0 + s, y0 + s))
+        arr = np.asarray(im, np.uint8)
+    if rng.rand() < 0.5:
+        arr = arr[:, ::-1]
+    return arr
+
+
+def _decode_eval(path: str, image_size: int):
+    """Resize(short side = size*256/224) + CenterCrop(size) — the
+    reference's validation transform."""
+    from PIL import Image
+
+    resize = int(image_size * 256 / 224)
+    with Image.open(path) as im:
+        im = im.convert("RGB")
+        w, h = im.size
+        if w < h:
+            nw, nh = resize, int(round(h * resize / w))
+        else:
+            nw, nh = int(round(w * resize / h)), resize
+        im = im.resize((nw, nh), Image.BILINEAR)
+        x0, y0 = (nw - image_size) // 2, (nh - image_size) // 2
+        im = im.crop((x0, y0, x0 + image_size, y0 + image_size))
+        return np.asarray(im, np.uint8)
+
+
+def image_folder_loader(root: str, batch_size: int, image_size: int = 224,
+                        train: bool = True, shuffle: Optional[bool] = None,
+                        seed: int = 0, num_workers: int = 8,
+                        loop: bool = True):
+    """Stream (x uint8 NHWC, y int32) batches from a torchvision-style
+    image folder using a PIL decode pool — the real-data input path the
+    reference gets from ``datasets.ImageFolder`` + ``DataLoader`` workers
+    (``examples/imagenet/main_amp.py``).
+
+    ``train`` picks the transform (RandomResizedCrop+flip vs
+    Resize+CenterCrop).  ``loop=False`` yields one pass (validation) with
+    a final short batch.
+    """
+    samples, _ = _list_image_folder(root)  # eager: bad root fails HERE
+    if train and len(samples) < batch_size:
+        # the drop-ragged-tail rule below would otherwise yield NOTHING
+        # and (with loop=True) spin forever
+        raise ValueError(
+            f"{root}: {len(samples)} images < batch_size {batch_size}; "
+            "a training epoch would produce zero batches")
+    if shuffle is None:
+        shuffle = train
+    return _image_folder_iter(samples, batch_size, image_size, train,
+                              shuffle, seed, num_workers, loop)
+
+
+def _image_folder_iter(samples, batch_size, image_size, train, shuffle,
+                       seed, num_workers, loop):
+    from concurrent.futures import ThreadPoolExecutor
+
+    rng = np.random.RandomState(seed)
+    pool = ThreadPoolExecutor(max_workers=num_workers)
+
+    def decode(item):
+        (path, label), item_seed = item
+        if train:
+            # per-item seed drawn in the MAIN thread (RandomState is not
+            # thread-safe; workers only consume their private generator)
+            return _decode_train(path, image_size,
+                                 np.random.RandomState(item_seed)), label
+        return _decode_eval(path, image_size), label
+
+    while True:
+        order = rng.permutation(len(samples)) if shuffle \
+            else np.arange(len(samples))
+        for i in range(0, len(order), batch_size):
+            idx = order[i:i + batch_size]
+            if train and len(idx) < batch_size:
+                break  # drop ragged train tail (the reference's drop_last)
+            seeds = rng.randint(2 ** 31, size=len(idx))
+            decoded = list(pool.map(
+                decode, [(samples[j], s) for j, s in zip(idx, seeds)]))
+            x = np.stack([d[0] for d in decoded]).astype(np.uint8)
+            y = np.asarray([d[1] for d in decoded], np.int32)
+            yield x, y
+        if not loop:
+            return
+
+
 def prefetch_to_device(iterator, size: int = 2, sharding=None):
     """Wrap a host batch iterator with a background thread that moves
     batches to device (with ``sharding`` when given) ``size`` steps ahead.

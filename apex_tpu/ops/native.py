@@ -48,6 +48,10 @@ def _load() -> Optional[ctypes.CDLL]:
     global _lib, available
     if _lib is not None:
         return _lib
+    if os.environ.get("APEX_TPU_NO_NATIVE"):
+        # build-matrix hook: force the python-only install path (the
+        # reference's "no --cpp_ext" axis) without monkeypatching
+        return None
     if not os.path.exists(_LIB_PATH) and not _build():
         return None
     try:

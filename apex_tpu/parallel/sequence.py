@@ -108,7 +108,7 @@ def ring_attention(q, k, v, *, axis_name: str,
         if _target_vma is None:
             return x
         missing = tuple(sorted(_target_vma - set(jax.typeof(x).vma)))
-        return lax.pvary(x, missing) if missing else x
+        return lax.pcast(x, missing, to="varying") if missing else x
 
     q_pos = my_idx * s_local + jnp.arange(s_local)    # global q positions
 

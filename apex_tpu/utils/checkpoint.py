@@ -13,6 +13,16 @@ Backend: orbax-checkpoint when importable (async-capable, multi-host
 aware), else a numpy ``.npz`` + structure-pickle fallback with the same
 API. Restore always takes a ``target`` pytree so namedtuple/custom-node
 structure (AmpOptimizerState, optax states) round-trips exactly.
+
+.. caution:: The npz fallback pickles the *treedef* alongside the arrays.
+   Pickled treedefs reference the defining classes by module path, so a
+   fallback checkpoint is NOT portable across jax/optax/apex_tpu version
+   bumps that move or rename state classes (orbax checkpoints restore
+   structurally via ``target`` and don't have this problem). Treat npz
+   checkpoints as same-environment restart artifacts; for archival or
+   cross-version checkpoints, install orbax. On version-mismatch
+   ``restore`` raises the underlying unpickling error rather than
+   guessing.
 """
 
 from __future__ import annotations

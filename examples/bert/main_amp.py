@@ -114,8 +114,12 @@ def main():
             return f(q, k, v, bias)
 
     model_def = models.BertForPreTraining(cfg, attention_fn=attention_fn)
+    # the BERT recipe: bias/LayerNorm params take no weight decay (param
+    # group) AND no layer adaptation (trust ratio 1.0) — the reference's
+    # downstream-BERT convention, now expressible declaratively
     optimizer_def = optimizers.FusedLAMB(
         lr=args.lr, max_grad_norm=args.max_grad_norm,
+        param_groups=[{"match": r"(bias|_ln)", "weight_decay": 0.0}],
         exclude_from_layer_adaptation=lambda path: any(
             "bias" in str(k) or "_ln" in str(k) for k in path))
     model, optimizer = amp.initialize(

@@ -51,8 +51,9 @@ def parse_args():
     p = argparse.ArgumentParser(
         description="ImageNet training with apex_tpu amp (TPU)")
     p.add_argument("--data", default=None,
-                   help=".npz shard dir (x: NHWC uint8, y: int); synthetic "
-                   "data when omitted")
+                   help="dataset dir: either torchvision-ImageFolder layout "
+                   "(train/<class>/*.jpg [+ val/<class>/*.jpg]) or .npz "
+                   "shards (x: NHWC uint8, y: int); synthetic when omitted")
     p.add_argument("--arch", "-a", default="resnet50", choices=sorted(ARCHS))
     p.add_argument("--epochs", type=int, default=2)
     p.add_argument("--b", "--batch-size", type=int, default=256, dest="b",
@@ -60,12 +61,22 @@ def parse_args():
     p.add_argument("--lr", type=float, default=0.1)
     p.add_argument("--momentum", type=float, default=0.9)
     p.add_argument("--weight-decay", type=float, default=1e-4)
+    p.add_argument("--warmup-epochs", type=int, default=5,
+                   help="linear lr warmup epochs (reference "
+                   "adjust_learning_rate, main_amp.py:464-500)")
     p.add_argument("--print-freq", type=int, default=10)
     p.add_argument("--steps-per-epoch", type=int, default=100,
-                   help="synthetic-data epoch length")
+                   help="epoch length for synthetic/npz data (ImageFolder "
+                   "derives it from the dataset size)")
+    p.add_argument("--val-steps", type=int, default=10,
+                   help="validation batches for synthetic data")
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--workers", type=int, default=8,
+                   help="decode threads for the ImageFolder loader")
     p.add_argument("--deterministic", action="store_true")
+    p.add_argument("--evaluate", action="store_true",
+                   help="validate and exit (reference --evaluate)")
     p.add_argument("--prof", type=int, default=None,
                    help="profile N iterations then exit")
     p.add_argument("--sync_bn", action="store_true",
@@ -79,7 +90,7 @@ def parse_args():
     p.add_argument("--resume", default=None,
                    help="checkpoint dir to resume from")
     p.add_argument("--checkpoint-dir", default=None,
-                   help="save a checkpoint per epoch when set")
+                   help="save last/best checkpoints when set")
     return p.parse_args()
 
 
@@ -99,6 +110,64 @@ def synthetic_batches(args, steps, seed=0):
 def npz_batches(args, steps):
     from apex_tpu.data import npz_loader
     return npz_loader(args.data, batch_size=args.b, steps_per_epoch=steps)
+
+
+def make_loaders(args):
+    """Route --data to the right pipeline; returns
+    (train_iter, make_val_iter | None, steps_per_epoch)."""
+    import glob as _glob
+    import os as _os
+
+    if args.data is None:
+        train = synthetic_batches(args, args.steps_per_epoch)
+        # fixed-seed synthetic val set so --evaluate works hermetically
+        make_val = lambda: iter(
+            [b for _, b in zip(range(args.val_steps),
+                               synthetic_batches(args, args.val_steps,
+                                                 seed=1234))])
+        return train, make_val, args.steps_per_epoch
+
+    train_dir = _os.path.join(args.data, "train")
+    if _os.path.isdir(train_dir):  # ImageFolder layout (reference default)
+        from apex_tpu.data import image_folder_loader
+        from apex_tpu.data.loaders import _list_image_folder
+        n_train = len(_list_image_folder(train_dir)[0])
+        steps = max(1, n_train // args.b)
+        train = image_folder_loader(
+            train_dir, args.b, image_size=args.image_size, train=True,
+            num_workers=args.workers)
+        val_dir = _os.path.join(args.data, "val")
+        make_val = None
+        if _os.path.isdir(val_dir):
+            make_val = lambda: image_folder_loader(
+                val_dir, args.b, image_size=args.image_size, train=False,
+                num_workers=args.workers, loop=False)
+        return train, make_val, steps
+    if _glob.glob(_os.path.join(args.data, "*.npz")):
+        return (npz_batches(args, args.steps_per_epoch), None,
+                args.steps_per_epoch)
+    raise SystemExit(f"--data {args.data}: neither train/ subdir nor .npz "
+                     "shards found")
+
+
+def lr_schedule(args, steps_per_epoch):
+    """The reference's schedule (``adjust_learning_rate``,
+    ``main_amp.py:464-500``): linear warmup over the first
+    ``--warmup-epochs``, then step decay x0.1 at ABSOLUTE epochs
+    30/60/80."""
+    import optax
+    warmup = args.warmup_epochs * steps_per_epoch
+    # join_schedules rebases the second schedule's step count to the
+    # boundary, so express the absolute-epoch decay points relative to
+    # the end of warmup
+    decay = optax.piecewise_constant_schedule(
+        args.lr, {max(e * steps_per_epoch - warmup, 1): 0.1
+                  for e in (30, 60, 80)})
+    if warmup == 0:
+        return decay
+    return optax.join_schedules(
+        [optax.linear_schedule(args.lr / max(warmup, 1), args.lr, warmup),
+         decay], [warmup])
 
 
 MEAN = np.array([0.485, 0.456, 0.406], np.float32) * 255.0
@@ -121,7 +190,10 @@ def main():
             else models.resnet.default_norm)
     model = ARCHS[args.arch](num_classes=args.num_classes, norm=norm)
 
-    tx = optax.sgd(args.lr, momentum=args.momentum)
+    batches, make_val, steps_per_epoch = make_loaders(args)
+
+    tx = optax.sgd(lr_schedule(args, steps_per_epoch),
+                   momentum=args.momentum)
     if args.weight_decay:
         tx = optax.chain(optax.add_decayed_weights(args.weight_decay), tx)
 
@@ -138,15 +210,17 @@ def main():
     opt_state = optimizer.init(params)
 
     start_epoch = 0
+    best_prec1 = 0.0
     if args.resume:
         from apex_tpu.utils import checkpoint as ckpt
         state = ckpt.restore(args.resume, {
             "params": params, "batch_stats": batch_stats,
-            "opt_state": opt_state, "epoch": 0})
+            "opt_state": opt_state, "epoch": 0, "best_prec1": 0.0})
         params, batch_stats = state["params"], state["batch_stats"]
         opt_state, start_epoch = state["opt_state"], int(state["epoch"]) + 1
-        maybe_print(f"resumed from {args.resume} at epoch {start_epoch}",
-                    rank0=True)
+        best_prec1 = float(state.get("best_prec1", 0.0))
+        maybe_print(f"resumed from {args.resume} at epoch {start_epoch} "
+                    f"(best prec@1 {best_prec1:.2f})", rank0=True)
 
     repl = NamedSharding(mesh, P())
     shard = NamedSharding(mesh, P("data"))
@@ -179,8 +253,53 @@ def main():
                          .astype(jnp.float32)) * 100
         return params, new_stats, opt_state, loss, prec1, prec5
 
-    batches = (npz_batches(args, args.steps_per_epoch) if args.data
-               else synthetic_batches(args, args.steps_per_epoch))
+    @jax.jit
+    def eval_step(params, batch_stats, x, y):
+        x = (x.astype(jnp.float32) - mean) / std
+        logits = model.apply(
+            {"params": params, "batch_stats": batch_stats}, x,
+            train=False).astype(jnp.float32)
+        top5 = jnp.argsort(logits, axis=-1)[:, -5:]
+        return (top5[:, -1] == y), jnp.any(top5 == y[:, None], axis=1)
+
+    def validate(params, batch_stats):
+        """Full prec@1/5 over the val set (reference ``validate()``,
+        ``main_amp.py:376-443``); pads ragged final batches to keep the
+        jit shape static and the batch divisible over chips."""
+        if make_val is None:
+            return None, None
+        n = c1 = c5 = 0
+        end = time.time()
+        batch_time = AverageMeter()
+        for x, y in make_val():
+            bs = x.shape[0]
+            if bs < args.b:  # pad final batch to the static step shape
+                pad = args.b - bs
+                x = np.concatenate([x, np.zeros((pad,) + x.shape[1:],
+                                                x.dtype)])
+                y = np.concatenate([y, np.full((pad,), -1, y.dtype)])
+            xd = jax.device_put(jnp.asarray(x), shard)
+            yd = jax.device_put(jnp.asarray(y), shard)
+            c1v, c5v = eval_step(params, batch_stats, xd, yd)
+            c1 += int(np.asarray(c1v)[:bs].sum())
+            c5 += int(np.asarray(c5v)[:bs].sum())
+            n += bs
+            batch_time.update(time.time() - end)
+            end = time.time()
+        prec1, prec5 = 100.0 * c1 / n, 100.0 * c5 / n
+        maybe_print(f" * Prec@1 {prec1:.3f} Prec@5 {prec5:.3f} "
+                    f"({n} images, {batch_time.avg:.3f}s/batch)",
+                    rank0=True)
+        return prec1, prec5
+
+    if args.evaluate:
+        if make_val is None:
+            raise SystemExit(
+                "--evaluate needs a validation source: an ImageFolder "
+                "--data dir with a val/ subdir, or synthetic data (no "
+                "--data)")
+        validate(params, batch_stats)
+        return
 
     if args.prof:
         profile(args, train_step, params, batch_stats, opt_state, batches,
@@ -190,7 +309,7 @@ def main():
     for epoch in range(start_epoch, args.epochs):
         batch_time, losses, top1, top5m = (AverageMeter() for _ in range(4))
         end = time.time()
-        for i in range(args.steps_per_epoch):
+        for i in range(steps_per_epoch):
             x, y = next(batches)
             x = jax.device_put(jnp.asarray(x), shard)
             y = jax.device_put(jnp.asarray(y), shard)
@@ -207,7 +326,7 @@ def main():
                 top5m.update(float(p5), args.b)
                 speed = args.b / batch_time.val if batch_time.val else 0.0
                 maybe_print(
-                    f"Epoch: [{epoch}][{i}/{args.steps_per_epoch}]\t"
+                    f"Epoch: [{epoch}][{i}/{steps_per_epoch}]\t"
                     f"Time {batch_time.val:.3f} ({batch_time.avg:.3f})\t"
                     f"Speed {speed:.1f}\t"
                     f"Loss {losses.val:.4f} ({losses.avg:.4f})\t"
@@ -215,12 +334,25 @@ def main():
                     f"Prec@5 {top5m.val:.2f} ({top5m.avg:.2f})",
                     rank0=True)
                 end = time.time()
+
+        prec1, _ = validate(params, batch_stats)
+
         if args.checkpoint_dir:
+            import os as _os
             from apex_tpu.utils import checkpoint as ckpt
-            ckpt.save(args.checkpoint_dir, {
-                "params": params, "batch_stats": batch_stats,
-                "opt_state": opt_state, "epoch": epoch})
-            maybe_print(f"saved checkpoint for epoch {epoch}", rank0=True)
+            is_best = prec1 is not None and prec1 > best_prec1
+            if is_best:
+                best_prec1 = prec1
+            state = {"params": params, "batch_stats": batch_stats,
+                     "opt_state": opt_state, "epoch": epoch,
+                     "best_prec1": best_prec1}
+            ckpt.save(_os.path.join(args.checkpoint_dir, "last"), state)
+            if is_best:  # reference's shutil.copyfile best-model pattern
+                ckpt.save(_os.path.join(args.checkpoint_dir, "best"), state)
+            maybe_print(
+                f"saved checkpoint for epoch {epoch}"
+                + (f" (new best prec@1 {best_prec1:.2f})" if is_best else ""),
+                rank0=True)
 
 
 def profile(args, train_step, params, batch_stats, opt_state, batches, shard):

@@ -111,9 +111,12 @@ def test_patch_torch_functions_alias():
 def test_disabled_passthrough():
     model, optimizer = amp.initialize(Net(), optax.sgd(0.1), enabled=False,
                                       verbosity=0)
-    params = model.init(jax.random.PRNGKey(0), jnp.ones((2, 8)))
+    # explicit f32 input: under JAX_ENABLE_X64 an untyped ones() literal is
+    # f64, and disabled amp passes whatever dtype through (correctly)
+    x = jnp.ones((2, 8), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x)
     assert all(d == jnp.float32 for d in leaf_dtypes(params).values())
-    out = model.apply(params, jnp.ones((2, 8)))
+    out = model.apply(params, x)
     assert out.dtype == jnp.float32
 
 

@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Build-matrix smoke — the analog of the reference's
+# tests/docker_extension_builds/run.sh (which installs apex with and
+# without CUDA/C++ extensions across ~7 torch docker images and collects
+# per-image exit codes).  No network in this environment, so the matrix
+# axes are the install variants expressible in-image:
+#
+#   native   — C++ host extension built and loaded (the --cpp_ext path)
+#   pyonly   — APEX_TPU_NO_NATIVE=1, pure-python fallbacks everywhere
+#   x64      — JAX_ENABLE_X64=1 (dtype-promotion hygiene)
+#
+# Each axis runs the L0 tier (the unit surface); exit codes are collected
+# and reported like the reference (:28-51).
+
+set -u
+cd "$(dirname "$0")/../.."
+
+declare -A results
+
+run_axis() {
+  local name="$1"; shift
+  echo "=== build-matrix axis: $name ==="
+  env "$@" python -m pytest tests/L0 -q -x --no-header
+  results[$name]=$?
+}
+
+run_axis native  APEX_TPU_NO_NATIVE=
+run_axis pyonly  APEX_TPU_NO_NATIVE=1
+run_axis x64     JAX_ENABLE_X64=1
+
+echo
+echo "=== build-matrix results ==="
+rc=0
+for name in "${!results[@]}"; do
+  code=${results[$name]}
+  printf '%-8s : %s\n' "$name" "$([ "$code" -eq 0 ] && echo PASS || echo "FAIL($code)")"
+  [ "$code" -ne 0 ] && rc=1
+done
+exit $rc

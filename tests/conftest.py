@@ -35,3 +35,19 @@ assert len(jax.devices()) >= 8, (
     f"test harness expected >=8 CPU devices, got {jax.devices()}")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _isolate_amp_state():
+    """amp.initialize(O1) installs process-global op patches (by design —
+    the reference patches torch namespaces the same way). Tests must not
+    leak that policy into each other: deactivate after every test."""
+    yield
+    try:
+        from apex_tpu.amp._amp_state import _amp_state
+        _amp_state.opt_properties = None
+        _amp_state.casts_disabled = False
+    except Exception:
+        pass

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from apex_tpu import amp, parallel
@@ -43,7 +43,7 @@ def test_master_params_cross_rank_consistency(mesh):
     @partial(shard_map, mesh=mesh,
              in_specs=(P(), P(), P("data"), P("data")),
              out_specs=(P(), P()),
-             check_rep=False)
+             check_vma=False)
     def train_step(params, opt_state, x, y):
         def loss_fn(p):
             logits = ddp.apply(p, x).astype(jnp.float32)
@@ -65,7 +65,7 @@ def test_master_params_cross_rank_consistency(mesh):
     # masters; all shards must be byte-identical
     @jax.jit
     @partial(shard_map, mesh=mesh, in_specs=(P(),),
-             out_specs=P("data"), check_rep=False)
+             out_specs=P("data"), check_vma=False)
     def per_rank_checksum(params):
         leaves = jax.tree_util.tree_leaves(params)
         s = sum(jnp.sum(l.astype(jnp.float64)) for l in leaves)
@@ -92,7 +92,7 @@ def test_closed_form_gradients_every_iteration(mesh):
 
     @jax.jit
     @partial(shard_map, mesh=mesh, in_specs=(P(),),
-             out_specs=P("data"), check_rep=False)
+             out_specs=P("data"), check_vma=False)
     def grad_once(w):
         r = jax.lax.axis_index("data").astype(jnp.float32)
         x = jnp.full(w.shape, r + 1.0)

@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from apex_tpu.parallel import ring_attention, ulysses_attention
@@ -52,7 +52,7 @@ def _qkv(seed=0, dtype=jnp.float32):
 def _sharded(mesh, fn, has_mask):
     specs = (P(None, "seq"),) * (4 if has_mask else 3)
     return jax.jit(shard_map(fn, mesh=mesh, in_specs=specs,
-                             out_specs=P(None, "seq"), check_rep=False))
+                             out_specs=P(None, "seq"), check_vma=False))
 
 
 @pytest.mark.parametrize("impl", [ring_attention, ulysses_attention])
@@ -187,7 +187,7 @@ def test_bert_encoder_with_ring_attention(mesh):
             mesh=mesh,
             in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq"),
                       P(None, None, None, "seq")),
-            out_specs=P(None, "seq"), check_rep=False)
+            out_specs=P(None, "seq"), check_vma=False)
         return f(q, k, v, bias)
 
     cfg = models.BertConfig(vocab_size=64, hidden_size=32,
