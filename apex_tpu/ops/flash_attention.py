@@ -238,6 +238,26 @@ def _pad_seq(x, block):
     return x
 
 
+def _union_vma(*xs):
+    """Union of the operands' varying mesh axes (shard_map's vma typing) —
+    pallas_call out_shapes must declare it explicitly under the default
+    check_vma=True."""
+    vma = set()
+    for x in xs:
+        try:
+            vma |= set(jax.typeof(x).vma)
+        except AttributeError:
+            pass
+    return frozenset(vma)
+
+
+def _out_struct(shape, dtype, vma):
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:  # older jax without vma typing
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _specs(bq, bk, d, h):
     """Common BlockSpecs for (BH, S, D)-laid-out operands.
 
@@ -261,14 +281,15 @@ def _fwd_pallas(q3, k3, v3, mask, *, scale, causal, bq, bk, h, interpret):
     nq, nk = sq // bq, sk // bk
     lanes = 128
     q_spec, k_spec, mask_spec, row_spec = _specs(bq, bk, d, h)
+    vma = _union_vma(q3, k3, v3, mask)
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nk=nk),
         grid=(bh, nq, nk),
         in_specs=[mask_spec, q_spec, k_spec, k_spec],
         out_specs=[q_spec, row_spec],
-        out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
-                   jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32)],
+        out_shape=[_out_struct((bh, sq, d), q3.dtype, vma),
+                   _out_struct((bh, 1, sq), jnp.float32, vma)],
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32),
                         pltpu.VMEM((bq, lanes), jnp.float32),
                         pltpu.VMEM((bq, lanes), jnp.float32)],
@@ -280,17 +301,23 @@ def _fwd_pallas(q3, k3, v3, mask, *, scale, causal, bq, bk, h, interpret):
 @functools.partial(jax.jit, static_argnames=("scale", "causal", "bq", "bk",
                                              "h", "interpret"))
 def _bwd_pallas(q3, k3, v3, do3, o3, lse, mask, *, scale, causal, bq, bk,
-                h, interpret):
+                h, interpret, dlse=None):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     nq, nk = sq // bq, sk // bk
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1)                         # (BH, Sq)
+    if dlse is not None:
+        # lse cotangent folds into delta: d lse/d s = p (softmax probs),
+        # so ds = p*(dov - delta + dlse) — i.e. delta' = delta - dlse,
+        # reusing the kernels unchanged
+        delta = delta - dlse.astype(jnp.float32)
     q_spec, k_spec, mask_spec, row_spec = _specs(bq, bk, d, h)
     mask3 = mask[:, None, :]
     lse3 = lse[:, None, :]
     delta3 = delta[:, None, :]
 
+    vma = _union_vma(q3, k3, v3, do3, lse3, delta3, mask3)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nk=nk),
@@ -298,7 +325,7 @@ def _bwd_pallas(q3, k3, v3, do3, o3, lse, mask, *, scale, causal, bq, bk,
         in_specs=[mask_spec, q_spec, k_spec, k_spec, q_spec, row_spec,
                   row_spec],
         out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+        out_shape=_out_struct((bh, sq, d), q3.dtype, vma),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
     )(mask3, q3, k3, v3, do3, lse3, delta3)
@@ -314,8 +341,8 @@ def _bwd_pallas(q3, k3, v3, do3, o3, lse, mask, *, scale, causal, bq, bk,
         in_specs=[dkv_mask, dkv_qspec, dkv_kspec, dkv_kspec, dkv_qspec,
                   dkv_row, dkv_row],
         out_specs=[dkv_kspec, dkv_kspec],
-        out_shape=[jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
-                   jax.ShapeDtypeStruct((bh, sk, d), v3.dtype)],
+        out_shape=[_out_struct((bh, sk, d), k3.dtype, vma),
+                   _out_struct((bh, sk, d), v3.dtype, vma)],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
@@ -327,8 +354,12 @@ def _bwd_pallas(q3, k3, v3, do3, o3, lse, mask, *, scale, causal, bq, bk,
 # public entry
 # ---------------------------------------------------------------------------
 
-def _reference(q, k, v, kv_mask, causal, scale):
-    """Pure-jnp oracle (fp32 softmax), shapes (B, S, H, D)."""
+def _reference(q, k, v, kv_mask, causal, scale, return_lse: bool = False):
+    """Pure-jnp oracle (fp32 softmax), shapes (B, S, H, D).
+
+    With ``return_lse`` also returns the per-row log-sum-exp (B, H, Sq)
+    fp32 (NEG_INF for fully-masked rows) — the merge statistic for
+    blockwise/ring combination."""
     s = _einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     if kv_mask is not None:
@@ -345,19 +376,28 @@ def _reference(q, k, v, kv_mask, causal, scale):
     out = _einsum("bhqk,bkhd->bqhd", p / jnp.maximum(den, 1e-30),
                      v.astype(jnp.float32))
     out = out * jnp.transpose(valid, (0, 2, 1, 3)).astype(out.dtype)
-    return out.astype(q.dtype)
+    out = out.astype(q.dtype)
+    if not return_lse:
+        return out
+    lse = jnp.where(valid[..., 0],
+                    m[..., 0] + jnp.log(jnp.maximum(den[..., 0], 1e-30)),
+                    NEG_INF)                         # (B, H, Sq)
+    return out, lse
 
 
-@functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, mask, causal, scale, bq, bk, interpret):
-    """``mask`` is always a concrete (B, Sk) fp32 array here (zeros when
-    the caller had none) so the VJP can return a well-typed cotangent."""
-    out, _ = _flash_fwd(q, k, v, mask, causal, scale, bq, bk, interpret)
-    return out
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_lse(q, k, v, mask, causal, scale, bq, bk, interpret):
+    """Returns ``(out, lse)`` with lse (B, H, Sq) fp32 — differentiable
+    in BOTH outputs (the lse cotangent folds into the kernels' delta
+    input, see ``_bwd_pallas``).  ``mask`` is always a concrete (B, Sk)
+    fp32 array (zeros when the caller had none) so the VJP can return a
+    well-typed cotangent."""
+    (out, lse), _ = _flash_lse_fwd(q, k, v, mask, causal, scale, bq, bk,
+                                   interpret)
+    return out, lse
 
 
-def _flash_fwd(q, k, v, mask, causal, scale, bq, bk, interpret):
+def _flash_lse_fwd(q, k, v, mask, causal, scale, bq, bk, interpret):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     q3 = _pad_seq(_layout(q), bq)
@@ -371,20 +411,53 @@ def _flash_fwd(q, k, v, mask, causal, scale, bq, bk, interpret):
     o3, lse = _fwd_pallas(q3, k3, v3, mask_p, scale=scale, causal=causal,
                           bq=bq, bk=bk, h=h, interpret=interpret)
     out = _unlayout(o3[:, :sq], b, h)
-    return out, (q3, k3, v3, o3, lse, mask_p, b, h, sq, sk)
+    lse_pub = lse[:, :sq].reshape(b, h, sq)
+    return (out, lse_pub), (q3, k3, v3, o3, lse, mask_p, b, h, sq, sk)
 
 
-def _flash_bwd(causal, scale, bq, bk, interpret, res, g):
+def _flash_lse_bwd(causal, scale, bq, bk, interpret, res, g):
+    do, dlse = g
     q3, k3, v3, o3, lse, mask_p, b, h, sq, sk = res
-    do3 = _pad_seq(_layout(g), bq)
+    do3 = _pad_seq(_layout(do), bq)
+    dlse3 = None
+    if dlse is not None:
+        sq_pad = q3.shape[1]
+        dlse3 = dlse.astype(jnp.float32).reshape(b * h, sq)
+        if sq_pad != sq:
+            dlse3 = jnp.pad(dlse3, ((0, 0), (0, sq_pad - sq)))
     dq3, dk3, dv3 = _bwd_pallas(q3, k3, v3, do3, o3, lse, mask_p,
                                 scale=scale, causal=causal, bq=bq, bk=bk,
-                                h=h, interpret=interpret)
+                                h=h, interpret=interpret, dlse=dlse3)
     dq = _unlayout(dq3[:, :sq], b, h)
     dk = _unlayout(dk3[:, :sk], b, h)
     dv = _unlayout(dv3[:, :sk], b, h)
     dmask = jnp.zeros((b, sk), jnp.float32)  # masks are not trained
     return dq, dk, dv, dmask
+
+
+_flash_lse.defvjp(lambda q, k, v, m, causal, scale, bq, bk, interp:
+                  _flash_lse_fwd(q, k, v, m, causal, scale, bq, bk,
+                                 interp),
+                  _flash_lse_bwd)
+
+
+# out-only variant: same fwd/bwd machinery with the lse output discarded
+# (one implementation to keep in sync, not two)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, mask, causal, scale, bq, bk, interpret):
+    out, _ = _flash_fwd(q, k, v, mask, causal, scale, bq, bk, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, mask, causal, scale, bq, bk, interpret):
+    (out, _), res = _flash_lse_fwd(q, k, v, mask, causal, scale, bq, bk,
+                                   interpret)
+    return out, res
+
+
+def _flash_bwd(causal, scale, bq, bk, interpret, res, do):
+    return _flash_lse_bwd(causal, scale, bq, bk, interpret, res,
+                          (do, None))
 
 
 _flash.defvjp(lambda q, k, v, m, causal, scale, bq, bk, interp:
@@ -396,7 +469,8 @@ def flash_attention(q, k, v, *, kv_mask: Optional[jax.Array] = None,
                     causal: bool = False, scale: Optional[float] = None,
                     block_q: int = 128, block_k: int = 128,
                     use_pallas: Optional[bool] = None,
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None,
+                    return_lse: bool = False):
     """Memory-efficient exact attention.
 
     Args:
@@ -407,6 +481,10 @@ def flash_attention(q, k, v, *, kv_mask: Optional[jax.Array] = None,
       block_q, block_k: VMEM tile sizes (multiples of 128 recommended).
       use_pallas: None = auto (Pallas kernels on TPU, jnp oracle off-TPU).
       interpret: force Pallas interpret mode (defaults to not-on-TPU).
+      return_lse: also return the per-row log-sum-exp (B, H, Sq) fp32
+        (NEG_INF for fully-masked rows) — the statistic for combining
+        blockwise partial attentions (ring attention's merge); both
+        outputs are differentiable.
 
     Differentiable (custom VJP with recompute — no (Sq, Sk) tensor ever
     hits HBM in either pass).
@@ -415,11 +493,15 @@ def flash_attention(q, k, v, *, kv_mask: Optional[jax.Array] = None,
         scale = 1.0 / math.sqrt(q.shape[-1])
     use = on_tpu() if use_pallas is None else use_pallas
     if not use or not _HAS_PALLAS:
-        return _reference(q, k, v, kv_mask, causal, scale)
+        return _reference(q, k, v, kv_mask, causal, scale,
+                          return_lse=return_lse)
     if interpret is None:
         interpret = not on_tpu()
     mask = (jnp.zeros((q.shape[0], k.shape[1]), jnp.float32)
             if kv_mask is None else kv_mask.astype(jnp.float32))
+    if return_lse:
+        return _flash_lse(q, k, v, mask, causal, float(scale),
+                          int(block_q), int(block_k), bool(interpret))
     return _flash(q, k, v, mask, causal, float(scale), int(block_q),
                   int(block_k), bool(interpret))
 

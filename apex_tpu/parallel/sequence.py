@@ -34,13 +34,29 @@ import jax.numpy as jnp
 from jax import lax
 
 from apex_tpu.ops.flash_attention import bias_to_kv_mask as _bias_to_kv_mask
-from apex_tpu.ops.pallas_utils import unpatched
+from apex_tpu.ops.flash_attention import flash_attention
+from apex_tpu.ops.pallas_utils import on_tpu, unpatched
 
 NEG_INF = -1e30  # large-negative fp32 (not -inf: keeps exp/where NaN-free)
 
 # fp32-accumulation einsum, immune to amp O1's half-list patch (ring
 # attention upcasts scores/probabilities to fp32 deliberately)
 _einsum = unpatched(jnp.einsum)
+
+
+def _vary_like(x, *refs, extra_axes=()):
+    """Broadcast ``x``'s varying-axes type to the union of ``refs``' (plus
+    ``extra_axes``, e.g. the ring axis ppermute will introduce) — needed
+    so lax.cond/scan branches built from constants type-check under
+    shard_map's vma tracking. No-op outside shard_map."""
+    try:
+        target = set(extra_axes)
+        for r in refs:
+            target |= set(jax.typeof(r).vma)
+        missing = tuple(sorted(target - set(jax.typeof(x).vma)))
+    except AttributeError:
+        return x
+    return lax.pcast(x, missing, to="varying") if missing else x
 
 
 def _online_block_update(m, den, acc, scores, v):
@@ -63,7 +79,9 @@ def _online_block_update(m, den, acc, scores, v):
 def ring_attention(q, k, v, *, axis_name: str,
                    kv_mask: Optional[jax.Array] = None,
                    causal: bool = False,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None,
+                   use_flash: Optional[bool] = None,
+                   flash_kwargs: Optional[dict] = None):
     """Exact attention over a sequence sharded on ``axis_name``.
 
     Args:
@@ -75,10 +93,26 @@ def ring_attention(q, k, v, *, axis_name: str,
       causal: apply causal masking using global positions (shard offsets
         from ``lax.axis_index``).
       scale: logit scale; defaults to 1/sqrt(D).
+      use_flash: compute each ring hop with the Pallas flash kernel
+        (``return_lse`` merge) instead of materializing the local
+        (S_local, S_local) score block — O(block) VMEM per hop. None =
+        auto (flash on TPU, jnp blocks elsewhere).
+      flash_kwargs: forwarded to :func:`flash_attention` (block sizes,
+        ``interpret`` for tests — note interpret-mode pallas inside
+        shard_map requires ``check_vma=False``: jax's pallas HLO
+        interpreter cannot type varying axes yet; the compiled TPU path
+        type-checks under default vma checking).
 
     Returns (B, S_local, H, D) in q's dtype. Gradients flow through the
     ppermute rotations, so the backward pass is itself a ring program.
     """
+    if use_flash is None:
+        use_flash = on_tpu()
+    if use_flash:
+        return _ring_attention_flash(q, k, v, axis_name=axis_name,
+                                     kv_mask=kv_mask, causal=causal,
+                                     scale=scale,
+                                     flash_kwargs=flash_kwargs or {})
     n = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
@@ -96,19 +130,10 @@ def ring_attention(q, k, v, *, axis_name: str,
     # type its outputs will have: the accumulators inherit the union of the
     # inputs' varying axes (e.g. `data` AND the ring axis on a hybrid
     # DP x SP mesh), plus the ring axis itself from ppermute.
-    try:
-        _target_vma = set(jax.typeof(q).vma) | set(jax.typeof(k).vma) \
-            | set(jax.typeof(v).vma) | {axis_name}
-        if has_mask:
-            _target_vma |= set(jax.typeof(kv_mask).vma)
-    except AttributeError:
-        _target_vma = None
+    _refs = (q, k, v, kv_mask) if has_mask else (q, k, v)
 
     def _vary(x):
-        if _target_vma is None:
-            return x
-        missing = tuple(sorted(_target_vma - set(jax.typeof(x).vma)))
-        return lax.pcast(x, missing, to="varying") if missing else x
+        return _vary_like(x, *_refs, extra_axes=(axis_name,))
 
     q_pos = my_idx * s_local + jnp.arange(s_local)    # global q positions
 
@@ -152,17 +177,106 @@ def ring_attention(q, k, v, *, axis_name: str,
     return out.astype(q.dtype)
 
 
+def _ring_attention_flash(q, k, v, *, axis_name, kv_mask, causal, scale,
+                          flash_kwargs):
+    """Ring attention with the flash kernel per hop.
+
+    Each hop runs :func:`flash_attention` with ``return_lse`` on the
+    local (q, KV-block) pair, and blocks merge through the exact
+    log-sum-exp combination ``out = sum_i o_i * exp(lse_i - LSE)`` —
+    never materializing a score block even on-chip beyond the kernel's
+    VMEM tiles. Under global causal masking a hop is one of three static
+    programs selected by ring position (src == my: local-diagonal causal
+    flash; src < my: unmasked flash; src > my: skip — the classic ring
+    causal work-split, here the skip also saves the whole kernel)."""
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    has_mask = kv_mask is not None
+    if has_mask:
+        kv_mask = kv_mask.astype(jnp.float32)
+
+    def flash(k_blk, v_blk, mask_blk, is_causal):
+        return flash_attention(q, k_blk, v_blk, kv_mask=mask_blk,
+                               causal=is_causal, scale=scale,
+                               return_lse=True, **flash_kwargs)
+
+    def merge(acc, acc_lse, o_blk, lse_blk):
+        # exact normalized-block combination: weights exp(lse_i - LSE)
+        new_lse = jnp.logaddexp(acc_lse, lse_blk)      # (B, H, Sq)
+        w_a = jnp.exp(acc_lse - new_lse)
+        w_b = jnp.exp(lse_blk - new_lse)
+        t = lambda w: jnp.transpose(w, (0, 2, 1))[..., None]
+        acc = acc * t(w_a) + o_blk.astype(jnp.float32) * t(w_b)
+        return acc, new_lse
+
+    # step 0: the local diagonal block (the only causal-masked hop)
+    o0, lse0 = flash(k, v, kv_mask, causal)
+    acc = o0.astype(jnp.float32)
+    acc_lse = lse0
+
+    def rotate(x):
+        return lax.ppermute(x, axis_name, perm)
+
+    k_blk, v_blk = rotate(k), rotate(v)
+    mask_blk = rotate(kv_mask) if has_mask else None
+
+    def skip_outputs():
+        o = _vary_like(jnp.zeros(q.shape, q.dtype), q, k_blk)
+        lse = _vary_like(jnp.full((b, h, s_local), NEG_INF, jnp.float32),
+                         q, k_blk)
+        return o, lse
+
+    def body(carry, step):
+        if has_mask:
+            k_blk, v_blk, mask_blk, acc, acc_lse = carry
+        else:
+            k_blk, v_blk, acc, acc_lse = carry
+            mask_blk = None
+        src = (my_idx - step) % n
+        if causal:
+            # src > my: every key is in this query shard's future
+            o_blk, lse_blk = lax.cond(
+                src < my_idx,
+                lambda k_, v_, m_: flash(k_, v_, m_, False),
+                lambda k_, v_, m_: skip_outputs(),
+                k_blk, v_blk,
+                mask_blk if has_mask else jnp.zeros((b, s_local),
+                                                    jnp.float32))
+        else:
+            o_blk, lse_blk = flash(k_blk, v_blk, mask_blk, False)
+        acc2, acc_lse2 = merge(acc, acc_lse, o_blk, lse_blk)
+        k2, v2 = rotate(k_blk), rotate(v_blk)
+        if has_mask:
+            return (k2, v2, rotate(mask_blk), acc2, acc_lse2), None
+        return (k2, v2, acc2, acc_lse2), None
+
+    init = ((k_blk, v_blk, mask_blk, acc, acc_lse) if has_mask
+            else (k_blk, v_blk, acc, acc_lse))
+    carry_out, _ = lax.scan(body, init, jnp.arange(1, n))
+    acc, acc_lse = carry_out[-2:]
+
+    valid = jnp.transpose(acc_lse > NEG_INF / 2, (0, 2, 1))[..., None]
+    return jnp.where(valid, acc, 0.0).astype(q.dtype)
+
+
 def ulysses_attention(q, k, v, *, axis_name: str,
                       kv_mask: Optional[jax.Array] = None,
                       causal: bool = False,
                       scale: Optional[float] = None,
-                      attention_impl: Optional[Callable] = None):
+                      attention_impl: Optional[Callable] = None,
+                      use_flash: Optional[bool] = None,
+                      flash_kwargs: Optional[dict] = None):
     """All-to-all sequence parallelism (the "Ulysses" pattern).
 
     Input shards (B, S_local, H, D) with H divisible by the axis size.
     ``lax.all_to_all`` swaps the sharded dimension: each chip ends up with
     the FULL sequence for H/n heads, runs ordinary full attention locally
-    (``attention_impl`` hook, default exact softmax attention), and swaps
+    (``attention_impl`` hook; default = flash kernel on TPU, exact jnp
+    softmax attention elsewhere — ``use_flash`` overrides), and swaps
     back. ``kv_mask`` is the local (B, S_local) additive key mask.
     """
     n = lax.psum(1, axis_name)
@@ -173,6 +287,8 @@ def ulysses_attention(q, k, v, *, axis_name: str,
             "attention_impl owns its own logit scaling")
     if scale is None:
         scale = 1.0 / math.sqrt(d)
+    if use_flash is None:
+        use_flash = attention_impl is None and on_tpu()
 
     def to_heads(x):
         # (B, S_local, H, D) -> (B, S_global, H/n, D)
@@ -186,11 +302,18 @@ def ulysses_attention(q, k, v, *, axis_name: str,
     qg, kg, vg = to_heads(q), to_heads(k), to_heads(v)
     s_global = s_local * n
 
-    bias = None
+    mask_g = None
     if kv_mask is not None:
-        bias = lax.all_gather(kv_mask.astype(jnp.float32), axis_name,
-                              axis=1, tiled=True)      # (B, S_global)
-        bias = bias[:, None, None, :]
+        mask_g = lax.all_gather(kv_mask.astype(jnp.float32), axis_name,
+                                axis=1, tiled=True)    # (B, S_global)
+
+    if attention_impl is None and use_flash:
+        # local full attention IS flash_attention's contract exactly
+        out = flash_attention(qg, kg, vg, kv_mask=mask_g, causal=causal,
+                              scale=scale, **(flash_kwargs or {}))
+        return to_seq(out)
+
+    bias = mask_g[:, None, None, :] if mask_g is not None else None
     if causal:
         pos = jnp.arange(s_global)
         cmask = jnp.where(pos[:, None] >= pos[None, :], 0.0, NEG_INF)
@@ -206,7 +329,13 @@ def ulysses_attention(q, k, v, *, axis_name: str,
             scores = scores + bias
         probs = jax.nn.softmax(scores, axis=-1)
         out = _einsum("bhqk,bkhd->bqhd", probs,
-                         vg.astype(jnp.float32)).astype(q.dtype)
+                         vg.astype(jnp.float32))
+        # fully-masked rows emit zeros, matching flash_attention and the
+        # ring path (a uniform softmax over mask offsets is garbage)
+        valid = jnp.max(scores, axis=-1) > NEG_INF / 2    # (B, H, Sq)
+        out = jnp.where(jnp.transpose(valid, (0, 2, 1))[..., None],
+                        out, 0.0)
+        out = out.astype(q.dtype)
     return to_seq(out)
 
 
