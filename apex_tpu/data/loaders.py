@@ -140,7 +140,7 @@ def _decode_eval(path: str, image_size: int):
 def image_folder_loader(root: str, batch_size: int, image_size: int = 224,
                         train: bool = True, shuffle: Optional[bool] = None,
                         seed: int = 0, num_workers: int = 8,
-                        loop: bool = True):
+                        loop: bool = True, samples=None):
     """Stream (x uint8 NHWC, y int32) batches from a torchvision-style
     image folder using a PIL decode pool — the real-data input path the
     reference gets from ``datasets.ImageFolder`` + ``DataLoader`` workers
@@ -148,9 +148,11 @@ def image_folder_loader(root: str, batch_size: int, image_size: int = 224,
 
     ``train`` picks the transform (RandomResizedCrop+flip vs
     Resize+CenterCrop).  ``loop=False`` yields one pass (validation) with
-    a final short batch.
+    a final short batch.  ``samples`` (from :func:`_list_image_folder`)
+    skips re-scanning a directory tree the caller already listed.
     """
-    samples, _ = _list_image_folder(root)  # eager: bad root fails HERE
+    if samples is None:
+        samples, _ = _list_image_folder(root)  # eager: bad root fails HERE
     if train and len(samples) < batch_size:
         # the drop-ragged-tail rule below would otherwise yield NOTHING
         # and (with loop=True) spin forever

@@ -302,6 +302,9 @@ def main():
         if result["value"] > 0 and time.perf_counter() - START < BUDGET_S:
             ceiling_ips, _, _ = measure("O3", batch, image_size, iters)
             result["vs_baseline"] = round(result["value"] / ceiling_ips, 3)
+        else:
+            ERRORS.append("O3: skipped (budget exceeded or O2 failed); "
+                          "vs_baseline=0.0 is NOT a measured ratio")
     except Exception as e:
         _note("O3", e)
 

@@ -131,11 +131,11 @@ def make_loaders(args):
     if _os.path.isdir(train_dir):  # ImageFolder layout (reference default)
         from apex_tpu.data import image_folder_loader
         from apex_tpu.data.loaders import _list_image_folder
-        n_train = len(_list_image_folder(train_dir)[0])
-        steps = max(1, n_train // args.b)
+        train_samples = _list_image_folder(train_dir)[0]  # one scan
+        steps = max(1, len(train_samples) // args.b)
         train = image_folder_loader(
             train_dir, args.b, image_size=args.image_size, train=True,
-            num_workers=args.workers)
+            num_workers=args.workers, samples=train_samples)
         val_dir = _os.path.join(args.data, "val")
         make_val = None
         if _os.path.isdir(val_dir):

@@ -1,0 +1,52 @@
+"""Tracing/profiling utilities — the nvtx-analog surface (SURVEY §5)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.utils import trace_annotation
+from apex_tpu.utils.profiling import (annotate_function, start_trace,
+                                      stop_trace)
+
+
+def test_trace_annotation_wraps_computation():
+    with trace_annotation("forward"):
+        y = jnp.ones((4,)) * 2
+    np.testing.assert_allclose(np.asarray(y), 2.0)
+
+
+def test_trace_annotation_inside_jit():
+    @jax.jit
+    def step(x):
+        with trace_annotation("matmul"):
+            return x @ x.T
+
+    out = step(jnp.ones((4, 4)))
+    np.testing.assert_allclose(np.asarray(out), 4.0)
+
+
+def test_annotate_function_decorator():
+    @annotate_function(name="square")
+    def square(x):
+        return x * x
+
+    out = jax.jit(square)(jnp.asarray(3.0))
+    assert float(out) == 9.0
+
+
+def test_start_stop_trace_produces_artifacts(tmp_path):
+    """start/stop around a jitted computation must produce a trace dir
+    (the cudaProfilerStart/Stop round-trip of the race test,
+    reference ddp_race_condition_test.py:44,66)."""
+    logdir = str(tmp_path / "trace")
+    start_trace(logdir)
+    try:
+        jax.block_until_ready(jax.jit(lambda x: x + 1)(jnp.ones((8,))))
+    finally:
+        stop_trace()
+    found = []
+    for root, _, files in os.walk(logdir):
+        found += files
+    assert found, "no trace artifacts written"

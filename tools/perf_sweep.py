@@ -1,0 +1,104 @@
+"""Perf sweep on real hardware: find the fastest configurations for the
+headline benchmark and the flash kernels.
+
+Complements ``bench.py`` (which reports ONE headline line for the driver):
+this sweeps the knobs that move single-chip throughput and prints one JSON
+line per point, so block sizes / batch sizes can be chosen from data
+rather than defaults.
+
+Usage: ``python tools/perf_sweep.py [--quick]``
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402  (robust backend init + builders live there)
+
+
+def sweep_resnet(batches, iters):
+    for b in batches:
+        try:
+            ips, step_ms, flops = bench.measure("O2", b, 224, iters)
+            row = {"sweep": "resnet50_O2", "batch": b,
+                   "images_per_sec": round(ips, 1),
+                   "step_time_ms": round(step_ms, 2)}
+            if flops:
+                row["step_tflops"] = round(flops / 1e12, 3)
+            print(json.dumps(row), flush=True)
+        except Exception as e:
+            print(json.dumps({"sweep": "resnet50_O2", "batch": b,
+                              "error": f"{type(e).__name__}: {e}"}),
+                  flush=True)
+
+
+def sweep_flash(blocks, iters):
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu.ops.flash_attention import flash_attention
+
+    b, s, h, d = 4, 2048, 8, 64
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q, k, v = (jax.random.normal(kk, (b, s, h, d), jnp.bfloat16)
+               for kk in ks)
+    flops = 3.5 * 4 * b * h * s * s * d * 0.5  # fwd+bwd, causal
+
+    for bq in blocks:
+        for bk in blocks:
+            try:
+                @jax.jit
+                def fwd_bwd(q, k, v):
+                    f = lambda q, k, v: flash_attention(
+                        q, k, v, causal=True, use_pallas=True,
+                        interpret=False, block_q=bq,
+                        block_k=bk).astype(jnp.float32).sum()
+                    return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+                g = fwd_bwd(q, k, v)
+                jax.block_until_ready(g)
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    g = fwd_bwd(q, k, v)
+                jax.block_until_ready(g)
+                dt = (time.perf_counter() - t0) / iters
+                print(json.dumps({
+                    "sweep": "flash_fwd_bwd", "block_q": bq, "block_k": bk,
+                    "ms": round(dt * 1e3, 2),
+                    "tflops": round(flops / dt / 1e12, 2)}), flush=True)
+            except Exception as e:
+                print(json.dumps({"sweep": "flash_fwd_bwd", "block_q": bq,
+                                  "block_k": bk,
+                                  "error": f"{type(e).__name__}: {e}"}),
+                      flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="fewer points / iterations")
+    args = ap.parse_args()
+
+    platform, err = bench.init_backend()
+    print(json.dumps({"platform": platform, "error": err}), flush=True)
+    on_tpu = platform == "tpu"
+    if not on_tpu:
+        print(json.dumps({"note": "no TPU; sweep skipped"}))
+        return
+
+    iters = 5 if args.quick else 20
+    sweep_resnet([128] if args.quick else [64, 128, 256], iters)
+    sweep_flash([128] if args.quick else [128, 256, 512],
+                3 if args.quick else 10)
+    try:
+        print(json.dumps({"sweep": "fused_adam",
+                          **bench.bench_fused_adam()}), flush=True)
+    except Exception as e:
+        print(json.dumps({"sweep": "fused_adam",
+                          "error": f"{type(e).__name__}: {e}"}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
