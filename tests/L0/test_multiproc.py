@@ -1,10 +1,6 @@
 """Multi-host bootstrap env mapping + local launcher
 (reference ``apex/parallel/multiproc.py`` behavior)."""
 
-import os
-import subprocess
-import sys
-
 import pytest
 
 from apex_tpu.parallel import multiproc
