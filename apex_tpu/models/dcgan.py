@@ -60,6 +60,12 @@ class Discriminator(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = True):
+        if x.shape[1] != 64 or x.shape[2] != 64:
+            # the DCGAN topology (4 stride-2 convs + a 4x4 VALID head) is
+            # 64px-specific; other sizes silently collapse to 0-dim maps
+            raise ValueError(
+                f"DCGAN discriminator expects 64x64 inputs, got "
+                f"{x.shape[1]}x{x.shape[2]}")
         f = self.base_features
         x = nn.Conv(f, (4, 4), (2, 2), padding=1, use_bias=False,
                     kernel_init=dcgan_init)(x)

@@ -290,6 +290,7 @@ def main():
     try:
         ips, step_ms, flops = measure("O2", batch, image_size, iters)
         result["value"] = round(ips, 1)
+        result["batch"] = batch
         result["step_time_ms"] = round(step_ms, 2)
         if flops and peak and on_tpu:
             result["mfu"] = round(flops / (step_ms / 1e3) / peak, 4)
@@ -298,9 +299,37 @@ def main():
         _note("O2", e)
         traceback.print_exc(file=sys.stderr)
 
+    # bigger batch often lifts MFU; try it and keep the better number
+    # (headline = best achieved throughput, like the reference's Speed)
+    if on_tpu and result["value"] > 0 and \
+            time.perf_counter() - START < BUDGET_S - 120:
+        try:
+            ips2, step_ms2, flops2 = measure("O2", batch * 2, image_size,
+                                             iters)
+            result.setdefault("extras", {})["O2_batch_sweep"] = {
+                str(batch): result["value"],
+                str(batch * 2): round(ips2, 1)}
+            if ips2 > result["value"]:
+                result["value"] = round(ips2, 1)
+                result["batch"] = batch * 2
+                result["step_time_ms"] = round(step_ms2, 2)
+                # never leave batch-128 mfu/tflops next to batch-256
+                # timings: recompute or drop
+                result.pop("mfu", None)
+                result.pop("step_tflops", None)
+                if flops2 and peak:
+                    result["mfu"] = round(
+                        flops2 / (step_ms2 / 1e3) / peak, 4)
+                    result["step_tflops"] = round(flops2 / 1e12, 3)
+        except Exception as e:
+            _note("O2_batch_sweep", e)
+
     try:
         if result["value"] > 0 and time.perf_counter() - START < BUDGET_S:
-            ceiling_ips, _, _ = measure("O3", batch, image_size, iters)
+            # same batch as the reported O2 number: the speed-of-light
+            # ratio is only meaningful like-for-like
+            ceiling_ips, _, _ = measure("O3", result.get("batch", batch),
+                                        image_size, iters)
             result["vs_baseline"] = round(result["value"] / ceiling_ips, 3)
         else:
             ERRORS.append("O3: skipped (budget exceeded or O2 failed); "
@@ -308,7 +337,7 @@ def main():
     except Exception as e:
         _note("O3", e)
 
-    extras = {}
+    extras = result.get("extras", {})
     if on_tpu and time.perf_counter() - START < BUDGET_S:
         try:
             extras["flash_attention"] = bench_flash_attention()
