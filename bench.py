@@ -287,14 +287,21 @@ def main():
 
     peak = next((v for key, v in PEAK_BF16 if key in kind.lower()), None)
 
-    try:
-        ips, step_ms, flops = measure("O2", batch, image_size, iters)
+    def record_o2(ips, step_ms, flops, b):
+        """All headline fields from ONE measurement — value, batch,
+        timing, and mfu/tflops always agree with each other."""
         result["value"] = round(ips, 1)
-        result["batch"] = batch
+        result["batch"] = b
         result["step_time_ms"] = round(step_ms, 2)
+        result.pop("mfu", None)
+        result.pop("step_tflops", None)
         if flops and peak and on_tpu:
             result["mfu"] = round(flops / (step_ms / 1e3) / peak, 4)
             result["step_tflops"] = round(flops / 1e12, 3)
+
+    try:
+        ips, step_ms, flops = measure("O2", batch, image_size, iters)
+        record_o2(ips, step_ms, flops, batch)
     except Exception as e:
         _note("O2", e)
         traceback.print_exc(file=sys.stderr)
@@ -310,17 +317,7 @@ def main():
                 str(batch): result["value"],
                 str(batch * 2): round(ips2, 1)}
             if ips2 > result["value"]:
-                result["value"] = round(ips2, 1)
-                result["batch"] = batch * 2
-                result["step_time_ms"] = round(step_ms2, 2)
-                # never leave batch-128 mfu/tflops next to batch-256
-                # timings: recompute or drop
-                result.pop("mfu", None)
-                result.pop("step_tflops", None)
-                if flops2 and peak:
-                    result["mfu"] = round(
-                        flops2 / (step_ms2 / 1e3) / peak, 4)
-                    result["step_tflops"] = round(flops2 / 1e12, 3)
+                record_o2(ips2, step_ms2, flops2, batch * 2)
         except Exception as e:
             _note("O2_batch_sweep", e)
 

@@ -1,0 +1,99 @@
+"""Unit tests for the imagenet example's pure logic: lr schedule and
+data routing (reference ``adjust_learning_rate`` semantics,
+``examples/imagenet/main_amp.py:464-500``)."""
+
+import importlib.util
+import os
+import types
+
+import numpy as np
+import pytest
+
+_SPEC = importlib.util.spec_from_file_location(
+    "imagenet_main_amp",
+    os.path.join(os.path.dirname(__file__), "..", "..", "examples",
+                 "imagenet", "main_amp.py"))
+example = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(example)
+
+
+def _args(**kw):
+    a = types.SimpleNamespace(
+        data=None, b=12, image_size=32, num_classes=3, workers=2,
+        steps_per_epoch=4, val_steps=2, lr=0.1, warmup_epochs=5)
+    for k, v in kw.items():
+        setattr(a, k, v)
+    return a
+
+
+class TestLrSchedule:
+    def test_decays_at_absolute_epochs(self):
+        """x0.1 at epochs 30/60/80 measured from step 0, NOT from the end
+        of warmup (regression: join_schedules rebases the second
+        schedule's step count)."""
+        spe = 100
+        sched = example.lr_schedule(_args(), spe)
+        assert np.isclose(float(sched(30 * spe - 10)), 0.1, rtol=1e-5)
+        assert np.isclose(float(sched(30 * spe + 10)), 0.01, rtol=1e-5)
+        assert np.isclose(float(sched(60 * spe + 10)), 0.001, rtol=1e-5)
+        assert np.isclose(float(sched(80 * spe + 10)), 1e-4, rtol=1e-5)
+
+    def test_linear_warmup(self):
+        spe = 100
+        sched = example.lr_schedule(_args(), spe)
+        w = 5 * spe
+        assert float(sched(0)) < 0.01
+        assert np.isclose(float(sched(w // 2)), 0.05, rtol=0.02)
+        assert np.isclose(float(sched(w)), 0.1, rtol=1e-5)
+
+    def test_no_warmup(self):
+        sched = example.lr_schedule(_args(warmup_epochs=0), 100)
+        assert np.isclose(float(sched(0)), 0.1, rtol=1e-5)
+        assert np.isclose(float(sched(3500)), 0.01, rtol=1e-5)
+
+
+class TestMakeLoaders:
+    def test_synthetic_default(self):
+        train, make_val, steps = example.make_loaders(_args())
+        x, y = next(train)
+        assert x.shape == (12, 32, 32, 3) and steps == 4
+        assert make_val is not None
+        vals = list(make_val())
+        assert len(vals) == 2
+        # hermetic: the synthetic val set is identical across calls
+        v2 = list(make_val())
+        np.testing.assert_array_equal(vals[0][0], v2[0][0])
+
+    def test_image_folder_routing(self, tmp_path):
+        from PIL import Image
+        rng = np.random.RandomState(0)
+        for split, n in (("train", 8), ("val", 4)):
+            for cls in ("a", "b"):
+                d = tmp_path / split / cls
+                d.mkdir(parents=True)
+                for i in range(n):
+                    Image.fromarray(
+                        rng.randint(0, 255, (36, 36, 3), dtype=np.uint8)
+                    ).save(d / f"{i}.jpg")
+        train, make_val, steps = example.make_loaders(
+            _args(data=str(tmp_path)))
+        assert steps == 16 // 12  # floor(n_train / batch)
+        x, y = next(train)
+        assert x.shape == (12, 32, 32, 3)
+        assert make_val is not None
+        total = sum(x.shape[0] for x, _ in make_val())
+        assert total == 8  # full val pass
+
+    def test_npz_routing(self, tmp_path):
+        np.savez(tmp_path / "shard0.npz",
+                 x=np.zeros((24, 32, 32, 3), np.uint8),
+                 y=np.zeros((24,), np.int32))
+        train, make_val, steps = example.make_loaders(
+            _args(data=str(tmp_path)))
+        assert make_val is None  # npz path has no val set
+        x, y = next(train)
+        assert x.shape == (12, 32, 32, 3)
+
+    def test_bad_data_dir_raises(self, tmp_path):
+        with pytest.raises(SystemExit, match="neither"):
+            example.make_loaders(_args(data=str(tmp_path)))
