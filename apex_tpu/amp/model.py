@@ -29,6 +29,7 @@ across steps params change anyway.
 
 from __future__ import annotations
 
+import contextlib
 import re
 from typing import Any, Callable, Optional, Sequence
 
@@ -55,6 +56,18 @@ NORM_PATTERNS = BATCHNORM_PATTERNS + (r"LayerNorm", r"GroupNorm", r"RMSNorm",
 
 def _path_matches(path, patterns) -> bool:
     names = [str(getattr(p, "key", getattr(p, "name", p))) for p in path]
+    return any(re.search(pat, name) for pat in patterns for name in names)
+
+
+def _module_matches(module, patterns) -> bool:
+    """Does a flax module instance look like a kept-fp32 norm layer?
+    Checked against both the class name (BatchNorm, SyncBatchNorm, ...)
+    and the instance name (stem_bn, downsample_bn, ...) so it agrees with
+    the param-path policy in ``_path_matches``."""
+    names = [type(module).__name__]
+    inst = getattr(module, "name", None)
+    if inst:
+        names.append(str(inst))
     return any(re.search(pat, name) for pat in patterns for name in names)
 
 
@@ -173,6 +186,58 @@ class AmpModel:
         kwargs = {k: applier(v, cast) for k, v in kwargs.items()}
         return args, kwargs
 
+    def _norm_output_recast(self):
+        """Context manager installing a flax method interceptor that casts
+        kept-fp32 norm layers' *outputs* back to the half compute dtype.
+
+        Without it, flax's dtype promotion silently drags everything
+        downstream of a fp32 BatchNorm up to fp32 — including every conv —
+        because ``bf16 x  op  f32 scale -> f32`` propagates.  The reference
+        does not have this problem: torch's batch_norm with half input and
+        fp32 weight emits *half* (``fp16_utils/fp16util.py:22-33`` keeps BN
+        fp32 precisely because mixed-dtype BN works there).  The interceptor
+        restores those semantics: statistics and affine params stay exactly
+        fp32 (flax computes stats in fp32 internally regardless), only the
+        returned activation is recast, so the convs stay on the MXU in
+        bf16.  Perf-critical: without this, amp O2 ResNet runs its convs in
+        fp32 and MFU collapses."""
+        import flax.linen as nn
+
+        half = self.half_dtype
+        patterns = self.keep_fp32_patterns
+
+        def recast(x):
+            if hasattr(x, "dtype") and hasattr(x, "astype") and \
+                    x.dtype == jnp.float32:
+                return x.astype(half)
+            return x
+
+        def interceptor(next_fun, args, kwargs, context):
+            out = next_fun(*args, **kwargs)
+            # recast only modules that are kept fp32 AND look like norm
+            # layers: a user-supplied keep_fp32_patterns entry (e.g. a
+            # final classifier kept fp32 for logit accuracy) must keep
+            # its fp32 output — the seam mend is for norms only
+            if context.method_name == "__call__" and \
+                    _module_matches(context.module, patterns) and \
+                    _module_matches(context.module, NORM_PATTERNS):
+                out = jax.tree.map(recast, out)
+            return out
+
+        return nn.intercept_methods(interceptor)
+
+    def _apply_context(self):
+        """Interceptor scope for ``apply``: active only when compute casting
+        is on AND some params are deliberately kept fp32 (so there is a
+        dtype seam to mend)."""
+        import flax.linen as nn
+
+        if (self._compute_cast_needed() and self.keep_fp32_patterns
+                and not _amp_state._amp_state.casts_disabled
+                and isinstance(self.module, nn.Module)):
+            return self._norm_output_recast()
+        return contextlib.nullcontext()
+
     # -- flax-like surface ------------------------------------------------
     def init(self, rngs, *args, **kwargs) -> Pytree:
         args, kwargs = self.cast_inputs(args, kwargs)
@@ -183,7 +248,8 @@ class AmpModel:
         variables = self.compute_variables(variables)
         args, kwargs = self.cast_inputs(args, kwargs)
         if hasattr(self.module, "apply"):
-            return self.module.apply(variables, *args, **kwargs)
+            with self._apply_context():
+                return self.module.apply(variables, *args, **kwargs)
         return self.module(variables, *args, **kwargs)
 
     def __call__(self, variables: Pytree, *args, **kwargs):

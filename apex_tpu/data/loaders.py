@@ -219,10 +219,14 @@ def prefetch_to_device(iterator, size: int = 2, sharding=None):
         q.put(batch)
 
     def producer():
+        # a loader exception must surface at the consumer's next(), with
+        # its original traceback — not vanish into a bare StopIteration
         try:
             for batch in iterator:
                 put(batch)
-        finally:
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            q.put(e)
+        else:
             q.put(_END)
 
     t = threading.Thread(target=producer, daemon=True)
@@ -231,4 +235,6 @@ def prefetch_to_device(iterator, size: int = 2, sharding=None):
         item = q.get()
         if item is _END:
             return
+        if isinstance(item, BaseException):
+            raise item
         yield item

@@ -196,12 +196,14 @@ def bench_flash_attention(iters=5):
                 interpret=False).astype(jnp.float32).sum()
             l, grads = jax.value_and_grad(f, argnums=(0, 1, 2))(q, k, v)
             return l, grads
+        # NOTE: block_until_ready is a no-op through the axon plugin;
+        # a scalar host fetch is the only reliable sync
         l, g = fwd_bwd(q, k, v)
-        jax.block_until_ready(g)
+        float(l)
         t0 = time.perf_counter()
         for _ in range(iters):
             l, g = fwd_bwd(q, k, v)
-        jax.block_until_ready(g)
+        float(l)
         return (time.perf_counter() - t0) / iters
 
     t_pallas = timed(True)
@@ -237,15 +239,20 @@ def bench_fused_adam(iters=20):
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def run(params, state, grads):
             return step_fn(params, grads, state)
+        def sync(tree):
+            # block_until_ready is a no-op through the axon plugin; fetch
+            # one element to force completion of the step
+            float(jax.tree.leaves(tree)[0].ravel()[0])
+
         # fresh copies: donation consumes them, and `params` is shared
         # across the fused/optax runs
         p = jax.tree.map(jnp.copy, params)
         p, s = run(p, state, grads)
-        jax.block_until_ready(p)
+        sync(p)
         t0 = time.perf_counter()
         for _ in range(iters):
             p, s = run(p, s, grads)
-        jax.block_until_ready(p)
+        sync(p)
         return (time.perf_counter() - t0) / iters * 1e3
 
     fused = optimizers.FusedAdam(lr=1e-3)

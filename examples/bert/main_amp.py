@@ -92,8 +92,9 @@ def main():
 
     attention_fn = None
     if sp:
-        from jax.experimental.shard_map import shard_map
         from apex_tpu.parallel import make_ring_attention
+
+        shard_map = jax.shard_map
 
         ring_fn = make_ring_attention("sp")
 

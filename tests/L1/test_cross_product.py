@@ -49,9 +49,11 @@ def test_fused_vs_python_parity(opt_level):
     is covered bitwise-tight by the L0 kernel tests)."""
     py = run_training(opt_level=opt_level, use_pallas=False, steps=6)
     fused = run_training(opt_level=opt_level, use_pallas=True, steps=6)
-    # O3 keeps params in bf16 (no fp32 masters), which amplifies the
-    # reduction-order deltas between the two paths step over step
-    tol = 1e-2 if opt_level == "O3" else 1e-3
+    # under O1-O3 activations run genuinely bf16 end-to-end (incl. past
+    # the kept-fp32 norms — the output-recast seam), so the two paths'
+    # differing reduction orders quantize differently and trajectories
+    # drift ~1e-3/step; O0 runs pure fp32 and stays tight
+    tol = 1e-2 if opt_level != "O0" else 1e-3
     np.testing.assert_allclose(fused["losses"], py["losses"],
                                rtol=tol, atol=tol)
     fa = np.concatenate([x.astype(np.float32).ravel()

@@ -88,5 +88,7 @@ def test_fused_vs_python_parity_distributed():
                                   use_pallas=False, steps=4)
     fused = run_training_distributed(opt_level="O2", mode="gspmd",
                                      use_pallas=True, steps=4)
-    np.testing.assert_allclose(fused["losses"], py["losses"], rtol=1e-3,
-                               atol=1e-3)
+    # bf16 activations end-to-end (see test_cross_product): the two
+    # kernel paths' trajectories drift ~1e-3/step
+    np.testing.assert_allclose(fused["losses"], py["losses"], rtol=1e-2,
+                               atol=1e-2)
