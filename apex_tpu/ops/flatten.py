@@ -47,13 +47,25 @@ def _spec_for(leaves: Sequence[jax.Array]) -> Tuple[tuple, list, tuple]:
     return shapes, sizes, offsets
 
 
-def flatten(tree: Pytree, dtype=None):
+def _pad_flat(flat: jax.Array, pad_to: int) -> jax.Array:
+    """Zero-pad a 1-D buffer so its length is a multiple of ``pad_to``
+    (makes the buffer evenly shardable across mesh axes whose size
+    divides ``pad_to`` — the ZeRO-1 layout, ``parallel.zero``)."""
+    if pad_to > 1 and flat.shape[0] % pad_to:
+        extra = pad_to - flat.shape[0] % pad_to
+        flat = jnp.concatenate([flat, jnp.zeros((extra,), flat.dtype)])
+    return flat
+
+
+def flatten(tree: Pytree, dtype=None, pad_to: int = 1):
     """Concatenate all leaves of ``tree`` into one 1-D array.
 
     Returns ``(flat, spec)``. If ``dtype`` is None the leaves are cast to the
     widest leaf dtype (mirroring apex's requirement that flattened lists are
     same-dtype — ``split_half_float_double`` at ``distributed.py:51`` exists
     precisely because torch's flatten can't mix; here we just promote).
+    ``pad_to``: zero-pad the buffer length to a multiple (``spec.total``
+    stays the logical element count; :func:`unflatten` ignores the tail).
     """
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     if not leaves:
@@ -61,13 +73,16 @@ def flatten(tree: Pytree, dtype=None):
     if dtype is None:
         dtype = jnp.result_type(*[x.dtype for x in leaves])
     shapes, sizes, offsets = _spec_for(leaves)
-    flat = jnp.concatenate([x.astype(dtype).reshape(-1) for x in leaves])
+    flat = _pad_flat(
+        jnp.concatenate([x.astype(dtype).reshape(-1) for x in leaves]),
+        pad_to)
     spec = FlatSpec(treedef, shapes, tuple(x.dtype for x in leaves), offsets,
                     int(sum(sizes)))
     return flat, spec
 
 
-def flatten_grouped(tree: Pytree, group_ids: Sequence[int], dtype=None):
+def flatten_grouped(tree: Pytree, group_ids: Sequence[int], dtype=None,
+                    pad_to: int = 1):
     """Like :func:`flatten`, but lay the buffer out group-by-group so each
     group is one contiguous slice (see ``FlatSpec.perm``/``group_bounds``).
 
@@ -97,14 +112,15 @@ def flatten_grouped(tree: Pytree, group_ids: Sequence[int], dtype=None):
                 offsets[i] = cursor
                 cursor += sizes[i]
         group_bounds.append((start, cursor - start))
-    flat = jnp.concatenate(
-        [leaves[i].astype(dtype).reshape(-1) for i in perm])
+    flat = _pad_flat(jnp.concatenate(
+        [leaves[i].astype(dtype).reshape(-1) for i in perm]), pad_to)
     spec = FlatSpec(treedef, shapes, tuple(x.dtype for x in leaves),
                     tuple(offsets), cursor, perm, tuple(group_bounds))
     return flat, spec
 
 
-def flatten_like(tree: Pytree, spec: FlatSpec, dtype=None) -> jax.Array:
+def flatten_like(tree: Pytree, spec: FlatSpec, dtype=None,
+                 pad_to: int = 1) -> jax.Array:
     """Flatten ``tree`` (matching ``spec``'s structure) without rebuilding
     spec, honoring the spec's (possibly grouped) buffer layout."""
     leaves = jax.tree_util.tree_leaves(tree)
@@ -114,7 +130,9 @@ def flatten_like(tree: Pytree, spec: FlatSpec, dtype=None) -> jax.Array:
         dtype = jnp.result_type(*[x.dtype for x in leaves])
     if spec.perm:
         leaves = [leaves[i] for i in spec.perm]
-    return jnp.concatenate([x.astype(dtype).reshape(-1) for x in leaves])
+    return _pad_flat(
+        jnp.concatenate([x.astype(dtype).reshape(-1) for x in leaves]),
+        pad_to)
 
 
 def unflatten(flat: jax.Array, spec: FlatSpec, *, cast_back: bool = True) -> Pytree:

@@ -70,7 +70,11 @@ class FP16_Optimizer:
         self.verbose = verbose
 
     def init(self, params_half: Pytree) -> FP16OptimizerState:
-        master, spec = flatten(params_half, dtype=jnp.float32)
+        # pad the master like the inner optimizer pads its moments, so
+        # ZeRO-1 (parallel.shard_optimizer_state) can shard ALL the big
+        # buffers, master included
+        master, spec = flatten(params_half, dtype=jnp.float32,
+                               pad_to=getattr(self.optimizer, "pad_to", 128))
         return FP16OptimizerState(
             master=master,
             inner=self.optimizer.init(_FlatParams(master)),

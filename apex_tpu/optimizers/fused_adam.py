@@ -143,13 +143,22 @@ class FusedAdam:
     group over flat memory (no per-leaf launches, no extra HBM traffic).
 
     ``use_pallas``: None = auto (Pallas on TPU, jnp elsewhere).
+
+    ``pad_to``: zero-pad the flat state buffers to a length multiple, so
+    they shard evenly across mesh axes whose size divides it (ZeRO-1
+    layout via ``parallel.shard_optimizer_state``; no reference analog —
+    its flat masters are replicated per rank,
+    ``apex/optimizers/fp16_optimizer.py:61-67``). Default 128 covers
+    every power-of-two axis up to 128 at the cost of <=127 extra
+    elements; the padding tail is zeros and stays zeros.
     """
 
     def __init__(self, lr: float = 1e-3, bias_correction: bool = True,
                  betas: Tuple[float, float] = (0.9, 0.999), eps: float = 1e-8,
                  eps_inside_sqrt: bool = False, weight_decay: float = 0.0,
                  max_grad_norm: float = 0.0, amsgrad: bool = False,
-                 use_pallas: Optional[bool] = None, param_groups=None):
+                 use_pallas: Optional[bool] = None, param_groups=None,
+                 pad_to: int = 128):
         if amsgrad:
             raise RuntimeError("FusedAdam does not support the AMSGrad "
                                "variant.")
@@ -161,6 +170,7 @@ class FusedAdam:
         self.weight_decay = weight_decay
         self.max_grad_norm = max_grad_norm
         self.use_pallas = use_pallas
+        self.pad_to = pad_to
         self.param_groups = list(param_groups) if param_groups else []
         if self.param_groups:
             from apex_tpu.optimizers.param_groups import validate_specs
@@ -180,7 +190,7 @@ class FusedAdam:
             # group_bounds aligns with group_hparams
             ids = tuple(ids)
             flat, spec = flatten_grouped(
-                params, ids, dtype=jnp.float32)
+                params, ids, dtype=jnp.float32, pad_to=self.pad_to)
             n_groups = len(self.param_groups) + 1
             if len(spec.group_bounds) < n_groups:  # trailing empty groups
                 bounds = list(spec.group_bounds)
@@ -188,7 +198,8 @@ class FusedAdam:
                     bounds.append((spec.total, 0))
                 spec = spec._replace(group_bounds=tuple(bounds))
         else:
-            flat, spec = flatten(params, dtype=jnp.float32)
+            flat, spec = flatten(params, dtype=jnp.float32,
+                                 pad_to=self.pad_to)
         return FusedAdamState(step=jnp.asarray(0, jnp.int32),
                               m=jnp.zeros_like(flat),
                               v=jnp.zeros_like(flat), spec=spec)
@@ -215,7 +226,7 @@ class FusedAdam:
             weight_decay=self.weight_decay,
             max_grad_norm=self.max_grad_norm, use_pallas=self.use_pallas,
             param_groups=[dict(match=match, **overrides)]
-            + self.param_groups)
+            + self.param_groups, pad_to=self.pad_to)
         new_state = new_opt.init(params)
         # carry over moments by leaf path (old layout -> new layout)
         old_m = unflatten(state.m, state.spec, cast_back=False)
@@ -239,8 +250,10 @@ class FusedAdam:
         v_tree = jax.tree_util.tree_unflatten(treedef, v_leaves)
         return new_opt, FusedAdamState(
             step=state.step,
-            m=flatten_like(m_tree, new_state.spec, dtype=jnp.float32),
-            v=flatten_like(v_tree, new_state.spec, dtype=jnp.float32),
+            m=flatten_like(m_tree, new_state.spec, dtype=jnp.float32,
+                           pad_to=self.pad_to),
+            v=flatten_like(v_tree, new_state.spec, dtype=jnp.float32,
+                           pad_to=self.pad_to),
             spec=new_state.spec)
 
     def update(self, grads: Pytree, state: FusedAdamState,
@@ -322,8 +335,20 @@ class FusedAdam:
 
     def _step_flat(self, params, grads, state: FusedAdamState, scale,
                    grad_norm):
-        p = flatten_like(params, state.spec, dtype=jnp.float32)
-        g = flatten_like(grads, state.spec, dtype=jnp.float32)
+        # pad p/g (independently — a pre-padded params tree arrives at
+        # full length while grads may not) to the state buffers' length,
+        # not self.pad_to: a state restored from a checkpoint must keep
+        # ITS layout
+        buf_len = state.m.shape[0]
+
+        def to_buf_len(x):
+            if x.shape[0] < buf_len:
+                x = jnp.concatenate(
+                    [x, jnp.zeros((buf_len - x.shape[0],), jnp.float32)])
+            return x
+
+        p = to_buf_len(flatten_like(params, state.spec, dtype=jnp.float32))
+        g = to_buf_len(flatten_like(grads, state.spec, dtype=jnp.float32))
         step = state.step + 1
         use_pallas = self.use_pallas if self.use_pallas is not None \
             else on_tpu()

@@ -28,6 +28,10 @@ from apex_tpu.parallel.sequence import (
     ring_attention,
     ulysses_attention,
 )
+from apex_tpu.parallel.zero import (
+    shard_optimizer_state,
+    unshard_optimizer_state,
+)
 
 
 def create_syncbn_process_group(group_size: int, axis_name: str = "data",
@@ -53,6 +57,8 @@ __all__ = [
     "make_ulysses_attention",
     "merge_stats",
     "ring_attention",
+    "shard_optimizer_state",
     "ulysses_attention",
+    "unshard_optimizer_state",
     "welford_combine",
 ]

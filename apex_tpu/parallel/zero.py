@@ -1,0 +1,91 @@
+"""ZeRO-1-style optimizer-state sharding over a mesh axis.
+
+The reference replicates its flat fp32 master/moment buffers on every
+rank (``apex/optimizers/fp16_optimizer.py:67`` — "flat master weights"
+are per-GPU copies; ZeRO postdates it).  On TPU the same memory win is a
+one-liner rather than a runtime subsystem: the optimizer state is a
+pytree of flat fp32 buffers (``FusedAdamState.m/v``, FP16_Optimizer
+masters), so *placing those buffers sharded across the data axis* makes
+XLA compile the optimizer update shard-local and insert exactly the
+ZeRO-1 collectives (reduce-scatter of grads into the update, all-gather
+of fresh params) — no wrapper class, no manual bucketing.
+
+Usage::
+
+    opt_state = optimizer.init(params)
+    opt_state = zero.shard_optimizer_state(opt_state, mesh, axis="data")
+    # jit as usual; donate opt_state so the sharded buffers update in place
+
+Memory: Adam moments are 8 bytes/param replicated; sharded over an
+8-device axis they drop to 1 byte/param/device — at ResNet-50 scale
+~180 MB/device, at BERT-large ~2.5 GB/device of HBM back.
+
+Two contracts:
+
+1. the train step must be jitted over the SAME mesh so GSPMD can honor
+   the placement (a ``with mesh:`` scope or explicit shardings);
+2. the optimizer update must be expressed in partitionable ops.  The
+   pure-jnp Adam path is (elementwise ops partition shard-local for
+   free); the Pallas kernel is a *single-chip* optimization whose
+   ``tpu_custom_call`` carries no GSPMD partitioning rule — under a
+   sharded state XLA re-gathers its operands, defeating the memory win.
+   So pair ZeRO with ``FusedAdam(use_pallas=False)`` on TPU; the
+   elementwise update is HBM-bandwidth-bound either way, and XLA fuses
+   the jnp form into one sharded loop.
+
+Works for any optimizer state pytree; scalars and sub-axis-length
+leaves stay replicated.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Pytree = Any
+
+
+def shard_optimizer_state(opt_state: Pytree, mesh: Mesh,
+                          axis: str = "data") -> Pytree:
+    """Place large leaves of ``opt_state`` sharded along ``axis`` (dim 0),
+    everything else replicated.
+
+    A leaf is sharded when its leading dim holds at least one element per
+    device on ``axis`` — covers the flat fp32 m/v/master buffers (the
+    whole point) while leaving step counters, loss-scale scalars, and
+    tiny vectors replicated.  Returns a new state pytree; pass it through
+    the jitted step with donation and the sharding sticks for the life of
+    training.
+    """
+    n = mesh.shape[axis]
+    sharded = NamedSharding(mesh, P(axis))
+    repl = NamedSharding(mesh, P())
+
+    def place(x):
+        # device_put demands exact divisibility; FusedAdam's default
+        # pad_to=128 guarantees it for power-of-two axes, and per-leaf
+        # states (FusedLAMB, optax) shard leaf-by-leaf where they can
+        if hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] >= n \
+                and x.shape[0] % n == 0:
+            return jax.device_put(x, sharded)
+        if hasattr(x, "ndim"):
+            return jax.device_put(x, repl)
+        return x  # static aux (FlatSpec et al.) passes through
+
+    return jax.tree_util.tree_map(place, opt_state)
+
+
+def unshard_optimizer_state(opt_state: Pytree, mesh: Mesh) -> Pytree:
+    """Gather a sharded state back to replicated layout (checkpoint
+    save paths that want single-host arrays)."""
+    repl = NamedSharding(mesh, P())
+
+    def place(x):
+        if hasattr(x, "ndim"):
+            return jax.device_put(x, repl)
+        return x
+
+    return jax.tree_util.tree_map(place, opt_state)

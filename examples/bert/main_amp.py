@@ -43,6 +43,10 @@ def parse_args():
                    help="shard attention over SP-way sequence parallelism "
                    "(hybrid DP x SP mesh; SP must divide the device count "
                    "and --seq-len)")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize encoder layers in backward "
+                   "(jax.checkpoint): ~33%% more FLOPs for O(layers) "
+                   "less activation HBM — for long --seq-len")
     return p.parse_args()
 
 
@@ -72,6 +76,9 @@ def synthetic_mlm_batch(rng, args, cfg):
 def main():
     args = parse_args()
     cfg = get_config(args.config)
+    if args.remat:
+        import dataclasses
+        cfg = dataclasses.replace(cfg, remat=True)
 
     devices = jax.devices()
     n_dev = len(devices)

@@ -238,3 +238,33 @@ def test_bert_trains_with_fused_lamb():
         g = jax.grad(loss_fn)(params)
         params, state = opt.step(params, g, state)
     assert float(loss_fn(params)) < l0
+
+
+def test_bert_remat_matches_no_remat():
+    """cfg.remat must change memory scheduling only: identical params
+    (same init), identical outputs, identical grads."""
+    import dataclasses
+
+    kw = dict(vocab_size=100, hidden_size=32, num_hidden_layers=2,
+              num_attention_heads=4, intermediate_size=64,
+              max_position_embeddings=16)
+    cfg = models.BertConfig(**kw)
+    cfg_r = dataclasses.replace(cfg, remat=True)
+    ids = jnp.ones((2, 8), jnp.int32)
+
+    enc, enc_r = models.BertEncoder(cfg), models.BertEncoder(cfg_r)
+    v = enc.init(jax.random.PRNGKey(0), ids)
+    v_r = enc_r.init(jax.random.PRNGKey(0), ids)
+    for a, b in zip(jax.tree.leaves(v), jax.tree.leaves(v_r)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def loss(m, vv):
+        return m.apply(vv, ids).astype(jnp.float32).sum()
+
+    out, out_r = enc.apply(v, ids), enc_r.apply(v_r, ids)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out_r))
+    g = jax.jit(jax.grad(lambda vv: loss(enc, vv)))(v)
+    g_r = jax.jit(jax.grad(lambda vv: loss(enc_r, vv)))(v_r)
+    for a, b in zip(jax.tree.leaves(g), jax.tree.leaves(g_r)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6,
+                                   atol=1e-7)

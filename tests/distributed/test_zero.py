@@ -1,0 +1,114 @@
+"""ZeRO-1 optimizer-state sharding: numerics identical to replicated,
+sharding sticks across jitted steps, memory actually partitioned.
+
+The reference replicates flat master/moment buffers per rank
+(``apex/optimizers/fp16_optimizer.py:67``); sharding them over the data
+axis is the TPU-native extension.  The invariant that matters: placement
+must change WHERE the update runs, never WHAT it computes.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from apex_tpu import amp, parallel
+from apex_tpu.models import MLP
+from apex_tpu.optimizers import FusedAdam
+
+NDEV = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return Mesh(np.asarray(jax.devices()[:NDEV]), ("data",))
+
+
+def _setup(seed=0):
+    model, optimizer = amp.initialize(
+        MLP(features=(32, 32, 10)), FusedAdam(lr=1e-2),
+        opt_level="O2", verbosity=0)
+    x = jax.random.normal(jax.random.PRNGKey(seed), (16, 8))
+    y = jax.random.randint(jax.random.PRNGKey(seed + 1), (16,), 0, 10)
+    params = model.init(jax.random.PRNGKey(2), x)["params"]
+    opt_state = optimizer.init(params)
+
+    def train_step(params, opt_state, x, y):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, x)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), y).mean()
+            with amp.scale_loss(loss, opt_state) as scaled:
+                return scaled, loss
+        grads, loss = jax.grad(loss_fn, has_aux=True)(params)
+        params, opt_state = optimizer.step(params, grads, opt_state)
+        return params, opt_state, loss
+
+    return model, optimizer, train_step, params, opt_state, x, y
+
+
+def test_sharded_state_matches_replicated(mesh):
+    _, _, train_step, params, opt_state, x, y = _setup()
+
+    # replicated run
+    step = jax.jit(train_step)
+    p_r, s_r = params, opt_state
+    for _ in range(4):
+        p_r, s_r, loss_r = step(p_r, s_r, x, y)
+
+    # ZeRO run: same data, state sharded over the axis
+    shard = NamedSharding(mesh, P("data"))
+    repl = NamedSharding(mesh, P())
+    p_z = jax.device_put(params, repl)
+    s_z = parallel.shard_optimizer_state(opt_state, mesh)
+    x_z = jax.device_put(x, shard)
+    y_z = jax.device_put(y, shard)
+    with mesh:
+        for _ in range(4):
+            p_z, s_z, loss_z = step(p_z, s_z, x_z, y_z)
+
+    # sharded execution splits the bf16 batch reductions per device (psum
+    # of partial sums) — same math, different association; the deltas pass
+    # through Adam's m/sqrt(v) normalization, so allow ~1e-4-absolute
+    # trajectory drift over the 4 steps
+    np.testing.assert_allclose(float(loss_z), float(loss_r), rtol=1e-4)
+    for a, b in zip(jax.tree.leaves(p_r), jax.tree.leaves(p_z)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), rtol=2e-3,
+                                   atol=5e-4)
+
+
+def test_sharding_sticks_and_partitions_memory(mesh):
+    _, _, train_step, params, opt_state, x, y = _setup()
+    s_z = parallel.shard_optimizer_state(opt_state, mesh)
+
+    # moments are sharded across the axis; a shard holds 1/NDEV of the
+    # buffer (amp wraps the FusedAdam state in AmpOptimizerState.inner)
+    m = s_z.inner.m
+    assert len(m.sharding.spec) == 1 and m.sharding.spec[0] == "data"
+    local = m.addressable_shards[0].data
+    assert local.shape[0] * NDEV <= m.shape[0] + NDEV * 128
+    # step counter stays replicated
+    assert s_z.inner.step.sharding.is_fully_replicated
+
+    step = jax.jit(train_step)
+    with mesh:
+        p, s2, _ = step(jax.device_put(params, NamedSharding(mesh, P())),
+                        s_z, jax.device_put(x, NamedSharding(mesh, P("data"))),
+                        jax.device_put(y, NamedSharding(mesh, P("data"))))
+    # the jitted step preserves the ZeRO placement — no silent gather
+    assert s2.inner.m.sharding.spec == s_z.inner.m.sharding.spec
+    assert s2.inner.v.sharding.spec == s_z.inner.v.sharding.spec
+
+
+def test_unshard_roundtrip(mesh):
+    _, _, _, params, opt_state, _, _ = _setup()
+    s_z = parallel.shard_optimizer_state(opt_state, mesh)
+    s_back = parallel.unshard_optimizer_state(s_z, mesh)
+    assert s_back.inner.m.sharding.is_fully_replicated
+    np.testing.assert_array_equal(np.asarray(s_back.inner.m),
+                                  np.asarray(opt_state.inner.m))

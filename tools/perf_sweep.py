@@ -56,13 +56,15 @@ def sweep_flash(blocks, iters):
                         q, k, v, causal=True, use_pallas=True,
                         interpret=False, block_q=bq,
                         block_k=bk).astype(jnp.float32).sum()
-                    return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
-                g = fwd_bwd(q, k, v)
-                jax.block_until_ready(g)
+                    return jax.value_and_grad(f, argnums=(0, 1, 2))(q, k, v)
+                # scalar host fetch: block_until_ready is a no-op
+                # through the axon plugin
+                l, g = fwd_bwd(q, k, v)
+                float(l)
                 t0 = time.perf_counter()
                 for _ in range(iters):
-                    g = fwd_bwd(q, k, v)
-                jax.block_until_ready(g)
+                    l, g = fwd_bwd(q, k, v)
+                float(l)
                 dt = (time.perf_counter() - t0) / iters
                 print(json.dumps({
                     "sweep": "flash_fwd_bwd", "block_q": bq, "block_k": bk,
