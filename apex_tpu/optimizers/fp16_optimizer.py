@@ -74,7 +74,7 @@ class FP16_Optimizer:
         # ZeRO-1 (parallel.shard_optimizer_state) can shard ALL the big
         # buffers, master included
         master, spec = flatten(params_half, dtype=jnp.float32,
-                               pad_to=getattr(self.optimizer, "pad_to", 128))
+                               pad_to=self.optimizer.pad_to)
         return FP16OptimizerState(
             master=master,
             inner=self.optimizer.init(_FlatParams(master)),

@@ -1,0 +1,208 @@
+"""Multi-model x multi-optimizer x multi-loss amp protocol — the analog
+of the reference's largest L0 suite
+(``tests/L0/run_amp/test_multiple_models_optimizers_losses.py:45-760``):
+2-3 models, 2 losses with per-loss scalers, 1-2 optimizers, infs
+injected into chosen (loss, iteration) points, checking
+
+- which optimizer skips which step (shared-model gradient coupling
+  propagates an overflow to every optimizer whose params it poisons),
+- which loss scaler halves (only the overflowed loss's),
+- and that trained params track an fp32 reference trajectory that
+  applies the same skip pattern.
+
+The reference drives this through ``handle.scale_loss(loss, [opts],
+loss_id=...)`` + per-optimizer patched steps; here the same protocol is
+the functional triple ``amp.scale`` / ``unscale_grads(loss_id)`` /
+``apply_gradients``.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu import amp
+
+D = 8
+LR = 0.05
+INIT_SCALE = 2.0 ** 16
+
+
+class Net(nn.Module):
+    """Tiny regressor; distinct instances play model0/model1/model2
+    (reference MyModel, :16-34)."""
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(16)(x)
+        x = nn.relu(x)
+        return nn.Dense(1)(x)
+
+
+def _data(seed=0, n=8):
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    return (jax.random.normal(k1, (n, D)),
+            jax.random.normal(k2, (n, 1)))
+
+
+def _mse(pred, tgt):
+    return jnp.mean((pred.astype(jnp.float32) - tgt) ** 2)
+
+
+def _init(model, seed):
+    return model.init(jax.random.PRNGKey(seed), jnp.ones((1, D)))
+
+
+def _leaves_close(a, b, rtol, atol):
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_allclose(np.asarray(x, np.float32),
+                                   np.asarray(y, np.float32),
+                                   rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("opt_level", ["O1", "O2"])
+@pytest.mark.parametrize("inject", [None, (1, 0), (2, 1)])
+def test_2models_2losses_1optimizer(opt_level, inject):
+    """Reference :45-168. One optimizer owns both models; either loss
+    overflowing skips the joint step and halves only that scaler."""
+    (mA, mB), optimizer = amp.initialize(
+        [Net(), Net()], optax.sgd(LR), opt_level=opt_level,
+        num_losses=2, verbosity=0)
+    params = {"A": _init(mA, 1), "B": _init(mB, 2)}
+    opt_state = optimizer.init(params)
+    x, tgt = _data()
+
+    @jax.jit
+    def step(params, opt_state, x0, x1):
+        def loss0(p):
+            return amp.scale(_mse(mA.apply(p["A"], x0), tgt), opt_state,
+                             loss_id=0)
+
+        def loss1(p):
+            return amp.scale(_mse(mB.apply(p["B"], x1), tgt), opt_state,
+                             loss_id=1)
+
+        g0 = jax.grad(loss0)(params)
+        g1 = jax.grad(loss1)(params)
+        g0, ov0, st = optimizer.unscale_grads(g0, opt_state, 0)
+        g1, ov1, st = optimizer.unscale_grads(g1, st, 1)
+        merged = jax.tree_util.tree_map(lambda a, b: a + b, g0, g1)
+        return optimizer.apply_gradients(params, merged, st, ov0 | ov1)
+
+    # fp32 reference applies the same updates, skipping injected steps
+    ref = jax.tree_util.tree_map(lambda x: jnp.asarray(x, jnp.float32),
+                                 params)
+
+    def ref_step(p, x0, x1):
+        g0 = jax.grad(lambda q: _mse(mA.unwrapped.apply(q["A"], x0), tgt))(p)
+        g1 = jax.grad(lambda q: _mse(mB.unwrapped.apply(q["B"], x1), tgt))(p)
+        return jax.tree_util.tree_map(lambda a, b0, b1: a - LR * (b0 + b1),
+                                      p, g0, g1)
+
+    steps = 4
+    for i in range(steps):
+        x0 = x1 = x
+        if inject is not None and i == inject[0]:
+            bad = x.at[0, 0].set(jnp.inf)
+            x0, x1 = (bad, x) if inject[1] == 0 else (x, bad)
+        else:
+            ref = ref_step(ref, x, x)
+        params, opt_state = step(params, opt_state, x0, x1)
+
+    if inject is None:
+        assert int(opt_state.skipped_steps) == 0
+        assert int(opt_state.applied_steps) == steps
+        for s in opt_state.loss_scalers:
+            assert float(s.loss_scale) == INIT_SCALE
+    else:
+        assert int(opt_state.skipped_steps) == 1
+        assert int(opt_state.applied_steps) == steps - 1
+        hit, miss = inject[1], 1 - inject[1]
+        assert float(opt_state.loss_scalers[hit].loss_scale) == \
+            INIT_SCALE / 2
+        assert float(opt_state.loss_scalers[miss].loss_scale) == INIT_SCALE
+    tol = dict(rtol=0.05, atol=5e-3)
+    _leaves_close(params, ref, **tol)
+
+
+@pytest.mark.parametrize("opt_level", ["O1", "O2"])
+def test_2models_2losses_2optimizers_independent_skip(opt_level):
+    """Reference :326-514. Disjoint ownership: an inf in loss0 skips only
+    optimizer0's step and halves only scaler0; optimizer1 proceeds."""
+    (mA, mB), (optA, optB) = amp.initialize(
+        [Net(), Net()], [optax.sgd(LR), optax.sgd(LR)],
+        opt_level=opt_level, verbosity=0)
+    pA, pB = _init(mA, 1), _init(mB, 2)
+    sA, sB = optA.init(pA), optB.init(pB)
+    x, tgt = _data()
+
+    @jax.jit
+    def step(pA, pB, sA, sB, x0, x1):
+        gA = jax.grad(lambda p: amp.scale(_mse(mA.apply(p, x0), tgt), sA))(pA)
+        gB = jax.grad(lambda p: amp.scale(_mse(mB.apply(p, x1), tgt), sB))(pB)
+        gA, ovA, sA2 = optA.unscale_grads(gA, sA)
+        gB, ovB, sB2 = optB.unscale_grads(gB, sB)
+        pA2, sA2 = optA.apply_gradients(pA, gA, sA2, ovA)
+        pB2, sB2 = optB.apply_gradients(pB, gB, sB2, ovB)
+        return pA2, pB2, sA2, sB2
+
+    bad = x.at[0, 0].set(jnp.inf)
+    for i in range(3):
+        x0 = bad if i == 1 else x
+        pA, pB, sA, sB = step(pA, pB, sA, sB, x0, x)
+
+    assert int(sA.skipped_steps) == 1 and int(sA.applied_steps) == 2
+    assert int(sB.skipped_steps) == 0 and int(sB.applied_steps) == 3
+    assert float(sA.loss_scalers[0].loss_scale) == INIT_SCALE / 2
+    assert float(sB.loss_scalers[0].loss_scale) == INIT_SCALE
+
+
+@pytest.mark.parametrize("opt_level", ["O1", "O2"])
+def test_3models_2losses_2optimizers_shared_model_coupling(opt_level):
+    """Reference :516-760. modelC participates in BOTH losses and belongs
+    to optimizer0: an inf in loss1 poisons C's gradient too, so BOTH
+    optimizers skip — but only scaler1 halves."""
+    (mA, mB, mC), (opt0, opt1) = amp.initialize(
+        [Net(), Net(), Net()], [optax.sgd(LR), optax.sgd(LR)],
+        opt_level=opt_level, num_losses=2, verbosity=0)
+    p0 = {"A": _init(mA, 1), "C": _init(mC, 3)}   # optimizer0 owns A, C
+    p1 = {"B": _init(mB, 2)}                      # optimizer1 owns B
+    s0, s1 = opt0.init(p0), opt1.init(p1)
+    x, tgt = _data()
+
+    @jax.jit
+    def step(p0, p1, s0, s1, x0, x1):
+        # loss0 = f(A, C); loss1 = g(B, C)
+        def loss0(q0):
+            out = mA.apply(q0["A"], x0) + mC.apply(q0["C"], x0)
+            return amp.scale(_mse(out, tgt), s0, loss_id=0)
+
+        def loss1(q0, q1):
+            out = mB.apply(q1["B"], x1) + mC.apply(q0["C"], x1)
+            return amp.scale(_mse(out, tgt), s0, loss_id=1)
+
+        g0_from0 = jax.grad(loss0)(p0)
+        g0_from1, g1 = jax.grad(loss1, argnums=(0, 1))(p0, p1)
+        u0a, ov0, s0b = opt0.unscale_grads(g0_from0, s0, 0)
+        u0b, ov1, s0b = opt0.unscale_grads(g0_from1, s0b, 1)
+        g0 = jax.tree_util.tree_map(lambda a, b: a + b, u0a, u0b)
+        u1, ov1b, s1b = opt1.unscale_grads(g1, s1)
+        p0n, s0b = opt0.apply_gradients(p0, g0, s0b, ov0 | ov1)
+        p1n, s1b = opt1.apply_gradients(p1, u1, s1b, ov1b)
+        return p0n, p1n, s0b, s1b
+
+    bad = x.at[0, 0].set(jnp.inf)
+    for i in range(3):
+        x1 = bad if i == 1 else x
+        p0, p1, s0, s1 = step(p0, p1, s0, s1, x, x1)
+
+    # both optimizers skipped the poisoned iteration...
+    assert int(s0.skipped_steps) == 1 and int(s0.applied_steps) == 2
+    assert int(s1.skipped_steps) == 1 and int(s1.applied_steps) == 2
+    # ...but only loss1's scaler halved (loss0 saw clean grads)
+    assert float(s0.loss_scalers[0].loss_scale) == INIT_SCALE
+    assert float(s0.loss_scalers[1].loss_scale) == INIT_SCALE / 2
+    assert float(s1.loss_scalers[0].loss_scale) == INIT_SCALE / 2
