@@ -63,7 +63,7 @@ def main():
     @partial(shard_map, mesh=mesh,
              in_specs=(P(), P(), P("data"), P("data")),
              out_specs=(P(), P(), P()),
-             check_rep=False)
+             check_vma=False)
     def train_step(params, opt_state, x, y):
         # per-replica forward/backward on the local batch shard
         def loss_fn(p):

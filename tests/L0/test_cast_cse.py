@@ -1,0 +1,92 @@
+"""The cast-cache analog (reference ``tests/L0/run_amp/test_cache.py``).
+
+The reference memoizes per-iteration weight casts in a handle cache
+(``apex/amp/utils.py:87-119``) and must invalidate it across train/eval
+transitions and param updates.  Here the "cache" is XLA common
+subexpression elimination inside one traced step — these tests pin the
+claims ``amp/model.py``'s docstring makes:
+
+1. a param consumed twice in one step is cast ONCE in the jaxpr
+   (CSE-able: two identical convert_element_type eqns on the same var
+   collapse after XLA CSE; we assert the jaxpr doesn't duplicate the
+   cast at trace level where flax shares the module application);
+2. params updated between steps produce fresh casts (trivially true
+   functionally — the cast consumes the new value; asserted by
+   training actually changing outputs);
+3. train/eval transitions can't serve stale weights (same reason;
+   asserted by eval-after-update seeing updated params).
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from apex_tpu import amp
+
+
+class TiedNet(nn.Module):
+    """One Dense applied twice — weight sharing, the cache-sensitive
+    case (reference caches by parameter identity)."""
+
+    @nn.compact
+    def __call__(self, x):
+        layer = nn.Dense(8, name="tied")
+        return layer(nn.relu(layer(x)))
+
+
+def _count_casts_of_params(jaxpr, dtype_name="bfloat16"):
+    """convert_element_type eqns producing ``dtype_name`` from f32."""
+    n = 0
+
+    def walk(jx):
+        nonlocal n
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "convert_element_type" and \
+                    eqn.outvars[0].aval.dtype.name == dtype_name and \
+                    eqn.invars[0].aval.dtype.name == "float32":
+                n += 1
+            for v in eqn.params.values():
+                if hasattr(v, "jaxpr"):
+                    walk(v.jaxpr)
+
+    walk(jaxpr.jaxpr)
+    return n
+
+
+def test_shared_weight_cast_not_duplicated():
+    model, _ = amp.initialize(TiedNet(), optax.sgd(0.1), opt_level="O2",
+                              verbosity=0)
+    x = jnp.ones((2, 8), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    jaxpr = jax.make_jaxpr(lambda v, x: model.apply(v, x))(variables, x)
+    # tied kernel + tied bias + input = 3 casts; a per-application cast
+    # (the bug the reference's cache prevents) would give 5
+    n = _count_casts_of_params(jaxpr)
+    assert n <= 3, f"expected <=3 f32->bf16 casts (param tree + input), got {n}"
+
+
+def test_updated_params_recast_next_step():
+    model, optimizer = amp.initialize(TiedNet(), optax.sgd(0.5),
+                                      opt_level="O2", verbosity=0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 8))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    params = variables["params"]
+    opt_state = optimizer.init(params)
+
+    out_before = model.apply({"params": params}, x)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            out = model.apply({"params": p}, x).astype(jnp.float32)
+            with amp.scale_loss(out.sum(), opt_state) as scaled:
+                return scaled
+        grads = jax.grad(loss_fn)(params)
+        return optimizer.step(params, grads, opt_state)
+
+    params, opt_state = step(params, opt_state)
+    out_after = model.apply({"params": params}, x)
+    # a stale cast cache would reproduce the old output bit-for-bit
+    assert not np.array_equal(np.asarray(out_before), np.asarray(out_after))

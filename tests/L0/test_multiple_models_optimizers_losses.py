@@ -189,7 +189,9 @@ def test_3models_2losses_2optimizers_shared_model_coupling(opt_level):
         u0a, ov0, s0b = opt0.unscale_grads(g0_from0, s0, 0)
         u0b, ov1, s0b = opt0.unscale_grads(g0_from1, s0b, 1)
         g0 = jax.tree_util.tree_map(lambda a, b: a + b, u0a, u0b)
-        u1, ov1b, s1b = opt1.unscale_grads(g1, s1)
+        # loss1 was scaled with slot 1 — unscale p1's grads from the SAME
+        # slot of opt1's state so the pairing is explicit
+        u1, ov1b, s1b = opt1.unscale_grads(g1, s1, 1)
         p0n, s0b = opt0.apply_gradients(p0, g0, s0b, ov0 | ov1)
         p1n, s1b = opt1.apply_gradients(p1, u1, s1b, ov1b)
         return p0n, p1n, s0b, s1b
@@ -202,7 +204,8 @@ def test_3models_2losses_2optimizers_shared_model_coupling(opt_level):
     # both optimizers skipped the poisoned iteration...
     assert int(s0.skipped_steps) == 1 and int(s0.applied_steps) == 2
     assert int(s1.skipped_steps) == 1 and int(s1.applied_steps) == 2
-    # ...but only loss1's scaler halved (loss0 saw clean grads)
+    # ...but only loss1's scaler slot halved (loss0 saw clean grads)
     assert float(s0.loss_scalers[0].loss_scale) == INIT_SCALE
     assert float(s0.loss_scalers[1].loss_scale) == INIT_SCALE / 2
-    assert float(s1.loss_scalers[0].loss_scale) == INIT_SCALE / 2
+    assert float(s1.loss_scalers[1].loss_scale) == INIT_SCALE / 2
+    assert float(s1.loss_scalers[0].loss_scale) == INIT_SCALE
