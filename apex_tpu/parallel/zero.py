@@ -49,30 +49,35 @@ Pytree = Any
 
 def shard_optimizer_state(opt_state: Pytree, mesh: Mesh,
                           axis: str = "data") -> Pytree:
-    """Place large leaves of ``opt_state`` sharded along ``axis`` (dim 0),
+    """Place large leaves of ``opt_state`` sharded along ``axis``,
     everything else replicated.
 
-    A leaf is sharded when its leading dim holds at least one element per
-    device on ``axis`` — covers the flat fp32 m/v/master buffers (the
-    whole point) while leaving step counters, loss-scale scalars, and
-    tiny vectors replicated.  Returns a new state pytree; pass it through
-    the jitted step with donation and the sharding sticks for the life of
-    training.
+    Each leaf is sharded on its first dimension that divides evenly
+    across the axis — flat fp32 m/v/master buffers on dim 0 (the main
+    win), per-leaf moment trees (sgd momentum, optax.adam, FusedLAMB) on
+    a channel dim — while step counters, loss-scale scalars, and tiny
+    vectors stay replicated.  Returns a new state pytree; pass it
+    through the jitted step with donation and the sharding sticks for
+    the life of training.
     """
     n = mesh.shape[axis]
-    sharded = NamedSharding(mesh, P(axis))
     repl = NamedSharding(mesh, P())
 
     def place(x):
-        # device_put demands exact divisibility; FusedAdam's default
-        # pad_to=128 guarantees it for power-of-two axes, and per-leaf
-        # states (FusedLAMB, optax) shard leaf-by-leaf where they can
-        if hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] >= n \
-                and x.shape[0] % n == 0:
-            return jax.device_put(x, sharded)
-        if hasattr(x, "ndim"):
-            return jax.device_put(x, repl)
-        return x  # static aux (FlatSpec et al.) passes through
+        if not hasattr(x, "ndim"):
+            return x  # static aux (FlatSpec et al.) passes through
+        # shard the first evenly-divisible dimension (device_put demands
+        # exact divisibility).  Flat fp32 buffers (FusedAdam m/v,
+        # FP16_Optimizer masters; padded to pad_to=128) shard on dim 0;
+        # per-leaf moment trees (optax sgd/adam, FusedLAMB) shard on
+        # whichever axis divides — e.g. a (3,3,256,256) conv moment
+        # shards its channel dim.  Numerics never change, only placement.
+        for d in range(x.ndim):
+            if x.shape[d] >= n and x.shape[d] % n == 0:
+                spec = [None] * x.ndim
+                spec[d] = axis
+                return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+        return jax.device_put(x, repl)
 
     return jax.tree_util.tree_map(place, opt_state)
 

@@ -91,6 +91,9 @@ def parse_args():
                    help="checkpoint dir to resume from")
     p.add_argument("--checkpoint-dir", default=None,
                    help="save last/best checkpoints when set")
+    p.add_argument("--zero", action="store_true",
+                   help="ZeRO-1: shard optimizer state across the data "
+                   "axis (parallel.shard_optimizer_state)")
     return p.parse_args()
 
 
@@ -226,7 +229,13 @@ def main():
     shard = NamedSharding(mesh, P("data"))
     params = jax.device_put(params, repl)
     batch_stats = jax.device_put(batch_stats, repl)
-    opt_state = jax.device_put(opt_state, repl)
+    if args.zero:
+        # moments shard over the data axis; GSPMD runs the optimizer
+        # update shard-local (pair with a non-Pallas optimizer step —
+        # docs/parallel.md)
+        opt_state = parallel.shard_optimizer_state(opt_state, mesh)
+    else:
+        opt_state = jax.device_put(opt_state, repl)
     mean = jnp.asarray(MEAN)
     std = jnp.asarray(STD)
 
@@ -348,8 +357,10 @@ def main():
             is_best = prec1 is not None and prec1 > best_prec1
             if is_best:
                 best_prec1 = prec1
+            save_opt = (parallel.unshard_optimizer_state(opt_state, mesh)
+                        if args.zero else opt_state)
             state = {"params": params, "batch_stats": batch_stats,
-                     "opt_state": opt_state, "epoch": epoch,
+                     "opt_state": save_opt, "epoch": epoch,
                      "best_prec1": best_prec1}
             ckpt.save(_os.path.join(args.checkpoint_dir, "last"), state)
             if is_best:  # reference's shutil.copyfile best-model pattern

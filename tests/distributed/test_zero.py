@@ -112,3 +112,45 @@ def test_unshard_roundtrip(mesh):
     assert s_back.inner.m.sharding.is_fully_replicated
     np.testing.assert_array_equal(np.asarray(s_back.inner.m),
                                   np.asarray(opt_state.inner.m))
+
+
+def test_per_leaf_state_shards_on_divisible_dim(mesh):
+    """sgd-momentum / optax-style per-leaf moments shard on whichever
+    dimension divides the axis (conv moments via their channel dim),
+    and training numerics are placement-invariant."""
+    import flax.linen as nn
+
+    class ConvNet(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Conv(16, (3, 3), use_bias=False)(x)
+            x = nn.relu(x).reshape((x.shape[0], -1))
+            return nn.Dense(8)(x)
+
+    model = ConvNet()
+    x = jax.random.normal(jax.random.PRNGKey(0), (8, 8, 8, 3))
+    params = model.init(jax.random.PRNGKey(1), x)["params"]
+    tx = optax.sgd(0.1, momentum=0.9)
+    state = parallel.shard_optimizer_state(tx.init(params), mesh)
+
+    mom = state[0].trace
+    conv_m = mom["Conv_0"]["kernel"]          # (3, 3, 3, 16): dim 3 = 16
+    assert conv_m.sharding.spec == P(None, None, None, "data")
+    dense_m = mom["Dense_0"]["kernel"]        # (1024, 8): dim 0 divides
+    assert dense_m.sharding.spec[0] == "data"
+    bias_m = mom["Dense_0"]["bias"]           # (8,): 8 % 8 == 0 -> shards
+    assert bias_m.sharding.spec[0] == "data"
+
+    @jax.jit
+    def step(params, state, x):
+        grads = jax.grad(
+            lambda p: model.apply({"params": p}, x).sum())(params)
+        updates, state = tx.update(grads, state, params)
+        return optax.apply_updates(params, updates), state
+
+    ref_p, ref_s = step(params, tx.init(params), x)
+    with mesh:
+        shd_p, shd_s = step(params, state, x)
+    for a, b in zip(jax.tree.leaves(ref_p), jax.tree.leaves(shd_p)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
