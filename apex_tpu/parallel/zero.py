@@ -55,8 +55,9 @@ def shard_optimizer_state(opt_state: Pytree, mesh: Mesh,
     Each leaf is sharded on its first dimension that divides evenly
     across the axis — flat fp32 m/v/master buffers on dim 0 (the main
     win), per-leaf moment trees (sgd momentum, optax.adam, FusedLAMB) on
-    a channel dim — while step counters, loss-scale scalars, and tiny
-    vectors stay replicated.  Returns a new state pytree; pass it
+    a channel dim — while scalars (step counters, loss scales) and
+    leaves with no evenly-divisible dimension stay replicated.  Returns
+    a new state pytree; pass it
     through the jitted step with donation and the sharding sticks for
     the life of training.
     """
