@@ -55,7 +55,8 @@ NORM_PATTERNS = BATCHNORM_PATTERNS + (r"LayerNorm", r"GroupNorm", r"RMSNorm",
 
 
 def _path_matches(path, patterns) -> bool:
-    names = [str(getattr(p, "key", getattr(p, "name", p))) for p in path]
+    from apex_tpu.utils.paths import path_components
+    names = path_components(path)
     return any(re.search(pat, name) for pat in patterns for name in names)
 
 

@@ -28,6 +28,12 @@ from apex_tpu.parallel.sequence import (
     ring_attention,
     ulysses_attention,
 )
+from apex_tpu.parallel.tensor_parallel import (
+    BERT_TP_RULES,
+    bert_tp_rules,
+    param_specs,
+    shard_params,
+)
 from apex_tpu.parallel.zero import (
     shard_optimizer_state,
     unshard_optimizer_state,
@@ -41,11 +47,15 @@ def create_syncbn_process_group(group_size: int, axis_name: str = "data",
 
 
 __all__ = [
+    "BERT_TP_RULES",
     "DistributedDataParallel",
     "LARC",
     "ProcessGroup",
     "Reducer",
     "SyncBatchNorm",
+    "bert_tp_rules",
+    "param_specs",
+    "shard_params",
     "all_gather_tree",
     "all_reduce_tree",
     "broadcast_params",

@@ -1,0 +1,112 @@
+"""Tensor parallelism as GSPMD param-sharding rules.
+
+The reference has no TP (SURVEY §2.3 — 2019 library); on TPU it needs
+no runtime machinery at all: place the weights sharded across a mesh
+axis and XLA's SPMD partitioner runs every matmul shard-local and
+inserts the Megatron-style collectives (all-reduce after row-parallel
+layers) itself.  What a framework owes the user is therefore just the
+*rules* — which tensor shards on which dimension — and a placement
+helper, the same design GSPMD-era trainers use (pjit + logical
+sharding rules; see jax-ml scaling-book's TP recipe).
+
+``shard_params(params, mesh, rules)`` matches each param's ``/``-joined
+path against ordered ``(regex, PartitionSpec)`` rules — first match
+wins, no match means replicated — and device_puts accordingly.
+``BERT_TP_RULES`` ships the standard transformer split for
+``models.bert`` on a ``"model"`` axis:
+
+- attention q/k/v kernels ``(H, heads, hd)`` shard the heads dim;
+  attention output ``(heads, hd, H)`` likewise (row-parallel: XLA
+  all-reduces its product);
+- MLP ``intermediate`` ``(H, 4H)`` shards columns, ``output``
+  ``(4H, H)`` shards rows (one all-reduce per block, the Megatron
+  pairing);
+- ``word_embeddings``/``mlm_decoder`` shard the vocab dim;
+- norms, biases of row-parallel layers, and everything unmatched stay
+  replicated.
+
+Composition: the specs only name the TP axis, so a ``("data", "sp",
+"model")`` mesh runs DP x SP x TP in one jit — ring attention's
+shard_map carries the head axis through (heads are embarrassingly
+parallel inside attention).  A dimension that does not divide the axis
+evenly falls back to replicated for that rule (sizes must be chosen
+TP-friendly, as everywhere).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Pytree = Any
+Rules = Sequence[Tuple[str, P]]
+
+
+def bert_tp_rules(axis: str = "model") -> Rules:
+    """Megatron-style split for ``models.bert`` (see module docstring)."""
+    return (
+        (r"attention/(query|key|value)/kernel$", P(None, axis, None)),
+        (r"attention/(query|key|value)/bias$", P(axis, None)),
+        (r"attention/output/kernel$", P(axis, None, None)),
+        (r"intermediate/kernel$", P(None, axis)),
+        (r"intermediate/bias$", P(axis)),
+        (r"output/kernel$", P(axis, None)),
+        (r"word_embeddings/embedding$", P(axis, None)),
+        (r"mlm_decoder/kernel$", P(None, axis)),
+        (r"mlm_decoder/bias$", P(axis)),
+    )
+
+
+BERT_TP_RULES = bert_tp_rules()
+
+
+def _spec_fits(shape, spec: P, mesh: Mesh, rule_pat: str) -> bool:
+    if len(spec) > len(shape):
+        return False
+    for dim, names in zip(shape, spec):
+        if names is None:
+            continue
+        names = names if isinstance(names, tuple) else (names,)
+        size = 1
+        for nm in names:
+            if nm not in mesh.shape:
+                # a missing AXIS is a config error, not a shape that
+                # happens not to divide — fail loudly with context
+                raise ValueError(
+                    f"TP rule {rule_pat!r} names mesh axis {nm!r}, but the "
+                    f"mesh only has axes {tuple(mesh.shape)}; build the "
+                    "mesh with that axis or use rules for yours (e.g. "
+                    "bert_tp_rules(axis=...))")
+            size *= mesh.shape[nm]
+        if dim % size != 0:
+            return False
+    return True
+
+
+def param_specs(params: Pytree, mesh: Mesh, rules: Rules) -> Pytree:
+    """PartitionSpec pytree for ``params``: first rule whose regex
+    matches the /-joined path AND whose spec divides the shape wins;
+    otherwise replicated ``P()``."""
+    from apex_tpu.utils.paths import path_str
+
+    def one(path, x):
+        name = path_str(path)
+        for pat, spec in rules:
+            if re.search(pat, name):
+                if _spec_fits(x.shape, spec, mesh, pat):
+                    return spec
+                return P()  # declared but indivisible -> replicated
+        return P()
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def shard_params(params: Pytree, mesh: Mesh, rules: Rules) -> Pytree:
+    """Place ``params`` per ``rules`` on ``mesh`` (replicated default)."""
+    specs = param_specs(params, mesh, rules)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs)

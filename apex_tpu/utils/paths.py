@@ -1,0 +1,27 @@
+"""Pytree key-path helpers shared by the path-matching subsystems.
+
+Key extraction from jax KeyPath entries (DictKey/GetAttrKey/
+SequenceKey/FlattenedIndexKey) lives here once; the consumers differ
+only in matching semantics:
+
+- ``amp.model`` matches patterns against individual components
+  (anchored patterns like ``^bn(_|\\d|$)`` must see one name at a time);
+- ``parallel.tensor_parallel`` matches rules against the ``/``-joined
+  path (``attention/query/kernel``);
+- ``optimizers.param_groups`` keeps ``jax.tree_util.keystr`` — its
+  regex format is a documented user contract there.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+
+def path_components(keypath) -> List[str]:
+    """Printable name of each entry in a jax tree key path."""
+    return [str(getattr(k, "key", getattr(k, "name", k))) for k in keypath]
+
+
+def path_str(keypath) -> str:
+    """``/``-joined form: ``encoder/layer_0/attention/query/kernel``."""
+    return "/".join(path_components(keypath))

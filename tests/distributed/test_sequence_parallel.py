@@ -352,18 +352,23 @@ def test_long_context_bert_sp_remat_amp(mesh):
     repl = NamedSharding(mesh, P())
     params = jax.device_put(params, repl)
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def train_step(params, opt_state, ids, labels):
-        def loss_fn(p):
-            mlm, _ = model.apply({"params": p}, ids, deterministic=True)
-            loss = optax.softmax_cross_entropy_with_integer_labels(
-                mlm.astype(jnp.float32), labels).mean()
-            with amp.scale_loss(loss, opt_state) as scaled:
-                return scaled, loss
-        grads, loss = jax.grad(loss_fn, has_aux=True)(params)
-        params, opt_state = optimizer.step(params, grads, opt_state)
-        return params, opt_state, loss
+    def make_step(mdl, opt):
+        # a fresh jitted step per model: reusing one jit across models
+        # would silently run the first model from its closure
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def train_step(params, opt_state, ids, labels):
+            def loss_fn(p):
+                mlm, _ = mdl.apply({"params": p}, ids, deterministic=True)
+                loss = optax.softmax_cross_entropy_with_integer_labels(
+                    mlm.astype(jnp.float32), labels).mean()
+                with amp.scale_loss(loss, opt_state) as scaled:
+                    return scaled, loss
+            grads, loss = jax.grad(loss_fn, has_aux=True)(params)
+            params, opt_state = opt.step(params, grads, opt_state)
+            return params, opt_state, loss
+        return train_step
 
+    train_step = make_step(model, optimizer)
     with mesh:
         params, opt_state, loss = train_step(params, opt_state, ids, labels)
     assert np.isfinite(float(loss))
@@ -378,20 +383,7 @@ def test_long_context_bert_sp_remat_amp(mesh):
         model2.init(jax.random.PRNGKey(0), ids)["params"], repl)
     opt_state2 = optimizer2.init(params2)
 
-    # a SECOND jitted step closing over the no-remat model — reusing
-    # train_step would silently run the remat model again
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def train_step2(params, opt_state, ids, labels):
-        def loss_fn(p):
-            mlm, _ = model2.apply({"params": p}, ids, deterministic=True)
-            loss = optax.softmax_cross_entropy_with_integer_labels(
-                mlm.astype(jnp.float32), labels).mean()
-            with amp.scale_loss(loss, opt_state) as scaled:
-                return scaled, loss
-        grads, loss = jax.grad(loss_fn, has_aux=True)(params)
-        params, opt_state = optimizer2.step(params, grads, opt_state)
-        return params, opt_state, loss
-
     with mesh:
-        _, _, loss2 = train_step2(params2, opt_state2, ids, labels)
+        _, _, loss2 = make_step(model2, optimizer2)(
+            params2, opt_state2, ids, labels)
     np.testing.assert_allclose(float(loss), float(loss2), rtol=1e-6)
