@@ -38,6 +38,7 @@ import jax.numpy as jnp
 
 from apex_tpu.amp import _amp_state
 from apex_tpu.amp.properties import Properties
+from apex_tpu.utils.paths import path_components
 
 Pytree = Any
 
@@ -55,7 +56,6 @@ NORM_PATTERNS = BATCHNORM_PATTERNS + (r"LayerNorm", r"GroupNorm", r"RMSNorm",
 
 
 def _path_matches(path, patterns) -> bool:
-    from apex_tpu.utils.paths import path_components
     names = path_components(path)
     return any(re.search(pat, name) for pat in patterns for name in names)
 

@@ -20,6 +20,7 @@ from apex_tpu.models.resnet import (
     ResNet152,
 )
 from apex_tpu.models.dcgan import Discriminator, Generator
+from apex_tpu.models.moe import EP_RULES, MoEMlp, ep_rules
 from apex_tpu.models.bert import (
     BertConfig,
     BertEncoder,
@@ -30,6 +31,9 @@ from apex_tpu.models.bert import (
 
 __all__ = [
     "BasicBlock",
+    "EP_RULES",
+    "MoEMlp",
+    "ep_rules",
     "BertConfig",
     "BertEncoder",
     "BertForPreTraining",

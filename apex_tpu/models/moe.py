@@ -1,0 +1,84 @@
+"""Mixture-of-experts MLP with expert parallelism over a mesh axis.
+
+The reference has no MoE/EP (SURVEY §2.3 — absent). TPU-first design:
+experts live as one stacked parameter ``(E, ...)`` and the block
+computes a dense einsum over the expert dimension with a top-1 (Switch)
+router — so sharding the leading expert dim across an ``"expert"`` mesh
+axis (``EP_RULES`` + ``parallel.shard_params``) makes GSPMD run each
+device's experts locally and combine with one reduce — expert
+parallelism with zero dispatch machinery.  Dense compute (every expert
+sees every token, results masked by the router's one-hot) trades E x
+MLP FLOPs for perfect static shapes: no capacity factor, no token
+dropping, no sort/scatter — the right call for modest expert counts on
+the MXU, and exact (the usual capacity-overflow nondeterminism never
+appears).  A capacity-based sparse dispatch is an optimization of this
+same contract, not a different API.
+
+Router: softmax gate, top-1 selection scaled by the gate probability
+(Switch Transformer, Fedus et al. 2021), plus the standard load-balance
+auxiliary loss ``E * mean(gate_prob) . mean(assignment)`` returned to
+the caller (weight it into the training loss).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from jax.sharding import PartitionSpec as P
+
+
+def ep_rules(axis: str = "expert"):
+    """Sharding rules for ``MoEMlp`` params (leading expert dim)."""
+    return (
+        (r"experts_in$", P(axis, None, None)),
+        (r"experts_bias_in$", P(axis, None)),
+        (r"experts_out$", P(axis, None, None)),
+        (r"experts_bias_out$", P(axis, None)),
+    )
+
+
+EP_RULES = ep_rules()
+
+
+class MoEMlp(nn.Module):
+    """Top-1-routed MLP: ``(B, S, H) -> ((B, S, H), aux_loss)``."""
+
+    num_experts: int
+    hidden_size: int
+    intermediate_size: int
+
+    @nn.compact
+    def __call__(self, x) -> Tuple[jax.Array, jax.Array]:
+        e, h, f = self.num_experts, self.hidden_size, self.intermediate_size
+        init = nn.initializers.normal(0.02)
+        w_in = self.param("experts_in", init, (e, h, f))
+        b_in = self.param("experts_bias_in", nn.initializers.zeros, (e, f))
+        w_out = self.param("experts_out", init, (e, f, h))
+        b_out = self.param("experts_bias_out", nn.initializers.zeros, (e, h))
+
+        # router in fp32 (precision decides expert assignment)
+        gate_logits = nn.Dense(e, name="router",
+                               kernel_init=init)(x.astype(jnp.float32))
+        gate = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+        top1 = jnp.argmax(gate, axis=-1)                      # (B, S)
+        one_hot = jax.nn.one_hot(top1, e, dtype=gate.dtype)   # (B, S, E)
+        # Switch scaling: route weight = the chosen expert's probability
+        combine = (one_hot * gate).astype(x.dtype)            # (B, S, E)
+
+        # dense expert compute, masked-combined; contracting over h/f
+        # keeps the expert dim outermost so an expert-sharded placement
+        # computes local experts only and reduces once
+        y = jnp.einsum("bsh,ehf->bsef", x, w_in) + b_in[None, None]
+        y = nn.gelu(y, approximate=False)
+        y = jnp.einsum("bsef,efh->bseh", y, w_out) + b_out[None, None]
+        out = jnp.einsum("bseh,bse->bsh", y, combine)
+
+        # load-balance aux loss (Switch eq. 4): E * sum_e f_e * P_e
+        frac_tokens = jnp.mean(one_hot, axis=(0, 1))          # f_e
+        frac_prob = jnp.mean(gate, axis=(0, 1))               # P_e
+        aux = e * jnp.sum(frac_tokens * frac_prob)
+        return out, aux.astype(jnp.float32)

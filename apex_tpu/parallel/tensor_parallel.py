@@ -41,6 +41,8 @@ from typing import Any, Sequence, Tuple
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from apex_tpu.utils.paths import path_str
+
 Pytree = Any
 Rules = Sequence[Tuple[str, P]]
 
@@ -65,7 +67,11 @@ BERT_TP_RULES = bert_tp_rules()
 
 def _spec_fits(shape, spec: P, mesh: Mesh, rule_pat: str) -> bool:
     if len(spec) > len(shape):
-        return False
+        # rank mismatch is a rule-authoring error like a missing axis,
+        # not a shape that happens not to divide — fail loudly
+        raise ValueError(
+            f"TP rule {rule_pat!r} has a {len(spec)}-dim PartitionSpec "
+            f"but matched a rank-{len(shape)} param {tuple(shape)}")
     for dim, names in zip(shape, spec):
         if names is None:
             continue
@@ -90,7 +96,6 @@ def param_specs(params: Pytree, mesh: Mesh, rules: Rules) -> Pytree:
     """PartitionSpec pytree for ``params``: first rule whose regex
     matches the /-joined path AND whose spec divides the shape wins;
     otherwise replicated ``P()``."""
-    from apex_tpu.utils.paths import path_str
 
     def one(path, x):
         name = path_str(path)

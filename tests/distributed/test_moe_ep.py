@@ -1,0 +1,96 @@
+"""Expert parallelism: MoEMlp with experts sharded over an "expert"
+mesh axis must compute exactly what the replicated block computes
+(placement changes where experts run, never the routing or the math),
+and the Switch router must actually distribute and balance load.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from apex_tpu import amp, models, parallel
+
+NDEV = 8
+B, S, H, F, E = 4, 16, 32, 64, 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return Mesh(np.asarray(jax.devices()[:NDEV]), ("expert",))
+
+
+def _setup(seed=0):
+    moe = models.MoEMlp(num_experts=E, hidden_size=H, intermediate_size=F)
+    x = jax.random.normal(jax.random.PRNGKey(seed), (B, S, H))
+    params = moe.init(jax.random.PRNGKey(seed + 1), x)["params"]
+    return moe, params, x
+
+
+def test_ep_placement_matches_replicated(mesh):
+    moe, params, x = _setup()
+    out_r, aux_r = jax.jit(
+        lambda p, x: moe.apply({"params": p}, x))(params, x)
+
+    ep = parallel.shard_params(params, mesh, models.EP_RULES)
+    assert ep["experts_in"].sharding.spec[0] == "expert"
+    assert ep["router"]["kernel"].sharding.is_fully_replicated
+    with mesh:
+        out_e, aux_e = jax.jit(
+            lambda p, x: moe.apply({"params": p}, x))(ep, x)
+
+    np.testing.assert_allclose(np.asarray(out_e), np.asarray(out_r),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(float(aux_e), float(aux_r), rtol=1e-6)
+
+
+def test_router_routes_and_balances():
+    moe, params, x = _setup(3)
+    out, aux = moe.apply({"params": params}, x)
+    assert out.shape == (B, S, H)
+    # aux = E * sum(f_e * P_e); 1.0 is the perfectly-uniform value and
+    # E the worst case — a fresh random router should be near uniform
+    assert 0.9 < float(aux) < 2.5
+    # tokens actually spread across experts (not a collapsed router)
+    gate_logits = x.astype(jnp.float32) @ params["router"]["kernel"] + \
+        params["router"]["bias"]
+    picks = np.asarray(jnp.argmax(gate_logits, -1)).ravel()
+    assert len(set(picks.tolist())) >= 3
+
+
+def test_ep_amp_train_step_keeps_sharding(mesh):
+    """amp O2 + aux-weighted loss over expert-sharded params: one jitted
+    step runs, experts stay sharded, loss decreases over a few steps."""
+    moe, _, x = _setup(5)
+    model, optimizer = amp.initialize(moe, optax.adam(1e-3),
+                                      opt_level="O2", verbosity=0)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    params = parallel.shard_params(variables["params"], mesh,
+                                   models.EP_RULES)
+    opt_state = optimizer.init(params)
+    tgt = jax.random.normal(jax.random.PRNGKey(6), (B, S, H))
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state):
+        def loss_fn(p):
+            out, aux = model.apply({"params": p}, x)
+            loss = jnp.mean((out.astype(jnp.float32) - tgt) ** 2) + \
+                0.01 * aux
+            with amp.scale_loss(loss, opt_state) as scaled:
+                return scaled, loss
+        grads, loss = jax.grad(loss_fn, has_aux=True)(params)
+        params, opt_state = optimizer.step(params, grads, opt_state)
+        return params, opt_state, loss
+
+    losses = []
+    with mesh:
+        for _ in range(6):
+            params, opt_state, loss = step(params, opt_state)
+            losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    assert params["experts_in"].sharding.spec[0] == "expert"
