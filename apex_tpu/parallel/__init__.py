@@ -28,6 +28,7 @@ from apex_tpu.parallel.sequence import (
     ring_attention,
     ulysses_attention,
 )
+from apex_tpu.parallel.pipeline import gpipe_spmd, pipeline_apply
 from apex_tpu.parallel.tensor_parallel import (
     BERT_TP_RULES,
     bert_tp_rules,
@@ -62,7 +63,9 @@ __all__ = [
     "convert_syncbn_model",
     "create_process_group",
     "create_syncbn_process_group",
+    "gpipe_spmd",
     "initialize_distributed",
+    "pipeline_apply",
     "make_ring_attention",
     "make_ulysses_attention",
     "merge_stats",

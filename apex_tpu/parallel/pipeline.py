@@ -1,0 +1,108 @@
+"""Pipeline parallelism: GPipe over a mesh axis, TPU-native.
+
+The reference has no PP (SURVEY §2.3). The TPU formulation needs no
+scheduler threads or p2p runtime: stages are laid out on a ``"pipe"``
+mesh axis, the microbatch schedule is a ``lax.scan`` over ticks, and
+stage-to-stage transfer is one ``ppermute`` hop per tick over ICI —
+the whole pipeline is a single compiled SPMD program, and autodiff
+through scan + ppermute yields the reverse pipeline for backward
+automatically (no hand-written 1F1B machinery).
+
+Contract (classic GPipe):
+
+- ``stage_fn(stage_params, x) -> y`` with ``y.shape == x.shape`` — all
+  stages share one activation shape (transformer blocks, MLP stacks);
+- stage parameters live STACKED with a leading stage dim ``(S, ...)``
+  (build with ``jax.vmap(stage.init)`` over per-stage rngs), sharded
+  ``P("pipe")`` so each device holds its own stage;
+- the global batch is split into ``num_microbatches`` M; the schedule
+  runs ``T = M + S - 1`` ticks with the usual bubble ``(S-1)/T``.
+
+Use :func:`pipeline_apply` for the packaged shard_map wrapper, or
+:func:`gpipe_spmd` directly inside your own shard_map when composing
+with other axes (see ``tests/distributed/test_pipeline.py`` for a
+(data, pipe) composition).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.parallel.sequence import _vary_like
+
+Pytree = Any
+
+
+def gpipe_spmd(stage_fn: Callable, axis_name: str,
+               num_microbatches: int):
+    """Per-device GPipe body, to be called INSIDE ``shard_map`` with the
+    stage axis ``axis_name``.
+
+    Returns ``run(stacked_params_local, x)`` where
+    ``stacked_params_local`` is this device's ``(1, ...)`` slice of the
+    stacked stage params and ``x`` is the (replicated-per-pipe) global
+    batch ``(B, ...)``; returns the pipeline output ``(B, ...)``,
+    identical on every device of the axis (psum-combined).
+    """
+
+    def run(stacked_params_local: Pytree, x: jax.Array) -> jax.Array:
+        s = lax.axis_size(axis_name)
+        stage = lax.axis_index(axis_name)
+        for leaf in jax.tree_util.tree_leaves(stacked_params_local):
+            # each device must hold exactly ONE stage slice; a stacked
+            # stage count that is a multiple of the axis size would
+            # otherwise silently run only every k-th stage
+            if leaf.shape[0] != 1:
+                raise ValueError(
+                    f"stacked stage params have leading dim "
+                    f"{leaf.shape[0]} per device; the stage count must "
+                    f"equal the size of mesh axis {axis_name!r} ({s})")
+        params = jax.tree_util.tree_map(lambda a: a[0],
+                                        stacked_params_local)
+        m = num_microbatches
+        b = x.shape[0]
+        assert b % m == 0, f"batch {b} must divide into {m} microbatches"
+        xs = x.reshape((m, b // m) + x.shape[1:])
+
+        fwd_perm = [(i, i + 1) for i in range(s - 1)]
+
+        def tick(x_buf, t):
+            # stage 0 injects microbatch t (clipped; invalid ticks feed
+            # garbage that never reaches the output window)
+            inject = xs[jnp.clip(t, 0, m - 1)]
+            x_in = jnp.where(stage == 0, inject, x_buf)
+            y = stage_fn(params, x_in)
+            x_next = lax.ppermute(y, axis_name, fwd_perm)
+            return x_next, y
+
+        # the carry crosses ppermute, so it is varying on the pipe axis;
+        # the zeros init must carry the same vma type
+        zero = _vary_like(jnp.zeros_like(xs[0]), extra_axes=(axis_name,))
+        _, ys = lax.scan(tick, zero, jnp.arange(m + s - 1))
+        # microbatch j leaves the last stage at tick s-1+j
+        valid = lax.dynamic_slice_in_dim(ys, s - 1, m)
+        out = jnp.where(stage == s - 1, valid, jnp.zeros_like(valid))
+        out = lax.psum(out, axis_name)
+        return out.reshape((b,) + out.shape[2:])
+
+    return run
+
+
+def pipeline_apply(mesh: Mesh, axis_name: str, stage_fn: Callable,
+                   stacked_params: Pytree, x: jax.Array,
+                   num_microbatches: int) -> jax.Array:
+    """One-call GPipe: shard ``stacked_params`` over ``axis_name`` of
+    ``mesh``, run the microbatch schedule, return the output (replicated
+    over the pipe axis).  Differentiable; jit over it freely."""
+    run = gpipe_spmd(stage_fn, axis_name, num_microbatches)
+    f = jax.shard_map(
+        run, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(axis_name),
+                                         stacked_params), P()),
+        out_specs=P())
+    return f(stacked_params, x)
