@@ -49,6 +49,12 @@ class BertConfig:
     # trades ~33% more FLOPs for O(layers) less activation HBM — the
     # lever that lets long sequences fit (pairs with ring/Ulysses SP)
     remat: bool = False
+    # >0 replaces each layer's dense MLP with a Switch-MoE of this many
+    # experts (models.MoEMlp); per-layer load-balance aux losses are
+    # sown into the "losses" collection — apply with
+    # mutable=["losses"] and add their sum (weighted) to the training
+    # loss. Shard experts with models.EP_RULES for expert parallelism.
+    moe_experts: int = 0
 
 
 def bert_base() -> "BertConfig":
@@ -121,10 +127,19 @@ class BertLayer(nn.Module):
         x = FusedLayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps,
                            name="attention_ln")(x + drop(attn_out))
 
-        y = nn.Dense(cfg.intermediate_size, kernel_init=init,
-                     name="intermediate")(x)
-        y = nn.gelu(y, approximate=False)
-        y = nn.Dense(cfg.hidden_size, kernel_init=init, name="output")(y)
+        if cfg.moe_experts:
+            from apex_tpu.models.moe import MoEMlp
+            y, aux = MoEMlp(num_experts=cfg.moe_experts,
+                            hidden_size=cfg.hidden_size,
+                            intermediate_size=cfg.intermediate_size,
+                            kernel_init=init, name="moe")(x)
+            self.sow("losses", "moe_aux", aux)
+        else:
+            y = nn.Dense(cfg.intermediate_size, kernel_init=init,
+                         name="intermediate")(x)
+            y = nn.gelu(y, approximate=False)
+            y = nn.Dense(cfg.hidden_size, kernel_init=init,
+                         name="output")(y)
         return FusedLayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps,
                               name="output_ln")(x + drop(y))
 

@@ -22,7 +22,7 @@ the caller (weight it into the training loss).
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Callable, Optional, Tuple
 
 import flax.linen as nn
 import jax
@@ -50,11 +50,12 @@ class MoEMlp(nn.Module):
     num_experts: int
     hidden_size: int
     intermediate_size: int
+    kernel_init: Optional[Callable] = None  # default: normal(0.02)
 
     @nn.compact
     def __call__(self, x) -> Tuple[jax.Array, jax.Array]:
         e, h, f = self.num_experts, self.hidden_size, self.intermediate_size
-        init = nn.initializers.normal(0.02)
+        init = self.kernel_init or nn.initializers.normal(0.02)
         w_in = self.param("experts_in", init, (e, h, f))
         b_in = self.param("experts_bias_in", nn.initializers.zeros, (e, f))
         w_out = self.param("experts_out", init, (e, f, h))

@@ -13,6 +13,7 @@ data-parallel).
 """
 
 import argparse
+import dataclasses
 import time
 
 import jax
@@ -47,6 +48,10 @@ def parse_args():
                    help="rematerialize encoder layers in backward "
                    "(jax.checkpoint): ~33%% more FLOPs for O(layers) "
                    "less activation HBM — for long --seq-len")
+    p.add_argument("--moe", type=int, default=0, metavar="E",
+                   help="replace each layer's MLP with a Switch-MoE of "
+                   "E experts (aux load-balance loss auto-added; shard "
+                   "experts with models.EP_RULES for EP)")
     return p.parse_args()
 
 
@@ -76,9 +81,8 @@ def synthetic_mlm_batch(rng, args, cfg):
 def main():
     args = parse_args()
     cfg = get_config(args.config)
-    if args.remat:
-        import dataclasses
-        cfg = dataclasses.replace(cfg, remat=True)
+    cfg = dataclasses.replace(cfg, remat=args.remat,
+                              moe_experts=args.moe)
 
     devices = jax.devices()
     n_dev = len(devices)
@@ -147,15 +151,23 @@ def main():
     @jax.jit
     def train_step(params, opt_state, ids, labels, weights, nsp):
         def loss_fn(p):
-            mlm_logits, nsp_logits = model.apply(
-                {"params": p}, ids, deterministic=True)
+            if args.moe:
+                (mlm_logits, nsp_logits), mut = model.apply(
+                    {"params": p}, ids, deterministic=True,
+                    mutable=["losses"])
+                aux = sum(jnp.sum(leaf) for leaf in
+                          jax.tree_util.tree_leaves(mut["losses"]))
+            else:
+                mlm_logits, nsp_logits = model.apply(
+                    {"params": p}, ids, deterministic=True)
+                aux = 0.0
             mlm_losses = optax.softmax_cross_entropy_with_integer_labels(
                 mlm_logits, labels)
             mlm_loss = jnp.sum(mlm_losses * weights) / \
                 jnp.maximum(jnp.sum(weights), 1.0)
             nsp_loss = optax.softmax_cross_entropy_with_integer_labels(
                 nsp_logits, nsp).mean()
-            loss = mlm_loss + nsp_loss
+            loss = mlm_loss + nsp_loss + 0.01 * aux
             with amp.scale_loss(loss, opt_state) as scaled:
                 return scaled, loss
         (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)

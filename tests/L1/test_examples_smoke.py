@@ -53,8 +53,9 @@ def test_dcgan_multi_loss():
     assert "loss_d" in out.lower() or "loss" in out.lower()
 
 
-@pytest.mark.parametrize("extra", [[], ["--remat"]],
-                         ids=["plain", "remat"])
+@pytest.mark.parametrize("extra", [[], ["--remat"], ["--moe", "4"],
+                                   ["--remat", "--moe", "4"]],
+                         ids=["plain", "remat", "moe", "remat_moe"])
 def test_bert_tiny(extra):
     out = _run("examples/bert/main_amp.py", "--config", "tiny", "--b", "8",
                "--seq-len", "32", "--steps", "3", *extra)

@@ -94,3 +94,48 @@ def test_ep_amp_train_step_keeps_sharding(mesh):
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0]
     assert params["experts_in"].sharding.spec[0] == "expert"
+
+
+def test_bert_moe_ep_train_step(mesh):
+    """BERT with Switch-MoE layers (cfg.moe_experts) trains under EP:
+    experts shard via the same EP_RULES (path-suffix match), per-layer
+    aux losses come back through the "losses" collection, loss is
+    finite and sharding survives the update."""
+    cfg = models.BertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=16, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, moe_experts=E)
+    model, optimizer = amp.initialize(
+        models.BertForPreTraining(cfg), optax.adam(1e-3),
+        opt_level="O2", verbosity=0)
+    ids = jnp.ones((2, 8), jnp.int32)
+    labels = jnp.zeros((2, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    params = parallel.shard_params(params, mesh, models.EP_RULES)
+    moe_in = params["encoder"]["layer_0"]["moe"]["experts_in"]
+    assert moe_in.sharding.spec[0] == "expert"
+    opt_state = optimizer.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state):
+        def loss_fn(p):
+            (mlm, _), mut = model.apply(
+                {"params": p}, ids, deterministic=True,
+                mutable=["losses"])
+            aux = sum(jnp.sum(leaf) for leaf in
+                      jax.tree_util.tree_leaves(mut["losses"]))
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                mlm.astype(jnp.float32), labels).mean() + 0.01 * aux
+            with amp.scale_loss(loss, opt_state) as scaled:
+                return scaled, loss
+        grads, loss = jax.grad(loss_fn, has_aux=True)(params)
+        params, opt_state = optimizer.step(params, grads, opt_state)
+        return params, opt_state, loss
+
+    with mesh:
+        for _ in range(3):
+            params, opt_state, loss = step(params, opt_state)
+    assert np.isfinite(float(loss))
+    moe_in = params["encoder"]["layer_0"]["moe"]["experts_in"]
+    assert moe_in.sharding.spec[0] == "expert"
