@@ -1,0 +1,128 @@
+"""Torch checkpoint interop: load torchvision-style ResNet weights.
+
+The migration path for reference users: the reference's flagship
+workload is torchvision ResNet driven by ``examples/imagenet``
+(reference ``main_amp.py:141-148``), so "switching frameworks" starts
+with carrying those checkpoints over.  ``models.resnet`` is structured
+1:1 with torchvision (same stem/stage/block layout, v1.5 strides), so
+the conversion is pure renaming + layout transposition:
+
+- conv ``weight`` OIHW -> flax ``kernel`` HWIO;
+- linear ``weight`` (O, I) -> ``kernel`` (I, O);
+- bn ``weight``/``bias`` -> ``scale``/``bias`` (params) and
+  ``running_mean``/``running_var`` -> ``mean``/``var`` (batch_stats);
+- ``layer{s}.{i}`` -> the s/i-th ``BasicBlock_k``/``Bottleneck_k`` in
+  flax auto-naming order, ``downsample.0/.1`` ->
+  ``downsample_conv``/``downsample_bn``.
+
+Accepts a ``state_dict``-like mapping of torch tensors OR numpy arrays
+(no torch import needed unless tensors are passed).  Returns
+``{"params": ..., "batch_stats": ...}`` ready for
+``models.ResNetXX().apply`` — verified numerically against a live
+torch model in ``tests/L0/test_torch_interop.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+_ARCH = {
+    "resnet18": ("BasicBlock", [2, 2, 2, 2], 2),
+    "resnet34": ("BasicBlock", [3, 4, 6, 3], 2),
+    "resnet50": ("Bottleneck", [3, 4, 6, 3], 3),
+    "resnet101": ("Bottleneck", [3, 4, 23, 3], 3),
+    "resnet152": ("Bottleneck", [3, 8, 36, 3], 3),
+}
+
+
+def _np(x) -> np.ndarray:
+    if hasattr(x, "detach"):  # torch tensor
+        x = x.detach().cpu().numpy()
+    return np.asarray(x)
+
+
+def _conv(w) -> jnp.ndarray:
+    return jnp.asarray(_np(w).transpose(2, 3, 1, 0))  # OIHW -> HWIO
+
+
+def load_torch_resnet(state_dict: Mapping[str, Any],
+                      arch: str = "resnet50") -> Dict[str, Any]:
+    """Convert a torchvision-format ResNet ``state_dict`` into the
+    variables pytree of ``models.ResNetXX`` (see module docstring)."""
+    if arch not in _ARCH:
+        raise ValueError(f"unknown arch {arch!r}; have {sorted(_ARCH)}")
+    block_name, stage_sizes, convs_per_block = _ARCH[arch]
+
+    # DDP-wrapped models save "module."-prefixed keys (the reference's
+    # own imagenet script does); strip a uniform prefix transparently
+    if state_dict and all(k.startswith("module.") for k in state_dict):
+        state_dict = {k[len("module."):]: v for k, v in state_dict.items()}
+
+    consumed = set()
+
+    class _Tracking:
+        """dict view recording which checkpoint keys were consumed, and
+        turning missing keys into arch-mismatch guidance."""
+
+        def __getitem__(self, key):
+            consumed.add(key)
+            try:
+                return state_dict[key]
+            except KeyError:
+                raise ValueError(
+                    f"state_dict is missing {key!r}, required by "
+                    f"arch={arch!r} — wrong arch for this checkpoint?"
+                ) from None
+
+        def __contains__(self, key):
+            return key in state_dict
+
+    sd = _Tracking()
+    params: Dict[str, Any] = {}
+    stats: Dict[str, Any] = {}
+
+    def bn(src: str, dst: str, p: Dict[str, Any], s: Dict[str, Any]):
+        p[dst] = {"scale": jnp.asarray(_np(sd[f"{src}.weight"])),
+                  "bias": jnp.asarray(_np(sd[f"{src}.bias"]))}
+        s[dst] = {"mean": jnp.asarray(_np(sd[f"{src}.running_mean"])),
+                  "var": jnp.asarray(_np(sd[f"{src}.running_var"]))}
+
+    params["stem_conv"] = {"kernel": _conv(sd["conv1.weight"])}
+    bn("bn1", "stem_bn", params, stats)
+
+    k = 0
+    for s, n_blocks in enumerate(stage_sizes, start=1):
+        for i in range(n_blocks):
+            src = f"layer{s}.{i}"
+            blk_p: Dict[str, Any] = {}
+            blk_s: Dict[str, Any] = {}
+            for c in range(convs_per_block):
+                blk_p[f"Conv_{c}"] = {
+                    "kernel": _conv(sd[f"{src}.conv{c + 1}.weight"])}
+                bn(f"{src}.bn{c + 1}", f"BatchNorm_{c}", blk_p, blk_s)
+            if f"{src}.downsample.0.weight" in sd:
+                blk_p["downsample_conv"] = {
+                    "kernel": _conv(sd[f"{src}.downsample.0.weight"])}
+                bn(f"{src}.downsample.1", "downsample_bn", blk_p, blk_s)
+            name = f"{block_name}_{k}"
+            params[name] = blk_p
+            stats[name] = blk_s
+            k += 1
+
+    params["fc"] = {"kernel": jnp.asarray(_np(sd["fc.weight"]).T),
+                    "bias": jnp.asarray(_np(sd["fc.bias"]))}
+
+    # a checkpoint deeper than `arch` converts key-complete but silently
+    # truncated — refuse leftovers instead (num_batches_tracked counters
+    # are torch bookkeeping with no flax analog)
+    leftovers = [key for key in state_dict
+                 if key not in consumed
+                 and not key.endswith("num_batches_tracked")]
+    if leftovers:
+        raise ValueError(
+            f"state_dict has {len(leftovers)} keys not consumed by "
+            f"arch={arch!r} (e.g. {sorted(leftovers)[:4]}); wrong arch?")
+    return {"params": params, "batch_stats": stats}

@@ -1,0 +1,226 @@
+"""Torch checkpoint conversion, verified against a LIVE torch model.
+
+torchvision is not installed here, so the test defines a minimal torch
+ResNet-18 with torchvision's exact module naming (conv1/bn1/layer{s}.{i}
+.conv{c}/bn{c}/downsample.0-1/fc — the checkpoint format contract) and
+checks that ``load_torch_resnet`` makes ``models.ResNet18`` reproduce
+the torch model's eval forward on random inputs — true cross-framework
+numerical parity, not just key bookkeeping.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from apex_tpu import models  # noqa: E402
+from apex_tpu.utils.torch_interop import load_torch_resnet  # noqa: E402
+
+
+class _TorchBasicBlock(tnn.Module):
+    def __init__(self, cin, cout, stride=1):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(cout)
+        self.conv2 = tnn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(cout)
+        self.downsample = None
+        if stride != 1 or cin != cout:
+            self.downsample = tnn.Sequential(
+                tnn.Conv2d(cin, cout, 1, stride, bias=False),
+                tnn.BatchNorm2d(cout))
+        self.relu = tnn.ReLU()
+
+    def forward(self, x):
+        idt = x if self.downsample is None else self.downsample(x)
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.bn2(self.conv2(y))
+        return self.relu(idt + y)
+
+
+class _TorchResNet18(tnn.Module):
+    """torchvision-naming ResNet-18 (width trimmed for test speed)."""
+
+    def __init__(self, width=16, num_classes=10):
+        super().__init__()
+        w = width
+        self.conv1 = tnn.Conv2d(3, w, 7, 2, 3, bias=False)
+        self.bn1 = tnn.BatchNorm2d(w)
+        self.relu = tnn.ReLU()
+        self.maxpool = tnn.MaxPool2d(3, 2, 1)
+        sizes = [2, 2, 2, 2]
+        cin = w
+        for s, n in enumerate(sizes, start=1):
+            cout = w * 2 ** (s - 1)
+            blocks = []
+            for i in range(n):
+                stride = 2 if (s > 1 and i == 0) else 1
+                blocks.append(_TorchBasicBlock(cin, cout, stride))
+                cin = cout
+            setattr(self, f"layer{s}", tnn.Sequential(*blocks))
+        self.avgpool = tnn.AdaptiveAvgPool2d(1)
+        self.fc = tnn.Linear(cin, num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        for s in range(1, 5):
+            x = getattr(self, f"layer{s}")(x)
+        x = self.avgpool(x).flatten(1)
+        return self.fc(x)
+
+
+@pytest.fixture(scope="module")
+def torch_model():
+    torch.manual_seed(0)
+    m = _TorchResNet18()
+    # randomize running stats so the conversion of batch_stats is
+    # actually load-bearing in the comparison
+    with torch.no_grad():
+        for mod in m.modules():
+            if isinstance(mod, tnn.BatchNorm2d):
+                mod.running_mean.uniform_(-0.2, 0.2)
+                mod.running_var.uniform_(0.7, 1.4)
+    return m.eval()
+
+
+def test_forward_parity_with_torch(torch_model):
+    variables = load_torch_resnet(torch_model.state_dict(),
+                                  arch="resnet18")
+    flax_model = models.ResNet18(num_classes=10, width=16)
+
+    x = np.random.RandomState(1).randn(2, 32, 32, 3).astype(np.float32)
+    with torch.no_grad():
+        want = torch_model(torch.from_numpy(
+            x.transpose(0, 3, 1, 2))).numpy()
+    got = flax_model.apply(variables, jnp.asarray(x), train=False)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_converted_tree_matches_init_structure(torch_model):
+    """Converted pytree must be structurally identical to a fresh init
+    (same treedef + shapes), so optimizers/checkpoints accept it."""
+    variables = load_torch_resnet(torch_model.state_dict(),
+                                  arch="resnet18")
+    flax_model = models.ResNet18(num_classes=10, width=16)
+    ref = flax_model.init(jax.random.PRNGKey(0),
+                          jnp.ones((1, 32, 32, 3)), train=True)
+    ref_paths = jax.tree_util.tree_flatten_with_path(ref)[0]
+    got_paths = jax.tree_util.tree_flatten_with_path(variables)[0]
+    assert [p for p, _ in ref_paths] == [p for p, _ in got_paths]
+    for (p, a), (_, b) in zip(ref_paths, got_paths):
+        assert a.shape == b.shape, (p, a.shape, b.shape)
+
+
+def test_unknown_arch_raises(torch_model):
+    with pytest.raises(ValueError, match="unknown arch"):
+        load_torch_resnet(torch_model.state_dict(), arch="resnet99")
+
+
+class _TorchBottleneck(tnn.Module):
+    def __init__(self, cin, planes, stride=1):
+        super().__init__()
+        cout = planes * 4
+        self.conv1 = tnn.Conv2d(cin, planes, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(planes)
+        self.conv2 = tnn.Conv2d(planes, planes, 3, stride, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(planes)
+        self.conv3 = tnn.Conv2d(planes, cout, 1, bias=False)
+        self.bn3 = tnn.BatchNorm2d(cout)
+        self.downsample = None
+        if stride != 1 or cin != cout:
+            self.downsample = tnn.Sequential(
+                tnn.Conv2d(cin, cout, 1, stride, bias=False),
+                tnn.BatchNorm2d(cout))
+        self.relu = tnn.ReLU()
+
+    def forward(self, x):
+        idt = x if self.downsample is None else self.downsample(x)
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.relu(self.bn2(self.conv2(y)))
+        y = self.bn3(self.conv3(y))
+        return self.relu(idt + y)
+
+
+class _TorchResNet50(tnn.Module):
+    """torchvision-naming ResNet-50 (width trimmed); note layer1.0 has
+    the stride-1 downsample only Bottleneck produces."""
+
+    def __init__(self, width=8, num_classes=10):
+        super().__init__()
+        w = width
+        self.conv1 = tnn.Conv2d(3, w, 7, 2, 3, bias=False)
+        self.bn1 = tnn.BatchNorm2d(w)
+        self.relu = tnn.ReLU()
+        self.maxpool = tnn.MaxPool2d(3, 2, 1)
+        cin = w
+        for s, n in enumerate([3, 4, 6, 3], start=1):
+            planes = w * 2 ** (s - 1)
+            blocks = []
+            for i in range(n):
+                stride = 2 if (s > 1 and i == 0) else 1
+                blocks.append(_TorchBottleneck(cin, planes, stride))
+                cin = planes * 4
+            setattr(self, f"layer{s}", tnn.Sequential(*blocks))
+        self.avgpool = tnn.AdaptiveAvgPool2d(1)
+        self.fc = tnn.Linear(cin, num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        for s in range(1, 5):
+            x = getattr(self, f"layer{s}")(x)
+        x = self.avgpool(x).flatten(1)
+        return self.fc(x)
+
+
+def test_bottleneck_forward_parity_with_torch():
+    torch.manual_seed(1)
+    tm = _TorchResNet50()
+    with torch.no_grad():
+        for mod in tm.modules():
+            if isinstance(mod, tnn.BatchNorm2d):
+                mod.running_mean.uniform_(-0.2, 0.2)
+                mod.running_var.uniform_(0.7, 1.4)
+    tm = tm.eval()
+    variables = load_torch_resnet(tm.state_dict(), arch="resnet50")
+    flax_model = models.ResNet50(num_classes=10, width=8)
+
+    x = np.random.RandomState(2).randn(2, 32, 32, 3).astype(np.float32)
+    with torch.no_grad():
+        want = tm(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    got = flax_model.apply(variables, jnp.asarray(x), train=False)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=5e-4,
+                               atol=5e-4)
+
+
+def test_wrong_arch_leftover_keys_raise(torch_model):
+    """A resnet18 checkpoint converted as resnet34 would silently
+    truncate without the leftover-key check — and vice versa: here the
+    50-style dict fed as resnet18 must refuse."""
+    torch.manual_seed(2)
+    sd = _TorchResNet50().state_dict()
+    with pytest.raises(ValueError, match="wrong arch"):
+        load_torch_resnet(sd, arch="resnet18")
+    # and a shallow dict for a deeper arch gets the same guidance
+    with pytest.raises(ValueError, match="wrong arch"):
+        load_torch_resnet(torch_model.state_dict(), arch="resnet34")
+
+
+def test_ddp_module_prefix_stripped(torch_model):
+    """The reference's imagenet script checkpoints the DDP-wrapped
+    model, so keys arrive as module.conv1.weight — converted
+    transparently."""
+    sd = {f"module.{k}": v for k, v in torch_model.state_dict().items()}
+    variables = load_torch_resnet(sd, arch="resnet18")
+    x = np.random.RandomState(3).randn(1, 32, 32, 3).astype(np.float32)
+    with torch.no_grad():
+        want = torch_model(torch.from_numpy(
+            x.transpose(0, 3, 1, 2))).numpy()
+    got = models.ResNet18(num_classes=10, width=16).apply(
+        variables, jnp.asarray(x), train=False)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4,
+                               atol=2e-4)
