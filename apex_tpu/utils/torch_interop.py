@@ -49,9 +49,16 @@ def _conv(w) -> jnp.ndarray:
 
 
 def load_torch_resnet(state_dict: Mapping[str, Any],
-                      arch: str = "resnet50") -> Dict[str, Any]:
+                      arch: str = "resnet50",
+                      norm_name: str = "BatchNorm") -> Dict[str, Any]:
     """Convert a torchvision-format ResNet ``state_dict`` into the
-    variables pytree of ``models.ResNetXX`` (see module docstring)."""
+    variables pytree of ``models.ResNetXX`` (see module docstring).
+
+    ``norm_name``: class name of the model's block norm layers — flax
+    auto-names them ``{ClassName}_{i}``, so a model built with
+    ``norm=parallel.SyncBatchNorm`` (``convert_syncbn_model`` /
+    ``--sync_bn``) needs ``norm_name="SyncBatchNorm"``.  The explicitly
+    named ``stem_bn``/``downsample_bn`` are unaffected."""
     if arch not in _ARCH:
         raise ValueError(f"unknown arch {arch!r}; have {sorted(_ARCH)}")
     block_name, stage_sizes, convs_per_block = _ARCH[arch]
@@ -102,7 +109,7 @@ def load_torch_resnet(state_dict: Mapping[str, Any],
             for c in range(convs_per_block):
                 blk_p[f"Conv_{c}"] = {
                     "kernel": _conv(sd[f"{src}.conv{c + 1}.weight"])}
-                bn(f"{src}.bn{c + 1}", f"BatchNorm_{c}", blk_p, blk_s)
+                bn(f"{src}.bn{c + 1}", f"{norm_name}_{c}", blk_p, blk_s)
             if f"{src}.downsample.0.weight" in sd:
                 blk_p["downsample_conv"] = {
                     "kernel": _conv(sd[f"{src}.downsample.0.weight"])}

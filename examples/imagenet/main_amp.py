@@ -94,6 +94,10 @@ def parse_args():
     p.add_argument("--zero", action="store_true",
                    help="ZeRO-1: shard optimizer state across the data "
                    "axis (parallel.shard_optimizer_state)")
+    p.add_argument("--torch-weights", default=None, metavar="PT",
+                   help="initialize from a torchvision-format torch "
+                   "checkpoint (.pt state_dict; 'module.' DDP prefixes "
+                   "handled) via utils.load_torch_resnet")
     return p.parse_args()
 
 
@@ -210,6 +214,23 @@ def main():
     variables = model.init(rng, dummy, train=True)
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
+    if args.torch_weights:
+        # migration path: start from a torchvision-format checkpoint
+        # (e.g. one trained with the reference library)
+        import torch
+        from apex_tpu.utils import load_torch_resnet
+        sd = torch.load(args.torch_weights, map_location="cpu")
+        sd = sd.get("state_dict", sd)  # accept full checkpoint dicts
+        converted = load_torch_resnet(
+            sd, arch=args.arch,
+            norm_name="SyncBatchNorm" if args.sync_bn else "BatchNorm")
+        # amp owns the canonical dtype layout (fp32 masters / O3 half,
+        # batch_stats included)
+        converted = model.canonical_variables(converted)
+        params, batch_stats = (converted["params"],
+                               converted["batch_stats"])
+        maybe_print(f"loaded torch weights from {args.torch_weights}",
+                    rank0=True)
     opt_state = optimizer.init(params)
 
     start_epoch = 0

@@ -224,3 +224,22 @@ def test_ddp_module_prefix_stripped(torch_model):
         variables, jnp.asarray(x), train=False)
     np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4,
                                atol=2e-4)
+
+
+def test_syncbn_norm_name_matches_structure(torch_model):
+    """A model built with norm=SyncBatchNorm auto-names its block norms
+    SyncBatchNorm_{i}; norm_name routes the converted params there."""
+    from apex_tpu.parallel import SyncBatchNorm
+
+    variables = load_torch_resnet(torch_model.state_dict(),
+                                  arch="resnet18",
+                                  norm_name="SyncBatchNorm")
+    flax_model = models.ResNet18(num_classes=10, width=16,
+                                 norm=SyncBatchNorm)
+    ref = flax_model.init(jax.random.PRNGKey(0),
+                          jnp.ones((1, 32, 32, 3)), train=True)
+    ref_paths = [p for p, _ in
+                 jax.tree_util.tree_flatten_with_path(ref)[0]]
+    got_paths = [p for p, _ in
+                 jax.tree_util.tree_flatten_with_path(variables)[0]]
+    assert ref_paths == got_paths
