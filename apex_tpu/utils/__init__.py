@@ -25,12 +25,13 @@ from apex_tpu.utils.profiling import (
     stop_trace,
 )
 from apex_tpu.utils import checkpoint
-from apex_tpu.utils.torch_interop import load_torch_resnet
+from apex_tpu.utils.torch_interop import load_hf_bert, load_torch_resnet
 
 __all__ = [
     "AverageMeter",
     "annotate_function",
     "checkpoint",
+    "load_hf_bert",
     "load_torch_resnet",
     "maybe_print",
     "start_trace",

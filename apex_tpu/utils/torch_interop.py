@@ -48,6 +48,14 @@ def _conv(w) -> jnp.ndarray:
     return jnp.asarray(_np(w).transpose(2, 3, 1, 0))  # OIHW -> HWIO
 
 
+def _strip_module_prefix(state_dict):
+    """DDP-wrapped models save "module."-prefixed keys (the reference's
+    own imagenet script does); strip a uniform prefix transparently."""
+    if state_dict and all(k.startswith("module.") for k in state_dict):
+        return {k[len("module."):]: v for k, v in state_dict.items()}
+    return state_dict
+
+
 def load_torch_resnet(state_dict: Mapping[str, Any],
                       arch: str = "resnet50",
                       norm_name: str = "BatchNorm") -> Dict[str, Any]:
@@ -63,11 +71,7 @@ def load_torch_resnet(state_dict: Mapping[str, Any],
         raise ValueError(f"unknown arch {arch!r}; have {sorted(_ARCH)}")
     block_name, stage_sizes, convs_per_block = _ARCH[arch]
 
-    # DDP-wrapped models save "module."-prefixed keys (the reference's
-    # own imagenet script does); strip a uniform prefix transparently
-    if state_dict and all(k.startswith("module.") for k in state_dict):
-        state_dict = {k[len("module."):]: v for k, v in state_dict.items()}
-
+    state_dict = _strip_module_prefix(state_dict)
     consumed = set()
 
     class _Tracking:
@@ -133,3 +137,117 @@ def load_torch_resnet(state_dict: Mapping[str, Any],
             f"state_dict has {len(leftovers)} keys not consumed by "
             f"arch={arch!r} (e.g. {sorted(leftovers)[:4]}); wrong arch?")
     return {"params": params, "batch_stats": stats}
+
+
+def load_hf_bert(state_dict: Mapping[str, Any],
+                 num_hidden_layers: int,
+                 num_attention_heads: int) -> Dict[str, Any]:
+    """Convert a HuggingFace ``BertForPreTraining`` ``state_dict`` into
+    the params pytree of ``models.BertForPreTraining``.
+
+    Mapping (torch Linear ``weight`` is (out, in); flax kernels are
+    (in, out), attention projections DenseGeneral-shaped):
+
+    - ``bert.embeddings.*`` -> ``encoder/{word,position,token_type}_
+      embeddings`` + ``embeddings_ln``;
+    - ``attention.self.{query,key,value}``: weight.T reshaped
+      ``(H, heads, head_dim)``, bias ``(heads, head_dim)``;
+    - ``attention.output.dense``: weight.T reshaped
+      ``(heads, head_dim, H)``;
+    - ``intermediate/output`` denses and LayerNorms 1:1;
+    - ``cls.predictions.transform`` -> ``mlm_transform``/``mlm_ln``,
+      ``cls.predictions.decoder`` (+ the tied ``cls.predictions.bias``)
+      -> ``mlm_decoder``; ``cls.seq_relationship`` -> ``nsp_classifier``;
+      ``bert.pooler.dense`` -> ``pooler``.
+
+    Returns ``{"params": ...}``; verified numerically against a live
+    ``transformers`` model in ``tests/L0/test_torch_interop.py``.
+    """
+    raw = {k: _np(v)
+           for k, v in _strip_module_prefix(state_dict).items()}
+    consumed = set()
+
+    def get(key):
+        consumed.add(key)
+        try:
+            return raw[key]
+        except KeyError:
+            raise ValueError(
+                f"state_dict is missing {key!r} — not a HuggingFace "
+                "BertForPreTraining checkpoint, or wrong "
+                "num_hidden_layers?") from None
+
+    nh = num_attention_heads
+
+    def lin(src):  # torch Linear -> flax Dense
+        return {"kernel": jnp.asarray(get(f"{src}.weight").T),
+                "bias": jnp.asarray(get(f"{src}.bias"))}
+
+    def ln(src):
+        return {"scale": jnp.asarray(get(f"{src}.weight")),
+                "bias": jnp.asarray(get(f"{src}.bias"))}
+
+    def emb(src):
+        return {"embedding": jnp.asarray(get(f"{src}.weight"))}
+
+    enc: Dict[str, Any] = {
+        "word_embeddings": emb("bert.embeddings.word_embeddings"),
+        "position_embeddings": emb("bert.embeddings.position_embeddings"),
+        "token_type_embeddings": emb(
+            "bert.embeddings.token_type_embeddings"),
+        "embeddings_ln": ln("bert.embeddings.LayerNorm"),
+    }
+    for i in range(num_hidden_layers):
+        src = f"bert.encoder.layer.{i}"
+        h = get(f"{src}.attention.self.query.weight").shape[1]
+        dh = h // nh
+
+        def qkv(name):
+            w = get(f"{src}.attention.self.{name}.weight")
+            b = get(f"{src}.attention.self.{name}.bias")
+            return {"kernel": jnp.asarray(w.T.reshape(h, nh, dh)),
+                    "bias": jnp.asarray(b.reshape(nh, dh))}
+
+        out_w = get(f"{src}.attention.output.dense.weight")
+        enc[f"layer_{i}"] = {
+            "attention": {
+                "query": qkv("query"), "key": qkv("key"),
+                "value": qkv("value"),
+                "output": {
+                    "kernel": jnp.asarray(out_w.T.reshape(nh, dh, h)),
+                    "bias": jnp.asarray(
+                        get(f"{src}.attention.output.dense.bias"))},
+            },
+            "attention_ln": ln(f"{src}.attention.output.LayerNorm"),
+            "intermediate": lin(f"{src}.intermediate.dense"),
+            "output": lin(f"{src}.output.dense"),
+            "output_ln": ln(f"{src}.output.LayerNorm"),
+        }
+
+    if "cls.predictions.decoder.bias" in raw:
+        dec_bias = get("cls.predictions.decoder.bias")
+        consumed.add("cls.predictions.bias")  # tied duplicate, if present
+    else:
+        dec_bias = get("cls.predictions.bias")
+    params = {
+        "encoder": enc,
+        "pooler": lin("bert.pooler.dense"),
+        "mlm_transform": lin("cls.predictions.transform.dense"),
+        "mlm_ln": ln("cls.predictions.transform.LayerNorm"),
+        "mlm_decoder": {
+            "kernel": jnp.asarray(get("cls.predictions.decoder.weight").T),
+            "bias": jnp.asarray(dec_bias)},
+        "nsp_classifier": lin("cls.seq_relationship"),
+    }
+
+    # refuse silent truncation (e.g. a 24-layer checkpoint converted
+    # with num_hidden_layers=12); position_ids is a registered buffer in
+    # some transformers versions, bookkeeping with no param analog
+    leftovers = [k for k in raw if k not in consumed
+                 and not k.endswith("position_ids")]
+    if leftovers:
+        raise ValueError(
+            f"state_dict has {len(leftovers)} keys not consumed with "
+            f"num_hidden_layers={num_hidden_layers} "
+            f"(e.g. {sorted(leftovers)[:4]}); wrong layer count?")
+    return {"params": params}

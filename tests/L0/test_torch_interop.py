@@ -243,3 +243,99 @@ def test_syncbn_norm_name_matches_structure(torch_model):
     got_paths = [p for p, _ in
                  jax.tree_util.tree_flatten_with_path(variables)[0]]
     assert ref_paths == got_paths
+
+
+# ---------------------------------------------------------------------------
+# HF BERT conversion vs a LIVE transformers model
+# ---------------------------------------------------------------------------
+
+def test_hf_bert_forward_parity():
+    transformers = pytest.importorskip("transformers")
+    from apex_tpu.utils.torch_interop import load_hf_bert
+
+    hf_cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=32, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+    torch.manual_seed(0)
+    hf = transformers.BertForPreTraining(hf_cfg).eval()
+
+    cfg = models.BertConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=32, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+    variables = load_hf_bert(hf.state_dict(), num_hidden_layers=2,
+                             num_attention_heads=4)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 128, (2, 16)).astype(np.int64)
+    mask = np.ones_like(ids)
+    mask[:, 12:] = 0
+    segs = rng.randint(0, 2, (2, 16)).astype(np.int64)
+
+    with torch.no_grad():
+        out = hf(input_ids=torch.from_numpy(ids),
+                 attention_mask=torch.from_numpy(mask),
+                 token_type_ids=torch.from_numpy(segs))
+        want_mlm = out.prediction_logits.numpy()
+        want_nsp = out.seq_relationship_logits.numpy()
+
+    got_mlm, got_nsp = models.BertForPreTraining(cfg).apply(
+        variables, jnp.asarray(ids.astype(np.int32)),
+        attention_mask=jnp.asarray(mask.astype(np.int32)),
+        token_type_ids=jnp.asarray(segs.astype(np.int32)),
+        deterministic=True)
+
+    np.testing.assert_allclose(np.asarray(got_nsp), want_nsp, rtol=1e-4,
+                               atol=1e-4)
+    # compare only non-padding positions: HF masks attention the same
+    # way but padding rows still differ by the mask's -1e9 vs -10000
+    np.testing.assert_allclose(np.asarray(got_mlm)[:, :12], want_mlm[:, :12],
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_hf_bert_structure_matches_init():
+    transformers = pytest.importorskip("transformers")
+    from apex_tpu.utils.torch_interop import load_hf_bert
+
+    hf_cfg = transformers.BertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=2, intermediate_size=48,
+        max_position_embeddings=16)
+    hf = transformers.BertForPreTraining(hf_cfg)
+    variables = load_hf_bert(hf.state_dict(), 1, 2)
+
+    cfg = models.BertConfig(vocab_size=64, hidden_size=32,
+                            num_hidden_layers=1, num_attention_heads=2,
+                            intermediate_size=48,
+                            max_position_embeddings=16)
+    ref = models.BertForPreTraining(cfg).init(
+        jax.random.PRNGKey(0), jnp.ones((1, 8), jnp.int32))
+    ref_paths = [p for p, _ in
+                 jax.tree_util.tree_flatten_with_path(ref)[0]]
+    got_paths = [p for p, _ in
+                 jax.tree_util.tree_flatten_with_path(variables)[0]]
+    assert ref_paths == got_paths
+    for (p, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(ref)[0],
+            jax.tree_util.tree_flatten_with_path(variables)[0]):
+        assert a.shape == b.shape, (p, a.shape, b.shape)
+
+
+def test_hf_bert_layer_count_mismatch_raises():
+    transformers = pytest.importorskip("transformers")
+    from apex_tpu.utils.torch_interop import load_hf_bert
+
+    hf_cfg = transformers.BertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=48,
+        max_position_embeddings=16)
+    hf = transformers.BertForPreTraining(hf_cfg)
+    with pytest.raises(ValueError, match="wrong layer count"):
+        load_hf_bert(hf.state_dict(), num_hidden_layers=1,
+                     num_attention_heads=2)
+    with pytest.raises(ValueError, match="missing"):
+        load_hf_bert(hf.state_dict(), num_hidden_layers=4,
+                     num_attention_heads=2)
