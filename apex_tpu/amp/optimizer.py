@@ -66,11 +66,17 @@ class AmpOptimizer:
 
     # -- granular protocol (multi-loss / grad accumulation) ---------------
     def unscale_grads(self, grads: Pytree, state: AmpOptimizerState,
-                      loss_id: int = 0, *, stashed: Optional[Pytree] = None):
+                      loss_id: int = 0, *, stashed: Optional[Pytree] = None,
+                      update_scale: bool = True):
         """Unscale one loss's grads; returns (grads, overflow, new_state).
 
         With ``stashed`` accumulates into previously-unscaled grads
-        (reference ``scaler.py:149-180``).
+        (reference ``scaler.py:149-180``).  ``update_scale=False`` defers
+        the dynamic-scale update — the grad-accumulation protocol: the
+        reference updates the scale ONCE per optimizer step from the
+        overflow state accumulated across every microbatch's unscale
+        (``scaler.py:184-210``), so intermediate microbatches pass False
+        and the step ends with :meth:`update_scale` on the ORed flag.
         """
         sstate = state.loss_scalers[loss_id]
         if stashed is None:
@@ -79,10 +85,20 @@ class AmpOptimizer:
         else:
             g, overflow = self.loss_scaler.unscale_with_stashed(
                 grads, stashed, sstate)
-        new_sstate = self.loss_scaler.update(sstate, overflow)
+        if not update_scale:
+            return g, overflow, state
+        return g, overflow, self.update_scale(state, overflow, loss_id)
+
+    def update_scale(self, state: AmpOptimizerState, overflow,
+                     loss_id: int = 0) -> AmpOptimizerState:
+        """One dynamic-scale update from an (accumulated) overflow flag —
+        the per-step half of the grad-accumulation protocol (see
+        :meth:`unscale_grads`)."""
+        new_sstate = self.loss_scaler.update(
+            state.loss_scalers[loss_id], overflow)
         scalers = tuple(new_sstate if i == loss_id else s
                         for i, s in enumerate(state.loss_scalers))
-        return g, overflow, state._replace(loss_scalers=scalers)
+        return state._replace(loss_scalers=scalers)
 
     def apply_gradients(self, params: Pytree, grads: Pytree,
                         state: AmpOptimizerState, overflow) -> Tuple[Pytree, AmpOptimizerState]:

@@ -52,6 +52,10 @@ def parse_args():
                    help="replace each layer's MLP with a Switch-MoE of "
                    "E experts (aux load-balance loss auto-added; shard "
                    "experts with models.EP_RULES for EP)")
+    p.add_argument("--grad-accum", type=int, default=1, metavar="A",
+                   help="accumulate grads over A microbatches per step "
+                   "(amp unscale-with-stashed protocol; overflow in ANY "
+                   "microbatch skips the whole update)")
     return p.parse_args()
 
 
@@ -148,31 +152,90 @@ def main():
     params = jax.device_put(params, repl)
     opt_state = jax.device_put(opt_state, repl)
 
+    def batch_loss(p, ids, labels, weights, nsp, mlm_denom, div):
+        """Shared by the plain and grad-accum steps: MLM (weighted by
+        mask positions over ``mlm_denom``) + NSP/div + MoE aux/div."""
+        if args.moe:
+            (mlm_logits, nsp_logits), mut = model.apply(
+                {"params": p}, ids, deterministic=True,
+                mutable=["losses"])
+            aux = sum(jnp.sum(leaf) for leaf in
+                      jax.tree_util.tree_leaves(mut["losses"]))
+        else:
+            mlm_logits, nsp_logits = model.apply(
+                {"params": p}, ids, deterministic=True)
+            aux = 0.0
+        mlm_losses = optax.softmax_cross_entropy_with_integer_labels(
+            mlm_logits, labels)
+        mlm_loss = jnp.sum(mlm_losses * weights) / mlm_denom
+        nsp_loss = optax.softmax_cross_entropy_with_integer_labels(
+            nsp_logits, nsp).mean() / div
+        return mlm_loss + nsp_loss + 0.01 * aux / div
+
     @jax.jit
     def train_step(params, opt_state, ids, labels, weights, nsp):
         def loss_fn(p):
-            if args.moe:
-                (mlm_logits, nsp_logits), mut = model.apply(
-                    {"params": p}, ids, deterministic=True,
-                    mutable=["losses"])
-                aux = sum(jnp.sum(leaf) for leaf in
-                          jax.tree_util.tree_leaves(mut["losses"]))
-            else:
-                mlm_logits, nsp_logits = model.apply(
-                    {"params": p}, ids, deterministic=True)
-                aux = 0.0
-            mlm_losses = optax.softmax_cross_entropy_with_integer_labels(
-                mlm_logits, labels)
-            mlm_loss = jnp.sum(mlm_losses * weights) / \
-                jnp.maximum(jnp.sum(weights), 1.0)
-            nsp_loss = optax.softmax_cross_entropy_with_integer_labels(
-                nsp_logits, nsp).mean()
-            loss = mlm_loss + nsp_loss + 0.01 * aux
+            loss = batch_loss(p, ids, labels, weights, nsp,
+                              jnp.maximum(jnp.sum(weights), 1.0), 1.0)
             with amp.scale_loss(loss, opt_state) as scaled:
                 return scaled, loss
         (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         params, opt_state = optimizer.step(params, grads, opt_state)
         return params, opt_state, loss
+
+    accum = args.grad_accum
+    if accum < 1:
+        raise SystemExit(f"--grad-accum must be >= 1, got {accum}")
+    if accum > 1:
+        if args.b % accum:
+            raise SystemExit(f"batch {args.b} must divide by "
+                             f"--grad-accum {accum}")
+        if (args.b // accum) % dp:
+            raise SystemExit(
+                f"microbatch {args.b // accum} (b/{accum}) must divide "
+                f"by dp={dp}")
+
+        @jax.jit
+        def train_step(params, opt_state, ids, labels, weights, nsp):
+            """Microbatched variant (reference delay_unscale /
+            unscale_with_stashed protocol): per-microbatch backward,
+            grads unscale-accumulated into the stash, the dynamic scale
+            updated ONCE per step from the ORed overflow, one optimizer
+            step.  The loop unrolls A forward/backward graphs into the
+            jit — compile time grows with A; fine for the usual 2-8.
+            The accumulated grad equals the full-batch grad: the MLM
+            term divides by the GLOBAL mask count."""
+            # STRIDED microbatches (a[j::accum]) keep each microbatch
+            # spread across all data-axis devices; a contiguous reshape
+            # would land each microbatch on dp/accum devices and force a
+            # redistribution every step
+            mb = lambda a: jnp.stack([a[j::accum] for j in range(accum)])
+            ids_m, labels_m = mb(ids), mb(labels)
+            weights_m, nsp_m = mb(weights), mb(nsp)
+            denom = jnp.maximum(jnp.sum(weights), 1.0)
+
+            stashed = None
+            overflow = jnp.asarray(False)
+            st = opt_state
+            total_loss = 0.0
+            for j in range(accum):
+                def loss_fn(p):
+                    loss = batch_loss(p, ids_m[j], labels_m[j],
+                                      weights_m[j], nsp_m[j], denom,
+                                      float(accum))
+                    with amp.scale_loss(loss, st) as scaled:
+                        return scaled, loss
+                (_, loss_j), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                grads, ovf, st = optimizer.unscale_grads(
+                    grads, st, 0, stashed=stashed, update_scale=False)
+                stashed = grads
+                overflow = overflow | ovf
+                total_loss = total_loss + loss_j
+            st = optimizer.update_scale(st, overflow, 0)
+            params2, st = optimizer.apply_gradients(
+                params, stashed, st, overflow)
+            return params2, st, total_loss
 
     rng = np.random.RandomState(0)
     losses, batch_time = AverageMeter(), AverageMeter()

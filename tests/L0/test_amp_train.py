@@ -185,3 +185,50 @@ def test_O2_grads_match_fp32_reference():
                     jax.tree_util.tree_leaves(g_ref)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(r),
                                    rtol=0.05, atol=0.01)
+
+
+def test_grad_accum_defers_scale_update():
+    """The grad-accumulation protocol: unscale_grads(update_scale=False)
+    must not advance the dynamic scaler; the one update_scale() call at
+    step end advances it exactly once from the ORed overflow (the
+    reference's one-update-per-step contract, scaler.py:184-210)."""
+    model, optimizer = amp.initialize(MLP(), optax.sgd(0.05),
+                                      opt_level="O2", verbosity=0)
+    optimizer.loss_scaler.scale_window = 2
+    params = model.init(jax.random.PRNGKey(1), jnp.ones((2, 8)))
+    opt_state = optimizer.init(params)
+    x, y = data()
+
+    def grads_for(x_in, st):
+        def loss_fn(p):
+            logits = model.apply(p, x_in).astype(jnp.float32)
+            return amp.scale(
+                optax.softmax_cross_entropy_with_integer_labels(
+                    logits, y).mean(), st)
+        return jax.grad(loss_fn)(params)
+
+    s0 = float(optimizer.loss_scale(opt_state))
+
+    # two clean microbatches: scaler advances ONCE -> with window 2 it
+    # must NOT have doubled yet after one accumulated step
+    st = opt_state
+    g1, ov1, st = optimizer.unscale_grads(grads_for(x, st), st,
+                                          update_scale=False)
+    g, ov2, st = optimizer.unscale_grads(grads_for(x, st), st,
+                                         stashed=g1, update_scale=False)
+    st = optimizer.update_scale(st, ov1 | ov2)
+    params2, st = optimizer.apply_gradients(params, g, st, ov1 | ov2)
+    assert float(optimizer.loss_scale(st)) == s0
+    assert int(st.applied_steps) == 1
+
+    # an overflow in the FIRST microbatch halves the scale exactly once
+    x_bad = x.at[0, 0].set(jnp.inf)
+    st2 = opt_state
+    g1, ov1, st2 = optimizer.unscale_grads(grads_for(x_bad, st2), st2,
+                                           update_scale=False)
+    g, ov2, st2 = optimizer.unscale_grads(grads_for(x, st2), st2,
+                                          stashed=g1, update_scale=False)
+    st2 = optimizer.update_scale(st2, ov1 | ov2)
+    _, st2 = optimizer.apply_gradients(params, g, st2, ov1 | ov2)
+    assert float(optimizer.loss_scale(st2)) == s0 / 2
+    assert int(st2.skipped_steps) == 1

@@ -54,10 +54,14 @@ def test_dcgan_multi_loss():
 
 
 @pytest.mark.parametrize("extra", [[], ["--remat"], ["--moe", "4"],
-                                   ["--remat", "--moe", "4"]],
-                         ids=["plain", "remat", "moe", "remat_moe"])
+                                   ["--remat", "--moe", "4"],
+                                   ["--grad-accum", "2"]],
+                         ids=["plain", "remat", "moe", "remat_moe",
+                              "grad_accum"])
 def test_bert_tiny(extra):
-    out = _run("examples/bert/main_amp.py", "--config", "tiny", "--b", "8",
+    # b=16: the grad-accum microbatch (b/2) must still divide the device
+    # count the subprocess may inherit (up to 8)
+    out = _run("examples/bert/main_amp.py", "--config", "tiny", "--b", "16",
                "--seq-len", "32", "--steps", "3", *extra)
     assert "loss" in out.lower()
 
