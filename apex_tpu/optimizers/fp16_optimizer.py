@@ -69,6 +69,15 @@ class FP16_Optimizer:
             self.loss_scaler = LossScaler(static_loss_scale)
         self.verbose = verbose
 
+    def with_zero(self, mesh, axis: str = "data") -> "FP16_Optimizer":
+        """ZeRO-1 pairing: the inner FusedAdam's Pallas update runs
+        shard-local over ``axis`` (``FusedAdam.with_zero``)."""
+        new = FP16_Optimizer.__new__(FP16_Optimizer)
+        new.optimizer = self.optimizer.with_zero(mesh, axis)
+        new.loss_scaler = self.loss_scaler
+        new.verbose = self.verbose
+        return new
+
     def init(self, params_half: Pytree) -> FP16OptimizerState:
         # pad the master like the inner optimizer pads its moments, so
         # ZeRO-1 (parallel.shard_optimizer_state) can shard ALL the big

@@ -31,6 +31,7 @@ provided so FusedAdam slots into ``amp.initialize`` as the inner optimizer.
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
@@ -171,6 +172,7 @@ class FusedAdam:
         self.max_grad_norm = max_grad_norm
         self.use_pallas = use_pallas
         self.pad_to = pad_to
+        self._zero = None  # (mesh, axis) once with_zero() configures it
         self.param_groups = list(param_groups) if param_groups else []
         if self.param_groups:
             from apex_tpu.optimizers.param_groups import validate_specs
@@ -181,6 +183,44 @@ class FusedAdam:
         return {"lr": self.lr, "betas": self.betas, "eps": self.eps,
                 "weight_decay": self.weight_decay,
                 "max_grad_norm": self.max_grad_norm}
+
+    def _clone(self, **overrides) -> "FusedAdam":
+        kw = dict(lr=self.lr, bias_correction=self.bias_correction,
+                  betas=self.betas, eps=self.eps,
+                  eps_inside_sqrt=self.eps_inside_sqrt,
+                  weight_decay=self.weight_decay,
+                  max_grad_norm=self.max_grad_norm,
+                  use_pallas=self.use_pallas,
+                  param_groups=self.param_groups, pad_to=self.pad_to)
+        kw.update(overrides)
+        new = FusedAdam(**kw)
+        new._zero = self._zero
+        return new
+
+    def with_zero(self, mesh, axis: str = "data") -> "FusedAdam":
+        """Return a copy whose Pallas update runs shard-local over ``axis``.
+
+        ZeRO-1 composition (``parallel.shard_optimizer_state``): the raw
+        ``pallas_call`` lowers to a ``tpu_custom_call`` that carries no
+        GSPMD partitioning rule, so under a sharded m/v state XLA would
+        re-gather the flat buffers — defeating the memory win.  Configured
+        with the mesh, the kernel is wrapped in ``jax.shard_map`` over the
+        ZeRO axis instead: each device updates only its 1/n slice of the
+        flat buffers (the update is elementwise, so no collectives), and
+        the sharded placement survives the step.  The buffers are padded
+        to ``pad_to`` (default 128) at ``init`` precisely so they divide
+        evenly.
+
+        ``axis`` must be the same axis the state was sharded on by
+        ``parallel.shard_optimizer_state`` — the kernel's out_specs SET
+        the output placement, so a mismatched axis would reshard the
+        buffers every step.  Buffers below that helper's min-size
+        threshold (``axis_size * 128`` elements) take the jnp update and
+        stay replicated, matching its placement decision.
+        """
+        new = self._clone()
+        new._zero = (mesh, axis)
+        return new
 
     # -- optax GradientTransformation protocol ---------------------------
     def init(self, params: Pytree) -> FusedAdamState:
@@ -219,14 +259,9 @@ class FusedAdam:
         # PREPEND: group resolution is first-match-wins, so the newest
         # declaration must come first to actually override leaves an
         # earlier group already matched
-        new_opt = FusedAdam(
-            lr=self.lr, bias_correction=self.bias_correction,
-            betas=self.betas, eps=self.eps,
-            eps_inside_sqrt=self.eps_inside_sqrt,
-            weight_decay=self.weight_decay,
-            max_grad_norm=self.max_grad_norm, use_pallas=self.use_pallas,
+        new_opt = self._clone(
             param_groups=[dict(match=match, **overrides)]
-            + self.param_groups, pad_to=self.pad_to)
+            + self.param_groups)
         new_state = new_opt.init(params)
         # carry over moments by leaf path (old layout -> new layout)
         old_m = unflatten(state.m, state.spec, cast_back=False)
@@ -325,10 +360,40 @@ class FusedAdam:
                 combined_scale,
                 jnp.asarray(hp["weight_decay"], jnp.float32),
             ])
-            return _adam_flat_pallas(
-                p, m, v, g, scalars,
-                eps_inside_sqrt=self.eps_inside_sqrt,
+            call = functools.partial(
+                _adam_flat_pallas, eps_inside_sqrt=self.eps_inside_sqrt,
                 interpret=not on_tpu())
+            if self._zero is not None:
+                mesh, ax = self._zero
+                nshard = mesh.shape[ax]
+                # mirror shard_optimizer_state's min-size threshold: a
+                # buffer it left replicated must not be force-sharded by
+                # the kernel's out_specs (placement flip + recompile
+                # under donation)
+                if p.shape[0] % nshard == 0 and \
+                        p.shape[0] >= nshard * 128:
+                    # ZeRO composition: run the kernel shard-local over
+                    # the axis the flat state is sharded on (with_zero);
+                    # elementwise update, so no collectives inside
+                    from jax.sharding import PartitionSpec as P
+                    sharded = P(ax)
+                    # check_vma=False: pallas_call outputs carry no vma
+                    # annotation; the update is shard-local elementwise,
+                    # so there is no replication invariant to check
+                    return jax.shard_map(
+                        call, mesh=mesh,
+                        in_specs=(sharded, sharded, sharded, sharded, P()),
+                        out_specs=(sharded, sharded, sharded),
+                        check_vma=False)(p, m, v, g, scalars)
+                # a group slice that doesn't divide the axis (grouped
+                # layouts pad only the total buffer), or a buffer small
+                # enough that shard_optimizer_state left it replicated:
+                # the jnp update follows the state's placement for free
+                return _adam_math(
+                    p, m, v, g, step_size, beta1, beta2, hp["eps"],
+                    combined_scale, hp["weight_decay"],
+                    self.eps_inside_sqrt)
+            return call(p, m, v, g, scalars)
         return _adam_math(
             p, m, v, g, step_size, beta1, beta2, hp["eps"],
             combined_scale, hp["weight_decay"], self.eps_inside_sqrt)
@@ -352,6 +417,27 @@ class FusedAdam:
         step = state.step + 1
         use_pallas = self.use_pallas if self.use_pallas is not None \
             else on_tpu()
+        if use_pallas and self._zero is None:
+            # eager-path guard: a sharded state meeting the un-configured
+            # Pallas kernel would be silently re-gathered by GSPMD (no
+            # partitioning rule on the custom call), defeating ZeRO's
+            # memory win — fall back to the partitionable jnp update and
+            # tell the user about with_zero.  (Inside jit the committed
+            # input sharding is not visible on tracers; the same pairing
+            # is then the caller's contract, parallel/zero.py.)
+            try:
+                sharding = (getattr(state.m, "sharding", None)
+                            if jax.core.is_concrete(state.m) else None)
+            except Exception:
+                sharding = None
+            if sharding is not None and not sharding.is_fully_replicated:
+                warnings.warn(
+                    "FusedAdam: optimizer state is sharded but the Pallas "
+                    "kernel has no GSPMD partitioning rule; using the jnp "
+                    "update instead. Configure the fused path with "
+                    "optimizer.with_zero(mesh, axis) to run it "
+                    "shard-local.", stacklevel=3)
+                use_pallas = False
 
         bounds = state.spec.group_bounds or ((0, state.spec.total),)
         hps = group_hparams(self._defaults(), self.param_groups)

@@ -24,14 +24,16 @@ Two contracts:
 
 1. the train step must be jitted over the SAME mesh so GSPMD can honor
    the placement (a ``with mesh:`` scope or explicit shardings);
-2. the optimizer update must be expressed in partitionable ops.  The
-   pure-jnp Adam path is (elementwise ops partition shard-local for
-   free); the Pallas kernel is a *single-chip* optimization whose
-   ``tpu_custom_call`` carries no GSPMD partitioning rule — under a
-   sharded state XLA re-gathers its operands, defeating the memory win.
-   So pair ZeRO with ``FusedAdam(use_pallas=False)`` on TPU; the
-   elementwise update is HBM-bandwidth-bound either way, and XLA fuses
-   the jnp form into one sharded loop.
+2. the optimizer update must partition along the sharded buffers.  The
+   pure-jnp Adam path does for free (elementwise ops run shard-local);
+   the Pallas kernel's ``tpu_custom_call`` carries no GSPMD partitioning
+   rule, so it must be told the mesh:
+   ``optimizer = optimizer.with_zero(mesh, axis)`` wraps the kernel in
+   ``jax.shard_map`` over the ZeRO axis — each device updates only its
+   slice of the flat buffers (the buffers are padded to 128 at init so
+   they divide evenly).  An un-configured Pallas path meeting a sharded
+   state falls back to the jnp update with a warning on the eager path;
+   inside jit the pairing is the caller's contract.
 
 Works for any optimizer state pytree; scalars and sub-axis-length
 leaves stay replicated.
@@ -48,20 +50,28 @@ Pytree = Any
 
 
 def shard_optimizer_state(opt_state: Pytree, mesh: Mesh,
-                          axis: str = "data") -> Pytree:
+                          axis: str = "data",
+                          min_shard_elems: int | None = None) -> Pytree:
     """Place large leaves of ``opt_state`` sharded along ``axis``,
     everything else replicated.
 
-    Each leaf is sharded on its first dimension that divides evenly
-    across the axis — flat fp32 m/v/master buffers on dim 0 (the main
-    win), per-leaf moment trees (sgd momentum, optax.adam, FusedLAMB) on
-    a channel dim — while scalars (step counters, loss scales) and
-    leaves with no evenly-divisible dimension stay replicated.  Returns
-    a new state pytree; pass it
-    through the jitted step with donation and the sharding sticks for
-    the life of training.
+    Each large-enough leaf is sharded on its first dimension that divides
+    evenly across the axis — flat fp32 m/v/master buffers on dim 0 (the
+    main win), per-leaf moment trees (sgd momentum, optax.adam, FusedLAMB)
+    on a channel dim — while scalars (step counters, loss scales), and
+    leaves with no evenly-divisible dimension stay replicated.
+
+    ``min_shard_elems`` (default ``axis_size * 128``, one lane-width tile
+    per device): leaves below it stay replicated — sharding an (8,)
+    bias moment 1 element/device buys nothing and costs a per-leaf
+    collective on every touch.
+
+    Returns a new state pytree; pass it through the jitted step with
+    donation and the sharding sticks for the life of training.
     """
     n = mesh.shape[axis]
+    if min_shard_elems is None:
+        min_shard_elems = n * 128
     repl = NamedSharding(mesh, P())
 
     def place(x):
@@ -73,11 +83,12 @@ def shard_optimizer_state(opt_state: Pytree, mesh: Mesh,
         # per-leaf moment trees (optax sgd/adam, FusedLAMB) shard on
         # whichever axis divides — e.g. a (3,3,256,256) conv moment
         # shards its channel dim.  Numerics never change, only placement.
-        for d in range(x.ndim):
-            if x.shape[d] >= n and x.shape[d] % n == 0:
-                spec = [None] * x.ndim
-                spec[d] = axis
-                return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+        if x.size >= min_shard_elems:
+            for d in range(x.ndim):
+                if x.shape[d] >= n and x.shape[d] % n == 0:
+                    spec = [None] * x.ndim
+                    spec[d] = axis
+                    return jax.device_put(x, NamedSharding(mesh, P(*spec)))
         return jax.device_put(x, repl)
 
     return jax.tree_util.tree_map(place, opt_state)
