@@ -54,13 +54,15 @@ class AmpOptimizer:
         self.loss_scaler = loss_scaler
         self.num_losses = int(num_losses)
 
-    def with_zero(self, mesh, axis: str = "data") -> "AmpOptimizer":
+    def with_zero(self, mesh, axis: str = "data",
+                  min_shard_elems: Optional[int] = None) -> "AmpOptimizer":
         """ZeRO-1 pairing passthrough: reconfigure the wrapped optimizer's
         fused path to run shard-local over ``axis`` (see
         ``FusedAdam.with_zero`` / ``parallel.shard_optimizer_state``)."""
         if not hasattr(self.inner, "with_zero"):
             return self  # per-leaf optimizers partition shard-local as-is
-        return AmpOptimizer(self.inner.with_zero(mesh, axis),
+        return AmpOptimizer(self.inner.with_zero(mesh, axis,
+                                                 min_shard_elems),
                             self.loss_scaler, self.num_losses)
 
     # -- state ------------------------------------------------------------

@@ -140,11 +140,19 @@ def _decode_eval(path: str, image_size: int):
 def image_folder_loader(root: str, batch_size: int, image_size: int = 224,
                         train: bool = True, shuffle: Optional[bool] = None,
                         seed: int = 0, num_workers: int = 8,
-                        loop: bool = True, samples=None):
+                        loop: bool = True, samples=None,
+                        native: bool = True):
     """Stream (x uint8 NHWC, y int32) batches from a torchvision-style
-    image folder using a PIL decode pool — the real-data input path the
-    reference gets from ``datasets.ImageFolder`` + ``DataLoader`` workers
-    (``examples/imagenet/main_amp.py``).
+    image folder — the real-data input path the reference gets from
+    ``datasets.ImageFolder`` + multi-worker ``DataLoader`` + fast_collate
+    (``examples/imagenet/main_amp.py:218-225,256-303``).
+
+    Decode path: with ``native`` (default) JPEG files are decoded by ONE
+    GIL-free C call per batch (libjpeg-turbo, one thread per image,
+    transform fused into the decode — ``ops.native.decode_jpeg_batch``);
+    non-JPEG files and any the native decoder rejects fall back to a PIL
+    thread pool.  ``native=False`` forces the PIL pool everywhere (parity
+    oracle for tests).
 
     ``train`` picks the transform (RandomResizedCrop+flip vs
     Resize+CenterCrop).  ``loop=False`` yields one pass (validation) with
@@ -162,13 +170,16 @@ def image_folder_loader(root: str, batch_size: int, image_size: int = 224,
     if shuffle is None:
         shuffle = train
     return _image_folder_iter(samples, batch_size, image_size, train,
-                              shuffle, seed, num_workers, loop)
+                              shuffle, seed, num_workers, loop, native)
 
 
 def _image_folder_iter(samples, batch_size, image_size, train, shuffle,
-                       seed, num_workers, loop):
+                       seed, num_workers, loop, native=True):
     from concurrent.futures import ThreadPoolExecutor
 
+    from apex_tpu.ops import native as native_ops
+
+    use_native = native and native_ops.jpeg_available
     rng = np.random.RandomState(seed)
     pool = ThreadPoolExecutor(max_workers=num_workers)
 
@@ -181,6 +192,36 @@ def _image_folder_iter(samples, batch_size, image_size, train, shuffle,
                                  np.random.RandomState(item_seed)), label
         return _decode_eval(path, image_size), label
 
+    def assemble(idx, seeds):
+        items = [samples[j] for j in idx]
+        y = np.asarray([label for _, label in items], np.int32)
+        if use_native:
+            x = np.empty((len(items), image_size, image_size, 3), np.uint8)
+            jpeg_rows = [r for r, (p, _) in enumerate(items)
+                         if p.lower().endswith((".jpg", ".jpeg"))]
+            jset = set(jpeg_rows)
+            rest = [r for r in range(len(items)) if r not in jset]
+            if jpeg_rows:
+                batch, fail = native_ops.decode_jpeg_batch(
+                    [items[r][0] for r in jpeg_rows], image_size,
+                    train=train,
+                    seeds=np.asarray([seeds[r] for r in jpeg_rows],
+                                     np.uint64))
+                for k, r in enumerate(jpeg_rows):
+                    if fail[k]:
+                        rest.append(r)  # corrupt/CMYK: PIL fallback
+                    else:
+                        x[r] = batch[k]
+            if rest:
+                decoded = list(pool.map(
+                    decode, [(items[r], seeds[r]) for r in rest]))
+                for k, r in enumerate(rest):
+                    x[r] = decoded[k][0]
+            return x, y
+        decoded = list(pool.map(
+            decode, [(it, s) for it, s in zip(items, seeds)]))
+        return np.stack([d[0] for d in decoded]).astype(np.uint8), y
+
     while True:
         order = rng.permutation(len(samples)) if shuffle \
             else np.arange(len(samples))
@@ -189,11 +230,7 @@ def _image_folder_iter(samples, batch_size, image_size, train, shuffle,
             if train and len(idx) < batch_size:
                 break  # drop ragged train tail (the reference's drop_last)
             seeds = rng.randint(2 ** 31, size=len(idx))
-            decoded = list(pool.map(
-                decode, [(samples[j], s) for j, s in zip(idx, seeds)]))
-            x = np.stack([d[0] for d in decoded]).astype(np.uint8)
-            y = np.asarray([d[1] for d in decoded], np.int32)
-            yield x, y
+            yield assemble(idx, seeds)
         if not loop:
             return
 

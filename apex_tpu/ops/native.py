@@ -24,6 +24,8 @@ _LIB_PATH = os.path.join(_HERE, "_libapex_tpu_host.so")
 
 _lib: Optional[ctypes.CDLL] = None
 available = False
+jpeg_available = False
+_ABI = 2
 
 
 def _build() -> bool:
@@ -32,9 +34,14 @@ def _build() -> bool:
         # never load a half-written .so
         fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
         os.close(fd)
-        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-               _SRC, "-o", tmp]
-        r = subprocess.run(cmd, capture_output=True, timeout=120)
+        base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+                _SRC, "-o", tmp]
+        # try with libjpeg (the batch decode path) first; fall back to a
+        # decode-less build on systems without it
+        r = subprocess.run(base + ["-DAPEX_HAVE_JPEG", "-ljpeg"],
+                           capture_output=True, timeout=120)
+        if r.returncode != 0:
+            r = subprocess.run(base, capture_output=True, timeout=120)
         if r.returncode != 0:
             os.unlink(tmp)
             return False
@@ -45,7 +52,7 @@ def _build() -> bool:
 
 
 def _load() -> Optional[ctypes.CDLL]:
-    global _lib, available
+    global _lib, available, jpeg_available
     if _lib is not None:
         return _lib
     if os.environ.get("APEX_TPU_NO_NATIVE"):
@@ -54,20 +61,31 @@ def _load() -> Optional[ctypes.CDLL]:
         return None
     if not os.path.exists(_LIB_PATH) and not _build():
         return None
-    try:
+
+    def _open():
         lib = ctypes.CDLL(_LIB_PATH)
         lib.apex_native_abi_version.restype = ctypes.c_int
-        if lib.apex_native_abi_version() != 1:
-            return None
+        return lib
+
+    try:
+        lib = _open()
+        stale = lib.apex_native_abi_version() != _ABI
     except OSError:
-        # stale .so (e.g. different arch) — rebuild once
+        stale = True  # e.g. different arch
+    if stale:
+        # out-of-date cached .so (older ABI / other arch) — rebuild once
         try:
             os.unlink(_LIB_PATH)
         except OSError:
             pass
         if not _build():
             return None
-        lib = ctypes.CDLL(_LIB_PATH)
+        try:
+            lib = _open()
+        except OSError:
+            return None
+        if lib.apex_native_abi_version() != _ABI:
+            return None
 
     u8p = ctypes.POINTER(ctypes.c_uint8)
     i64p = ctypes.POINTER(ctypes.c_int64)
@@ -80,8 +98,15 @@ def _load() -> Optional[ctypes.CDLL]:
                                    ctypes.c_int64, ctypes.c_int]
     lib.apex_normalize_u8.argtypes = [u8p, ctypes.c_int64, ctypes.c_int64,
                                       f32p, f32p, f32p, ctypes.c_int]
+    lib.apex_decode_jpeg_batch.restype = ctypes.c_int64
+    lib.apex_decode_jpeg_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int64, ctypes.c_int,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_uint64), u8p, u8p,
+        ctypes.c_int]
+    lib.apex_jpeg_available.restype = ctypes.c_int
     _lib = lib
     available = True
+    jpeg_available = bool(lib.apex_jpeg_available())
     return lib
 
 
@@ -176,6 +201,46 @@ def normalize_u8(x: np.ndarray, mean, std, *, n_threads: int = 0) -> np.ndarray:
         std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n_threads)
     return out
+
+
+def decode_jpeg_batch(paths: List[str], image_size: int, *,
+                      train: bool = False, seeds=None,
+                      out: Optional[np.ndarray] = None,
+                      n_threads: int = 0):
+    """Decode + transform a batch of JPEG files into uint8 NHWC — one
+    GIL-free native call, one thread per image (libjpeg-turbo decode,
+    DCT-scaled, transform fused; ``csrc/host_ops.cpp``).
+
+    ``train`` fuses RandomResizedCrop(0.08-1.0)+hflip (per-image
+    ``seeds``); eval fuses Resize(short=size*256/224)+CenterCrop — the
+    reference's torchvision transforms
+    (``examples/imagenet/main_amp.py:218-236``).
+
+    Returns ``(batch, fail)``: ``fail[i]`` is True for files the native
+    path could not decode (missing/corrupt/CMYK/non-JPEG) — those slots
+    are untouched; the caller decodes them with its fallback (PIL).
+    Raises RuntimeError when the native library/libjpeg is unavailable —
+    callers gate on :data:`jpeg_available`.
+    """
+    lib = _load()
+    if lib is None or not jpeg_available:
+        raise RuntimeError("native JPEG decode unavailable "
+                           "(check apex_tpu.ops.native.jpeg_available)")
+    n = len(paths)
+    if out is None:
+        out = np.empty((n, image_size, image_size, 3), np.uint8)
+    assert out.shape == (n, image_size, image_size, 3) and \
+        out.dtype == np.uint8 and out.flags.c_contiguous
+    fail = np.zeros((n,), np.uint8)
+    if seeds is None:
+        seeds = np.zeros((n,), np.uint64)
+    seeds = np.ascontiguousarray(seeds, np.uint64)
+    cpaths = (ctypes.c_char_p * n)(*[os.fsencode(p) for p in paths])
+    lib.apex_decode_jpeg_batch(
+        cpaths, n, image_size, int(train),
+        seeds.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        _u8(out), _u8(fail), n_threads)
+    return out, fail.astype(bool)
 
 
 # trigger a build eagerly so `available` reflects reality at import time,

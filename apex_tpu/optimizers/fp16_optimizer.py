@@ -69,11 +69,13 @@ class FP16_Optimizer:
             self.loss_scaler = LossScaler(static_loss_scale)
         self.verbose = verbose
 
-    def with_zero(self, mesh, axis: str = "data") -> "FP16_Optimizer":
+    def with_zero(self, mesh, axis: str = "data",
+                  min_shard_elems=None) -> "FP16_Optimizer":
         """ZeRO-1 pairing: the inner FusedAdam's Pallas update runs
         shard-local over ``axis`` (``FusedAdam.with_zero``)."""
         new = FP16_Optimizer.__new__(FP16_Optimizer)
-        new.optimizer = self.optimizer.with_zero(mesh, axis)
+        new.optimizer = self.optimizer.with_zero(mesh, axis,
+                                                 min_shard_elems)
         new.loss_scaler = self.loss_scaler
         new.verbose = self.verbose
         return new

@@ -197,7 +197,8 @@ class FusedAdam:
         new._zero = self._zero
         return new
 
-    def with_zero(self, mesh, axis: str = "data") -> "FusedAdam":
+    def with_zero(self, mesh, axis: str = "data",
+                  min_shard_elems: Optional[int] = None) -> "FusedAdam":
         """Return a copy whose Pallas update runs shard-local over ``axis``.
 
         ZeRO-1 composition (``parallel.shard_optimizer_state``): the raw
@@ -211,15 +212,17 @@ class FusedAdam:
         to ``pad_to`` (default 128) at ``init`` precisely so they divide
         evenly.
 
-        ``axis`` must be the same axis the state was sharded on by
+        ``axis`` and ``min_shard_elems`` must match what was given to
         ``parallel.shard_optimizer_state`` — the kernel's out_specs SET
-        the output placement, so a mismatched axis would reshard the
-        buffers every step.  Buffers below that helper's min-size
-        threshold (``axis_size * 128`` elements) take the jnp update and
-        stay replicated, matching its placement decision.
+        the output placement, so a mismatch would reshard the buffers
+        every step.  Buffers below the threshold (default
+        ``axis_size * 128`` elements, same as that helper) take the jnp
+        update and stay replicated, matching its placement decision.
         """
+        if min_shard_elems is None:
+            min_shard_elems = mesh.shape[axis] * 128
         new = self._clone()
-        new._zero = (mesh, axis)
+        new._zero = (mesh, axis, min_shard_elems)
         return new
 
     # -- optax GradientTransformation protocol ---------------------------
@@ -364,14 +367,13 @@ class FusedAdam:
                 _adam_flat_pallas, eps_inside_sqrt=self.eps_inside_sqrt,
                 interpret=not on_tpu())
             if self._zero is not None:
-                mesh, ax = self._zero
+                mesh, ax, min_elems = self._zero
                 nshard = mesh.shape[ax]
                 # mirror shard_optimizer_state's min-size threshold: a
                 # buffer it left replicated must not be force-sharded by
                 # the kernel's out_specs (placement flip + recompile
                 # under donation)
-                if p.shape[0] % nshard == 0 and \
-                        p.shape[0] >= nshard * 128:
+                if p.shape[0] % nshard == 0 and p.shape[0] >= min_elems:
                     # ZeRO composition: run the kernel shard-local over
                     # the axis the flat state is sharded on (with_zero);
                     # elementwise update, so no collectives inside

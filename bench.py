@@ -220,6 +220,33 @@ def bench_flash_attention(iters=5):
     }
 
 
+def bench_input_pipeline():
+    """Real-data loader throughput (images/sec) for both decode paths on
+    a synthetic ImageFolder — answers whether the host can feed the chip
+    at train speed (VERDICT r2 missing #2).  CPU-side; independent of
+    the TPU tunnel."""
+    import tempfile
+
+    from tools.data_bench import make_dataset, measure
+
+    from apex_tpu.ops import native as native_ops
+
+    with tempfile.TemporaryDirectory(prefix="apex_tpu_bench_data_") as root:
+        make_dataset(root, 192)
+        out = {"cores": os.cpu_count(),
+               "native_available": bool(native_ops.jpeg_available)}
+        out["pil_img_s"] = round(measure(root, 64, 224, False, 2), 1)
+        if native_ops.jpeg_available:  # else native=True silently = PIL
+            try:
+                out["native_img_s"] = round(
+                    measure(root, 64, 224, True, 2), 1)
+                out["speedup"] = round(
+                    out["native_img_s"] / out["pil_img_s"], 2)
+            except Exception as e:
+                out["native_error"] = f"{type(e).__name__}: {e}"
+        return out
+
+
 def bench_fused_adam(iters=20):
     """Optimizer step alone at ResNet-50 param scale: FusedAdam (flat
     Pallas buffers) vs optax.adam — answers whether the per-step
@@ -376,6 +403,11 @@ def main():
                 extras["fused_adam"] = bench_fused_adam()
         except Exception as e:
             _note("fused_adam", e)
+    if time.perf_counter() - START < BUDGET_S:
+        try:
+            extras["input_pipeline"] = bench_input_pipeline()
+        except Exception as e:
+            _note("input_pipeline", e)
     if extras:
         result["extras"] = extras
     emit()

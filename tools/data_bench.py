@@ -1,0 +1,117 @@
+"""Input-pipeline throughput: native batch JPEG decode vs the PIL pool.
+
+VERDICT r2 missing #2: the practical ImageNet bottleneck is host-side
+JPEG decode — the reference solves it with multi-process DataLoader
+workers + fast_collate + a CUDA-stream prefetcher
+(``/root/reference/examples/imagenet/main_amp.py:218-225,256-303``).
+This tool measures what our ``image_folder_loader`` actually sustains,
+for both decode paths, on a synthetic ImageFolder of realistic JPEGs.
+
+Prints one JSON line:
+    {"native_img_s": ..., "pil_img_s": ..., "speedup": ...,
+     "cores": ..., "batch": ..., "image_size": ...}
+
+Usage: python tools/data_bench.py [--n 512] [--batch 128] [--size 224]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_dataset(root: str, n: int, classes: int = 8) -> None:
+    """Synthesize an ImageFolder of ImageNet-like JPEGs (~500x375,
+    quality 90, smooth low-frequency content so file sizes are
+    realistic ~40-90 KB)."""
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        cls = f"class_{i % classes:03d}"
+        os.makedirs(os.path.join(root, cls), exist_ok=True)
+        h = int(rng.randint(300, 500))
+        w = int(rng.randint(400, 640))
+        # sum of a few random 2-D cosines: natural-ish spectrum
+        yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+        img = np.zeros((h, w, 3), np.float32)
+        for _ in range(6):
+            fy, fx = rng.uniform(0.2, 6.0, 2)
+            ph = rng.uniform(0, 2 * np.pi, 3)
+            amp = rng.uniform(10, 50)
+            for c in range(3):
+                img[:, :, c] += amp * np.cos(
+                    2 * np.pi * (fy * yy / h + fx * xx / w) + ph[c])
+        img = np.clip(img + 127, 0, 255).astype(np.uint8)
+        Image.fromarray(img).save(
+            os.path.join(root, cls, f"img_{i:05d}.jpg"), quality=90)
+
+
+def measure(root: str, batch: int, size: int, native: bool,
+            n_batches: int) -> float:
+    from apex_tpu.data.loaders import image_folder_loader
+
+    it = image_folder_loader(root, batch, image_size=size, train=True,
+                             seed=1, native=native)
+    next(it)  # warm up pools / native build outside the timed region
+    t0 = time.perf_counter()
+    got = 0
+    for _ in range(n_batches):
+        x, y = next(it)
+        got += x.shape[0]
+    return got / (time.perf_counter() - t0)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=512,
+                    help="dataset size (images)")
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--size", type=int, default=224)
+    ap.add_argument("--batches", type=int, default=3,
+                    help="timed batches per path")
+    ap.add_argument("--root", default=None,
+                    help="existing ImageFolder (skips synthesis)")
+    args = ap.parse_args()
+
+    from apex_tpu.ops import native as native_ops
+
+    tmp = None
+    root = args.root
+    if root is None:
+        tmp = tempfile.TemporaryDirectory(prefix="apex_tpu_databench_")
+        root = tmp.name
+        make_dataset(root, args.n)
+
+    result = {
+        "batch": args.batch, "image_size": args.size,
+        "cores": os.cpu_count(),
+        "native_available": bool(native_ops.jpeg_available),
+    }
+    try:
+        result["pil_img_s"] = round(
+            measure(root, args.batch, args.size, False, args.batches), 1)
+    except Exception as e:
+        result["pil_error"] = f"{type(e).__name__}: {e}"
+    if native_ops.jpeg_available:
+        try:
+            result["native_img_s"] = round(
+                measure(root, args.batch, args.size, True, args.batches), 1)
+        except Exception as e:
+            result["native_error"] = f"{type(e).__name__}: {e}"
+    if "native_img_s" in result and result.get("pil_img_s"):
+        result["speedup"] = round(
+            result["native_img_s"] / result["pil_img_s"], 2)
+    print(json.dumps(result), flush=True)
+    if tmp is not None:
+        tmp.cleanup()
+
+
+if __name__ == "__main__":
+    main()
