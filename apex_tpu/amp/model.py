@@ -53,6 +53,13 @@ BATCHNORM_PATTERNS = (r"BatchNorm", r"SyncBatchNorm", r"^bn(_|\d|$)",
 NORM_PATTERNS = BATCHNORM_PATTERNS + (r"LayerNorm", r"GroupNorm", r"RMSNorm",
                                       r"^norm(_|\d|$)", r"_norm$",
                                       r"^ln(_|\d|$)", r"_ln$")
+# MoE router weights stay fp32 under the O1 and O2 policies too: top-1
+# expert assignment is a DISCRETE function of the gate logits, so bf16
+# rounding of the router kernel flips token->expert routing decisions
+# (Switch Transformer keeps the router in fp32 — "selective precision",
+# Fedus et al. 2021 sec 2.4).  models.MoEMlp names its gate Dense
+# "router" to pair with this.
+ROUTER_PATTERNS = (r"^router$",)
 
 
 def _path_matches(path, patterns) -> bool:
@@ -127,10 +134,10 @@ class AmpModel:
                            else jnp.bfloat16)
         if keep_fp32_patterns is not None:
             self.keep_fp32_patterns = tuple(keep_fp32_patterns)
-        elif p.cast_ops:  # O1: norm layers stay fp32
-            self.keep_fp32_patterns = NORM_PATTERNS
+        elif p.cast_ops:  # O1: norm layers + MoE routers stay fp32
+            self.keep_fp32_patterns = NORM_PATTERNS + ROUTER_PATTERNS
         elif p.keep_batchnorm_fp32:  # O2 (and O3 w/ override)
-            self.keep_fp32_patterns = BATCHNORM_PATTERNS
+            self.keep_fp32_patterns = BATCHNORM_PATTERNS + ROUTER_PATTERNS
         else:
             self.keep_fp32_patterns = ()
 

@@ -55,6 +55,10 @@ class BertConfig:
     # mutable=["losses"] and add their sum (weighted) to the training
     # loss. Shard experts with models.EP_RULES for expert parallelism.
     moe_experts: int = 0
+    # MoE dispatch mode: "dense" (exact, E x FLOPs) or "capacity"
+    # (Switch capacity-factor gather/scatter — the perf path at E >= 8)
+    moe_dispatch: str = "dense"
+    moe_capacity_factor: float = 1.25
 
 
 def bert_base() -> "BertConfig":
@@ -132,7 +136,9 @@ class BertLayer(nn.Module):
             y, aux = MoEMlp(num_experts=cfg.moe_experts,
                             hidden_size=cfg.hidden_size,
                             intermediate_size=cfg.intermediate_size,
-                            kernel_init=init, name="moe")(x)
+                            kernel_init=init, name="moe",
+                            dispatch=cfg.moe_dispatch,
+                            capacity_factor=cfg.moe_capacity_factor)(x)
             self.sow("losses", "moe_aux", aux)
         else:
             y = nn.Dense(cfg.intermediate_size, kernel_init=init,

@@ -1,27 +1,41 @@
 """Mixture-of-experts MLP with expert parallelism over a mesh axis.
 
 The reference has no MoE/EP (SURVEY §2.3 — absent). TPU-first design:
-experts live as one stacked parameter ``(E, ...)`` and the block
-computes a dense einsum over the expert dimension with a top-1 (Switch)
-router — so sharding the leading expert dim across an ``"expert"`` mesh
-axis (``EP_RULES`` + ``parallel.shard_params``) makes GSPMD run each
-device's experts locally and combine with one reduce — expert
-parallelism with zero dispatch machinery.  Dense compute (every expert
-sees every token, results masked by the router's one-hot) trades E x
-MLP FLOPs for perfect static shapes: no capacity factor, no token
-dropping, no sort/scatter — the right call for modest expert counts on
-the MXU, and exact (the usual capacity-overflow nondeterminism never
-appears).  A capacity-based sparse dispatch is an optimization of this
-same contract, not a different API.
+experts live as one stacked parameter ``(E, ...)`` so sharding the
+leading expert dim across an ``"expert"`` mesh axis (``EP_RULES`` +
+``parallel.shard_params``) makes GSPMD run each device's experts locally
+and combine with one reduce — expert parallelism with zero bespoke
+dispatch machinery.  Two dispatch modes share that contract:
+
+- ``dispatch="dense"`` (default): every expert computes every token and
+  the router's one-hot masks the combine.  Trades E x MLP FLOPs for
+  perfect static shapes — no capacity factor, no token dropping, exact —
+  the right call for modest E, and the parity oracle for the sparse path.
+- ``dispatch="capacity"``: Switch-style capacity-factor gather/scatter.
+  Each expert processes at most ``C = ceil(capacity_factor * T / E)``
+  tokens: tokens gather into an ``(E, C, H)`` buffer by routing slot
+  (static shapes, XLA-friendly), the expert MLP runs once per *assigned*
+  token instead of once per (token, expert) pair, and a scatter-add
+  combines.  Tokens past an expert's capacity are DROPPED — they output
+  zero from this block and ride the caller's residual connection, the
+  standard Switch overflow semantics (Fedus et al. 2021 sec 2.2).  With
+  ``capacity_factor >= E`` no token can drop and the output equals the
+  dense path's (``tests/distributed/test_moe_ep.py``).
 
 Router: softmax gate, top-1 selection scaled by the gate probability
 (Switch Transformer, Fedus et al. 2021), plus the standard load-balance
 auxiliary loss ``E * mean(gate_prob) . mean(assignment)`` returned to
-the caller (weight it into the training loss).
+the caller (weight it into the training loss).  The router runs in fp32
+end to end: the Dense is named ``router`` to pair with amp's
+keep-fp32 policy (``amp.model.ROUTER_PATTERNS`` keeps its kernel fp32
+under O1/O2) and computes with ``dtype=float32`` — expert assignment is
+a discrete decision, so it never rides bf16 (the paper's "selective
+precision").
 """
 
 from __future__ import annotations
 
+import math
 from typing import Callable, Optional, Tuple
 
 import flax.linen as nn
@@ -51,9 +65,15 @@ class MoEMlp(nn.Module):
     hidden_size: int
     intermediate_size: int
     kernel_init: Optional[Callable] = None  # default: normal(0.02)
+    dispatch: str = "dense"                 # "dense" | "capacity"
+    capacity_factor: float = 1.25           # capacity dispatch only
 
     @nn.compact
     def __call__(self, x) -> Tuple[jax.Array, jax.Array]:
+        if self.dispatch not in ("dense", "capacity"):
+            raise ValueError(
+                f"MoEMlp dispatch must be 'dense' or 'capacity', got "
+                f"{self.dispatch!r}")
         e, h, f = self.num_experts, self.hidden_size, self.intermediate_size
         init = self.kernel_init or nn.initializers.normal(0.02)
         w_in = self.param("experts_in", init, (e, h, f))
@@ -61,25 +81,81 @@ class MoEMlp(nn.Module):
         w_out = self.param("experts_out", init, (e, f, h))
         b_out = self.param("experts_bias_out", nn.initializers.zeros, (e, h))
 
-        # router in fp32 (precision decides expert assignment)
-        gate_logits = nn.Dense(e, name="router",
-                               kernel_init=init)(x.astype(jnp.float32))
+        # router strictly in fp32 (see module docstring): dtype=float32
+        # forces fp32 operands even when x is bf16, precision=HIGHEST
+        # keeps the TPU MXU from running the fp32 matmul with bf16
+        # multiply passes, and the "router" name keeps the kernel itself
+        # un-rounded under amp O1/O2
+        gate_logits = nn.Dense(
+            e, name="router", kernel_init=init, dtype=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)(x.astype(jnp.float32))
         gate = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
         top1 = jnp.argmax(gate, axis=-1)                      # (B, S)
         one_hot = jax.nn.one_hot(top1, e, dtype=gate.dtype)   # (B, S, E)
-        # Switch scaling: route weight = the chosen expert's probability
-        combine = (one_hot * gate).astype(x.dtype)            # (B, S, E)
 
-        # dense expert compute, masked-combined; contracting over h/f
-        # keeps the expert dim outermost so an expert-sharded placement
-        # computes local experts only and reduces once
-        y = jnp.einsum("bsh,ehf->bsef", x, w_in) + b_in[None, None]
-        y = nn.gelu(y, approximate=False)
-        y = jnp.einsum("bsef,efh->bseh", y, w_out) + b_out[None, None]
-        out = jnp.einsum("bseh,bse->bsh", y, combine)
+        if self.dispatch == "capacity":
+            out = self._capacity_path(x, w_in, b_in, w_out, b_out, gate,
+                                      top1, one_hot)
+        else:
+            # Switch scaling: route weight = chosen expert's probability
+            combine = (one_hot * gate).astype(x.dtype)        # (B, S, E)
+            # dense expert compute, masked-combined; contracting over h/f
+            # keeps the expert dim outermost so an expert-sharded
+            # placement computes local experts only and reduces once
+            y = jnp.einsum("bsh,ehf->bsef", x, w_in) + b_in[None, None]
+            y = nn.gelu(y, approximate=False)
+            y = jnp.einsum("bsef,efh->bseh", y, w_out) + b_out[None, None]
+            out = jnp.einsum("bseh,bse->bsh", y, combine)
 
         # load-balance aux loss (Switch eq. 4): E * sum_e f_e * P_e
         frac_tokens = jnp.mean(one_hot, axis=(0, 1))          # f_e
         frac_prob = jnp.mean(gate, axis=(0, 1))               # P_e
         aux = e * jnp.sum(frac_tokens * frac_prob)
         return out, aux.astype(jnp.float32)
+
+    def _capacity_path(self, x, w_in, b_in, w_out, b_out, gate, top1,
+                       one_hot):
+        """Capacity-factor gather/scatter dispatch (module docstring)."""
+        e = self.num_experts
+        b, s, h = x.shape
+        t = b * s
+        cap = max(1, int(math.ceil(self.capacity_factor * t / e)))
+
+        xf = x.reshape(t, h)
+        top1_f = top1.reshape(t)
+        # chosen expert's probability per token (Switch combine weight)
+        gate_top = jnp.sum(one_hot * gate, axis=-1).reshape(t)
+        oh = one_hot.reshape(t, e)
+        # position of each token within its expert's arrival order
+        # (exclusive cumsum over the token dim)
+        cum = jnp.cumsum(oh, axis=0) - oh
+        pos = cum[jnp.arange(t), top1_f].astype(jnp.int32)
+        keep = pos < cap
+        # routing slot = expert * cap + position; overflow -> dummy slot
+        slot = jnp.where(keep, top1_f.astype(jnp.int32) * cap + pos,
+                         e * cap)
+
+        # invert token->slot into slot->token (kept slots are unique;
+        # dropped tokens all land on the dummy and are discarded with it)
+        token_for_slot = jnp.full((e * cap + 1,), t, jnp.int32)
+        token_for_slot = token_for_slot.at[slot].set(
+            jnp.arange(t, dtype=jnp.int32))
+        tok = token_for_slot[:e * cap]                        # (E*C,)
+
+        # gather: empty slots read the appended zero row
+        xg = jnp.concatenate([xf, jnp.zeros((1, h), xf.dtype)])[tok]
+        xe = xg.reshape(e, cap, h)
+        y = jnp.einsum("ech,ehf->ecf", xe, w_in) + b_in[:, None]
+        y = nn.gelu(y, approximate=False)
+        y = jnp.einsum("ecf,efh->ech", y, w_out) + b_out[:, None]
+
+        # combine: scale each slot by its token's gate prob (0 for empty
+        # slots via the appended zero) and scatter-add back; dropped
+        # tokens' rows stay zero — they ride the caller's residual.
+        # Scatter in y's dtype (params may be wider than x, e.g. during
+        # amp init) and cast once at the end.
+        gate_slot = jnp.concatenate(
+            [gate_top, jnp.zeros((1,), gate_top.dtype)])[tok]
+        yf = y.reshape(e * cap, h) * gate_slot[:, None].astype(y.dtype)
+        out = jnp.zeros((t + 1, h), yf.dtype).at[tok].add(yf)
+        return out[:t].reshape(b, s, h).astype(x.dtype)

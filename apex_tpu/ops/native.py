@@ -229,10 +229,20 @@ def decode_jpeg_batch(paths: List[str], image_size: int, *,
     n = len(paths)
     if out is None:
         out = np.empty((n, image_size, image_size, 3), np.uint8)
-    assert out.shape == (n, image_size, image_size, 3) and \
-        out.dtype == np.uint8 and out.flags.c_contiguous
+    if out.shape != (n, image_size, image_size, 3) or \
+            out.dtype != np.uint8 or not out.flags.c_contiguous:
+        # a bad buffer here means native threads writing out of bounds
+        raise ValueError(
+            f"out must be C-contiguous uint8 of shape "
+            f"{(n, image_size, image_size, 3)}; got {out.dtype} "
+            f"{out.shape} contiguous={out.flags.c_contiguous}")
     fail = np.zeros((n,), np.uint8)
     if seeds is None:
+        if train:
+            # seed 0 for every image would silently freeze the
+            # augmentation RNG across images AND epochs
+            raise ValueError(
+                "decode_jpeg_batch(train=True) requires per-image seeds")
         seeds = np.zeros((n,), np.uint64)
     seeds = np.ascontiguousarray(seeds, np.uint64)
     cpaths = (ctypes.c_char_p * n)(*[os.fsencode(p) for p in paths])

@@ -220,6 +220,47 @@ def bench_flash_attention(iters=5):
     }
 
 
+def bench_moe(iters=10):
+    """Dense vs capacity MoE dispatch at E=8 (fwd+bwd step ms): the
+    capacity path should win as E grows since dense pays E x MLP FLOPs
+    per token while capacity pays ~capacity_factor x."""
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu import models
+
+    e, b, s, h, f = 8, 8, 512, 512, 2048
+    x = jax.random.normal(jax.random.PRNGKey(3), (b, s, h), jnp.bfloat16)
+
+    def timed(dispatch):
+        moe = models.MoEMlp(num_experts=e, hidden_size=h,
+                            intermediate_size=f, dispatch=dispatch)
+        params = moe.init(jax.random.PRNGKey(4), x)["params"]
+
+        @jax.jit
+        def fwd_bwd(p, x):
+            def loss(p):
+                out, aux = moe.apply({"params": p}, x)
+                return jnp.sum(out.astype(jnp.float32) ** 2) + 0.01 * aux
+            # grads must reach the output or XLA prunes the backward
+            l, g = jax.value_and_grad(loss)(p)
+            return l, g
+        l, g = fwd_bwd(params, x)
+        float(l)  # sync (block_until_ready is a no-op via axon)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            l, g = fwd_bwd(params, x)
+        float(l)
+        float(jax.tree.leaves(g)[0].ravel()[0])
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    dense_ms = timed("dense")
+    cap_ms = timed("capacity")
+    return {"shape": f"E{e} b{b} s{s} h{h} f{f} bf16",
+            "dense_ms": round(dense_ms, 2),
+            "capacity_ms": round(cap_ms, 2),
+            "speedup": round(dense_ms / cap_ms, 2)}
+
+
 def bench_input_pipeline():
     """Real-data loader throughput (images/sec) for both decode paths on
     a synthetic ImageFolder — answers whether the host can feed the chip
@@ -403,6 +444,11 @@ def main():
                 extras["fused_adam"] = bench_fused_adam()
         except Exception as e:
             _note("fused_adam", e)
+    if on_tpu and time.perf_counter() - START < BUDGET_S:
+        try:
+            extras["moe_dispatch"] = bench_moe()
+        except Exception as e:
+            _note("moe_dispatch", e)
     if time.perf_counter() - START < BUDGET_S:
         try:
             extras["input_pipeline"] = bench_input_pipeline()

@@ -52,6 +52,12 @@ def parse_args():
                    help="replace each layer's MLP with a Switch-MoE of "
                    "E experts (aux load-balance loss auto-added; shard "
                    "experts with models.EP_RULES for EP)")
+    p.add_argument("--moe-dispatch", default="dense",
+                   choices=["dense", "capacity"],
+                   help="MoE dispatch: dense (exact, E x FLOPs) or "
+                   "capacity (Switch capacity-factor gather/scatter; "
+                   "tokens past capacity ride the residual)")
+    p.add_argument("--moe-capacity-factor", type=float, default=1.25)
     p.add_argument("--grad-accum", type=int, default=1, metavar="A",
                    help="accumulate grads over A microbatches per step "
                    "(amp unscale-with-stashed protocol; overflow in ANY "
@@ -86,7 +92,9 @@ def main():
     args = parse_args()
     cfg = get_config(args.config)
     cfg = dataclasses.replace(cfg, remat=args.remat,
-                              moe_experts=args.moe)
+                              moe_experts=args.moe,
+                              moe_dispatch=args.moe_dispatch,
+                              moe_capacity_factor=args.moe_capacity_factor)
 
     devices = jax.devices()
     n_dev = len(devices)
