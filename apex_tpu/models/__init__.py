@@ -25,6 +25,7 @@ from apex_tpu.models.bert import (
     BertConfig,
     BertEncoder,
     BertForPreTraining,
+    PipelinedBert,
     bert_base,
     bert_large,
 )
@@ -37,6 +38,7 @@ __all__ = [
     "BertConfig",
     "BertEncoder",
     "BertForPreTraining",
+    "PipelinedBert",
     "Bottleneck",
     "Discriminator",
     "Generator",

@@ -74,6 +74,50 @@ def _dense_init(cfg):
     return nn.initializers.normal(cfg.initializer_range)
 
 
+def _embed_block(cfg, input_ids, token_type_ids, deterministic):
+    """Embedding sum + LN + dropout, shared by :class:`BertEncoder` and
+    :class:`BertEmbeddings` so the param names/math cannot drift (must
+    be called inside an ``@nn.compact`` body)."""
+    s = input_ids.shape[1]
+    init = _dense_init(cfg)
+    emb = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                   embedding_init=init, name="word_embeddings")(input_ids)
+    pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+                   embedding_init=init, name="position_embeddings")(
+        jnp.arange(s)[None, :])
+    # segment table always exists (standard BERT: ids default to 0)
+    # so init-without-segments checkpoints still apply with them
+    if token_type_ids is None:
+        token_type_ids = jnp.zeros_like(input_ids)
+    typ = nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
+                   embedding_init=init,
+                   name="token_type_embeddings")(token_type_ids)
+    x = FusedLayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps,
+                       name="embeddings_ln")(emb + pos + typ)
+    return nn.Dropout(cfg.hidden_dropout_prob,
+                      deterministic=deterministic)(x)
+
+
+def _pretraining_heads(cfg, seq):
+    """MLM + NSP heads, shared by :class:`BertForPreTraining` and
+    :class:`BertHeads` (must be called inside ``@nn.compact``)."""
+    init = _dense_init(cfg)
+    # MLM: transform -> untied decoder projection
+    h = nn.Dense(cfg.hidden_size, kernel_init=init,
+                 name="mlm_transform")(seq)
+    h = nn.gelu(h, approximate=False)
+    h = FusedLayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps,
+                       name="mlm_ln")(h)
+    mlm_logits = nn.Dense(cfg.vocab_size, kernel_init=init,
+                          name="mlm_decoder")(h).astype(jnp.float32)
+    # NSP: [CLS] pooled
+    cls = jnp.tanh(nn.Dense(cfg.hidden_size, kernel_init=init,
+                            name="pooler")(seq[:, 0]))
+    nsp_logits = nn.Dense(2, kernel_init=init,
+                          name="nsp_classifier")(cls).astype(jnp.float32)
+    return mlm_logits, nsp_logits
+
+
 def dot_product_attention(q, k, v, bias=None, dropout_fn=None):
     """(B, S, H, D) q/k/v -> (B, S, H, D); softmax in fp32."""
     d = q.shape[-1]
@@ -161,26 +205,7 @@ class BertEncoder(nn.Module):
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
                  deterministic: bool = True):
         cfg = self.cfg
-        b, s = input_ids.shape
-        init = _dense_init(cfg)
-
-        emb = nn.Embed(cfg.vocab_size, cfg.hidden_size,
-                       embedding_init=init, name="word_embeddings")(input_ids)
-        pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
-                       embedding_init=init, name="position_embeddings")(
-            jnp.arange(s)[None, :])
-        # segment table always exists (standard BERT: ids default to 0)
-        # so init-without-segments checkpoints still apply with them
-        if token_type_ids is None:
-            token_type_ids = jnp.zeros_like(input_ids)
-        typ = nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
-                       embedding_init=init,
-                       name="token_type_embeddings")(token_type_ids)
-        emb = emb + pos + typ
-        x = FusedLayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps,
-                           name="embeddings_ln")(emb)
-        x = nn.Dropout(cfg.hidden_dropout_prob,
-                       deterministic=deterministic)(x)
+        x = _embed_block(cfg, input_ids, token_type_ids, deterministic)
 
         attn_bias = None
         if attention_mask is not None:
@@ -197,6 +222,149 @@ class BertEncoder(nn.Module):
         return x
 
 
+class BertEmbeddings(nn.Module):
+    """Embedding sublayer split out for pipeline parallelism (param
+    names match the inline embeddings of :class:`BertEncoder`)."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None,
+                 deterministic: bool = True):
+        return _embed_block(self.cfg, input_ids, token_type_ids,
+                            deterministic)
+
+
+class BertStage(nn.Module):
+    """``layers_per_stage`` consecutive encoder layers — the GPipe stage
+    body for :class:`PipelinedBert` (activation shape preserved)."""
+
+    cfg: BertConfig
+    layers_per_stage: int
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, attn_bias, deterministic: bool = True):
+        layer_cls = BertLayer
+        if self.cfg.remat:
+            layer_cls = nn.remat(BertLayer, static_argnums=(3,))
+        for i in range(self.layers_per_stage):
+            x = layer_cls(self.cfg, self.attention_fn, name=f"layer_{i}")(
+                x, attn_bias, deterministic)
+        return x
+
+
+class BertHeads(nn.Module):
+    """MLM + NSP heads split out for pipeline parallelism (param names
+    match :class:`BertForPreTraining`)."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, seq):
+        return _pretraining_heads(self.cfg, seq)
+
+
+class PipelinedBert:
+    """BERT-for-pretraining with the encoder stack pipelined over a mesh
+    axis (GPipe, ``parallel.gpipe_spmd``) — the PP composition the
+    reference never had (SURVEY §2.3).
+
+    Layout: embeddings and heads run replicated on every pipe device
+    (they are a few percent of the FLOPs); the ``num_hidden_layers``
+    encoder layers split into ``pp`` equal stages whose params live
+    STACKED with a leading ``(pp, ...)`` dim, sharded ``P(pipe_axis)``.
+    The activation pytree ``(hidden, attention_bias)`` flows through the
+    microbatch schedule; the bias rides along unchanged so every stage
+    can mask attention.
+
+    Composes with data parallelism: pass ``batch_axis`` and shard the
+    batch over it — inside ``shard_map`` the pipe schedule runs
+    per-data-shard.  Follows the flax calling convention
+    (``init(rng, ids) -> variables``, ``apply(variables, ids, ...)``)
+    so ``amp.initialize`` wraps it like any module.
+
+    Constraints: ``num_hidden_layers % pp == 0``; dropout must be off
+    (``deterministic=True`` path — per-stage rng plumbing through the
+    scan is not wired); MoE aux losses are silently dropped inside the
+    pipeline (flax ``sow`` into an immutable collection is a no-op) —
+    prefer EP without PP for MoE configs.
+    """
+
+    def __init__(self, cfg: BertConfig, mesh, pp: int,
+                 num_microbatches: int, pipe_axis: str = "pipe",
+                 batch_axis: Optional[str] = None,
+                 attention_fn: Optional[Callable] = None):
+        if cfg.num_hidden_layers % pp:
+            raise ValueError(
+                f"num_hidden_layers={cfg.num_hidden_layers} must divide "
+                f"into pp={pp} equal stages")
+        if cfg.hidden_dropout_prob or cfg.attention_probs_dropout_prob:
+            raise ValueError(
+                "PipelinedBert requires dropout-free configs "
+                "(hidden_dropout_prob=0, attention_probs_dropout_prob=0): "
+                "per-stage dropout rngs are not plumbed through the "
+                "pipeline scan")
+        self.cfg = cfg
+        self.mesh = mesh
+        self.pp = pp
+        self.num_microbatches = num_microbatches
+        self.pipe_axis = pipe_axis
+        self.batch_axis = batch_axis
+        self.embed = BertEmbeddings(cfg)
+        self.stage = BertStage(cfg, cfg.num_hidden_layers // pp,
+                               attention_fn)
+        self.heads = BertHeads(cfg)
+
+    def init(self, rng, input_ids, attention_mask=None,
+             token_type_ids=None, deterministic: bool = True):
+        r_embed, r_stage, r_heads = jax.random.split(rng, 3)
+        embed_p = self.embed.init(r_embed, input_ids, token_type_ids,
+                                  True)["params"]
+        x0 = self.embed.apply({"params": embed_p}, input_ids,
+                              token_type_ids, True)
+        bias0 = self._bias(input_ids, attention_mask)
+        stage_p = jax.vmap(
+            lambda r: self.stage.init(r, x0, bias0, True)["params"])(
+            jax.random.split(r_stage, self.pp))
+        heads_p = self.heads.init(r_heads, x0)["params"]
+        return {"params": {"embed": embed_p, "stages": stage_p,
+                           "heads": heads_p}}
+
+    def _bias(self, input_ids, attention_mask):
+        b, s = input_ids.shape
+        if attention_mask is None:
+            return jnp.zeros((b, 1, 1, s), jnp.float32)
+        return jnp.where(attention_mask[:, None, None, :] > 0,
+                         0.0, -1e9).astype(jnp.float32)
+
+    def apply(self, variables, input_ids, attention_mask=None,
+              token_type_ids=None, deterministic: bool = True):
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.parallel.pipeline import gpipe_spmd
+
+        p = variables["params"]
+        x = self.embed.apply({"params": p["embed"]}, input_ids,
+                             token_type_ids, deterministic)
+        bias = self._bias(input_ids, attention_mask)
+
+        def stage_fn(sp, xb):
+            h, b = xb
+            return (self.stage.apply({"params": sp}, h, b, True), b)
+
+        run = gpipe_spmd(stage_fn, self.pipe_axis, self.num_microbatches)
+        xspec = P(self.batch_axis) if self.batch_axis else P()
+        f = jax.shard_map(
+            run, mesh=self.mesh,
+            in_specs=(jax.tree_util.tree_map(lambda _: P(self.pipe_axis),
+                                             p["stages"]),
+                      (xspec, xspec)),
+            out_specs=(xspec, xspec))
+        seq, _ = f(p["stages"], (x, bias))
+        return self.heads.apply({"params": p["heads"]}, seq)
+
+
 class BertForPreTraining(nn.Module):
     """Encoder + MLM head + NSP head (untied decoder matrix)."""
 
@@ -207,22 +375,6 @@ class BertForPreTraining(nn.Module):
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
                  deterministic: bool = True):
         cfg = self.cfg
-        init = _dense_init(cfg)
         enc = BertEncoder(cfg, self.attention_fn, name="encoder")
         seq = enc(input_ids, attention_mask, token_type_ids, deterministic)
-
-        # MLM: transform -> untied decoder projection
-        h = nn.Dense(cfg.hidden_size, kernel_init=init,
-                     name="mlm_transform")(seq)
-        h = nn.gelu(h, approximate=False)
-        h = FusedLayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps,
-                           name="mlm_ln")(h)
-        mlm_logits = nn.Dense(cfg.vocab_size, kernel_init=init,
-                              name="mlm_decoder")(h).astype(jnp.float32)
-
-        # NSP: [CLS] pooled
-        cls = jnp.tanh(nn.Dense(cfg.hidden_size, kernel_init=init,
-                                name="pooler")(seq[:, 0]))
-        nsp_logits = nn.Dense(2, kernel_init=init,
-                              name="nsp_classifier")(cls).astype(jnp.float32)
-        return mlm_logits, nsp_logits
+        return _pretraining_heads(cfg, seq)

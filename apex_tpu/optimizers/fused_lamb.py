@@ -48,7 +48,8 @@ class FusedLAMB:
                  eps: float = 1e-6, weight_decay: float = 0.01,
                  max_grad_norm: float = 1.0,
                  trust_clip: Optional[float] = None,
-                 exclude_from_layer_adaptation=None, param_groups=None):
+                 exclude_from_layer_adaptation=None, param_groups=None,
+                 per_slice_trust_ratio=None):
         """``exclude_from_layer_adaptation``: optional predicate
         ``f(path) -> bool``; matching tensors use ratio 1.0 (the usual
         BERT practice for bias/LayerNorm params).
@@ -58,7 +59,14 @@ class FusedLAMB:
         ``weight_decay`` / ``eps`` overrides, resolved per leaf (the
         trust ratio is per-tensor already, so grouping needs no layout
         change here).  ``betas``/``max_grad_norm`` remain global: the
-        grad-norm clip is a single global norm by construction."""
+        grad-norm clip is a single global norm by construction.
+
+        ``per_slice_trust_ratio``: optional predicate ``f(path) -> bool``
+        marking leaves that are STACKS of per-layer tensors along dim 0
+        (``models.PipelinedBert``'s ``(pp, ...)`` stage params) — each
+        dim-0 slice gets its own trust ratio, preserving LAMB's
+        layer-wise adaptation exactly as if the layers were separate
+        leaves."""
         self.lr = lr
         self.bias_correction = bias_correction
         self.betas = betas
@@ -67,6 +75,7 @@ class FusedLAMB:
         self.max_grad_norm = max_grad_norm
         self.trust_clip = trust_clip
         self.exclude_from_layer_adaptation = exclude_from_layer_adaptation
+        self.per_slice_trust_ratio = per_slice_trust_ratio
         self.param_groups = list(param_groups) if param_groups else []
         if self.param_groups:
             from apex_tpu.optimizers.param_groups import validate_specs
@@ -95,7 +104,8 @@ class FusedLAMB:
             max_grad_norm=self.max_grad_norm, trust_clip=self.trust_clip,
             exclude_from_layer_adaptation=self.exclude_from_layer_adaptation,
             param_groups=[dict(match=match, **overrides)]
-            + self.param_groups)
+            + self.param_groups,
+            per_slice_trust_ratio=self.per_slice_trust_ratio)
         old_paths = leaf_paths(state.m)
         old_m = dict(zip(old_paths, jax.tree_util.tree_leaves(state.m)))
         old_v = dict(zip(old_paths, jax.tree_util.tree_leaves(state.v)))
@@ -164,17 +174,27 @@ class FusedLAMB:
         _, p_norms = multi_tensor_l2norm(params, per_tensor=True)
         _, u_norms = multi_tensor_l2norm(updates, per_tensor=True)
 
-        def stage2(path, upd, pn, un):
+        def stage2(path, upd, pn, un, p):
+            if self.per_slice_trust_ratio is not None and \
+                    self.per_slice_trust_ratio(path):
+                # a (S, ...) stack of per-layer tensors: one ratio per
+                # dim-0 slice, as if the layers were separate leaves
+                axes = tuple(range(1, upd.ndim))
+                pn = jnp.sqrt(jnp.sum(
+                    jnp.square(jnp.asarray(p, jnp.float32)), axis=axes))
+                un = jnp.sqrt(jnp.sum(jnp.square(upd), axis=axes))
             ratio = jnp.where((pn > 0) & (un > 0), pn / un, 1.0)
             if self.trust_clip is not None:
                 ratio = jnp.minimum(ratio, self.trust_clip)
             if self.exclude_from_layer_adaptation is not None and \
                     self.exclude_from_layer_adaptation(path):
-                ratio = jnp.asarray(1.0, jnp.float32)
+                ratio = jnp.ones_like(ratio)
+            if ratio.ndim:  # per-slice: broadcast over the layer stack
+                ratio = ratio.reshape(ratio.shape + (1,) * (upd.ndim - 1))
             return -self._hp(path)["lr"] * ratio * upd
 
         deltas = jax.tree_util.tree_map_with_path(stage2, updates, p_norms,
-                                                  u_norms)
+                                                  u_norms, params)
         deltas = jax.tree_util.tree_map(
             lambda d, p: d.astype(jnp.asarray(p).dtype), deltas, params)
         return deltas, FusedLAMBState(step=step, m=new_m, v=new_v)

@@ -10,8 +10,12 @@ automatically (no hand-written 1F1B machinery).
 
 Contract (classic GPipe):
 
-- ``stage_fn(stage_params, x) -> y`` with ``y.shape == x.shape`` — all
-  stages share one activation shape (transformer blocks, MLP stacks);
+- ``stage_fn(stage_params, x) -> y`` where ``x``/``y`` are an array or
+  a PYTREE of arrays with identical structure and per-leaf shapes — all
+  stages share one activation layout (transformer blocks, MLP stacks).
+  Pytree activations carry per-example side inputs through the
+  pipeline, e.g. ``(hidden, attention_bias)`` with the bias returned
+  unchanged (see ``models.PipelinedBert``);
 - stage parameters live STACKED with a leading stage dim ``(S, ...)``
   (build with ``jax.vmap(stage.init)`` over per-stage rngs), sharded
   ``P("pipe")`` so each device holds its own stage;
@@ -50,7 +54,7 @@ def gpipe_spmd(stage_fn: Callable, axis_name: str,
     identical on every device of the axis (psum-combined).
     """
 
-    def run(stacked_params_local: Pytree, x: jax.Array) -> jax.Array:
+    def run(stacked_params_local: Pytree, x: Pytree) -> Pytree:
         s = lax.axis_size(axis_name)
         stage = lax.axis_index(axis_name)
         for leaf in jax.tree_util.tree_leaves(stacked_params_local):
@@ -65,37 +69,53 @@ def gpipe_spmd(stage_fn: Callable, axis_name: str,
         params = jax.tree_util.tree_map(lambda a: a[0],
                                         stacked_params_local)
         m = num_microbatches
-        b = x.shape[0]
+        x_leaves = jax.tree_util.tree_leaves(x)
+        b = x_leaves[0].shape[0]
+        for leaf in x_leaves:
+            if leaf.shape[0] != b:
+                raise ValueError(
+                    "every activation leaf must share the batch dim; got "
+                    f"{[l.shape for l in x_leaves]}")
         assert b % m == 0, f"batch {b} must divide into {m} microbatches"
-        xs = x.reshape((m, b // m) + x.shape[1:])
+        xs = jax.tree_util.tree_map(
+            lambda a: a.reshape((m, b // m) + a.shape[1:]), x)
 
         fwd_perm = [(i, i + 1) for i in range(s - 1)]
 
         def tick(x_buf, t):
             # stage 0 injects microbatch t (clipped; invalid ticks feed
             # garbage that never reaches the output window)
-            inject = xs[jnp.clip(t, 0, m - 1)]
-            x_in = jnp.where(stage == 0, inject, x_buf)
+            inject = jax.tree_util.tree_map(
+                lambda a: a[jnp.clip(t, 0, m - 1)], xs)
+            x_in = jax.tree_util.tree_map(
+                lambda i, buf: jnp.where(stage == 0, i, buf), inject, x_buf)
             y = stage_fn(params, x_in)
-            x_next = lax.ppermute(y, axis_name, fwd_perm)
+            x_next = jax.tree_util.tree_map(
+                lambda a: lax.ppermute(a, axis_name, fwd_perm), y)
             return x_next, y
 
         # the carry crosses ppermute, so it is varying on the pipe axis;
         # the zeros init must carry the same vma type
-        zero = _vary_like(jnp.zeros_like(xs[0]), extra_axes=(axis_name,))
+        zero = jax.tree_util.tree_map(
+            lambda a: _vary_like(jnp.zeros_like(a[0]),
+                                 extra_axes=(axis_name,)), xs)
         _, ys = lax.scan(tick, zero, jnp.arange(m + s - 1))
         # microbatch j leaves the last stage at tick s-1+j
-        valid = lax.dynamic_slice_in_dim(ys, s - 1, m)
-        out = jnp.where(stage == s - 1, valid, jnp.zeros_like(valid))
-        out = lax.psum(out, axis_name)
-        return out.reshape((b,) + out.shape[2:])
+
+        def collect(leaf):
+            valid = lax.dynamic_slice_in_dim(leaf, s - 1, m)
+            out = jnp.where(stage == s - 1, valid, jnp.zeros_like(valid))
+            out = lax.psum(out, axis_name)
+            return out.reshape((b,) + out.shape[2:])
+
+        return jax.tree_util.tree_map(collect, ys)
 
     return run
 
 
 def pipeline_apply(mesh: Mesh, axis_name: str, stage_fn: Callable,
-                   stacked_params: Pytree, x: jax.Array,
-                   num_microbatches: int) -> jax.Array:
+                   stacked_params: Pytree, x: Pytree,
+                   num_microbatches: int) -> Pytree:
     """One-call GPipe: shard ``stacked_params`` over ``axis_name`` of
     ``mesh``, run the microbatch schedule, return the output (replicated
     over the pipe axis).  Differentiable; jit over it freely."""
@@ -103,6 +123,7 @@ def pipeline_apply(mesh: Mesh, axis_name: str, stage_fn: Callable,
     f = jax.shard_map(
         run, mesh=mesh,
         in_specs=(jax.tree_util.tree_map(lambda _: P(axis_name),
-                                         stacked_params), P()),
-        out_specs=P())
+                                         stacked_params),
+                  jax.tree_util.tree_map(lambda _: P(), x)),
+        out_specs=jax.tree_util.tree_map(lambda _: P(), x))
     return f(stacked_params, x)

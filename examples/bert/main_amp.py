@@ -62,6 +62,15 @@ def parse_args():
                    help="accumulate grads over A microbatches per step "
                    "(amp unscale-with-stashed protocol; overflow in ANY "
                    "microbatch skips the whole update)")
+    p.add_argument("--pp", type=int, default=0, metavar="S",
+                   help="pipeline the encoder over S stages on a "
+                   "(data, pipe) mesh (models.PipelinedBert / GPipe); "
+                   "S must divide the device count and the layer count. "
+                   "Forces dropout off (the example trains "
+                   "deterministically anyway)")
+    p.add_argument("--pp-microbatches", type=int, default=4, metavar="M",
+                   help="GPipe microbatches per step under --pp "
+                   "(bubble fraction (S-1)/(M+S-1))")
     return p.parse_args()
 
 
@@ -99,18 +108,33 @@ def main():
     devices = jax.devices()
     n_dev = len(devices)
     sp = args.ring_attention
+    pp = args.pp
+    if pp and sp:
+        raise SystemExit("--pp and --ring-attention define conflicting "
+                         "meshes; pick one (PP x SP is composable via "
+                         "models.PipelinedBert + a custom attention_fn)")
+    if pp and args.moe:
+        raise SystemExit("--pp drops MoE aux losses inside the pipeline "
+                         "(see models.PipelinedBert); use EP without PP "
+                         "for MoE configs")
     if sp:
         if n_dev % sp or args.seq_len % sp:
             raise SystemExit(f"SP={sp} must divide devices ({n_dev}) and "
                              f"seq len ({args.seq_len})")
         dp = n_dev // sp
         mesh = Mesh(np.array(devices).reshape(dp, sp), ("data", "sp"))
+    elif pp:
+        if n_dev % pp or cfg.num_hidden_layers % pp:
+            raise SystemExit(f"PP={pp} must divide devices ({n_dev}) and "
+                             f"layers ({cfg.num_hidden_layers})")
+        dp = n_dev // pp
+        mesh = Mesh(np.array(devices).reshape(dp, pp), ("data", "pipe"))
     else:
         dp = n_dev
         mesh = Mesh(np.array(devices), ("data",))
     if args.b % dp:
         raise SystemExit(f"batch {args.b} must divide by dp={dp}")
-    maybe_print(f"devices: {n_dev} (dp={dp}, sp={sp or 1}), "
+    maybe_print(f"devices: {n_dev} (dp={dp}, sp={sp or 1}, pp={pp or 1}), "
                 f"config: {args.config}", rank0=True)
 
     attention_fn = None
@@ -137,7 +161,23 @@ def main():
                 out_specs=P("data", "sp"))
             return f(q, k, v, bias)
 
-    model_def = models.BertForPreTraining(cfg, attention_fn=attention_fn)
+    if pp:
+        # the example's train loop is deterministic (no dropout rngs);
+        # PipelinedBert requires the config to say so explicitly
+        cfg = dataclasses.replace(cfg, hidden_dropout_prob=0.0,
+                                  attention_probs_dropout_prob=0.0)
+        # the pipeline sees b/grad_accum examples per call, dp-sharded
+        per_call = args.b // max(args.grad_accum, 1) // dp
+        if per_call % args.pp_microbatches:
+            raise SystemExit(
+                f"per-data-shard batch {per_call} (b/grad_accum/dp) must "
+                f"divide into --pp-microbatches {args.pp_microbatches}")
+        model_def = models.PipelinedBert(
+            cfg, mesh, pp=pp, num_microbatches=args.pp_microbatches,
+            batch_axis="data")
+    else:
+        model_def = models.BertForPreTraining(cfg,
+                                              attention_fn=attention_fn)
     # the BERT recipe: bias/LayerNorm params take no weight decay (param
     # group) AND no layer adaptation (trust ratio 1.0) — the reference's
     # downstream-BERT convention, now expressible declaratively
@@ -145,7 +185,13 @@ def main():
         lr=args.lr, max_grad_norm=args.max_grad_norm,
         param_groups=[{"match": r"(bias|_ln)", "weight_decay": 0.0}],
         exclude_from_layer_adaptation=lambda path: any(
-            "bias" in str(k) or "_ln" in str(k) for k in path))
+            "bias" in str(k) or "_ln" in str(k) for k in path),
+        # under --pp stage params are (pp, ...) stacks of per-layer
+        # tensors; per-slice ratios keep LAMB's layer-wise adaptation
+        # identical to the non-pipelined model
+        per_slice_trust_ratio=(
+            (lambda path: any("stages" in str(k) for k in path))
+            if pp else None))
     model, optimizer = amp.initialize(
         model_def, optimizer_def, opt_level=args.opt_level,
         loss_scale=args.loss_scale)
@@ -158,6 +204,10 @@ def main():
     repl = NamedSharding(mesh, P())
     shard = NamedSharding(mesh, P("data"))
     params = jax.device_put(params, repl)
+    if pp:  # stage stacks live one-per-device on the pipe axis
+        params["stages"] = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, NamedSharding(mesh, P("pipe"))),
+            params["stages"])
     opt_state = jax.device_put(opt_state, repl)
 
     def batch_loss(p, ids, labels, weights, nsp, mlm_denom, div):
