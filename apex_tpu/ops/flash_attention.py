@@ -27,6 +27,25 @@ backward (custom VJP), two kernels over the same block structure::
     dq += ds @ k * scale    (grid q-major)
     dk += ds^T @ q * scale  (grid k-major)
 
+attention-probability dropout (in-kernel, ``dropout_rate``/``seed``):
+dropout multiplies the NORMALIZED probs by ``c = keep/(1-rate)``, so
+``out_i = sum_j c_ij p_ij v_j`` with ``p_ij = exp(s_ij - lse_i)``.  In
+the streaming forward, ``l`` (and lse) accumulate UNdropped ``p`` while
+``acc`` accumulates ``c*p @ v`` — ``acc/l`` is then exactly
+``dropout(softmax(s)) @ v``.  Backward: differentiating through the
+softmax with the ``c`` weights gives ::
+
+    d out_i / d s_ij . do_i = p_ij * (c_ij (do_i . v_j) - delta_i),
+    delta_i = sum_k c_ik p_ik (do_i . v_k) = rowsum(do * out)
+
+i.e. the usual ``ds = p * (dov - delta)`` with ``dov`` masked+scaled by
+``c`` — ``delta`` needs NO change because ``out`` already carries the
+dropout.  ``dv`` uses the dropped probs: ``dv_j += sum_i c_ij p_ij
+do_i``.  The keep-mask is a counter-based hash of the GLOBAL (batch*
+head, q, k) coordinate (``_dropout_keep``), regenerated bit-identically
+in all three kernels and the jnp oracle; the lse cotangent fold is
+unchanged since lse is the un-dropped statistic.
+
 Key-position masks (additive, (B, Sk)) and causal masking are supported;
 fully-masked query rows emit zeros. A pure-jnp path (``use_pallas=False``)
 is the parity oracle and CPU fallback; on CPU the kernels run in
@@ -56,14 +75,53 @@ def _cdiv(a, b):
     return (a + b - 1) // b
 
 
+def _dropout_keep(seed, bh, rows, cols, rate):
+    """Deterministic keep-mask for attention-probability dropout.
+
+    Counter-based: a murmur3-finalizer hash of the GLOBAL logical
+    coordinate (batch*head, q position, k position) and the step seed —
+    plain integer jnp ops, so the SAME mask is regenerated bit-exactly
+    in the forward kernel, both backward kernels, the jnp oracle, and
+    interpret mode (pltpu's hardware PRNG returns zeros under interpret,
+    and jax.random can't run inside a Pallas body).  ~6 VPU int ops per
+    score element, overlapped with the MXU matmuls.
+
+    ``rate`` is the DROP probability; keep => True.
+    """
+    x = (rows.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)) ^ \
+        (cols.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)) ^ \
+        ((jnp.asarray(bh, jnp.uint32) + jnp.uint32(1))
+         * jnp.uint32(0xC2B2AE3D)) ^ \
+        (jnp.asarray(seed, jnp.uint32) * jnp.uint32(0x27D4EB2F))
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> jnp.uint32(13))
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> jnp.uint32(16))
+    u = (x >> jnp.uint32(8)).astype(jnp.float32) * (2.0 ** -24)
+    return u >= rate
+
+
+def _keep_block(seed_ref, bh, iq, ik, bq, bk, rate):
+    """The (bq, bk) keep-mask for grid position (bh, iq, ik) — THE ONE
+    place that maps block coordinates to the global hash, so the
+    forward and both backward kernels cannot drift apart."""
+    rows = lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
+    cols = lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ik * bk
+    return _dropout_keep(seed_ref[0], bh, rows, cols, rate)
+
+
 # ---------------------------------------------------------------------------
 # forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *, scale, causal, bq, bk, nk):
+def _fwd_kernel(mask_ref, seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, causal, bq, bk, nk,
+                dropout_rate):
     ik = pl.program_id(2)
     iq = pl.program_id(1)
+    bh = pl.program_id(0)  # hoisted: program_id may not appear inside
+    # a pl.when body (interpret mode cannot lower it there)
 
     @pl.when(ik == 0)
     def _init():
@@ -88,9 +146,16 @@ def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
         corr = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new[:, None])
+        # dropout applies to the normalized probs: the normalizer l
+        # accumulates UNdropped p, the value accumulator the dropped —
+        # out = acc/l then equals dropout(softmax(s)) @ v exactly
+        p_v = p
+        if dropout_rate > 0.0:
+            keep = _keep_block(seed_ref, bh, iq, ik, bq, bk, dropout_rate)
+            p_v = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
         l_new = l_ref[:, 0] * corr + jnp.sum(p, axis=1)
         acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
-            p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            p_v, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
@@ -140,10 +205,12 @@ def _recompute_p(q, k, mask_row, lse_col, scale, causal, iq, ik, bq, bk):
     return jnp.where(valid, jnp.exp(s - lse2), 0.0)
 
 
-def _bwd_dq_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                   delta_ref, dq_ref, dq_acc, *, scale, causal, bq, bk, nk):
+def _bwd_dq_kernel(mask_ref, seed_ref, q_ref, k_ref, v_ref, do_ref,
+                   lse_ref, delta_ref, dq_ref, dq_acc, *, scale, causal,
+                   bq, bk, nk, dropout_rate):
     ik = pl.program_id(2)
     iq = pl.program_id(1)
+    bh = pl.program_id(0)  # hoisted out of the pl.when body
 
     @pl.when(ik == 0)
     def _init():
@@ -155,6 +222,12 @@ def _bwd_dq_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dov = jax.lax.dot_general(
             do_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
             (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            # ds = p * (c * dov - delta), c = keep/(1-rate) — same mask
+            # via _keep_block; delta already carries the dropped-out
+            # forward (see module docstring dropout derivation)
+            keep = _keep_block(seed_ref, bh, iq, ik, bq, bk, dropout_rate)
+            dov = jnp.where(keep, dov / (1.0 - dropout_rate), 0.0)
         ds = p * (dov - delta_ref[0, 0][:, None])
         dq_acc[:] += jax.lax.dot_general(
             ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
@@ -170,11 +243,12 @@ def _bwd_dq_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                    delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
-                    *, scale, causal, bq, bk, nq):
+def _bwd_dkv_kernel(mask_ref, seed_ref, q_ref, k_ref, v_ref, do_ref,
+                    lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale, causal, bq, bk, nq, dropout_rate):
     iq = pl.program_id(2)
     ik = pl.program_id(1)
+    bh = pl.program_id(0)  # hoisted out of the pl.when body
 
     @pl.when(iq == 0)
     def _init():
@@ -185,12 +259,18 @@ def _bwd_dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         p = _recompute_p(q_ref[0], k_ref[0], mask_ref[0, 0], lse_ref[0, 0],
                          scale, causal, iq, ik, bq, bk)  # (bq, bk)
         do32 = do_ref[0].astype(jnp.float32)
+        p_v = p
+        if dropout_rate > 0.0:
+            keep = _keep_block(seed_ref, bh, iq, ik, bq, bk, dropout_rate)
+            p_v = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
         dv_acc[:] += jax.lax.dot_general(
-            p, do32, (((0,), (0,)), ((), ())),
+            p_v, do32, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)          # (bk, D)
         dov = jax.lax.dot_general(
             do32, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            dov = jnp.where(keep, dov / (1.0 - dropout_rate), 0.0)
         ds = p * (dov - delta_ref[0, 0][:, None])        # (bq, bk)
         dk_acc[:] += jax.lax.dot_general(
             ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
@@ -274,19 +354,22 @@ def _specs(bq, bk, d, h):
 
 
 @functools.partial(jax.jit, static_argnames=("scale", "causal", "bq", "bk",
-                                             "h", "interpret"))
-def _fwd_pallas(q3, k3, v3, mask, *, scale, causal, bq, bk, h, interpret):
+                                             "h", "interpret",
+                                             "dropout_rate"))
+def _fwd_pallas(q3, k3, v3, mask, seed, *, scale, causal, bq, bk, h,
+                interpret, dropout_rate=0.0):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     nq, nk = sq // bq, sk // bk
     lanes = 128
     q_spec, k_spec, mask_spec, row_spec = _specs(bq, bk, d, h)
+    seed_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
     vma = _union_vma(q3, k3, v3, mask)
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk),
+                          bq=bq, bk=bk, nk=nk, dropout_rate=dropout_rate),
         grid=(bh, nq, nk),
-        in_specs=[mask_spec, q_spec, k_spec, k_spec],
+        in_specs=[mask_spec, seed_spec, q_spec, k_spec, k_spec],
         out_specs=[q_spec, row_spec],
         out_shape=[_out_struct((bh, sq, d), q3.dtype, vma),
                    _out_struct((bh, 1, sq), jnp.float32, vma)],
@@ -294,14 +377,15 @@ def _fwd_pallas(q3, k3, v3, mask, *, scale, causal, bq, bk, h, interpret):
                         pltpu.VMEM((bq, lanes), jnp.float32),
                         pltpu.VMEM((bq, lanes), jnp.float32)],
         interpret=interpret,
-    )(mask[:, None, :], q3, k3, v3)
+    )(mask[:, None, :], seed, q3, k3, v3)
     return o, lse[:, 0, :]                           # (BH, Sq)
 
 
 @functools.partial(jax.jit, static_argnames=("scale", "causal", "bq", "bk",
-                                             "h", "interpret"))
-def _bwd_pallas(q3, k3, v3, do3, o3, lse, mask, *, scale, causal, bq, bk,
-                h, interpret, dlse=None):
+                                             "h", "interpret",
+                                             "dropout_rate"))
+def _bwd_pallas(q3, k3, v3, do3, o3, lse, mask, seed, *, scale, causal,
+                bq, bk, h, interpret, dlse=None, dropout_rate=0.0):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     nq, nk = sq // bq, sk // bk
@@ -313,6 +397,7 @@ def _bwd_pallas(q3, k3, v3, do3, o3, lse, mask, *, scale, causal, bq, bk,
         # reusing the kernels unchanged
         delta = delta - dlse.astype(jnp.float32)
     q_spec, k_spec, mask_spec, row_spec = _specs(bq, bk, d, h)
+    seed_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
     mask3 = mask[:, None, :]
     lse3 = lse[:, None, :]
     delta3 = delta[:, None, :]
@@ -320,15 +405,15 @@ def _bwd_pallas(q3, k3, v3, do3, o3, lse, mask, *, scale, causal, bq, bk,
     vma = _union_vma(q3, k3, v3, do3, lse3, delta3, mask3)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk),
+                          bq=bq, bk=bk, nk=nk, dropout_rate=dropout_rate),
         grid=(bh, nq, nk),
-        in_specs=[mask_spec, q_spec, k_spec, k_spec, q_spec, row_spec,
-                  row_spec],
+        in_specs=[mask_spec, seed_spec, q_spec, k_spec, k_spec, q_spec,
+                  row_spec, row_spec],
         out_specs=q_spec,
         out_shape=_out_struct((bh, sq, d), q3.dtype, vma),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(mask3, q3, k3, v3, do3, lse3, delta3)
+    )(mask3, seed, q3, k3, v3, do3, lse3, delta3)
 
     dkv_kspec = pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0))
     dkv_qspec = pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0))
@@ -336,17 +421,17 @@ def _bwd_pallas(q3, k3, v3, do3, o3, lse, mask, *, scale, causal, bq, bk,
     dkv_row = pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq),
+                          bq=bq, bk=bk, nq=nq, dropout_rate=dropout_rate),
         grid=(bh, nk, nq),
-        in_specs=[dkv_mask, dkv_qspec, dkv_kspec, dkv_kspec, dkv_qspec,
-                  dkv_row, dkv_row],
+        in_specs=[dkv_mask, seed_spec, dkv_qspec, dkv_kspec, dkv_kspec,
+                  dkv_qspec, dkv_row, dkv_row],
         out_specs=[dkv_kspec, dkv_kspec],
         out_shape=[_out_struct((bh, sk, d), k3.dtype, vma),
                    _out_struct((bh, sk, d), v3.dtype, vma)],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
-    )(mask3, q3, k3, v3, do3, lse3, delta3)
+    )(mask3, seed, q3, k3, v3, do3, lse3, delta3)
     return dq, dk, dv
 
 
@@ -354,12 +439,15 @@ def _bwd_pallas(q3, k3, v3, do3, o3, lse, mask, *, scale, causal, bq, bk,
 # public entry
 # ---------------------------------------------------------------------------
 
-def _reference(q, k, v, kv_mask, causal, scale, return_lse: bool = False):
+def _reference(q, k, v, kv_mask, causal, scale, return_lse: bool = False,
+               dropout_rate: float = 0.0, seed=None):
     """Pure-jnp oracle (fp32 softmax), shapes (B, S, H, D).
 
     With ``return_lse`` also returns the per-row log-sum-exp (B, H, Sq)
     fp32 (NEG_INF for fully-masked rows) — the merge statistic for
-    blockwise/ring combination."""
+    blockwise/ring combination.  Dropout uses the SAME deterministic
+    hash mask as the kernels (``_dropout_keep``), so kernel-vs-oracle
+    parity holds at any fixed (rate, seed)."""
     s = _einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     if kv_mask is not None:
@@ -373,8 +461,15 @@ def _reference(q, k, v, kv_mask, causal, scale, return_lse: bool = False):
     valid = m > NEG_INF / 2
     p = jnp.exp(s - m)
     den = jnp.sum(p, axis=-1, keepdims=True)
-    out = _einsum("bhqk,bkhd->bqhd", p / jnp.maximum(den, 1e-30),
-                     v.astype(jnp.float32))
+    probs = p / jnp.maximum(den, 1e-30)
+    if dropout_rate > 0.0:
+        b, sq, h, _ = q.shape
+        bh = jnp.arange(b * h).reshape(b, h)[:, :, None, None]
+        rows = jnp.arange(sq)[None, None, :, None]
+        cols = jnp.arange(k.shape[1])[None, None, None, :]
+        keep = _dropout_keep(seed[0], bh, rows, cols, dropout_rate)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+    out = _einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
     out = out * jnp.transpose(valid, (0, 2, 1, 3)).astype(out.dtype)
     out = out.astype(q.dtype)
     if not return_lse:
@@ -385,19 +480,22 @@ def _reference(q, k, v, kv_mask, causal, scale, return_lse: bool = False):
     return out, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash_lse(q, k, v, mask, causal, scale, bq, bk, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash_lse(q, k, v, mask, seed, causal, scale, bq, bk, interpret,
+               dropout_rate):
     """Returns ``(out, lse)`` with lse (B, H, Sq) fp32 — differentiable
     in BOTH outputs (the lse cotangent folds into the kernels' delta
     input, see ``_bwd_pallas``).  ``mask`` is always a concrete (B, Sk)
-    fp32 array (zeros when the caller had none) so the VJP can return a
-    well-typed cotangent."""
-    (out, lse), _ = _flash_lse_fwd(q, k, v, mask, causal, scale, bq, bk,
-                                   interpret)
+    fp32 array (zeros when the caller had none) and ``seed`` a (1,)
+    int32 array (zeros when dropout is off) so the VJP can return
+    well-typed cotangents."""
+    (out, lse), _ = _flash_lse_fwd(q, k, v, mask, seed, causal, scale,
+                                   bq, bk, interpret, dropout_rate)
     return out, lse
 
 
-def _flash_lse_fwd(q, k, v, mask, causal, scale, bq, bk, interpret):
+def _flash_lse_fwd(q, k, v, mask, seed, causal, scale, bq, bk, interpret,
+                   dropout_rate):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     q3 = _pad_seq(_layout(q), bq)
@@ -408,16 +506,18 @@ def _flash_lse_fwd(q, k, v, mask, causal, scale, bq, bk, interpret):
     if sk_pad != sk:  # padded keys must never win the softmax
         mask_p = jnp.pad(mask, ((0, 0), (0, sk_pad - sk)),
                          constant_values=NEG_INF)
-    o3, lse = _fwd_pallas(q3, k3, v3, mask_p, scale=scale, causal=causal,
-                          bq=bq, bk=bk, h=h, interpret=interpret)
+    o3, lse = _fwd_pallas(q3, k3, v3, mask_p, seed, scale=scale,
+                          causal=causal, bq=bq, bk=bk, h=h,
+                          interpret=interpret, dropout_rate=dropout_rate)
     out = _unlayout(o3[:, :sq], b, h)
     lse_pub = lse[:, :sq].reshape(b, h, sq)
-    return (out, lse_pub), (q3, k3, v3, o3, lse, mask_p, b, h, sq, sk)
+    return (out, lse_pub), (q3, k3, v3, o3, lse, mask_p, seed, b, h, sq,
+                            sk)
 
 
-def _flash_lse_bwd(causal, scale, bq, bk, interpret, res, g):
+def _flash_lse_bwd(causal, scale, bq, bk, interpret, dropout_rate, res, g):
     do, dlse = g
-    q3, k3, v3, o3, lse, mask_p, b, h, sq, sk = res
+    q3, k3, v3, o3, lse, mask_p, seed, b, h, sq, sk = res
     do3 = _pad_seq(_layout(do), bq)
     dlse3 = None
     if dlse is not None:
@@ -425,43 +525,50 @@ def _flash_lse_bwd(causal, scale, bq, bk, interpret, res, g):
         dlse3 = dlse.astype(jnp.float32).reshape(b * h, sq)
         if sq_pad != sq:
             dlse3 = jnp.pad(dlse3, ((0, 0), (0, sq_pad - sq)))
-    dq3, dk3, dv3 = _bwd_pallas(q3, k3, v3, do3, o3, lse, mask_p,
+    dq3, dk3, dv3 = _bwd_pallas(q3, k3, v3, do3, o3, lse, mask_p, seed,
                                 scale=scale, causal=causal, bq=bq, bk=bk,
-                                h=h, interpret=interpret, dlse=dlse3)
+                                h=h, interpret=interpret, dlse=dlse3,
+                                dropout_rate=dropout_rate)
     dq = _unlayout(dq3[:, :sq], b, h)
     dk = _unlayout(dk3[:, :sk], b, h)
     dv = _unlayout(dv3[:, :sk], b, h)
     dmask = jnp.zeros((b, sk), jnp.float32)  # masks are not trained
-    return dq, dk, dv, dmask
+    dseed = jnp.zeros((1,), jnp.int32)
+    return dq, dk, dv, dmask, dseed
 
 
-_flash_lse.defvjp(lambda q, k, v, m, causal, scale, bq, bk, interp:
-                  _flash_lse_fwd(q, k, v, m, causal, scale, bq, bk,
-                                 interp),
+_flash_lse.defvjp(lambda q, k, v, m, s, causal, scale, bq, bk, interp,
+                  rate:
+                  _flash_lse_fwd(q, k, v, m, s, causal, scale, bq, bk,
+                                 interp, rate),
                   _flash_lse_bwd)
 
 
 # out-only variant: same fwd/bwd machinery with the lse output discarded
 # (one implementation to keep in sync, not two)
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, mask, causal, scale, bq, bk, interpret):
-    out, _ = _flash_fwd(q, k, v, mask, causal, scale, bq, bk, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, mask, seed, causal, scale, bq, bk, interpret,
+           dropout_rate):
+    out, _ = _flash_fwd(q, k, v, mask, seed, causal, scale, bq, bk,
+                        interpret, dropout_rate)
     return out
 
 
-def _flash_fwd(q, k, v, mask, causal, scale, bq, bk, interpret):
-    (out, _), res = _flash_lse_fwd(q, k, v, mask, causal, scale, bq, bk,
-                                   interpret)
+def _flash_fwd(q, k, v, mask, seed, causal, scale, bq, bk, interpret,
+               dropout_rate):
+    (out, _), res = _flash_lse_fwd(q, k, v, mask, seed, causal, scale,
+                                   bq, bk, interpret, dropout_rate)
     return out, res
 
 
-def _flash_bwd(causal, scale, bq, bk, interpret, res, do):
-    return _flash_lse_bwd(causal, scale, bq, bk, interpret, res,
-                          (do, None))
+def _flash_bwd(causal, scale, bq, bk, interpret, dropout_rate, res, do):
+    return _flash_lse_bwd(causal, scale, bq, bk, interpret, dropout_rate,
+                          res, (do, None))
 
 
-_flash.defvjp(lambda q, k, v, m, causal, scale, bq, bk, interp:
-              _flash_fwd(q, k, v, m, causal, scale, bq, bk, interp),
+_flash.defvjp(lambda q, k, v, m, s, causal, scale, bq, bk, interp, rate:
+              _flash_fwd(q, k, v, m, s, causal, scale, bq, bk, interp,
+                         rate),
               _flash_bwd)
 
 
@@ -470,7 +577,9 @@ def flash_attention(q, k, v, *, kv_mask: Optional[jax.Array] = None,
                     block_q: int = 128, block_k: int = 128,
                     use_pallas: Optional[bool] = None,
                     interpret: Optional[bool] = None,
-                    return_lse: bool = False):
+                    return_lse: bool = False,
+                    dropout_rate: float = 0.0,
+                    dropout_seed=None):
     """Memory-efficient exact attention.
 
     Args:
@@ -485,25 +594,52 @@ def flash_attention(q, k, v, *, kv_mask: Optional[jax.Array] = None,
         (NEG_INF for fully-masked rows) — the statistic for combining
         blockwise partial attentions (ring attention's merge); both
         outputs are differentiable.
+      dropout_rate: attention-probability dropout (applied to the
+        normalized probs IN-KERNEL — no (Sq, Sk) mask tensor in HBM).
+        The mask is a deterministic hash of (seed, batch*head, q pos,
+        k pos) regenerated identically in forward, backward, and the
+        jnp oracle (``_dropout_keep``); lse stays the un-dropped
+        statistic.
+      dropout_seed: int32 scalar (Python int or traced) — REQUIRED when
+        dropout_rate > 0.  The mask is a pure function of (seed, bh, q,
+        k), so the seed must be distinct per training step AND per
+        attention layer — a single per-step seed shared by N layers
+        would drop the same positions in every layer.  Derive per-layer
+        seeds with ``jax.random.fold_in``/``randint`` from a per-layer
+        rng (flax's ``make_rng('dropout')`` folds the module path in
+        automatically — what ``models.bert.BertSelfAttention`` does).
 
     Differentiable (custom VJP with recompute — no (Sq, Sk) tensor ever
     hits HBM in either pass).
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    dropout_rate = float(dropout_rate)
+    if not 0.0 <= dropout_rate < 1.0:
+        raise ValueError(f"dropout_rate must be in [0, 1); got "
+                         f"{dropout_rate}")
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError(
+            "flash_attention(dropout_rate>0) requires dropout_seed — a "
+            "per-step int32 scalar (a fixed implicit seed would freeze "
+            "the dropout mask across steps)")
+    seed = (jnp.zeros((1,), jnp.int32) if dropout_seed is None
+            else jnp.asarray(dropout_seed, jnp.int32).reshape((1,)))
     use = on_tpu() if use_pallas is None else use_pallas
     if not use or not _HAS_PALLAS:
         return _reference(q, k, v, kv_mask, causal, scale,
-                          return_lse=return_lse)
+                          return_lse=return_lse,
+                          dropout_rate=dropout_rate, seed=seed)
     if interpret is None:
         interpret = not on_tpu()
     mask = (jnp.zeros((q.shape[0], k.shape[1]), jnp.float32)
             if kv_mask is None else kv_mask.astype(jnp.float32))
     if return_lse:
-        return _flash_lse(q, k, v, mask, causal, float(scale),
-                          int(block_q), int(block_k), bool(interpret))
-    return _flash(q, k, v, mask, causal, float(scale), int(block_q),
-                  int(block_k), bool(interpret))
+        return _flash_lse(q, k, v, mask, seed, causal, float(scale),
+                          int(block_q), int(block_k), bool(interpret),
+                          dropout_rate)
+    return _flash(q, k, v, mask, seed, causal, float(scale), int(block_q),
+                  int(block_k), bool(interpret), dropout_rate)
 
 
 def bias_to_kv_mask(bias):
@@ -524,17 +660,44 @@ def bias_to_kv_mask(bias):
     return bias[:, 0, 0, :].astype(jnp.float32)
 
 
+def dropout_params(dropout_fn):
+    """Extract in-kernel dropout params from an ``attention_fn``-contract
+    ``dropout_fn``.
+
+    ``models.bert.BertSelfAttention`` attaches ``.rate`` (static float)
+    and ``.seed`` (per-step traced int32) to the dropout closure it
+    passes to attention adapters; fused kernels consume those instead of
+    calling the closure (which materializes the (Sq, Sk) probs).
+    Returns ``(rate, seed)`` or raises if the closure carries no params
+    (a plain function can only be applied to materialized probs, which
+    defeats the fused kernel).
+    """
+    if dropout_fn is None:
+        return 0.0, None
+    rate = getattr(dropout_fn, "rate", None)
+    seed = getattr(dropout_fn, "seed", None)
+    if rate is None or seed is None:
+        raise NotImplementedError(
+            "this dropout_fn carries no (rate, seed) annotation, and a "
+            "plain probs->probs dropout closure cannot run inside the "
+            "fused kernel (the probs are never materialized). Attach "
+            "`dropout_fn.rate` / `dropout_fn.seed` (see "
+            "models.bert.BertSelfAttention) or set "
+            "attention_probs_dropout_prob=0.")
+    return float(rate), seed
+
+
 def make_flash_attention(*, causal: bool = False, **kwargs):
     """Adapter with the ``attention_fn(q, k, v, bias, dropout_fn)``
     signature of ``models.bert.dot_product_attention``; bias must be a
-    key-position-only (B, 1, 1, Sk) additive mask."""
+    key-position-only (B, 1, 1, Sk) additive mask.  Attention dropout
+    runs IN-KERNEL via the (rate, seed) annotation on ``dropout_fn``
+    (see :func:`dropout_params`)."""
 
     def attention_fn(q, k, v, bias=None, dropout_fn=None):
-        if dropout_fn is not None:
-            raise NotImplementedError(
-                "attention-probability dropout is not supported by the "
-                "fused kernel; set attention_probs_dropout_prob=0")
+        rate, seed = dropout_params(dropout_fn)
         return flash_attention(q, k, v, kv_mask=bias_to_kv_mask(bias),
-                               causal=causal, **kwargs)
+                               causal=causal, dropout_rate=rate,
+                               dropout_seed=seed, **kwargs)
 
     return attention_fn

@@ -351,7 +351,11 @@ def make_ring_attention(axis_name: str, *, causal: bool = False) -> Callable:
         if dropout_fn is not None:
             raise NotImplementedError(
                 "attention-probability dropout is not supported under ring "
-                "attention; set attention_probs_dropout_prob=0")
+                "attention (the in-kernel mask would need global ring-hop "
+                "coordinates; single-chip flash_attention supports it via "
+                "dropout_rate/dropout_seed). Set "
+                "attention_probs_dropout_prob=0 under SP — the common "
+                "practice for long-context training.")
         return ring_attention(q, k, v, axis_name=axis_name,
                               kv_mask=_bias_to_kv_mask(bias), causal=causal)
 
@@ -365,7 +369,8 @@ def make_ulysses_attention(axis_name: str, *, causal: bool = False) -> Callable:
         if dropout_fn is not None:
             raise NotImplementedError(
                 "attention-probability dropout is not supported under "
-                "sequence parallelism; set attention_probs_dropout_prob=0")
+                "sequence parallelism (see make_ring_attention); set "
+                "attention_probs_dropout_prob=0")
         return ulysses_attention(q, k, v, axis_name=axis_name,
                                  kv_mask=_bias_to_kv_mask(bias),
                                  causal=causal)
