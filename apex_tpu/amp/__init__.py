@@ -31,6 +31,7 @@ from apex_tpu.amp.optimizer import AmpOptimizer, AmpOptimizerState
 from apex_tpu.amp.frontend import initialize
 from apex_tpu.amp.handle import scale_loss, scale, disable_casts
 from apex_tpu.amp.functional import (
+    banned_function,
     half_function,
     float_function,
     promote_function,

@@ -19,11 +19,18 @@ from apex_tpu.amp.model import applier
 from apex_tpu.amp.optimizer import AmpOptimizerState
 
 
-def _active_half_dtype():
+def _amp_active() -> bool:
+    """Active amp configuration and casts not disabled — THE predicate
+    every decorator in this module gates on."""
     props = _amp_state._amp_state.opt_properties
-    if props is None or not props.enabled or \
-            _amp_state._amp_state.casts_disabled:
+    return (props is not None and bool(props.enabled)
+            and not _amp_state._amp_state.casts_disabled)
+
+
+def _active_half_dtype():
+    if not _amp_active():
         return None
+    props = _amp_state._amp_state.opt_properties
     if props.cast_model_type not in (None, False):
         return props.cast_model_type
     if props.cast_ops:
@@ -88,7 +95,32 @@ def promote_function(fn):
     return wrapper
 
 
+def banned_function(fn):
+    """Wrap ``fn`` to raise under active amp (the reference's banned
+    wrapper, ``amp.py:164-171``): decorating IS the ban declaration —
+    the call errors whenever amp is active (``disable_casts`` is the
+    escape hatch), whatever the function is named."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if _amp_active():
+            raise RuntimeError(
+                f"amp does not work out-of-the-box with "
+                f"`{fn.__name__}` — it was registered as banned (fp16 "
+                "range makes it unsafe). Use a *_with_logits form, or "
+                "wrap the call in apex_tpu.amp.disable_casts.")
+        return fn(*args, **kwargs)
+    wrapper.__amp_original__ = fn
+    return wrapper
+
+
 def _register(module, fn_name: str, wrapper):
+    from apex_tpu.amp import lists
+
+    # the reference refuses banned fns no matter how they're registered
+    # (functional_overrides.py:67-77): registering BCE-on-probabilities
+    # for half casting would legitimize an fp16-unsafe op
+    lists.check_banned(fn_name)
     fn = getattr(module, fn_name)
     setattr(module, fn_name, wrapper(fn))
 

@@ -99,4 +99,5 @@ def check_banned(op_name: str) -> None:
             f"amp does not work out-of-the-box with `{op_name}` — the fp16 "
             "range makes it unsafe. Use the *_with_logits / "
             "sigmoid_binary_cross_entropy form instead, or wrap the call "
-            "in apex_tpu.amp.float_function / disable_casts.")
+            "site in apex_tpu.amp.disable_casts to compute it outside "
+            "amp's policy.")

@@ -41,7 +41,7 @@ import jax.numpy as jnp
 # __init__ rebinds its `_amp_state` attribute to this same instance, so
 # attribute-style module imports are ambiguous here
 from apex_tpu.amp._amp_state import _amp_state as _STATE
-from apex_tpu.amp.lists import FP16_OPS, FP32_OPS
+from apex_tpu.amp.lists import BANNED_OPS, FP16_OPS, FP32_OPS, check_banned
 
 _HALF_DTYPES = (jnp.float16, jnp.bfloat16)
 
@@ -93,6 +93,8 @@ def _wrap(fn: Callable, mode: str) -> Callable:
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
         if _active():
+            if mode == "banned":  # reference amp.py:164-171
+                check_banned(fn.__name__)
             cast = _maybe_float if mode == "fp32" else _maybe_half
             args, kwargs = _cast_args(args, kwargs, cast)
         return fn(*args, **kwargs)
@@ -122,6 +124,14 @@ def _targets() -> List[Tuple[Any, str, str]]:
     out += [(jsp, n, "fp32") for n in fp32_jsp if hasattr(jsp, n)]
     out += [(jnp.linalg, "norm", "fp32")]
     out += [(jnp, n, "half") for n in half_jnp if hasattr(jnp, n)]
+    # banned ops (BCE on probabilities — fp16-range-unsafe, reference
+    # functional_overrides.py:67-77): no baked-in jax/optax namespace
+    # ships one today (optax's sigmoid_binary_cross_entropy takes
+    # LOGITS, which is the safe form), so this sweep arms the guard for
+    # any namespace that grows one; user-code registration is enforced
+    # through amp.functional._register / banned_function.
+    for mod in (jnp, jax.nn):
+        out += [(mod, n, "banned") for n in BANNED_OPS if hasattr(mod, n)]
 
     try:
         import optax
@@ -140,7 +150,7 @@ def _targets() -> List[Tuple[Any, str, str]]:
         pass
 
     # sanity: every patched name must be covered by the policy tables
-    known = FP32_OPS | FP16_OPS | {
+    known = FP32_OPS | FP16_OPS | BANNED_OPS | {
         "arccos", "arcsin", "arctan", "standardize", "power", "vdot",
         "inner", "tensordot", "l2_loss", "huber_loss", "kl_divergence",
         "log_cosh",

@@ -152,16 +152,20 @@ class BertSelfAttention(nn.Module):
             drop = nn.Dropout(cfg.attention_probs_dropout_prob,
                               deterministic=False)
             dropout_fn = lambda p: drop(p)
-            # annotate for fused attention adapters (flash/ring/Ulysses):
-            # kernels can't call a probs->probs closure (probs are never
-            # materialized), so they consume (rate, per-step seed) and
-            # run dropout in-kernel (ops.flash_attention.dropout_params).
-            # The seed derives from the same flax 'dropout' rng stream
-            # the closure would use, so each step/microbatch redraws.
-            dropout_fn.rate = cfg.attention_probs_dropout_prob
-            dropout_fn.seed = jax.random.randint(
-                self.make_rng("dropout"), (), 0, jnp.iinfo(jnp.int32).max,
-                dtype=jnp.int32)
+            if self.attention_fn is not None:
+                # annotate for fused attention adapters (flash/ring/
+                # Ulysses): kernels can't call a probs->probs closure
+                # (probs are never materialized), so they consume
+                # (rate, per-step seed) and run dropout in-kernel
+                # (ops.flash_attention.dropout_params).  The seed comes
+                # from the flax 'dropout' rng stream (module path folded
+                # in => distinct per layer), redrawn each step.  Only
+                # drawn for custom attention_fns so the DEFAULT path's
+                # rng stream is unchanged.
+                dropout_fn.rate = cfg.attention_probs_dropout_prob
+                dropout_fn.seed = jax.random.randint(
+                    self.make_rng("dropout"), (), 0,
+                    jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
         attn = self.attention_fn or dot_product_attention
         ctx = attn(q, k, v, bias=attn_bias, dropout_fn=dropout_fn)
         return nn.DenseGeneral(h, axis=(-2, -1), kernel_init=init,

@@ -66,3 +66,51 @@ def test_noop_handle():
     assert not handle.is_active
     with handle.scale_loss(jnp.asarray(2.5), None) as s:
         assert float(s) == 2.5
+
+
+def test_banned_enforced_at_registration():
+    """Registering a banned op for amp casting refuses immediately — the
+    reference rejects BCE-on-probabilities however it reaches amp
+    (functional_overrides.py:67-77)."""
+    import types
+
+    import pytest
+
+    from apex_tpu import amp
+
+    mod = types.ModuleType("user_losses")
+    mod.binary_cross_entropy = lambda p, y: p  # fp16-unsafe form
+    with pytest.raises(RuntimeError, match="with_logits"):
+        amp.register_half_function(mod, "binary_cross_entropy")
+    with pytest.raises(RuntimeError, match="with_logits"):
+        amp.register_float_function(mod, "binary_cross_entropy")
+
+
+def test_banned_function_raises_only_under_active_amp():
+    """amp.banned_function: call-time enforcement, inert without an
+    active amp configuration (the reference's handle-active check)."""
+    import jax.numpy as jnp
+    import optax
+    import pytest
+
+    from apex_tpu import amp
+    from apex_tpu.models import MLP
+
+    def binary_cross_entropy(p, y):
+        return -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p)).mean()
+
+    wrapped = amp.banned_function(binary_cross_entropy)
+    p = jnp.asarray([0.4, 0.9])
+    y = jnp.asarray([0.0, 1.0])
+    assert jnp.isfinite(wrapped(p, y))  # amp inactive: passes through
+
+    amp.initialize(MLP(features=(4,)), optax.sgd(0.1), opt_level="O1",
+                   verbosity=0)
+    try:
+        with pytest.raises(RuntimeError, match="with_logits"):
+            wrapped(p, y)
+        with amp.disable_casts():  # the documented escape hatch
+            assert jnp.isfinite(wrapped(p, y))
+    finally:
+        from apex_tpu.amp._amp_state import _amp_state
+        _amp_state.opt_properties = None

@@ -60,6 +60,51 @@ def test_multi_process_without_coordinator_raises(clean_env, monkeypatch):
         multiproc.initialize_distributed()
 
 
+def test_real_two_process_bootstrap(clean_env, tmp_path, monkeypatch):
+    """UNMOCKED multi-process bootstrap: the launcher spawns two
+    processes whose ``initialize_distributed()`` really runs
+    ``jax.distributed.initialize`` (CPU backend), and a cross-process
+    allgather proves the distributed runtime is live — the analog of
+    the reference's real 2-process NCCL tier
+    (``tests/distributed/DDP/ddp_race_condition_test.py``)."""
+    import os
+    import socket
+
+    # pick a free coordinator port so parallel test runs can't collide
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(multiproc.__file__))))
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "from jax.experimental import multihost_utils\n"
+        "from apex_tpu.parallel import multiproc\n"
+        "pid = multiproc.initialize_distributed()\n"
+        "gathered = multihost_utils.process_allgather(\n"
+        "    np.asarray([pid], np.int32))\n"
+        "with open(f'result_{pid}.txt', 'w') as f:\n"
+        "    f.write(f'{jax.process_count()} '\n"
+        "            f'{sorted(gathered.ravel().tolist())}')\n")
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("NUM_PROCESSES", "2")
+    monkeypatch.setenv("COORDINATOR_ADDRESS", f"localhost:{port}")
+    monkeypatch.setenv(
+        "PYTHONPATH",
+        repo_root + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    rc = multiproc.main([str(script)])
+    assert rc == 0
+    for r in (0, 1):
+        # both processes saw the 2-process world AND each other's rank
+        assert (tmp_path / f"result_{r}.txt").read_text() == "2 [0, 1]"
+
+
 def test_launcher_spawns_world_size_processes(clean_env, tmp_path,
                                               monkeypatch):
     """The local launcher forks NUM_PROCESSES copies with PROCESS_ID set

@@ -1,0 +1,113 @@
+"""Record an L1 loss trajectory for the bitwise native-vs-pyonly gate.
+
+The reference's strongest correctness oracle asserts EXACT loss equality
+between the python-only and extension installs
+(``/root/reference/tests/L1/common/compare.py:41,55-56``).  Here the two
+installs run the SAME XLA program — the native C++ extension only
+touches host-side IO (batch gather, flatten staging, JPEG decode) — so
+their trajectories must be bit-identical, and this script records one
+for ``run.sh`` to compare across the ``native`` / ``pyonly`` axes.
+
+The input batches are routed through ``npz_loader`` so the native
+row-gather (vs numpy fancy indexing) is actually ON the trajectory
+path; the train step is the L1 harness ConvBNNet amp O2 run.
+
+Usage: python l1_trajectory.py OUT.json  (respects APEX_TPU_NO_NATIVE)
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+# CPU pinning dance (tests/conftest.py): env var is not enough when the
+# sitecustomize auto-registers a TPU plugin
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+from L1 import harness  # noqa: E402
+
+from apex_tpu import amp  # noqa: E402
+from apex_tpu.data import npz_loader  # noqa: E402
+from apex_tpu.ops import native  # noqa: E402
+from apex_tpu.optimizers import FusedAdam  # noqa: E402
+
+STEPS = 8
+BATCH = 16
+
+
+def main(out_path: str) -> None:
+    import jax.numpy as jnp
+
+    # deterministic dataset written to an npz shard; the loader's
+    # shuffled batch assembly then runs through the native gather (or
+    # its numpy fallback under APEX_TPU_NO_NATIVE=1)
+    xs, ys = harness.make_data(STEPS, batch=BATCH, seed=0)
+    n = STEPS * BATCH
+    x_all = np.asarray(xs, np.float32).reshape((n,) + xs.shape[2:])
+    # loaders expect uint8 images; quantize deterministically
+    x_u8 = np.clip(
+        (x_all - x_all.min()) / max(float(np.ptp(x_all)), 1e-6) * 255,
+        0, 255).astype(np.uint8)
+    y_all = np.asarray(ys, np.int32).reshape(n)
+    with tempfile.TemporaryDirectory() as d:
+        np.savez(os.path.join(d, "shard0.npz"), x=x_u8, y=y_all)
+        it = npz_loader(d, BATCH, shuffle=True, seed=1)
+        batches = [next(it) for _ in range(STEPS)]
+
+    model, optimizer = amp.initialize(
+        harness.ConvBNNet(use_pallas=False), FusedAdam(lr=1e-2),
+        opt_level="O2", verbosity=0)
+    x0 = jnp.asarray(batches[0][0], jnp.float32) / 255.0
+    variables = model.init(jax.random.PRNGKey(0), x0, train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    opt_state = optimizer.init(params)
+
+    @jax.jit
+    def train_step(params, batch_stats, opt_state, x, y):
+        import optax
+
+        def loss_fn(p):
+            logits, mut = model.apply(
+                {"params": p, "batch_stats": batch_stats}, x, train=True,
+                mutable=["batch_stats"])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), y).mean()
+            with amp.scale_loss(loss, opt_state) as scaled:
+                return scaled, (loss, mut["batch_stats"])
+        grads, (loss, new_stats) = jax.grad(loss_fn, has_aux=True)(params)
+        params, opt_state = optimizer.step(params, grads, opt_state)
+        return params, new_stats, opt_state, loss
+
+    losses = []
+    for x_u8_b, y_b in batches:
+        x = jnp.asarray(x_u8_b, jnp.float32) / 255.0
+        y = jnp.asarray(y_b)
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, x, y)
+        # bit-exact serialization: hex of the raw float32
+        losses.append(np.float32(loss).tobytes().hex())
+
+    record = {
+        "native_loaded": bool(native.available),
+        "losses_hex": losses,
+        "final_param_checksum": np.float64(sum(
+            float(np.asarray(leaf, np.float64).sum())
+            for leaf in jax.tree_util.tree_leaves(params))).hex(),
+    }
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=1)
+    print(f"trajectory: native_loaded={record['native_loaded']} "
+          f"losses={len(losses)} -> {out_path}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

@@ -28,6 +28,30 @@ run_axis native  APEX_TPU_NO_NATIVE=
 run_axis pyonly  APEX_TPU_NO_NATIVE=1
 run_axis x64     JAX_ENABLE_X64=1
 
+# bitwise gate (the reference's strongest oracle,
+# tests/L1/common/compare.py:41,55-56: python-only vs extension installs
+# must produce EXACTLY equal losses): the native ext only touches
+# host-side IO, so the two axes run the same XLA program and their L1
+# trajectories must be bit-identical, not merely close.
+echo "=== build-matrix axis: bitwise (native vs pyonly trajectories) ==="
+tmpdir=$(mktemp -d)
+env APEX_TPU_NO_NATIVE=  python tests/build_matrix/l1_trajectory.py "$tmpdir/native.json" \
+  && env APEX_TPU_NO_NATIVE=1 python tests/build_matrix/l1_trajectory.py "$tmpdir/pyonly.json" \
+  && python - "$tmpdir" <<'EOF'
+import json, sys
+d = sys.argv[1]
+a = json.load(open(f"{d}/native.json"))
+b = json.load(open(f"{d}/pyonly.json"))
+assert a["native_loaded"] and not b["native_loaded"], \
+    (a["native_loaded"], b["native_loaded"])
+assert a["losses_hex"] == b["losses_hex"], \
+    f"loss trajectories differ:\n  native: {a['losses_hex']}\n  pyonly: {b['losses_hex']}"
+assert a["final_param_checksum"] == b["final_param_checksum"]
+print(f"bitwise: {len(a['losses_hex'])} losses + final params identical")
+EOF
+results[bitwise]=$?
+rm -rf "$tmpdir"
+
 echo
 echo "=== build-matrix results ==="
 rc=0
