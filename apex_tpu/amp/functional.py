@@ -104,11 +104,8 @@ def banned_function(fn):
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
         if _amp_active():
-            raise RuntimeError(
-                f"amp does not work out-of-the-box with "
-                f"`{fn.__name__}` — it was registered as banned (fp16 "
-                "range makes it unsafe). Use a *_with_logits form, or "
-                "wrap the call in apex_tpu.amp.disable_casts.")
+            from apex_tpu.amp.lists import banned_message
+            raise RuntimeError(banned_message(fn.__name__))
         return fn(*args, **kwargs)
     wrapper.__amp_original__ = fn
     return wrapper

@@ -91,13 +91,19 @@ def policy_for(op_name: str) -> str:
     return "passthrough"
 
 
+def banned_message(op_name: str) -> str:
+    """The single source of the banned-op remediation text (shared by
+    :func:`check_banned` and ``amp.banned_function``)."""
+    return (
+        f"amp does not work out-of-the-box with `{op_name}` — the fp16 "
+        "range makes it unsafe. Use the *_with_logits / "
+        "sigmoid_binary_cross_entropy form instead, or wrap the call "
+        "site in apex_tpu.amp.disable_casts to compute it outside "
+        "amp's policy.")
+
+
 def check_banned(op_name: str) -> None:
     """Raise (like the reference's banned-function wrapper,
     ``amp.py:164-171``) if ``op_name`` must not be used under amp."""
     if policy_for(op_name) == "banned":
-        raise RuntimeError(
-            f"amp does not work out-of-the-box with `{op_name}` — the fp16 "
-            "range makes it unsafe. Use the *_with_logits / "
-            "sigmoid_binary_cross_entropy form instead, or wrap the call "
-            "site in apex_tpu.amp.disable_casts to compute it outside "
-            "amp's policy.")
+        raise RuntimeError(banned_message(op_name))

@@ -102,13 +102,51 @@ def _dropout_keep(seed, bh, rows, cols, rate):
     return u >= rate
 
 
-def _keep_block(seed_ref, bh, iq, ik, bq, bk, rate):
-    """The (bq, bk) keep-mask for grid position (bh, iq, ik) — THE ONE
-    place that maps block coordinates to the global hash, so the
-    forward and both backward kernels cannot drift apart."""
-    rows = lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
-    cols = lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ik * bk
-    return _dropout_keep(seed_ref[0], bh, rows, cols, rate)
+def seed_array(dropout_seed, offsets=None, *, num_heads):
+    """Pack (seed, row_off, col_off, head_off, num_heads_total) into the
+    (5,) int32 scalar array every dropout consumer takes — flash's SMEM
+    operand, the jnp oracle, and the sequence-parallel fallbacks all
+    read THIS layout (``_keep_block`` / :func:`keep_from_seed`)."""
+    ro, co, ho, ht = offsets or (0, 0, 0, num_heads)
+    return jnp.stack([
+        jnp.asarray(dropout_seed, jnp.int32).reshape(()),
+        jnp.asarray(ro, jnp.int32).reshape(()),
+        jnp.asarray(co, jnp.int32).reshape(()),
+        jnp.asarray(ho, jnp.int32).reshape(()),
+        jnp.asarray(ht, jnp.int32).reshape(())])
+
+
+def keep_from_seed(seed, b, h_local, rows, cols, rate):
+    """(B, h_local, len(rows), len(cols)) keep-mask from a
+    :func:`seed_array` and LOCAL coordinate ranges — the one non-kernel
+    mapping of local coordinates to the global hash (the in-kernel
+    block form is :func:`_keep_block`; both must agree, pinned by the
+    kernel-vs-oracle parity tests)."""
+    bh = (jnp.arange(b)[:, None] * seed[4] + seed[3]
+          + jnp.arange(h_local)[None, :])[:, :, None, None]
+    return _dropout_keep(seed[0], bh,
+                         (rows + seed[1])[None, None, :, None],
+                         (cols + seed[2])[None, None, None, :], rate)
+
+
+def _keep_block(seed_ref, bh, iq, ik, bq, bk, rate, h):
+    """The (bq, bk) keep-mask for grid position (bh, iq, ik) — the ONE
+    in-kernel mapping of block coordinates to the global hash, so the
+    forward and both backward kernels cannot drift apart (the host-side
+    equivalent is :func:`keep_from_seed`).
+
+    ``seed_ref`` is the (5,) SMEM scalar array
+    ``[seed, row_offset, col_offset, head_offset, num_heads_total]``:
+    the offsets translate LOCAL coordinates to GLOBAL ones so sharded
+    callers (ring attention's rotating KV shards, Ulysses' head shards)
+    drop exactly the positions the equivalent single-device call would.
+    ``h`` is the LOCAL head count (the bh grid dim is batch*h_local)."""
+    rows = lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq \
+        + seed_ref[1]
+    cols = lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ik * bk \
+        + seed_ref[2]
+    bh_g = (bh // h) * seed_ref[4] + seed_ref[3] + bh % h
+    return _dropout_keep(seed_ref[0], bh_g, rows, cols, rate)
 
 
 # ---------------------------------------------------------------------------
@@ -117,7 +155,7 @@ def _keep_block(seed_ref, bh, iq, ik, bq, bk, rate):
 
 def _fwd_kernel(mask_ref, seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref, *, scale, causal, bq, bk, nk,
-                dropout_rate):
+                dropout_rate, h):
     ik = pl.program_id(2)
     iq = pl.program_id(1)
     bh = pl.program_id(0)  # hoisted: program_id may not appear inside
@@ -151,7 +189,8 @@ def _fwd_kernel(mask_ref, seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         # out = acc/l then equals dropout(softmax(s)) @ v exactly
         p_v = p
         if dropout_rate > 0.0:
-            keep = _keep_block(seed_ref, bh, iq, ik, bq, bk, dropout_rate)
+            keep = _keep_block(seed_ref, bh, iq, ik, bq, bk, dropout_rate,
+                               h)
             p_v = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
         l_new = l_ref[:, 0] * corr + jnp.sum(p, axis=1)
         acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
@@ -207,7 +246,7 @@ def _recompute_p(q, k, mask_row, lse_col, scale, causal, iq, ik, bq, bk):
 
 def _bwd_dq_kernel(mask_ref, seed_ref, q_ref, k_ref, v_ref, do_ref,
                    lse_ref, delta_ref, dq_ref, dq_acc, *, scale, causal,
-                   bq, bk, nk, dropout_rate):
+                   bq, bk, nk, dropout_rate, h):
     ik = pl.program_id(2)
     iq = pl.program_id(1)
     bh = pl.program_id(0)  # hoisted out of the pl.when body
@@ -226,7 +265,8 @@ def _bwd_dq_kernel(mask_ref, seed_ref, q_ref, k_ref, v_ref, do_ref,
             # ds = p * (c * dov - delta), c = keep/(1-rate) — same mask
             # via _keep_block; delta already carries the dropped-out
             # forward (see module docstring dropout derivation)
-            keep = _keep_block(seed_ref, bh, iq, ik, bq, bk, dropout_rate)
+            keep = _keep_block(seed_ref, bh, iq, ik, bq, bk, dropout_rate,
+                               h)
             dov = jnp.where(keep, dov / (1.0 - dropout_rate), 0.0)
         ds = p * (dov - delta_ref[0, 0][:, None])
         dq_acc[:] += jax.lax.dot_general(
@@ -245,7 +285,7 @@ def _bwd_dq_kernel(mask_ref, seed_ref, q_ref, k_ref, v_ref, do_ref,
 
 def _bwd_dkv_kernel(mask_ref, seed_ref, q_ref, k_ref, v_ref, do_ref,
                     lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
-                    *, scale, causal, bq, bk, nq, dropout_rate):
+                    *, scale, causal, bq, bk, nq, dropout_rate, h):
     iq = pl.program_id(2)
     ik = pl.program_id(1)
     bh = pl.program_id(0)  # hoisted out of the pl.when body
@@ -261,7 +301,8 @@ def _bwd_dkv_kernel(mask_ref, seed_ref, q_ref, k_ref, v_ref, do_ref,
         do32 = do_ref[0].astype(jnp.float32)
         p_v = p
         if dropout_rate > 0.0:
-            keep = _keep_block(seed_ref, bh, iq, ik, bq, bk, dropout_rate)
+            keep = _keep_block(seed_ref, bh, iq, ik, bq, bk, dropout_rate,
+                               h)
             p_v = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
         dv_acc[:] += jax.lax.dot_general(
             p_v, do32, (((0,), (0,)), ((), ())),
@@ -367,7 +408,8 @@ def _fwd_pallas(q3, k3, v3, mask, seed, *, scale, causal, bq, bk, h,
     vma = _union_vma(q3, k3, v3, mask)
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk, dropout_rate=dropout_rate),
+                          bq=bq, bk=bk, nk=nk, dropout_rate=dropout_rate,
+                          h=h),
         grid=(bh, nq, nk),
         in_specs=[mask_spec, seed_spec, q_spec, k_spec, k_spec],
         out_specs=[q_spec, row_spec],
@@ -405,7 +447,8 @@ def _bwd_pallas(q3, k3, v3, do3, o3, lse, mask, seed, *, scale, causal,
     vma = _union_vma(q3, k3, v3, do3, lse3, delta3, mask3)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk, dropout_rate=dropout_rate),
+                          bq=bq, bk=bk, nk=nk, dropout_rate=dropout_rate,
+                          h=h),
         grid=(bh, nq, nk),
         in_specs=[mask_spec, seed_spec, q_spec, k_spec, k_spec, q_spec,
                   row_spec, row_spec],
@@ -421,7 +464,8 @@ def _bwd_pallas(q3, k3, v3, do3, o3, lse, mask, seed, *, scale, causal,
     dkv_row = pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq, dropout_rate=dropout_rate),
+                          bq=bq, bk=bk, nq=nq, dropout_rate=dropout_rate,
+                          h=h),
         grid=(bh, nk, nq),
         in_specs=[dkv_mask, seed_spec, dkv_qspec, dkv_kspec, dkv_kspec,
                   dkv_qspec, dkv_row, dkv_row],
@@ -464,10 +508,8 @@ def _reference(q, k, v, kv_mask, causal, scale, return_lse: bool = False,
     probs = p / jnp.maximum(den, 1e-30)
     if dropout_rate > 0.0:
         b, sq, h, _ = q.shape
-        bh = jnp.arange(b * h).reshape(b, h)[:, :, None, None]
-        rows = jnp.arange(sq)[None, None, :, None]
-        cols = jnp.arange(k.shape[1])[None, None, None, :]
-        keep = _dropout_keep(seed[0], bh, rows, cols, dropout_rate)
+        keep = keep_from_seed(seed, b, h, jnp.arange(sq),
+                              jnp.arange(k.shape[1]), dropout_rate)
         probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
     out = _einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
     out = out * jnp.transpose(valid, (0, 2, 1, 3)).astype(out.dtype)
@@ -533,7 +575,7 @@ def _flash_lse_bwd(causal, scale, bq, bk, interpret, dropout_rate, res, g):
     dk = _unlayout(dk3[:, :sk], b, h)
     dv = _unlayout(dv3[:, :sk], b, h)
     dmask = jnp.zeros((b, sk), jnp.float32)  # masks are not trained
-    dseed = jnp.zeros((1,), jnp.int32)
+    dseed = jnp.zeros_like(seed)
     return dq, dk, dv, dmask, dseed
 
 
@@ -579,7 +621,8 @@ def flash_attention(q, k, v, *, kv_mask: Optional[jax.Array] = None,
                     interpret: Optional[bool] = None,
                     return_lse: bool = False,
                     dropout_rate: float = 0.0,
-                    dropout_seed=None):
+                    dropout_seed=None,
+                    dropout_offsets=None):
     """Memory-efficient exact attention.
 
     Args:
@@ -608,6 +651,12 @@ def flash_attention(q, k, v, *, kv_mask: Optional[jax.Array] = None,
         seeds with ``jax.random.fold_in``/``randint`` from a per-layer
         rng (flax's ``make_rng('dropout')`` folds the module path in
         automatically — what ``models.bert.BertSelfAttention`` does).
+      dropout_offsets: optional ``(row_offset, col_offset, head_offset,
+        num_heads_total)`` int32 scalars (traced OK) translating this
+        call's LOCAL coordinates to GLOBAL ones, so sharded callers drop
+        exactly what the single-device call would: ring attention passes
+        its q-shard/KV-hop offsets, Ulysses its head-shard offset.
+        Default ``(0, 0, 0, H)``.
 
     Differentiable (custom VJP with recompute — no (Sq, Sk) tensor ever
     hits HBM in either pass).
@@ -623,8 +672,11 @@ def flash_attention(q, k, v, *, kv_mask: Optional[jax.Array] = None,
             "flash_attention(dropout_rate>0) requires dropout_seed — a "
             "per-step int32 scalar (a fixed implicit seed would freeze "
             "the dropout mask across steps)")
-    seed = (jnp.zeros((1,), jnp.int32) if dropout_seed is None
-            else jnp.asarray(dropout_seed, jnp.int32).reshape((1,)))
+    if dropout_seed is None:
+        seed = jnp.zeros((5,), jnp.int32)
+    else:
+        seed = seed_array(dropout_seed, dropout_offsets,
+                          num_heads=q.shape[2])
     use = on_tpu() if use_pallas is None else use_pallas
     if not use or not _HAS_PALLAS:
         return _reference(q, k, v, kv_mask, causal, scale,

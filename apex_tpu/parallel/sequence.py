@@ -59,20 +59,27 @@ def _vary_like(x, *refs, extra_axes=()):
     return lax.pcast(x, missing, to="varying") if missing else x
 
 
-def _online_block_update(m, den, acc, scores, v):
+def _online_block_update(m, den, acc, scores, v, keep=None,
+                         dropout_rate=0.0):
     """One online-softmax accumulation step, all fp32.
 
     m: (B, H, Sq) running max; den: (B, H, Sq) running denominator;
     acc: (B, Sq, H, D) running numerator; scores: (B, H, Sq, Sk) this
     block's logits; v: (B, Sk, H, D) this block's values.
+
+    ``keep``: optional (B, H, Sq, Sk) dropout keep-mask — applied to the
+    numerator only (den stays un-dropped), the flash-kernel convention
+    that makes acc/den equal dropout(softmax) @ v exactly.
     """
     m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
     # renormalize previous accumulators to the new max
     correction = jnp.exp(m - m_new)
     p = jnp.exp(scores - m_new[..., None])            # (B, H, Sq, Sk)
     den = den * correction + jnp.sum(p, axis=-1)
+    p_v = p if keep is None else \
+        jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
     acc = acc * jnp.transpose(correction, (0, 2, 1))[..., None] \
-        + _einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+        + _einsum("bhqk,bkhd->bqhd", p_v, v.astype(jnp.float32))
     return m_new, den, acc
 
 
@@ -81,7 +88,9 @@ def ring_attention(q, k, v, *, axis_name: str,
                    causal: bool = False,
                    scale: Optional[float] = None,
                    use_flash: Optional[bool] = None,
-                   flash_kwargs: Optional[dict] = None):
+                   flash_kwargs: Optional[dict] = None,
+                   dropout_rate: float = 0.0,
+                   dropout_seed=None):
     """Exact attention over a sequence sharded on ``axis_name``.
 
     Args:
@@ -103,16 +112,32 @@ def ring_attention(q, k, v, *, axis_name: str,
         interpreter cannot type varying axes yet; the compiled TPU path
         type-checks under default vma checking).
 
+      dropout_rate / dropout_seed: attention-probability dropout with
+        GLOBAL-coordinate masks (``ops.flash_attention._dropout_keep``):
+        every (q, k) pair drops exactly as the equivalent single-device
+        flash/oracle call would at the same seed, independent of the
+        ring layout — each hop hashes its shard offsets in.
+
     Returns (B, S_local, H, D) in q's dtype. Gradients flow through the
     ppermute rotations, so the backward pass is itself a ring program.
     """
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError(
+            "ring_attention(dropout_rate>0) requires dropout_seed")
+    if flash_kwargs and any(k.startswith("dropout") for k in flash_kwargs):
+        raise ValueError(
+            "pass dropout_rate/dropout_seed to ring_attention itself, not "
+            "via flash_kwargs — per-hop masks need the ring's global "
+            "coordinate offsets, which only the outer call can supply")
     if use_flash is None:
         use_flash = on_tpu()
     if use_flash:
         return _ring_attention_flash(q, k, v, axis_name=axis_name,
                                      kv_mask=kv_mask, causal=causal,
                                      scale=scale,
-                                     flash_kwargs=flash_kwargs or {})
+                                     flash_kwargs=flash_kwargs or {},
+                                     dropout_rate=dropout_rate,
+                                     dropout_seed=dropout_seed)
     n = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
@@ -152,7 +177,20 @@ def ring_attention(q, k, v, *, axis_name: str,
             k_pos = src * s_local + jnp.arange(s_local)
             allowed = q_pos[:, None] >= k_pos[None, :]   # (Sq, Sk)
             scores = jnp.where(allowed[None, None], scores, NEG_INF)
-        m, den, acc = _online_block_update(m, den, acc, scores, v_blk)
+        keep = None
+        if dropout_rate > 0.0:
+            from apex_tpu.ops.flash_attention import (keep_from_seed,
+                                                      seed_array)
+            # q_pos/k_pos are already global: offsets fold in directly
+            keep = keep_from_seed(
+                seed_array(dropout_seed,
+                           (my_idx * s_local, src * s_local, 0, h),
+                           num_heads=h),
+                b, h, jnp.arange(s_local), jnp.arange(s_local),
+                dropout_rate)
+        m, den, acc = _online_block_update(m, den, acc, scores, v_blk,
+                                           keep=keep,
+                                           dropout_rate=dropout_rate)
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
         if has_mask:
@@ -178,7 +216,8 @@ def ring_attention(q, k, v, *, axis_name: str,
 
 
 def _ring_attention_flash(q, k, v, *, axis_name, kv_mask, causal, scale,
-                          flash_kwargs):
+                          flash_kwargs, dropout_rate=0.0,
+                          dropout_seed=None):
     """Ring attention with the flash kernel per hop.
 
     Each hop runs :func:`flash_attention` with ``return_lse`` on the
@@ -199,10 +238,18 @@ def _ring_attention_flash(q, k, v, *, axis_name, kv_mask, causal, scale,
     if has_mask:
         kv_mask = kv_mask.astype(jnp.float32)
 
-    def flash(k_blk, v_blk, mask_blk, is_causal):
+    def flash(k_blk, v_blk, mask_blk, is_causal, src):
+        extra = {}
+        if dropout_rate > 0.0:
+            # global coordinates: this q shard starts at my_idx*s_local,
+            # the KV block we hold originated at rank `src`
+            extra = dict(dropout_rate=dropout_rate,
+                         dropout_seed=dropout_seed,
+                         dropout_offsets=(my_idx * s_local,
+                                          src * s_local, 0, h))
         return flash_attention(q, k_blk, v_blk, kv_mask=mask_blk,
                                causal=is_causal, scale=scale,
-                               return_lse=True, **flash_kwargs)
+                               return_lse=True, **extra, **flash_kwargs)
 
     def merge(acc, acc_lse, o_blk, lse_blk):
         # exact normalized-block combination: weights exp(lse_i - LSE)
@@ -214,7 +261,7 @@ def _ring_attention_flash(q, k, v, *, axis_name, kv_mask, causal, scale,
         return acc, new_lse
 
     # step 0: the local diagonal block (the only causal-masked hop)
-    o0, lse0 = flash(k, v, kv_mask, causal)
+    o0, lse0 = flash(k, v, kv_mask, causal, my_idx)
     acc = o0.astype(jnp.float32)
     acc_lse = lse0
 
@@ -241,13 +288,13 @@ def _ring_attention_flash(q, k, v, *, axis_name, kv_mask, causal, scale,
             # src > my: every key is in this query shard's future
             o_blk, lse_blk = lax.cond(
                 src < my_idx,
-                lambda k_, v_, m_: flash(k_, v_, m_, False),
+                lambda k_, v_, m_: flash(k_, v_, m_, False, src),
                 lambda k_, v_, m_: skip_outputs(),
                 k_blk, v_blk,
                 mask_blk if has_mask else jnp.zeros((b, s_local),
                                                     jnp.float32))
         else:
-            o_blk, lse_blk = flash(k_blk, v_blk, mask_blk, False)
+            o_blk, lse_blk = flash(k_blk, v_blk, mask_blk, False, src)
         acc2, acc_lse2 = merge(acc, acc_lse, o_blk, lse_blk)
         k2, v2 = rotate(k_blk), rotate(v_blk)
         if has_mask:
@@ -269,7 +316,9 @@ def ulysses_attention(q, k, v, *, axis_name: str,
                       scale: Optional[float] = None,
                       attention_impl: Optional[Callable] = None,
                       use_flash: Optional[bool] = None,
-                      flash_kwargs: Optional[dict] = None):
+                      flash_kwargs: Optional[dict] = None,
+                      dropout_rate: float = 0.0,
+                      dropout_seed=None):
     """All-to-all sequence parallelism (the "Ulysses" pattern).
 
     Input shards (B, S_local, H, D) with H divisible by the axis size.
@@ -280,7 +329,20 @@ def ulysses_attention(q, k, v, *, axis_name: str,
     back. ``kv_mask`` is the local (B, S_local) additive key mask.
     """
     n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError(
+            "ulysses_attention(dropout_rate>0) requires dropout_seed")
+    if dropout_rate > 0.0 and attention_impl is not None:
+        raise ValueError(
+            "dropout_rate and attention_impl are mutually exclusive: a "
+            "custom attention_impl owns its own dropout")
+    if flash_kwargs and any(k.startswith("dropout") for k in flash_kwargs):
+        raise ValueError(
+            "pass dropout_rate/dropout_seed to ulysses_attention itself, "
+            "not via flash_kwargs — the mask needs the head-shard offset, "
+            "which only the outer call can supply")
     if attention_impl is not None and scale is not None:
         raise ValueError(
             "scale and attention_impl are mutually exclusive: a custom "
@@ -309,8 +371,16 @@ def ulysses_attention(q, k, v, *, axis_name: str,
 
     if attention_impl is None and use_flash:
         # local full attention IS flash_attention's contract exactly
+        extra = {}
+        if dropout_rate > 0.0:
+            # after the all-to-all this device holds heads
+            # [my*(h/n), (my+1)*(h/n)) of the ORIGINAL h — hash global
+            # head ids so the mask matches the unsharded call
+            extra = dict(dropout_rate=dropout_rate,
+                         dropout_seed=dropout_seed,
+                         dropout_offsets=(0, 0, my_idx * (h // n), h))
         out = flash_attention(qg, kg, vg, kv_mask=mask_g, causal=causal,
-                              scale=scale, **(flash_kwargs or {}))
+                              scale=scale, **extra, **(flash_kwargs or {}))
         return to_seq(out)
 
     bias = mask_g[:, None, None, :] if mask_g is not None else None
@@ -328,6 +398,16 @@ def ulysses_attention(q, k, v, *, axis_name: str,
         if bias is not None:
             scores = scores + bias
         probs = jax.nn.softmax(scores, axis=-1)
+        if dropout_rate > 0.0:
+            from apex_tpu.ops.flash_attention import (keep_from_seed,
+                                                      seed_array)
+            h_loc = h // n
+            pos = jnp.arange(s_global)
+            keep = keep_from_seed(
+                seed_array(dropout_seed, (0, 0, my_idx * h_loc, h),
+                           num_heads=h_loc),
+                b, h_loc, pos, pos, dropout_rate)
+            probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
         out = _einsum("bhqk,bkhd->bqhd", probs,
                          vg.astype(jnp.float32))
         # fully-masked rows emit zeros, matching flash_attention and the
@@ -344,20 +424,17 @@ def make_ring_attention(axis_name: str, *, causal: bool = False) -> Callable:
     signature of :func:`apex_tpu.models.bert.dot_product_attention`: drop
     it into ``BertEncoder(attention_fn=...)`` inside shard_map and the
     encoder runs sequence-parallel. ``bias`` must be key-position-only
-    (padding mask for the local KV shard); attention dropout is not
-    supported under sequence parallelism (matches common practice)."""
+    (padding mask for the local KV shard).  Attention dropout runs
+    through the in-kernel global-coordinate mask (``dropout_fn`` rate/
+    seed annotation — ``ops.flash_attention.dropout_params``), dropping
+    exactly what the single-device call would."""
 
     def attention_fn(q, k, v, bias=None, dropout_fn=None):
-        if dropout_fn is not None:
-            raise NotImplementedError(
-                "attention-probability dropout is not supported under ring "
-                "attention (the in-kernel mask would need global ring-hop "
-                "coordinates; single-chip flash_attention supports it via "
-                "dropout_rate/dropout_seed). Set "
-                "attention_probs_dropout_prob=0 under SP — the common "
-                "practice for long-context training.")
+        from apex_tpu.ops.flash_attention import dropout_params
+        rate, seed = dropout_params(dropout_fn)
         return ring_attention(q, k, v, axis_name=axis_name,
-                              kv_mask=_bias_to_kv_mask(bias), causal=causal)
+                              kv_mask=_bias_to_kv_mask(bias), causal=causal,
+                              dropout_rate=rate, dropout_seed=seed)
 
     return attention_fn
 
@@ -366,13 +443,11 @@ def make_ulysses_attention(axis_name: str, *, causal: bool = False) -> Callable:
     """Like :func:`make_ring_attention` but via all-to-all head resharding."""
 
     def attention_fn(q, k, v, bias=None, dropout_fn=None):
-        if dropout_fn is not None:
-            raise NotImplementedError(
-                "attention-probability dropout is not supported under "
-                "sequence parallelism (see make_ring_attention); set "
-                "attention_probs_dropout_prob=0")
+        from apex_tpu.ops.flash_attention import dropout_params
+        rate, seed = dropout_params(dropout_fn)
         return ulysses_attention(q, k, v, axis_name=axis_name,
                                  kv_mask=_bias_to_kv_mask(bias),
-                                 causal=causal)
+                                 causal=causal, dropout_rate=rate,
+                                 dropout_seed=seed)
 
     return attention_fn

@@ -158,8 +158,12 @@ def build_step(opt_level, batch, image_size, num_classes=1000):
     return train_step, (params, batch_stats, opt_state, x, y)
 
 
-def measure(opt_level, batch, image_size, iters):
-    """Returns (images_per_sec, step_time_ms, flops_per_step|None)."""
+def measure(opt_level, batch, image_size, iters, trace_dir=None):
+    """Returns (images_per_sec, step_time_ms, flops_per_step|None).
+
+    ``trace_dir``: capture an xprof trace of 3 steps after the timed
+    loop — the step-time breakdown artifact for MFU work (the driver
+    archives the repo tree, so the trace survives the round)."""
     step, args = build_step(opt_level, batch, image_size)
     params, batch_stats, opt_state, x, y = args
     lowered = step.lower(params, batch_stats, opt_state, x, y)
@@ -174,6 +178,16 @@ def measure(opt_level, batch, image_size, iters):
             params, batch_stats, opt_state, x, y)
     float(loss)
     dt = time.perf_counter() - t0
+    if trace_dir:
+        try:
+            import jax
+            with jax.profiler.trace(trace_dir):
+                for _ in range(3):
+                    params, batch_stats, opt_state, loss = compiled(
+                        params, batch_stats, opt_state, x, y)
+                float(loss)
+        except Exception as e:
+            _note("xprof_trace", e)
     return iters * batch / dt, dt / iters * 1e3, flops
 
 
@@ -398,8 +412,12 @@ def main():
             result["step_tflops"] = round(flops / 1e12, 3)
 
     try:
-        ips, step_ms, flops = measure("O2", batch, image_size, iters)
+        trace_dir = "xprof_trace" if on_tpu else None
+        ips, step_ms, flops = measure("O2", batch, image_size, iters,
+                                      trace_dir=trace_dir)
         record_o2(ips, step_ms, flops, batch)
+        if trace_dir and os.path.isdir(trace_dir):
+            result["xprof_trace"] = trace_dir
     except Exception as e:
         _note("O2", e)
         traceback.print_exc(file=sys.stderr)

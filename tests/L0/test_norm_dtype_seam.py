@@ -162,3 +162,49 @@ def test_disable_casts_keeps_fp32(resnet_o2):
         convs = _conv_dtypes(jax.make_jaxpr(fwd)(variables, x))
     bad = [c for c in convs if c != ("float32", "float32")]
     assert not bad, f"disable_casts leaked half convs: {bad}"
+
+
+def test_o2_full_train_step_convs_all_bf16():
+    """The WHOLE train step — forward, backward, optimizer — keeps every
+    conv on bf16 operands. The forward-only pin above cannot see a seam
+    that only the grad convs hit (cotangents re-promoted to fp32 by a
+    loss/cast edge would silently put the entire backward — two thirds
+    of the step FLOPs — off the bf16 MXU path)."""
+    from apex_tpu.optimizers import FusedAdam
+
+    model, optimizer = amp.initialize(
+        models.ResNet18(num_classes=10), FusedAdam(lr=1e-3,
+                                                   use_pallas=False),
+        opt_level="O2", verbosity=0)
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    y = jnp.zeros((2,), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    opt_state = optimizer.init(params)
+
+    def train_step(params, batch_stats, opt_state, x, y):
+        def loss_fn(p):
+            logits, mut = model.apply(
+                {"params": p, "batch_stats": batch_stats}, x, train=True,
+                mutable=["batch_stats"])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), y).mean()
+            with amp.scale_loss(loss, opt_state) as scaled:
+                return scaled, (loss, mut["batch_stats"])
+        grads, (loss, new_stats) = jax.grad(loss_fn, has_aux=True)(params)
+        params, opt_state = optimizer.step(params, grads, opt_state)
+        return params, new_stats, opt_state, loss
+
+    jaxpr = jax.make_jaxpr(train_step)(params, batch_stats, opt_state,
+                                       x, y)
+    convs = _conv_dtypes(jaxpr)
+    # forward + d/d_input + d/d_filter per conv: backward convs present
+    n_fwd = len(_conv_dtypes(jax.make_jaxpr(
+        lambda v, x: model.apply(v, x, train=True,
+                                 mutable=["batch_stats"])[0])(
+        {"params": params, "batch_stats": batch_stats}, x)))
+    assert len(convs) > n_fwd, (
+        f"train step traced {len(convs)} convs vs {n_fwd} forward-only — "
+        "backward convs missing from the pin")
+    bad = [c for c in convs if c != ("bfloat16", "bfloat16")]
+    assert not bad, f"train-step convs off bf16: {bad}"
