@@ -528,9 +528,9 @@ def _flash_lse(q, k, v, mask, seed, causal, scale, bq, bk, interpret,
     """Returns ``(out, lse)`` with lse (B, H, Sq) fp32 — differentiable
     in BOTH outputs (the lse cotangent folds into the kernels' delta
     input, see ``_bwd_pallas``).  ``mask`` is always a concrete (B, Sk)
-    fp32 array (zeros when the caller had none) and ``seed`` a (1,)
-    int32 array (zeros when dropout is off) so the VJP can return
-    well-typed cotangents."""
+    fp32 array (zeros when the caller had none) and ``seed`` the (5,)
+    int32 :func:`seed_array` (zeros when dropout is off) so the VJP can
+    return well-typed cotangents."""
     (out, lse), _ = _flash_lse_fwd(q, k, v, mask, seed, causal, scale,
                                    bq, bk, interpret, dropout_rate)
     return out, lse

@@ -447,6 +447,21 @@ class TestDropoutUnderSP:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
 
+    def test_ulysses_flash_path(self, mesh):
+        """The head-shard offset reaches the kernel: flash path after
+        the all-to-all must drop the same positions as the unsharded
+        oracle."""
+        q, k, v = _qkv(18)
+        fn = lambda q, k, v: ulysses_attention(
+            q, k, v, axis_name="seq", use_flash=True,
+            flash_kwargs=dict(interpret=True, block_q=16, block_k=16,
+                              use_pallas=True),
+            dropout_rate=self.RATE, dropout_seed=self.SEED)
+        out = _sharded(mesh, fn, False)(q, k, v)
+        ref = self._oracle(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
     def test_ring_gradients_match_oracle(self, mesh):
         q, k, v = _qkv(14)
 

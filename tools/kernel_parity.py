@@ -73,12 +73,20 @@ def check_flash_attention(dtype):
     kv_mask = jnp.where(
         jax.random.uniform(ks[3], (b, s)) < 0.9, 0.0, -1e30)
 
-    for causal in (False, True):
+    # third variant: compiled in-kernel dropout — the hash mask must
+    # regenerate bit-identically through Mosaic's uint32 lowering (only
+    # interpret mode is validated off-hardware)
+    variants = [
+        ("flash_attention", dict(kv_mask=kv_mask)),
+        ("flash_attention_causal", dict(kv_mask=kv_mask, causal=True)),
+        ("flash_attention_dropout", dict(causal=True, dropout_rate=0.2,
+                                         dropout_seed=11)),
+    ]
+    for name, kw in variants:
         def loss(fn_use_pallas):
             def f(q, k, v):
-                o = flash_attention(q, k, v, kv_mask=kv_mask, causal=causal,
-                                    use_pallas=fn_use_pallas,
-                                    interpret=False)
+                o = flash_attention(q, k, v, use_pallas=fn_use_pallas,
+                                    interpret=False, **kw)
                 return (o.astype(jnp.float32) ** 2).sum(), o
             return jax.jit(jax.value_and_grad(f, argnums=(0, 1, 2),
                                               has_aux=True))
@@ -88,8 +96,7 @@ def check_flash_attention(dtype):
         rel_o, max_o = _errs(o_p, o_r)
         rel_g, max_g = _tree_errs(g_p, g_r)
         rel, mx = max(rel_o, rel_g), max(max_o, max_g)
-        record(f"flash_attention{'_causal' if causal else ''}", dtype,
-               rel <= TOL[dtype], rel, mx)
+        record(name, dtype, rel <= TOL[dtype], rel, mx)
 
 
 def check_fused_layer_norm(dtype):
