@@ -96,19 +96,76 @@ class Bottleneck(nn.Module):
         return nn.relu(residual + y)
 
 
+def space_to_depth(x, block: int = 2):
+    """NHWC (B, H, W, C) -> (B, H/b, W/b, b*b*C); channel order
+    (dh, dw, c) — the layout :func:`stem_to_s2d` rearranges the stem
+    kernel into."""
+    b_, h, w, c = x.shape
+    x = x.reshape(b_, h // block, block, w // block, block, c)
+    return jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(
+        b_, h // block, w // block, block * block * c)
+
+
+def stem_to_s2d(kernel):
+    """Rearrange a standard (7, 7, C, F) stride-2 stem kernel into the
+    EXACTLY equivalent (4, 4, 4C, F) stride-1 kernel over
+    space-to-depth input (``ResNet(stem="s2d")``); used by the torch
+    checkpoint converter when the target model runs the s2d stem.
+
+    Derivation: zero-pad the kernel to 8x8 at the top-left so window
+    starts align to even offsets, then fold each 2x2 spatial sub-block
+    into the channel dim in ``space_to_depth``'s (dh, dw, c) order.
+    """
+    k7, _, c, f = kernel.shape
+    assert kernel.shape[:2] == (7, 7), kernel.shape
+    k8 = jnp.zeros((8, 8, c, f), kernel.dtype).at[1:, 1:].set(kernel)
+    # (8, 8, C, F) -> (4, dh, 4, dw, C, F) -> (4, 4, dh, dw, C, F)
+    k8 = k8.reshape(4, 2, 4, 2, c, f)
+    return jnp.transpose(k8, (0, 2, 1, 3, 4, 5)).reshape(4, 4, 4 * c, f)
+
+
 class ResNet(nn.Module):
-    """Input NHWC, output (B, num_classes) logits."""
+    """Input NHWC, output (B, num_classes) logits.
+
+    ``stem``: ``"conv"`` is the standard torchvision 7x7/stride-2 stem;
+    ``"s2d"`` computes the SAME function via a space-to-depth transform
+    + 4x4/stride-1 conv — the MLPerf ResNet TPU optimization: a
+    (4, 4, 12, W) kernel tiles the MXU far better than (7, 7, 3, W)
+    with its 3-deep contraction. Exact equivalence (same math, weights
+    related by :func:`stem_to_s2d`) is pinned in
+    ``tests/L0/test_models.py``.
+    """
 
     stage_sizes: Sequence[int]
     block: ModuleDef
     num_classes: int = 1000
     width: int = 64
     norm: ModuleDef = default_norm
+    stem: str = "conv"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        x = nn.Conv(self.width, (7, 7), (2, 2), padding=3, use_bias=False,
-                    kernel_init=conv_init, name="stem_conv")(x)
+        if self.stem == "s2d":
+            # pad (4, 4) both sides: left 4 = the kernel's top-left zero
+            # pad + the conv's padding 3; right 4 keeps H even for s2d
+            # (the extra output row/col is sliced off below)
+            h, w = x.shape[1], x.shape[2]
+            if h % 2 or w % 2:
+                raise ValueError(
+                    f"stem='s2d' needs even spatial dims; got {(h, w)}")
+            xp = jnp.pad(x, ((0, 0), (4, 4), (4, 4), (0, 0)))
+            y = space_to_depth(xp, 2)
+            y = nn.Conv(self.width, (4, 4), (1, 1), padding="VALID",
+                        use_bias=False, kernel_init=conv_init,
+                        name="stem_conv_s2d")(y)
+            x = y[:, :(h + 1) // 2, :(w + 1) // 2]
+        elif self.stem == "conv":
+            x = nn.Conv(self.width, (7, 7), (2, 2), padding=3,
+                        use_bias=False, kernel_init=conv_init,
+                        name="stem_conv")(x)
+        else:
+            raise ValueError(f"stem must be 'conv' or 's2d', got "
+                             f"{self.stem!r}")
         x = self.norm(use_running_average=not train, name="stem_bn")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), (2, 2), padding=((1, 1), (1, 1)))
@@ -125,9 +182,10 @@ class ResNet(nn.Module):
 
 def _resnet(stages, block):
     def build(num_classes: int = 1000, norm: ModuleDef = default_norm,
-              width: int = 64) -> ResNet:
+              width: int = 64, stem: str = "conv") -> ResNet:
         return ResNet(stage_sizes=stages, block=block,
-                      num_classes=num_classes, norm=norm, width=width)
+                      num_classes=num_classes, norm=norm, width=width,
+                      stem=stem)
     return build
 
 

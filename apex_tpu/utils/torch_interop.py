@@ -58,7 +58,8 @@ def _strip_module_prefix(state_dict):
 
 def load_torch_resnet(state_dict: Mapping[str, Any],
                       arch: str = "resnet50",
-                      norm_name: str = "BatchNorm") -> Dict[str, Any]:
+                      norm_name: str = "BatchNorm",
+                      stem: str = "conv") -> Dict[str, Any]:
     """Convert a torchvision-format ResNet ``state_dict`` into the
     variables pytree of ``models.ResNetXX`` (see module docstring).
 
@@ -66,7 +67,11 @@ def load_torch_resnet(state_dict: Mapping[str, Any],
     auto-names them ``{ClassName}_{i}``, so a model built with
     ``norm=parallel.SyncBatchNorm`` (``convert_syncbn_model`` /
     ``--sync_bn``) needs ``norm_name="SyncBatchNorm"``.  The explicitly
-    named ``stem_bn``/``downsample_bn`` are unaffected."""
+    named ``stem_bn``/``downsample_bn`` are unaffected.
+
+    ``stem="s2d"``: emit the checkpoint's 7x7 stem kernel rearranged
+    for ``models.ResNet(stem="s2d")`` (``models.resnet.stem_to_s2d`` —
+    exactly equivalent math, MXU-friendlier layout)."""
     if arch not in _ARCH:
         raise ValueError(f"unknown arch {arch!r}; have {sorted(_ARCH)}")
     block_name, stage_sizes, convs_per_block = _ARCH[arch]
@@ -101,7 +106,12 @@ def load_torch_resnet(state_dict: Mapping[str, Any],
         s[dst] = {"mean": jnp.asarray(_np(sd[f"{src}.running_mean"])),
                   "var": jnp.asarray(_np(sd[f"{src}.running_var"]))}
 
-    params["stem_conv"] = {"kernel": _conv(sd["conv1.weight"])}
+    if stem == "s2d":
+        from apex_tpu.models.resnet import stem_to_s2d
+        params["stem_conv_s2d"] = {
+            "kernel": stem_to_s2d(_conv(sd["conv1.weight"]))}
+    else:
+        params["stem_conv"] = {"kernel": _conv(sd["conv1.weight"])}
     bn("bn1", "stem_bn", params, stats)
 
     k = 0

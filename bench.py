@@ -118,14 +118,15 @@ def _flops_of(compiled):
         return None
 
 
-def build_step(opt_level, batch, image_size, num_classes=1000):
+def build_step(opt_level, batch, image_size, num_classes=1000,
+               stem="conv"):
     import jax
     import jax.numpy as jnp
     import optax
     from apex_tpu import amp, models, optimizers
 
     model, optimizer = amp.initialize(
-        models.ResNet50(num_classes=num_classes),
+        models.ResNet50(num_classes=num_classes, stem=stem),
         optimizers.FusedAdam(lr=1e-3), opt_level=opt_level,
         keep_batchnorm_fp32=True if opt_level == "O3" else None,
         verbosity=0)
@@ -158,13 +159,14 @@ def build_step(opt_level, batch, image_size, num_classes=1000):
     return train_step, (params, batch_stats, opt_state, x, y)
 
 
-def measure(opt_level, batch, image_size, iters, trace_dir=None):
+def measure(opt_level, batch, image_size, iters, trace_dir=None,
+            stem="conv"):
     """Returns (images_per_sec, step_time_ms, flops_per_step|None).
 
     ``trace_dir``: capture an xprof trace of 3 steps after the timed
     loop — the step-time breakdown artifact for MFU work (the driver
     archives the repo tree, so the trace survives the round)."""
-    step, args = build_step(opt_level, batch, image_size)
+    step, args = build_step(opt_level, batch, image_size, stem=stem)
     params, batch_stats, opt_state, x, y = args
     lowered = step.lower(params, batch_stats, opt_state, x, y)
     compiled = lowered.compile()

@@ -268,3 +268,44 @@ def test_bert_remat_matches_no_remat():
     for a, b in zip(jax.tree.leaves(g), jax.tree.leaves(g_r)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6,
                                    atol=1e-7)
+
+
+def test_s2d_stem_exactly_equals_conv_stem():
+    """ResNet(stem='s2d') computes the SAME function as the standard
+    7x7/stride-2 stem when the stem kernel is rearranged with
+    stem_to_s2d — the MLPerf TPU stem optimization must be a pure
+    layout change, never a numerics change."""
+    from apex_tpu.models.resnet import stem_to_s2d
+
+    std = models.resnet.ResNet(stage_sizes=[1, 1],
+                               block=models.resnet.BasicBlock,
+                               num_classes=10, width=16)
+    s2d = models.resnet.ResNet(stage_sizes=[1, 1],
+                               block=models.resnet.BasicBlock,
+                               num_classes=10, width=16, stem="s2d")
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 32, 3))
+    v_std = std.init(jax.random.PRNGKey(1), x, train=False)
+
+    # transplant: same weights, stem kernel rearranged
+    v_s2d = s2d.init(jax.random.PRNGKey(2), x, train=False)
+    params = dict(v_std["params"])
+    params["stem_conv_s2d"] = {
+        "kernel": stem_to_s2d(params.pop("stem_conv")["kernel"])}
+    assert params["stem_conv_s2d"]["kernel"].shape == \
+        jax.tree.leaves(v_s2d["params"]["stem_conv_s2d"])[0].shape
+
+    out_std = std.apply(v_std, x, train=False)
+    out_s2d = s2d.apply(
+        {"params": params, "batch_stats": v_std["batch_stats"]}, x,
+        train=False)
+    np.testing.assert_allclose(np.asarray(out_s2d), np.asarray(out_std),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_s2d_stem_rejects_odd_input():
+    s2d = models.resnet.ResNet(stage_sizes=[1, 1],
+                               block=models.resnet.BasicBlock,
+                               num_classes=10, width=16, stem="s2d")
+    x = jnp.ones((1, 33, 33, 3))
+    with pytest.raises(ValueError, match="even"):
+        s2d.init(jax.random.PRNGKey(0), x, train=False)

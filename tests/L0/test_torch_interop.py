@@ -339,3 +339,19 @@ def test_hf_bert_layer_count_mismatch_raises():
     with pytest.raises(ValueError, match="missing"):
         load_hf_bert(hf.state_dict(), num_hidden_layers=4,
                      num_attention_heads=2)
+
+
+def test_forward_parity_with_torch_s2d_stem(torch_model):
+    """stem='s2d' conversion: the torchvision checkpoint reproduces the
+    torch forward through the space-to-depth stem layout too."""
+    variables = load_torch_resnet(torch_model.state_dict(),
+                                  arch="resnet18", stem="s2d")
+    flax_model = models.ResNet18(num_classes=10, width=16, stem="s2d")
+
+    x = np.random.RandomState(2).randn(2, 32, 32, 3).astype(np.float32)
+    with torch.no_grad():
+        want = torch_model(torch.from_numpy(
+            x.transpose(0, 3, 1, 2))).numpy()
+    got = flax_model.apply(variables, jnp.asarray(x), train=False)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4,
+                               atol=2e-4)

@@ -36,6 +36,25 @@ def sweep_resnet(batches, iters):
                   flush=True)
 
 
+def sweep_stem(iters, batch=128):
+    """Standard 7x7 stem vs the MLPerf space-to-depth stem (exactly
+    equivalent math, tests/L0/test_models.py) — the C=3 stem is the
+    canonical MXU-underutilization suspect in the step breakdown."""
+    for stem in ("conv", "s2d"):
+        try:
+            ips, step_ms, _ = bench.measure("O2", batch, 224, iters,
+                                            stem=stem)
+            print(json.dumps({"sweep": "stem", "stem": stem,
+                              "batch": batch,
+                              "images_per_sec": round(ips, 1),
+                              "step_time_ms": round(step_ms, 2)}),
+                  flush=True)
+        except Exception as e:
+            print(json.dumps({"sweep": "stem", "stem": stem,
+                              "error": f"{type(e).__name__}: {e}"}),
+                  flush=True)
+
+
 def sweep_flash(blocks, iters):
     import jax
     import jax.numpy as jnp
@@ -92,6 +111,7 @@ def main():
 
     iters = 5 if args.quick else 20
     sweep_resnet([128] if args.quick else [64, 128, 256], iters)
+    sweep_stem(iters)
     sweep_flash([128] if args.quick else [128, 256, 512],
                 3 if args.quick else 10)
     try:
