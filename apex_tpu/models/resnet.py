@@ -146,19 +146,19 @@ class ResNet(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = True):
         if self.stem == "s2d":
-            # pad (4, 4) both sides: left 4 = the kernel's top-left zero
-            # pad + the conv's padding 3; right 4 keeps H even for s2d
-            # (the extra output row/col is sliced off below)
+            # pad left 4 (the folded kernel's top-left zero pad + the
+            # conv's padding 3), right 2 (the conv's right padding that
+            # the last window reaches): h+6 stays even and the VALID
+            # conv yields exactly h/2 outputs — no slicing
             h, w = x.shape[1], x.shape[2]
             if h % 2 or w % 2:
                 raise ValueError(
                     f"stem='s2d' needs even spatial dims; got {(h, w)}")
-            xp = jnp.pad(x, ((0, 0), (4, 4), (4, 4), (0, 0)))
+            xp = jnp.pad(x, ((0, 0), (4, 2), (4, 2), (0, 0)))
             y = space_to_depth(xp, 2)
-            y = nn.Conv(self.width, (4, 4), (1, 1), padding="VALID",
+            x = nn.Conv(self.width, (4, 4), (1, 1), padding="VALID",
                         use_bias=False, kernel_init=conv_init,
                         name="stem_conv_s2d")(y)
-            x = y[:, :(h + 1) // 2, :(w + 1) // 2]
         elif self.stem == "conv":
             x = nn.Conv(self.width, (7, 7), (2, 2), padding=3,
                         use_bias=False, kernel_init=conv_init,

@@ -110,8 +110,10 @@ def load_torch_resnet(state_dict: Mapping[str, Any],
         from apex_tpu.models.resnet import stem_to_s2d
         params["stem_conv_s2d"] = {
             "kernel": stem_to_s2d(_conv(sd["conv1.weight"]))}
-    else:
+    elif stem == "conv":
         params["stem_conv"] = {"kernel": _conv(sd["conv1.weight"])}
+    else:  # same validation as ResNet.__call__ — fail HERE, not at apply
+        raise ValueError(f"stem must be 'conv' or 's2d', got {stem!r}")
     bn("bn1", "stem_bn", params, stats)
 
     k = 0

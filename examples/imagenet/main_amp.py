@@ -55,6 +55,10 @@ def parse_args():
                    "(train/<class>/*.jpg [+ val/<class>/*.jpg]) or .npz "
                    "shards (x: NHWC uint8, y: int); synthetic when omitted")
     p.add_argument("--arch", "-a", default="resnet50", choices=sorted(ARCHS))
+    p.add_argument("--stem", default="conv", choices=["conv", "s2d"],
+                   help="s2d = space-to-depth stem (MLPerf TPU layout; "
+                   "exactly equivalent math, MXU-friendlier 4x4x12 "
+                   "kernel; --torch-weights converts automatically)")
     p.add_argument("--epochs", type=int, default=2)
     p.add_argument("--b", "--batch-size", type=int, default=256, dest="b",
                    help="global batch size (split over chips)")
@@ -195,7 +199,8 @@ def main():
 
     norm = (parallel.SyncBatchNorm if args.sync_bn
             else models.resnet.default_norm)
-    model = ARCHS[args.arch](num_classes=args.num_classes, norm=norm)
+    model = ARCHS[args.arch](num_classes=args.num_classes, norm=norm,
+                             stem=args.stem)
 
     batches, make_val, steps_per_epoch = make_loaders(args)
 
@@ -223,7 +228,8 @@ def main():
         sd = sd.get("state_dict", sd)  # accept full checkpoint dicts
         converted = load_torch_resnet(
             sd, arch=args.arch,
-            norm_name="SyncBatchNorm" if args.sync_bn else "BatchNorm")
+            norm_name="SyncBatchNorm" if args.sync_bn else "BatchNorm",
+            stem=args.stem)
         # amp owns the canonical dtype layout (fp32 masters / O3 half,
         # batch_stats included)
         converted = model.canonical_variables(converted)
