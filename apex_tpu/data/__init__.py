@@ -21,8 +21,9 @@ from apex_tpu.data.loaders import (
     image_folder_loader,
     npz_loader,
     prefetch_to_device,
+    put_global,
     synthetic_loader,
 )
 
 __all__ = ["image_folder_loader", "npz_loader", "prefetch_to_device",
-           "synthetic_loader"]
+           "put_global", "synthetic_loader"]

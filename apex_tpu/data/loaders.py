@@ -34,12 +34,22 @@ def synthetic_loader(batch_size: int, image_size: int = 224,
 
 def npz_loader(data_dir: str, batch_size: int,
                steps_per_epoch: Optional[int] = None, shuffle: bool = True,
-               seed: int = 0,
-               native: bool = True) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+               seed: int = 0, native: bool = True,
+               num_shards: int = 1,
+               shard_index: int = 0) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
     """Stream batches from ``.npz`` shards holding ``x`` (N,H,W,C uint8)
     and ``y`` (N int). Batches are assembled with the native C++ gather
     when the extension is available (``apex_tpu.ops.native``), else numpy
-    fancy indexing."""
+    fancy indexing.
+
+    ``num_shards``/``shard_index``: multi-host sample sharding (the
+    ``DistributedSampler`` role, see :func:`image_folder_loader`) —
+    identical per-epoch permutations on every host, strided disjoint
+    row slices per shard within each npz file.
+    """
+    if not 0 <= shard_index < num_shards:
+        raise ValueError(
+            f"shard_index {shard_index} not in [0, {num_shards})")
     shards = sorted(glob.glob(os.path.join(data_dir, "*.npz")))
     if not shards:
         raise FileNotFoundError(f"no .npz shards in {data_dir}")
@@ -54,7 +64,18 @@ def npz_loader(data_dir: str, batch_size: int,
                 x, y = z["x"], z["y"]
             n = x.shape[0]
             perm = rng.permutation(n) if shuffle else np.arange(n)
-            for i in range(n // batch_size):
+            if num_shards > 1:
+                usable = (n // num_shards) * num_shards
+                perm = perm[:usable][shard_index::num_shards]
+            if len(perm) < batch_size:
+                # without this, a too-small file (or per-shard slice)
+                # yields zero batches and the endless loop would spin
+                # forever producing nothing
+                raise ValueError(
+                    f"{shards[si]}: {n} rows / {num_shards} shards "
+                    f"< batch_size {batch_size}; this shard cannot "
+                    "produce a single batch")
+            for i in range(len(perm) // batch_size):
                 idx = perm[i * batch_size:(i + 1) * batch_size]
                 idx = np.ascontiguousarray(idx, dtype=np.int64)
                 if use_native:
@@ -141,7 +162,8 @@ def image_folder_loader(root: str, batch_size: int, image_size: int = 224,
                         train: bool = True, shuffle: Optional[bool] = None,
                         seed: int = 0, num_workers: int = 8,
                         loop: bool = True, samples=None,
-                        native: bool = True):
+                        native: bool = True,
+                        num_shards: int = 1, shard_index: int = 0):
     """Stream (x uint8 NHWC, y int32) batches from a torchvision-style
     image folder — the real-data input path the reference gets from
     ``datasets.ImageFolder`` + multi-worker ``DataLoader`` + fast_collate
@@ -158,23 +180,39 @@ def image_folder_loader(root: str, batch_size: int, image_size: int = 224,
     Resize+CenterCrop).  ``loop=False`` yields one pass (validation) with
     a final short batch.  ``samples`` (from :func:`_list_image_folder`)
     skips re-scanning a directory tree the caller already listed.
+
+    ``num_shards``/``shard_index``: multi-host sample sharding — the
+    reference's ``DistributedSampler`` role (its example wraps the
+    dataset per rank, ``examples/imagenet/main_amp.py:218-225``).  Every
+    shard draws the SAME per-epoch permutation (seeded identically on
+    all hosts) and takes its strided slice, so shards are disjoint and
+    equal-length (up to ``num_shards-1`` trailing samples of each
+    epoch's permutation are dropped), and each host feeds only its own
+    batches (pass ``jax.process_count()``/``jax.process_index()``).
+    ``batch_size`` is this shard's PER-HOST batch.
     """
+    if not 0 <= shard_index < num_shards:
+        raise ValueError(
+            f"shard_index {shard_index} not in [0, {num_shards})")
     if samples is None:
         samples, _ = _list_image_folder(root)  # eager: bad root fails HERE
-    if train and len(samples) < batch_size:
+    if train and len(samples) // num_shards < batch_size:
         # the drop-ragged-tail rule below would otherwise yield NOTHING
         # and (with loop=True) spin forever
         raise ValueError(
-            f"{root}: {len(samples)} images < batch_size {batch_size}; "
-            "a training epoch would produce zero batches")
+            f"{root}: {len(samples)} images / {num_shards} shards < "
+            f"batch_size {batch_size}; a training epoch would produce "
+            "zero batches")
     if shuffle is None:
         shuffle = train
     return _image_folder_iter(samples, batch_size, image_size, train,
-                              shuffle, seed, num_workers, loop, native)
+                              shuffle, seed, num_workers, loop, native,
+                              num_shards, shard_index)
 
 
 def _image_folder_iter(samples, batch_size, image_size, train, shuffle,
-                       seed, num_workers, loop, native=True):
+                       seed, num_workers, loop, native=True,
+                       num_shards=1, shard_index=0):
     from concurrent.futures import ThreadPoolExecutor
 
     from apex_tpu.ops import native as native_ops
@@ -222,17 +260,52 @@ def _image_folder_iter(samples, batch_size, image_size, train, shuffle,
             decode, [(it, s) for it, s in zip(items, seeds)]))
         return np.stack([d[0] for d in decoded]).astype(np.uint8), y
 
+    epoch = 0
     while True:
         order = rng.permutation(len(samples)) if shuffle \
             else np.arange(len(samples))
+        if num_shards > 1:
+            # DistributedSampler semantics: the permutation rng draws
+            # exactly once per epoch on every host (identical streams),
+            # each shard takes a strided disjoint slice; the <num_shards
+            # remainder is dropped so shards stay equal-length
+            usable = (len(order) // num_shards) * num_shards
+            order = order[:usable][shard_index::num_shards]
+        # augmentation seeds come from a per-(epoch, shard) rng so their
+        # consumption can never desynchronize the permutation stream
+        # across hosts
+        aug_rng = np.random.RandomState(
+            (seed * 1000003 + epoch * 9973 + shard_index) % (2 ** 31))
         for i in range(0, len(order), batch_size):
             idx = order[i:i + batch_size]
             if train and len(idx) < batch_size:
                 break  # drop ragged train tail (the reference's drop_last)
-            seeds = rng.randint(2 ** 31, size=len(idx))
+            seeds = aug_rng.randint(2 ** 31, size=len(idx))
             yield assemble(idx, seeds)
+        epoch += 1
         if not loop:
             return
+
+
+def put_global(x, sharding=None):
+    """Stage one host array onto devices under ``sharding``.
+
+    Single-process: a plain ``jax.device_put``.  Multi-host: the local
+    array is this process's SHARD of the global batch (each host's
+    loader yields its ``num_shards``-th of the samples), so the global
+    array is assembled with ``jax.make_array_from_process_local_data`` —
+    a global batch of ``process_count * local_batch`` rows.  A bare
+    ``device_put`` would instead treat every host's rows as the whole
+    batch and silently drop the non-addressable remainder.
+    """
+    import jax
+
+    if sharding is None:
+        return jax.device_put(x)
+    if jax.process_count() > 1:
+        return jax.make_array_from_process_local_data(
+            sharding, np.asarray(x))
+    return jax.device_put(x, sharding)
 
 
 def prefetch_to_device(iterator, size: int = 2, sharding=None):
@@ -240,19 +313,17 @@ def prefetch_to_device(iterator, size: int = 2, sharding=None):
     batches to device (with ``sharding`` when given) ``size`` steps ahead.
 
     The TPU analog of pinned-memory + ``non_blocking=True`` copies: by the
-    time the consumer asks for batch N+1 it is already on-chip.
+    time the consumer asks for batch N+1 it is already on-chip.  On
+    multi-host, batches assemble into global arrays via
+    :func:`put_global`.
     """
-    import jax
-
     q: "queue.Queue" = queue.Queue(maxsize=size)
     _END = object()
 
     def put(batch):
-        if sharding is not None:
-            batch = jax.tree_util.tree_map(
-                lambda x: jax.device_put(x, sharding), batch)
-        else:
-            batch = jax.tree_util.tree_map(jax.device_put, batch)
+        import jax
+        batch = jax.tree_util.tree_map(
+            lambda x: put_global(x, sharding), batch)
         q.put(batch)
 
     def producer():

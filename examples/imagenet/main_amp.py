@@ -61,7 +61,9 @@ def parse_args():
                    "kernel; --torch-weights converts automatically)")
     p.add_argument("--epochs", type=int, default=2)
     p.add_argument("--b", "--batch-size", type=int, default=256, dest="b",
-                   help="global batch size (split over chips)")
+                   help="PER-HOST batch size (split over this host's "
+                   "chips; global batch = b * process_count, the "
+                   "reference's per-rank convention)")
     p.add_argument("--lr", type=float, default=0.1)
     p.add_argument("--momentum", type=float, default=0.9)
     p.add_argument("--weight-decay", type=float, default=1e-4)
@@ -120,7 +122,9 @@ def synthetic_batches(args, steps, seed=0):
 
 def npz_batches(args, steps):
     from apex_tpu.data import npz_loader
-    return npz_loader(args.data, batch_size=args.b, steps_per_epoch=steps)
+    return npz_loader(args.data, batch_size=args.b, steps_per_epoch=steps,
+                      num_shards=jax.process_count(),
+                      shard_index=jax.process_index())
 
 
 def make_loaders(args):
@@ -140,19 +144,29 @@ def make_loaders(args):
 
     train_dir = _os.path.join(args.data, "train")
     if _os.path.isdir(train_dir):  # ImageFolder layout (reference default)
+        import jax as _jax
+
         from apex_tpu.data import image_folder_loader
         from apex_tpu.data.loaders import _list_image_folder
+
+        # multi-host: each process loads its disjoint sample shard
+        # (the reference's DistributedSampler); args.b is the PER-HOST
+        # batch and put_global assembles the process-local batches into
+        # the (process_count * b)-row global array
+        nsh, sh = _jax.process_count(), _jax.process_index()
         train_samples = _list_image_folder(train_dir)[0]  # one scan
-        steps = max(1, len(train_samples) // args.b)
+        steps = max(1, len(train_samples) // nsh // args.b)
         train = image_folder_loader(
             train_dir, args.b, image_size=args.image_size, train=True,
-            num_workers=args.workers, samples=train_samples)
+            num_workers=args.workers, samples=train_samples,
+            num_shards=nsh, shard_index=sh)
         val_dir = _os.path.join(args.data, "val")
         make_val = None
         if _os.path.isdir(val_dir):
             make_val = lambda: image_folder_loader(
                 val_dir, args.b, image_size=args.image_size, train=False,
-                num_workers=args.workers, loop=False)
+                num_workers=args.workers, loop=False,
+                num_shards=nsh, shard_index=sh)
         return train, make_val, steps
     if _glob.glob(_os.path.join(args.data, "*.npz")):
         return (npz_batches(args, args.steps_per_epoch), None,
@@ -296,7 +310,15 @@ def main():
             {"params": params, "batch_stats": batch_stats}, x,
             train=False).astype(jnp.float32)
         top5 = jnp.argsort(logits, axis=-1)[:, -5:]
-        return (top5[:, -1] == y), jnp.any(top5 == y[:, None], axis=1)
+        # GLOBAL scalar sums over valid (non-padding, y >= 0) rows:
+        # replicated outputs every host can read — per-example vectors
+        # would span non-addressable devices on multi-host (the
+        # reference all-reduces val metrics the same way,
+        # reduce_tensor, main_amp.py:499-503)
+        valid = y >= 0
+        c1 = jnp.sum((top5[:, -1] == y) & valid)
+        c5 = jnp.sum(jnp.any(top5 == y[:, None], axis=1) & valid)
+        return c1, c5, jnp.sum(valid)
 
     def validate(params, batch_stats):
         """Full prec@1/5 over the val set (reference ``validate()``,
@@ -314,12 +336,12 @@ def main():
                 x = np.concatenate([x, np.zeros((pad,) + x.shape[1:],
                                                 x.dtype)])
                 y = np.concatenate([y, np.full((pad,), -1, y.dtype)])
-            xd = jax.device_put(jnp.asarray(x), shard)
-            yd = jax.device_put(jnp.asarray(y), shard)
-            c1v, c5v = eval_step(params, batch_stats, xd, yd)
-            c1 += int(np.asarray(c1v)[:bs].sum())
-            c5 += int(np.asarray(c5v)[:bs].sum())
-            n += bs
+            xd = put_global(jnp.asarray(x), shard)
+            yd = put_global(jnp.asarray(y), shard)
+            c1v, c5v, nv = eval_step(params, batch_stats, xd, yd)
+            c1 += int(c1v)   # replicated global scalars: same on every
+            c5 += int(c5v)   # host, so best-checkpoint choices agree
+            n += int(nv)
             batch_time.update(time.time() - end)
             end = time.time()
         prec1, prec5 = 100.0 * c1 / n, 100.0 * c5 / n
@@ -346,7 +368,7 @@ def main():
     # overlaps the previous step's compute (the pinned-memory /
     # non_blocking analog; reference uses DataLoader workers + CUDA
     # streams for the same overlap)
-    from apex_tpu.data import prefetch_to_device
+    from apex_tpu.data import prefetch_to_device, put_global
     batches_dev = prefetch_to_device(batches, size=2, sharding=shard)
 
     for epoch in range(start_epoch, args.epochs):
@@ -406,8 +428,8 @@ def profile(args, train_step, params, batch_stats, opt_state, batches, shard):
     for i in range(args.prof):
         x, y = next(batches)
         with trace_annotation(f"iter_{i}"):
-            x = jax.device_put(jnp.asarray(x), shard)
-            y = jax.device_put(jnp.asarray(y), shard)
+            x = put_global(jnp.asarray(x), shard)
+            y = put_global(jnp.asarray(y), shard)
             params, batch_stats, opt_state, loss, _, _ = train_step(
                 params, batch_stats, opt_state, x, y)
         jax.block_until_ready(loss)

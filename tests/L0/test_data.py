@@ -71,3 +71,25 @@ def test_prefetch_propagates_loader_errors():
     next(it)
     with pytest.raises(ValueError, match="corrupt record 7"):
         next(it)
+
+
+def test_npz_loader_sharded_disjoint(tmp_path):
+    """num_shards/shard_index: disjoint equal rows per 'host' from a
+    host-identical permutation (the DistributedSampler role)."""
+    x = np.arange(24, dtype=np.uint8).reshape(24, 1, 1, 1)
+    y = np.arange(24, dtype=np.int32)
+    np.savez(tmp_path / "shard0.npz", x=x, y=y)
+
+    def rows(shard_index):
+        it = npz_loader(str(tmp_path), batch_size=4, shuffle=True, seed=9,
+                        num_shards=2, shard_index=shard_index)
+        out = []
+        for _ in range(3):  # one epoch: 12 rows / 4
+            _, yb = next(it)
+            out.extend(yb.tolist())
+        return out
+
+    a, b = rows(0), rows(1)
+    assert len(a) == len(b) == 12
+    assert not (set(a) & set(b))
+    assert set(a) | set(b) == set(range(24))
