@@ -37,6 +37,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from apex_tpu import amp, models, parallel
+from apex_tpu.data import prefetch_to_device, put_global
 from apex_tpu.utils import AverageMeter, maybe_print
 
 
@@ -336,14 +337,18 @@ def main():
                 x = np.concatenate([x, np.zeros((pad,) + x.shape[1:],
                                                 x.dtype)])
                 y = np.concatenate([y, np.full((pad,), -1, y.dtype)])
-            xd = put_global(jnp.asarray(x), shard)
-            yd = put_global(jnp.asarray(y), shard)
+            xd = put_global(x, shard)
+            yd = put_global(y, shard)
             c1v, c5v, nv = eval_step(params, batch_stats, xd, yd)
             c1 += int(c1v)   # replicated global scalars: same on every
             c5 += int(c5v)   # host, so best-checkpoint choices agree
             n += int(nv)
             batch_time.update(time.time() - end)
             end = time.time()
+        if n == 0:  # e.g. a val set smaller than the shard count
+            maybe_print("validate: no validation batches on this shard; "
+                        "skipping metrics", rank0=True)
+            return None, None
         prec1, prec5 = 100.0 * c1 / n, 100.0 * c5 / n
         maybe_print(f" * Prec@1 {prec1:.3f} Prec@5 {prec5:.3f} "
                     f"({n} images, {batch_time.avg:.3f}s/batch)",
@@ -368,7 +373,6 @@ def main():
     # overlaps the previous step's compute (the pinned-memory /
     # non_blocking analog; reference uses DataLoader workers + CUDA
     # streams for the same overlap)
-    from apex_tpu.data import prefetch_to_device, put_global
     batches_dev = prefetch_to_device(batches, size=2, sharding=shard)
 
     for epoch in range(start_epoch, args.epochs):
@@ -428,8 +432,8 @@ def profile(args, train_step, params, batch_stats, opt_state, batches, shard):
     for i in range(args.prof):
         x, y = next(batches)
         with trace_annotation(f"iter_{i}"):
-            x = put_global(jnp.asarray(x), shard)
-            y = put_global(jnp.asarray(y), shard)
+            x = put_global(x, shard)
+            y = put_global(y, shard)
             params, batch_stats, opt_state, loss, _, _ = train_step(
                 params, batch_stats, opt_state, x, y)
         jax.block_until_ready(loss)
