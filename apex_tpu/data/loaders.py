@@ -303,11 +303,11 @@ def put_global(x, sharding=None):
     if sharding is None:
         return jax.device_put(x)
     if jax.process_count() > 1:
-        # pass HOST arrays here: converting a device-committed array
-        # back with np.asarray would round-trip device->host->device
-        if not isinstance(x, np.ndarray):
-            x = np.asarray(x)
-        return jax.make_array_from_process_local_data(sharding, x)
+        # contract: pass HOST arrays — a device-committed input would
+        # round-trip device->host->device here (the loaders all yield
+        # numpy)
+        return jax.make_array_from_process_local_data(
+            sharding, np.asarray(x))
     return jax.device_put(x, sharding)
 
 

@@ -439,12 +439,35 @@ def main():
         except Exception as e:
             _note("O2_batch_sweep", e)
 
+    # space-to-depth stem (EXACTLY equivalent math, models.resnet
+    # stem_to_s2d + tests/L0/test_models.py): adopt for the headline if
+    # it measures faster — a layout choice, not a model change
+    if on_tpu and result["value"] > 0 and \
+            time.perf_counter() - START < BUDGET_S - 120:
+        try:
+            b_now = result.get("batch", batch)
+            # own trace dir: the recorded xprof artifact must profile
+            # whichever stem the headline ends up reporting
+            ips3, step_ms3, flops3 = measure("O2", b_now, image_size,
+                                             iters, stem="s2d",
+                                             trace_dir="xprof_trace_s2d")
+            result.setdefault("extras", {})["stem_s2d"] = {
+                "conv": result["value"], "s2d": round(ips3, 1)}
+            if ips3 > result["value"]:
+                record_o2(ips3, step_ms3, flops3, b_now)
+                result["stem"] = "s2d"
+                if os.path.isdir("xprof_trace_s2d"):
+                    result["xprof_trace"] = "xprof_trace_s2d"
+        except Exception as e:
+            _note("stem_s2d", e)
+
     try:
         if result["value"] > 0 and time.perf_counter() - START < BUDGET_S:
-            # same batch as the reported O2 number: the speed-of-light
-            # ratio is only meaningful like-for-like
+            # same batch AND stem as the reported O2 number: the
+            # speed-of-light ratio is only meaningful like-for-like
             ceiling_ips, _, _ = measure("O3", result.get("batch", batch),
-                                        image_size, iters)
+                                        image_size, iters,
+                                        stem=result.get("stem", "conv"))
             result["vs_baseline"] = round(result["value"] / ceiling_ips, 3)
         else:
             ERRORS.append("O3: skipped (budget exceeded or O2 failed); "

@@ -51,6 +51,23 @@ def _setup(seed=0, **adam_kwargs):
     return model, optimizer, train_step, params, opt_state, x, y
 
 
+def _make_step(model, opt):
+    """Jitted amp O2 train step over ``opt`` — shared by every test that
+    compares optimizer variants so they can never drift apart."""
+    def train_step(params, opt_state, x, y):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, x)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), y).mean()
+            with amp.scale_loss(loss, opt_state) as scaled:
+                return scaled, loss
+        grads, loss = jax.grad(loss_fn, has_aux=True)(params)
+        params, opt_state = opt.step(params, grads, opt_state)
+        return params, opt_state, loss
+    return jax.jit(train_step)
+
+
+
 def test_sharded_state_matches_replicated(mesh):
     _, _, train_step, params, opt_state, x, y = _setup()
 
@@ -90,27 +107,14 @@ def test_pallas_shard_map_matches_replicated(mesh):
     only placement differs."""
     model, optimizer, _, params, opt_state, x, y = _setup(use_pallas=True)
 
-    def make_step(opt):
-        def train_step(params, opt_state, x, y):
-            def loss_fn(p):
-                logits = model.apply({"params": p}, x)
-                loss = optax.softmax_cross_entropy_with_integer_labels(
-                    logits.astype(jnp.float32), y).mean()
-                with amp.scale_loss(loss, opt_state) as scaled:
-                    return scaled, loss
-            grads, loss = jax.grad(loss_fn, has_aux=True)(params)
-            params, opt_state = opt.step(params, grads, opt_state)
-            return params, opt_state, loss
-        return jax.jit(train_step)
-
     # replicated Pallas run
-    step_r = make_step(optimizer)
+    step_r = _make_step(model, optimizer)
     p_r, s_r = params, opt_state
     for _ in range(3):
         p_r, s_r, loss_r = step_r(p_r, s_r, x, y)
 
     # ZeRO Pallas run: state sharded, kernel shard_map'd over the axis
-    step_z = make_step(optimizer.with_zero(mesh))
+    step_z = _make_step(model, optimizer.with_zero(mesh))
     p_z = jax.device_put(params, NamedSharding(mesh, P()))
     s_z = parallel.shard_optimizer_state(opt_state, mesh)
     assert s_z.inner.m.sharding.spec[0] == "data"
@@ -146,25 +150,12 @@ def test_grouped_with_zero_matches_replicated(mesh):
     assert any(s % NDEV or s < NDEV * 128
                for _, s in opt_state.inner.spec.group_bounds if s)
 
-    def make_step(opt):
-        def train_step(params, opt_state, x, y):
-            def loss_fn(p):
-                logits = model.apply({"params": p}, x)
-                loss = optax.softmax_cross_entropy_with_integer_labels(
-                    logits.astype(jnp.float32), y).mean()
-                with amp.scale_loss(loss, opt_state) as scaled:
-                    return scaled, loss
-            grads, loss = jax.grad(loss_fn, has_aux=True)(params)
-            params, opt_state = opt.step(params, grads, opt_state)
-            return params, opt_state, loss
-        return jax.jit(train_step)
-
-    step_r = make_step(optimizer)
+    step_r = _make_step(model, optimizer)
     p_r, s_r = params, opt_state
     for _ in range(3):
         p_r, s_r, _ = step_r(p_r, s_r, x, y)
 
-    step_z = make_step(optimizer.with_zero(mesh))
+    step_z = _make_step(model, optimizer.with_zero(mesh))
     p_z = jax.device_put(params, NamedSharding(mesh, P()))
     s_z = parallel.shard_optimizer_state(opt_state, mesh)
     with mesh:
@@ -272,22 +263,8 @@ def test_zero_checkpoint_roundtrip(mesh, tmp_path):
     from apex_tpu.utils import checkpoint
 
     model, optimizer, _, params, opt_state, x, y = _setup(use_pallas=True)
-    optimizer_z = optimizer.with_zero(mesh)
 
-    def make_step(opt):
-        def train_step(params, opt_state, x, y):
-            def loss_fn(p):
-                logits = model.apply({"params": p}, x)
-                loss = optax.softmax_cross_entropy_with_integer_labels(
-                    logits.astype(jnp.float32), y).mean()
-                with amp.scale_loss(loss, opt_state) as scaled:
-                    return scaled, loss
-            grads, loss = jax.grad(loss_fn, has_aux=True)(params)
-            params, opt_state = opt.step(params, grads, opt_state)
-            return params, opt_state, loss
-        return jax.jit(train_step)
-
-    step = make_step(optimizer_z)
+    step = _make_step(model, optimizer.with_zero(mesh))
     p_z = jax.device_put(params, NamedSharding(mesh, P()))
     s_z = parallel.shard_optimizer_state(opt_state, mesh)
     with mesh:
