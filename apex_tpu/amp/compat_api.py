@@ -17,7 +17,6 @@ from __future__ import annotations
 import contextlib
 import warnings
 
-import jax.numpy as jnp
 
 from apex_tpu.amp._amp_state import _amp_state as _amp_state_singleton
 from apex_tpu.amp import handle as _handle

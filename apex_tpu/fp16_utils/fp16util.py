@@ -26,7 +26,7 @@ from apex_tpu.amp.model import (
     cast_tree,
     _path_matches,
 )
-from apex_tpu.ops.flatten import FlatSpec, flatten, flatten_like, unflatten
+from apex_tpu.ops.flatten import flatten, flatten_like, unflatten
 from apex_tpu.ops.multi_tensor import multi_tensor_l2norm
 
 Pytree = Any

@@ -40,7 +40,6 @@ Two usage styles:
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Optional, Union
 
 import jax

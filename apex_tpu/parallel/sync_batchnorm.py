@@ -41,7 +41,6 @@ from __future__ import annotations
 from typing import Any, Optional, Tuple
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 from jax import lax
 

@@ -7,7 +7,6 @@ axis is the TPU-native extension.  The invariant that matters: placement
 must change WHERE the update runs, never WHAT it computes.
 """
 
-import functools
 
 import jax
 import jax.numpy as jnp
