@@ -298,11 +298,16 @@ class PipelinedBert:
     (``init(rng, ids) -> variables``, ``apply(variables, ids, ...)``)
     so ``amp.initialize`` wraps it like any module.
 
-    Constraints: ``num_hidden_layers % pp == 0``; dropout must be off
-    (``deterministic=True`` path — per-stage rng plumbing through the
-    scan is not wired); MoE aux losses are silently dropped inside the
-    pipeline (flax ``sow`` into an immutable collection is a no-op) —
-    prefer EP without PP for MoE configs.
+    Dropout composes: pass ``deterministic=False`` and
+    ``rngs={"dropout": key}`` like any flax model.  Each (microbatch,
+    stage[, data-shard]) folds its coordinates into the key inside the
+    pipeline body, so every stage of every microbatch draws an
+    independent mask and the schedule stays a pure scan.
+
+    Constraints: ``num_hidden_layers % pp == 0``; MoE aux losses are
+    silently dropped inside the pipeline (flax ``sow`` into an
+    immutable collection is a no-op) — prefer EP without PP for MoE
+    configs.
     """
 
     def __init__(self, cfg: BertConfig, mesh, pp: int,
@@ -313,12 +318,6 @@ class PipelinedBert:
             raise ValueError(
                 f"num_hidden_layers={cfg.num_hidden_layers} must divide "
                 f"into pp={pp} equal stages")
-        if cfg.hidden_dropout_prob or cfg.attention_probs_dropout_prob:
-            raise ValueError(
-                "PipelinedBert requires dropout-free configs "
-                "(hidden_dropout_prob=0, attention_probs_dropout_prob=0): "
-                "per-stage dropout rngs are not plumbed through the "
-                "pipeline scan")
         self.cfg = cfg
         self.mesh = mesh
         self.pp = pp
@@ -353,24 +352,69 @@ class PipelinedBert:
                          0.0, -1e9).astype(jnp.float32)
 
     def apply(self, variables, input_ids, attention_mask=None,
-              token_type_ids=None, deterministic: bool = True):
+              token_type_ids=None, deterministic: bool = True,
+              rngs=None):
+        from jax import lax
         from jax.sharding import PartitionSpec as P
 
         from apex_tpu.parallel.pipeline import gpipe_spmd
 
+        cfg = self.cfg
+        needs_rng = not deterministic and (
+            cfg.hidden_dropout_prob > 0
+            or cfg.attention_probs_dropout_prob > 0)
+        base_key = None
+        embed_rngs = None
+        if needs_rng:
+            if not rngs or "dropout" not in rngs:
+                raise ValueError(
+                    "PipelinedBert.apply(deterministic=False) with "
+                    "dropout in the config needs rngs={'dropout': key}")
+            base_key = rngs["dropout"]
+            embed_rngs = {"dropout": jax.random.fold_in(base_key, 2 ** 20)}
+
         p = variables["params"]
         x = self.embed.apply({"params": p["embed"]}, input_ids,
-                             token_type_ids, deterministic)
+                             token_type_ids, deterministic,
+                             rngs=embed_rngs)
         bias = self._bias(input_ids, attention_mask)
 
         def stage_fn(sp, xb):
+            if needs_rng:
+                h, b, mb = xb
+                # independent mask per (microbatch, stage[, data shard]):
+                # mb rides the activation pytree (one id per microbatch,
+                # garbage during bubble ticks whose outputs are
+                # discarded), the stage/shard indices come from the mesh
+                key = jax.random.fold_in(base_key, mb[0])
+                key = jax.random.fold_in(
+                    key, lax.axis_index(self.pipe_axis))
+                if self.batch_axis:
+                    key = jax.random.fold_in(
+                        key, lax.axis_index(self.batch_axis))
+                out = self.stage.apply({"params": sp}, h, b, False,
+                                       rngs={"dropout": key})
+                return (out, b, mb)
             h, b = xb
-            return (self.stage.apply({"params": sp}, h, b, True), b)
+            return (self.stage.apply({"params": sp}, h, b,
+                                     deterministic), b)
 
         run = gpipe_spmd(stage_fn, self.pipe_axis, self.num_microbatches)
+
+        def run_with_mb(sp, xb):
+            if not needs_rng:  # no mb leaf: nothing extra in the carry
+                return run(sp, xb)
+            h, b = xb
+            # local microbatch id per row, assigned the way gpipe splits
+            # the (local) batch: contiguous groups of b_local/m rows
+            mb = jnp.arange(h.shape[0], dtype=jnp.int32) // \
+                max(1, h.shape[0] // self.num_microbatches)
+            out, b2, _ = run(sp, (h, b, mb))
+            return out, b2
+
         xspec = P(self.batch_axis) if self.batch_axis else P()
         f = jax.shard_map(
-            run, mesh=self.mesh,
+            run_with_mb, mesh=self.mesh,
             in_specs=(jax.tree_util.tree_map(lambda _: P(self.pipe_axis),
                                              p["stages"]),
                       (xspec, xspec)),

@@ -65,9 +65,7 @@ def parse_args():
     p.add_argument("--pp", type=int, default=0, metavar="S",
                    help="pipeline the encoder over S stages on a "
                    "(data, pipe) mesh (models.PipelinedBert / GPipe); "
-                   "S must divide the device count and the layer count. "
-                   "Forces dropout off (the example trains "
-                   "deterministically anyway)")
+                   "S must divide the device count and the layer count")
     p.add_argument("--pp-microbatches", type=int, default=4, metavar="M",
                    help="GPipe microbatches per step under --pp "
                    "(bubble fraction (S-1)/(M+S-1))")
@@ -162,10 +160,10 @@ def main():
             return f(q, k, v, bias)
 
     if pp:
-        # the example's train loop is deterministic (no dropout rngs);
-        # PipelinedBert requires the config to say so explicitly
-        cfg = dataclasses.replace(cfg, hidden_dropout_prob=0.0,
-                                  attention_probs_dropout_prob=0.0)
+        # NB: the example trains deterministically (it passes no dropout
+        # rngs), so the config's dropout probs are inert here; with
+        # rngs={'dropout': ...} PipelinedBert runs them per
+        # (microbatch, stage, data-shard)
         # the pipeline sees b/grad_accum examples per call, dp-sharded
         per_call = args.b // max(args.grad_accum, 1) // dp
         if per_call % args.pp_microbatches:

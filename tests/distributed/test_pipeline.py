@@ -329,10 +329,53 @@ def test_pipelined_bert_amp_train_step():
     assert leaf.sharding.spec[0] == "pipe"
 
 
-def test_pipelined_bert_rejects_dropout_config():
+def test_pipelined_bert_dropout():
+    """DEFAULT dropout config under PP: per-(microbatch, stage) keys
+    fold inside the pipeline body — training is stochastic per rng,
+    deterministic per fixed rng, and eval ignores dropout entirely."""
     from apex_tpu import models
 
     mesh = Mesh(np.asarray(jax.devices()[:4]), ("pipe",))
-    cfg = models.BertConfig(num_hidden_layers=4)  # default dropout 0.1
-    with pytest.raises(ValueError, match="dropout"):
-        models.PipelinedBert(cfg, mesh, pp=4, num_microbatches=2)
+    cfg = models.BertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=4,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=16)  # default dropout probs 0.1
+    pb = models.PipelinedBert(cfg, mesh, pp=4, num_microbatches=2)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (4, 16), 0, 64)
+    variables = pb.init(jax.random.PRNGKey(1), ids)
+
+    with mesh:
+        r1 = pb.apply(variables, ids, deterministic=False,
+                      rngs={"dropout": jax.random.PRNGKey(7)})[0]
+        r1b = pb.apply(variables, ids, deterministic=False,
+                       rngs={"dropout": jax.random.PRNGKey(7)})[0]
+        r2 = pb.apply(variables, ids, deterministic=False,
+                      rngs={"dropout": jax.random.PRNGKey(8)})[0]
+        ev1 = pb.apply(variables, ids, deterministic=True)[0]
+        ev2 = pb.apply(variables, ids, deterministic=True)[0]
+    np.testing.assert_array_equal(np.asarray(r1), np.asarray(r1b))
+    assert not np.array_equal(np.asarray(r1), np.asarray(r2))
+    np.testing.assert_array_equal(np.asarray(ev1), np.asarray(ev2))
+    assert not np.array_equal(np.asarray(r1), np.asarray(ev1))
+
+    # missing rng is an actionable error, not silent determinism
+    with mesh, pytest.raises(ValueError, match="dropout"):
+        pb.apply(variables, ids, deterministic=False)
+
+    # dp x pp: the batch_axis fold runs (keys also differ per data
+    # shard) and the same determinism contract holds on the 2-axis mesh
+    mesh2 = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4),
+                 ("data", "pipe"))
+    pb2 = models.PipelinedBert(cfg, mesh2, pp=4, num_microbatches=2,
+                               batch_axis="data")
+    with mesh2:
+        d1 = pb2.apply(variables, ids, deterministic=False,
+                       rngs={"dropout": jax.random.PRNGKey(7)})[0]
+        d1b = pb2.apply(variables, ids, deterministic=False,
+                        rngs={"dropout": jax.random.PRNGKey(7)})[0]
+        dev = pb2.apply(variables, ids, deterministic=True)[0]
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d1b))
+    assert not np.array_equal(np.asarray(d1), np.asarray(dev))
+    # eval equals the single-axis mesh's eval: placement-invariant
+    np.testing.assert_allclose(np.asarray(dev), np.asarray(ev1),
+                               rtol=1e-5, atol=1e-5)
