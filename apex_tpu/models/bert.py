@@ -304,10 +304,17 @@ class PipelinedBert:
     pipeline body, so every stage of every microbatch draws an
     independent mask and the schedule stays a pure scan.
 
-    Constraints: ``num_hidden_layers % pp == 0``; MoE aux losses are
-    silently dropped inside the pipeline (flax ``sow`` into an
-    immutable collection is a no-op) — prefer EP without PP for MoE
-    configs.
+    MoE configs compose too: each stage's Switch load-balance aux
+    losses (``sow``n into the ``"losses"`` collection by
+    ``models.MoEMlp``) accumulate in an extra per-row ``(batch,)``
+    leaf riding the activation pytree (every leaf must share the batch
+    dim — the rows of a microbatch all carry its running total), and
+    ``apply`` returns their mean as a third output —
+    ``(mlm_logits, nsp_logits, moe_aux)`` when ``cfg.moe_experts > 0``
+    (weight it into the loss like the monolithic model's
+    ``mutable=["losses"]`` flow).
+
+    Constraint: ``num_hidden_layers % pp == 0``.
     """
 
     def __init__(self, cfg: BertConfig, mesh, pp: int,
@@ -379,9 +386,29 @@ class PipelinedBert:
                              rngs=embed_rngs)
         bias = self._bias(input_ids, attention_mask)
 
+        has_moe = cfg.moe_experts > 0
+
+        def run_stage(sp, h, b, rngs_):
+            if has_moe:
+                # read the stage's sown MoE aux losses purely: mutable
+                # returns them instead of mutating hidden state
+                out, mut = self.stage.apply(
+                    {"params": sp}, h, b,
+                    deterministic if rngs_ is None else False,
+                    rngs=rngs_, mutable=["losses"])
+                aux = sum(jnp.sum(leaf) for leaf in
+                          jax.tree_util.tree_leaves(mut["losses"]))
+                return out, aux.astype(jnp.float32)
+            out = self.stage.apply(
+                {"params": sp}, h, b,
+                deterministic if rngs_ is None else False, rngs=rngs_)
+            return out, jnp.float32(0)
+
         def stage_fn(sp, xb):
+            h, b, mb, aux = (xb if needs_rng else
+                             (xb[0], xb[1], None, xb[2]))
+            stage_rngs = None
             if needs_rng:
-                h, b, mb = xb
                 # independent mask per (microbatch, stage[, data shard]):
                 # mb rides the activation pytree (one id per microbatch,
                 # garbage during bubble ticks whose outputs are
@@ -392,35 +419,53 @@ class PipelinedBert:
                 if self.batch_axis:
                     key = jax.random.fold_in(
                         key, lax.axis_index(self.batch_axis))
-                out = self.stage.apply({"params": sp}, h, b, False,
-                                       rngs={"dropout": key})
-                return (out, b, mb)
-            h, b = xb
-            return (self.stage.apply({"params": sp}, h, b,
-                                     deterministic), b)
+                stage_rngs = {"dropout": key}
+            out, stage_aux = run_stage(sp, h, b, stage_rngs)
+            # aux accumulates across stages in a per-row (b/m,) leaf of
+            # the activation pytree (gpipe requires the shared batch
+            # dim; zero for non-MoE, where XLA removes it)
+            aux = aux + stage_aux
+            if needs_rng:
+                return (out, b, mb, aux)
+            return (out, b, aux)
 
         run = gpipe_spmd(stage_fn, self.pipe_axis, self.num_microbatches)
 
-        def run_with_mb(sp, xb):
-            if not needs_rng:  # no mb leaf: nothing extra in the carry
-                return run(sp, xb)
+        def run_wrapped(sp, xb):
+            from apex_tpu.parallel.sequence import _vary_like
+
             h, b = xb
-            # local microbatch id per row, assigned the way gpipe splits
-            # the (local) batch: contiguous groups of b_local/m rows
-            mb = jnp.arange(h.shape[0], dtype=jnp.int32) // \
-                max(1, h.shape[0] // self.num_microbatches)
-            out, b2, _ = run(sp, (h, b, mb))
-            return out, b2
+            # the accumulated aux inherits h's varying axes (the stage
+            # adds h-derived values), so its zero init must carry the
+            # same vma type or the scan carry types mismatch
+            aux0 = _vary_like(jnp.zeros((h.shape[0],), jnp.float32), h)
+            if needs_rng:
+                # local microbatch id per row, assigned the way gpipe
+                # splits the (local) batch: contiguous b_local/m groups
+                mb = jnp.arange(h.shape[0], dtype=jnp.int32) // \
+                    max(1, h.shape[0] // self.num_microbatches)
+                out, b2, _, aux = run(sp, (h, b, mb, aux0))
+            else:
+                out, b2, aux = run(sp, (h, b, aux0))
+            return out, aux
 
         xspec = P(self.batch_axis) if self.batch_axis else P()
         f = jax.shard_map(
-            run_with_mb, mesh=self.mesh,
+            run_wrapped, mesh=self.mesh,
             in_specs=(jax.tree_util.tree_map(lambda _: P(self.pipe_axis),
                                              p["stages"]),
                       (xspec, xspec)),
             out_specs=(xspec, xspec))
-        seq, _ = f(p["stages"], (x, bias))
-        return self.heads.apply({"params": p["heads"]}, seq)
+        seq, aux = f(p["stages"], (x, bias))
+        mlm, nsp = self.heads.apply({"params": p["heads"]}, seq)
+        if has_moe:
+            # every row of a (shard, microbatch) group carries that
+            # group's stage-summed aux; the mean over rows is the mean
+            # over groups — matching the monolithic model's full-batch
+            # per-layer aux scale (each layer's aux is itself a mean
+            # over its tokens)
+            return mlm, nsp, jnp.mean(aux)
+        return mlm, nsp
 
 
 class BertForPreTraining(nn.Module):

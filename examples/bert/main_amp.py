@@ -111,10 +111,6 @@ def main():
         raise SystemExit("--pp and --ring-attention define conflicting "
                          "meshes; pick one (PP x SP is composable via "
                          "models.PipelinedBert + a custom attention_fn)")
-    if pp and args.moe:
-        raise SystemExit("--pp drops MoE aux losses inside the pipeline "
-                         "(see models.PipelinedBert); use EP without PP "
-                         "for MoE configs")
     if sp:
         if n_dev % sp or args.seq_len % sp:
             raise SystemExit(f"SP={sp} must divide devices ({n_dev}) and "
@@ -211,7 +207,12 @@ def main():
     def batch_loss(p, ids, labels, weights, nsp, mlm_denom, div):
         """Shared by the plain and grad-accum steps: MLM (weighted by
         mask positions over ``mlm_denom``) + NSP/div + MoE aux/div."""
-        if args.moe:
+        if args.moe and pp:
+            # PipelinedBert returns the pipeline-accumulated aux as a
+            # third output (sow can't escape the pipeline scan)
+            mlm_logits, nsp_logits, aux = model.apply(
+                {"params": p}, ids, deterministic=True)
+        elif args.moe:
             (mlm_logits, nsp_logits), mut = model.apply(
                 {"params": p}, ids, deterministic=True,
                 mutable=["losses"])
