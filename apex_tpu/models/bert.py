@@ -314,26 +314,52 @@ class PipelinedBert:
     (weight it into the loss like the monolithic model's
     ``mutable=["losses"]`` flow).
 
+    ``seq_axis``: shard the SEQUENCE dim over this mesh axis inside the
+    pipeline, paired with a sequence-parallel ``attention_fn`` built
+    for the same axis (``parallel.make_ring_attention(seq_axis)``); the
+    hidden states and attention bias enter the pipeline sequence-
+    sharded and every stage's ring collectives run inside the pipeline
+    body, composing dp x sp x pp on one mesh::
+
+        mesh = Mesh(devs.reshape(dp, sp, pp), ("data", "sp", "pipe"))
+        pb = PipelinedBert(cfg, mesh, pp=pp, num_microbatches=m,
+                           batch_axis="data", seq_axis="sp",
+                           attention_fn=parallel.make_ring_attention("sp"))
+
     Constraint: ``num_hidden_layers % pp == 0``.
     """
 
     def __init__(self, cfg: BertConfig, mesh, pp: int,
                  num_microbatches: int, pipe_axis: str = "pipe",
                  batch_axis: Optional[str] = None,
+                 seq_axis: Optional[str] = None,
                  attention_fn: Optional[Callable] = None):
         if cfg.num_hidden_layers % pp:
             raise ValueError(
                 f"num_hidden_layers={cfg.num_hidden_layers} must divide "
                 f"into pp={pp} equal stages")
+        if seq_axis is not None and attention_fn is None:
+            raise ValueError(
+                "seq_axis requires a sequence-parallel attention_fn for "
+                "the same axis (parallel.make_ring_attention(seq_axis)) "
+                "— plain attention would silently attend only within "
+                "each sequence shard")
         self.cfg = cfg
         self.mesh = mesh
         self.pp = pp
         self.num_microbatches = num_microbatches
         self.pipe_axis = pipe_axis
         self.batch_axis = batch_axis
+        self.seq_axis = seq_axis
         self.embed = BertEmbeddings(cfg)
         self.stage = BertStage(cfg, cfg.num_hidden_layers // pp,
                                attention_fn)
+        # init traces OUTSIDE shard_map where a sequence-parallel
+        # attention_fn's collectives have no bound axis; attention_fn
+        # creates no params, so a plain-attention twin yields the
+        # identical parameter tree
+        self._stage_init = BertStage(cfg, cfg.num_hidden_layers // pp,
+                                     None)
         self.heads = BertHeads(cfg)
 
     def init(self, rng, input_ids, attention_mask=None,
@@ -345,7 +371,7 @@ class PipelinedBert:
                               token_type_ids, True)
         bias0 = self._bias(input_ids, attention_mask)
         stage_p = jax.vmap(
-            lambda r: self.stage.init(r, x0, bias0, True)["params"])(
+            lambda r: self._stage_init.init(r, x0, bias0, True)["params"])(
             jax.random.split(r_stage, self.pp))
         heads_p = self.heads.init(r_heads, x0)["params"]
         return {"params": {"embed": embed_p, "stages": stage_p,
@@ -419,6 +445,9 @@ class PipelinedBert:
                 if self.batch_axis:
                     key = jax.random.fold_in(
                         key, lax.axis_index(self.batch_axis))
+                if self.seq_axis:
+                    key = jax.random.fold_in(
+                        key, lax.axis_index(self.seq_axis))
                 stage_rngs = {"dropout": key}
             out, stage_aux = run_stage(sp, h, b, stage_rngs)
             # aux accumulates across stages in a per-row (b/m,) leaf of
@@ -432,13 +461,13 @@ class PipelinedBert:
         run = gpipe_spmd(stage_fn, self.pipe_axis, self.num_microbatches)
 
         def run_wrapped(sp, xb):
-            from apex_tpu.parallel.sequence import _vary_like
+            from apex_tpu.parallel.collectives import vary_like
 
             h, b = xb
             # the accumulated aux inherits h's varying axes (the stage
             # adds h-derived values), so its zero init must carry the
             # same vma type or the scan carry types mismatch
-            aux0 = _vary_like(jnp.zeros((h.shape[0],), jnp.float32), h)
+            aux0 = vary_like(jnp.zeros((h.shape[0],), jnp.float32), h)
             if needs_rng:
                 # local microbatch id per row, assigned the way gpipe
                 # splits the (local) batch: contiguous b_local/m groups
@@ -447,15 +476,25 @@ class PipelinedBert:
                 out, b2, _, aux = run(sp, (h, b, mb, aux0))
             else:
                 out, b2, aux = run(sp, (h, b, aux0))
+            if self.seq_axis is not None:
+                # each sequence shard's MoE layers saw only its tokens;
+                # the per-layer aux is a token mean, so the full-batch
+                # value is the mean over sequence shards
+                aux = lax.pmean(aux, self.seq_axis)
             return out, aux
 
-        xspec = P(self.batch_axis) if self.batch_axis else P()
+        # h: (B, S, H) batch- and optionally sequence-sharded; the bias
+        # (B, 1, 1, S) shards its key dim with the sequence so each ring
+        # hop sees its KV shard's mask; the aux output is per-row (B,)
+        hspec = P(self.batch_axis, self.seq_axis)
+        bspec = P(self.batch_axis, None, None, self.seq_axis)
+        rowspec = P(self.batch_axis)
         f = jax.shard_map(
             run_wrapped, mesh=self.mesh,
             in_specs=(jax.tree_util.tree_map(lambda _: P(self.pipe_axis),
                                              p["stages"]),
-                      (xspec, xspec)),
-            out_specs=(xspec, xspec))
+                      (hspec, bspec)),
+            out_specs=(hspec, rowspec))
         seq, aux = f(p["stages"], (x, bias))
         mlm, nsp = self.heads.apply({"params": p["heads"]}, seq)
         if has_moe:

@@ -22,6 +22,23 @@ from jax import lax
 from apex_tpu.ops.pallas_utils import on_tpu
 
 
+def vary_like(x, *refs, extra_axes=()):
+    """Broadcast ``x``'s varying-axes type to the union of ``refs``' (plus
+    ``extra_axes``, e.g. a ring axis that ppermute will introduce) —
+    needed so lax.cond/scan branches built from constants type-check
+    under shard_map's vma tracking. No-op outside shard_map."""
+    import jax
+
+    try:
+        target = set(extra_axes)
+        for r in refs:
+            target |= set(jax.typeof(r).vma)
+        missing = tuple(sorted(target - set(jax.typeof(x).vma)))
+    except AttributeError:
+        return x
+    return lax.pcast(x, missing, to="varying") if missing else x
+
+
 def _group_maps(groups) -> Tuple[np.ndarray, np.ndarray]:
     """(rank->group id, group id -> member ranks) as static arrays."""
     n_ranks = sum(len(g) for g in groups)

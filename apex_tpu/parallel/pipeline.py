@@ -37,7 +37,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from apex_tpu.parallel.sequence import _vary_like
+from apex_tpu.parallel.collectives import vary_like as _vary_like
 
 Pytree = Any
 

@@ -44,19 +44,7 @@ NEG_INF = -1e30  # large-negative fp32 (not -inf: keeps exp/where NaN-free)
 _einsum = unpatched(jnp.einsum)
 
 
-def _vary_like(x, *refs, extra_axes=()):
-    """Broadcast ``x``'s varying-axes type to the union of ``refs``' (plus
-    ``extra_axes``, e.g. the ring axis ppermute will introduce) — needed
-    so lax.cond/scan branches built from constants type-check under
-    shard_map's vma tracking. No-op outside shard_map."""
-    try:
-        target = set(extra_axes)
-        for r in refs:
-            target |= set(jax.typeof(r).vma)
-        missing = tuple(sorted(target - set(jax.typeof(x).vma)))
-    except AttributeError:
-        return x
-    return lax.pcast(x, missing, to="varying") if missing else x
+from apex_tpu.parallel.collectives import vary_like as _vary_like  # noqa: E402 (shared vma helper)
 
 
 def _online_block_update(m, den, acc, scores, v, keep=None,

@@ -108,10 +108,16 @@ def main():
     sp = args.ring_attention
     pp = args.pp
     if pp and sp:
-        raise SystemExit("--pp and --ring-attention define conflicting "
-                         "meshes; pick one (PP x SP is composable via "
-                         "models.PipelinedBert + a custom attention_fn)")
-    if sp:
+        if n_dev % (sp * pp) or args.seq_len % sp or \
+                cfg.num_hidden_layers % pp:
+            raise SystemExit(
+                f"SP={sp} x PP={pp} must divide devices ({n_dev}), SP "
+                f"the seq len ({args.seq_len}), PP the layers "
+                f"({cfg.num_hidden_layers})")
+        dp = n_dev // (sp * pp)
+        mesh = Mesh(np.array(devices).reshape(dp, sp, pp),
+                    ("data", "sp", "pipe"))
+    elif sp:
         if n_dev % sp or args.seq_len % sp:
             raise SystemExit(f"SP={sp} must divide devices ({n_dev}) and "
                              f"seq len ({args.seq_len})")
@@ -132,7 +138,12 @@ def main():
                 f"config: {args.config}", rank0=True)
 
     attention_fn = None
-    if sp:
+    if sp and pp:
+        # inside PipelinedBert's shard_map the sp axis is already
+        # manual: the ring adapter runs directly, no inner shard_map
+        from apex_tpu.parallel import make_ring_attention
+        attention_fn = make_ring_attention("sp")
+    elif sp:
         from apex_tpu.parallel import make_ring_attention
 
         shard_map = jax.shard_map
@@ -168,7 +179,8 @@ def main():
                 f"divide into --pp-microbatches {args.pp_microbatches}")
         model_def = models.PipelinedBert(
             cfg, mesh, pp=pp, num_microbatches=args.pp_microbatches,
-            batch_axis="data")
+            batch_axis="data", seq_axis="sp" if sp else None,
+            attention_fn=attention_fn)
     else:
         model_def = models.BertForPreTraining(cfg,
                                               attention_fn=attention_fn)

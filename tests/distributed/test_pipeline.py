@@ -131,6 +131,19 @@ def test_stage_count_mismatch_raises(mesh):
                                 num_microbatches=4)
 
 
+def _monolithic_params(variables, pp, layers_per_stage):
+    """Rebuild the monolithic BertForPreTraining param tree from
+    PipelinedBert's stacked-stage variables (same weights) — the oracle
+    used by every pipelined-vs-sequential comparison."""
+    sp = variables["params"]
+    enc = dict(sp["embed"])
+    for st in range(pp):
+        for li in range(layers_per_stage):
+            enc[f"layer_{st * layers_per_stage + li}"] = jax.tree.map(
+                lambda a: a[st], sp["stages"][f"layer_{li}"])
+    return {"encoder": enc, **sp["heads"]}
+
+
 def test_pipelined_bert_matches_sequential():
     """PipelinedBert on a (data, pipe) mesh computes exactly what the
     monolithic BertForPreTraining computes with the same weights —
@@ -165,14 +178,8 @@ def test_pipelined_bert_matches_sequential():
 
     # sequential oracle with the SAME weights: stage layers unstacked
     # into encoder/layer_i, embed/head names match by construction
-    sp = variables["params"]
-    enc = dict(sp["embed"])
-    lps = cfg.num_hidden_layers // 4
-    for st in range(4):
-        for li in range(lps):
-            enc[f"layer_{st * lps + li}"] = jax.tree.map(
-                lambda a: a[st], sp["stages"][f"layer_{li}"])
-    seq_params = {"encoder": enc, **sp["heads"]}
+    seq_params = _monolithic_params(
+        variables, 4, cfg.num_hidden_layers // 4)
     mlm_ref, nsp_ref = jax.jit(
         lambda p, i, m: models.BertForPreTraining(cfg).apply(
             {"params": p}, i, m, deterministic=True))(seq_params, ids, mask)
@@ -211,11 +218,7 @@ def test_pipelined_bert_gradients_match_sequential():
 
     # sequential oracle, same weights
     sp = variables["params"]
-    enc = dict(sp["embed"])
-    for st in range(4):
-        enc[f"layer_{st}"] = jax.tree.map(lambda a: a[st],
-                                          sp["stages"]["layer_0"])
-    seq_params = {"encoder": enc, **sp["heads"]}
+    seq_params = _monolithic_params(variables, 4, 1)
     seq_model = models.BertForPreTraining(cfg)
 
     def seq_loss(p):
@@ -404,12 +407,7 @@ def test_pipelined_bert_moe_aux_matches_monolithic():
     assert np.isfinite(float(aux)) and float(aux) > 0
 
     # monolithic oracle with the SAME weights
-    sp = variables["params"]
-    enc = dict(sp["embed"])
-    for st in range(4):
-        enc[f"layer_{st}"] = jax.tree.map(lambda a: a[st],
-                                          sp["stages"]["layer_0"])
-    seq_params = {"encoder": enc, **sp["heads"]}
+    seq_params = _monolithic_params(variables, 4, 1)
     (mlm_ref, _), mut = models.BertForPreTraining(cfg).apply(
         {"params": seq_params}, ids, deterministic=True,
         mutable=["losses"])
@@ -455,3 +453,50 @@ def test_pipelined_bert_moe_aux_matches_monolithic():
             params, opt_state, loss = step(params, opt_state)
             losses.append(float(loss))
     assert all(np.isfinite(losses))
+
+
+def test_pipelined_bert_dp_sp_pp():
+    """The full dp x sp x pp composition on one (2, 2, 2) mesh: ring
+    attention's collectives run INSIDE the pipeline body over the sp
+    axis, and the result matches the monolithic full-attention model
+    with the same weights."""
+    from apex_tpu import models, parallel
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 2, 2),
+                ("data", "sp", "pipe"))
+    cfg = models.BertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=16, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+    ring = parallel.make_ring_attention("sp")
+    pb = models.PipelinedBert(cfg, mesh, pp=2, num_microbatches=2,
+                              batch_axis="data", seq_axis="sp",
+                              attention_fn=ring)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (4, 16), 0, 64)
+    mask = jnp.asarray(np.pad(np.ones((4, 12)), ((0, 0), (0, 4))),
+                       jnp.int32)
+    variables = pb.init(jax.random.PRNGKey(1), ids, mask)
+    with mesh:
+        mlm, nsp = jax.jit(lambda v, i, m: pb.apply(v, i, m))(
+            variables, ids, mask)
+
+    # monolithic full-attention oracle, same weights
+    seq_params = _monolithic_params(variables, 2, 1)
+    mlm_ref, nsp_ref = models.BertForPreTraining(cfg).apply(
+        {"params": seq_params}, ids, mask, deterministic=True)
+    np.testing.assert_allclose(np.asarray(mlm), np.asarray(mlm_ref),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(nsp), np.asarray(nsp_ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pipelined_bert_seq_axis_requires_attention_fn():
+    from apex_tpu import models
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 2, 2),
+                ("data", "sp", "pipe"))
+    cfg = models.BertConfig(num_hidden_layers=2)
+    with pytest.raises(ValueError, match="seq_axis"):
+        models.PipelinedBert(cfg, mesh, pp=2, num_microbatches=2,
+                             seq_axis="sp")
