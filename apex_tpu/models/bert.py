@@ -326,6 +326,18 @@ class PipelinedBert:
                            batch_axis="data", seq_axis="sp",
                            attention_fn=parallel.make_ring_attention("sp"))
 
+    ``tp_axis``: layer Megatron tensor parallelism on top — stage
+    weights take ``P(pipe, ...model...)`` placement
+    (:meth:`shard_variables`) and the TP axis stays GSPMD-automatic
+    inside the pipeline's ``shard_map`` (partial-manual mode), so XLA
+    inserts the TP collectives while pipe/data run the explicit
+    schedule.  KNOWN LIMITATION: half-precision compute (amp O2/O3)
+    inside the partial-manual region trips an XLA crash in this jax
+    build's CPU backend ("Invalid binary instruction opcode copy",
+    ``hlo_instruction.cc``) — ``tp_axis`` is tested fp32; re-check on
+    hardware where the TPU backend compiles the same program
+    independently.
+
     Constraint: ``num_hidden_layers % pp == 0``.
     """
 
@@ -333,6 +345,7 @@ class PipelinedBert:
                  num_microbatches: int, pipe_axis: str = "pipe",
                  batch_axis: Optional[str] = None,
                  seq_axis: Optional[str] = None,
+                 tp_axis: Optional[str] = None,
                  attention_fn: Optional[Callable] = None):
         if cfg.num_hidden_layers % pp:
             raise ValueError(
@@ -351,6 +364,7 @@ class PipelinedBert:
         self.pipe_axis = pipe_axis
         self.batch_axis = batch_axis
         self.seq_axis = seq_axis
+        self.tp_axis = tp_axis
         self.embed = BertEmbeddings(cfg)
         self.stage = BertStage(cfg, cfg.num_hidden_layers // pp,
                                attention_fn)
@@ -376,6 +390,49 @@ class PipelinedBert:
         heads_p = self.heads.init(r_heads, x0)["params"]
         return {"params": {"embed": embed_p, "stages": stage_p,
                            "heads": heads_p}}
+
+    def shard_variables(self, variables):
+        """Place the variables for this model's mesh: stage stacks on the
+        pipe axis, and — with ``tp_axis`` — Megatron-style tensor-parallel
+        placement (``parallel.bert_tp_rules``) layered on top: stage
+        leaves get ``P(pipe, *tp_spec)``, embeddings/heads their unstacked
+        TP specs.  The TP axis stays GSPMD-automatic inside the pipeline's
+        ``shard_map`` (partial-manual mode), so XLA inserts the Megatron
+        collectives around the model-sharded matmuls while the pipe/data
+        axes run the explicit schedule."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from apex_tpu.parallel.tensor_parallel import (bert_tp_rules,
+                                                       param_specs,
+                                                       shard_params)
+
+        def place(tree, specs):
+            return jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(
+                    x, NamedSharding(self.mesh, s)), tree, specs)
+
+        p = dict(variables["params"])
+        if self.tp_axis is not None:
+            rules = bert_tp_rules(self.tp_axis)
+            stacked = tuple((pat, P(self.pipe_axis, *spec))
+                            for pat, spec in rules)
+            outer = shard_params({"embed": p["embed"],
+                                  "heads": p["heads"]}, self.mesh, rules)
+            stage_specs = param_specs(p["stages"], self.mesh, stacked)
+            # leaves no stacked rule matched still live on the pipe axis
+            stage_specs = jax.tree_util.tree_map(
+                lambda s: s if len(s) and s[0] == self.pipe_axis
+                else P(self.pipe_axis), stage_specs)
+            p.update(embed=outer["embed"], heads=outer["heads"],
+                     stages=place(p["stages"], stage_specs))
+        else:
+            repl = NamedSharding(self.mesh, P())
+            p["embed"] = jax.device_put(p["embed"], repl)
+            p["heads"] = jax.device_put(p["heads"], repl)
+            p["stages"] = place(
+                p["stages"], jax.tree_util.tree_map(
+                    lambda _: P(self.pipe_axis), p["stages"]))
+        return {"params": p}
 
     def _bias(self, input_ids, attention_mask):
         b, s = input_ids.shape
@@ -477,9 +534,12 @@ class PipelinedBert:
             else:
                 out, b2, aux = run(sp, (h, b, aux0))
             if self.seq_axis is not None:
-                # each sequence shard's MoE layers saw only its tokens;
-                # the per-layer aux is a token mean, so the full-batch
-                # value is the mean over sequence shards
+                # each sequence shard routes only its own tokens, so its
+                # aux is a LOCAL estimate; the mean over shards is the
+                # standard per-device aux of sharded MoE training — a
+                # valid load-balance regularizer, but NOT bitwise the
+                # full-sequence statistic (the Switch aux is a product
+                # of token means, which doesn't commute with sharding)
                 aux = lax.pmean(aux, self.seq_axis)
             return out, aux
 
@@ -489,12 +549,24 @@ class PipelinedBert:
         hspec = P(self.batch_axis, self.seq_axis)
         bspec = P(self.batch_axis, None, None, self.seq_axis)
         rowspec = P(self.batch_axis)
+        kwargs = {}
+        if self.tp_axis is not None:
+            # partial-manual shard_map: the TP axis stays automatic, so
+            # GSPMD inserts the Megatron collectives for the
+            # model-sharded matmuls inside the manual pipe schedule
+            # (vma checking doesn't support partial-auto outputs yet)
+            manual = {self.pipe_axis}
+            if self.batch_axis:
+                manual.add(self.batch_axis)
+            if self.seq_axis:
+                manual.add(self.seq_axis)
+            kwargs = dict(axis_names=manual, check_vma=False)
         f = jax.shard_map(
             run_wrapped, mesh=self.mesh,
             in_specs=(jax.tree_util.tree_map(lambda _: P(self.pipe_axis),
                                              p["stages"]),
                       (hspec, bspec)),
-            out_specs=(hspec, rowspec))
+            out_specs=(hspec, rowspec), **kwargs)
         seq, aux = f(p["stages"], (x, bias))
         mlm, nsp = self.heads.apply({"params": p["heads"]}, seq)
         if has_moe:

@@ -209,11 +209,10 @@ def main():
 
     repl = NamedSharding(mesh, P())
     shard = NamedSharding(mesh, P("data"))
-    params = jax.device_put(params, repl)
-    if pp:  # stage stacks live one-per-device on the pipe axis
-        params["stages"] = jax.tree_util.tree_map(
-            lambda a: jax.device_put(a, NamedSharding(mesh, P("pipe"))),
-            params["stages"])
+    if pp:  # the model owns its placement (stages on the pipe axis)
+        params = model_def.shard_variables({"params": params})["params"]
+    else:
+        params = jax.device_put(params, repl)
     opt_state = jax.device_put(opt_state, repl)
 
     def batch_loss(p, ids, labels, weights, nsp, mlm_denom, div):

@@ -500,3 +500,95 @@ def test_pipelined_bert_seq_axis_requires_attention_fn():
     with pytest.raises(ValueError, match="seq_axis"):
         models.PipelinedBert(cfg, mesh, pp=2, num_microbatches=2,
                              seq_axis="sp")
+
+
+def test_pipelined_bert_dp_tp_pp():
+    """dp x tp x pp: Megatron tensor parallelism runs INSIDE the
+    pipeline via partial-manual shard_map (the model axis stays
+    GSPMD-automatic, pipe/data explicit); stage weights carry
+    P(pipe, ...model...) placement and the result matches the
+    monolithic model exactly."""
+    from apex_tpu import models
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 2, 2),
+                ("data", "model", "pipe"))
+    cfg = models.BertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=16, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+    pb = models.PipelinedBert(cfg, mesh, pp=2, num_microbatches=2,
+                              batch_axis="data", tp_axis="model")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (4, 16), 0, 64)
+    raw = pb.init(jax.random.PRNGKey(1), ids)
+    variables = pb.shard_variables(raw)
+
+    # Megatron placement landed on the stacked stage weights
+    qk = variables["params"]["stages"]["layer_0"]["attention"]["query"][
+        "kernel"]
+    assert qk.sharding.spec == P("pipe", None, "model", None)
+    inter = variables["params"]["stages"]["layer_0"]["intermediate"][
+        "kernel"]
+    assert inter.sharding.spec == P("pipe", None, "model")
+    # embeddings/heads take their unstacked TP specs
+    emb = variables["params"]["embed"]["word_embeddings"]["embedding"]
+    assert emb.sharding.spec == P("model", None)
+
+    with mesh:
+        mlm, nsp = jax.jit(lambda v, i: pb.apply(v, i))(variables, ids)
+
+    seq_params = _monolithic_params(raw, 2, 1)
+    mlm_ref, nsp_ref = models.BertForPreTraining(cfg).apply(
+        {"params": seq_params}, ids, deterministic=True)
+    np.testing.assert_allclose(np.asarray(mlm), np.asarray(mlm_ref),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(nsp), np.asarray(nsp_ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pipelined_bert_dp_tp_pp_trains():
+    """A FusedLAMB training step over the dp x tp x pp placement (fp32:
+    bf16 compute inside the partial-manual shard_map trips an XLA
+    CPU-backend crash in this jax build, see PipelinedBert docstring):
+    loss descends and both the pipe and model shardings survive."""
+    import functools
+
+    from apex_tpu import models, optimizers
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 2, 2),
+                ("data", "model", "pipe"))
+    cfg = models.BertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=16, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+    pb = models.PipelinedBert(cfg, mesh, pp=2, num_microbatches=2,
+                              batch_axis="data", tp_axis="model")
+    optimizer = optimizers.FusedLAMB(lr=1e-3)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (8, 16), 0, 64)
+    labels = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
+    variables = pb.shard_variables(pb.init(jax.random.PRNGKey(2), ids))
+    params = variables["params"]
+    opt_state = optimizer.init(params)
+    ids_s = jax.device_put(ids, NamedSharding(mesh, P("data")))
+    lab_s = jax.device_put(labels, NamedSharding(mesh, P("data")))
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, ids, labels):
+        def loss_fn(p):
+            mlm, _ = pb.apply({"params": p}, ids)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                mlm.astype(jnp.float32), labels).mean()
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params, opt_state = optimizer.step(params, grads, opt_state)
+        return params, opt_state, loss
+
+    losses = []
+    with mesh:
+        for _ in range(5):
+            params, opt_state, loss = step(params, opt_state, ids_s,
+                                           lab_s)
+            losses.append(float(loss))
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
+    qk = params["stages"]["layer_0"]["attention"]["query"]["kernel"]
+    assert "pipe" in qk.sharding.spec and "model" in str(qk.sharding.spec)
