@@ -1,0 +1,111 @@
+"""Opportunistic follow-up for measurements a wedged bench run missed.
+
+The 2026-07-31 live window captured the O2 headline (2435 img/s, MFU
+29.7%, batch 256, s2d stem — BENCH_NOTES.md) but the tunnel died during
+the O3 ceiling compile, so ``vs_baseline`` and the kernel extras are
+still unmeasured. This script runs ONLY the missing sections, each
+individually fenced, and appends every completed section as its own
+JSON line to ``BENCH_FOLLOWUP.jsonl`` IMMEDIATELY — a mid-run wedge
+loses only the section in flight, never completed ones.
+
+Usage: python tools/bench_followup.py [--sections o3,flash,adam,moe]
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "BENCH_FOLLOWUP.jsonl")
+WATCHDOG_S = 1500
+
+
+def log(section, payload):
+    line = {"section": section, "t": round(time.perf_counter(), 1),
+            **payload}
+    with open(OUT, "a") as f:
+        f.write(json.dumps(line) + "\n")
+    print(json.dumps(line), flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sections", default="o3,flash,adam,moe",
+                    help="comma list: o3,flash,adam,moe")
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--stem", default="s2d")
+    ap.add_argument("--o2", action="store_true",
+                    help="also re-measure O2 at --batch/--stem (for a "
+                         "fresh like-for-like ratio in one window)")
+    args = ap.parse_args()
+    sections = set(args.sections.split(","))
+
+    import bench  # reuse the fenced helpers; bench owns the probe logic
+
+    ok, err = bench._probe_tpu_subprocess()
+    if not ok:
+        log("probe", {"ok": False, "error": err})
+        return
+    log("probe", {"ok": True})
+
+    o2_ips = None
+    if args.o2:
+        try:
+            ips, step_ms, flops = bench.measure(
+                "O2", args.batch, 224, 20, stem=args.stem)
+            o2_ips = ips
+            log("o2", {"images_per_sec": round(ips, 1),
+                       "step_time_ms": round(step_ms, 2),
+                       "batch": args.batch, "stem": args.stem,
+                       "flops_per_step": flops})
+        except Exception as e:
+            log("o2", {"error": f"{type(e).__name__}: {e}"})
+
+    if "o3" in sections:
+        try:
+            ips, step_ms, flops = bench.measure(
+                "O3", args.batch, 224, 20, stem=args.stem)
+            payload = {"images_per_sec": round(ips, 1),
+                       "step_time_ms": round(step_ms, 2),
+                       "batch": args.batch, "stem": args.stem}
+            if o2_ips:
+                payload["vs_baseline_o2_over_o3"] = round(o2_ips / ips, 3)
+            log("o3_ceiling", payload)
+        except Exception as e:
+            log("o3_ceiling", {"error": f"{type(e).__name__}: {e}"})
+
+    if "flash" in sections:
+        try:
+            log("flash_attention", bench.bench_flash_attention())
+        except Exception as e:
+            log("flash_attention", {"error": f"{type(e).__name__}: {e}"})
+
+    if "adam" in sections:
+        try:
+            log("fused_adam", bench.bench_fused_adam())
+        except Exception as e:
+            log("fused_adam", {"error": f"{type(e).__name__}: {e}"})
+
+    if "moe" in sections:
+        try:
+            log("moe_dispatch", bench.bench_moe())
+        except Exception as e:
+            log("moe_dispatch", {"error": f"{type(e).__name__}: {e}"})
+
+
+if __name__ == "__main__":
+    def fire():
+        time.sleep(WATCHDOG_S)
+        log("watchdog", {"error": f"wedged past {WATCHDOG_S}s"})
+        os._exit(0)
+
+    threading.Thread(target=fire, daemon=True).start()
+    try:
+        main()
+    except BaseException as e:
+        log("fatal", {"error": f"{type(e).__name__}: {e}"})
