@@ -214,3 +214,71 @@ def test_fp16_optimizer_protocol():
     np.testing.assert_array_equal(np.asarray(frozen["w"], np.float32),
                                   np.asarray(new_half["w"], np.float32))
     assert float(fp16_opt.loss_scale(state)) == scale0 / 2
+
+
+@pytest.mark.parametrize("use_pallas", [False, True])
+def test_in_kernel_skip_step(use_pallas):
+    """skip=True must be a full no-op — params, m, v AND the
+    bias-correction step clock unchanged (the reference's patched step
+    is a one-shot no-op on overflow, amp/handle.py:130-150) — with the
+    select fused inside the kernel, even when the grads carry inf."""
+    params = params_tree()
+    grads = {k: jnp.ones_like(v) for k, v in params.items()}
+    bad = {k: jnp.full_like(v, jnp.inf) for k, v in params.items()}
+    opt = FusedAdam(lr=1e-2, weight_decay=0.01, use_pallas=use_pallas)
+    state = opt.init(params)
+
+    p_skip, s_skip = opt.step(params, bad, state,
+                              skip=jnp.asarray(True))
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(p_skip[k]),
+                                      np.asarray(params[k]))
+    np.testing.assert_array_equal(np.asarray(s_skip.m), np.asarray(state.m))
+    np.testing.assert_array_equal(np.asarray(s_skip.v), np.asarray(state.v))
+    assert int(s_skip.step) == int(state.step)
+
+    # skip=False must match the no-skip-arg step exactly
+    p_a, s_a = opt.step(params, grads, state, skip=jnp.asarray(False))
+    p_b, s_b = opt.step(params, grads, state)
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(p_a[k]), np.asarray(p_b[k]))
+    np.testing.assert_array_equal(np.asarray(s_a.m), np.asarray(s_b.m))
+    assert int(s_a.step) == int(s_b.step) == 1
+
+    # a skipped first step then a real one == just the real one (the
+    # clock advanced once; numerics identical)
+    p_c, s_c = opt.step(p_skip, grads, s_skip, skip=jnp.asarray(False))
+    for k in params:
+        np.testing.assert_allclose(np.asarray(p_c[k]), np.asarray(p_b[k]),
+                                   rtol=1e-6, atol=1e-7)
+    assert int(s_c.step) == 1
+
+
+def test_amp_optimizer_fused_skip_path():
+    """AmpOptimizer.apply_gradients routes FusedAdam through the
+    in-kernel skip (supports_fused_skip) — same trajectory as the
+    generic tree-select path, and overflow still skips + halves the
+    scale."""
+    from apex_tpu.amp.optimizer import AmpOptimizer
+    from apex_tpu.amp.scaler import LossScaler
+
+    params = params_tree()
+    inner = FusedAdam(lr=1e-2, use_pallas=False)
+    amp_opt = AmpOptimizer(inner, LossScaler(init_scale=2.0 ** 8))
+    state = amp_opt.init(params)
+    assert inner.supports_fused_skip
+
+    scale0 = float(amp_opt.loss_scale(state))
+    good = {k: jnp.ones_like(v) * scale0 for k, v in params.items()}
+    p1, s1 = amp_opt.step(params, good, state)
+    assert int(s1.applied_steps) == 1 and int(s1.skipped_steps) == 0
+    assert not np.allclose(np.asarray(p1["w"]), np.asarray(params["w"]))
+
+    bad = {k: jnp.full_like(v, jnp.inf) for k, v in params.items()}
+    p2, s2 = amp_opt.step(p1, bad, s1)
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(p2[k]), np.asarray(p1[k]))
+    assert int(s2.skipped_steps) == 1
+    assert float(amp_opt.loss_scale(s2)) == scale0 / 2
+    np.testing.assert_array_equal(np.asarray(s2.inner.m),
+                                  np.asarray(s1.inner.m))

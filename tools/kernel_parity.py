@@ -150,6 +150,19 @@ def check_fused_adam(dtype):
     # fused adam is pure elementwise VPU math: hold it to fp32 parity
     record("fused_adam", dtype, rel <= 1e-5, rel, mx, tol=1e-5)
 
+    # in-kernel skip-step (scalar-bool select through Mosaic's compiled
+    # lowering — interpret mode can't validate it): skip=True must leave
+    # params/m/v bit-identical even against inf grads
+    opt = FusedAdam(lr=1e-2, weight_decay=0.01, use_pallas=True)
+    state = opt.init(params)
+    bad = jax.tree_util.tree_map(lambda g: jnp.full_like(g, jnp.inf), grads)
+    p2, s2 = jax.jit(opt.step)(params, bad, state, skip=jnp.asarray(True))
+    rel_p, max_p = _tree_errs(p2, params)
+    rel_m, max_m = _errs(s2.m, state.m)
+    ok = max_p == 0.0 and max_m == 0.0 and int(s2.step) == 0
+    record("fused_adam_skip", dtype, ok, max(rel_p, rel_m),
+           max(max_p, max_m), tol=0.0)
+
 
 def main():
     dev = jax.devices()[0]

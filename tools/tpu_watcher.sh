@@ -1,0 +1,18 @@
+#!/bin/bash
+# Probes the axon TPU tunnel every ~9 min; at the first live window runs
+# the pending hardware queue (bench_followup incl. fresh O2 for a
+# like-for-like ratio, then kernel_parity), serialized, then exits.
+# Log: /tmp/tpu_watcher.log
+cd "$(dirname "$0")/.."
+while true; do
+  if timeout 90 python -c "import jax; assert jax.devices()[0].platform=='tpu'" 2>/dev/null; then
+    echo "$(date +%H:%M:%S) TUNNEL UP - running followup" >> /tmp/tpu_watcher.log
+    python tools/bench_followup.py --o2 >> /tmp/tpu_watcher.log 2>&1
+    echo "$(date +%H:%M:%S) followup done - kernel parity" >> /tmp/tpu_watcher.log
+    timeout 1500 python tools/kernel_parity.py > KERNEL_PARITY_r03.json 2>>/tmp/tpu_watcher.log
+    echo "$(date +%H:%M:%S) all done" >> /tmp/tpu_watcher.log
+    exit 0
+  fi
+  echo "$(date +%H:%M:%S) tunnel down" >> /tmp/tpu_watcher.log
+  sleep 540
+done
