@@ -287,6 +287,19 @@ def _image_folder_iter(samples, batch_size, image_size, train, shuffle,
             return
 
 
+def s2d_batches(iterator):
+    """Wrap any (x, y) batch iterator, applying the ResNet
+    ``stem="s2d_pre"`` input layout (``models.resnet.s2d_input_transform``)
+    to x on HOST — numpy reshape/transpose during batch assembly, like
+    the MLPerf TPU ResNet input pipelines. Inside the step the same
+    transform costs real per-iteration HBM round-trips (~0.5 ms at
+    b256/224px on v5e, BENCH_NOTES.md); here it rides the idle host."""
+    from apex_tpu.models.resnet import s2d_input_transform
+
+    for x, y in iterator:
+        yield s2d_input_transform(np.asarray(x)), y
+
+
 def put_global(x, sharding=None):
     """Stage one host array onto devices under ``sharding``.
 

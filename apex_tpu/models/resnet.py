@@ -99,11 +99,33 @@ class Bottleneck(nn.Module):
 def space_to_depth(x, block: int = 2):
     """NHWC (B, H, W, C) -> (B, H/b, W/b, b*b*C); channel order
     (dh, dw, c) — the layout :func:`stem_to_s2d` rearranges the stem
-    kernel into."""
+    kernel into. Method-call ops only, so it runs on numpy arrays
+    (host-side input pipeline) and jax arrays alike."""
     b_, h, w, c = x.shape
     x = x.reshape(b_, h // block, block, w // block, block, c)
-    return jnp.transpose(x, (0, 1, 3, 2, 4, 5)).reshape(
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(
         b_, h // block, w // block, block * block * c)
+
+
+def s2d_input_transform(x):
+    """Host/outside-jit half of the ``stem="s2d_pre"`` split: NHWC
+    (B, H, W, C) image batch -> (B, (H+6)/2, (W+6)/2, 4C) space-to-depth
+    layout the pre-transformed stem consumes.
+
+    ``stem="s2d"`` runs this pad+reshape+transpose INSIDE the train step,
+    where it costs real HBM round-trips every iteration (~0.5 ms at
+    b256/224px on v5e, xprof-measured: the 163 MB reshape + transpose
+    copy show up as data-formatting ops, BENCH_NOTES.md). The transform
+    is a pure layout change of the input, so it belongs with the input
+    pipeline (``data.loaders`` applies it on host when asked, like the
+    MLPerf TPU ResNet input pipelines do); inside the step the stem
+    reduces to one dense VALID conv.
+
+    Works on numpy or jnp arrays (pure reshape/transpose ops).
+    """
+    import numpy as np
+    pad = np.pad if isinstance(x, np.ndarray) else jnp.pad
+    return space_to_depth(pad(x, ((0, 0), (4, 2), (4, 2), (0, 0))), 2)
 
 
 def stem_to_s2d(kernel):
@@ -131,9 +153,13 @@ class ResNet(nn.Module):
     ``"s2d"`` computes the SAME function via a space-to-depth transform
     + 4x4/stride-1 conv — the MLPerf ResNet TPU optimization: a
     (4, 4, 12, W) kernel tiles the MXU far better than (7, 7, 3, W)
-    with its 3-deep contraction. Exact equivalence (same math, weights
-    related by :func:`stem_to_s2d`) is pinned in
-    ``tests/L0/test_models.py``.
+    with its 3-deep contraction. ``"s2d_pre"`` is the same stem with
+    the transform hoisted OUT of the step: the model consumes input
+    already in :func:`s2d_input_transform` layout (the input pipeline's
+    job — ``data.loaders`` does it host-side), so per-step HBM traffic
+    for the pad/reshape/transpose disappears. Exact equivalence (same
+    math, same ``stem_conv_s2d`` weights, related to the 7x7 kernel by
+    :func:`stem_to_s2d`) is pinned in ``tests/L0/test_models.py``.
     """
 
     stage_sizes: Sequence[int]
@@ -145,27 +171,30 @@ class ResNet(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        if self.stem == "s2d":
-            # pad left 4 (the folded kernel's top-left zero pad + the
-            # conv's padding 3), right 2 (the conv's right padding that
-            # the last window reaches): h+6 stays even and the VALID
-            # conv yields exactly h/2 outputs — no slicing
-            h, w = x.shape[1], x.shape[2]
-            if h % 2 or w % 2:
-                raise ValueError(
-                    f"stem='s2d' needs even spatial dims; got {(h, w)}")
-            xp = jnp.pad(x, ((0, 0), (4, 2), (4, 2), (0, 0)))
-            y = space_to_depth(xp, 2)
+        if self.stem in ("s2d", "s2d_pre"):
+            if self.stem == "s2d":
+                # pad left 4 (the folded kernel's top-left zero pad +
+                # the conv's padding 3), right 2 (the conv's right
+                # padding that the last window reaches): h+6 stays even
+                # and the VALID conv yields exactly h/2 outputs — no
+                # slicing
+                h, w = x.shape[1], x.shape[2]
+                if h % 2 or w % 2:
+                    raise ValueError(
+                        f"stem='s2d' needs even spatial dims; got {(h, w)}")
+                x = s2d_input_transform(x)
+            # s2d_pre: input arrives already transformed (the input
+            # pipeline ran s2d_input_transform on host)
             x = nn.Conv(self.width, (4, 4), (1, 1), padding="VALID",
                         use_bias=False, kernel_init=conv_init,
-                        name="stem_conv_s2d")(y)
+                        name="stem_conv_s2d")(x)
         elif self.stem == "conv":
             x = nn.Conv(self.width, (7, 7), (2, 2), padding=3,
                         use_bias=False, kernel_init=conv_init,
                         name="stem_conv")(x)
         else:
-            raise ValueError(f"stem must be 'conv' or 's2d', got "
-                             f"{self.stem!r}")
+            raise ValueError(f"stem must be 'conv', 's2d' or 's2d_pre', "
+                             f"got {self.stem!r}")
         x = self.norm(use_running_average=not train, name="stem_bn")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), (2, 2), padding=((1, 1), (1, 1)))

@@ -106,14 +106,16 @@ def load_torch_resnet(state_dict: Mapping[str, Any],
         s[dst] = {"mean": jnp.asarray(_np(sd[f"{src}.running_mean"])),
                   "var": jnp.asarray(_np(sd[f"{src}.running_var"]))}
 
-    if stem == "s2d":
+    if stem in ("s2d", "s2d_pre"):  # identical weights either way — the
+        # variants differ only in where the input transform runs
         from apex_tpu.models.resnet import stem_to_s2d
         params["stem_conv_s2d"] = {
             "kernel": stem_to_s2d(_conv(sd["conv1.weight"]))}
     elif stem == "conv":
         params["stem_conv"] = {"kernel": _conv(sd["conv1.weight"])}
     else:  # same validation as ResNet.__call__ — fail HERE, not at apply
-        raise ValueError(f"stem must be 'conv' or 's2d', got {stem!r}")
+        raise ValueError(f"stem must be 'conv', 's2d' or 's2d_pre', "
+                         f"got {stem!r}")
     bn("bn1", "stem_bn", params, stats)
 
     k = 0

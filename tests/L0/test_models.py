@@ -309,3 +309,41 @@ def test_s2d_stem_rejects_odd_input():
     x = jnp.ones((1, 33, 33, 3))
     with pytest.raises(ValueError, match="even"):
         s2d.init(jax.random.PRNGKey(0), x, train=False)
+
+
+def test_s2d_pre_stem_matches_s2d():
+    """stem='s2d_pre' over host-transformed input computes exactly what
+    stem='s2d' computes over raw input — same weights, the transform
+    merely moved from the step into the input pipeline (numpy path
+    included)."""
+    from apex_tpu.models.resnet import s2d_input_transform
+
+    s2d = models.resnet.ResNet(stage_sizes=[1, 1],
+                               block=models.resnet.BasicBlock,
+                               num_classes=10, width=16, stem="s2d")
+    pre = models.resnet.ResNet(stage_sizes=[1, 1],
+                               block=models.resnet.BasicBlock,
+                               num_classes=10, width=16, stem="s2d_pre")
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 32, 3))
+    xt_np = s2d_input_transform(np.asarray(x))           # host/numpy path
+    xt_j = s2d_input_transform(x)                        # device path
+    np.testing.assert_array_equal(np.asarray(xt_j), xt_np)
+
+    v = s2d.init(jax.random.PRNGKey(1), x, train=False)
+    out_s2d = s2d.apply(v, x, train=False)
+    out_pre = pre.apply(v, jnp.asarray(xt_np), train=False)
+    np.testing.assert_array_equal(np.asarray(out_pre), np.asarray(out_s2d))
+
+
+def test_s2d_batches_loader_wrapper():
+    from apex_tpu.data import loaders
+    from apex_tpu.models.resnet import s2d_input_transform
+
+    it = loaders.synthetic_loader(4, image_size=32, num_classes=10)
+    wrapped = loaders.s2d_batches(loaders.synthetic_loader(
+        4, image_size=32, num_classes=10))
+    x, y = next(it)
+    xt, yt = next(wrapped)
+    assert xt.shape == (4, 19, 19, 12)
+    np.testing.assert_array_equal(xt, s2d_input_transform(x))
+    np.testing.assert_array_equal(yt, y)

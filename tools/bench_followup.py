@@ -38,7 +38,7 @@ def main():
     ap.add_argument("--sections", default="o3,flash,adam,moe",
                     help="comma list: o3,flash,adam,moe")
     ap.add_argument("--batch", type=int, default=256)
-    ap.add_argument("--stem", default="s2d")
+    ap.add_argument("--stem", default="s2d_pre")
     ap.add_argument("--o2", action="store_true",
                     help="also re-measure O2 at --batch/--stem (for a "
                          "fresh like-for-like ratio in one window)")
