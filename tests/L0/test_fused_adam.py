@@ -282,3 +282,104 @@ def test_amp_optimizer_fused_skip_path():
     assert float(amp_opt.loss_scale(s2)) == scale0 / 2
     np.testing.assert_array_equal(np.asarray(s2.inner.m),
                                   np.asarray(s1.inner.m))
+
+
+def test_tree_layout_matches_flat():
+    """layout='tree' (per-leaf fused update) walks the same trajectory
+    as the flat-buffer layout — same math, only the memory layout and
+    fusion structure differ (BENCH_NOTES: the tree layout skips the
+    per-step concat/pad/slice-back HBM traffic)."""
+    params = params_tree(n=5000)
+    rng = np.random.RandomState(7)
+    grads = [{k: jnp.asarray(rng.randn(*np.shape(v)), jnp.float32)
+              for k, v in params.items()} for _ in range(3)]
+    outs = {}
+    for layout in ("flat", "tree"):
+        opt = FusedAdam(lr=1e-2, weight_decay=0.01, use_pallas=False,
+                        layout=layout)
+        state = opt.init(params)
+        p = params
+        for g in grads:
+            p, state = jax.jit(opt.step)(p, g, state, scale=2.0)
+        outs[layout] = (p, state)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(outs["tree"][0][k]), np.asarray(outs["flat"][0][k]),
+            rtol=1e-6, atol=1e-7)
+    assert int(outs["tree"][1].step) == int(outs["flat"][1].step) == 3
+    # tree state mirrors the params structure
+    assert set(outs["tree"][1].m.keys()) == set(params.keys())
+
+
+def test_tree_layout_param_groups_and_max_grad_norm():
+    """Per-group lr/wd/max_grad_norm resolve identically in both
+    layouts (group-wise grad-norm clipping included)."""
+    params = {"w": jnp.ones((8, 8)) * 0.3, "bias": jnp.ones((8,)) * 0.1,
+              "u": jnp.ones((4, 4))}
+    grads = {"w": jnp.ones((8, 8)) * 3.0, "bias": jnp.ones((8,)) * 3.0,
+             "u": jnp.ones((4, 4)) * 3.0}
+    groups = [{"match": r"bias", "weight_decay": 0.0, "lr": 1e-3},
+              {"match": r"u", "max_grad_norm": 0.5}]
+    outs = {}
+    for layout in ("flat", "tree"):
+        opt = FusedAdam(lr=1e-2, weight_decay=0.1, use_pallas=False,
+                        param_groups=groups, layout=layout)
+        state = opt.init(params)
+        p, state = opt.step(params, grads, state)
+        p, state = opt.step(p, grads, state)
+        outs[layout] = p
+    for k in params:
+        np.testing.assert_allclose(np.asarray(outs["tree"][k]),
+                                   np.asarray(outs["flat"][k]),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_tree_layout_skip_step():
+    params = params_tree()
+    bad = {k: jnp.full_like(v, jnp.inf) for k, v in params.items()}
+    good = {k: jnp.ones_like(v) for k, v in params.items()}
+    opt = FusedAdam(lr=1e-2, layout="tree", use_pallas=False)
+    state = opt.init(params)
+    p_skip, s_skip = opt.step(params, bad, state, skip=jnp.asarray(True))
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(p_skip[k]),
+                                      np.asarray(params[k]))
+    np.testing.assert_array_equal(np.asarray(s_skip.m["w"]),
+                                  np.asarray(state.m["w"]))
+    assert int(s_skip.step) == 0
+    # and the fused-skip path through AmpOptimizer works for tree too
+    from apex_tpu.amp.optimizer import AmpOptimizer
+    from apex_tpu.amp.scaler import LossScaler
+    amp_opt = AmpOptimizer(opt, LossScaler(init_scale=4.0))
+    astate = amp_opt.init(params)
+    p1, a1 = amp_opt.step(params, {k: v * 4.0 for k, v in good.items()},
+                          astate)
+    assert int(a1.applied_steps) == 1
+    p2, a2 = amp_opt.step(p1, bad, a1)
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(p2[k]), np.asarray(p1[k]))
+    assert int(a2.skipped_steps) == 1
+
+
+def test_tree_layout_add_param_group():
+    """Mid-training group addition carries per-leaf moments over and
+    zero-inits new leaves (the reference's unfreeze use case)."""
+    params = params_tree()
+    grads = {k: jnp.ones_like(v) * 0.1 for k, v in params.items()}
+    opt = FusedAdam(lr=1e-2, layout="tree", use_pallas=False)
+    state = opt.init(params)
+    p, state = opt.step(params, grads, state)
+
+    bigger = dict(p, extra=jnp.zeros((5, 5)))
+    opt2, state2 = opt.add_param_group(state, bigger, match=r"extra",
+                                       lr=1e-4)
+    np.testing.assert_array_equal(np.asarray(state2.m["w"]),
+                                  np.asarray(state.m["w"]))
+    np.testing.assert_array_equal(np.asarray(state2.m["extra"]),
+                                  np.zeros((5, 5), np.float32))
+    assert int(state2.step) == 1
+    g2 = dict({k: jnp.ones_like(v) * 0.1 for k, v in p.items()},
+              extra=jnp.ones((5, 5)))
+    p2, state3 = opt2.step(bigger, g2, state2)
+    assert p2["extra"].shape == (5, 5)
+    assert not np.allclose(np.asarray(p2["extra"]), 0.0)

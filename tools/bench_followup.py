@@ -54,25 +54,33 @@ def main():
     log("probe", {"ok": True})
 
     o2_ips = None
+    best_layout = "flat"
     if args.o2:
-        try:
-            ips, step_ms, flops = bench.measure(
-                "O2", args.batch, 224, 20, stem=args.stem)
-            o2_ips = ips
-            log("o2", {"images_per_sec": round(ips, 1),
-                       "step_time_ms": round(step_ms, 2),
-                       "batch": args.batch, "stem": args.stem,
-                       "flops_per_step": flops})
-        except Exception as e:
-            log("o2", {"error": f"{type(e).__name__}: {e}"})
+        for layout in ("flat", "tree"):
+            try:
+                ips, step_ms, flops = bench.measure(
+                    "O2", args.batch, 224, 20, stem=args.stem,
+                    adam_layout=layout)
+                if o2_ips is None or ips > o2_ips:
+                    o2_ips, best_layout = ips, layout
+                log("o2", {"images_per_sec": round(ips, 1),
+                           "step_time_ms": round(step_ms, 2),
+                           "batch": args.batch, "stem": args.stem,
+                           "adam_layout": layout,
+                           "flops_per_step": flops})
+            except Exception as e:
+                log("o2", {"adam_layout": layout,
+                           "error": f"{type(e).__name__}: {e}"})
 
     if "o3" in sections:
         try:
             ips, step_ms, flops = bench.measure(
-                "O3", args.batch, 224, 20, stem=args.stem)
+                "O3", args.batch, 224, 20, stem=args.stem,
+                adam_layout=best_layout)
             payload = {"images_per_sec": round(ips, 1),
                        "step_time_ms": round(step_ms, 2),
-                       "batch": args.batch, "stem": args.stem}
+                       "batch": args.batch, "stem": args.stem,
+                       "adam_layout": best_layout}
             if o2_ips:
                 payload["vs_baseline_o2_over_o3"] = round(o2_ips / ips, 3)
             log("o3_ceiling", payload)
