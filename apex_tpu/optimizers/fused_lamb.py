@@ -133,12 +133,24 @@ class FusedLAMB:
                               v=jax.tree_util.tree_map(jnp.copy, zeros))
 
     def update(self, grads: Pytree, state: FusedLAMBState,
-               params: Optional[Pytree] = None):
+               params: Optional[Pytree] = None, *, skip=None):
+        """``skip`` (bool scalar or None): amp's overflow->skip-step
+        fused into the per-leaf update — moments keep their old values,
+        deltas are zero, and the bias-correction clock stands still
+        (same contract as ``FusedAdam.step(skip=...)``; the selects
+        fuse into each leaf's update pass, no post-step tree-select)."""
         if params is None:
             raise ValueError("FusedLAMB.update requires params")
-        step = state.step + 1
+        if skip is None:
+            keep = None
+            step = state.step + 1
+        else:
+            keep = ~jnp.asarray(skip)
+            step = state.step + keep.astype(jnp.int32)
         beta1, beta2 = self.betas
-        t = step.astype(jnp.float32)
+        # clamp: a skipped first step sees t=0 where 1-beta^0 = 0; the
+        # produced update only feeds keep-selected zeros
+        t = jnp.maximum(step, 1).astype(jnp.float32)
         bc1 = 1.0 - beta1 ** t if self.bias_correction else 1.0
         bc2 = 1.0 - beta2 ** t if self.bias_correction else 1.0
 
@@ -157,6 +169,10 @@ class FusedLAMB:
             v2 = beta2 * v + (1.0 - beta2) * g * g
             upd = (m2 / bc1) / (jnp.sqrt(v2 / bc2) + hp["eps"]) \
                 + hp["weight_decay"] * p
+            if keep is not None:
+                # jnp.where, not a blend: overflow grads carry inf/nan
+                m2 = jnp.where(keep, m2, m)
+                v2 = jnp.where(keep, v2, v)
             return upd, m2, v2
 
         triples = jax.tree_util.tree_map_with_path(
@@ -195,11 +211,19 @@ class FusedLAMB:
 
         deltas = jax.tree_util.tree_map_with_path(stage2, updates, p_norms,
                                                   u_norms, params)
+        if keep is not None:
+            deltas = jax.tree_util.tree_map(
+                lambda d: jnp.where(keep, d, jnp.zeros_like(d)), deltas)
         deltas = jax.tree_util.tree_map(
             lambda d, p: d.astype(jnp.asarray(p).dtype), deltas, params)
         return deltas, FusedLAMBState(step=step, m=new_m, v=new_v)
 
-    def step(self, params: Pytree, grads: Pytree, state: FusedLAMBState):
+    # AmpOptimizer routes the overflow->skip select through the fused
+    # per-leaf update (see FusedAdam.supports_fused_skip)
+    supports_fused_skip = True
+
+    def step(self, params: Pytree, grads: Pytree, state: FusedLAMBState,
+             skip=None):
         import optax
-        deltas, new_state = self.update(grads, state, params)
+        deltas, new_state = self.update(grads, state, params, skip=skip)
         return optax.apply_updates(params, deltas), new_state

@@ -107,3 +107,41 @@ def test_jits_and_trains():
         params, state, loss = step(params, state)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.5
+
+
+def test_lamb_fused_skip_step():
+    """skip=True: params/m/v/step clock unchanged even against inf
+    grads; skip=False matches the no-arg step (same protocol as
+    FusedAdam.supports_fused_skip)."""
+    import numpy as np
+
+    params = {"w": jnp.ones((6, 6)) * 0.5, "b": jnp.ones((6,)) * 0.1}
+    good = {k: jnp.ones_like(v) * 0.01 for k, v in params.items()}
+    bad = {k: jnp.full_like(v, jnp.inf) for k, v in params.items()}
+    opt = FusedLAMB(lr=1e-2)
+    assert opt.supports_fused_skip
+    state = opt.init(params)
+
+    p_skip, s_skip = opt.step(params, bad, state, skip=jnp.asarray(True))
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(p_skip[k]),
+                                      np.asarray(params[k]))
+        np.testing.assert_array_equal(np.asarray(s_skip.m[k]),
+                                      np.asarray(state.m[k]))
+    assert int(s_skip.step) == 0
+
+    p_a, s_a = opt.step(params, good, state, skip=jnp.asarray(False))
+    p_b, s_b = opt.step(params, good, state)
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(p_a[k]), np.asarray(p_b[k]))
+    assert int(s_a.step) == int(s_b.step) == 1
+
+    # through AmpOptimizer: overflow -> fused skip path
+    from apex_tpu.amp.optimizer import AmpOptimizer
+    from apex_tpu.amp.scaler import LossScaler
+    amp_opt = AmpOptimizer(opt, LossScaler(init_scale=4.0))
+    astate = amp_opt.init(params)
+    p2, a2 = amp_opt.step(params, bad, astate)
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(p2[k]), np.asarray(params[k]))
+    assert int(a2.skipped_steps) == 1
