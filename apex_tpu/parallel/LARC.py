@@ -99,10 +99,24 @@ class LARC:
                                      params)
 
     # -- apex-style --------------------------------------------------------
-    def step(self, params: Pytree, grads: Pytree, state):
+    @property
+    def supports_fused_skip(self):
+        """AmpOptimizer's fused overflow->skip routes through the wrapped
+        optimizer's kernel when it can take it (FusedAdam/FusedLAMB)."""
+        return getattr(self.optimizer, "supports_fused_skip", False)
+
+    def step(self, params: Pytree, grads: Pytree, state, skip=None):
         import optax
         if hasattr(self.optimizer, "step"):
+            kw = {"skip": skip} if self.supports_fused_skip else {}
+            if skip is not None and not self.supports_fused_skip:
+                raise TypeError(
+                    "LARC: skip= given but the wrapped optimizer has no "
+                    "fused skip support")
             return self.optimizer.step(params, self._adapt(grads, params),
-                                       state)
+                                       state, **kw)
+        if skip is not None:
+            raise TypeError("LARC: skip= requires a wrapped optimizer "
+                            "with fused skip support")
         updates, state = self.update(grads, state, params)
         return optax.apply_updates(params, updates), state

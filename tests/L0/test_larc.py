@@ -67,3 +67,26 @@ def test_clip_without_base_lr_raises():
 
     with pytest.raises(ValueError, match="base_lr"):
         LARC(NoLR())
+
+
+def test_larc_forwards_fused_skip():
+    """LARC(FusedAdam) advertises and forwards the fused skip protocol;
+    LARC over a skip-less optimizer rejects skip= loudly."""
+    import numpy as np
+    from apex_tpu.optimizers import FusedAdam
+
+    params = {"w": jnp.ones((4, 4))}
+    bad = {"w": jnp.full((4, 4), jnp.inf)}
+    larc = LARC(FusedAdam(lr=1e-2, use_pallas=False))
+    assert larc.supports_fused_skip
+    state = larc.init(params)
+    p, s = larc.step(params, bad, state, skip=jnp.asarray(True))
+    np.testing.assert_array_equal(np.asarray(p["w"]), np.asarray(params["w"]))
+    assert int(s.step) == 0
+
+    import optax
+    larc2 = LARC(optax.sgd(1e-2), base_lr=1e-2)
+    assert not larc2.supports_fused_skip
+    s2 = larc2.init(params)
+    with pytest.raises(TypeError, match="skip"):
+        larc2.step(params, bad, s2, skip=jnp.asarray(True))
