@@ -28,7 +28,12 @@ from apex_tpu.parallel.sequence import (
     ring_attention,
     ulysses_attention,
 )
-from apex_tpu.parallel.pipeline import gpipe_spmd, pipeline_apply
+from apex_tpu.parallel.pipeline import (
+    gpipe_spmd,
+    onef1b_loss_and_grad,
+    onef1b_spmd,
+    pipeline_apply,
+)
 from apex_tpu.parallel.tensor_parallel import (
     BERT_TP_RULES,
     bert_tp_rules,
@@ -64,6 +69,8 @@ __all__ = [
     "create_process_group",
     "create_syncbn_process_group",
     "gpipe_spmd",
+    "onef1b_loss_and_grad",
+    "onef1b_spmd",
     "initialize_distributed",
     "pipeline_apply",
     "make_ring_attention",

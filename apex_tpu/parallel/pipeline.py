@@ -1,12 +1,18 @@
-"""Pipeline parallelism: GPipe over a mesh axis, TPU-native.
+"""Pipeline parallelism over a mesh axis, TPU-native: GPipe and 1F1B.
 
 The reference has no PP (SURVEY §2.3). The TPU formulation needs no
 scheduler threads or p2p runtime: stages are laid out on a ``"pipe"``
 mesh axis, the microbatch schedule is a ``lax.scan`` over ticks, and
 stage-to-stage transfer is one ``ppermute`` hop per tick over ICI —
-the whole pipeline is a single compiled SPMD program, and autodiff
-through scan + ppermute yields the reverse pipeline for backward
-automatically (no hand-written 1F1B machinery).
+the whole pipeline is a single compiled SPMD program.  Two schedules:
+
+- :func:`gpipe_spmd` / :func:`pipeline_apply` — differentiable GPipe;
+  autodiff through scan + ppermute yields the reverse pipeline, XLA
+  saves per-tick activations (memory grows with ``M``);
+- :func:`onef1b_spmd` / :func:`onef1b_loss_and_grad` — hand-interleaved
+  1F1B loss-and-grad with rematerialized backward; live stage inputs
+  bounded by ``S`` regardless of ``M`` (the PipeDream-flush memory
+  profile), same bubble fraction as GPipe.
 
 Contract (classic GPipe):
 
@@ -111,6 +117,233 @@ def gpipe_spmd(stage_fn: Callable, axis_name: str,
         return jax.tree_util.tree_map(collect, ys)
 
     return run
+
+
+def onef1b_spmd(stage_fn: Callable, loss_fn: Callable, axis_name: str,
+                num_microbatches: int):
+    """Per-device 1F1B (PipeDream-flush) body, to be called INSIDE
+    ``shard_map`` over the stage axis ``axis_name``.
+
+    Where :func:`gpipe_spmd` relies on autodiff through the scan — XLA
+    saves every tick's activations, so live memory grows with
+    ``T = M + S - 1`` microbatch activations per device — this schedule
+    hand-interleaves forward and backward so each device keeps at most
+    ``S`` stage *inputs* alive, independent of ``M``.  The backward for
+    a microbatch REMATERIALIZES its stage forward from the saved input
+    (``jax.vjp`` at the backward tick), trading ~1 extra stage-forward
+    per microbatch for the memory bound — the same trade
+    ``jax.checkpoint`` makes, scheduled explicitly.
+
+    Schedule (ticks ``t = 0 .. 2(M+S-1)-1``, stage ``s``, microbatch
+    ``m``): forward of ``m`` on ``s`` at ``t = 2m + s``; backward at
+    ``t = 2m + 2S - 1 - s``.  Adjacent stages act on opposite tick
+    parities, so activations produced at ``t`` are consumed at ``t+1``
+    after one ``ppermute`` hop (forward hops down the axis, gradient
+    hops up), every device alternates F and B ticks in steady state
+    (the 1F1B invariant), and the bubble fraction ``(S-1)/(M+S-1)``
+    equals GPipe's.  A microbatch's saved input lives from its forward
+    tick to its backward tick — ``2(S-s)-1`` ticks — so a ring buffer
+    of ``S`` slots (slot ``m % S``) never collides.
+
+    Because forward and backward are fused into one pass, this is a
+    loss-and-grad primitive, not a differentiable layer:
+
+    ``run(stacked_params_local, x, target) -> (loss, grads, dx)``
+
+    - ``loss_fn(y_pred_mb, target_mb) -> scalar`` (mean over the
+      microbatch); the returned ``loss`` is the mean over microbatches,
+      exact since microbatches are equal-sized;
+    - ``grads`` is this device's ``(1, ...)`` stage-param grad slice
+      (d loss / d params, microbatch-summed, matching the stacked
+      layout of the input params);
+    - ``dx`` is d loss / d x, replicated — chain it into whatever
+      produced ``x`` (embeddings, a previous parallel region) with the
+      caller's own vjp; see ``tests/distributed/test_pipeline.py``.
+
+    The last stage owns the loss: its backward tick rematerializes
+    ``loss_fn(stage_fn(params, x_m), target_m)`` and seeds the vjp with
+    ``1/M``, so the head can live in the last stage's params.
+    """
+
+    def run(stacked_params_local: Pytree, x: Pytree,
+            target: Pytree):
+        s_size = lax.axis_size(axis_name)
+        stage = lax.axis_index(axis_name)
+        for leaf in jax.tree_util.tree_leaves(stacked_params_local):
+            if leaf.shape[0] != 1:
+                raise ValueError(
+                    f"stacked stage params have leading dim "
+                    f"{leaf.shape[0]} per device; the stage count must "
+                    f"equal the size of mesh axis {axis_name!r} "
+                    f"({s_size})")
+        params = jax.tree_util.tree_map(lambda a: a[0],
+                                        stacked_params_local)
+        m = num_microbatches
+        x_leaves = jax.tree_util.tree_leaves(x)
+        b = x_leaves[0].shape[0]
+        for leaf in x_leaves:
+            if leaf.shape[0] != b:
+                raise ValueError(
+                    "every activation leaf must share the batch dim; got "
+                    f"{[l.shape for l in x_leaves]}")
+        assert b % m == 0, f"batch {b} must divide into {m} microbatches"
+        mb = b // m
+        xs = jax.tree_util.tree_map(
+            lambda a: a.reshape((m, mb) + a.shape[1:]), x)
+        tgts = jax.tree_util.tree_map(
+            lambda a: a.reshape((m, mb) + a.shape[1:]), target)
+
+        fwd_perm = [(i, i + 1) for i in range(s_size - 1)]
+        bwd_perm = [(i + 1, i) for i in range(s_size - 1)]
+        last = s_size - 1
+
+        def _v(a, *refs):
+            # fresh zeros carry no vma type; inherit the reference
+            # leaves' varying axes (e.g. a data axis from composition)
+            # plus the pipe axis the ppermutes will introduce
+            return _vary_like(a, *refs, extra_axes=(axis_name,))
+
+        x_ref = x_leaves[0]
+        carry0 = dict(
+            x_inbox=jax.tree_util.tree_map(
+                lambda a: _v(jnp.zeros_like(a[0]), a), xs),
+            g_inbox=jax.tree_util.tree_map(
+                lambda a: _v(jnp.zeros_like(a[0]), a), xs),
+            ring=jax.tree_util.tree_map(
+                lambda a: _v(jnp.zeros((s_size,) + a.shape[1:],
+                                       a.dtype), a), xs),
+            gacc=jax.tree_util.tree_map(
+                lambda a: _v(jnp.zeros_like(a), a, x_ref), params),
+            dxbuf=jax.tree_util.tree_map(
+                lambda a: _v(jnp.zeros_like(a), a), xs),
+            lacc=_v(jnp.zeros((), jnp.float32), x_ref),
+        )
+
+        def tick(carry, t):
+            mf = (t - stage) // 2
+            fwd_valid = (t >= stage) & (mf < m)
+            tb = t - (2 * s_size - 1 - stage)
+            mb_i = tb // 2
+            bwd_valid = (tb >= 0) & (mb_i < m)
+            mf_c = jnp.clip(mf, 0, m - 1)
+            mb_c = jnp.clip(mb_i, 0, m - 1)
+
+            def fwd_branch(carry):
+                inject = jax.tree_util.tree_map(lambda a: a[mf_c], xs)
+                x_in = jax.tree_util.tree_map(
+                    lambda i, buf: jnp.where(stage == 0, i, buf),
+                    inject, carry["x_inbox"])
+                y = stage_fn(params, x_in)
+                slot = mf_c % s_size
+                ring = jax.tree_util.tree_map(
+                    lambda r, v: jnp.where(
+                        fwd_valid,
+                        lax.dynamic_update_index_in_dim(r, v, slot, 0),
+                        r),
+                    carry["ring"], x_in)
+                out = dict(carry, ring=ring)
+                g_zero = jax.tree_util.tree_map(
+                    lambda a: _v(jnp.zeros_like(a), a),
+                    carry["g_inbox"])
+                return out, y, g_zero
+
+            def bwd_branch(carry):
+                slot = mb_c % s_size
+                x_saved = jax.tree_util.tree_map(
+                    lambda r: lax.dynamic_index_in_dim(
+                        r, slot, 0, keepdims=False), carry["ring"])
+
+                def mid(_):
+                    _, vjp = jax.vjp(stage_fn, params, x_saved)
+                    dp, dx = vjp(carry["g_inbox"])
+                    return dp, dx, _v(jnp.zeros((), jnp.float32),
+                                      carry["lacc"])
+
+                def tail(_):
+                    tgt_m = jax.tree_util.tree_map(
+                        lambda a: a[mb_c], tgts)
+
+                    def f(p, xi):
+                        return loss_fn(stage_fn(p, xi), tgt_m)
+
+                    lval, vjp = jax.vjp(f, params, x_saved)
+                    seed = _vary_like(jnp.asarray(1.0 / m,
+                                                  dtype=lval.dtype),
+                                      lval)
+                    dp, dx = vjp(seed)
+                    lval = _v(lval.astype(jnp.float32) / m,
+                              carry["lacc"])
+                    return dp, dx, lval
+
+                dp, dx, lval = lax.cond(stage == last, tail, mid, None)
+                gacc = jax.tree_util.tree_map(
+                    lambda acc, g: acc + jnp.where(bwd_valid, g, 0),
+                    carry["gacc"], dp)
+                lacc = carry["lacc"] + jnp.where(bwd_valid, lval, 0.0)
+                dxbuf = jax.tree_util.tree_map(
+                    lambda buf, v: jnp.where(
+                        bwd_valid & (stage == 0),
+                        lax.dynamic_update_index_in_dim(buf, v, mb_c, 0),
+                        buf),
+                    carry["dxbuf"], dx)
+                out = dict(carry, gacc=gacc, lacc=lacc, dxbuf=dxbuf)
+                y_zero = jax.tree_util.tree_map(
+                    lambda a: _v(jnp.zeros_like(a), a),
+                    carry["x_inbox"])
+                return out, y_zero, dx
+
+            carry, y_out, g_out = lax.cond(
+                (t - stage) % 2 == 0, fwd_branch, bwd_branch, carry)
+            # collectives OUTSIDE the branches: every device must
+            # participate every tick; off-parity payloads are garbage
+            # that the receiver's schedule never reads
+            carry = dict(
+                carry,
+                x_inbox=jax.tree_util.tree_map(
+                    lambda a: lax.ppermute(a, axis_name, fwd_perm),
+                    y_out),
+                g_inbox=jax.tree_util.tree_map(
+                    lambda a: lax.ppermute(a, axis_name, bwd_perm),
+                    g_out))
+            return carry, None
+
+        ticks = jnp.arange(2 * (m + s_size - 1))
+        carry, _ = lax.scan(tick, carry0, ticks)
+
+        loss = lax.psum(jnp.where(stage == last, carry["lacc"], 0.0),
+                        axis_name)
+        grads = jax.tree_util.tree_map(lambda a: a[None],
+                                       carry["gacc"])
+        dx = jax.tree_util.tree_map(
+            lambda buf: lax.psum(
+                jnp.where(stage == 0, buf, jnp.zeros_like(buf)),
+                axis_name).reshape((b,) + buf.shape[2:]),
+            carry["dxbuf"])
+        return loss, grads, dx
+
+    return run
+
+
+def onef1b_loss_and_grad(mesh: Mesh, axis_name: str, stage_fn: Callable,
+                         loss_fn: Callable, stacked_params: Pytree,
+                         x: Pytree, target: Pytree,
+                         num_microbatches: int):
+    """One-call 1F1B: shard ``stacked_params`` over ``axis_name``, run
+    the interleaved schedule, return ``(loss, grads, dx)`` with
+    ``grads`` stacked ``(S, ...)`` like the input params and ``loss`` /
+    ``dx`` replicated.  This is the memory-bounded alternative to
+    ``jax.grad`` over :func:`pipeline_apply`; see :func:`onef1b_spmd`
+    for the contract."""
+    run = onef1b_spmd(stage_fn, loss_fn, axis_name, num_microbatches)
+    p_spec = jax.tree_util.tree_map(lambda _: P(axis_name),
+                                    stacked_params)
+    r_spec = jax.tree_util.tree_map(lambda _: P(), x)
+    f = jax.shard_map(
+        run, mesh=mesh,
+        in_specs=(p_spec, r_spec,
+                  jax.tree_util.tree_map(lambda _: P(), target)),
+        out_specs=(P(), p_spec, r_spec))
+    return f(stacked_params, x, target)
 
 
 def pipeline_apply(mesh: Mesh, axis_name: str, stage_fn: Callable,

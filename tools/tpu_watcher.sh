@@ -1,18 +1,49 @@
 #!/bin/bash
-# Probes the axon TPU tunnel every ~9 min; at the first live window runs
-# the pending hardware queue (bench_followup incl. fresh O2 for a
-# like-for-like ratio, then kernel_parity), serialized, then exits.
-# Log: /tmp/tpu_watcher.log
+# Probes the axon TPU tunnel every ~9 min; whenever it is live, runs the
+# next PENDING item of the hardware queue — each item in its own process
+# so a mid-compile wedge loses only that item, never the window. Repeats
+# until every item has a recorded success, then exits.
+# Queue state is derived from artifacts, not kept in memory, so the
+# watcher survives restarts. Log: /tmp/tpu_watcher.log
 cd "$(dirname "$0")/.."
+LOG=/tmp/tpu_watcher.log
+
+sec_done() {  # recorded success, or given up after 4 live attempts
+  grep "\"section\": \"$1\"" BENCH_FOLLOWUP.jsonl 2>/dev/null | grep -qv '"error"' && return 0
+  n=$(grep -c "running $1\$" "$LOG" 2>/dev/null); [ "${n:-0}" -ge 4 ]
+}
+
+pending() {
+  for s in o3_ceiling flash_attention fused_adam moe_dispatch; do
+    sec_done "$s" || { echo "$s"; return; }
+  done
+  kp=$(grep -c 'running kernel_parity$' "$LOG" 2>/dev/null)
+  if ! grep -q '"pass"' KERNEL_PARITY_r03.json 2>/dev/null \
+      && [ "${kp:-0}" -lt 4 ]; then
+    echo kernel_parity; return
+  fi
+  echo none
+}
+
 while true; do
-  if timeout 90 python -c "import jax; assert jax.devices()[0].platform=='tpu'" 2>/dev/null; then
-    echo "$(date +%H:%M:%S) TUNNEL UP - running followup" >> /tmp/tpu_watcher.log
-    python tools/bench_followup.py --o2 >> /tmp/tpu_watcher.log 2>&1
-    echo "$(date +%H:%M:%S) followup done - kernel parity" >> /tmp/tpu_watcher.log
-    timeout 1500 python tools/kernel_parity.py > KERNEL_PARITY_r03.json 2>>/tmp/tpu_watcher.log
-    echo "$(date +%H:%M:%S) all done" >> /tmp/tpu_watcher.log
+  next=$(pending)
+  if [ "$next" = none ]; then
+    echo "$(date +%H:%M:%S) queue empty - exiting" >> "$LOG"
     exit 0
   fi
-  echo "$(date +%H:%M:%S) tunnel down" >> /tmp/tpu_watcher.log
-  sleep 540
+  if timeout 120 python -c "import jax; assert jax.devices()[0].platform=='tpu'" 2>/dev/null; then
+    echo "$(date +%H:%M:%S) TUNNEL UP - running $next" >> "$LOG"
+    case "$next" in
+      o3_ceiling)      timeout 1800 python tools/bench_followup.py --sections o3   >> "$LOG" 2>&1 ;;
+      flash_attention) timeout 1800 python tools/bench_followup.py --sections flash >> "$LOG" 2>&1 ;;
+      fused_adam)      timeout 1800 python tools/bench_followup.py --sections adam >> "$LOG" 2>&1 ;;
+      moe_dispatch)    timeout 1800 python tools/bench_followup.py --sections moe  >> "$LOG" 2>&1 ;;
+      kernel_parity)   timeout 1800 python tools/kernel_parity.py > KERNEL_PARITY_r03.json 2>>"$LOG" ;;
+    esac
+    echo "$(date +%H:%M:%S) $next attempt finished" >> "$LOG"
+    sleep 10   # tiny gap, then loop re-probes before the next item
+  else
+    echo "$(date +%H:%M:%S) tunnel down (next: $next)" >> "$LOG"
+    sleep 540
+  fi
 done
