@@ -441,35 +441,36 @@ class PipelinedBert:
         return jnp.where(attention_mask[:, None, None, :] > 0,
                          0.0, -1e9).astype(jnp.float32)
 
-    def apply(self, variables, input_ids, attention_mask=None,
-              token_type_ids=None, deterministic: bool = True,
-              rngs=None):
-        from jax import lax
-        from jax.sharding import PartitionSpec as P
-
-        from apex_tpu.parallel.pipeline import gpipe_spmd
-
+    def _dropout_setup(self, deterministic, rngs, caller):
+        """Shared rng prologue of both training paths: validates the
+        rngs contract and derives the embed key (a fold_in index far
+        outside the microbatch-id range the stage keys use).
+        Returns ``(needs_rng, base_key, embed_rngs)``."""
         cfg = self.cfg
         needs_rng = not deterministic and (
             cfg.hidden_dropout_prob > 0
             or cfg.attention_probs_dropout_prob > 0)
-        base_key = None
-        embed_rngs = None
-        if needs_rng:
-            if not rngs or "dropout" not in rngs:
-                raise ValueError(
-                    "PipelinedBert.apply(deterministic=False) with "
-                    "dropout in the config needs rngs={'dropout': key}")
-            base_key = rngs["dropout"]
-            embed_rngs = {"dropout": jax.random.fold_in(base_key, 2 ** 20)}
+        if not needs_rng:
+            return False, None, None
+        if not rngs or "dropout" not in rngs:
+            raise ValueError(
+                f"{caller}(deterministic=False) with dropout in the "
+                "config needs rngs={'dropout': key}")
+        base_key = rngs["dropout"]
+        return True, base_key, {
+            "dropout": jax.random.fold_in(base_key, 2 ** 20)}
 
-        p = variables["params"]
-        x = self.embed.apply({"params": p["embed"]}, input_ids,
-                             token_type_ids, deterministic,
-                             rngs=embed_rngs)
-        bias = self._bias(input_ids, attention_mask)
+    def _build_stage_fn(self, needs_rng, base_key, deterministic):
+        """The per-stage body both schedules share (GPipe ``apply`` and
+        :meth:`loss_and_grad_1f1b`).  Activation pytree:
+        ``(hidden, bias, mb_ids, aux)`` when dropout rngs are live,
+        ``(hidden, bias, aux)`` otherwise — ``mb_ids`` carries one
+        microbatch id per row for per-(microbatch, stage) dropout keys,
+        ``aux`` accumulates per-row MoE load-balance losses (zero and
+        DCE'd for dense configs)."""
+        from jax import lax
 
-        has_moe = cfg.moe_experts > 0
+        has_moe = self.cfg.moe_experts > 0
 
         def run_stage(sp, h, b, rngs_):
             if has_moe:
@@ -508,12 +509,36 @@ class PipelinedBert:
                 stage_rngs = {"dropout": key}
             out, stage_aux = run_stage(sp, h, b, stage_rngs)
             # aux accumulates across stages in a per-row (b/m,) leaf of
-            # the activation pytree (gpipe requires the shared batch
-            # dim; zero for non-MoE, where XLA removes it)
+            # the activation pytree (the schedules require the shared
+            # batch dim; zero for non-MoE, where XLA removes it)
             aux = aux + stage_aux
             if needs_rng:
                 return (out, b, mb, aux)
             return (out, b, aux)
+
+        return stage_fn
+
+    def apply(self, variables, input_ids, attention_mask=None,
+              token_type_ids=None, deterministic: bool = True,
+              rngs=None):
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.parallel.pipeline import gpipe_spmd
+
+        cfg = self.cfg
+        needs_rng, base_key, embed_rngs = self._dropout_setup(
+            deterministic, rngs, "PipelinedBert.apply")
+
+        p = variables["params"]
+        x = self.embed.apply({"params": p["embed"]}, input_ids,
+                             token_type_ids, deterministic,
+                             rngs=embed_rngs)
+        bias = self._bias(input_ids, attention_mask)
+
+        has_moe = cfg.moe_experts > 0
+        stage_fn = self._build_stage_fn(needs_rng, base_key,
+                                        deterministic)
 
         run = gpipe_spmd(stage_fn, self.pipe_axis, self.num_microbatches)
 
@@ -577,6 +602,111 @@ class PipelinedBert:
             # over its tokens)
             return mlm, nsp, jnp.mean(aux)
         return mlm, nsp
+
+    def loss_and_grad_1f1b(self, variables, input_ids, loss_fn, targets,
+                           attention_mask=None, token_type_ids=None,
+                           deterministic: bool = True, rngs=None):
+        """Memory-bounded training step: the interleaved 1F1B schedule
+        (``parallel.onef1b_spmd``) instead of autodiff-through-GPipe —
+        live encoder activations bounded by ``pp`` stage inputs per
+        device instead of growing with the microbatch count.
+
+        ``loss_fn(mlm_logits, nsp_logits, target_mb) -> scalar`` (mean
+        over the microbatch rows); ``targets`` is any pytree of
+        per-example arrays (leading batch dim), sliced into microbatches
+        alongside the hidden states.  Returns ``(loss, grads)`` with
+        ``grads`` matching ``variables["params"]`` — embeddings get
+        their grads through the pipeline's input cotangent, the MLM/NSP
+        heads through the schedule's differentiated ``loss_params``.
+
+        Composes with ``batch_axis`` (grads are global-batch means, as
+        DDP semantics require).  Not yet wired: ``seq_axis`` /
+        ``tp_axis`` / MoE configs (use the GPipe ``apply`` path there).
+        """
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.parallel.collectives import vary_like
+        from apex_tpu.parallel.pipeline import onef1b_spmd
+
+        if self.seq_axis is not None or self.tp_axis is not None:
+            raise NotImplementedError(
+                "loss_and_grad_1f1b supports dp x pp; for seq_axis/"
+                "tp_axis compositions use the GPipe apply() path")
+        if self.cfg.moe_experts > 0:
+            raise NotImplementedError(
+                "loss_and_grad_1f1b does not yet thread MoE aux losses; "
+                "use the GPipe apply() path for MoE configs")
+
+        needs_rng, base_key, embed_rngs = self._dropout_setup(
+            deterministic, rngs, "loss_and_grad_1f1b")
+
+        p = variables["params"]
+
+        def embed_f(ep):
+            return self.embed.apply({"params": ep}, input_ids,
+                                    token_type_ids, deterministic,
+                                    rngs=embed_rngs)
+
+        x, embed_vjp = jax.vjp(embed_f, p["embed"])
+        bias = self._bias(input_ids, attention_mask)
+        stage_fn = self._build_stage_fn(needs_rng, base_key,
+                                        deterministic)
+
+        def pl_loss(y, tgt_mb, heads_p):
+            # y is the stage activation pytree; hidden is leaf 0, the
+            # bias/mb/aux side leaves are not part of the objective
+            mlm, nsp = self.heads.apply({"params": heads_p}, y[0])
+            return loss_fn(mlm, nsp, tgt_mb)
+
+        run = onef1b_spmd(stage_fn, pl_loss, self.pipe_axis,
+                          self.num_microbatches)
+
+        def run_wrapped(sp, xb, tgt, hp):
+            h, b = xb
+            aux0 = vary_like(jnp.zeros((h.shape[0],), jnp.float32), h)
+            if needs_rng:
+                mb = jnp.arange(h.shape[0], dtype=jnp.int32) // \
+                    max(1, h.shape[0] // self.num_microbatches)
+                xb_full = (h, b, mb, aux0)
+            else:
+                xb_full = (h, b, aux0)
+            loss, g, dxb, dhp = run(sp, xb_full, tgt, hp)
+            dh = dxb[0]
+            if self.batch_axis:
+                # loss and param grads are means over the data shards;
+                # each ROW's input grad lives in exactly one shard, so
+                # dh scales by 1/n instead of pmean
+                n = lax.axis_size(self.batch_axis)
+                loss = lax.pmean(loss, self.batch_axis)
+                g = jax.tree_util.tree_map(
+                    lambda a: lax.pmean(a, self.batch_axis), g)
+                dhp = jax.tree_util.tree_map(
+                    lambda a: lax.pmean(a, self.batch_axis), dhp)
+                dh = dh / n
+            return loss, g, dh, dhp
+
+        hspec = P(self.batch_axis, None)
+        bspec = P(self.batch_axis, None, None, None)
+        f = jax.shard_map(
+            run_wrapped, mesh=self.mesh,
+            in_specs=(jax.tree_util.tree_map(lambda _: P(self.pipe_axis),
+                                             p["stages"]),
+                      (hspec, bspec),
+                      jax.tree_util.tree_map(
+                          lambda _: P(self.batch_axis), targets),
+                      jax.tree_util.tree_map(lambda _: P(), p["heads"])),
+            out_specs=(P(),
+                       jax.tree_util.tree_map(
+                           lambda _: P(self.pipe_axis), p["stages"]),
+                       hspec,
+                       jax.tree_util.tree_map(lambda _: P(),
+                                              p["heads"])))
+        loss, stage_grads, dh, head_grads = f(p["stages"], (x, bias),
+                                              targets, p["heads"])
+        (embed_grads,) = embed_vjp(dh)
+        return loss, {"embed": embed_grads, "stages": stage_grads,
+                      "heads": head_grads}
 
 
 class BertForPreTraining(nn.Module):

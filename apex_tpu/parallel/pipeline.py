@@ -48,6 +48,35 @@ from apex_tpu.parallel.collectives import vary_like as _vary_like
 Pytree = Any
 
 
+def _unstack_and_microbatch(stacked_params_local: Pytree, x: Pytree,
+                            m: int, axis_name: str, s: int):
+    """Shared schedule prologue: validate the one-stage-per-device
+    stacked layout and the shared batch dim, unstack this device's
+    params, split the batch into microbatches.
+    Returns ``(params, b, xs)``."""
+    for leaf in jax.tree_util.tree_leaves(stacked_params_local):
+        # each device must hold exactly ONE stage slice; a stacked
+        # stage count that is a multiple of the axis size would
+        # otherwise silently run only every k-th stage
+        if leaf.shape[0] != 1:
+            raise ValueError(
+                f"stacked stage params have leading dim "
+                f"{leaf.shape[0]} per device; the stage count must "
+                f"equal the size of mesh axis {axis_name!r} ({s})")
+    params = jax.tree_util.tree_map(lambda a: a[0], stacked_params_local)
+    x_leaves = jax.tree_util.tree_leaves(x)
+    b = x_leaves[0].shape[0]
+    for leaf in x_leaves:
+        if leaf.shape[0] != b:
+            raise ValueError(
+                "every activation leaf must share the batch dim; got "
+                f"{[l.shape for l in x_leaves]}")
+    assert b % m == 0, f"batch {b} must divide into {m} microbatches"
+    xs = jax.tree_util.tree_map(
+        lambda a: a.reshape((m, b // m) + a.shape[1:]), x)
+    return params, b, xs
+
+
 def gpipe_spmd(stage_fn: Callable, axis_name: str,
                num_microbatches: int):
     """Per-device GPipe body, to be called INSIDE ``shard_map`` with the
@@ -63,28 +92,9 @@ def gpipe_spmd(stage_fn: Callable, axis_name: str,
     def run(stacked_params_local: Pytree, x: Pytree) -> Pytree:
         s = lax.axis_size(axis_name)
         stage = lax.axis_index(axis_name)
-        for leaf in jax.tree_util.tree_leaves(stacked_params_local):
-            # each device must hold exactly ONE stage slice; a stacked
-            # stage count that is a multiple of the axis size would
-            # otherwise silently run only every k-th stage
-            if leaf.shape[0] != 1:
-                raise ValueError(
-                    f"stacked stage params have leading dim "
-                    f"{leaf.shape[0]} per device; the stage count must "
-                    f"equal the size of mesh axis {axis_name!r} ({s})")
-        params = jax.tree_util.tree_map(lambda a: a[0],
-                                        stacked_params_local)
         m = num_microbatches
-        x_leaves = jax.tree_util.tree_leaves(x)
-        b = x_leaves[0].shape[0]
-        for leaf in x_leaves:
-            if leaf.shape[0] != b:
-                raise ValueError(
-                    "every activation leaf must share the batch dim; got "
-                    f"{[l.shape for l in x_leaves]}")
-        assert b % m == 0, f"batch {b} must divide into {m} microbatches"
-        xs = jax.tree_util.tree_map(
-            lambda a: a.reshape((m, b // m) + a.shape[1:]), x)
+        params, b, xs = _unstack_and_microbatch(
+            stacked_params_local, x, m, axis_name, s)
 
         fwd_perm = [(i, i + 1) for i in range(s - 1)]
 
@@ -148,7 +158,8 @@ def onef1b_spmd(stage_fn: Callable, loss_fn: Callable, axis_name: str,
     Because forward and backward are fused into one pass, this is a
     loss-and-grad primitive, not a differentiable layer:
 
-    ``run(stacked_params_local, x, target) -> (loss, grads, dx)``
+    ``run(stacked_params_local, x, target[, loss_params])
+    -> (loss, grads, dx[, loss_param_grads])``
 
     - ``loss_fn(y_pred_mb, target_mb) -> scalar`` (mean over the
       microbatch); the returned ``loss`` is the mean over microbatches,
@@ -158,40 +169,31 @@ def onef1b_spmd(stage_fn: Callable, loss_fn: Callable, axis_name: str,
       layout of the input params);
     - ``dx`` is d loss / d x, replicated — chain it into whatever
       produced ``x`` (embeddings, a previous parallel region) with the
-      caller's own vjp; see ``tests/distributed/test_pipeline.py``.
+      caller's own vjp; integer leaves of ``x`` (e.g. microbatch-id
+      side inputs) get zero "grads" of their own dtype;
+    - ``loss_params`` (optional): an extra pytree the loss closes
+      over with real parameters — a task head living OUTSIDE the
+      stages (``models.PipelinedBert`` puts its MLM/NSP heads here).
+      When given, ``loss_fn(y_pred_mb, target_mb, loss_params)`` and a
+      fourth output carries d loss / d loss_params (replicated).
 
     The last stage owns the loss: its backward tick rematerializes
-    ``loss_fn(stage_fn(params, x_m), target_m)`` and seeds the vjp with
-    ``1/M``, so the head can live in the last stage's params.
+    ``loss_fn(stage_fn(params, x_m), target_m[, loss_params])`` and
+    seeds the vjp with ``1/M``, so the head can live in the last
+    stage's params or in ``loss_params``.
     """
 
     def run(stacked_params_local: Pytree, x: Pytree,
-            target: Pytree):
+            target: Pytree, loss_params: Pytree = None):
         s_size = lax.axis_size(axis_name)
         stage = lax.axis_index(axis_name)
-        for leaf in jax.tree_util.tree_leaves(stacked_params_local):
-            if leaf.shape[0] != 1:
-                raise ValueError(
-                    f"stacked stage params have leading dim "
-                    f"{leaf.shape[0]} per device; the stage count must "
-                    f"equal the size of mesh axis {axis_name!r} "
-                    f"({s_size})")
-        params = jax.tree_util.tree_map(lambda a: a[0],
-                                        stacked_params_local)
         m = num_microbatches
-        x_leaves = jax.tree_util.tree_leaves(x)
-        b = x_leaves[0].shape[0]
-        for leaf in x_leaves:
-            if leaf.shape[0] != b:
-                raise ValueError(
-                    "every activation leaf must share the batch dim; got "
-                    f"{[l.shape for l in x_leaves]}")
-        assert b % m == 0, f"batch {b} must divide into {m} microbatches"
+        params, b, xs = _unstack_and_microbatch(
+            stacked_params_local, x, m, axis_name, s_size)
         mb = b // m
-        xs = jax.tree_util.tree_map(
-            lambda a: a.reshape((m, mb) + a.shape[1:]), x)
         tgts = jax.tree_util.tree_map(
             lambda a: a.reshape((m, mb) + a.shape[1:]), target)
+        x_leaves = jax.tree_util.tree_leaves(x)
 
         fwd_perm = [(i, i + 1) for i in range(s_size - 1)]
         bwd_perm = [(i + 1, i) for i in range(s_size - 1)]
@@ -204,6 +206,16 @@ def onef1b_spmd(stage_fn: Callable, loss_fn: Callable, axis_name: str,
             return _vary_like(a, *refs, extra_axes=(axis_name,))
 
         x_ref = x_leaves[0]
+        if loss_params is not None:
+            # make the loss params pipe-VARYING before any vjp sees
+            # them: a pipe-invariant primal would make the transpose
+            # insert a psum for its cotangent INSIDE the last-stage-only
+            # cond branch — a collective only one device executes, which
+            # deadlocks the others at the tick ppermute. Varying primal
+            # -> varying cotangent; the reduction instead happens at the
+            # uniform psum after the scan.
+            loss_params = jax.tree_util.tree_map(
+                lambda a: _v(a, x_ref), loss_params)
         carry0 = dict(
             x_inbox=jax.tree_util.tree_map(
                 lambda a: _v(jnp.zeros_like(a[0]), a), xs),
@@ -218,6 +230,28 @@ def onef1b_spmd(stage_fn: Callable, loss_fn: Callable, axis_name: str,
                 lambda a: _v(jnp.zeros_like(a), a), xs),
             lacc=_v(jnp.zeros((), jnp.float32), x_ref),
         )
+        if loss_params is not None:
+            carry0["lpacc"] = jax.tree_util.tree_map(
+                lambda a: _v(jnp.zeros_like(a), a, x_ref), loss_params)
+
+        import numpy as _np
+        from jax import dtypes as _jdtypes
+
+        def _to_cotangents(tree):
+            """vjp demands float0 cotangents for integer-dtype primal
+            leaves (e.g. a microbatch-id side input riding the
+            activation pytree); the carries keep primal dtypes, so
+            convert right at the vjp boundary."""
+            return jax.tree_util.tree_map(
+                lambda ct: _np.zeros(ct.shape, _jdtypes.float0)
+                if not jnp.issubdtype(ct.dtype, jnp.inexact) else ct,
+                tree)
+
+        def _from_cotangents(primal_tree, ct_tree):
+            return jax.tree_util.tree_map(
+                lambda p_l, ct: _v(jnp.zeros(p_l.shape, p_l.dtype), p_l)
+                if ct.dtype == _jdtypes.float0 else ct,
+                primal_tree, ct_tree)
 
         def tick(carry, t):
             mf = (t - stage) // 2
@@ -253,29 +287,57 @@ def onef1b_spmd(stage_fn: Callable, loss_fn: Callable, axis_name: str,
                     lambda r: lax.dynamic_index_in_dim(
                         r, slot, 0, keepdims=False), carry["ring"])
 
+                def _lp_norm(dlp):
+                    # vjp can return SOME head-grad leaves without the
+                    # varying type the other cond branch carries (the
+                    # grad path for e.g. a bias may reduce away every
+                    # varying operand); pvary all leaves to one type
+                    if dlp is None:
+                        return None
+                    return jax.tree_util.tree_map(
+                        lambda g: _v(g, x_ref), dlp)
+
+                def _lp_zero():
+                    if loss_params is None:
+                        return None
+                    return _lp_norm(jax.tree_util.tree_map(
+                        jnp.zeros_like, loss_params))
+
                 def mid(_):
                     _, vjp = jax.vjp(stage_fn, params, x_saved)
-                    dp, dx = vjp(carry["g_inbox"])
-                    return dp, dx, _v(jnp.zeros((), jnp.float32),
-                                      carry["lacc"])
+                    dp, dx = vjp(_to_cotangents(carry["g_inbox"]))
+                    dx = _from_cotangents(x_saved, dx)
+                    return (dp, dx, _v(jnp.zeros((), jnp.float32),
+                                       carry["lacc"]), _lp_zero())
 
                 def tail(_):
                     tgt_m = jax.tree_util.tree_map(
                         lambda a: a[mb_c], tgts)
 
-                    def f(p, xi):
-                        return loss_fn(stage_fn(p, xi), tgt_m)
+                    if loss_params is None:
+                        def f(p, xi):
+                            return loss_fn(stage_fn(p, xi), tgt_m)
 
-                    lval, vjp = jax.vjp(f, params, x_saved)
+                        lval, vjp = jax.vjp(f, params, x_saved)
+                    else:
+                        def f(p, xi, lp):
+                            return loss_fn(stage_fn(p, xi), tgt_m, lp)
+
+                        lval, vjp = jax.vjp(f, params, x_saved,
+                                            loss_params)
                     seed = _vary_like(jnp.asarray(1.0 / m,
                                                   dtype=lval.dtype),
                                       lval)
-                    dp, dx = vjp(seed)
+                    cts = vjp(seed)
+                    dp, dx = cts[0], _from_cotangents(x_saved, cts[1])
+                    dlp = (_lp_norm(cts[2]) if loss_params is not None
+                           else None)
                     lval = _v(lval.astype(jnp.float32) / m,
                               carry["lacc"])
-                    return dp, dx, lval
+                    return dp, dx, lval, dlp
 
-                dp, dx, lval = lax.cond(stage == last, tail, mid, None)
+                dp, dx, lval, dlp = lax.cond(stage == last, tail, mid,
+                                             None)
                 gacc = jax.tree_util.tree_map(
                     lambda acc, g: acc + jnp.where(bwd_valid, g, 0),
                     carry["gacc"], dp)
@@ -287,6 +349,10 @@ def onef1b_spmd(stage_fn: Callable, loss_fn: Callable, axis_name: str,
                         buf),
                     carry["dxbuf"], dx)
                 out = dict(carry, gacc=gacc, lacc=lacc, dxbuf=dxbuf)
+                if loss_params is not None:
+                    out["lpacc"] = jax.tree_util.tree_map(
+                        lambda acc, g: acc + jnp.where(bwd_valid, g, 0),
+                        carry["lpacc"], dlp)
                 y_zero = jax.tree_util.tree_map(
                     lambda a: _v(jnp.zeros_like(a), a),
                     carry["x_inbox"])
@@ -319,7 +385,14 @@ def onef1b_spmd(stage_fn: Callable, loss_fn: Callable, axis_name: str,
                 jnp.where(stage == 0, buf, jnp.zeros_like(buf)),
                 axis_name).reshape((b,) + buf.shape[2:]),
             carry["dxbuf"])
-        return loss, grads, dx
+        if loss_params is None:
+            return loss, grads, dx
+        lp_grads = jax.tree_util.tree_map(
+            lambda acc: lax.psum(
+                jnp.where(stage == last, acc, jnp.zeros_like(acc)),
+                axis_name),
+            carry["lpacc"])
+        return loss, grads, dx, lp_grads
 
     return run
 
@@ -327,23 +400,32 @@ def onef1b_spmd(stage_fn: Callable, loss_fn: Callable, axis_name: str,
 def onef1b_loss_and_grad(mesh: Mesh, axis_name: str, stage_fn: Callable,
                          loss_fn: Callable, stacked_params: Pytree,
                          x: Pytree, target: Pytree,
-                         num_microbatches: int):
+                         num_microbatches: int,
+                         loss_params: Pytree = None):
     """One-call 1F1B: shard ``stacked_params`` over ``axis_name``, run
-    the interleaved schedule, return ``(loss, grads, dx)`` with
-    ``grads`` stacked ``(S, ...)`` like the input params and ``loss`` /
-    ``dx`` replicated.  This is the memory-bounded alternative to
-    ``jax.grad`` over :func:`pipeline_apply`; see :func:`onef1b_spmd`
-    for the contract."""
+    the interleaved schedule, return ``(loss, grads, dx)`` — plus
+    ``loss_param_grads`` when ``loss_params`` is given — with ``grads``
+    stacked ``(S, ...)`` like the input params and everything else
+    replicated.  This is the memory-bounded alternative to ``jax.grad``
+    over :func:`pipeline_apply`; see :func:`onef1b_spmd` for the
+    contract."""
     run = onef1b_spmd(stage_fn, loss_fn, axis_name, num_microbatches)
     p_spec = jax.tree_util.tree_map(lambda _: P(axis_name),
                                     stacked_params)
     r_spec = jax.tree_util.tree_map(lambda _: P(), x)
+    t_spec = jax.tree_util.tree_map(lambda _: P(), target)
+    if loss_params is None:
+        f = jax.shard_map(
+            run, mesh=mesh,
+            in_specs=(p_spec, r_spec, t_spec),
+            out_specs=(P(), p_spec, r_spec))
+        return f(stacked_params, x, target)
+    lp_spec = jax.tree_util.tree_map(lambda _: P(), loss_params)
     f = jax.shard_map(
         run, mesh=mesh,
-        in_specs=(p_spec, r_spec,
-                  jax.tree_util.tree_map(lambda _: P(), target)),
-        out_specs=(P(), p_spec, r_spec))
-    return f(stacked_params, x, target)
+        in_specs=(p_spec, r_spec, t_spec, lp_spec),
+        out_specs=(P(), p_spec, r_spec, lp_spec))
+    return f(stacked_params, x, target, loss_params)
 
 
 def pipeline_apply(mesh: Mesh, axis_name: str, stage_fn: Callable,

@@ -394,7 +394,9 @@ def _cached_ceiling_fallback(result):
     for rec in reversed(lines):
         if (rec.get("section") == "o3_ceiling" and "error" not in rec
                 and rec.get("batch") == result.get("batch")
-                and rec.get("stem") == result.get("stem")):
+                and rec.get("stem") == result.get("stem")
+                and rec.get("adam_layout", "flat") ==
+                result.get("adam_layout", "flat")):
             ceiling = rec["images_per_sec"]
             result["vs_baseline"] = round(result["value"] / ceiling, 3)
             result["vs_baseline_source"] = (
@@ -516,7 +518,10 @@ def main():
     if on_tpu and result["vs_baseline"] == 0.0 and result["value"] > 0:
         _cached_ceiling_fallback(result)
 
-    extras = result.get("extras", {})
+    # attach the dict NOW: if the watchdog fires mid-section (the tree
+    # layout A/B below is a known wedger), already-measured extras must
+    # ride the emitted payload
+    extras = result.setdefault("extras", {})
     if on_tpu and time.perf_counter() - START < BUDGET_S:
         try:
             extras["flash_attention"] = bench_flash_attention()
@@ -553,8 +558,8 @@ def main():
                 "flat": result["value"], "tree": round(ips_t, 1)}
         except Exception as e:
             _note("adam_layout", e)
-    if extras:
-        result["extras"] = extras
+    if not extras:
+        result.pop("extras", None)
     emit()
 
 

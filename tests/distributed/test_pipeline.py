@@ -704,3 +704,154 @@ def test_onef1b_dp_x_pp_training():
         losses.append(float(loss))
     assert losses[-1] < 0.7 * losses[0], losses
     assert params["w"].sharding.spec[0] == "pipe"
+
+
+def _pretrain_loss(mlm, nsp, tgt):
+    """Toy pretraining objective over both heads (mean over rows)."""
+    oh = jax.nn.one_hot(tgt["mlm"], mlm.shape[-1])
+    l1 = -jnp.mean(jnp.sum(jax.nn.log_softmax(mlm) * oh, -1))
+    oh2 = jax.nn.one_hot(tgt["nsp"], 2)
+    l2 = -jnp.mean(jnp.sum(jax.nn.log_softmax(nsp) * oh2, -1))
+    return l1 + l2
+
+
+def _bert_cfg(dropout=0.0):
+    from apex_tpu import models
+    return models.BertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=4,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=16, hidden_dropout_prob=dropout,
+        attention_probs_dropout_prob=0.0)
+
+
+def _bert_batch(b=4, s=16):
+    ids = jax.random.randint(jax.random.PRNGKey(0), (b, s), 0, 64)
+    mask = jnp.asarray(np.pad(np.ones((b, s - 4)), ((0, 0), (0, 4))),
+                       jnp.int32)
+    tgt = {"mlm": jax.random.randint(jax.random.PRNGKey(2), (b, s), 0, 64),
+           "nsp": jax.random.randint(jax.random.PRNGKey(3), (b,), 0, 2)}
+    return ids, mask, tgt
+
+
+def test_bert_1f1b_matches_monolithic_grads():
+    """loss_and_grad_1f1b == jax.value_and_grad of the monolithic
+    BertForPreTraining with the same weights: loss, embedding grads
+    (through the pipeline input cotangent), stage grads, head grads
+    (through the schedule's differentiated loss_params)."""
+    from apex_tpu import models
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("pipe",))
+    cfg = _bert_cfg()
+    pb = models.PipelinedBert(cfg, mesh, pp=4, num_microbatches=2)
+    ids, mask, tgt = _bert_batch()
+    variables = pb.init(jax.random.PRNGKey(1), ids, mask)
+
+    loss, grads = jax.jit(
+        lambda v, i, m, t: pb.loss_and_grad_1f1b(
+            v, i, _pretrain_loss, t, attention_mask=m))(
+        variables, ids, mask, tgt)
+
+    seq_params = _monolithic_params(variables, 4,
+                                    cfg.num_hidden_layers // 4)
+
+    def mono_loss(p):
+        mlm, nsp = models.BertForPreTraining(cfg).apply(
+            {"params": p}, ids, mask, deterministic=True)
+        return _pretrain_loss(mlm, nsp, tgt)
+
+    want_l, want_g = jax.value_and_grad(mono_loss)(seq_params)
+    np.testing.assert_allclose(float(loss), float(want_l), rtol=1e-5)
+    # embeddings
+    for k in grads["embed"]:
+        for a, b in zip(jax.tree.leaves(grads["embed"][k]),
+                        jax.tree.leaves(want_g["encoder"][k])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=1e-5)
+    # stage layers: stacked (pp, ...) vs encoder/layer_i
+    for li in range(cfg.num_hidden_layers):
+        got_li = jax.tree.map(lambda a: a[li],
+                              grads["stages"]["layer_0"])
+        for a, b in zip(jax.tree.leaves(got_li),
+                        jax.tree.leaves(want_g["encoder"][f"layer_{li}"])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=1e-5)
+    # heads
+    for k in grads["heads"]:
+        for a, b in zip(jax.tree.leaves(grads["heads"][k]),
+                        jax.tree.leaves(want_g[k])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=1e-5)
+
+
+def test_bert_1f1b_dp_x_pp_matches_monolithic():
+    """(data, pipe) composition: global-batch mean loss and grads equal
+    the monolithic single-program autodiff (DDP semantics)."""
+    from apex_tpu import models
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4),
+                ("data", "pipe"))
+    cfg = _bert_cfg()
+    pb = models.PipelinedBert(cfg, mesh, pp=4, num_microbatches=2,
+                              batch_axis="data")
+    ids, mask, tgt = _bert_batch()
+    variables = pb.init(jax.random.PRNGKey(1), ids, mask)
+    loss, grads = jax.jit(
+        lambda v, i, m, t: pb.loss_and_grad_1f1b(
+            v, i, _pretrain_loss, t, attention_mask=m))(
+        variables, ids, mask, tgt)
+
+    seq_params = _monolithic_params(variables, 4,
+                                    cfg.num_hidden_layers // 4)
+
+    def mono_loss(p):
+        mlm, nsp = models.BertForPreTraining(cfg).apply(
+            {"params": p}, ids, mask, deterministic=True)
+        return _pretrain_loss(mlm, nsp, tgt)
+
+    want_l, want_g = jax.value_and_grad(mono_loss)(seq_params)
+    np.testing.assert_allclose(float(loss), float(want_l), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(grads["heads"]),
+                    jax.tree.leaves({k: want_g[k]
+                                     for k in grads["heads"]})):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-5)
+    for k in grads["embed"]:
+        for a, b in zip(jax.tree.leaves(grads["embed"][k]),
+                        jax.tree.leaves(want_g["encoder"][k])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=1e-5)
+
+
+def test_bert_1f1b_dropout_matches_gpipe_autodiff():
+    """With live dropout, 1F1B's rematerialized backward draws the SAME
+    per-(microbatch, stage) keys as the GPipe apply path, so grads must
+    match autodiff through apply exactly."""
+    from apex_tpu import models
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("pipe",))
+    cfg = _bert_cfg(dropout=0.1)
+    pb = models.PipelinedBert(cfg, mesh, pp=4, num_microbatches=2)
+    ids, mask, tgt = _bert_batch()
+    variables = pb.init(jax.random.PRNGKey(1), ids, mask)
+    key = jax.random.PRNGKey(7)
+
+    loss, grads = jax.jit(
+        lambda v, i, m, t: pb.loss_and_grad_1f1b(
+            v, i, _pretrain_loss, t, attention_mask=m,
+            deterministic=False, rngs={"dropout": key}))(
+        variables, ids, mask, tgt)
+
+    def gpipe_loss(p):
+        mlm, nsp = pb.apply({"params": p}, ids, mask,
+                            deterministic=False,
+                            rngs={"dropout": key})
+        return _pretrain_loss(mlm, nsp, tgt)
+
+    want_l, want_g = jax.jit(jax.value_and_grad(gpipe_loss))(
+        variables["params"])
+    np.testing.assert_allclose(float(loss), float(want_l), rtol=1e-5)
+    for name in ("embed", "stages", "heads"):
+        for a, b in zip(jax.tree.leaves(grads[name]),
+                        jax.tree.leaves(want_g[name])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=1e-5)

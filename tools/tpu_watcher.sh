@@ -7,6 +7,10 @@
 # watcher survives restarts. Log: /tmp/tpu_watcher.log
 cd "$(dirname "$0")/.."
 LOG=/tmp/tpu_watcher.log
+# fresh attempt budget per watcher launch: the give-up counters below
+# read "running X" lines from this log, and stale lines from a previous
+# measurement round would exhaust retries before anything runs
+: > "$LOG"
 
 sec_done() {  # recorded success, or given up after 4 live attempts
   grep "\"section\": \"$1\"" BENCH_FOLLOWUP.jsonl 2>/dev/null | grep -qv '"error"' && return 0
@@ -18,7 +22,7 @@ pending() {
     sec_done "$s" || { echo "$s"; return; }
   done
   kp=$(grep -c 'running kernel_parity$' "$LOG" 2>/dev/null)
-  if ! grep -q '"pass"' KERNEL_PARITY_r03.json 2>/dev/null \
+  if ! grep -q '"all_pass": true' KERNEL_PARITY_r03.json 2>/dev/null \
       && [ "${kp:-0}" -lt 4 ]; then
     echo kernel_parity; return
   fi
