@@ -414,18 +414,16 @@ def onef1b_loss_and_grad(mesh: Mesh, axis_name: str, stage_fn: Callable,
                                     stacked_params)
     r_spec = jax.tree_util.tree_map(lambda _: P(), x)
     t_spec = jax.tree_util.tree_map(lambda _: P(), target)
-    if loss_params is None:
-        f = jax.shard_map(
-            run, mesh=mesh,
-            in_specs=(p_spec, r_spec, t_spec),
-            out_specs=(P(), p_spec, r_spec))
-        return f(stacked_params, x, target)
-    lp_spec = jax.tree_util.tree_map(lambda _: P(), loss_params)
-    f = jax.shard_map(
-        run, mesh=mesh,
-        in_specs=(p_spec, r_spec, t_spec, lp_spec),
-        out_specs=(P(), p_spec, r_spec, lp_spec))
-    return f(stacked_params, x, target, loss_params)
+    in_specs, out_specs = (p_spec, r_spec, t_spec), (P(), p_spec, r_spec)
+    args = (stacked_params, x, target)
+    if loss_params is not None:
+        lp_spec = jax.tree_util.tree_map(lambda _: P(), loss_params)
+        in_specs += (lp_spec,)
+        out_specs += (lp_spec,)
+        args += (loss_params,)
+    f = jax.shard_map(run, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs)
+    return f(*args)
 
 
 def pipeline_apply(mesh: Mesh, axis_name: str, stage_fn: Callable,

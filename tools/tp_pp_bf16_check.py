@@ -1,0 +1,91 @@
+"""On-hardware recheck of the tp x pp half-precision limitation.
+
+``models.PipelinedBert`` documents a KNOWN LIMITATION: amp O2/O3
+compute inside the partial-manual shard_map region (tp_axis) crashes
+THIS jax build's XLA **CPU** backend ("Invalid binary instruction
+opcode copy", hlo_instruction.cc), so the dp x tp x pp tier is pinned
+fp32. A single real chip on a (1, 1, 1) mesh compiles the bf16
+partial-manual program through the TPU backend. CAVEAT on evidence
+strength: the CPU backend also passes at size-1 axes (verified
+2026-07-31) — the crash needs a real size-2 model axis, which one chip
+cannot form — so a pass here shows the TPU compiler handles the bf16
+partial-manual lowering, not that the size-2 case is fixed; the full
+answer needs a multi-chip window.
+
+Appends one JSON line to ``BENCH_FOLLOWUP.jsonl``
+(section ``tp_pp_bf16``): {"ok": true} when the bf16 program compiles
+and runs, else the error. Run at a live tunnel window (the watcher
+queues it after kernel parity).
+"""
+
+import json
+import os
+import sys
+import time
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "BENCH_FOLLOWUP.jsonl")
+
+
+def log(payload):
+    line = {"section": "tp_pp_bf16", **payload}
+    with open(OUT, "a") as f:
+        f.write(json.dumps(line) + "\n")
+    print(json.dumps(line), flush=True)
+
+
+def main():
+    import bench
+
+    ok, err = bench._probe_tpu_subprocess()
+    if not ok:
+        log({"ok": False, "error": f"tpu unavailable: {err}"})
+        return
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from apex_tpu import amp, models
+
+    if jax.devices()[0].platform != "tpu":
+        log({"ok": False, "error": "backend is not tpu"})
+        return
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1, 1),
+                ("data", "model", "pipe"))
+    cfg = models.BertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=16, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+    pb = models.PipelinedBert(cfg, mesh, pp=1, num_microbatches=2,
+                              batch_axis="data", tp_axis="model")
+    model = amp.initialize(pb, None, opt_level="O2", verbosity=0)
+    ids = jnp.ones((2, 16), jnp.int32)
+    variables = pb.shard_variables(pb.init(jax.random.PRNGKey(0), ids))
+    t0 = time.perf_counter()
+    with mesh:
+        mlm, nsp = jax.jit(lambda v, i: model.apply(v, i))(variables, ids)
+    # axon block_until_ready is a no-op; force a sync via host fetch
+    finite = bool(np.isfinite(np.asarray(mlm, np.float32)).all())
+    log({"ok": True, "bf16_partial_manual_compiles": True,
+         "outputs_finite": finite,
+         "compile_plus_step_s": round(time.perf_counter() - t0, 1)})
+
+
+if __name__ == "__main__":
+    def fire():
+        time.sleep(1200)
+        log({"ok": False, "error": "wedged past 1200s"})
+        os._exit(0)
+
+    threading.Thread(target=fire, daemon=True).start()
+    try:
+        main()
+    except BaseException as e:
+        log({"ok": False, "error": f"{type(e).__name__}: {e}"})

@@ -26,6 +26,7 @@ pending() {
       && [ "${kp:-0}" -lt 4 ]; then
     echo kernel_parity; return
   fi
+  sec_done tp_pp_bf16 || { echo tp_pp_bf16; return; }
   echo none
 }
 
@@ -43,6 +44,7 @@ while true; do
       fused_adam)      timeout 1800 python tools/bench_followup.py --sections adam >> "$LOG" 2>&1 ;;
       moe_dispatch)    timeout 1800 python tools/bench_followup.py --sections moe  >> "$LOG" 2>&1 ;;
       kernel_parity)   timeout 1800 python tools/kernel_parity.py > KERNEL_PARITY_r03.json 2>>"$LOG" ;;
+      tp_pp_bf16)      timeout 1500 python tools/tp_pp_bf16_check.py >> "$LOG" 2>&1 ;;
     esac
     echo "$(date +%H:%M:%S) $next attempt finished" >> "$LOG"
     sleep 10   # tiny gap, then loop re-probes before the next item
