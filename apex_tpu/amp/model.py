@@ -237,12 +237,14 @@ class AmpModel:
     def _apply_context(self):
         """Interceptor scope for ``apply``: active only when compute casting
         is on AND some params are deliberately kept fp32 (so there is a
-        dtype seam to mend)."""
-        import flax.linen as nn
-
+        dtype seam to mend).  Installed regardless of whether the wrapped
+        object is itself an ``nn.Module``: pipeline wrappers like
+        ``models.PipelinedBert`` are plain classes whose INNER applies are
+        flax modules, and ``nn.intercept_methods`` is a global trace-time
+        context that reaches them; for bare apply_fns with no flax calls
+        it is a no-op."""
         if (self._compute_cast_needed() and self.keep_fp32_patterns
-                and not _amp_state._amp_state.casts_disabled
-                and isinstance(self.module, nn.Module)):
+                and not _amp_state._amp_state.casts_disabled):
             return self._norm_output_recast()
         return contextlib.nullcontext()
 
@@ -262,3 +264,20 @@ class AmpModel:
 
     def __call__(self, variables: Pytree, *args, **kwargs):
         return self.apply(variables, *args, **kwargs)
+
+    def loss_and_grad_1f1b(self, variables: Pytree, *args, **kwargs):
+        """amp-composed passthrough to the wrapped model's 1F1B
+        loss-and-grad (``models.PipelinedBert.loss_and_grad_1f1b``):
+        params cast to the compute layout and the norm-seam interceptor
+        active around the schedule's rematerialized applies, so grads
+        come back in the half compute dtype — exactly how amp grads
+        arrive on the autodiff path — for ``AmpOptimizer.step`` to
+        unscale onto the fp32 masters."""
+        if not hasattr(self.module, "loss_and_grad_1f1b"):
+            raise AttributeError(
+                f"{type(self.module).__name__} has no loss_and_grad_1f1b "
+                "(only pipeline models with the 1F1B schedule do)")
+        variables = self.compute_variables(variables)
+        with self._apply_context():
+            return self.module.loss_and_grad_1f1b(variables, *args,
+                                                  **kwargs)

@@ -460,6 +460,28 @@ class PipelinedBert:
         return True, base_key, {
             "dropout": jax.random.fold_in(base_key, 2 ** 20)}
 
+    def _schedule_input(self, h, b, needs_rng):
+        """The ``(hidden, bias[, mb_ids], aux0)`` activation tuple both
+        schedules feed their stage_fn.  The microbatch-id row assembly
+        and the vma-typed aux zero init must stay IDENTICAL between the
+        GPipe and 1F1B paths, or the dropout keys / pytree layout drift
+        (``test_bert_1f1b_dropout_matches_gpipe_autodiff`` pins this).
+
+        - aux inherits h's varying axes (the stage adds h-derived
+          values), so its zero init must carry the same vma type or the
+          scan carry types mismatch;
+        - mb ids: one id per row, assigned the way the schedules split
+          the (local) batch — contiguous b_local/m groups.
+        """
+        from apex_tpu.parallel.collectives import vary_like
+
+        aux0 = vary_like(jnp.zeros((h.shape[0],), jnp.float32), h)
+        if needs_rng:
+            mb = jnp.arange(h.shape[0], dtype=jnp.int32) // \
+                max(1, h.shape[0] // self.num_microbatches)
+            return (h, b, mb, aux0)
+        return (h, b, aux0)
+
     def _build_stage_fn(self, needs_rng, base_key, deterministic):
         """The per-stage body both schedules share (GPipe ``apply`` and
         :meth:`loss_and_grad_1f1b`).  Activation pytree:
@@ -543,21 +565,8 @@ class PipelinedBert:
         run = gpipe_spmd(stage_fn, self.pipe_axis, self.num_microbatches)
 
         def run_wrapped(sp, xb):
-            from apex_tpu.parallel.collectives import vary_like
-
-            h, b = xb
-            # the accumulated aux inherits h's varying axes (the stage
-            # adds h-derived values), so its zero init must carry the
-            # same vma type or the scan carry types mismatch
-            aux0 = vary_like(jnp.zeros((h.shape[0],), jnp.float32), h)
-            if needs_rng:
-                # local microbatch id per row, assigned the way gpipe
-                # splits the (local) batch: contiguous b_local/m groups
-                mb = jnp.arange(h.shape[0], dtype=jnp.int32) // \
-                    max(1, h.shape[0] // self.num_microbatches)
-                out, b2, _, aux = run(sp, (h, b, mb, aux0))
-            else:
-                out, b2, aux = run(sp, (h, b, aux0))
+            outs = run(sp, self._schedule_input(*xb, needs_rng))
+            out, aux = outs[0], outs[-1]
             if self.seq_axis is not None:
                 # each sequence shard routes only its own tokens, so its
                 # aux is a LOCAL estimate; the mean over shards is the
@@ -626,7 +635,6 @@ class PipelinedBert:
         from jax import lax
         from jax.sharding import PartitionSpec as P
 
-        from apex_tpu.parallel.collectives import vary_like
         from apex_tpu.parallel.pipeline import onef1b_spmd
 
         if self.seq_axis is not None or self.tp_axis is not None:
@@ -663,15 +671,8 @@ class PipelinedBert:
                           self.num_microbatches)
 
         def run_wrapped(sp, xb, tgt, hp):
-            h, b = xb
-            aux0 = vary_like(jnp.zeros((h.shape[0],), jnp.float32), h)
-            if needs_rng:
-                mb = jnp.arange(h.shape[0], dtype=jnp.int32) // \
-                    max(1, h.shape[0] // self.num_microbatches)
-                xb_full = (h, b, mb, aux0)
-            else:
-                xb_full = (h, b, aux0)
-            loss, g, dxb, dhp = run(sp, xb_full, tgt, hp)
+            loss, g, dxb, dhp = run(
+                sp, self._schedule_input(*xb, needs_rng), tgt, hp)
             dh = dxb[0]
             if self.batch_axis:
                 # loss and param grads are means over the data shards;

@@ -66,6 +66,13 @@ def parse_args():
                    help="pipeline the encoder over S stages on a "
                    "(data, pipe) mesh (models.PipelinedBert / GPipe); "
                    "S must divide the device count and the layer count")
+    p.add_argument("--pp-schedule", default="gpipe",
+                   choices=("gpipe", "1f1b"),
+                   help="pipeline schedule under --pp: gpipe (autodiff "
+                        "through the scan) or 1f1b (interleaved "
+                        "fwd/bwd, live activations bounded by the stage "
+                        "count; needs --grad-accum 1, no --moe / "
+                        "--ring-attention)")
     p.add_argument("--pp-microbatches", type=int, default=4, metavar="M",
                    help="GPipe microbatches per step under --pp "
                    "(bubble fraction (S-1)/(M+S-1))")
@@ -134,6 +141,14 @@ def main():
         mesh = Mesh(np.array(devices), ("data",))
     if args.b % dp:
         raise SystemExit(f"batch {args.b} must divide by dp={dp}")
+    onef1b = pp and args.pp_schedule == "1f1b"
+    if args.pp_schedule == "1f1b" and not pp:
+        raise SystemExit("--pp-schedule 1f1b needs --pp S")
+    if onef1b and (sp or args.moe or args.grad_accum > 1):
+        raise SystemExit(
+            "--pp-schedule 1f1b composes with dp only for now: drop "
+            "--ring-attention/--moe and use --grad-accum 1 (the "
+            "schedule already microbatches)")
     maybe_print(f"devices: {n_dev} (dp={dp}, sp={sp or 1}, pp={pp or 1}), "
                 f"config: {args.config}", rank0=True)
 
@@ -250,6 +265,38 @@ def main():
         (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         params, opt_state = optimizer.step(params, grads, opt_state)
         return params, opt_state, loss
+
+    if onef1b:
+        n_mb = args.pp_microbatches
+
+        @jax.jit
+        def train_step(params, opt_state, ids, labels, weights, nsp):
+            """1F1B variant: the interleaved schedule returns scaled
+            grads directly (loss scaling rides the per-microbatch loss
+            via ``amp.scale``); ``optimizer.step`` unscales them onto
+            the masters exactly as on the autodiff path. The MLM term
+            uses the GLOBAL mask count, so each microbatch loss carries
+            a ``n_mb * dp`` factor that cancels the schedule's
+            mean-over-microbatches and the data-axis pmean."""
+            denom = jnp.maximum(jnp.sum(weights), 1.0)
+            scale0 = optimizer.loss_scale(opt_state)
+
+            def mb_loss(mlm_logits, nsp_logits, tgt):
+                mlm_losses = \
+                    optax.softmax_cross_entropy_with_integer_labels(
+                        mlm_logits, tgt["labels"])
+                mlm = jnp.sum(mlm_losses * tgt["weights"]) \
+                    * (n_mb * dp) / denom
+                nsp_loss = \
+                    optax.softmax_cross_entropy_with_integer_labels(
+                        nsp_logits, tgt["nsp"]).mean()
+                return amp.scale(mlm + nsp_loss, opt_state)
+
+            targets = {"labels": labels, "weights": weights, "nsp": nsp}
+            loss_s, grads = model.loss_and_grad_1f1b(
+                {"params": params}, ids, mb_loss, targets)
+            params, opt_state = optimizer.step(params, grads, opt_state)
+            return params, opt_state, loss_s / scale0
 
     accum = args.grad_accum
     if accum < 1:

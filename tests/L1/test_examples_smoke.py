@@ -83,3 +83,12 @@ def test_bert_tiny_ring_attention():
                "--seq-len", "32", "--steps", "3", "--ring-attention", "2",
                ndev=8)
     assert "loss" in out.lower()
+
+
+def test_bert_tiny_pp_1f1b():
+    """dp x pp with the interleaved memory-bounded schedule: the manual
+    loss-and-grad path under amp O2 + FusedLAMB + dynamic scaling."""
+    out = _run("examples/bert/main_amp.py", "--config", "tiny", "--b", "16",
+               "--seq-len", "32", "--steps", "3", "--pp", "2",
+               "--pp-microbatches", "2", "--pp-schedule", "1f1b", ndev=8)
+    assert "loss" in out.lower()
