@@ -209,6 +209,65 @@ def measure(opt_level, batch, image_size, iters, trace_dir=None,
     return iters * batch / dt, dt / iters * 1e3, flops
 
 
+def bench_bert(iters=10, batch=32, seq_len=128, config="base"):
+    """Second model family on hardware: BERT pretraining train-step
+    throughput (seq/s), amp O2 + FusedLAMB — the reference's other
+    flagship config (its LAMB kernels exist FOR downstream BERT,
+    SURVEY §2.2 amp_C note). Returns seq/s, step ms, and step TFLOPs
+    from XLA cost analysis."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from apex_tpu import amp, models, optimizers
+
+    cfg = {"base": models.BertConfig(),
+           "tiny": models.BertConfig(
+               vocab_size=1024, hidden_size=128, num_hidden_layers=2,
+               num_attention_heads=2, intermediate_size=512,
+               max_position_embeddings=seq_len)}[config]
+    model, optimizer = amp.initialize(
+        models.BertForPreTraining(cfg),
+        optimizers.FusedLAMB(
+            lr=1e-4, max_grad_norm=1.0,
+            param_groups=[{"match": r"(bias|_ln)", "weight_decay": 0.0}],
+            exclude_from_layer_adaptation=lambda path: any(
+                "bias" in str(k) or "_ln" in str(k) for k in path)),
+        opt_level="O2", verbosity=0)
+    ids = jnp.ones((batch, seq_len), jnp.int32)
+    labels = jnp.zeros((batch, seq_len), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    opt_state = optimizer.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, ids, labels):
+        def loss_fn(p):
+            mlm, nsp = model.apply({"params": p}, ids,
+                                   deterministic=True)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                mlm.astype(jnp.float32), labels).mean()
+            with amp.scale_loss(loss, opt_state) as scaled:
+                return scaled, loss
+        grads, loss = jax.grad(loss_fn, has_aux=True)(params)
+        params, opt_state = optimizer.step(params, grads, opt_state)
+        return params, opt_state, loss
+
+    compiled = train_step.lower(params, opt_state, ids, labels).compile()
+    flops = _flops_of(compiled)
+    params, opt_state, loss = compiled(params, opt_state, ids, labels)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = compiled(params, opt_state, ids, labels)
+    float(loss)
+    dt = time.perf_counter() - t0
+    out = {"config": config, "batch": batch, "seq_len": seq_len,
+           "seq_per_sec": round(iters * batch / dt, 1),
+           "step_time_ms": round(dt / iters * 1e3, 2)}
+    if flops:
+        out["step_tflops"] = round(flops / 1e12, 3)
+    return out
+
+
 def bench_flash_attention(iters=5):
     """Pallas flash-attention fwd+bwd vs jnp oracle (TPU only)."""
     import jax

@@ -8,7 +8,7 @@ individually fenced, and appends every completed section as its own
 JSON line to ``BENCH_FOLLOWUP.jsonl`` IMMEDIATELY — a mid-run wedge
 loses only the section in flight, never completed ones.
 
-Usage: python tools/bench_followup.py [--sections o3,flash,adam,moe]
+Usage: python tools/bench_followup.py [--sections o3,flash,adam,moe,bert]
 """
 
 import argparse
@@ -35,8 +35,8 @@ def log(section, payload):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--sections", default="o3,flash,adam,moe",
-                    help="comma list: o3,flash,adam,moe")
+    ap.add_argument("--sections", default="o3,flash,adam,moe,bert",
+                    help="comma list: o3,flash,adam,moe,bert")
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--stem", default="s2d_pre")
     ap.add_argument("--o2", action="store_true",
@@ -104,6 +104,12 @@ def main():
             log("moe_dispatch", bench.bench_moe())
         except Exception as e:
             log("moe_dispatch", {"error": f"{type(e).__name__}: {e}"})
+
+    if "bert" in sections:
+        try:
+            log("bert", bench.bench_bert())
+        except Exception as e:
+            log("bert", {"error": f"{type(e).__name__}: {e}"})
 
 
 if __name__ == "__main__":

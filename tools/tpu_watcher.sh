@@ -18,7 +18,7 @@ sec_done() {  # recorded success, or given up after 4 live attempts
 }
 
 pending() {
-  for s in o3_ceiling flash_attention fused_adam moe_dispatch; do
+  for s in o3_ceiling flash_attention fused_adam moe_dispatch bert; do
     sec_done "$s" || { echo "$s"; return; }
   done
   kp=$(grep -c 'running kernel_parity$' "$LOG" 2>/dev/null)
@@ -43,6 +43,7 @@ while true; do
       flash_attention) timeout 1800 python tools/bench_followup.py --sections flash >> "$LOG" 2>&1 ;;
       fused_adam)      timeout 1800 python tools/bench_followup.py --sections adam >> "$LOG" 2>&1 ;;
       moe_dispatch)    timeout 1800 python tools/bench_followup.py --sections moe  >> "$LOG" 2>&1 ;;
+      bert)            timeout 1800 python tools/bench_followup.py --sections bert >> "$LOG" 2>&1 ;;
       kernel_parity)   timeout 1800 python tools/kernel_parity.py > KERNEL_PARITY_r03.json 2>>"$LOG" ;;
       tp_pp_bf16)      timeout 1500 python tools/tp_pp_bf16_check.py >> "$LOG" 2>&1 ;;
     esac
