@@ -302,13 +302,48 @@ def bench_flash_attention(iters=5):
     # attention FLOPs: fwd 4*b*h*s^2*d (QK^T + PV), bwd ~2.5x fwd,
     # causal halves the work
     flops = 3.5 * 4 * b * h * s * s * d * 0.5
-    return {
+    out = {
         "shape": f"b{b} s{s} h{h} d{d} bf16 causal",
         "pallas_ms": round(t_pallas * 1e3, 2),
         "jnp_ms": round(t_jnp * 1e3, 2),
         "pallas_tflops": round(flops / t_pallas / 1e12, 2),
         "speedup_vs_jnp": round(t_jnp / t_pallas, 2),
     }
+    # long-context leg: 16k tokens, Pallas only — the jnp oracle would
+    # materialize a 16k x 16k score matrix per head; the flash kernel's
+    # whole point is that this shape still runs in O(s) memory
+    try:
+        bl, sl = 1, 16384
+        ks = jax.random.split(jax.random.PRNGKey(3), 3)
+        ql, kl, vl = (jax.random.normal(kk, (bl, sl, h, d), jnp.bfloat16)
+                      for kk in ks)
+
+        @jax.jit
+        def fwd_bwd_long(q, k, v):
+            f = lambda q, k, v: flash_attention(
+                q, k, v, causal=True, use_pallas=True,
+                interpret=False).astype(jnp.float32).sum()
+            return jax.value_and_grad(f, argnums=(0, 1, 2))(q, k, v)
+
+        l, _ = fwd_bwd_long(ql, kl, vl)
+        float(l)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            l, _ = fwd_bwd_long(ql, kl, vl)
+        float(l)
+        t_long = (time.perf_counter() - t0) / iters
+        flops_l = 3.5 * 4 * bl * h * sl * sl * d * 0.5
+        out["long_context"] = {
+            "shape": f"b{bl} s{sl} h{h} d{d} bf16 causal",
+            "pallas_ms": round(t_long * 1e3, 2),
+            "pallas_tflops": round(flops_l / t_long / 1e12, 2),
+        }
+    except Exception as e:
+        # key is NOT "error": the watcher's sec_done greps the logged
+        # line for "error" to decide retry, and a failed optional leg
+        # must not mark the whole (successful) section as failed
+        out["long_context"] = {"skipped": f"{type(e).__name__}: {e}"}
+    return out
 
 
 def bench_moe(iters=10):

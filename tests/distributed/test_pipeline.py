@@ -855,3 +855,53 @@ def test_bert_1f1b_dropout_matches_gpipe_autodiff():
                         jax.tree.leaves(want_g[name])):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=1e-5)
+
+
+def test_onef1b_memory_bounded(mesh):
+    """The schedule's memory claim, pinned via XLA's memory analysis:
+    GPipe-under-autodiff temp memory grows with the microbatch count
+    (XLA saves every tick's activations), 1F1B's stays flat (ring
+    buffer of S stage inputs + rematerialized backward). This test
+    pins M=4 -> M=16 at constant microbatch size (gpipe ~2.9x growth,
+    1f1b flat); a wider one-off probe on this backend measured gpipe
+    2.4 -> 26 MB at M=4 -> 64 vs 1f1b flat at ~1 MB."""
+    F2 = 256
+    ks = jax.random.split(jax.random.PRNGKey(0), S)
+    params = {"w": jax.vmap(
+        lambda k: jax.random.normal(k, (F2, F2)) * 0.3)(ks),
+        "b": jnp.zeros((S, F2))}
+    mse = lambda y, t: jnp.mean((y - t) ** 2)
+
+    def temp_bytes(fn, *args):
+        ma = jax.jit(fn).lower(*args).compile().memory_analysis()
+        if ma is None:  # backend without memory analysis: nothing to pin
+            pytest.skip("backend reports no memory analysis")
+        return ma.temp_size_in_bytes
+
+    sizes = {}
+    for m in (4, 16):
+        B2 = 64 * m  # microbatch size constant; only the count grows
+        x = jax.random.normal(jax.random.PRNGKey(1), (B2, F2))
+        tgt = jax.random.normal(jax.random.PRNGKey(2), (B2, F2))
+
+        def gpipe_lg(p, x, t, m=m):
+            return jax.value_and_grad(
+                lambda p: mse(parallel.pipeline_apply(
+                    mesh, "pipe", stage_fn, p, x,
+                    num_microbatches=m), t))(p)
+
+        def onef1b_lg(p, x, t, m=m):
+            l, g, _ = parallel.onef1b_loss_and_grad(
+                mesh, "pipe", stage_fn, mse, p, x, t,
+                num_microbatches=m)
+            return l, g
+
+        sizes[m] = (temp_bytes(gpipe_lg, params, x, tgt),
+                    temp_bytes(onef1b_lg, params, x, tgt))
+
+    gpipe_growth = sizes[16][0] / sizes[4][0]
+    onef1b_growth = sizes[16][1] / sizes[4][1]
+    assert gpipe_growth > 2.0, sizes   # grows with M (measured ~2.9x)
+    assert onef1b_growth < 1.5, sizes  # bounded by S (measured 1.0x)
+    # and at M=16 the interleaved schedule uses several times less
+    assert sizes[16][1] * 3 < sizes[16][0], sizes
