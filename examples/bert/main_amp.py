@@ -71,8 +71,8 @@ def parse_args():
                    help="pipeline schedule under --pp: gpipe (autodiff "
                         "through the scan) or 1f1b (interleaved "
                         "fwd/bwd, live activations bounded by the stage "
-                        "count; needs --grad-accum 1, no --moe / "
-                        "--ring-attention)")
+                        "count; composes with dp and --grad-accum, "
+                        "not yet --moe / --ring-attention)")
     p.add_argument("--pp-microbatches", type=int, default=4, metavar="M",
                    help="GPipe microbatches per step under --pp "
                    "(bubble fraction (S-1)/(M+S-1))")
@@ -144,11 +144,10 @@ def main():
     onef1b = pp and args.pp_schedule == "1f1b"
     if args.pp_schedule == "1f1b" and not pp:
         raise SystemExit("--pp-schedule 1f1b needs --pp S")
-    if onef1b and (sp or args.moe or args.grad_accum > 1):
+    if onef1b and (sp or args.moe):
         raise SystemExit(
-            "--pp-schedule 1f1b composes with dp only for now: drop "
-            "--ring-attention/--moe and use --grad-accum 1 (the "
-            "schedule already microbatches)")
+            "--pp-schedule 1f1b composes with dp (and --grad-accum) "
+            "for now: drop --ring-attention/--moe")
     maybe_print(f"devices: {n_dev} (dp={dp}, sp={sp or 1}, pp={pp or 1}), "
                 f"config: {args.config}", rank0=True)
 
@@ -266,38 +265,6 @@ def main():
         params, opt_state = optimizer.step(params, grads, opt_state)
         return params, opt_state, loss
 
-    if onef1b:
-        n_mb = args.pp_microbatches
-
-        @jax.jit
-        def train_step(params, opt_state, ids, labels, weights, nsp):
-            """1F1B variant: the interleaved schedule returns scaled
-            grads directly (loss scaling rides the per-microbatch loss
-            via ``amp.scale``); ``optimizer.step`` unscales them onto
-            the masters exactly as on the autodiff path. The MLM term
-            uses the GLOBAL mask count, so each microbatch loss carries
-            a ``n_mb * dp`` factor that cancels the schedule's
-            mean-over-microbatches and the data-axis pmean."""
-            denom = jnp.maximum(jnp.sum(weights), 1.0)
-            scale0 = optimizer.loss_scale(opt_state)
-
-            def mb_loss(mlm_logits, nsp_logits, tgt):
-                mlm_losses = \
-                    optax.softmax_cross_entropy_with_integer_labels(
-                        mlm_logits, tgt["labels"])
-                mlm = jnp.sum(mlm_losses * tgt["weights"]) \
-                    * (n_mb * dp) / denom
-                nsp_loss = \
-                    optax.softmax_cross_entropy_with_integer_labels(
-                        nsp_logits, tgt["nsp"]).mean()
-                return amp.scale(mlm + nsp_loss, opt_state)
-
-            targets = {"labels": labels, "weights": weights, "nsp": nsp}
-            loss_s, grads = model.loss_and_grad_1f1b(
-                {"params": params}, ids, mb_loss, targets)
-            params, opt_state = optimizer.step(params, grads, opt_state)
-            return params, opt_state, loss_s / scale0
-
     accum = args.grad_accum
     if accum < 1:
         raise SystemExit(f"--grad-accum must be >= 1, got {accum}")
@@ -310,16 +277,22 @@ def main():
                 f"microbatch {args.b // accum} (b/{accum}) must divide "
                 f"by dp={dp}")
 
+    def make_accum_step(slice_loss_and_grads):
+        """Shared grad-accumulation driver (reference delay_unscale /
+        unscale_with_stashed protocol), parameterized by the per-slice
+        loss-and-grad — GPipe autodiff or the 1F1B schedule: grads
+        unscale-accumulated into the stash, the dynamic scale updated
+        ONCE per step from the ORed overflow, one optimizer step.  The
+        loop unrolls A graphs into the jit — compile time grows with A;
+        fine for the usual 2-8.  The accumulated grad equals the
+        full-batch grad: the MLM term divides by the GLOBAL mask count.
+
+        ``slice_loss_and_grads(params, st, ids_j, labels_j, weights_j,
+        nsp_j, denom) -> (unscaled_loss_contrib, scaled_grads)``.
+        """
+
         @jax.jit
         def train_step(params, opt_state, ids, labels, weights, nsp):
-            """Microbatched variant (reference delay_unscale /
-            unscale_with_stashed protocol): per-microbatch backward,
-            grads unscale-accumulated into the stash, the dynamic scale
-            updated ONCE per step from the ORed overflow, one optimizer
-            step.  The loop unrolls A forward/backward graphs into the
-            jit — compile time grows with A; fine for the usual 2-8.
-            The accumulated grad equals the full-batch grad: the MLM
-            term divides by the GLOBAL mask count."""
             # STRIDED microbatches (a[j::accum]) keep each microbatch
             # spread across all data-axis devices; a contiguous reshape
             # would land each microbatch on dp/accum devices and force a
@@ -334,14 +307,9 @@ def main():
             st = opt_state
             total_loss = 0.0
             for j in range(accum):
-                def loss_fn(p):
-                    loss = batch_loss(p, ids_m[j], labels_m[j],
-                                      weights_m[j], nsp_m[j], denom,
-                                      float(accum))
-                    with amp.scale_loss(loss, st) as scaled:
-                        return scaled, loss
-                (_, loss_j), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True)(params)
+                loss_j, grads = slice_loss_and_grads(
+                    params, st, ids_m[j], labels_m[j], weights_m[j],
+                    nsp_m[j], denom)
                 grads, ovf, st = optimizer.unscale_grads(
                     grads, st, 0, stashed=stashed, update_scale=False)
                 stashed = grads
@@ -351,6 +319,74 @@ def main():
             params2, st = optimizer.apply_gradients(
                 params, stashed, st, overflow)
             return params2, st, total_loss
+
+        return train_step
+
+    if onef1b:
+        n_mb = args.pp_microbatches
+
+        def onef1b_slice(params, opt_state, ids_j, labels_j, weights_j,
+                         nsp_j, denom, div):
+            """One 1F1B pass over a batch slice: the interleaved
+            schedule returns scaled grads directly (loss scaling rides
+            the per-microbatch loss via ``amp.scale``). The MLM term
+            uses the GLOBAL mask count, so each microbatch loss carries
+            a ``n_mb * dp`` factor that cancels the schedule's
+            mean-over-microbatches and the data-axis pmean; NSP divides
+            by ``div`` (the accumulation count)."""
+
+            def mb_loss(mlm_logits, nsp_logits, tgt):
+                mlm_losses = \
+                    optax.softmax_cross_entropy_with_integer_labels(
+                        mlm_logits, tgt["labels"])
+                mlm = jnp.sum(mlm_losses * tgt["weights"]) \
+                    * (n_mb * dp) / denom
+                nsp_loss = \
+                    optax.softmax_cross_entropy_with_integer_labels(
+                        nsp_logits, tgt["nsp"]).mean() / div
+                return amp.scale(mlm + nsp_loss, opt_state)
+
+            targets = {"labels": labels_j, "weights": weights_j,
+                       "nsp": nsp_j}
+            return model.loss_and_grad_1f1b(
+                {"params": params}, ids_j, mb_loss, targets)
+
+        @jax.jit
+        def train_step(params, opt_state, ids, labels, weights, nsp):
+            """1F1B step: ``optimizer.step`` unscales the schedule's
+            grads onto the masters exactly as on the autodiff path."""
+            denom = jnp.maximum(jnp.sum(weights), 1.0)
+            scale0 = optimizer.loss_scale(opt_state)
+            loss_s, grads = onef1b_slice(params, opt_state, ids, labels,
+                                         weights, nsp, denom, 1.0)
+            params, opt_state = optimizer.step(params, grads, opt_state)
+            return params, opt_state, loss_s / scale0
+
+        if accum > 1:
+            def onef1b_accum_slice(params, st, ids_j, labels_j,
+                                   weights_j, nsp_j, denom):
+                # loss_scale(st) is loop-invariant here: the driver
+                # defers update_scale to the end of the step
+                loss_s, grads = onef1b_slice(
+                    params, st, ids_j, labels_j, weights_j, nsp_j,
+                    denom, float(accum))
+                return loss_s / optimizer.loss_scale(st), grads
+
+            train_step = make_accum_step(onef1b_accum_slice)
+
+    elif accum > 1:
+        def gpipe_accum_slice(params, st, ids_j, labels_j, weights_j,
+                              nsp_j, denom):
+            def loss_fn(p):
+                loss = batch_loss(p, ids_j, labels_j, weights_j, nsp_j,
+                                  denom, float(accum))
+                with amp.scale_loss(loss, st) as scaled:
+                    return scaled, loss
+            (_, loss_j), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            return loss_j, grads
+
+        train_step = make_accum_step(gpipe_accum_slice)
 
     rng = np.random.RandomState(0)
     losses, batch_time = AverageMeter(), AverageMeter()

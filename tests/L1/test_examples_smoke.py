@@ -85,10 +85,14 @@ def test_bert_tiny_ring_attention():
     assert "loss" in out.lower()
 
 
-def test_bert_tiny_pp_1f1b():
+@pytest.mark.parametrize("extra", [[], ["--grad-accum", "2"]],
+                         ids=["plain", "grad_accum"])
+def test_bert_tiny_pp_1f1b(extra):
     """dp x pp with the interleaved memory-bounded schedule: the manual
-    loss-and-grad path under amp O2 + FusedLAMB + dynamic scaling."""
+    loss-and-grad path under amp O2 + FusedLAMB + dynamic scaling,
+    with and without the unscale-with-stashed accumulation protocol."""
     out = _run("examples/bert/main_amp.py", "--config", "tiny", "--b", "16",
                "--seq-len", "32", "--steps", "3", "--pp", "2",
-               "--pp-microbatches", "2", "--pp-schedule", "1f1b", ndev=8)
+               "--pp-microbatches", "2", "--pp-schedule", "1f1b", *extra,
+               ndev=8)
     assert "loss" in out.lower()
