@@ -31,11 +31,27 @@ def _conv_dtypes(jaxpr):
             if eqn.primitive.name == "conv_general_dilated":
                 out.append(tuple(v.aval.dtype.name for v in eqn.invars[:2]))
             for v in eqn.params.values():
-                if hasattr(v, "jaxpr"):
-                    walk(v.jaxpr)
+                _walk_param(v, walk)
 
     walk(jaxpr.jaxpr)
     return out
+
+
+def _walk_param(v, walk):
+    """Recurse into nested jaxprs wherever primitives stash them:
+    ClosedJaxpr params (scan/pjit), raw Jaxprs (shard_map), and tuples
+    of ClosedJaxprs (cond's `branches`) — a missed container silently
+    un-pins every op inside it."""
+    if hasattr(v, "jaxpr"):
+        walk(v.jaxpr)
+    elif hasattr(v, "eqns"):
+        walk(v)
+    elif isinstance(v, (tuple, list)):
+        for u in v:
+            if hasattr(u, "jaxpr"):
+                walk(u.jaxpr)
+            elif hasattr(u, "eqns"):
+                walk(u)
 
 
 def _dot_dtypes(jaxpr):
@@ -46,8 +62,7 @@ def _dot_dtypes(jaxpr):
             if eqn.primitive.name == "dot_general":
                 out.append(tuple(v.aval.dtype.name for v in eqn.invars[:2]))
             for v in eqn.params.values():
-                if hasattr(v, "jaxpr"):
-                    walk(v.jaxpr)
+                _walk_param(v, walk)
 
     walk(jaxpr.jaxpr)
     return out

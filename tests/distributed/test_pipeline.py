@@ -907,3 +907,55 @@ def test_onef1b_memory_bounded(mesh):
     assert onef1b_growth < 1.5, sizes  # bounded by S (measured 1.0x)
     # and at M=16 the interleaved schedule uses several times less
     assert sizes[16][1] * 3 < sizes[16][0], sizes
+
+
+def test_bert_1f1b_amp_o2_dots_bf16():
+    """The amp passthrough (AmpModel.loss_and_grad_1f1b) keeps the
+    schedule's matmuls on bf16 operands through forward AND the
+    rematerialized backward — the perf pin the autodiff train paths
+    have in tests/L0/test_norm_dtype_seam.py, for the manual-grad
+    path."""
+    from apex_tpu import amp, models
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("pipe",))
+    cfg = _bert_cfg()
+    pb = models.PipelinedBert(cfg, mesh, pp=4, num_microbatches=2)
+    model = amp.initialize(pb, None, opt_level="O2", verbosity=0)
+    ids, mask, tgt = _bert_batch()
+    variables = pb.init(jax.random.PRNGKey(1), ids, mask)
+
+    jaxpr = jax.make_jaxpr(
+        lambda v, i, m, t: model.loss_and_grad_1f1b(
+            v, i, _pretrain_loss, t, attention_mask=m))(
+        variables, ids, mask, tgt)
+
+    dots = []
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "dot_general":
+                dots.append(tuple(v.aval.dtype.name
+                                  for v in eqn.invars[:2]))
+            for v in eqn.params.values():
+                if hasattr(v, "jaxpr"):   # ClosedJaxpr (scan, pjit)
+                    walk(v.jaxpr)
+                elif hasattr(v, "eqns"):  # raw Jaxpr (shard_map)
+                    walk(v)
+                elif isinstance(v, (tuple, list)):
+                    for u in v:           # cond stores `branches` as a
+                        if hasattr(u, "jaxpr"):  # tuple of ClosedJaxprs
+                            walk(u.jaxpr)
+                        elif hasattr(u, "eqns"):
+                            walk(u)
+
+    walk(jaxpr.jaxpr)
+    assert len(dots) > 10, f"only {len(dots)} dots traced — walker broken?"
+    # fp32 dots are allowed only where amp policy demands them (loss
+    # softmax path); every encoder/head matmul must be bf16 x bf16
+    bf16 = [d for d in dots if d == ("bfloat16", "bfloat16")]
+    f32 = [d for d in dots if d == ("float32", "float32")]
+    assert len(bf16) >= len(dots) * 0.8, (
+        f"amp O2 1F1B path off bf16: {len(bf16)}/{len(dots)} bf16 "
+        f"(fp32: {len(f32)}, all: {sorted(set(dots))})")
+    mixed = [d for d in dots if len(set(d)) > 1]
+    assert not mixed, f"mixed-dtype dots (promotion seam): {mixed}"
