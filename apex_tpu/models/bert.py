@@ -629,8 +629,18 @@ class PipelinedBert:
         heads through the schedule's differentiated ``loss_params``.
 
         Composes with ``batch_axis`` (grads are global-batch means, as
-        DDP semantics require).  Not yet wired: ``seq_axis`` /
-        ``tp_axis`` / MoE configs (use the GPipe ``apply`` path there).
+        DDP semantics require).  NOT with ``seq_axis``: the schedule's
+        fwd/bwd alternation is per-device control flow (``lax.cond`` on
+        the stage index), and a ring attention's collective scan inside
+        those divergent branches miscomputes — measured 2026-07-31 on
+        the CPU backend: wrong results even at sp=1 where the ring's
+        ppermutes are self-loops, i.e. the ring's inner scan itself is
+        unsound under the branch, independent of cross-device pairing
+        (a simple ``all_gather`` in the last-stage loss DOES compose
+        exactly, so the constraint is specifically nested
+        collective-carrying scans).  Ring-SP therefore composes with
+        the GPipe schedule only; ``tp_axis`` / MoE likewise use the
+        GPipe ``apply`` path.
         """
         from jax import lax
         from jax.sharding import PartitionSpec as P
@@ -639,8 +649,9 @@ class PipelinedBert:
 
         if self.seq_axis is not None or self.tp_axis is not None:
             raise NotImplementedError(
-                "loss_and_grad_1f1b supports dp x pp; for seq_axis/"
-                "tp_axis compositions use the GPipe apply() path")
+                "loss_and_grad_1f1b supports dp x pp; seq_axis/tp_axis "
+                "compositions use the GPipe apply() path (see docstring "
+                "for why the 1F1B branches cannot host the ring)")
         if self.cfg.moe_experts > 0:
             raise NotImplementedError(
                 "loss_and_grad_1f1b does not yet thread MoE aux losses; "

@@ -959,3 +959,4 @@ def test_bert_1f1b_amp_o2_dots_bf16():
         f"(fp32: {len(f32)}, all: {sorted(set(dots))})")
     mixed = [d for d in dots if len(set(d)) > 1]
     assert not mixed, f"mixed-dtype dots (promotion seam): {mixed}"
+

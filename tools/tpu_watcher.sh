@@ -36,7 +36,7 @@ while true; do
     echo "$(date +%H:%M:%S) queue empty - exiting" >> "$LOG"
     exit 0
   fi
-  if timeout 120 python -c "import jax; assert jax.devices()[0].platform=='tpu'" 2>/dev/null; then
+  if timeout 180 python -c "import jax; assert jax.devices()[0].platform=='tpu'" 2>/dev/null; then
     echo "$(date +%H:%M:%S) TUNNEL UP - running $next" >> "$LOG"
     case "$next" in
       o3_ceiling)      timeout 1800 python tools/bench_followup.py --sections o3   >> "$LOG" 2>&1 ;;
