@@ -614,7 +614,8 @@ class PipelinedBert:
 
     def loss_and_grad_1f1b(self, variables, input_ids, loss_fn, targets,
                            attention_mask=None, token_type_ids=None,
-                           deterministic: bool = True, rngs=None):
+                           deterministic: bool = True, rngs=None,
+                           moe_aux_weight: float = 0.0):
         """Memory-bounded training step: the interleaved 1F1B schedule
         (``parallel.onef1b_spmd``) instead of autodiff-through-GPipe —
         live encoder activations bounded by ``pp`` stage inputs per
@@ -639,8 +640,16 @@ class PipelinedBert:
         (a simple ``all_gather`` in the last-stage loss DOES compose
         exactly, so the constraint is specifically nested
         collective-carrying scans).  Ring-SP therefore composes with
-        the GPipe schedule only; ``tp_axis`` / MoE likewise use the
-        GPipe ``apply`` path.
+        the GPipe schedule only; ``tp_axis`` likewise.
+
+        MoE configs (dense or capacity dispatch, experts NOT sharded
+        over an ep axis — the PipelinedBert regime) compose: the stage
+        body stays collective-free, the per-row aux accumulator rides
+        the activation pytree to the last stage, and
+        ``moe_aux_weight * mean(aux)`` joins the objective there (the
+        same per-microbatch aux estimate the GPipe path returns);
+        router grads for earlier stages flow back through the aux
+        leaf's cotangent chain.
         """
         from jax import lax
         from jax.sharding import PartitionSpec as P
@@ -652,11 +661,6 @@ class PipelinedBert:
                 "loss_and_grad_1f1b supports dp x pp; seq_axis/tp_axis "
                 "compositions use the GPipe apply() path (see docstring "
                 "for why the 1F1B branches cannot host the ring)")
-        if self.cfg.moe_experts > 0:
-            raise NotImplementedError(
-                "loss_and_grad_1f1b does not yet thread MoE aux losses; "
-                "use the GPipe apply() path for MoE configs")
-
         needs_rng, base_key, embed_rngs = self._dropout_setup(
             deterministic, rngs, "loss_and_grad_1f1b")
 
@@ -672,11 +676,30 @@ class PipelinedBert:
         stage_fn = self._build_stage_fn(needs_rng, base_key,
                                         deterministic)
 
+        # static: moe_aux_weight may be a TRACED scalar (e.g. carrying
+        # the amp loss scale), so gate on python-level zeroness only
+        statically_zero = (isinstance(moe_aux_weight, (int, float))
+                           and moe_aux_weight == 0.0)
+        use_aux = self.cfg.moe_experts > 0 and not statically_zero
+        if self.cfg.moe_experts > 0 and statically_zero:
+            import warnings
+            warnings.warn(
+                "loss_and_grad_1f1b on an MoE config with "
+                "moe_aux_weight=0: the load-balance aux term is "
+                "dropped and nothing pushes the router toward balance "
+                "(the GPipe apply() path returns the aux explicitly); "
+                "pass moe_aux_weight to include it",
+                stacklevel=2)
+
         def pl_loss(y, tgt_mb, heads_p):
             # y is the stage activation pytree; hidden is leaf 0, the
-            # bias/mb/aux side leaves are not part of the objective
+            # bias/mb-id side leaves are not part of the objective; the
+            # trailing aux leaf joins it for MoE configs
             mlm, nsp = self.heads.apply({"params": heads_p}, y[0])
-            return loss_fn(mlm, nsp, tgt_mb)
+            loss = loss_fn(mlm, nsp, tgt_mb)
+            if use_aux:
+                loss = loss + moe_aux_weight * jnp.mean(y[-1])
+            return loss
 
         run = onef1b_spmd(stage_fn, pl_loss, self.pipe_axis,
                           self.num_microbatches)

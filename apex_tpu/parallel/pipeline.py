@@ -166,7 +166,13 @@ def onef1b_spmd(stage_fn: Callable, loss_fn: Callable, axis_name: str,
       exact since microbatches are equal-sized;
     - ``grads`` is this device's ``(1, ...)`` stage-param grad slice
       (d loss / d params, microbatch-summed, matching the stacked
-      layout of the input params);
+      layout of the input params).  Under cross-axis composition
+      (e.g. a data axis in the caller's shard_map) these are PER-SHARD
+      PARTIALS — the params are pvary'd to the activations' full
+      varying set at entry precisely so no implicit reduction happens
+      inside the schedule — and the caller applies its own reduction
+      exactly once (``lax.pmean`` over the data axis for DDP mean
+      semantics);
     - ``dx`` is d loss / d x, replicated — chain it into whatever
       produced ``x`` (embeddings, a previous parallel region) with the
       caller's own vjp; integer leaves of ``x`` (e.g. microbatch-id
@@ -206,6 +212,16 @@ def onef1b_spmd(stage_fn: Callable, loss_fn: Callable, axis_name: str,
             return _vary_like(a, *refs, extra_axes=(axis_name,))
 
         x_ref = x_leaves[0]
+        # pvary the stage params to the activations' full varying set
+        # (e.g. a data axis from composition): params that stay
+        # INVARIANT over an axis the activations vary on would make
+        # every vjp insert a psum over that axis for their cotangent —
+        # a collective inside the schedule's divergent cond branches,
+        # and a silently pre-summed grad that double-counts under the
+        # caller's mean-reduction. Varying params -> per-shard partial
+        # grads, no branch collectives; the caller reduces once.
+        params = jax.tree_util.tree_map(
+            lambda a: _v(a, x_ref), params)
         if loss_params is not None:
             # make the loss params pipe-VARYING before any vjp sees
             # them: a pipe-invariant primal would make the transpose

@@ -71,8 +71,8 @@ def parse_args():
                    help="pipeline schedule under --pp: gpipe (autodiff "
                         "through the scan) or 1f1b (interleaved "
                         "fwd/bwd, live activations bounded by the stage "
-                        "count; composes with dp and --grad-accum, "
-                        "not yet --moe / --ring-attention)")
+                        "count; composes with dp, --grad-accum and "
+                        "--moe, not --ring-attention)")
     p.add_argument("--pp-microbatches", type=int, default=4, metavar="M",
                    help="GPipe microbatches per step under --pp "
                    "(bubble fraction (S-1)/(M+S-1))")
@@ -144,10 +144,11 @@ def main():
     onef1b = pp and args.pp_schedule == "1f1b"
     if args.pp_schedule == "1f1b" and not pp:
         raise SystemExit("--pp-schedule 1f1b needs --pp S")
-    if onef1b and (sp or args.moe):
+    if onef1b and sp:
         raise SystemExit(
-            "--pp-schedule 1f1b composes with dp (and --grad-accum) "
-            "for now: drop --ring-attention/--moe")
+            "--pp-schedule 1f1b composes with dp, --grad-accum and "
+            "--moe; --ring-attention needs the gpipe schedule (the "
+            "ring cannot run inside the 1F1B branches)")
     maybe_print(f"devices: {n_dev} (dp={dp}, sp={sp or 1}, pp={pp or 1}), "
                 f"config: {args.config}", rank0=True)
 
@@ -348,8 +349,16 @@ def main():
 
             targets = {"labels": labels_j, "weights": weights_j,
                        "nsp": nsp_j}
+            # the aux joins the objective at the last stage with the
+            # same 0.01/div weighting as batch_loss — TIMES the loss
+            # scale: the aux never reaches mb_loss, so it must carry
+            # the amp scaling itself or optimizer.step's unscale would
+            # divide it to nothing
+            aux_w = ((0.01 / div) * optimizer.loss_scale(opt_state)
+                     if args.moe else 0.0)
             return model.loss_and_grad_1f1b(
-                {"params": params}, ids_j, mb_loss, targets)
+                {"params": params}, ids_j, mb_loss, targets,
+                moe_aux_weight=aux_w)
 
         @jax.jit
         def train_step(params, opt_state, ids, labels, weights, nsp):

@@ -85,8 +85,9 @@ def test_bert_tiny_ring_attention():
     assert "loss" in out.lower()
 
 
-@pytest.mark.parametrize("extra", [[], ["--grad-accum", "2"]],
-                         ids=["plain", "grad_accum"])
+@pytest.mark.parametrize("extra", [[], ["--grad-accum", "2"],
+                                   ["--moe", "4"]],
+                         ids=["plain", "grad_accum", "moe"])
 def test_bert_tiny_pp_1f1b(extra):
     """dp x pp with the interleaved memory-bounded schedule: the manual
     loss-and-grad path under amp O2 + FusedLAMB + dynamic scaling,

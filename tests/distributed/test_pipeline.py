@@ -667,8 +667,9 @@ def test_onef1b_pytree_activations(mesh):
 
 def test_onef1b_dp_x_pp_training():
     """(data, pipe) mesh: the 1F1B loss-and-grad drives a real training
-    loop — per-data-shard grads psum'd on the data axis, loss descends,
-    placement preserved."""
+    loop — the schedule returns per-data-shard PARTIAL grads (params
+    are pvary'd so nothing reduces implicitly) and this wrapper pmeans
+    them once; loss descends, placement preserved."""
     mesh = Mesh(np.asarray(jax.devices()[:NDEV]).reshape(2, S),
                 ("data", "pipe"))
     params, x = _stacked_params(18), _x(19)
@@ -820,6 +821,16 @@ def test_bert_1f1b_dp_x_pp_matches_monolithic():
                         jax.tree.leaves(want_g["encoder"][k])):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=1e-5)
+    # STAGE grads under dp were the gap that hid a double-count (the
+    # schedule's grads were data-psum'd by an implicit transpose
+    # collective AND pmean'd by the wrapper, 2x); pin them per layer
+    for li in range(cfg.num_hidden_layers):
+        got_li = jax.tree.map(lambda a: a[li],
+                              grads["stages"]["layer_0"])
+        for a, b in zip(jax.tree.leaves(got_li),
+                        jax.tree.leaves(want_g["encoder"][f"layer_{li}"])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=1e-5)
 
 
 def test_bert_1f1b_dropout_matches_gpipe_autodiff():
@@ -960,3 +971,50 @@ def test_bert_1f1b_amp_o2_dots_bf16():
     mixed = [d for d in dots if len(set(d)) > 1]
     assert not mixed, f"mixed-dtype dots (promotion seam): {mixed}"
 
+
+@pytest.mark.parametrize("dispatch", ["dense", "capacity"])
+def test_bert_1f1b_moe_matches_gpipe_autodiff(dispatch):
+    """MoE under the interleaved schedule (dense and capacity dispatch,
+    experts unsharded — the PipelinedBert regime where the stage body
+    is collective-free): loss with the weighted aux and ALL grads —
+    including router grads of EARLY stages, credited through the aux
+    leaf's cotangent chain — match autodiff through the GPipe apply
+    path, which slices the same microbatches."""
+    from apex_tpu import models
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4),
+                ("data", "pipe"))
+    cfg = models.BertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=4,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=16, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, moe_experts=4,
+        moe_dispatch=dispatch)
+    pb = models.PipelinedBert(cfg, mesh, pp=4, num_microbatches=2,
+                              batch_axis="data")
+    ids, mask, tgt = _bert_batch()
+    variables = pb.init(jax.random.PRNGKey(1), ids, mask)
+    W = 0.01
+
+    loss, grads = jax.jit(
+        lambda v, i, m, t: pb.loss_and_grad_1f1b(
+            v, i, _pretrain_loss, t, attention_mask=m,
+            moe_aux_weight=W))(variables, ids, mask, tgt)
+
+    def gpipe_loss(p):
+        mlm, nsp, aux = pb.apply({"params": p}, ids, mask)
+        return _pretrain_loss(mlm, nsp, tgt) + W * aux
+
+    want_l, want_g = jax.jit(jax.value_and_grad(gpipe_loss))(
+        variables["params"])
+    np.testing.assert_allclose(float(loss), float(want_l), rtol=1e-5)
+    for name in ("embed", "stages", "heads"):
+        for a, b in zip(jax.tree.leaves(grads[name]),
+                        jax.tree.leaves(want_g[name])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=3e-4, atol=1e-5)
+    # the router grads specifically must be nonzero (the aux term is
+    # the only thing training the router toward balance)
+    router = [a for path, a in jax.tree_util.tree_leaves_with_path(
+        grads["stages"]) if "router" in str(path)]
+    assert router and all(float(jnp.abs(r).max()) > 0 for r in router)
