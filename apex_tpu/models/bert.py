@@ -365,6 +365,7 @@ class PipelinedBert:
         self.batch_axis = batch_axis
         self.seq_axis = seq_axis
         self.tp_axis = tp_axis
+        self.attention_fn = attention_fn
         self.embed = BertEmbeddings(cfg)
         self.stage = BertStage(cfg, cfg.num_hidden_layers // pp,
                                attention_fn)
@@ -630,17 +631,24 @@ class PipelinedBert:
         heads through the schedule's differentiated ``loss_params``.
 
         Composes with ``batch_axis`` (grads are global-batch means, as
-        DDP semantics require).  NOT with ``seq_axis``: the schedule's
-        fwd/bwd alternation is per-device control flow (``lax.cond`` on
-        the stage index), and a ring attention's collective scan inside
-        those divergent branches miscomputes — measured 2026-07-31 on
-        the CPU backend: wrong results even at sp=1 where the ring's
-        ppermutes are self-loops, i.e. the ring's inner scan itself is
-        unsound under the branch, independent of cross-device pairing
-        (a simple ``all_gather`` in the last-stage loss DOES compose
-        exactly, so the constraint is specifically nested
-        collective-carrying scans).  Ring-SP therefore composes with
-        the GPipe schedule only; ``tp_axis`` likewise.
+        DDP semantics require), and with ``seq_axis`` for SCAN-FREE
+        sequence-parallel attention (Ulysses: all_to_all + local
+        attention).  The distinction, measured 2026-07-31 on the CPU
+        backend: the schedule's fwd/bwd alternation is per-device
+        control flow (``lax.cond`` on the stage index), and plain
+        collectives inside those branches compose exactly (every sp
+        shard of a stage takes the same branch), but a
+        collective-CARRYING ``lax.scan`` — the ring's per-hop loop —
+        miscomputes even at sp=1 where its ppermutes are self-loops.
+        Attention factories advertise this via ``onef1b_compatible``
+        (``make_ulysses_attention`` True, ``make_ring_attention``
+        False); ring-SP stays on the GPipe schedule, as does
+        ``tp_axis``.  Under ``seq_axis`` the last-stage loss
+        all_gathers the microbatch hidden over sp (mb-sized, cheap) so
+        ``loss_fn`` stays sequence-oblivious; the gather replicates
+        the loss computation per sp shard and its transpose sums the
+        copies, so stage grads and the input cotangent carry a 1/n_sp
+        correction (see run_wrapped).
 
         MoE configs (dense or capacity dispatch, experts NOT sharded
         over an ep axis — the PipelinedBert regime) compose: the stage
@@ -656,11 +664,29 @@ class PipelinedBert:
 
         from apex_tpu.parallel.pipeline import onef1b_spmd
 
-        if self.seq_axis is not None or self.tp_axis is not None:
+        if self.tp_axis is not None:
             raise NotImplementedError(
-                "loss_and_grad_1f1b supports dp x pp; seq_axis/tp_axis "
-                "compositions use the GPipe apply() path (see docstring "
-                "for why the 1F1B branches cannot host the ring)")
+                "loss_and_grad_1f1b supports dp x sp x pp; tp_axis "
+                "compositions use the GPipe apply() path")
+        if self.seq_axis is not None:
+            # fail CLOSED: only attention_fns that explicitly declare
+            # themselves scan-free may run inside the schedule's cond
+            # branches — an unknown wrapper around a ring would
+            # otherwise silently miscompute (wrong even at sp=1)
+            if not getattr(self.attention_fn, "onef1b_compatible",
+                           False):
+                raise NotImplementedError(
+                    "seq_axis under 1F1B needs an attention_fn marked "
+                    "onef1b_compatible=True (make_ulysses_attention "
+                    "is; ring attention is NOT — its collective-"
+                    "carrying scan miscomputes in the schedule's cond "
+                    "branches). Tag your own scan-free implementation "
+                    "explicitly, or use the GPipe apply() path")
+            if self.cfg.moe_experts > 0:
+                raise NotImplementedError(
+                    "seq_axis + MoE under 1F1B: the sp-local aux "
+                    "estimate breaks the loss/grad reduction algebra; "
+                    "use the GPipe apply() path")
         needs_rng, base_key, embed_rngs = self._dropout_setup(
             deterministic, rngs, "loss_and_grad_1f1b")
 
@@ -695,7 +721,14 @@ class PipelinedBert:
             # y is the stage activation pytree; hidden is leaf 0, the
             # bias/mb-id side leaves are not part of the objective; the
             # trailing aux leaf joins it for MoE configs
-            mlm, nsp = self.heads.apply({"params": heads_p}, y[0])
+            h = y[0]
+            if self.seq_axis is not None:
+                # gather the microbatch's sequence shards so loss_fn
+                # sees full-sequence logits (runs on every sp shard of
+                # the last stage — same branch, uniform; mb-sized so
+                # cheap); the gather's transpose re-scatters dh
+                h = lax.all_gather(h, self.seq_axis, axis=1, tiled=True)
+            mlm, nsp = self.heads.apply({"params": heads_p}, h)
             loss = loss_fn(mlm, nsp, tgt_mb)
             if use_aux:
                 loss = loss + moe_aux_weight * jnp.mean(y[-1])
@@ -708,6 +741,22 @@ class PipelinedBert:
             loss, g, dxb, dhp = run(
                 sp, self._schedule_input(*xb, needs_rng), tgt, hp)
             dh = dxb[0]
+            if self.seq_axis:
+                # the tail's all_gather REPLICATES the loss computation
+                # on every sp shard, and the gather's transpose SUMS
+                # the identical cotangent copies — so everything
+                # upstream of the gather (stage partials, dh) carries
+                # an extra n_sp factor: pmean (= psum of partials / the
+                # replication count) for stage grads, dh / n_sp; head
+                # grads accumulate locally as one copy per device ->
+                # plain mean; loss pmean (identical values, typing)
+                n_sp = lax.axis_size(self.seq_axis)
+                g = jax.tree_util.tree_map(
+                    lambda a: lax.pmean(a, self.seq_axis), g)
+                loss = lax.pmean(loss, self.seq_axis)
+                dhp = jax.tree_util.tree_map(
+                    lambda a: lax.pmean(a, self.seq_axis), dhp)
+                dh = dh / n_sp
             if self.batch_axis:
                 # loss and param grads are means over the data shards;
                 # each ROW's input grad lives in exactly one shard, so
@@ -721,8 +770,8 @@ class PipelinedBert:
                 dh = dh / n
             return loss, g, dh, dhp
 
-        hspec = P(self.batch_axis, None)
-        bspec = P(self.batch_axis, None, None, None)
+        hspec = P(self.batch_axis, self.seq_axis)
+        bspec = P(self.batch_axis, None, None, self.seq_axis)
         f = jax.shard_map(
             run_wrapped, mesh=self.mesh,
             in_specs=(jax.tree_util.tree_map(lambda _: P(self.pipe_axis),

@@ -424,6 +424,11 @@ def make_ring_attention(axis_name: str, *, causal: bool = False) -> Callable:
                               kv_mask=_bias_to_kv_mask(bias), causal=causal,
                               dropout_rate=rate, dropout_seed=seed)
 
+    # the ring's per-hop lax.scan CARRIES collectives; inside the 1F1B
+    # schedule's divergent cond branches that miscomputes (see
+    # models.PipelinedBert.loss_and_grad_1f1b) — scan-free collectives
+    # (Ulysses' all_to_alls) are fine there
+    attention_fn.onef1b_compatible = False
     return attention_fn
 
 
@@ -438,4 +443,7 @@ def make_ulysses_attention(axis_name: str, *, causal: bool = False) -> Callable:
                                  causal=causal, dropout_rate=rate,
                                  dropout_seed=seed)
 
+    # all_to_all + local attention, no collective-carrying scan:
+    # composes with the 1F1B schedule's cond branches
+    attention_fn.onef1b_compatible = True
     return attention_fn

@@ -1018,3 +1018,75 @@ def test_bert_1f1b_moe_matches_gpipe_autodiff(dispatch):
     router = [a for path, a in jax.tree_util.tree_leaves_with_path(
         grads["stages"]) if "router" in str(path)]
     assert router and all(float(jnp.abs(r).max()) > 0 for r in router)
+
+def test_bert_1f1b_ulysses_dp_sp_pp_matches_monolithic():
+    """dp x sp x pp on the interleaved schedule with Ulysses attention
+    (all_to_all + local attention — scan-free, so its collectives are
+    sound inside the schedule's branches): loss, embed, stage, and head
+    grads match the monolithic full-attention autodiff."""
+    from apex_tpu import models, parallel
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 2, 2),
+                ("data", "sp", "pipe"))
+    cfg = models.BertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=16, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+    uly = parallel.make_ulysses_attention("sp")
+    pb = models.PipelinedBert(cfg, mesh, pp=2, num_microbatches=2,
+                              batch_axis="data", seq_axis="sp",
+                              attention_fn=uly)
+    ids, mask, tgt = _bert_batch()
+    variables = pb.init(jax.random.PRNGKey(1), ids, mask)
+    loss, grads = jax.jit(
+        lambda v, i, m, t: pb.loss_and_grad_1f1b(
+            v, i, _pretrain_loss, t, attention_mask=m))(
+        variables, ids, mask, tgt)
+
+    seq_params = _monolithic_params(variables, 2, 1)
+
+    def mono_loss(p):
+        mlm, nsp = models.BertForPreTraining(cfg).apply(
+            {"params": p}, ids, mask, deterministic=True)
+        return _pretrain_loss(mlm, nsp, tgt)
+
+    want_l, want_g = jax.value_and_grad(mono_loss)(seq_params)
+    np.testing.assert_allclose(float(loss), float(want_l), rtol=1e-5)
+    for k in grads["heads"]:
+        for a, b in zip(jax.tree.leaves(grads["heads"][k]),
+                        jax.tree.leaves(want_g[k])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=2e-5)
+    for k in grads["embed"]:
+        for a, b in zip(jax.tree.leaves(grads["embed"][k]),
+                        jax.tree.leaves(want_g["encoder"][k])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=2e-5)
+    for li in range(cfg.num_hidden_layers):
+        got_li = jax.tree.map(lambda a: a[li],
+                              grads["stages"]["layer_0"])
+        for a, b in zip(jax.tree.leaves(got_li),
+                        jax.tree.leaves(want_g["encoder"][f"layer_{li}"])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=2e-5)
+
+
+def test_bert_1f1b_ring_rejected():
+    """The ring attention factory is tagged onef1b_compatible=False;
+    the 1F1B path must refuse it with an actionable message instead of
+    silently miscomputing."""
+    from apex_tpu import models, parallel
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 2, 2),
+                ("data", "sp", "pipe"))
+    cfg = _bert_cfg()
+    ring = parallel.make_ring_attention("sp")
+    pb = models.PipelinedBert(cfg, mesh, pp=2, num_microbatches=2,
+                              batch_axis="data", seq_axis="sp",
+                              attention_fn=ring)
+    ids, mask, tgt = _bert_batch()
+    variables = pb.init(jax.random.PRNGKey(1), ids, mask)
+    with pytest.raises(NotImplementedError, match="ring"):
+        pb.loss_and_grad_1f1b(variables, ids, _pretrain_loss, tgt,
+                              attention_mask=mask)
