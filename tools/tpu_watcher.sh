@@ -36,6 +36,13 @@ while true; do
     echo "$(date +%H:%M:%S) queue empty - exiting" >> "$LOG"
     exit 0
   fi
+  if pgrep -f "python bench.py" >/dev/null 2>&1; then
+    # the driver's round-end bench owns the tunnel; two concurrent
+    # clients wedge it (observed 2026-07-30) — stand down
+    echo "$(date +%H:%M:%S) bench.py running - standing down" >> "$LOG"
+    sleep 540
+    continue
+  fi
   if timeout 180 python -c "import jax; assert jax.devices()[0].platform=='tpu'" 2>/dev/null; then
     echo "$(date +%H:%M:%S) TUNNEL UP - running $next" >> "$LOG"
     case "$next" in
