@@ -209,12 +209,44 @@ def measure(opt_level, batch, image_size, iters, trace_dir=None,
     return iters * batch / dt, dt / iters * 1e3, flops
 
 
-def bench_bert(iters=10, batch=32, seq_len=128, config="base"):
-    """Second model family on hardware: BERT pretraining train-step
-    throughput (seq/s), amp O2 + FusedLAMB — the reference's other
-    flagship config (its LAMB kernels exist FOR downstream BERT,
-    SURVEY §2.2 amp_C note). Returns seq/s, step ms, and step TFLOPs
-    from XLA cost analysis."""
+def _peak_bf16():
+    import jax
+    kind = jax.devices()[0].device_kind
+    return next((v for key, v in PEAK_BF16 if key in kind.lower()), None)
+
+
+def _bert_model_flops(cfg, batch, seq):
+    """Analytic MODEL FLOPs for one BERT pretraining train step (PaLM
+    MFU convention): dense matmuls (2*M*N*K per matmul) on every token
+    plus the attention score/value contractions, backward = 2x forward.
+    This is the math the MODEL requires — identical for the flash and
+    non-flash implementations, so their MFU is directly comparable
+    (XLA's cost analysis cannot see inside the Pallas custom call).
+    Pooler/NSP ([CLS]-only) are negligible and omitted."""
+    h, L = cfg.hidden_size, cfg.num_hidden_layers
+    f, v = cfg.intermediate_size, cfg.vocab_size
+    # per-token matmul weights: QKV+out (4h^2) + MLP (2hf) per layer,
+    # then MLM transform (h^2) + vocab decoder (h*v) on every position
+    dense = L * (4 * h * h + 2 * h * f) + h * h + h * v
+    fwd = 2.0 * batch * seq * dense + 4.0 * L * batch * seq * seq * h
+    return 3.0 * fwd
+
+
+def bench_bert(iters=8, batch=128, seq_len=128, flash=False,
+               config="base"):
+    """BERT pretraining train-step throughput + MFU — the MXU-bound
+    workload where software quality (not HBM bandwidth) decides, per the
+    round-3 roofline: ResNet-50 on v5e is bandwidth-capped at ~31% MFU,
+    BERT is not. BASELINE config 4: BERT + FusedLAMB + FusedLayerNorm +
+    amp O2 (the reference's LAMB/LayerNorm CUDA kernels exist FOR this
+    workload — /root/reference/csrc/multi_tensor_lamb_stage_1.cu:84-116,
+    layer_norm_cuda_kernel.cu:280).
+
+    ``flash=True`` swaps the encoder onto the Pallas flash-attention
+    kernel via the ``attention_fn`` seam. ``mfu`` divides analytic model
+    FLOPs (:func:`_bert_model_flops`) by step time x chip peak;
+    ``step_tflops_xla`` (non-flash only) is XLA's own count alongside,
+    as a cross-check."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -225,8 +257,12 @@ def bench_bert(iters=10, batch=32, seq_len=128, config="base"):
                vocab_size=1024, hidden_size=128, num_hidden_layers=2,
                num_attention_heads=2, intermediate_size=512,
                max_position_embeddings=seq_len)}[config]
+    attention_fn = None
+    if flash:
+        from apex_tpu.ops.flash_attention import make_flash_attention
+        attention_fn = make_flash_attention()   # bidirectional BERT
     model, optimizer = amp.initialize(
-        models.BertForPreTraining(cfg),
+        models.BertForPreTraining(cfg, attention_fn=attention_fn),
         optimizers.FusedLAMB(
             lr=1e-4, max_grad_norm=1.0,
             param_groups=[{"match": r"(bias|_ln)", "weight_decay": 0.0}],
@@ -252,7 +288,7 @@ def bench_bert(iters=10, batch=32, seq_len=128, config="base"):
         return params, opt_state, loss
 
     compiled = train_step.lower(params, opt_state, ids, labels).compile()
-    flops = _flops_of(compiled)
+    flops_xla = _flops_of(compiled)
     params, opt_state, loss = compiled(params, opt_state, ids, labels)
     float(loss)
     t0 = time.perf_counter()
@@ -260,11 +296,149 @@ def bench_bert(iters=10, batch=32, seq_len=128, config="base"):
         params, opt_state, loss = compiled(params, opt_state, ids, labels)
     float(loss)
     dt = time.perf_counter() - t0
+    step_s = dt / iters
+    model_flops = _bert_model_flops(cfg, batch, seq_len)
     out = {"config": config, "batch": batch, "seq_len": seq_len,
+           "flash": flash,
            "seq_per_sec": round(iters * batch / dt, 1),
-           "step_time_ms": round(dt / iters * 1e3, 2)}
-    if flops:
-        out["step_tflops"] = round(flops / 1e12, 3)
+           "tokens_per_sec": round(iters * batch * seq_len / dt),
+           "step_time_ms": round(step_s * 1e3, 2),
+           "model_tflops_per_step": round(model_flops / 1e12, 3)}
+    peak = _peak_bf16()
+    if peak:
+        out["mfu"] = round(model_flops / step_s / peak, 4)
+        out["mfu_convention"] = "analytic model FLOPs (PaLM), bwd=2x fwd"
+    if flops_xla and not flash:   # XLA can't count the Pallas call
+        out["step_tflops_xla"] = round(flops_xla / 1e12, 3)
+    return out
+
+
+def bench_ulysses(iters=5, b=1, s=8192, h=8, d=64):
+    """Ulysses sequence-parallel attention timed on hardware. One chip
+    means sp=1: the ``all_to_all``s are DEGENERATE (size-1 axis, no
+    ICI), so this times the compiled Ulysses code path + its flash
+    composition and the overhead of the degenerate collectives vs a
+    plain flash call at the same shape. Multi-hop correctness/grads are
+    pinned on the 8-device CPU mesh
+    (tests/distributed/test_sequence_parallel.py)."""
+    import numpy as _np
+
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+    from apex_tpu.ops.flash_attention import flash_attention
+    from apex_tpu.parallel.sequence import ulysses_attention
+
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q, k, v = (jax.random.normal(kk, (b, s, h, d), jnp.bfloat16)
+               for kk in ks)
+    mesh = Mesh(_np.asarray(jax.devices()[:1]), ("sp",))
+    att = shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v, axis_name="sp",
+                                          causal=True),
+        mesh=mesh, in_specs=(P(None, "sp"),) * 3,
+        out_specs=P(None, "sp"), check_vma=False)
+
+    def timed(fn):
+        @jax.jit
+        def fwd_bwd(q, k, v):
+            f = lambda *a: fn(*a).astype(jnp.float32).sum()
+            return jax.value_and_grad(f, argnums=(0, 1, 2))(q, k, v)
+        l, _ = fwd_bwd(q, k, v)
+        float(l)                       # host fetch = the only real sync
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            l, _ = fwd_bwd(q, k, v)
+        float(l)
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    t_ulysses = timed(att)
+    t_plain = timed(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    return {"shape": f"b{b} s{s} h{h} d{d} bf16 causal",
+            "sp": 1,
+            "ulysses_ms": round(t_ulysses, 2),
+            "plain_flash_ms": round(t_plain, 2),
+            "overhead_pct": round((t_ulysses / t_plain - 1) * 100, 1),
+            "note": "sp=1 on one chip: all_to_all degenerate; "
+                    "multi-hop numerics live on the 8-dev CPU mesh"}
+
+
+def bench_realdata(steps=12, batch=256, image_size=224, n_images=512):
+    """End-to-end REAL-DATA training leg (VERDICT r3 missing #2): JPEG
+    ImageFolder -> native batch decode -> host-side s2d transform ->
+    device prefetch -> the same compiled O2 train step as the headline.
+    Reports the loader-only rate, the end-to-end rate, and the
+    synthetic-data rate of the same executable, so the bottleneck is
+    explicit. On THIS 1-core host the loader rate caps the e2e rate
+    (~a fifth of the train rate); the capacity model is
+    per-core decode x host cores >= train rate — a production v5e host
+    has dozens of cores (reference's answer to the same problem:
+    multi-worker DataLoader, main_amp.py:218-225)."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    from tools.data_bench import make_dataset
+
+    from apex_tpu.data.loaders import (image_folder_loader,
+                                       prefetch_to_device, s2d_batches)
+
+    step, args = build_step("O2", batch, image_size, stem="s2d_pre")
+    params, batch_stats, opt_state, x, y = args
+    compiled = step.lower(params, batch_stats, opt_state, x, y).compile()
+
+    # loaders ship uint8 (4x fewer host->device bytes than float32, the
+    # whole point of on-device normalization — examples/imagenet
+    # main_amp.py does the same); scalar mean/std: identical arithmetic
+    # cost to per-channel, and layout-agnostic under the s2d transform
+    @jax.jit
+    def to_f32(xb):
+        return (xb.astype(jnp.float32) - 127.5) / 58.0
+
+    p, bs, os_ = params, batch_stats, opt_state
+    p, bs, os_, loss = compiled(p, bs, os_, x, y)      # warmup
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        p, bs, os_, loss = compiled(p, bs, os_, x, y)
+    float(loss)
+    synth_ips = steps * batch / (time.perf_counter() - t0)
+
+    out = {"batch": batch, "steps": steps, "host_cores": os.cpu_count(),
+           "synthetic_img_s": round(synth_ips, 1)}
+    with tempfile.TemporaryDirectory(prefix="apex_tpu_realdata_") as root:
+        make_dataset(root, n_images)
+
+        def fresh():
+            return s2d_batches(image_folder_loader(
+                root, batch, image_size=image_size, train=True, seed=3,
+                native=True))
+
+        it = fresh()
+        next(it)                                       # warm pools
+        t0 = time.perf_counter()
+        for _ in range(4):
+            next(it)
+        out["loader_img_s"] = round(4 * batch / (time.perf_counter() - t0), 1)
+
+        it = prefetch_to_device(fresh(), size=2)
+        xb, yb = next(it)
+        p, bs, os_, loss = compiled(p, bs, os_, to_f32(xb), yb)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            xb, yb = next(it)
+            p, bs, os_, loss = compiled(p, bs, os_, to_f32(xb), yb)
+        float(loss)
+        out["e2e_img_s"] = round(steps * batch / (time.perf_counter() - t0), 1)
+    out["bottleneck"] = ("host_decode" if out["e2e_img_s"] <
+                         0.9 * out["synthetic_img_s"] else "device")
+    # loader_img_s uses every core on this host; the PER-CORE capacity
+    # model (cores needed to feed the chip) lives in the input_pipeline
+    # section's decode_img_s_by_threads["1"], not here
+    out["loader_vs_synthetic"] = round(
+        out["loader_img_s"] / synth_ips, 2) if synth_ips else None
     return out
 
 
@@ -420,7 +594,10 @@ def bench_input_pipeline():
                 import numpy as _np
                 seeds = _np.asarray(seeds, _np.uint64)
                 scaling = {}
-                for nt in sorted({1, os.cpu_count() or 1}):
+                # 1/2/4/8 regardless of core count: on a 1-core box the
+                # curve is honestly flat (threads can't beat cores) and
+                # the per-thread number is the per-core capacity model
+                for nt in sorted({1, 2, 4, 8, os.cpu_count() or 1}):
                     native_ops.decode_jpeg_batch(
                         paths, 224, train=True, seeds=seeds,
                         n_threads=nt)  # warm
@@ -541,6 +718,10 @@ def _attach_last_live_tpu(result):
     out = {}
     for rec in _read_followup_records():
         sec = rec.get("section")
+        # gave_up markers (tools/watcher_queue.py) are queue state, not
+        # measurements — they must never overwrite real cached results
+        if rec.get("gave_up"):
+            continue
         if sec and "error" not in rec and sec not in (
                 "probe", "watchdog", "fatal"):
             out[sec] = {k: v for k, v in rec.items()
@@ -604,7 +785,7 @@ def main():
     else:  # CPU fallback / CI smoke: tiny shapes, same code path
         batch, image_size, iters = 8, 32, 3
 
-    peak = next((v for key, v in PEAK_BF16 if key in kind.lower()), None)
+    peak = _peak_bf16()
 
     def record_o2(ips, step_ms, flops, b):
         """All headline fields from ONE measurement — value, batch,
@@ -690,18 +871,53 @@ def main():
             extras["moe_dispatch"] = bench_moe()
         except Exception as e:
             _note("moe_dispatch", e)
+    # BERT-base MFU — the MXU-bound workload where match-or-beat is
+    # decided (ResNet on v5e is bandwidth-capped ~31%, BENCH_NOTES
+    # roofline); one leg here, the full flash/seq sweep rides the
+    # watcher queue (tools/bench_followup.py --sections bert*)
+    if on_tpu and time.perf_counter() - START < BUDGET_S:
+        try:
+            extras["bert"] = bench_bert()
+        except Exception as e:
+            _note("bert", e)
     if time.perf_counter() - START < BUDGET_S:
         try:
             extras["input_pipeline"] = bench_input_pipeline()
             ip = extras["input_pipeline"]
             per_core = max(ip.get("decode_img_s_by_threads",
                                   {}).get("1", 0.0), 0.0)
-            if per_core and result["value"] > 0:
+            # denominator must be a TPU train rate — this run's if live,
+            # else the most recent live-window O2 (a CPU-fallback rate
+            # would make the answer meaningless, VERDICT r3 weak #4)
+            train_rate, rate_ref = None, None
+            if on_tpu and result["value"] > 0:
+                train_rate = result["value"]
+                rate_ref = {"img_s": train_rate, "source": "this_run",
+                            "batch": result.get("batch"),
+                            "stem": result.get("stem")}
+            else:
+                # prefer the headline config (b256/s2d_pre) like
+                # _cached_ceiling_fallback; else most recent o2, with
+                # its config recorded so the ratio stays like-for-like
+                recs = [r for r in _read_followup_records()
+                        if r.get("section") == "o2" and "error" not in r]
+                match = [r for r in recs if r.get("batch") == 256
+                         and r.get("stem") == "s2d_pre"] or recs
+                if match:
+                    rec = match[-1]
+                    train_rate = rec.get("images_per_sec")
+                    rate_ref = {"img_s": train_rate,
+                                "source": "last_live_tpu_o2",
+                                "batch": rec.get("batch"),
+                                "stem": rec.get("stem"),
+                                "adam_layout": rec.get("adam_layout")}
+            if per_core and train_rate:
                 # how many host cores the native decode needs to feed
-                # the measured train rate (one thread per image, GIL
+                # the TPU train rate (one thread per image, GIL
                 # released; a v5e host has dozens of cores)
                 ip["cores_to_feed_train_rate"] = int(
-                    -(-result["value"] // per_core))
+                    -(-train_rate // per_core))
+                ip["train_rate_ref"] = rate_ref
         except Exception as e:
             _note("input_pipeline", e)
     # FusedAdam layout A/B on the FULL step — deliberately LAST: the

@@ -8,7 +8,8 @@ individually fenced, and appends every completed section as its own
 JSON line to ``BENCH_FOLLOWUP.jsonl`` IMMEDIATELY — a mid-run wedge
 loses only the section in flight, never completed ones.
 
-Usage: python tools/bench_followup.py [--sections o3,flash,adam,moe,bert]
+Usage: python tools/bench_followup.py \
+    [--sections o3,flash,adam,moe,bert,bert_flash,bert512,bert512_flash,realdata,ulysses]
 """
 
 import argparse
@@ -36,14 +37,19 @@ def log(section, payload):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--sections", default="o3,flash,adam,moe,bert",
-                    help="comma list: o3,flash,adam,moe,bert")
+                    help="comma list: o3,flash,adam,moe,bert,"
+                         "bert_flash,bert512,bert512_flash,realdata,ulysses")
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--stem", default="s2d_pre")
     ap.add_argument("--o2", action="store_true",
                     help="also re-measure O2 at --batch/--stem (for a "
                          "fresh like-for-like ratio in one window)")
     args = ap.parse_args()
-    sections = set(args.sections.split(","))
+    # queue names (tools/watcher_queue.py) are accepted as aliases so
+    # the watcher shell needs no name-mapping case table
+    aliases = {"o3_ceiling": "o3", "flash_attention": "flash",
+               "fused_adam": "adam", "moe_dispatch": "moe"}
+    sections = {aliases.get(s, s) for s in args.sections.split(",")}
 
     import bench  # reuse the fenced helpers; bench owns the probe logic
 
@@ -110,6 +116,40 @@ def main():
             log("bert", bench.bench_bert())
         except Exception as e:
             log("bert", {"error": f"{type(e).__name__}: {e}"})
+
+    if "bert_flash" in sections:
+        try:
+            log("bert_flash", bench.bench_bert(flash=True))
+        except Exception as e:
+            log("bert_flash", {"error": f"{type(e).__name__}: {e}"})
+
+    # phase-2 pretraining shape (seq 512) — flash should win here; the
+    # two legs are SEPARATE sections so the watcher queue tracks/retries
+    # each independently (a wedge after the first must not mark both done)
+    if "bert512" in sections:
+        try:
+            log("bert512", bench.bench_bert(batch=32, seq_len=512))
+        except Exception as e:
+            log("bert512", {"error": f"{type(e).__name__}: {e}"})
+
+    if "bert512_flash" in sections:
+        try:
+            log("bert512_flash",
+                bench.bench_bert(batch=32, seq_len=512, flash=True))
+        except Exception as e:
+            log("bert512_flash", {"error": f"{type(e).__name__}: {e}"})
+
+    if "realdata" in sections:
+        try:
+            log("realdata", bench.bench_realdata())
+        except Exception as e:
+            log("realdata", {"error": f"{type(e).__name__}: {e}"})
+
+    if "ulysses" in sections:
+        try:
+            log("ulysses", bench.bench_ulysses())
+        except Exception as e:
+            log("ulysses", {"error": f"{type(e).__name__}: {e}"})
 
 
 if __name__ == "__main__":

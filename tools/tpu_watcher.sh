@@ -2,38 +2,20 @@
 # Probes the axon TPU tunnel every ~9 min; whenever it is live, runs the
 # next PENDING item of the hardware queue — each item in its own process
 # so a mid-compile wedge loses only that item, never the window. Repeats
-# until every item has a recorded success, then exits.
-# Queue state is derived from artifacts, not kept in memory, so the
-# watcher survives restarts. Log: /tmp/tpu_watcher.log
+# until every item has a recorded success or an explicit give-up record.
+#
+# ALL queue state is artifact-derived via tools/watcher_queue.py
+# (BENCH_FOLLOWUP.jsonl results + WATCHER_ATTEMPTS.jsonl retry budget),
+# so the watcher survives restarts WITHOUT resetting retry budgets, and
+# give-ups are recorded as {"section": S, "gave_up": true} lines rather
+# than silently dropped (ADVICE r3). Log: /tmp/tpu_watcher.log
 cd "$(dirname "$0")/.."
 LOG=/tmp/tpu_watcher.log
-# fresh attempt budget per watcher launch: the give-up counters below
-# read "running X" lines from this log, and stale lines from a previous
-# measurement round would exhaust retries before anything runs
-: > "$LOG"
-
-sec_done() {  # recorded success, or given up after 4 live attempts
-  grep "\"section\": \"$1\"" BENCH_FOLLOWUP.jsonl 2>/dev/null | grep -qv '"error"' && return 0
-  n=$(grep -c "running $1\$" "$LOG" 2>/dev/null); [ "${n:-0}" -ge 4 ]
-}
-
-pending() {
-  for s in o3_ceiling flash_attention fused_adam moe_dispatch bert; do
-    sec_done "$s" || { echo "$s"; return; }
-  done
-  kp=$(grep -c 'running kernel_parity$' "$LOG" 2>/dev/null)
-  if ! grep -q '"all_pass": true' KERNEL_PARITY_r03.json 2>/dev/null \
-      && [ "${kp:-0}" -lt 4 ]; then
-    echo kernel_parity; return
-  fi
-  sec_done tp_pp_bf16 || { echo tp_pp_bf16; return; }
-  echo none
-}
 
 while true; do
-  next=$(pending)
+  next=$(python tools/watcher_queue.py next)
   if [ "$next" = none ]; then
-    echo "$(date +%H:%M:%S) queue empty - exiting" >> "$LOG"
+    echo "$(date +%H:%M:%S) $(python tools/watcher_queue.py status) - exiting" >> "$LOG"
     exit 0
   fi
   if pgrep -f "python bench.py" >/dev/null 2>&1; then
@@ -45,15 +27,16 @@ while true; do
   fi
   if timeout 180 python -c "import jax; assert jax.devices()[0].platform=='tpu'" 2>/dev/null; then
     echo "$(date +%H:%M:%S) TUNNEL UP - running $next" >> "$LOG"
+    python tools/watcher_queue.py start "$next"
+    # only two sections have their own runners; everything else goes to
+    # bench_followup, which accepts queue names directly (alias map in
+    # its main) — so adding a QUEUE entry needs no change here
     case "$next" in
-      o3_ceiling)      timeout 1800 python tools/bench_followup.py --sections o3   >> "$LOG" 2>&1 ;;
-      flash_attention) timeout 1800 python tools/bench_followup.py --sections flash >> "$LOG" 2>&1 ;;
-      fused_adam)      timeout 1800 python tools/bench_followup.py --sections adam >> "$LOG" 2>&1 ;;
-      moe_dispatch)    timeout 1800 python tools/bench_followup.py --sections moe  >> "$LOG" 2>&1 ;;
-      bert)            timeout 1800 python tools/bench_followup.py --sections bert >> "$LOG" 2>&1 ;;
-      kernel_parity)   timeout 1800 python tools/kernel_parity.py > KERNEL_PARITY_r03.json 2>>"$LOG" ;;
+      kernel_parity)   timeout 1800 python tools/kernel_parity.py > KERNEL_PARITY_r04.json 2>>"$LOG" ;;
       tp_pp_bf16)      timeout 1500 python tools/tp_pp_bf16_check.py >> "$LOG" 2>&1 ;;
+      *)               timeout 1800 python tools/bench_followup.py --sections "$next" >> "$LOG" 2>&1 ;;
     esac
+    python tools/watcher_queue.py finish "$next" >> "$LOG" 2>&1
     echo "$(date +%H:%M:%S) $next attempt finished" >> "$LOG"
     sleep 10   # tiny gap, then loop re-probes before the next item
   else
