@@ -633,17 +633,21 @@ class PipelinedBert:
         Composes with ``batch_axis`` (grads are global-batch means, as
         DDP semantics require), and with ``seq_axis`` for SCAN-FREE
         sequence-parallel attention (Ulysses: all_to_all + local
-        attention).  The distinction, measured 2026-07-31 on the CPU
-        backend: the schedule's fwd/bwd alternation is per-device
-        control flow (``lax.cond`` on the stage index), and plain
-        collectives inside those branches compose exactly (every sp
-        shard of a stage takes the same branch), but a
-        collective-CARRYING ``lax.scan`` — the ring's per-hop loop —
-        miscomputes even at sp=1 where its ppermutes are self-loops.
-        Attention factories advertise this via ``onef1b_compatible``
+        attention).  The ring exclusion was root-caused in round 4
+        (``tools/repro_ring_1f1b.py``, bisected variants A-K): it is an
+        **XLA SPMD-partitioner miscompile, not a semantic constraint**
+        — every minimal collective-in-divergent-branch form computes
+        correctly, but with a scan-carried sp-ppermute inside the
+        schedule's pipe-divergent cond branches, the non-first stage's
+        inject/inbox ``where(axis_index==0, ...)`` select resolves to
+        the wrong side (stage 1 silently computes on the raw microbatch
+        instead of its inbox; reproduces at sp=1 where the ppermute is
+        a no-op self-loop, ~40-line repro, jax 0.9.0).  Attention
+        factories advertise the fence via ``onef1b_compatible``
         (``make_ulysses_attention`` True, ``make_ring_attention``
-        False); ring-SP stays on the GPipe schedule, as does
-        ``tp_axis``.  Under ``seq_axis`` the last-stage loss
+        False); ring-SP stays on the GPipe schedule — one uniform
+        program, no divergent cond for the partitioner to get wrong —
+        as does ``tp_axis``.  Under ``seq_axis`` the last-stage loss
         all_gathers the microbatch hidden over sp (mb-sized, cheap) so
         ``loss_fn`` stays sequence-oblivious; the gather replicates
         the loss computation per sp shard and its transpose sums the

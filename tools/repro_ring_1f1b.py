@@ -1,0 +1,463 @@
+"""Minimal repro for the ring-in-1F1B exclusion (VERDICT r3 weak #6).
+
+Round-3 finding (commit bcee05d, docs/parallel.md): inside the 1F1B
+schedule's per-stage ``lax.cond`` branches — control flow whose
+predicate DIVERGES across the pipe axis — a collective-carrying inner
+``lax.scan`` (ring attention's KV rotation) miscomputes, even at sp=1
+where every ``ppermute`` is a self-loop. This script strips the model,
+the schedule, and the autodiff away and tests the four smallest
+programs that bracket the failure, on a (pipe=2, sp=1) virtual CPU
+mesh (same backend the finding was made on):
+
+  A. scan+ppermute OUTSIDE any cond           (control: must pass)
+  B. plain ppermute INSIDE a divergent cond   (collective, no scan)
+  C. scan WITHOUT collective INSIDE the cond  (scan, no collective)
+  D. scan+ppermute INSIDE the divergent cond  (the 1F1B+ring shape)
+
+Each variant computes, per device, a quantity with a closed-form
+expected value that does not depend on which branch ran on which
+device. PASS/FAIL per variant pins whether the unsound ingredient is
+the collective-in-divergent-cond (B fails), the scan-in-cond (C
+fails), or specifically their nesting (only D fails).
+
+Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+         python tools/repro_ring_1f1b.py
+(or any device count >= 2; the mesh uses pipe=2, sp=1).
+
+Round-4 outcome: A-H all PASS — the round-3 hypothesis "collectives
+inside divergent branches are unsound" is FALSIFIED. The failure needs
+the schedule's inject/inbox select: variant K (~40 lines) is the
+minimal repro — with the (no-op, sp=1) ring ppermute present, stage
+1's ``where(axis_index==0, injected, inbox)`` reads the WRONG side;
+its collective-free control is exact. Variant F shows the same defect
+through the public onef1b_spmd API against a monolithic-grad oracle
+(expected: K_minimal_select_ring and F_onef1b_spmd_ring_stage_fn FAIL,
+everything else PASSES). Verdict: XLA SPMD-partitioner miscompile
+(upstream-reportable via K; zero-egress box, so recorded here instead),
+NOT a semantic constraint — see variant K's docstring and
+docs/parallel.md.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2").strip()
+
+import jax
+
+if __name__ == "__main__":
+    # the env var alone is not enough: this environment's TPU plugin
+    # programmatically overrides jax_platforms (see __graft_entry__)
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+N_STEPS = 3
+
+
+def _scan_rotate(x):
+    """The ring pattern: scan that ppermutes its carry each step and
+    accumulates. At sp=1 the ppermute is a self-loop, so this equals
+    N_STEPS * x regardless of device."""
+
+    def body(c, _):
+        c = lax.ppermute(c, "sp", [(0, 0)])
+        return c, c
+
+    _, ys = lax.scan(body, x, None, length=N_STEPS)
+    return ys.sum(0)
+
+
+def _scan_plain(x):
+    def body(c, _):
+        return c, c
+
+    _, ys = lax.scan(body, x, None, length=N_STEPS)
+    return ys.sum(0)
+
+
+def variant_a(x):
+    """scan+ppermute, NO cond (control)."""
+    return _scan_rotate(x)
+
+
+def variant_b(x):
+    """plain self-loop ppermute inside a pipe-divergent cond."""
+    stage = lax.axis_index("pipe")
+    return lax.cond(stage == 0,
+                    lambda v: lax.ppermute(v, "sp", [(0, 0)]) * 1.0,
+                    lambda v: lax.ppermute(v, "sp", [(0, 0)]) * 1.0,
+                    x) * N_STEPS
+
+
+def variant_c(x):
+    """collective-free scan inside the divergent cond."""
+    stage = lax.axis_index("pipe")
+    return lax.cond(stage == 0, _scan_plain, _scan_plain, x)
+
+
+def variant_d(x):
+    """scan+ppermute inside the divergent cond — the 1F1B+ring shape."""
+    stage = lax.axis_index("pipe")
+    return lax.cond(stage == 0, _scan_rotate, _scan_rotate, x)
+
+
+def run(fn, name):
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2, 1), ("pipe", "sp"))
+    f = shard_map(fn, mesh=mesh, in_specs=P("pipe"),
+                  out_specs=P("pipe"), check_vma=False)
+    x = jnp.arange(4, dtype=jnp.float32).reshape(4, 1) + 1.0
+    try:
+        got = np.asarray(jax.jit(f)(x))
+        want = np.asarray(x) * N_STEPS
+        ok = np.allclose(got, want)
+        detail = "" if ok else f" got={got.ravel()} want={want.ravel()}"
+        print(f"{name}: {'PASS' if ok else 'FAIL'}{detail}")
+        return ok
+    except Exception as e:
+        print(f"{name}: RAISED {type(e).__name__}: {e}")
+        return False
+
+
+def variant_e():
+    """The 1F1B skeleton faithfully: an OUTER scan over ticks, a cond
+    whose parity predicate diverges across pipe, and DIFFERENT branch
+    bodies — forward runs the ring scan, backward runs its vjp (the
+    transposed ring scan). 4 ticks => every device takes each branch
+    exactly twice; expected = 2*(3x) + 2*(3*ones), device-invariant."""
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2, 1), ("pipe", "sp"))
+
+    def per_device(x):
+        stage = lax.axis_index("pipe")
+
+        def fwd(c):
+            return c + _scan_rotate(x)
+
+        def bwd(c):
+            y, vjp = jax.vjp(_scan_rotate, x)
+            (dx,) = vjp(jnp.ones_like(y))
+            return c + dx
+
+        def tick(c, t):
+            return lax.cond((t + stage) % 2 == 0, fwd, bwd, c), None
+
+        out, _ = lax.scan(tick, jnp.zeros_like(x), jnp.arange(4))
+        return out
+
+    f = shard_map(per_device, mesh=mesh, in_specs=P("pipe"),
+                  out_specs=P("pipe"), check_vma=False)
+    x = jnp.arange(4, dtype=jnp.float32).reshape(4, 1) + 1.0
+    try:
+        got = np.asarray(jax.jit(f)(x))
+        want = 2 * N_STEPS * np.asarray(x) + 2 * N_STEPS * np.ones_like(x)
+        ok = np.allclose(got, want)
+        detail = "" if ok else f" got={got.ravel()} want={want.ravel()}"
+        print(f"E 1F1B skeleton (scan>cond>ring fwd/vjp): "
+              f"{'PASS' if ok else 'FAIL'}{detail}")
+        return ok
+    except Exception as e:
+        print(f"E 1F1B skeleton: RAISED {type(e).__name__}: {e}")
+        return False
+
+
+def variant_g(ring=True):
+    """Skeleton + the schedule's remaining ingredient: a UNIFORM pipe
+    ppermute of the branch outputs inside the same scan body (the
+    x_inbox/g_inbox hops) — i.e. a cross-axis composition: ppermute
+    over 'pipe' of a value produced by a divergent cond branch whose
+    body scans a ppermute over 'sp'. Expected value simulated in numpy
+    tick-for-tick."""
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2, 1), ("pipe", "sp"))
+    inner = _scan_rotate if ring else _scan_plain
+    swap = [(0, 1), (1, 0)]
+
+    def per_device(x):
+        stage = lax.axis_index("pipe")
+
+        def fwd(c):
+            return c + inner(x)
+
+        def bwd(c):
+            y, vjp = jax.vjp(inner, x)
+            (dx,) = vjp(jnp.ones_like(y))
+            return c + dx
+
+        def tick(c, t):
+            c = lax.cond((t + stage) % 2 == 0, fwd, bwd, c)
+            return lax.ppermute(c, "pipe", swap), None
+
+        out, _ = lax.scan(tick, jnp.zeros_like(x), jnp.arange(4))
+        return out
+
+    f = shard_map(per_device, mesh=mesh, in_specs=P("pipe"),
+                  out_specs=P("pipe"), check_vma=False)
+    x = jnp.arange(4, dtype=jnp.float32).reshape(4, 1) + 1.0
+    try:
+        got = np.asarray(jax.jit(f)(x))
+        # numpy simulation of the same program (sp=1: inner(x) == 3x,
+        # vjp contribution == 3*ones)
+        xs = np.asarray(x).reshape(2, 2, 1)
+        c = [np.zeros((2, 1), np.float32) for _ in range(2)]
+        for t in range(4):
+            nxt = [None, None]
+            for d in range(2):
+                contrib = (N_STEPS * xs[d] if (t + d) % 2 == 0
+                           else N_STEPS * np.ones_like(xs[d]))
+                nxt[d] = c[d] + contrib
+            c = [nxt[1], nxt[0]]                 # the pipe swap
+        want = np.concatenate(c, 0)
+        ok = np.allclose(got, want.reshape(got.shape))
+        detail = "" if ok else f" got={got.ravel()} want={want.ravel()}"
+        tag = "ring" if ring else "control"
+        print(f"G skeleton + pipe hop ({tag}): "
+              f"{'PASS' if ok else 'FAIL'}{detail}")
+        return ok
+    except Exception as e:
+        print(f"G skeleton + pipe hop: RAISED {type(e).__name__}: {e}")
+        return False
+
+
+def variant_h(ring=True):
+    """Closest skeleton yet: G plus the schedule's remaining structure —
+    a NESTED divergent cond inside the backward branch (the schedule's
+    stage==last tail/mid split), two branch outputs routed through two
+    different NON-cyclic pipe ppermutes (fwd_perm/bwd_perm, zero-filled
+    at the ends), and the vjp taken wrt BOTH a param and the input."""
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2, 1), ("pipe", "sp"))
+    inner = _scan_rotate if ring else _scan_plain
+    fwd_perm = [(0, 1)]
+    bwd_perm = [(1, 0)]
+
+    def stage(w, x):
+        return inner(x) * w
+
+    def per_device(w, x):
+        st = lax.axis_index("pipe")
+
+        def fwd(c):
+            y = stage(w, x)
+            return c, y, jnp.zeros_like(x)
+
+        def bwd(c):
+            def tail(_):
+                y, vjp = jax.vjp(stage, w, x)
+                dw, dx = vjp(jnp.ones_like(y))
+                return dw, dx
+
+            def mid(_):
+                y, vjp = jax.vjp(stage, w, x)
+                dw, dx = vjp(2.0 * jnp.ones_like(y))
+                return dw, dx
+
+            dw, dx = lax.cond(st == 1, tail, mid, None)
+            return c + dw, jnp.zeros_like(x), dx
+
+        def tick(c, t):
+            c, y_out, g_out = lax.cond((t + st) % 2 == 0, fwd, bwd, c)
+            y_in = lax.ppermute(y_out, "pipe", fwd_perm)
+            g_in = lax.ppermute(g_out, "pipe", bwd_perm)
+            return c + y_in.sum() * 0.0 + g_in.sum() * 0.0, None
+
+        out, _ = lax.scan(tick, jnp.zeros(()), jnp.arange(4))
+        return out.reshape(1)
+
+    f = shard_map(per_device, mesh=mesh, in_specs=(P(), P("pipe")),
+                  out_specs=P("pipe"), check_vma=False)
+    w = jnp.asarray(2.0)
+    x = jnp.arange(4, dtype=jnp.float32).reshape(4, 1) + 1.0
+    try:
+        got = np.asarray(jax.jit(f)(w, x)).ravel()
+        # per device: 2 bwd ticks, each dw = seed * sum(inner(x_local))
+        # with seed 1.0 on stage 1, 2.0 on stage 0; inner sums 3*x
+        xs = np.asarray(x).reshape(2, 2, 1)
+        want = np.asarray([2 * 2.0 * N_STEPS * xs[0].sum(),
+                           2 * 1.0 * N_STEPS * xs[1].sum()])
+        ok = np.allclose(got, want)
+        detail = "" if ok else f" got={got} want={want}"
+        tag = "ring" if ring else "control"
+        print(f"H nested-cond + noncyclic hops ({tag}): "
+              f"{'PASS' if ok else 'FAIL'}{detail}")
+        return ok
+    except Exception as e:
+        print(f"H nested-cond + noncyclic hops: RAISED "
+              f"{type(e).__name__}: {e}")
+        return False
+
+
+def variant_k(ring=True):
+    """THE MINIMAL REPRO (round-4 bisection result). Ingredients, all
+    required:
+
+      - outer ``lax.scan``; body: ``lax.cond`` with a pipe-divergent
+        parity predicate (the 1F1B fwd/bwd alternation);
+      - the branch computes ``x_in = where(axis_index('pipe')==0,
+        replicated_input, carry_inbox)`` — the schedule's
+        first-stage-injects-else-consume-inbox select — and feeds it
+        through a scan carrying a ppermute over the OTHER axis 'sp'
+        (the ring rotation; sp=1 here, so it is semantically a no-op
+        self-loop);
+      - the branch output rides a 'pipe' ppermute into the next tick's
+        inbox (the activation hop).
+
+    Observed (jax 0.9.0, CPU backend, 2 virtual devices): with the
+    sp-ppermute present, device 1's select takes the WRONG side — it
+    reads the replicated input instead of its inbox, i.e. stage 1
+    computes on the raw microbatch instead of stage 0's output. The
+    collective-free control (identical program minus the no-op
+    ppermute) is exact. Every coarser composition (variants A-H)
+    computes correctly, and the sp groups here are singletons — every
+    group member executes the collective whenever its branch is taken —
+    so SPMD collective semantics are respected and this is a compiler
+    (SPMD partitioner) bug, not a program error. This is why
+    ``PipelinedBert.loss_and_grad_1f1b`` fences off ring-SP: the
+    fence guards against an XLA miscompile, not a semantic
+    impossibility."""
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2, 1), ("pipe", "sp"))
+    inner = _scan_rotate if ring else _scan_plain
+
+    def per_device(xfull):
+        st = lax.axis_index("pipe")
+        w = st.astype(jnp.float32) + 2.0          # stage0: *2, stage1: *3
+
+        def fwd(args):
+            inbox, acc, t = args
+            x_in = jnp.where(st == 0, xfull, inbox)   # the suspect select
+            y = inner(x_in) * w
+            acc = acc + jnp.where(t == st, y, 0.0)    # keep tick t==st
+            return y, acc
+
+        def bwd(args):
+            inbox, acc, t = args
+            return jnp.zeros_like(inbox), acc
+
+        def tick(c, t):
+            inbox, acc = c
+            y_out, acc = lax.cond((t - st) % 2 == 0, fwd, bwd,
+                                  (inbox, acc, t))
+            inbox = lax.ppermute(y_out, "pipe", [(0, 1)])
+            return (inbox, acc), None
+
+        z = jnp.zeros_like(xfull)
+        (_, acc), _ = lax.scan(tick, (z, z), jnp.arange(4))
+        return acc[None]
+
+    f = shard_map(per_device, mesh=mesh, in_specs=P(),
+                  out_specs=P("pipe"), check_vma=False)
+    x = jnp.arange(4, dtype=jnp.float32) + 1.0
+    try:
+        got = np.asarray(jax.jit(f)(x))
+        xs = np.asarray(x)
+        # stage0 emits 2*(3x); stage1 consumes it: 3*(3*(6x)) = 54x
+        want = np.stack([2 * N_STEPS * xs,
+                         3 * N_STEPS * (2 * N_STEPS * xs)])
+        ok = np.allclose(got, want)
+        detail = "" if ok else (f" got={got.ravel()} want={want.ravel()}"
+                                " (stage 1 read the replicated input, "
+                                "not its inbox)")
+        tag = "ring" if ring else "control"
+        print(f"K MINIMAL inject/inbox select + ring ({tag}): "
+              f"{'PASS' if ok else 'FAIL'}{detail}")
+        return ok
+    except Exception as e:
+        print(f"K minimal select repro: RAISED {type(e).__name__}: {e}")
+        return False
+
+
+def variant_f(ring=True):
+    """The real schedule via the public API: onef1b_spmd with a
+    stage_fn whose body is the ring scan (sp-ppermute inside), on a
+    (pipe=2, sp=1) mesh, grads checked against the monolithic model's
+    jax.grad. This is exactly what PipelinedBert's seq_axis guard
+    fences off, minus the model. ``ring=False`` is the control: the
+    SAME scan with the ppermute deleted (numerically identical at
+    sp=1) — if the control passes while ring fails, the repro has
+    isolated the collective-in-scan-in-divergent-cond composition."""
+    from apex_tpu.parallel.pipeline import onef1b_spmd
+
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2, 1), ("pipe", "sp"))
+    inner = _scan_rotate if ring else _scan_plain
+
+    def stage_fn(p, x):
+        return inner(x) * p["w"]
+
+    def loss_fn(y, tgt):
+        return ((y - tgt) ** 2).mean()
+
+    run = onef1b_spmd(stage_fn, loss_fn, "pipe", num_microbatches=2)
+    w = jnp.asarray([2.0, 3.0])
+    params = {"w": w.reshape(2, 1)}   # stacked (S, 1)
+    x = jnp.arange(4, dtype=jnp.float32).reshape(4, 1) + 1.0
+    tgt = jnp.ones((4, 1), jnp.float32)
+
+    f = shard_map(run, mesh=mesh,
+                  in_specs=({"w": P("pipe")}, P(), P()),
+                  out_specs=(P(), {"w": P("pipe")}, P()),
+                  check_vma=False)
+    try:
+        loss, grads, dx = jax.jit(f)(
+            {"w": params["w"][:, :, None]}, x, tgt)
+
+        # monolithic oracle on one device (sp=1: ring == 3x identity)
+        def mono(w, x):
+            h = (N_STEPS * x) * w[0]
+            y = (N_STEPS * h) * w[1]
+            mbs = y.reshape(2, 2, 1), tgt.reshape(2, 2, 1)
+            return sum(((a - b) ** 2).mean()
+                       for a, b in zip(*mbs)) / 2
+
+        want_l, (want_w, want_dx) = jax.value_and_grad(
+            mono, argnums=(0, 1))(w, x)
+        got_w = np.asarray(grads["w"]).ravel()
+        ok = (np.allclose(float(loss), float(want_l), rtol=1e-5)
+              and np.allclose(got_w, np.asarray(want_w), rtol=1e-5)
+              and np.allclose(np.asarray(dx), np.asarray(want_dx),
+                              rtol=1e-5))
+        detail = ("" if ok else
+                  f" loss {float(loss)} vs {float(want_l)}; w-grads "
+                  f"{got_w} vs {np.asarray(want_w)}")
+        tag = "ring" if ring else "control (no collective)"
+        print(f"F onef1b_spmd {tag} stage_fn at sp=1: "
+              f"{'PASS' if ok else 'FAIL'}{detail}")
+        return ok
+    except Exception as e:
+        tag = "ring" if ring else "control"
+        print(f"F onef1b_spmd {tag} stage_fn: RAISED "
+              f"{type(e).__name__}: {e}")
+        return False
+
+
+def main():
+    results = {
+        "A_scan_ppermute_no_cond": run(variant_a, "A scan+ppermute, no cond"),
+        "B_ppermute_in_divergent_cond": run(
+            variant_b, "B ppermute in divergent cond"),
+        "C_scan_plain_in_divergent_cond": run(
+            variant_c, "C collective-free scan in divergent cond"),
+        "D_scan_ppermute_in_divergent_cond": run(
+            variant_d, "D scan+ppermute in divergent cond (ring-in-1F1B)"),
+        "E_1f1b_skeleton_ring_fwd_vjp": variant_e(),
+        "G_skeleton_plus_pipe_hop_ring": variant_g(ring=True),
+        "G_control_no_collective": variant_g(ring=False),
+        "H_nested_cond_noncyclic_ring": variant_h(ring=True),
+        "H_control_no_collective": variant_h(ring=False),
+        "K_minimal_select_ring": variant_k(ring=True),
+        "K_control_no_collective": variant_k(ring=False),
+        "F_onef1b_spmd_ring_stage_fn": variant_f(ring=True),
+        "F_control_no_collective": variant_f(ring=False),
+    }
+    print({k: ("pass" if v else "FAIL") for k, v in results.items()})
+    return results
+
+
+if __name__ == "__main__":
+    main()
