@@ -42,6 +42,7 @@ from apex_tpu.parallel.tensor_parallel import (
 )
 from apex_tpu.parallel.zero import (
     shard_optimizer_state,
+    spec_axes,
     unshard_optimizer_state,
 )
 
@@ -78,6 +79,7 @@ __all__ = [
     "merge_stats",
     "ring_attention",
     "shard_optimizer_state",
+    "spec_axes",
     "ulysses_attention",
     "unshard_optimizer_state",
     "welford_combine",

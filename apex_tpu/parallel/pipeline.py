@@ -197,6 +197,16 @@ def onef1b_spmd(stage_fn: Callable, loss_fn: Callable, axis_name: str,
         params, b, xs = _unstack_and_microbatch(
             stacked_params_local, x, m, axis_name, s_size)
         mb = b // m
+        # same contract as the activation leaves: a target whose leading
+        # dim != b would otherwise die in an opaque reshape (or, if the
+        # size happens to factor, silently regroup microbatches)
+        t_leaves = jax.tree_util.tree_leaves(target)
+        for leaf in t_leaves:
+            if leaf.ndim == 0 or leaf.shape[0] != b:
+                raise ValueError(
+                    "every target leaf must share the activations' "
+                    f"batch dim ({b}); got "
+                    f"{[l.shape for l in t_leaves]}")
         tgts = jax.tree_util.tree_map(
             lambda a: a.reshape((m, mb) + a.shape[1:]), target)
         x_leaves = jax.tree_util.tree_leaves(x)
