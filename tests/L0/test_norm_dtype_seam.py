@@ -223,3 +223,89 @@ def test_o2_full_train_step_convs_all_bf16():
         "backward convs missing from the pin")
     bad = [c for c in convs if c != ("bfloat16", "bfloat16")]
     assert not bad, f"train-step convs off bf16: {bad}"
+
+
+def test_o2_bert_full_train_step_dots_all_bf16():
+    """BERT analog of the full-train-step conv pin above: the workload
+    the round-4 MFU measurement runs (bench.bench_bert — amp O2 +
+    FusedLAMB + FusedLayerNorm) must put EVERY dot_general on bf16
+    operands through forward, backward and the optimizer.  The ResNet
+    seam bug this guards against cost 1.86x on hardware (BENCH_NOTES);
+    an fp32 leak past a kept-fp32 LayerNorm would cap the MXU-bound
+    BERT MFU the same silent way."""
+    from apex_tpu import optimizers
+
+    cfg = models.BertConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=128,
+        max_position_embeddings=32)
+    model, optimizer = amp.initialize(
+        models.BertForPreTraining(cfg),
+        optimizers.FusedLAMB(lr=1e-4, max_grad_norm=1.0),
+        opt_level="O2", verbosity=0)
+    ids = jnp.ones((2, 32), jnp.int32)
+    labels = jnp.zeros((2, 32), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    opt_state = optimizer.init(params)
+
+    def train_step(params, opt_state, ids, labels):
+        def loss_fn(p):
+            mlm, nsp = model.apply({"params": p}, ids,
+                                   deterministic=True)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                mlm.astype(jnp.float32), labels).mean()
+            with amp.scale_loss(loss, opt_state) as scaled:
+                return scaled, loss
+        grads, loss = jax.grad(loss_fn, has_aux=True)(params)
+        params, opt_state = optimizer.step(params, grads, opt_state)
+        return params, opt_state, loss
+
+    jaxpr = jax.make_jaxpr(train_step)(params, opt_state, ids, labels)
+    dots = _dot_dtypes(jaxpr)
+    assert dots, "no dots traced?"
+    bad = [d for d in dots if d != ("bfloat16", "bfloat16")]
+    assert not bad, (
+        f"{len(bad)}/{len(dots)} dots off bf16 operands: {bad[:8]}")
+
+
+def test_o2_bert_flash_kernel_inputs_bf16():
+    """Same seam, flash path: under O2 the Pallas flash-attention call
+    must receive bf16 q/k/v (an fp32 leak upstream of the kernel would
+    double its HBM traffic and silently halve the measured MFU)."""
+    from apex_tpu.ops.flash_attention import make_flash_attention
+
+    cfg = models.BertConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=128,
+        max_position_embeddings=32)
+    model, _ = amp.initialize(
+        models.BertForPreTraining(
+            cfg, attention_fn=make_flash_attention(use_pallas=True,
+                                                   interpret=True)),
+        optax.sgd(0.1), opt_level="O2", verbosity=0)
+    ids = jnp.ones((2, 32), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+
+    qkv_dtypes = []
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "pallas_call":
+                # q/k/v enter reshaped (B*H, S, D); the fp32 kv_mask
+                # enters broadcast (B, 1, Sk) — deliberate (mask
+                # semantics, tiny), excluded via its unit dim
+                qkv_dtypes.append(tuple(
+                    v.aval.dtype.name for v in eqn.invars
+                    if getattr(v.aval, "ndim", 0) >= 3
+                    and jnp.issubdtype(v.aval.dtype, jnp.floating)
+                    and min(v.aval.shape) > 1))
+            for v in eqn.params.values():
+                _walk_param(v, walk)
+
+    jaxpr = jax.make_jaxpr(
+        lambda p, i: model.apply({"params": p}, i, deterministic=True))(
+        params, ids)
+    walk(jaxpr.jaxpr)
+    assert qkv_dtypes, "no pallas_call traced — flash path not taken?"
+    for dts in qkv_dtypes:
+        assert dts and all(d == "bfloat16" for d in dts), qkv_dtypes
