@@ -435,6 +435,24 @@ class PipelinedBert:
                     lambda _: P(self.pipe_axis), p["stages"]))
         return {"params": p}
 
+    def _partial_manual_kwargs(self):
+        """shard_map kwargs shared by the GPipe and 1F1B paths: without
+        TP both run fully manual; with ``tp_axis`` the model axis stays
+        GSPMD-automatic (partial-manual mode) so XLA inserts the
+        Megatron collectives inside the manual schedule, and
+        ``check_vma=False`` because vma checking doesn't support
+        partial-auto outputs yet (the schedules' pvary discipline still
+        applies — tools/repro_ring_1f1b.py variant F runs the 1F1B
+        schedule under check_vma=False)."""
+        if self.tp_axis is None:
+            return {}
+        manual = {self.pipe_axis}
+        if self.batch_axis:
+            manual.add(self.batch_axis)
+        if self.seq_axis:
+            manual.add(self.seq_axis)
+        return dict(axis_names=manual, check_vma=False)
+
     def _bias(self, input_ids, attention_mask):
         b, s = input_ids.shape
         if attention_mask is None:
@@ -584,24 +602,12 @@ class PipelinedBert:
         hspec = P(self.batch_axis, self.seq_axis)
         bspec = P(self.batch_axis, None, None, self.seq_axis)
         rowspec = P(self.batch_axis)
-        kwargs = {}
-        if self.tp_axis is not None:
-            # partial-manual shard_map: the TP axis stays automatic, so
-            # GSPMD inserts the Megatron collectives for the
-            # model-sharded matmuls inside the manual pipe schedule
-            # (vma checking doesn't support partial-auto outputs yet)
-            manual = {self.pipe_axis}
-            if self.batch_axis:
-                manual.add(self.batch_axis)
-            if self.seq_axis:
-                manual.add(self.seq_axis)
-            kwargs = dict(axis_names=manual, check_vma=False)
         f = jax.shard_map(
             run_wrapped, mesh=self.mesh,
             in_specs=(jax.tree_util.tree_map(lambda _: P(self.pipe_axis),
                                              p["stages"]),
                       (hspec, bspec)),
-            out_specs=(hspec, rowspec), **kwargs)
+            out_specs=(hspec, rowspec), **self._partial_manual_kwargs())
         seq, aux = f(p["stages"], (x, bias))
         mlm, nsp = self.heads.apply({"params": p["heads"]}, seq)
         if has_moe:
@@ -646,8 +652,14 @@ class PipelinedBert:
         factories advertise the fence via ``onef1b_compatible``
         (``make_ulysses_attention`` True, ``make_ring_attention``
         False); ring-SP stays on the GPipe schedule — one uniform
-        program, no divergent cond for the partitioner to get wrong —
-        as does ``tp_axis``.  Under ``seq_axis`` the last-stage loss
+        program, no divergent cond for the partitioner to get wrong.
+        ``tp_axis`` DOES compose (round 4): the same partial-manual
+        shard_map as the GPipe path — GSPMD's Megatron collectives are
+        plain (not scan-carried) and every model-axis group member
+        takes the same branch per tick, the proven-safe class; grads
+        pinned vs the monolithic model at dp x tp x pp
+        (``test_bert_1f1b_dp_tp_pp_matches_monolithic``).
+        Under ``seq_axis`` the last-stage loss
         all_gathers the microbatch hidden over sp (mb-sized, cheap) so
         ``loss_fn`` stays sequence-oblivious; the gather replicates
         the loss computation per sp shard and its transpose sums the
@@ -668,10 +680,6 @@ class PipelinedBert:
 
         from apex_tpu.parallel.pipeline import onef1b_spmd
 
-        if self.tp_axis is not None:
-            raise NotImplementedError(
-                "loss_and_grad_1f1b supports dp x sp x pp; tp_axis "
-                "compositions use the GPipe apply() path")
         if self.seq_axis is not None:
             # fail CLOSED: only attention_fns that explicitly declare
             # themselves scan-free may run inside the schedule's cond
@@ -691,6 +699,15 @@ class PipelinedBert:
                     "seq_axis + MoE under 1F1B: the sp-local aux "
                     "estimate breaks the loss/grad reduction algebra; "
                     "use the GPipe apply() path")
+        if self.tp_axis is not None and self.cfg.moe_experts > 0:
+            # fail CLOSED: expert dispatch under GSPMD-auto tp inside
+            # the schedule's branches has no grad-pin test yet (dense
+            # tp x 1F1B is pinned; MoE x 1F1B is pinned without tp);
+            # un-fencing an unpinned composition in this schedule is
+            # how silent miscomputes ship
+            raise NotImplementedError(
+                "tp_axis + MoE under 1F1B is not yet numerics-pinned; "
+                "use the GPipe apply() path for tp x MoE")
         needs_rng, base_key, embed_rngs = self._dropout_setup(
             deterministic, rngs, "loss_and_grad_1f1b")
 
@@ -776,6 +793,15 @@ class PipelinedBert:
 
         hspec = P(self.batch_axis, self.seq_axis)
         bspec = P(self.batch_axis, None, None, self.seq_axis)
+        # TP runs partial-manual exactly like the GPipe path
+        # (_partial_manual_kwargs): GSPMD's Megatron collectives land
+        # INSIDE the schedule's cond branches, which is sound for the
+        # same reason Ulysses composes — the model-axis collective
+        # group at any (data, pipe) coordinate takes the same branch at
+        # the same tick, so every group member participates (the
+        # ring-SP miscompile needs a SCAN-carried collective + the
+        # inject/inbox select — tools/repro_ring_1f1b.py; plain GSPMD
+        # collectives are the proven-safe class).
         f = jax.shard_map(
             run_wrapped, mesh=self.mesh,
             in_specs=(jax.tree_util.tree_map(lambda _: P(self.pipe_axis),
@@ -789,7 +815,8 @@ class PipelinedBert:
                            lambda _: P(self.pipe_axis), p["stages"]),
                        hspec,
                        jax.tree_util.tree_map(lambda _: P(),
-                                              p["heads"])))
+                                              p["heads"])),
+            **self._partial_manual_kwargs())
         loss, stage_grads, dh, head_grads = f(p["stages"], (x, bias),
                                               targets, p["heads"])
         (embed_grads,) = embed_vjp(dh)

@@ -1090,3 +1090,66 @@ def test_bert_1f1b_ring_rejected():
     with pytest.raises(NotImplementedError, match="ring"):
         pb.loss_and_grad_1f1b(variables, ids, _pretrain_loss, tgt,
                               attention_mask=mask)
+
+
+def test_bert_1f1b_dp_tp_pp_matches_monolithic():
+    """dp x tp x pp on the INTERLEAVED schedule (round 4): Megatron
+    tensor parallelism inside 1F1B via the same partial-manual
+    shard_map as the GPipe path. Sound because GSPMD's TP collectives
+    are plain (not scan-carried) and every model-axis group member
+    takes the same cond branch per tick — the proven-safe class from
+    the ring root-cause bisection (tools/repro_ring_1f1b.py). Loss,
+    stage, embed and head grads pinned against the monolithic model.
+    fp32, matching the GPipe dp x tp x pp tier (bf16 inside
+    partial-manual crashes this build's XLA CPU backend)."""
+    from apex_tpu import models
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 2, 2),
+                ("data", "model", "pipe"))
+    cfg = models.BertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=16, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+    pb = models.PipelinedBert(cfg, mesh, pp=2, num_microbatches=2,
+                              batch_axis="data", tp_axis="model")
+    ids, mask, tgt = _bert_batch()
+    raw = pb.init(jax.random.PRNGKey(1), ids, mask)
+    variables = pb.shard_variables(raw)
+    with mesh:
+        loss, grads = jax.jit(
+            lambda v, i, m, t: pb.loss_and_grad_1f1b(
+                v, i, _pretrain_loss, t, attention_mask=m))(
+            variables, ids, mask, tgt)
+
+    seq_params = _monolithic_params(raw, 2, 1)
+
+    def mono_loss(p):
+        mlm, nsp = models.BertForPreTraining(cfg).apply(
+            {"params": p}, ids, mask, deterministic=True)
+        return _pretrain_loss(mlm, nsp, tgt)
+
+    want_l, want_g = jax.value_and_grad(mono_loss)(seq_params)
+    np.testing.assert_allclose(float(loss), float(want_l), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(grads["heads"]),
+                    jax.tree.leaves({k: want_g[k]
+                                     for k in grads["heads"]})):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-5)
+    for k in grads["embed"]:
+        for a, b in zip(jax.tree.leaves(grads["embed"][k]),
+                        jax.tree.leaves(want_g["encoder"][k])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=1e-5)
+    for li in range(cfg.num_hidden_layers):
+        got_li = jax.tree.map(lambda a: a[li],
+                              grads["stages"]["layer_0"])
+        for a, b in zip(jax.tree.leaves(got_li),
+                        jax.tree.leaves(want_g["encoder"][f"layer_{li}"])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=1e-5)
+    # the TP placement survived into the stage grads
+    qk_g = grads["stages"]["layer_0"]["attention"]["query"]["kernel"]
+    assert "model" in set(
+        a for e in qk_g.sharding.spec if e is not None
+        for a in (e if isinstance(e, tuple) else (e,))), qk_g.sharding.spec
