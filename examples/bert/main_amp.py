@@ -44,6 +44,13 @@ def parse_args():
                    help="shard attention over SP-way sequence parallelism "
                    "(hybrid DP x SP mesh; SP must divide the device count "
                    "and --seq-len)")
+    p.add_argument("--sp-attention", default="ring",
+                   choices=("ring", "ulysses"),
+                   help="sequence-parallel attention pattern under "
+                   "--ring-attention: ring (KV rotation, O(S_local) "
+                   "memory per hop) or ulysses (all_to_all head "
+                   "scatter; the pattern that composes with "
+                   "--pp-schedule 1f1b)")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize encoder layers in backward "
                    "(jax.checkpoint): ~33%% more FLOPs for O(layers) "
@@ -71,8 +78,9 @@ def parse_args():
                    help="pipeline schedule under --pp: gpipe (autodiff "
                         "through the scan) or 1f1b (interleaved "
                         "fwd/bwd, live activations bounded by the stage "
-                        "count; composes with dp, --grad-accum and "
-                        "--moe, not --ring-attention)")
+                        "count; composes with dp, --grad-accum, --moe, "
+                        "and --sp-attention ulysses — ring SP needs "
+                        "gpipe)")
     p.add_argument("--pp-microbatches", type=int, default=4, metavar="M",
                    help="GPipe microbatches per step under --pp "
                    "(bubble fraction (S-1)/(M+S-1))")
@@ -144,26 +152,33 @@ def main():
     onef1b = pp and args.pp_schedule == "1f1b"
     if args.pp_schedule == "1f1b" and not pp:
         raise SystemExit("--pp-schedule 1f1b needs --pp S")
-    if onef1b and sp:
+    if onef1b and sp and args.sp_attention == "ring":
         raise SystemExit(
-            "--pp-schedule 1f1b composes with dp, --grad-accum and "
-            "--moe; --ring-attention needs the gpipe schedule (the "
-            "ring cannot run inside the 1F1B branches)")
+            "--pp-schedule 1f1b cannot host ring attention (its "
+            "collective-carrying scan miscompiles in the schedule's "
+            "branches — tools/repro_ring_1f1b.py); use "
+            "--sp-attention ulysses or the gpipe schedule")
     maybe_print(f"devices: {n_dev} (dp={dp}, sp={sp or 1}, pp={pp or 1}), "
                 f"config: {args.config}", rank0=True)
 
     attention_fn = None
     if sp and pp:
         # inside PipelinedBert's shard_map the sp axis is already
-        # manual: the ring adapter runs directly, no inner shard_map
-        from apex_tpu.parallel import make_ring_attention
-        attention_fn = make_ring_attention("sp")
+        # manual: the adapter runs directly, no inner shard_map
+        from apex_tpu.parallel import (make_ring_attention,
+                                       make_ulysses_attention)
+        attention_fn = (make_ulysses_attention("sp")
+                        if args.sp_attention == "ulysses"
+                        else make_ring_attention("sp"))
     elif sp:
-        from apex_tpu.parallel import make_ring_attention
+        from apex_tpu.parallel import (make_ring_attention,
+                                       make_ulysses_attention)
 
         shard_map = jax.shard_map
 
-        ring_fn = make_ring_attention("sp")
+        ring_fn = (make_ulysses_attention("sp")
+                   if args.sp_attention == "ulysses"
+                   else make_ring_attention("sp"))
 
         def attention_fn(q, k, v, bias=None, dropout_fn=None):
             """Hybrid DP x SP: batch stays sharded on `data`, the sequence

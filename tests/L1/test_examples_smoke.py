@@ -97,3 +97,15 @@ def test_bert_tiny_pp_1f1b(extra):
                "--pp-microbatches", "2", "--pp-schedule", "1f1b", *extra,
                ndev=8)
     assert "loss" in out.lower()
+
+
+def test_bert_tiny_pp_1f1b_ulysses_sp():
+    """dp x sp x pp on the interleaved schedule through the example CLI:
+    --sp-attention ulysses is the SP pattern 1F1B can host (ring is
+    rejected with a pointer to the repro — see the arg's help)."""
+    out = _run("examples/bert/main_amp.py", "--config", "tiny", "--b", "8",
+               "--seq-len", "32", "--steps", "3", "--pp", "2",
+               "--pp-microbatches", "2", "--pp-schedule", "1f1b",
+               "--ring-attention", "2", "--sp-attention", "ulysses",
+               ndev=8)
+    assert "loss" in out.lower()
