@@ -253,6 +253,7 @@ def bench_bert(iters=8, batch=128, seq_len=128, flash=False,
     from apex_tpu import amp, models, optimizers
 
     cfg = {"base": models.BertConfig(),
+           "large": models.bert_large(),   # BASELINE config 4 verbatim
            "tiny": models.BertConfig(
                vocab_size=1024, hidden_size=128, num_hidden_layers=2,
                num_attention_heads=2, intermediate_size=512,

@@ -139,6 +139,16 @@ def main():
         except Exception as e:
             log("bert512_flash", {"error": f"{type(e).__name__}: {e}"})
 
+    if "bert_large" in sections:
+        # BASELINE config 4 verbatim (BERT-large + FusedLAMB +
+        # FusedLayerNorm + amp O2); larger matmuls -> higher MFU
+        # ceiling than base
+        try:
+            log("bert_large",
+                bench.bench_bert(batch=64, seq_len=128, config="large"))
+        except Exception as e:
+            log("bert_large", {"error": f"{type(e).__name__}: {e}"})
+
     if "realdata" in sections:
         try:
             log("realdata", bench.bench_realdata())

@@ -54,6 +54,7 @@ QUEUE = [
     "bert_flash",
     "bert512",
     "bert512_flash",
+    "bert_large",
     "flash_attention",
     "realdata",
     "fused_adam",
