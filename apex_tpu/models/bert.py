@@ -700,14 +700,16 @@ class PipelinedBert:
                     "estimate breaks the loss/grad reduction algebra; "
                     "use the GPipe apply() path")
         if self.tp_axis is not None and self.cfg.moe_experts > 0:
-            # fail CLOSED: expert dispatch under GSPMD-auto tp inside
-            # the schedule's branches has no grad-pin test yet (dense
-            # tp x 1F1B is pinned; MoE x 1F1B is pinned without tp);
-            # un-fencing an unpinned composition in this schedule is
-            # how silent miscomputes ship
+            # fail CLOSED: probed 2026-07-31 — this composition's aux
+            # leaf trips a shard_map out_specs error under the
+            # partial-manual regime (so it fails loudly, but with an
+            # opaque message), and there is no grad-pin test (dense
+            # tp x 1F1B is pinned; MoE x 1F1B is pinned without tp).
+            # GPipe apply() runs tp x MoE fine.
             raise NotImplementedError(
-                "tp_axis + MoE under 1F1B is not yet numerics-pinned; "
-                "use the GPipe apply() path for tp x MoE")
+                "tp_axis + MoE under 1F1B is not yet supported (the "
+                "aux-leaf out_specs don't compose with partial-manual "
+                "tp); use the GPipe apply() path for tp x MoE")
         needs_rng, base_key, embed_rngs = self._dropout_setup(
             deterministic, rngs, "loss_and_grad_1f1b")
 
