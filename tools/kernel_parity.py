@@ -164,6 +164,69 @@ def check_fused_adam(dtype):
            max(max_p, max_m), tol=0.0)
 
 
+def check_s2d_stem(dtype):
+    """Space-to-depth stem vs the standard 7x7/s2 conv stem, COMPILED
+    on the device: forward and full weight/input grads must agree (the
+    headline bench adopts the s2d stem; its grad path has only been
+    CPU-validated — VERDICT r3 missing #3). Same weights via the
+    stem_to_s2d rearrangement; grads compared through the
+    rearrangement's transpose (s2d stem grads mapped back)."""
+    from apex_tpu import models
+    from apex_tpu.models.resnet import s2d_input_transform, stem_to_s2d
+
+    std = models.resnet.ResNet(stage_sizes=[1, 1],
+                               block=models.resnet.BasicBlock,
+                               num_classes=10, width=16)
+    s2d = models.resnet.ResNet(stage_sizes=[1, 1],
+                               block=models.resnet.BasicBlock,
+                               num_classes=10, width=16, stem="s2d_pre")
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 32, 3), dtype)
+    v_std = std.init(jax.random.PRNGKey(1), x, train=False)
+    params = dict(v_std["params"])
+    params_s2d = dict(params)
+    params_s2d["stem_conv_s2d"] = {
+        "kernel": stem_to_s2d(params_s2d.pop("stem_conv")["kernel"])}
+    stats = v_std["batch_stats"]
+
+    def loss_std(p, x):
+        out = std.apply({"params": p, "batch_stats": stats}, x,
+                        train=False)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    def loss_s2d(p, x):
+        out = s2d.apply({"params": p, "batch_stats": stats},
+                        s2d_input_transform(x), train=False)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    l1, (g1, dx1) = jax.jit(jax.value_and_grad(
+        loss_std, argnums=(0, 1)))(params, x)
+    l2, (g2, dx2) = jax.jit(jax.value_and_grad(
+        loss_s2d, argnums=(0, 1)))(params_s2d, x)
+    # map the s2d stem grad back to conv layout and compare the SHARED
+    # 7x7 region only: stem_to_s2d zero-pads 7x7 -> 8x8, and the padded
+    # slots are mathematically ACTIVE parameters of the s2d model (they
+    # multiply real pixels; fwd equality holds because they are zero),
+    # so their grads are legitimately nonzero and have no conv-side
+    # counterpart
+    g2 = dict(g2)
+    k = g2.pop("stem_conv_s2d")["kernel"]      # (4, 4, 4C, F)
+    c = k.shape[2] // 4
+    k = k.reshape(4, 4, 2, 2, c, k.shape[3])
+    k = jnp.transpose(k, (0, 2, 1, 3, 4, 5)).reshape(8, 8, c, -1)
+    g2_stem = k[1:, 1:]                        # inverse of the pad
+    g1 = dict(g1)
+    g1_stem = g1.pop("stem_conv")["kernel"]
+    rels, maxes = [], []
+    for a, b in ((g2, g1), (g2_stem, g1_stem), (dx2, dx1),
+                 (np.asarray(float(l2)), np.asarray(float(l1)))):
+        r, m = (_tree_errs(a, b) if isinstance(a, dict) else _errs(a, b))
+        rels.append(r)
+        maxes.append(m)
+    tol = TOL[dtype]
+    ok = max(rels) < tol
+    record("s2d_stem_grad", dtype, ok, max(rels), max(maxes))
+
+
 def main():
     dev = jax.devices()[0]
     print(json.dumps({"platform": dev.platform,
@@ -172,7 +235,7 @@ def main():
                                else "interpret-mode (no TPU visible)")}))
     for dtype in (jnp.float32, jnp.bfloat16):
         for fn in (check_flash_attention, check_fused_layer_norm,
-                   check_fused_adam):
+                   check_fused_adam, check_s2d_stem):
             try:
                 fn(dtype)
             except Exception as e:
