@@ -20,6 +20,13 @@ from apex_tpu.models.resnet import (
     ResNet152,
 )
 from apex_tpu.models.dcgan import Discriminator, Generator
+from apex_tpu.models.gpt import (
+    GPTConfig,
+    GPTLMHeadModel,
+    gpt_medium,
+    gpt_small,
+    lm_loss,
+)
 from apex_tpu.models.moe import EP_RULES, MoEMlp, ep_rules
 from apex_tpu.models.bert import (
     BertConfig,
@@ -33,6 +40,11 @@ from apex_tpu.models.bert import (
 __all__ = [
     "BasicBlock",
     "EP_RULES",
+    "GPTConfig",
+    "GPTLMHeadModel",
+    "gpt_medium",
+    "gpt_small",
+    "lm_loss",
     "MoEMlp",
     "ep_rules",
     "BertConfig",

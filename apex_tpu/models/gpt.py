@@ -1,0 +1,218 @@
+"""Decoder-only causal language model (GPT-style) — the long-context
+flagship of the model zoo.
+
+The reference (apex) ships no models; this family exists because the
+framework's long-context machinery — causal flash attention
+(``ops.flash_attention``, O(S) memory), ring/Ulysses sequence
+parallelism (``parallel.sequence``), per-layer remat — needs a model
+whose workload is actually causal and long, the way BERT is the
+workload for FusedLAMB/FusedLayerNorm (BASELINE config 4). TPU-first
+choices:
+
+- pre-LN blocks (``FusedLayerNorm``, Pallas on TPU) — the stable-at-
+  depth variant every modern decoder uses;
+- attention as batched einsum -> fp32 softmax -> einsum on the default
+  path, with the same pluggable ``attention_fn`` seam as
+  ``models.bert`` — ``make_flash_attention(causal=True)`` swaps the
+  whole stack onto the fused kernel, ``make_ulysses_attention`` /
+  ``make_ring_attention`` shard the sequence axis;
+- learned positional embeddings (static shapes; no data-dependent
+  control flow under jit);
+- weight-tied LM head (embedding transpose) — half the embedding HBM
+  of an untied head at vocab scale;
+- ``remat=True`` rematerializes each block in backward
+  (``jax.checkpoint``) for long sequences.
+
+Causality is enforced in-model (the causal mask/bias is built from
+static positions), so callers never thread masks for plain LM
+training; padding masks compose additively when given.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.normalization import FusedLayerNorm
+
+NEG_INF = -1e9
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    # rematerialize each block in backward: the long-sequence lever
+    remat: bool = False
+
+
+def gpt_small() -> "GPTConfig":
+    """The 124M 12x768 configuration."""
+    return GPTConfig()
+
+
+def gpt_medium() -> "GPTConfig":
+    return GPTConfig(hidden_size=1024, num_hidden_layers=24,
+                     num_attention_heads=16, intermediate_size=4096)
+
+
+def _init(cfg):
+    return nn.initializers.normal(cfg.initializer_range)
+
+
+def causal_dot_product_attention(q, k, v, bias=None, dropout_fn=None):
+    """Default path: (B, S, H, D) -> (B, S, H, D). The causal mask is
+    built from static positions and folded into the additive bias;
+    everything else (scaling, fp32 softmax, dropout hook) DELEGATES to
+    ``models.bert.dot_product_attention`` so the numeric policy cannot
+    drift between the encoder and decoder families."""
+    from apex_tpu.models.bert import dot_product_attention
+
+    sq, sk = q.shape[1], k.shape[1]
+    cmask = jnp.where(jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :],
+                      0.0, NEG_INF)
+    bias = (cmask[None, None] if bias is None
+            else bias + cmask[None, None])
+    return dot_product_attention(q, k, v, bias=bias,
+                                 dropout_fn=dropout_fn)
+
+
+class GPTSelfAttention(nn.Module):
+    cfg: GPTConfig
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, attn_bias, deterministic: bool = True):
+        cfg = self.cfg
+        h, nh = cfg.hidden_size, cfg.num_attention_heads
+        init = _init(cfg)
+
+        def proj(name):
+            return nn.DenseGeneral((nh, h // nh), kernel_init=init,
+                                   name=name)(x)
+
+        q, k, v = proj("query"), proj("key"), proj("value")
+        dropout_fn = None
+        if cfg.attention_probs_dropout_prob > 0 and not deterministic:
+            drop = nn.Dropout(cfg.attention_probs_dropout_prob,
+                              deterministic=False)
+            dropout_fn = lambda p: drop(p)
+            if self.attention_fn is not None:
+                # same (rate, seed) annotation contract as BERT so the
+                # fused kernels run dropout in-kernel
+                # (ops.flash_attention.dropout_params)
+                dropout_fn.rate = cfg.attention_probs_dropout_prob
+                dropout_fn.seed = jax.random.randint(
+                    self.make_rng("dropout"), (), 0,
+                    jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+        attn = self.attention_fn or causal_dot_product_attention
+        ctx = attn(q, k, v, bias=attn_bias, dropout_fn=dropout_fn)
+        return nn.DenseGeneral(h, axis=(-2, -1), kernel_init=init,
+                               name="output")(ctx)
+
+
+class GPTBlock(nn.Module):
+    """Pre-LN: x + Attn(LN(x)); x + MLP(LN(x))."""
+
+    cfg: GPTConfig
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, attn_bias, deterministic: bool = True):
+        cfg = self.cfg
+        init = _init(cfg)
+        drop = nn.Dropout(cfg.hidden_dropout_prob,
+                          deterministic=deterministic)
+        h = FusedLayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps,
+                           name="attn_ln")(x)
+        h = GPTSelfAttention(cfg, self.attention_fn,
+                             name="attention")(h, attn_bias,
+                                               deterministic)
+        x = x + drop(h)
+        h = FusedLayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps,
+                           name="mlp_ln")(x)
+        h = nn.Dense(cfg.intermediate_size, kernel_init=init,
+                     name="mlp_in")(h)
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(cfg.hidden_size, kernel_init=init,
+                     name="mlp_out")(h)
+        return x + drop(h)
+
+
+class GPTLMHeadModel(nn.Module):
+    """Token + position embeddings -> pre-LN blocks -> final LN ->
+    weight-tied LM head. Returns (B, S, V) fp32 logits.
+
+    ``attention_fn``: optional fused/sequence-parallel attention with
+    the ``models.bert`` adapter signature. The DEFAULT path and the
+    flash path are both causal; adapters must be built causal
+    (``make_flash_attention(causal=True)``,
+    ``make_ring_attention("sp", causal=True)``) — there is no way to
+    express a non-causal LM here.
+    ``attention_mask``: optional (B, S) 1/0 padding mask, additive on
+    key positions on top of causality.
+    """
+
+    cfg: GPTConfig
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None,
+                 deterministic: bool = True):
+        cfg = self.cfg
+        b, s = input_ids.shape
+        init = _init(cfg)
+        wte = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                       embedding_init=init, name="wte")
+        x = wte(input_ids)
+        x = x + nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+                         embedding_init=init, name="wpe")(
+            jnp.arange(s)[None, :])
+        x = nn.Dropout(cfg.hidden_dropout_prob,
+                       deterministic=deterministic)(x)
+        bias = None
+        if attention_mask is not None:
+            bias = jnp.where(attention_mask[:, None, None, :] > 0,
+                             0.0, NEG_INF).astype(jnp.float32)
+        block = GPTBlock
+        if cfg.remat:
+            # deterministic (argnum 3; self=0) is the static arg — the
+            # bias is a traced array (same as models.bert)
+            block = nn.remat(GPTBlock, static_argnums=(3,))
+        for i in range(cfg.num_hidden_layers):
+            x = block(cfg, self.attention_fn, name=f"block_{i}")(
+                x, bias, deterministic)
+        x = FusedLayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps,
+                           name="final_ln")(x)
+        # weight-tied head: logits = x @ wte^T
+        logits = wte.attend(x)
+        return logits.astype(jnp.float32)
+
+
+def lm_loss(logits, input_ids, attention_mask=None):
+    """Next-token cross entropy: predict token t+1 from prefix <= t.
+    Position S-1 has no target and is dropped; with a padding mask,
+    positions whose TARGET is padding are dropped too. Mean over kept
+    positions."""
+    import optax
+
+    targets = input_ids[:, 1:]
+    lg = logits[:, :-1]
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(
+        lg, targets)
+    if attention_mask is None:
+        return per_tok.mean()
+    keep = attention_mask[:, 1:].astype(per_tok.dtype)
+    return (per_tok * keep).sum() / jnp.maximum(keep.sum(), 1.0)
