@@ -314,6 +314,67 @@ def bench_bert(iters=8, batch=128, seq_len=128, flash=False,
     return out
 
 
+def bench_gpt(iters=8, batch=16, seq_len=1024, flash=True):
+    """Causal-LM train-step throughput + MFU: gpt_small (124M) with the
+    causal flash kernel — the decoder-family companion to bench_bert
+    (same analytic-MFU convention; flash=False falls back to the
+    einsum+fp32-softmax path, whose S^2 score tensor dominates HBM at
+    long seq)."""
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu import amp, models, optimizers
+
+    cfg = models.gpt_small()
+    attention_fn = None
+    if flash:
+        from apex_tpu.ops.flash_attention import make_flash_attention
+        attention_fn = make_flash_attention(causal=True)
+    model, optimizer = amp.initialize(
+        models.GPTLMHeadModel(cfg, attention_fn=attention_fn),
+        optimizers.FusedAdam(lr=1e-4),
+        opt_level="O2", verbosity=0)
+    ids = jnp.ones((batch, seq_len), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    opt_state = optimizer.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, ids):
+        def loss_fn(p):
+            loss = models.lm_loss(model.apply({"params": p}, ids), ids)
+            with amp.scale_loss(loss, opt_state) as scaled:
+                return scaled, loss
+        grads, loss = jax.grad(loss_fn, has_aux=True)(params)
+        params, opt_state = optimizer.step(params, grads, opt_state)
+        return params, opt_state, loss
+
+    compiled = train_step.lower(params, opt_state, ids).compile()
+    params, opt_state, loss = compiled(params, opt_state, ids)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = compiled(params, opt_state, ids)
+    float(loss)
+    dt = time.perf_counter() - t0
+    step_s = dt / iters
+    h, L = cfg.hidden_size, cfg.num_hidden_layers
+    f, v = cfg.intermediate_size, cfg.vocab_size
+    # tied head: the vocab projection is the embedding transpose
+    dense = L * (4 * h * h + 2 * h * f) + h * v
+    # causal attention does half the score work
+    fwd = (2.0 * batch * seq_len * dense
+           + 4.0 * L * batch * seq_len * seq_len * h * 0.5)
+    model_flops = 3.0 * fwd
+    out = {"config": "gpt_small", "batch": batch, "seq_len": seq_len,
+           "flash": flash,
+           "tokens_per_sec": round(iters * batch * seq_len / dt),
+           "step_time_ms": round(step_s * 1e3, 2),
+           "model_tflops_per_step": round(model_flops / 1e12, 3)}
+    peak = _peak_bf16()
+    if peak:
+        out["mfu"] = round(model_flops / step_s / peak, 4)
+    return out
+
+
 def bench_ulysses(iters=5, b=1, s=8192, h=8, d=64):
     """Ulysses sequence-parallel attention timed on hardware. One chip
     means sp=1: the ``all_to_all``s are DEGENERATE (size-1 axis, no

@@ -155,6 +155,12 @@ def main():
         except Exception as e:
             log("realdata", {"error": f"{type(e).__name__}: {e}"})
 
+    if "gpt" in sections:
+        try:
+            log("gpt", bench.bench_gpt())
+        except Exception as e:
+            log("gpt", {"error": f"{type(e).__name__}: {e}"})
+
     if "ulysses" in sections:
         try:
             log("ulysses", bench.bench_ulysses())

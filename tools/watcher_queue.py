@@ -60,6 +60,7 @@ QUEUE = [
     "fused_adam",
     "moe_dispatch",
     "ulysses",
+    "gpt",
     "tp_pp_bf16",
 ]
 
