@@ -109,3 +109,13 @@ def test_bert_tiny_pp_1f1b_ulysses_sp():
                "--ring-attention", "2", "--sp-attention", "ulysses",
                ndev=8)
     assert "loss" in out.lower()
+
+
+@pytest.mark.parametrize("extra", [[], ["--flash"],
+                                   ["--sp", "2", "--sp-attention",
+                                    "ulysses"]],
+                         ids=["plain", "flash", "ulysses_sp"])
+def test_gpt_tiny(extra):
+    out = _run("examples/gpt/main_amp.py", "--config", "tiny", "--b", "8",
+               "--seq-len", "32", "--steps", "3", *extra, ndev=8)
+    assert "loss" in out.lower()
