@@ -23,6 +23,7 @@ from apex_tpu.models.dcgan import Discriminator, Generator
 from apex_tpu.models.gpt import (
     GPTConfig,
     GPTLMHeadModel,
+    PipelinedGPT,
     gpt_medium,
     gpt_small,
     lm_loss,
@@ -42,6 +43,7 @@ __all__ = [
     "EP_RULES",
     "GPTConfig",
     "GPTLMHeadModel",
+    "PipelinedGPT",
     "gpt_medium",
     "gpt_small",
     "lm_loss",

@@ -72,6 +72,25 @@ def _init(cfg):
     return nn.initializers.normal(cfg.initializer_range)
 
 
+def _embed_block(cfg, input_ids, deterministic):
+    """Token + position embeddings + dropout, shared by
+    :class:`GPTLMHeadModel` and :class:`GPTEmbed` so the param names
+    and math cannot drift (same discipline as ``bert._embed_block``;
+    must be called inside an ``@nn.compact`` body).  Returns
+    ``(x, wte)`` — the wte module for the tied LM head."""
+    init = _init(cfg)
+    s = input_ids.shape[1]
+    wte = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                   embedding_init=init, name="wte")
+    x = wte(input_ids)
+    x = x + nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+                     embedding_init=init, name="wpe")(
+        jnp.arange(s)[None, :])
+    x = nn.Dropout(cfg.hidden_dropout_prob,
+                   deterministic=deterministic)(x)
+    return x, wte
+
+
 def causal_dot_product_attention(q, k, v, bias=None, dropout_fn=None):
     """Default path: (B, S, H, D) -> (B, S, H, D). The causal mask is
     built from static positions and folded into the additive bias;
@@ -172,16 +191,7 @@ class GPTLMHeadModel(nn.Module):
     def __call__(self, input_ids, attention_mask=None,
                  deterministic: bool = True):
         cfg = self.cfg
-        b, s = input_ids.shape
-        init = _init(cfg)
-        wte = nn.Embed(cfg.vocab_size, cfg.hidden_size,
-                       embedding_init=init, name="wte")
-        x = wte(input_ids)
-        x = x + nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
-                         embedding_init=init, name="wpe")(
-            jnp.arange(s)[None, :])
-        x = nn.Dropout(cfg.hidden_dropout_prob,
-                       deterministic=deterministic)(x)
+        x, wte = _embed_block(cfg, input_ids, deterministic)
         bias = None
         if attention_mask is not None:
             bias = jnp.where(attention_mask[:, None, None, :] > 0,
@@ -216,3 +226,243 @@ def lm_loss(logits, input_ids, attention_mask=None):
         return per_tok.mean()
     keep = attention_mask[:, 1:].astype(per_tok.dtype)
     return (per_tok * keep).sum() / jnp.maximum(keep.sum(), 1.0)
+
+
+class GPTStage(nn.Module):
+    """``n_layers`` consecutive pre-LN blocks — one pipeline stage."""
+
+    cfg: GPTConfig
+    n_layers: int
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, attn_bias, deterministic: bool = True):
+        block = GPTBlock
+        if self.cfg.remat:
+            block = nn.remat(GPTBlock, static_argnums=(3,))
+        for i in range(self.n_layers):
+            x = block(self.cfg, self.attention_fn, name=f"block_{i}")(
+                x, attn_bias, deterministic)
+        return x
+
+
+class GPTEmbed(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids, deterministic: bool = True):
+        x, _ = _embed_block(self.cfg, input_ids, deterministic)
+        return x
+
+
+class PipelinedGPT:
+    """GPT over a ``pipe`` mesh axis — the decoder counterpart of
+    :class:`models.PipelinedBert` (same schedules,
+    ``parallel.pipeline``; same variables convention so
+    ``amp.initialize`` wraps it).
+
+    Param groups: ``embed`` (wte/wpe, replicated), ``stages`` (blocks
+    stacked ``(pp, ...)`` and pipe-sharded), ``head`` (the final LN;
+    the LM projection is TIED to ``embed/wte``). The tied head makes
+    the 1F1B grad flow the interesting part: ``wte``'s gradient has an
+    input-side contribution (token lookup, via the pipeline's input
+    cotangent) and a head-side contribution (the logits projection,
+    via the schedule's differentiated ``loss_params``) — they come
+    back on separate paths and are SUMMED, which is exactly the tied
+    parameter's chain rule.
+
+    v1 scope (kept honest): ``batch_axis`` composes (DDP mean
+    semantics); deterministic compute only (the per-(microbatch,
+    stage) dropout-key machinery lives in PipelinedBert — wire it
+    through ``_build_stage_fn``-style when needed); no
+    ``seq_axis``/``tp_axis`` yet (use ``models.PipelinedBert`` as the
+    reference implementation for those compositions).
+    """
+
+    def __init__(self, cfg: GPTConfig, mesh, pp: int,
+                 num_microbatches: int, pipe_axis: str = "pipe",
+                 batch_axis: Optional[str] = None,
+                 attention_fn: Optional[Callable] = None):
+        if cfg.num_hidden_layers % pp:
+            raise ValueError(
+                f"num_hidden_layers={cfg.num_hidden_layers} must divide "
+                f"into pp={pp} equal stages")
+        if cfg.hidden_dropout_prob or cfg.attention_probs_dropout_prob:
+            raise NotImplementedError(
+                "PipelinedGPT v1 is deterministic-only: zero the "
+                "dropout probs (the per-(microbatch, stage) key "
+                "machinery is in PipelinedBert; port _build_stage_fn "
+                "to enable dropout here)")
+        self.cfg = cfg
+        self.mesh = mesh
+        self.pp = pp
+        self.num_microbatches = num_microbatches
+        self.pipe_axis = pipe_axis
+        self.batch_axis = batch_axis
+        self.attention_fn = attention_fn
+        self.embed = GPTEmbed(cfg)
+        self.stage = GPTStage(cfg, cfg.num_hidden_layers // pp,
+                              attention_fn)
+        self._stage_init = GPTStage(cfg, cfg.num_hidden_layers // pp,
+                                    None)
+        self.final_ln = FusedLayerNorm(cfg.hidden_size,
+                                       eps=cfg.layer_norm_eps)
+
+    def init(self, rng, input_ids):
+        r_embed, r_stage, r_head = jax.random.split(rng, 3)
+        embed_p = self.embed.init(r_embed, input_ids, True)["params"]
+        x0 = self.embed.apply({"params": embed_p}, input_ids, True)
+        bias0 = self._bias(input_ids, None)
+        stage_p = jax.vmap(
+            lambda r: self._stage_init.init(r, x0, bias0, True)["params"])(
+            jax.random.split(r_stage, self.pp))
+        head_p = self.final_ln.init(r_head, x0)["params"]
+        return {"params": {"embed": embed_p, "stages": stage_p,
+                           "head": head_p}}
+
+    def shard_variables(self, variables):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        p = dict(variables["params"])
+        repl = NamedSharding(self.mesh, P())
+        p["embed"] = jax.device_put(p["embed"], repl)
+        p["head"] = jax.device_put(p["head"], repl)
+        p["stages"] = jax.tree_util.tree_map(
+            lambda a: jax.device_put(
+                a, NamedSharding(self.mesh, P(self.pipe_axis))),
+            p["stages"])
+        return {"params": p}
+
+    def _bias(self, input_ids, attention_mask):
+        b, s = input_ids.shape
+        if attention_mask is None:
+            return jnp.zeros((b, 1, 1, s), jnp.float32)
+        return jnp.where(attention_mask[:, None, None, :] > 0,
+                         0.0, NEG_INF).astype(jnp.float32)
+
+    def _head(self, h, head_p, wte):
+        x = self.final_ln.apply({"params": head_p}, h)
+        return jnp.einsum("bsh,vh->bsv", x, wte).astype(jnp.float32)
+
+    def apply(self, variables, input_ids, attention_mask=None,
+              deterministic: bool = True):
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.parallel.pipeline import gpipe_spmd
+
+        p = variables["params"]
+        x = self.embed.apply({"params": p["embed"]}, input_ids,
+                             deterministic)
+        bias = self._bias(input_ids, attention_mask)
+
+        def stage_fn(sp, xb):
+            h, b = xb
+            return self.stage.apply({"params": sp}, h, b, deterministic), b
+
+        run = gpipe_spmd(stage_fn, self.pipe_axis, self.num_microbatches)
+
+        def run_wrapped(sp, xb):
+            h, _ = run(sp, xb)
+            return h
+
+        hspec = P(self.batch_axis)
+        f = jax.shard_map(
+            run_wrapped, mesh=self.mesh,
+            in_specs=(jax.tree_util.tree_map(lambda _: P(self.pipe_axis),
+                                             p["stages"]),
+                      (hspec, hspec)),
+            out_specs=hspec)
+        h = f(p["stages"], (x, bias))
+        return self._head(h, p["head"],
+                          p["embed"]["wte"]["embedding"])
+
+    def loss_and_grad_1f1b(self, variables, input_ids, targets,
+                           attention_mask=None):
+        """1F1B training step: ``targets`` are the (B, S) token ids the
+        loss shifts against (usually ``input_ids`` itself).  Returns
+        ``(loss, grads)`` with grads matching ``variables["params"]``;
+        the tied ``wte`` grad sums its embedding-lookup and LM-head
+        contributions.
+
+        ``attention_mask`` reaches both the attention bias and the
+        loss (pad targets dropped).  Masked-loss caveat shared with
+        every microbatched schedule: the scheduled loss is the mean of
+        per-microbatch masked means, which equals the monolithic
+        global masked mean only when microbatches carry equal valid-
+        target counts (uniform padding per row group); with heavily
+        skewed padding, batch rows so each microbatch has a similar
+        valid count.
+        """
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.parallel.pipeline import onef1b_spmd
+
+        p = variables["params"]
+
+        def embed_f(ep):
+            return self.embed.apply({"params": ep}, input_ids, True)
+
+        x, embed_vjp = jax.vjp(embed_f, p["embed"])
+        bias = self._bias(input_ids, attention_mask)
+
+        def stage_fn(sp, xb):
+            h, b = xb
+            return self.stage.apply({"params": sp}, h, b, True), b
+
+        def pl_loss(y, tgt_mb, lp):
+            logits = self._head(y[0], lp["head"], lp["wte"])
+            # the mask rides the target pytree so each microbatch's
+            # loss drops its padding targets — same semantics as
+            # lm_loss(logits, ids, attention_mask) on the monolithic
+            # model (a mask that only shaped the attention bias would
+            # silently leave pad positions in the gradients)
+            return lm_loss(logits, tgt_mb["ids"], tgt_mb.get("mask"))
+
+        run = onef1b_spmd(stage_fn, pl_loss, self.pipe_axis,
+                          self.num_microbatches)
+        loss_params = {"head": p["head"],
+                       "wte": p["embed"]["wte"]["embedding"]}
+        tgt_tree = {"ids": targets}
+        if attention_mask is not None:
+            tgt_tree["mask"] = attention_mask
+
+        def run_wrapped(sp, xb, tgt, lp):
+            loss, g, dxb, dlp = run(sp, xb, tgt, lp)
+            dh = dxb[0]
+            if self.batch_axis:
+                n = lax.axis_size(self.batch_axis)
+                loss = lax.pmean(loss, self.batch_axis)
+                g = jax.tree_util.tree_map(
+                    lambda a: lax.pmean(a, self.batch_axis), g)
+                dlp = jax.tree_util.tree_map(
+                    lambda a: lax.pmean(a, self.batch_axis), dlp)
+                dh = dh / n
+            return loss, g, dh, dlp
+
+        hspec = P(self.batch_axis)
+        f = jax.shard_map(
+            run_wrapped, mesh=self.mesh,
+            in_specs=(jax.tree_util.tree_map(lambda _: P(self.pipe_axis),
+                                             p["stages"]),
+                      (hspec, hspec),
+                      jax.tree_util.tree_map(lambda _: P(self.batch_axis),
+                                             tgt_tree),
+                      jax.tree_util.tree_map(lambda _: P(), loss_params)),
+            out_specs=(P(),
+                       jax.tree_util.tree_map(
+                           lambda _: P(self.pipe_axis), p["stages"]),
+                       hspec,
+                       jax.tree_util.tree_map(lambda _: P(),
+                                              loss_params)))
+        loss, stage_grads, dh, lp_grads = f(p["stages"], (x, bias),
+                                            tgt_tree, loss_params)
+        (embed_grads,) = embed_vjp(dh)
+        # tied wte: embedding-lookup grad + LM-head grad, summed (the
+        # vjp's cotangent tree is fresh, so shallow-copying the two
+        # dicts we touch keeps the mutation local and explicit)
+        embed_grads = {**embed_grads, "wte": dict(embed_grads["wte"])}
+        embed_grads["wte"]["embedding"] = (
+            embed_grads["wte"]["embedding"] + lp_grads["wte"])
+        return loss, {"embed": embed_grads, "stages": stage_grads,
+                      "head": lp_grads["head"]}

@@ -117,7 +117,10 @@ def main():
             yield rng.randint(0, cfg.vocab_size,
                               (args.b, args.seq_len)).astype(np.int32)
 
-    ids0 = jnp.ones((args.b, args.seq_len), jnp.int32)
+    # dp-sized init dummy: a full-batch init would materialize the
+    # (B, S, V) fp32 logits on ONE device — at --seq-len 16384 that is
+    # ~26 GB before training starts (same trick as examples/bert)
+    ids0 = jnp.ones((dp, args.seq_len), jnp.int32)
     params = model.init(jax.random.PRNGKey(0), ids0)["params"]
     opt_state = optimizer.init(params)
     shard = NamedSharding(mesh, P("data"))
