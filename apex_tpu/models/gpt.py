@@ -271,22 +271,32 @@ class PipelinedGPT:
     back on separate paths and are SUMMED, which is exactly the tied
     parameter's chain rule.
 
-    v1 scope (kept honest): ``batch_axis`` composes (DDP mean
-    semantics); deterministic compute only (the per-(microbatch,
-    stage) dropout-key machinery lives in PipelinedBert — wire it
-    through ``_build_stage_fn``-style when needed); no
-    ``seq_axis``/``tp_axis`` yet (use ``models.PipelinedBert`` as the
-    reference implementation for those compositions).
+    ``batch_axis`` composes (DDP mean semantics), and ``seq_axis``
+    shards the sequence inside the pipeline (dp x sp x pp) when paired
+    with a sequence-parallel ``attention_fn`` for the same axis —
+    under 1F1B the attention must be scan-free
+    (``make_ulysses_attention``; the ring is fenced, see
+    tools/repro_ring_1f1b.py).  Still v1-scoped (kept honest):
+    deterministic compute only (the per-(microbatch, stage)
+    dropout-key machinery lives in PipelinedBert — port
+    ``_build_stage_fn`` to enable dropout here); no ``tp_axis`` yet.
     """
 
     def __init__(self, cfg: GPTConfig, mesh, pp: int,
                  num_microbatches: int, pipe_axis: str = "pipe",
                  batch_axis: Optional[str] = None,
+                 seq_axis: Optional[str] = None,
                  attention_fn: Optional[Callable] = None):
         if cfg.num_hidden_layers % pp:
             raise ValueError(
                 f"num_hidden_layers={cfg.num_hidden_layers} must divide "
                 f"into pp={pp} equal stages")
+        if seq_axis is not None and attention_fn is None:
+            raise ValueError(
+                "seq_axis requires a sequence-parallel attention_fn for "
+                "the same axis (parallel.make_ulysses_attention(seq_axis, "
+                "causal=True)) — plain attention would silently attend "
+                "only within each sequence shard")
         if cfg.hidden_dropout_prob or cfg.attention_probs_dropout_prob:
             raise NotImplementedError(
                 "PipelinedGPT v1 is deterministic-only: zero the "
@@ -299,6 +309,7 @@ class PipelinedGPT:
         self.num_microbatches = num_microbatches
         self.pipe_axis = pipe_axis
         self.batch_axis = batch_axis
+        self.seq_axis = seq_axis
         self.attention_fn = attention_fn
         self.embed = GPTEmbed(cfg)
         self.stage = GPTStage(cfg, cfg.num_hidden_layers // pp,
@@ -365,12 +376,13 @@ class PipelinedGPT:
             h, _ = run(sp, xb)
             return h
 
-        hspec = P(self.batch_axis)
+        hspec = P(self.batch_axis, self.seq_axis)
+        bspec = P(self.batch_axis, None, None, self.seq_axis)
         f = jax.shard_map(
             run_wrapped, mesh=self.mesh,
             in_specs=(jax.tree_util.tree_map(lambda _: P(self.pipe_axis),
                                              p["stages"]),
-                      (hspec, hspec)),
+                      (hspec, bspec)),
             out_specs=hspec)
         h = f(p["stages"], (x, bias))
         return self._head(h, p["head"],
@@ -398,6 +410,18 @@ class PipelinedGPT:
 
         from apex_tpu.parallel.pipeline import onef1b_spmd
 
+        if self.seq_axis is not None and not getattr(
+                self.attention_fn, "onef1b_compatible", False):
+            # same fail-closed rule as PipelinedBert: only scan-free
+            # attention may run inside the schedule's cond branches
+            # (the ring's scan-carried collective miscompiles there —
+            # tools/repro_ring_1f1b.py)
+            raise NotImplementedError(
+                "seq_axis under 1F1B needs an attention_fn marked "
+                "onef1b_compatible=True (make_ulysses_attention is; "
+                "ring attention is NOT). Use the GPipe apply() path "
+                "for ring-SP")
+
         p = variables["params"]
 
         def embed_f(ep):
@@ -411,7 +435,13 @@ class PipelinedGPT:
             return self.stage.apply({"params": sp}, h, b, True), b
 
         def pl_loss(y, tgt_mb, lp):
-            logits = self._head(y[0], lp["head"], lp["wte"])
+            h = y[0]
+            if self.seq_axis is not None:
+                # gather the microbatch's sequence shards so the loss
+                # shift sees the full sequence (runs on every sp shard
+                # of the last stage — uniform branch, mb-sized)
+                h = lax.all_gather(h, self.seq_axis, axis=1, tiled=True)
+            logits = self._head(h, lp["head"], lp["wte"])
             # the mask rides the target pytree so each microbatch's
             # loss drops its padding targets — same semantics as
             # lm_loss(logits, ids, attention_mask) on the monolithic
@@ -430,6 +460,18 @@ class PipelinedGPT:
         def run_wrapped(sp, xb, tgt, lp):
             loss, g, dxb, dlp = run(sp, xb, tgt, lp)
             dh = dxb[0]
+            if self.seq_axis:
+                # the tail's all_gather REPLICATES the loss per sp
+                # shard and its transpose SUMS the identical cotangent
+                # copies, so stage partials / head grads / dh carry an
+                # extra n_sp factor (same algebra as PipelinedBert)
+                n_sp = lax.axis_size(self.seq_axis)
+                loss = lax.pmean(loss, self.seq_axis)
+                g = jax.tree_util.tree_map(
+                    lambda a: lax.pmean(a, self.seq_axis), g)
+                dlp = jax.tree_util.tree_map(
+                    lambda a: lax.pmean(a, self.seq_axis), dlp)
+                dh = dh / n_sp
             if self.batch_axis:
                 n = lax.axis_size(self.batch_axis)
                 loss = lax.pmean(loss, self.batch_axis)
@@ -440,12 +482,13 @@ class PipelinedGPT:
                 dh = dh / n
             return loss, g, dh, dlp
 
-        hspec = P(self.batch_axis)
+        hspec = P(self.batch_axis, self.seq_axis)
+        bspec = P(self.batch_axis, None, None, self.seq_axis)
         f = jax.shard_map(
             run_wrapped, mesh=self.mesh,
             in_specs=(jax.tree_util.tree_map(lambda _: P(self.pipe_axis),
                                              p["stages"]),
-                      (hspec, hspec),
+                      (hspec, bspec),
                       jax.tree_util.tree_map(lambda _: P(self.batch_axis),
                                              tgt_tree),
                       jax.tree_util.tree_map(lambda _: P(), loss_params)),
