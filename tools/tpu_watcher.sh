@@ -1,20 +1,24 @@
 #!/bin/bash
-# Probes the axon TPU tunnel every ~9 min; whenever it is live, runs the
-# next PENDING item of the hardware queue — each item in its own process
-# so a mid-compile wedge loses only that item, never the window. Repeats
-# until every item has a recorded success or an explicit give-up record.
+# Probes the axon TPU tunnel every ~4.5 min; whenever it is live, hands
+# the FULL pending hardware queue to ONE tools/bench_followup.py
+# invocation (per-leg watchdogs inside), so the jax-import + probe cost
+# is paid once per window and a wedged leg costs only its own budget.
+# Sections attempted in the current window are not retried until the
+# tunnel has gone down and come back (one attempt per section per
+# window — tools/watcher_queue.py pending TS).
 #
 # ALL queue state is artifact-derived via tools/watcher_queue.py
-# (BENCH_FOLLOWUP.jsonl results + WATCHER_ATTEMPTS.jsonl retry budget),
-# so the watcher survives restarts WITHOUT resetting retry budgets, and
-# give-ups are recorded as {"section": S, "gave_up": true} lines rather
-# than silently dropped (ADVICE r3). Log: /tmp/tpu_watcher.log
+# (BENCH_FOLLOWUP.jsonl results + WATCHER_ATTEMPTS.jsonl retry budget;
+# attempts are now recorded by bench_followup per leg as it starts), so
+# the watcher survives restarts WITHOUT resetting retry budgets.
+# Log: /tmp/tpu_watcher.log
 cd "$(dirname "$0")/.."
 LOG=/tmp/tpu_watcher.log
+window_start=""
 
 while true; do
-  next=$(python tools/watcher_queue.py next)
-  if [ "$next" = none ]; then
+  if [ "$(python tools/watcher_queue.py pending)" = none ]; then
+    python tools/watcher_queue.py sweep >> "$LOG" 2>&1
     echo "$(date +%H:%M:%S) $(python tools/watcher_queue.py status) - exiting" >> "$LOG"
     exit 0
   fi
@@ -22,25 +26,32 @@ while true; do
     # the driver's round-end bench owns the tunnel; two concurrent
     # clients wedge it (observed 2026-07-30) — stand down
     echo "$(date +%H:%M:%S) bench.py running - standing down" >> "$LOG"
-    sleep 540
+    sleep 420
     continue
   fi
-  if timeout 180 python -c "import jax; assert jax.devices()[0].platform=='tpu'" 2>/dev/null; then
-    echo "$(date +%H:%M:%S) TUNNEL UP - running $next" >> "$LOG"
-    python tools/watcher_queue.py start "$next"
-    # only two sections have their own runners; everything else goes to
-    # bench_followup, which accepts queue names directly (alias map in
-    # its main) — so adding a QUEUE entry needs no change here
-    case "$next" in
-      kernel_parity)   timeout 1800 python tools/kernel_parity.py > KERNEL_PARITY_r04.json 2>>"$LOG" ;;
-      tp_pp_bf16)      timeout 1500 python tools/tp_pp_bf16_check.py >> "$LOG" 2>&1 ;;
-      *)               timeout 1800 python tools/bench_followup.py --sections "$next" >> "$LOG" 2>&1 ;;
-    esac
-    python tools/watcher_queue.py finish "$next" >> "$LOG" 2>&1
-    echo "$(date +%H:%M:%S) $next attempt finished" >> "$LOG"
-    sleep 10   # tiny gap, then loop re-probes before the next item
+  if timeout 120 python -c "import jax; assert jax.devices()[0].platform=='tpu'" 2>/dev/null; then
+    [ -z "$window_start" ] && window_start=$(date +%Y-%m-%dT%H:%M:%S)
+    pending=$(python tools/watcher_queue.py pending "$window_start")
+    if [ "$pending" = none ]; then
+      # everything runnable was already attempted this window; wait,
+      # and treat a still-alive tunnel as a fresh window afterwards
+      echo "$(date +%H:%M:%S) window drained (all attempted) - cooling off" >> "$LOG"
+      window_start=""
+      sleep 420
+      continue
+    fi
+    echo "$(date +%H:%M:%S) TUNNEL UP - running: $pending" >> "$LOG"
+    # outer timeout > sum of per-leg budgets (~7060s worst case) so a
+    # slow-but-healthy full-queue drain is never SIGTERMed mid-leg
+    timeout 7500 python tools/bench_followup.py --sections "$pending" >> "$LOG" 2>&1
+    rc=$?
+    echo "$(date +%H:%M:%S) invocation done rc=$rc ($(python tools/watcher_queue.py status))" >> "$LOG"
+    python tools/watcher_queue.py sweep >> "$LOG" 2>&1
+    sleep 10   # tiny gap, then re-probe: rc 3 means a leg wedged and
+               # the rest of the queue is still pending this window
   else
-    echo "$(date +%H:%M:%S) tunnel down (next: $next)" >> "$LOG"
-    sleep 540
+    window_start=""
+    echo "$(date +%H:%M:%S) tunnel down (pending: $(python tools/watcher_queue.py pending))" >> "$LOG"
+    sleep 270
   fi
 done
