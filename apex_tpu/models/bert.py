@@ -29,6 +29,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.models.pipelined_common import PipelinedCommon
 from apex_tpu.normalization import FusedLayerNorm
 
 
@@ -279,7 +280,7 @@ class BertHeads(nn.Module):
         return _pretraining_heads(self.cfg, seq)
 
 
-class PipelinedBert:
+class PipelinedBert(PipelinedCommon):
     """BERT-for-pretraining with the encoder stack pipelined over a mesh
     axis (GPipe, ``parallel.gpipe_spmd``) — the PP composition the
     reference never had (SURVEY §2.3).
@@ -392,66 +393,9 @@ class PipelinedBert:
         return {"params": {"embed": embed_p, "stages": stage_p,
                            "heads": heads_p}}
 
-    def shard_variables(self, variables):
-        """Place the variables for this model's mesh: stage stacks on the
-        pipe axis, and — with ``tp_axis`` — Megatron-style tensor-parallel
-        placement (``parallel.bert_tp_rules``) layered on top: stage
-        leaves get ``P(pipe, *tp_spec)``, embeddings/heads their unstacked
-        TP specs.  The TP axis stays GSPMD-automatic inside the pipeline's
-        ``shard_map`` (partial-manual mode), so XLA inserts the Megatron
-        collectives around the model-sharded matmuls while the pipe/data
-        axes run the explicit schedule."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        from apex_tpu.parallel.tensor_parallel import (bert_tp_rules,
-                                                       param_specs,
-                                                       shard_params)
-
-        def place(tree, specs):
-            return jax.tree_util.tree_map(
-                lambda x, s: jax.device_put(
-                    x, NamedSharding(self.mesh, s)), tree, specs)
-
-        p = dict(variables["params"])
-        if self.tp_axis is not None:
-            rules = bert_tp_rules(self.tp_axis)
-            stacked = tuple((pat, P(self.pipe_axis, *spec))
-                            for pat, spec in rules)
-            outer = shard_params({"embed": p["embed"],
-                                  "heads": p["heads"]}, self.mesh, rules)
-            stage_specs = param_specs(p["stages"], self.mesh, stacked)
-            # leaves no stacked rule matched still live on the pipe axis
-            stage_specs = jax.tree_util.tree_map(
-                lambda s: s if len(s) and s[0] == self.pipe_axis
-                else P(self.pipe_axis), stage_specs)
-            p.update(embed=outer["embed"], heads=outer["heads"],
-                     stages=place(p["stages"], stage_specs))
-        else:
-            repl = NamedSharding(self.mesh, P())
-            p["embed"] = jax.device_put(p["embed"], repl)
-            p["heads"] = jax.device_put(p["heads"], repl)
-            p["stages"] = place(
-                p["stages"], jax.tree_util.tree_map(
-                    lambda _: P(self.pipe_axis), p["stages"]))
-        return {"params": p}
-
-    def _partial_manual_kwargs(self):
-        """shard_map kwargs shared by the GPipe and 1F1B paths: without
-        TP both run fully manual; with ``tp_axis`` the model axis stays
-        GSPMD-automatic (partial-manual mode) so XLA inserts the
-        Megatron collectives inside the manual schedule, and
-        ``check_vma=False`` because vma checking doesn't support
-        partial-auto outputs yet (the schedules' pvary discipline still
-        applies — tools/repro_ring_1f1b.py variant F runs the 1F1B
-        schedule under check_vma=False)."""
-        if self.tp_axis is None:
-            return {}
-        manual = {self.pipe_axis}
-        if self.batch_axis:
-            manual.add(self.batch_axis)
-        if self.seq_axis:
-            manual.add(self.seq_axis)
-        return dict(axis_names=manual, check_vma=False)
+    # param_spec_tree / shard_variables / constrain_grads /
+    # _partial_manual_kwargs / _dropout_setup come from PipelinedCommon
+    tp_rules_name = "bert_tp_rules"
 
     def _bias(self, input_ids, attention_mask):
         b, s = input_ids.shape
@@ -459,25 +403,6 @@ class PipelinedBert:
             return jnp.zeros((b, 1, 1, s), jnp.float32)
         return jnp.where(attention_mask[:, None, None, :] > 0,
                          0.0, -1e9).astype(jnp.float32)
-
-    def _dropout_setup(self, deterministic, rngs, caller):
-        """Shared rng prologue of both training paths: validates the
-        rngs contract and derives the embed key (a fold_in index far
-        outside the microbatch-id range the stage keys use).
-        Returns ``(needs_rng, base_key, embed_rngs)``."""
-        cfg = self.cfg
-        needs_rng = not deterministic and (
-            cfg.hidden_dropout_prob > 0
-            or cfg.attention_probs_dropout_prob > 0)
-        if not needs_rng:
-            return False, None, None
-        if not rngs or "dropout" not in rngs:
-            raise ValueError(
-                f"{caller}(deterministic=False) with dropout in the "
-                "config needs rngs={'dropout': key}")
-        base_key = rngs["dropout"]
-        return True, base_key, {
-            "dropout": jax.random.fold_in(base_key, 2 ** 20)}
 
     def _schedule_input(self, h, b, needs_rng):
         """The ``(hidden, bias[, mb_ids], aux0)`` activation tuple both
@@ -822,8 +747,12 @@ class PipelinedBert:
         loss, stage_grads, dh, head_grads = f(p["stages"], (x, bias),
                                               targets, p["heads"])
         (embed_grads,) = embed_vjp(dh)
-        return loss, {"embed": embed_grads, "stages": stage_grads,
-                      "heads": head_grads}
+        # constrain_grads: without it the grads exit the partial-manual
+        # shard_map with unspecified tp-axis sharding and one optimizer
+        # step strips the Megatron placement (PipelinedCommon)
+        return loss, self.constrain_grads(
+            {"embed": embed_grads, "stages": stage_grads,
+             "heads": head_grads})
 
 
 class BertForPreTraining(nn.Module):

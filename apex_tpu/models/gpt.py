@@ -37,6 +37,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.models.pipelined_common import PipelinedCommon
 from apex_tpu.normalization import FusedLayerNorm
 
 NEG_INF = -1e9
@@ -255,7 +256,7 @@ class GPTEmbed(nn.Module):
         return x
 
 
-class PipelinedGPT:
+class PipelinedGPT(PipelinedCommon):
     """GPT over a ``pipe`` mesh axis — the decoder counterpart of
     :class:`models.PipelinedBert` (same schedules,
     ``parallel.pipeline``; same variables convention so
@@ -276,16 +277,31 @@ class PipelinedGPT:
     with a sequence-parallel ``attention_fn`` for the same axis —
     under 1F1B the attention must be scan-free
     (``make_ulysses_attention``; the ring is fenced, see
-    tools/repro_ring_1f1b.py).  Still v1-scoped (kept honest):
-    deterministic compute only (the per-(microbatch, stage)
-    dropout-key machinery lives in PipelinedBert — port
-    ``_build_stage_fn`` to enable dropout here); no ``tp_axis`` yet.
+    tools/repro_ring_1f1b.py).
+
+    ``tp_axis`` layers Megatron tensor parallelism on top
+    (``parallel.gpt_tp_rules``): stage weights take
+    ``P(pipe, ...model...)`` placement and the TP axis stays
+    GSPMD-automatic inside the pipeline's ``shard_map``
+    (partial-manual mode) — same machinery as ``PipelinedBert``.  The
+    TIED ``wte`` shards its vocab dim, so the LM-head einsum runs
+    column-parallel (each device computes its vocab slice of the
+    logits) instead of replicating the whole-vocab matmul.  Same KNOWN
+    LIMITATION as PipelinedBert: amp O2/O3 compute inside the
+    partial-manual region trips this jax build's XLA CPU backend;
+    ``tp_axis`` is tested fp32 (tools/tp_pp_bf16_check.py rechecks the
+    TPU backend at live windows).
+
+    Dropout composes like PipelinedBert: ``deterministic=False`` +
+    ``rngs={"dropout": key}``; each (microbatch, stage[, shard]) folds
+    its coordinates into the key inside the pipeline body.
     """
 
     def __init__(self, cfg: GPTConfig, mesh, pp: int,
                  num_microbatches: int, pipe_axis: str = "pipe",
                  batch_axis: Optional[str] = None,
                  seq_axis: Optional[str] = None,
+                 tp_axis: Optional[str] = None,
                  attention_fn: Optional[Callable] = None):
         if cfg.num_hidden_layers % pp:
             raise ValueError(
@@ -297,12 +313,6 @@ class PipelinedGPT:
                 "the same axis (parallel.make_ulysses_attention(seq_axis, "
                 "causal=True)) — plain attention would silently attend "
                 "only within each sequence shard")
-        if cfg.hidden_dropout_prob or cfg.attention_probs_dropout_prob:
-            raise NotImplementedError(
-                "PipelinedGPT v1 is deterministic-only: zero the "
-                "dropout probs (the per-(microbatch, stage) key "
-                "machinery is in PipelinedBert; port _build_stage_fn "
-                "to enable dropout here)")
         self.cfg = cfg
         self.mesh = mesh
         self.pp = pp
@@ -310,6 +320,7 @@ class PipelinedGPT:
         self.pipe_axis = pipe_axis
         self.batch_axis = batch_axis
         self.seq_axis = seq_axis
+        self.tp_axis = tp_axis
         self.attention_fn = attention_fn
         self.embed = GPTEmbed(cfg)
         self.stage = GPTStage(cfg, cfg.num_hidden_layers // pp,
@@ -331,18 +342,53 @@ class PipelinedGPT:
         return {"params": {"embed": embed_p, "stages": stage_p,
                            "head": head_p}}
 
-    def shard_variables(self, variables):
-        from jax.sharding import NamedSharding, PartitionSpec as P
+    # param_spec_tree / shard_variables / constrain_grads /
+    # _partial_manual_kwargs / _dropout_setup come from PipelinedCommon
+    tp_rules_name = "gpt_tp_rules"
 
-        p = dict(variables["params"])
-        repl = NamedSharding(self.mesh, P())
-        p["embed"] = jax.device_put(p["embed"], repl)
-        p["head"] = jax.device_put(p["head"], repl)
-        p["stages"] = jax.tree_util.tree_map(
-            lambda a: jax.device_put(
-                a, NamedSharding(self.mesh, P(self.pipe_axis))),
-            p["stages"])
-        return {"params": p}
+    def _schedule_input(self, h, b, needs_rng):
+        """Activation tuple both schedules feed their stage_fn:
+        ``(hidden, bias[, mb_ids])`` — mb ids carry one microbatch id
+        per row (contiguous groups, matching how the schedules split
+        the local batch) for per-(microbatch, stage) dropout keys.
+        No MoE aux leaf here: GPTConfig has no expert knobs."""
+        if needs_rng:
+            mb = jnp.arange(h.shape[0], dtype=jnp.int32) // \
+                max(1, h.shape[0] // self.num_microbatches)
+            return (h, b, mb)
+        return (h, b)
+
+    def _build_stage_fn(self, needs_rng, base_key, deterministic):
+        """The per-stage body both schedules share — the decoder port
+        of ``PipelinedBert._build_stage_fn`` (per-(microbatch, stage
+        [, shard]) dropout keys derived inside the pipeline body so
+        1F1B's rematerialized backward draws the same masks as the
+        GPipe forward)."""
+        from jax import lax
+
+        def stage_fn(sp, xb):
+            h, b, mb = xb if needs_rng else (xb[0], xb[1], None)
+            stage_rngs = None
+            if needs_rng:
+                key = jax.random.fold_in(base_key, mb[0])
+                key = jax.random.fold_in(
+                    key, lax.axis_index(self.pipe_axis))
+                if self.batch_axis:
+                    key = jax.random.fold_in(
+                        key, lax.axis_index(self.batch_axis))
+                if self.seq_axis:
+                    key = jax.random.fold_in(
+                        key, lax.axis_index(self.seq_axis))
+                stage_rngs = {"dropout": key}
+            out = self.stage.apply(
+                {"params": sp}, h, b,
+                deterministic if stage_rngs is None else False,
+                rngs=stage_rngs)
+            if needs_rng:
+                return (out, b, mb)
+            return (out, b)
+
+        return stage_fn
 
     def _bias(self, input_ids, attention_mask):
         b, s = input_ids.shape
@@ -356,25 +402,25 @@ class PipelinedGPT:
         return jnp.einsum("bsh,vh->bsv", x, wte).astype(jnp.float32)
 
     def apply(self, variables, input_ids, attention_mask=None,
-              deterministic: bool = True):
+              deterministic: bool = True, rngs=None):
         from jax.sharding import PartitionSpec as P
 
         from apex_tpu.parallel.pipeline import gpipe_spmd
 
+        needs_rng, base_key, embed_rngs = self._dropout_setup(
+            deterministic, rngs, "PipelinedGPT.apply")
+
         p = variables["params"]
         x = self.embed.apply({"params": p["embed"]}, input_ids,
-                             deterministic)
+                             deterministic, rngs=embed_rngs)
         bias = self._bias(input_ids, attention_mask)
 
-        def stage_fn(sp, xb):
-            h, b = xb
-            return self.stage.apply({"params": sp}, h, b, deterministic), b
-
+        stage_fn = self._build_stage_fn(needs_rng, base_key,
+                                        deterministic)
         run = gpipe_spmd(stage_fn, self.pipe_axis, self.num_microbatches)
 
         def run_wrapped(sp, xb):
-            h, _ = run(sp, xb)
-            return h
+            return run(sp, self._schedule_input(*xb, needs_rng))[0]
 
         hspec = P(self.batch_axis, self.seq_axis)
         bspec = P(self.batch_axis, None, None, self.seq_axis)
@@ -383,13 +429,14 @@ class PipelinedGPT:
             in_specs=(jax.tree_util.tree_map(lambda _: P(self.pipe_axis),
                                              p["stages"]),
                       (hspec, bspec)),
-            out_specs=hspec)
+            out_specs=hspec, **self._partial_manual_kwargs())
         h = f(p["stages"], (x, bias))
         return self._head(h, p["head"],
                           p["embed"]["wte"]["embedding"])
 
     def loss_and_grad_1f1b(self, variables, input_ids, targets,
-                           attention_mask=None):
+                           attention_mask=None,
+                           deterministic: bool = True, rngs=None):
         """1F1B training step: ``targets`` are the (B, S) token ids the
         loss shifts against (usually ``input_ids`` itself).  Returns
         ``(loss, grads)`` with grads matching ``variables["params"]``;
@@ -422,17 +469,20 @@ class PipelinedGPT:
                 "ring attention is NOT). Use the GPipe apply() path "
                 "for ring-SP")
 
+        needs_rng, base_key, embed_rngs = self._dropout_setup(
+            deterministic, rngs, "loss_and_grad_1f1b")
+
         p = variables["params"]
 
         def embed_f(ep):
-            return self.embed.apply({"params": ep}, input_ids, True)
+            return self.embed.apply({"params": ep}, input_ids,
+                                    deterministic, rngs=embed_rngs)
 
         x, embed_vjp = jax.vjp(embed_f, p["embed"])
         bias = self._bias(input_ids, attention_mask)
 
-        def stage_fn(sp, xb):
-            h, b = xb
-            return self.stage.apply({"params": sp}, h, b, True), b
+        stage_fn = self._build_stage_fn(needs_rng, base_key,
+                                        deterministic)
 
         def pl_loss(y, tgt_mb, lp):
             h = y[0]
@@ -458,7 +508,8 @@ class PipelinedGPT:
             tgt_tree["mask"] = attention_mask
 
         def run_wrapped(sp, xb, tgt, lp):
-            loss, g, dxb, dlp = run(sp, xb, tgt, lp)
+            loss, g, dxb, dlp = run(
+                sp, self._schedule_input(*xb, needs_rng), tgt, lp)
             dh = dxb[0]
             if self.seq_axis:
                 # the tail's all_gather REPLICATES the loss per sp
@@ -497,7 +548,8 @@ class PipelinedGPT:
                            lambda _: P(self.pipe_axis), p["stages"]),
                        hspec,
                        jax.tree_util.tree_map(lambda _: P(),
-                                              loss_params)))
+                                              loss_params)),
+            **self._partial_manual_kwargs())
         loss, stage_grads, dh, lp_grads = f(p["stages"], (x, bias),
                                             tgt_tree, loss_params)
         (embed_grads,) = embed_vjp(dh)
@@ -507,5 +559,9 @@ class PipelinedGPT:
         embed_grads = {**embed_grads, "wte": dict(embed_grads["wte"])}
         embed_grads["wte"]["embedding"] = (
             embed_grads["wte"]["embedding"] + lp_grads["wte"])
-        return loss, {"embed": embed_grads, "stages": stage_grads,
-                      "head": lp_grads["head"]}
+        # constrain_grads: without it the grads exit the partial-manual
+        # shard_map with unspecified tp-axis sharding and one optimizer
+        # step strips the Megatron placement (PipelinedCommon)
+        return loss, self.constrain_grads(
+            {"embed": embed_grads, "stages": stage_grads,
+             "head": lp_grads["head"]})

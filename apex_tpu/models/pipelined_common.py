@@ -1,0 +1,106 @@
+"""Shared plumbing of the pipelined model families.
+
+``PipelinedBert`` and ``PipelinedGPT`` differ in their stage bodies and
+loss heads but share the schedule-facing contracts: Megatron placement
+stacked over the pipe axis, the partial-manual shard_map kwargs for a
+GSPMD-automatic tp axis, and the dropout rng prologue.  One copy here
+(plus ``parallel.tensor_parallel.pipeline_param_specs``) so a fix
+cannot drift between the encoder and decoder families.
+
+The mixin reads the attributes both families set in ``__init__``:
+``mesh, pipe_axis, batch_axis, seq_axis, tp_axis, cfg`` (cfg carries
+``hidden_dropout_prob`` / ``attention_probs_dropout_prob``).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class PipelinedCommon:
+    #: name of this family's Megatron rules factory in
+    #: ``apex_tpu.parallel.tensor_parallel`` (resolved lazily — the
+    #: models package must not import parallel at module scope); set by
+    #: the subclass, e.g. ``"gpt_tp_rules"``
+    tp_rules_name = None
+
+    def param_spec_tree(self, params):
+        """The PartitionSpec pytree ``shard_variables`` places by and
+        ``loss_and_grad_1f1b`` constrains its grads to — the tp axis is
+        GSPMD-automatic inside the schedules' shard_map, so grad
+        shardings come out UNSPECIFIED, XLA is free to replicate them,
+        and one optimizer step would silently strip the Megatron
+        placement off the updated params (found by driving a jitted
+        dp x tp x pp train loop: the tied wte lost its vocab sharding
+        after step 1)."""
+        from apex_tpu.parallel import tensor_parallel
+
+        rules = (getattr(tensor_parallel, self.tp_rules_name)(self.tp_axis)
+                 if self.tp_axis is not None else ())
+        return tensor_parallel.pipeline_param_specs(
+            params, self.mesh, rules, self.pipe_axis)
+
+    def shard_variables(self, variables):
+        """Place the variables for this model's mesh: stage stacks on
+        the pipe axis; with ``tp_axis``, Megatron placement (this
+        family's ``tp_rules``) layers on top — stage leaves get
+        ``P(pipe, *tp_spec)``, the outer groups their unstacked TP
+        specs.  The TP axis stays GSPMD-automatic inside the pipeline's
+        ``shard_map`` (partial-manual mode), so XLA inserts the
+        Megatron collectives around the model-sharded matmuls while the
+        pipe/data axes run the explicit schedule."""
+        from jax.sharding import NamedSharding
+
+        p = variables["params"]
+        specs = self.param_spec_tree(p)
+        return {"params": jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+            dict(p), specs)}
+
+    def constrain_grads(self, grads):
+        """Pin 1F1B grads to the params' Megatron specs (see
+        ``param_spec_tree``); no-op without ``tp_axis``."""
+        if self.tp_axis is None:
+            return grads
+        from jax.sharding import NamedSharding
+        return jax.tree_util.tree_map(
+            lambda g, s: jax.lax.with_sharding_constraint(
+                g, NamedSharding(self.mesh, s)),
+            grads, self.param_spec_tree(grads))
+
+    def _partial_manual_kwargs(self):
+        """shard_map kwargs shared by the GPipe and 1F1B paths: without
+        TP both run fully manual; with ``tp_axis`` the model axis stays
+        GSPMD-automatic (partial-manual mode) so XLA inserts the
+        Megatron collectives inside the manual schedule, and
+        ``check_vma=False`` because vma checking doesn't support
+        partial-auto outputs yet (the schedules' pvary discipline still
+        applies — tools/repro_ring_1f1b.py variant F runs the 1F1B
+        schedule under check_vma=False)."""
+        if self.tp_axis is None:
+            return {}
+        manual = {self.pipe_axis}
+        if self.batch_axis:
+            manual.add(self.batch_axis)
+        if self.seq_axis:
+            manual.add(self.seq_axis)
+        return dict(axis_names=manual, check_vma=False)
+
+    def _dropout_setup(self, deterministic, rngs, caller):
+        """Shared rng prologue of both training paths: validates the
+        rngs contract and derives the embed key (a fold_in index far
+        outside the microbatch-id range the stage keys use).
+        Returns ``(needs_rng, base_key, embed_rngs)``."""
+        cfg = self.cfg
+        needs_rng = not deterministic and (
+            cfg.hidden_dropout_prob > 0
+            or cfg.attention_probs_dropout_prob > 0)
+        if not needs_rng:
+            return False, None, None
+        if not rngs or "dropout" not in rngs:
+            raise ValueError(
+                f"{caller}(deterministic=False) with dropout in the "
+                "config needs rngs={'dropout': key}")
+        base_key = rngs["dropout"]
+        return True, base_key, {
+            "dropout": jax.random.fold_in(base_key, 2 ** 20)}

@@ -184,6 +184,16 @@ class FusedAdam:
       xprof-measured, BENCH_NOTES.md). Same update semantics, group
       support, and skip protocol; state is per-leaf (like optax), so
       checkpoints are layout-specific.
+
+    Tensor-parallel params need ``layout="tree"``: the flat layout's
+    whole-model concat cannot preserve per-param Megatron placements
+    (``parallel.gpt_tp_rules`` / ``bert_tp_rules``), so a flat-layout
+    step gathers the TP shards and emits replicated params — numerics
+    are right but the placement is silently gone after one step (found
+    by driving a dp x tp x pp train loop). The tree layout updates each
+    leaf in place, so shardings propagate through. Flat + ZeRO over the
+    DATA axis (``with_zero``) is unaffected — that sharding is applied
+    to the flat buffers themselves.
     """
 
     # AmpOptimizer.apply_gradients: the overflow->skip select runs inside

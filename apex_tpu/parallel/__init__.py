@@ -37,6 +37,7 @@ from apex_tpu.parallel.pipeline import (
 from apex_tpu.parallel.tensor_parallel import (
     BERT_TP_RULES,
     bert_tp_rules,
+    gpt_tp_rules,
     param_specs,
     shard_params,
 )
@@ -61,6 +62,7 @@ __all__ = [
     "Reducer",
     "SyncBatchNorm",
     "bert_tp_rules",
+    "gpt_tp_rules",
     "param_specs",
     "shard_params",
     "all_gather_tree",

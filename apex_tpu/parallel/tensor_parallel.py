@@ -65,6 +65,29 @@ def bert_tp_rules(axis: str = "model") -> Rules:
 BERT_TP_RULES = bert_tp_rules()
 
 
+def gpt_tp_rules(axis: str = "model") -> Rules:
+    """Megatron-style split for ``models.gpt`` — the decoder-family
+    counterpart of :func:`bert_tp_rules` (VERDICT r4 #3).  Same
+    attention split (q/k/v shard heads, output row-parallel), MLP under
+    GPT's ``mlp_in``/``mlp_out`` names, and — the decoder-specific
+    piece — the TIED embedding ``wte`` shards its VOCAB dim: the
+    embedding lookup becomes a shard-local gather + all-reduce and the
+    tied LM head's ``bsh,vh->bsv`` einsum becomes column-parallel
+    (each device computes its vocab slice of the logits), removing the
+    replicated whole-vocab matmul that would otherwise dominate the
+    step (it is the single biggest matmul at vocab 50k).  Position
+    table ``wpe`` stays replicated (it is S x H, tiny)."""
+    return (
+        (r"attention/(query|key|value)/kernel$", P(None, axis, None)),
+        (r"attention/(query|key|value)/bias$", P(axis, None)),
+        (r"attention/output/kernel$", P(axis, None, None)),
+        (r"mlp_in/kernel$", P(None, axis)),
+        (r"mlp_in/bias$", P(axis)),
+        (r"mlp_out/kernel$", P(axis, None)),
+        (r"wte/embedding$", P(axis, None)),
+    )
+
+
 def _spec_fits(shape, spec: P, mesh: Mesh, rule_pat: str) -> bool:
     if len(spec) > len(shape):
         # rank mismatch is a rule-authoring error like a missing axis,
@@ -115,3 +138,30 @@ def shard_params(params: Pytree, mesh: Mesh, rules: Rules) -> Pytree:
     return jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
         params, specs)
+
+
+def pipeline_param_specs(params: Pytree, mesh: Mesh, rules: Rules,
+                         pipe_axis: str,
+                         stage_key: str = "stages") -> Pytree:
+    """Spec pytree for a pipelined model's grouped params (the shared
+    backend of ``PipelinedBert.param_spec_tree`` and
+    ``PipelinedGPT.param_spec_tree`` — one copy of the stacking/
+    fallback logic, so a fix applies to both families).
+
+    Non-stage groups take their plain rule specs (replicated when no
+    rule matches — which with empty ``rules`` means everything, the
+    no-TP case).  The ``stage_key`` group holds stage params STACKED
+    with a leading ``(pp, ...)`` dim, so its rules become
+    ``P(pipe_axis, *spec)`` and any leaf no rule matched still lives
+    on the pipe axis."""
+    stacked = tuple((pat, P(pipe_axis, *spec)) for pat, spec in rules)
+    out = {}
+    for key, sub in params.items():
+        if key == stage_key:
+            specs = param_specs(sub, mesh, stacked)
+            out[key] = jax.tree_util.tree_map(
+                lambda s: s if len(s) and s[0] == pipe_axis
+                else P(pipe_axis), specs)
+        else:
+            out[key] = param_specs(sub, mesh, rules)
+    return out

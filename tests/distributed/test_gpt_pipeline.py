@@ -123,11 +123,106 @@ def test_pipelined_gpt_1f1b_dp_x_pp():
     assert leaf.sharding.spec[0] == "pipe"
 
 
-def test_pipelined_gpt_rejects_dropout():
+def test_pipelined_gpt_1f1b_dropout_matches_gpipe_autodiff():
+    """With live dropout, 1F1B's rematerialized backward draws the SAME
+    per-(microbatch, stage) keys as the GPipe apply path, so grads must
+    match autodiff through apply exactly (decoder port of
+    test_bert_1f1b_dropout_matches_gpipe_autodiff)."""
     mesh = Mesh(np.asarray(jax.devices()[:4]), ("pipe",))
-    cfg = models.GPTConfig(num_hidden_layers=4)   # default dropout 0.1
-    with pytest.raises(NotImplementedError, match="deterministic-only"):
-        models.PipelinedGPT(cfg, mesh, pp=4, num_microbatches=2)
+    cfg = models.GPTConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=4,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=16, hidden_dropout_prob=0.1,
+        attention_probs_dropout_prob=0.1)
+    pg = models.PipelinedGPT(cfg, mesh, pp=4, num_microbatches=2)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (4, 16), 0, 64)
+    variables = pg.init(jax.random.PRNGKey(1), ids)
+    key = jax.random.PRNGKey(7)
+    with mesh:
+        loss, grads = jax.jit(
+            lambda v, i: pg.loss_and_grad_1f1b(
+                v, i, i, deterministic=False,
+                rngs={"dropout": key}))(variables, ids)
+
+        def gpipe_loss(p):
+            logits = pg.apply({"params": p}, ids, deterministic=False,
+                              rngs={"dropout": key})
+            return models.lm_loss(logits, ids)
+
+        want_l, want_g = jax.jit(jax.value_and_grad(gpipe_loss))(
+            variables["params"])
+    np.testing.assert_allclose(float(loss), float(want_l), rtol=1e-5)
+    # the tied wte grad sums lookup + head paths under dropout too
+    for name in ("embed", "stages", "head"):
+        for a, b in zip(jax.tree.leaves(grads[name]),
+                        jax.tree.leaves(want_g[name])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=1e-5)
+    # teeth: dropout actually perturbs the objective
+    with mesh:
+        det_loss, _ = jax.jit(
+            lambda v, i: pg.loss_and_grad_1f1b(v, i, i))(variables, ids)
+    assert abs(float(det_loss) - float(loss)) > 1e-5
+
+
+def test_pipelined_gpt_dropout_requires_rngs():
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("pipe",))
+    cfg = models.GPTConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=4,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=16)   # default dropout 0.1
+    pg = models.PipelinedGPT(cfg, mesh, pp=4, num_microbatches=2)
+    ids = jnp.ones((4, 16), jnp.int32)
+    variables = pg.init(jax.random.PRNGKey(1), ids)
+    with pytest.raises(ValueError, match="dropout"):
+        pg.loss_and_grad_1f1b(variables, ids, ids, deterministic=False)
+
+
+def test_pipelined_gpt_1f1b_dp_tp_pp_matches_monolithic():
+    """dp x tp x pp for the decoder family (VERDICT r4 #3): Megatron
+    placement via gpt_tp_rules — incl. the vocab-sharded tied wte, so
+    the LM head einsum runs column-parallel — under the 1F1B schedule;
+    loss + grads pinned vs monolithic autodiff (fp32, like the
+    encoder-family pin)."""
+    mesh = Mesh(np.asarray(jax.devices()[:NDEV]).reshape(2, 2, 2),
+                ("data", "model", "pipe"))
+    cfg = _cfg(layers=2)
+    pg = models.PipelinedGPT(cfg, mesh, pp=2, num_microbatches=2,
+                             batch_axis="data", tp_axis="model")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (4, 16), 0, 64)
+    variables = pg.shard_variables(pg.init(jax.random.PRNGKey(1), ids))
+    # placement: tied wte vocab-sharded, q/k/v stage kernels head-sharded
+    assert variables["params"]["embed"]["wte"][
+        "embedding"].sharding.spec == P("model", None)
+    qk = variables["params"]["stages"]["block_0"]["attention"]["query"][
+        "kernel"]
+    assert qk.sharding.spec == P("pipe", None, "model", None)
+    with mesh:
+        loss, grads = jax.jit(
+            lambda v, i: pg.loss_and_grad_1f1b(v, i, i))(variables, ids)
+
+    mono_p = _monolithic_params(variables, 2, 1)
+
+    def mono_loss(p):
+        logits = models.GPTLMHeadModel(cfg).apply({"params": p}, ids)
+        return models.lm_loss(logits, ids)
+
+    want_l, want_g = jax.value_and_grad(mono_loss)(mono_p)
+    np.testing.assert_allclose(float(loss), float(want_l), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(grads["embed"]["wte"]["embedding"]),
+        np.asarray(want_g["wte"]["embedding"]), rtol=3e-4, atol=2e-5)
+    # grads are constrained to the params' Megatron specs, so a
+    # per-leaf optimizer step preserves the TP placement (they
+    # otherwise exit the partial-manual shard_map with unspecified
+    # automatic-axis sharding and XLA replicates the updated params)
+    assert "model" in grads["embed"]["wte"]["embedding"].sharding.spec
+    for li in range(cfg.num_hidden_layers):
+        got_li = jax.tree.map(lambda a: a[li], grads["stages"]["block_0"])
+        for a, b in zip(jax.tree.leaves(got_li),
+                        jax.tree.leaves(want_g[f"block_{li}"])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=3e-4, atol=2e-5)
 
 
 def test_pipelined_gpt_1f1b_mask_in_loss():
