@@ -421,9 +421,7 @@ class PipelinedBert(PipelinedCommon):
 
         aux0 = vary_like(jnp.zeros((h.shape[0],), jnp.float32), h)
         if needs_rng:
-            mb = jnp.arange(h.shape[0], dtype=jnp.int32) // \
-                max(1, h.shape[0] // self.num_microbatches)
-            return (h, b, mb, aux0)
+            return (h, b, self._microbatch_ids(h), aux0)
         return (h, b, aux0)
 
     def _build_stage_fn(self, needs_rng, base_key, deterministic):
@@ -459,20 +457,11 @@ class PipelinedBert(PipelinedCommon):
                              (xb[0], xb[1], None, xb[2]))
             stage_rngs = None
             if needs_rng:
-                # independent mask per (microbatch, stage[, data shard]):
-                # mb rides the activation pytree (one id per microbatch,
-                # garbage during bubble ticks whose outputs are
-                # discarded), the stage/shard indices come from the mesh
-                key = jax.random.fold_in(base_key, mb[0])
-                key = jax.random.fold_in(
-                    key, lax.axis_index(self.pipe_axis))
-                if self.batch_axis:
-                    key = jax.random.fold_in(
-                        key, lax.axis_index(self.batch_axis))
-                if self.seq_axis:
-                    key = jax.random.fold_in(
-                        key, lax.axis_index(self.seq_axis))
-                stage_rngs = {"dropout": key}
+                # independent mask per (microbatch, stage[, shard]) —
+                # the key chain lives in PipelinedCommon so the two
+                # families cannot drift
+                stage_rngs = {
+                    "dropout": self._stage_dropout_key(base_key, mb)}
             out, stage_aux = run_stage(sp, h, b, stage_rngs)
             # aux accumulates across stages in a per-row (b/m,) leaf of
             # the activation pytree (the schedules require the shared

@@ -353,9 +353,7 @@ class PipelinedGPT(PipelinedCommon):
         the local batch) for per-(microbatch, stage) dropout keys.
         No MoE aux leaf here: GPTConfig has no expert knobs."""
         if needs_rng:
-            mb = jnp.arange(h.shape[0], dtype=jnp.int32) // \
-                max(1, h.shape[0] // self.num_microbatches)
-            return (h, b, mb)
+            return (h, b, self._microbatch_ids(h))
         return (h, b)
 
     def _build_stage_fn(self, needs_rng, base_key, deterministic):
@@ -364,22 +362,13 @@ class PipelinedGPT(PipelinedCommon):
         [, shard]) dropout keys derived inside the pipeline body so
         1F1B's rematerialized backward draws the same masks as the
         GPipe forward)."""
-        from jax import lax
 
         def stage_fn(sp, xb):
             h, b, mb = xb if needs_rng else (xb[0], xb[1], None)
             stage_rngs = None
             if needs_rng:
-                key = jax.random.fold_in(base_key, mb[0])
-                key = jax.random.fold_in(
-                    key, lax.axis_index(self.pipe_axis))
-                if self.batch_axis:
-                    key = jax.random.fold_in(
-                        key, lax.axis_index(self.batch_axis))
-                if self.seq_axis:
-                    key = jax.random.fold_in(
-                        key, lax.axis_index(self.seq_axis))
-                stage_rngs = {"dropout": key}
+                stage_rngs = {
+                    "dropout": self._stage_dropout_key(base_key, mb)}
             out = self.stage.apply(
                 {"params": sp}, h, b,
                 deterministic if stage_rngs is None else False,

@@ -86,6 +86,37 @@ class PipelinedCommon:
             manual.add(self.seq_axis)
         return dict(axis_names=manual, check_vma=False)
 
+    def _microbatch_ids(self, h):
+        """One microbatch id per row, assigned the way the schedules
+        split the (local) batch — contiguous b_local/m groups.  Both
+        families' ``_schedule_input`` must use THIS formula or the
+        dropout keys drift between them."""
+        import jax.numpy as jnp
+
+        return jnp.arange(h.shape[0], dtype=jnp.int32) // \
+            max(1, h.shape[0] // self.num_microbatches)
+
+    def _stage_dropout_key(self, base_key, mb):
+        """The per-(microbatch, stage[, data shard][, seq shard]) key
+        chain — the single definition of GPipe/1F1B mask identity for
+        both families (a fold-order change applied to one family only
+        would silently desynchronize the other's 1F1B-vs-autodiff
+        guarantee).  ``mb`` is the microbatch-id row vector riding the
+        activation pytree (garbage during bubble ticks, whose outputs
+        are discarded).  No tp-axis fold: tp is GSPMD-automatic and the
+        mask must agree across the TP group."""
+        from jax import lax
+
+        key = jax.random.fold_in(base_key, mb[0])
+        key = jax.random.fold_in(key, lax.axis_index(self.pipe_axis))
+        if self.batch_axis:
+            key = jax.random.fold_in(
+                key, lax.axis_index(self.batch_axis))
+        if self.seq_axis:
+            key = jax.random.fold_in(
+                key, lax.axis_index(self.seq_axis))
+        return key
+
     def _dropout_setup(self, deterministic, rngs, caller):
         """Shared rng prologue of both training paths: validates the
         rngs contract and derives the embed key (a fold_in index far

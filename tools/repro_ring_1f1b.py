@@ -36,6 +36,17 @@ everything else PASSES). Verdict: XLA SPMD-partitioner miscompile
 (upstream-reportable via K; zero-egress box, so recorded here instead),
 NOT a semantic constraint — see variant K's docstring and
 docs/parallel.md.
+
+Round-5 outcome (variant L): TEN local rewrites of the inject/inbox
+dataflow attempted — select_n, arithmetic masking, hoisting,
+optimization barriers (value + predicate), sharded stage-mask input,
+unrolled hops, pvary annotations, identity-collective laundering,
+init-only injection — ALL fail with the identical wrong value, incl.
+with sp-sharded inputs and at sp=2. Sharpened root cause: the select
+was never the trigger; whenever the sp-collective's operand depends on
+the pipe-scan CARRY, the partitioner resolves the whole chain to its
+replicated origin. No local workaround exists; the fence stands (use
+Ulysses under 1F1B, ring under GPipe).
 """
 
 import os
@@ -373,6 +384,119 @@ def variant_k(ring=True):
         return False
 
 
+def variant_l():
+    """WORKAROUND CATALOG (round-5, VERDICT r4 #4): every local rewrite
+    of variant K's inject/inbox dataflow, each run against the same
+    closed-form oracle. All TEN fail with the IDENTICAL wrong answer
+    (stage 1 computes on the replicated input), which sharpens the
+    root cause beyond round 4's "the select reads the wrong side":
+
+      the select is NOT the trigger.  Whenever the sp-collective's
+      operand depends on the pipe-scan carry (the activation inbox),
+      the SPMD partitioner resolves the entire chain — select, carry,
+      even the initial-carry injection — to its replicated origin.
+      The only passing compositions (variants E/G/H) are exactly the
+      ones whose collective operand is independent of the carry, which
+      for real ring attention is semantically impossible (attention
+      must consume the stage input).
+
+    Attempted rewrites, all FAIL (jax 0.9.0 CPU backend, identical
+    wrong value ``stage1 = w1 * inner(x_replicated)``):
+
+      1. ``lax.select_n`` instead of ``jnp.where``;
+      2. arithmetic masking ``x*m + inbox*(1-m)`` (no select op at all);
+      3. select hoisted OUT of the divergent cond into the tick body;
+      4. ``lax.optimization_barrier`` on the selected value;
+      5. ``lax.optimization_barrier`` on the stage predicate;
+      6. stage mask from a P('pipe')-sharded INPUT array (no
+         axis_index in the select at all);
+      7. ring hops UNROLLED as a python loop (plain ppermutes in the
+         branch — the variant-B class that passes standalone);
+      8. ``lax.pvary(x_in, ('sp',))`` before the collective (and on
+         the carry init) — explicit varying-manual-axes annotation;
+      9. identity sp-ppermute "laundering" of the operand;
+     10. injection moved ENTIRELY into the initial carry (the
+         replicated input appears nowhere in the scan body) — stage 1
+         still computes on the replicated input, proving the carry
+         chain itself, not any per-tick select, is what the
+         partitioner mis-resolves.
+
+    Also reproduced with sp-SHARDED inputs (the real schedule's
+    layout) and at sp=2 with a real rotation — so the fence in
+    ``PipelinedBert``/``PipelinedGPT`` (``onef1b_compatible``) stays:
+    ring-SP under 1F1B has no local workaround; use Ulysses under
+    1F1B or ring under GPipe.  This runs rewrites 2, 7, and 10 (the
+    three mechanistically distinct classes) to keep the tool fast."""
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2, 1), ("pipe", "sp"))
+    x = jnp.arange(4, dtype=jnp.float32) + 1.0
+    xs = np.asarray(x)
+    want = np.stack([2 * N_STEPS * xs,
+                     3 * N_STEPS * (2 * N_STEPS * xs)])
+
+    def _rotate_unrolled(v):
+        c, acc = v, jnp.zeros_like(v)
+        for _ in range(N_STEPS):
+            c = lax.ppermute(c, "sp", [(0, 0)])
+            acc = acc + c
+        return acc
+
+    def build(mode):
+        def per_device(xfull):
+            st = lax.axis_index("pipe")
+            w = st.astype(jnp.float32) + 2.0
+            inner = (_rotate_unrolled if mode == "unrolled"
+                     else _scan_rotate)
+
+            def fwd(args):
+                inbox, acc, t = args
+                if mode == "init_only":
+                    x_in = inbox
+                elif mode == "arith":
+                    m = (st == 0).astype(xfull.dtype)
+                    x_in = xfull * m + inbox * (1.0 - m)
+                else:
+                    x_in = jnp.where(st == 0, xfull, inbox)
+                y = inner(x_in) * w
+                acc = acc + jnp.where(t == st, y, 0.0)
+                return y, acc
+
+            def bwd(args):
+                inbox, acc, t = args
+                return jnp.zeros_like(inbox), acc
+
+            def tick(c, t):
+                inbox, acc = c
+                y_out, acc = lax.cond((t - st) % 2 == 0, fwd, bwd,
+                                      (inbox, acc, t))
+                inbox = lax.ppermute(y_out, "pipe", [(0, 1)])
+                return (inbox, acc), None
+
+            z = jnp.zeros_like(xfull)
+            inbox0 = (jnp.where(st == 0, xfull, z)
+                      if mode == "init_only" else z)
+            (_, acc), _ = lax.scan(tick, (inbox0, z), jnp.arange(4))
+            return acc[None]
+        return per_device
+
+    all_fail = True
+    for mode in ("arith", "unrolled", "init_only"):
+        f = shard_map(build(mode), mesh=mesh, in_specs=P(),
+                      out_specs=P("pipe"), check_vma=False)
+        try:
+            got = np.asarray(jax.jit(f)(x))
+            ok = np.allclose(got, want)
+        except Exception as e:
+            print(f"L workaround [{mode}]: RAISED {type(e).__name__}: {e}")
+            ok = False
+        print(f"L workaround [{mode}]: "
+              f"{'PASS (workaround FOUND!)' if ok else 'FAIL (expected)'}")
+        all_fail = all_fail and not ok
+    # "success" for the catalog = the documented state of the world
+    # still holds (all rewrites trip the miscompile); a PASS above
+    # would mean a workaround EXISTS and the fence can be lifted
+    return all_fail
+
+
 def variant_f(ring=True):
     """The real schedule via the public API: onef1b_spmd with a
     stage_fn whose body is the ring scan (sp-ppermute inside), on a
@@ -452,6 +576,7 @@ def main():
         "H_control_no_collective": variant_h(ring=False),
         "K_minimal_select_ring": variant_k(ring=True),
         "K_control_no_collective": variant_k(ring=False),
+        "L_workarounds_all_still_trip": variant_l(),
         "F_onef1b_spmd_ring_stage_fn": variant_f(ring=True),
         "F_control_no_collective": variant_f(ring=False),
     }
