@@ -432,8 +432,6 @@ class PipelinedBert(PipelinedCommon):
         microbatch id per row for per-(microbatch, stage) dropout keys,
         ``aux`` accumulates per-row MoE load-balance losses (zero and
         DCE'd for dense configs)."""
-        from jax import lax
-
         has_moe = self.cfg.moe_experts > 0
 
         def run_stage(sp, h, b, rngs_):
@@ -613,17 +611,13 @@ class PipelinedBert(PipelinedCommon):
                     "seq_axis + MoE under 1F1B: the sp-local aux "
                     "estimate breaks the loss/grad reduction algebra; "
                     "use the GPipe apply() path")
-        if self.tp_axis is not None and self.cfg.moe_experts > 0:
-            # fail CLOSED: probed 2026-07-31 — this composition's aux
-            # leaf trips a shard_map out_specs error under the
-            # partial-manual regime (so it fails loudly, but with an
-            # opaque message), and there is no grad-pin test (dense
-            # tp x 1F1B is pinned; MoE x 1F1B is pinned without tp).
-            # GPipe apply() runs tp x MoE fine.
-            raise NotImplementedError(
-                "tp_axis + MoE under 1F1B is not yet supported (the "
-                "aux-leaf out_specs don't compose with partial-manual "
-                "tp); use the GPipe apply() path for tp x MoE")
+        # tp x MoE x 1F1B: fenced in round 4 ("aux-leaf out_specs don't
+        # compose with partial-manual tp"); re-probed 2026-08-01 after
+        # the partial-manual/vma plumbing evolved — the composition now
+        # compiles AND pins exactly against GPipe autodiff for both
+        # dispatch modes incl. early-stage router grads
+        # (test_bert_1f1b_tp_moe_matches_gpipe_autodiff), so the fence
+        # is lifted.
         needs_rng, base_key, embed_rngs = self._dropout_setup(
             deterministic, rngs, "loss_and_grad_1f1b")
 

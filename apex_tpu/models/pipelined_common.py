@@ -8,8 +8,9 @@ GSPMD-automatic tp axis, and the dropout rng prologue.  One copy here
 cannot drift between the encoder and decoder families.
 
 The mixin reads the attributes both families set in ``__init__``:
-``mesh, pipe_axis, batch_axis, seq_axis, tp_axis, cfg`` (cfg carries
-``hidden_dropout_prob`` / ``attention_probs_dropout_prob``).
+``mesh, pipe_axis, batch_axis, seq_axis, tp_axis, num_microbatches,
+cfg`` (cfg carries ``hidden_dropout_prob`` /
+``attention_probs_dropout_prob``).
 """
 
 from __future__ import annotations
