@@ -45,6 +45,7 @@ from apex_tpu.parallel.zero import (
     shard_optimizer_state,
     spec_axes,
     unshard_optimizer_state,
+    zero2_update,
 )
 
 
@@ -85,4 +86,5 @@ __all__ = [
     "ulysses_attention",
     "unshard_optimizer_state",
     "welford_combine",
+    "zero2_update",
 ]

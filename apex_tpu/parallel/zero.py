@@ -82,14 +82,16 @@ def shard_optimizer_state(opt_state: Pytree, mesh: Mesh,
     PP/TP — the memory configuration a pipeline-staged BERT-large run
     wants, VERDICT r3 weak #7).  Per-leaf moments (FusedLAMB,
     optax.adam, FusedAdam ``layout="tree"``) mirror the param tree, so
-    each state leaf whose shape matches a placed param leaf first
+    each state leaf whose tree path ENDS WITH a placed param leaf's
+    path (state paths prepend attr/field segments like ``.m``) first
     INHERITS that param's PartitionSpec (a stage moment stays on its
     stage's pipe coordinate — anything else would gather the stage
     across the pipe every step), then the ZeRO ``axis`` is added on the
-    first still-unsharded dimension that divides evenly.  Matching is by
-    shape, which is exact for the staged case (every stacked stage leaf
-    of one shape carries the same placement).  Flat-layout states
-    (where one buffer concatenates ALL params) cannot follow a
+    first still-unsharded dimension that divides evenly.  Matching is
+    by path suffix (longest match wins) with a shape sanity check —
+    shape-keyed matching would let two same-shape params with
+    different specs silently cross-inherit (ADVICE r4).  Flat-layout
+    states (where one buffer concatenates ALL params) cannot follow a
     per-param placement; they ignore ``like_params``.
 
     Returns a new state pytree; pass it through the jitted step with
@@ -100,19 +102,43 @@ def shard_optimizer_state(opt_state: Pytree, mesh: Mesh,
         min_shard_elems = n * 128
     repl = NamedSharding(mesh, P())
 
-    param_spec_by_shape = {}
+    def _names(path):
+        out = []
+        for k in path:
+            for attr in ("key", "name", "idx"):
+                if hasattr(k, attr):
+                    out.append(str(getattr(k, attr)))
+                    break
+            else:
+                out.append(str(k))
+        return tuple(out)
+
+    placed_params = []   # (path_names, shape, spec)
     if like_params is not None:
-        for leaf in jax.tree_util.tree_leaves(like_params):
+        for path, leaf in jax.tree_util.tree_leaves_with_path(like_params):
             sh = getattr(leaf, "sharding", None)
             if isinstance(sh, NamedSharding) and any(
                     e is not None for e in sh.spec):
-                param_spec_by_shape.setdefault(leaf.shape, sh.spec)
+                placed_params.append((_names(path), leaf.shape, sh.spec))
 
-    def place(x):
+    def inherited_spec(state_path, shape):
+        """Longest param path that is a SUFFIX of the state leaf's path
+        (state trees mirror params under extra attr/field segments like
+        ``.m``), with a shape sanity check — shape-keyed matching would
+        let two same-shape params with different specs cross-inherit."""
+        names = _names(state_path)
+        best = None
+        for pnames, pshape, spec in placed_params:
+            if pshape == shape and names[-len(pnames):] == pnames:
+                if best is None or len(pnames) > len(best[0]):
+                    best = (pnames, spec)
+        return () if best is None else best[1]
+
+    def place_leaf(path, x):
         if not hasattr(x, "ndim"):
             return x  # static aux (FlatSpec et al.) passes through
         # inherit the matching param leaf's placement (ZeRO x PP/TP)
-        base = list(param_spec_by_shape.get(x.shape, ()))
+        base = list(inherited_spec(path, x.shape))
         base += [None] * (x.ndim - len(base))
         if axis in spec_axes(base):
             return jax.device_put(x, NamedSharding(mesh, P(*base)))
@@ -134,7 +160,109 @@ def shard_optimizer_state(opt_state: Pytree, mesh: Mesh,
             return jax.device_put(x, NamedSharding(mesh, P(*base)))
         return jax.device_put(x, repl)
 
-    return jax.tree_util.tree_map(place, opt_state)
+    return jax.tree_util.tree_map_with_path(place_leaf, opt_state)
+
+
+def zero2_update(optimizer, params: Pytree, grads: Pytree, opt_state,
+                 axis: str, *, average: bool = True, scale=1.0,
+                 skip=None, grad_norm=None):
+    """ZeRO-2: reduce-scatter gradients straight into this device's
+    optimizer shard — the full gradient tree is never materialized
+    after reduction.  Call INSIDE ``shard_map`` over ``axis`` (at the
+    point the DDP style would call ``reduce_gradients`` + ``step``):
+
+    - ``grads``: this device's LOCAL (unreduced) gradient tree from its
+      batch shard; the reduction here IS the ``psum_scatter`` — with
+      ``average=True`` the result matches DDP's world-mean semantics;
+    - ``opt_state``: a flat-layout :class:`~apex_tpu.optimizers.
+      FusedAdamState` whose ``m``/``v`` arrive as the LOCAL SHARD
+      (``in_specs`` ``P(axis)`` on m/v, ``P()`` on step — i.e. the
+      placement :func:`shard_optimizer_state` chose, viewed manually);
+    - params arrive replicated and return replicated: the update runs
+      on this device's 1/n slice and fresh params ride ONE tiled
+      ``all_gather`` — exactly the ZeRO paper's collective schedule
+      (reduce-scatter + all-gather, same bytes as one all-reduce, but
+      grads + m + v + master-compute all at 1/n per device).
+
+    vs ZeRO-1 (:func:`shard_optimizer_state` alone, GSPMD style): that
+    path materializes the full SUMMED grad on every device (XLA emits
+    all-reduce + slice — verified in the compiled HLO on this backend)
+    before the shard-local update; ZeRO-2 removes that full-size
+    buffer, the peak-memory term that dominates between backward and
+    update at BERT-large-and-up scale. Numerics are pinned identical
+    to the plain full-grad step in ``tests/distributed/test_zero.py``.
+
+    Supports amp's skip-step protocol (``skip``/``scale`` as in
+    ``FusedAdam.step``) and ``max_grad_norm`` (the global norm is one
+    scalar psum of shard partials). ``param_groups`` need per-group
+    slice bookkeeping across shard boundaries and are not supported in
+    this v1 (raises); use ZeRO-1 for grouped configs.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from apex_tpu.ops.flatten import flatten_like, unflatten
+    from apex_tpu.optimizers.fused_adam import FusedAdamState, on_tpu
+
+    if getattr(optimizer, "layout", None) != "flat":
+        raise ValueError("zero2_update needs a flat-layout FusedAdam "
+                         f"(got layout={getattr(optimizer, 'layout', None)!r})")
+    if optimizer.param_groups:
+        raise NotImplementedError(
+            "zero2_update v1 does not support param_groups (group "
+            "bounds do not align with shard bounds); use ZeRO-1 "
+            "(shard_optimizer_state) for grouped configs")
+    if getattr(optimizer, "_zero", None) is not None:
+        raise ValueError(
+            "zero2_update is already shard-local over the ZeRO axis — "
+            "pass the plain optimizer, not optimizer.with_zero(...) "
+            "(the with_zero kernel wrapper would open a nested "
+            "shard_map over an already-bound axis)")
+
+    spec = opt_state.spec
+    n = lax.psum(1, axis)
+    shard_len = opt_state.m.shape[0]
+    buf_len = shard_len * n
+
+    def to_buf_len(x):
+        if x.shape[0] < buf_len:
+            x = jnp.concatenate(
+                [x, jnp.zeros((buf_len - x.shape[0],), jnp.float32)])
+        return x
+
+    g = to_buf_len(flatten_like(grads, spec, dtype=jnp.float32))
+    # THE ZeRO-2 move: one reduce-scatter replaces all-reduce — each
+    # device receives only the summed slice its m/v shard covers
+    g_shard = lax.psum_scatter(g, axis, scatter_dimension=0, tiled=True)
+    if average:
+        g_shard = g_shard / n
+
+    p = to_buf_len(flatten_like(params, spec, dtype=jnp.float32))
+    idx = lax.axis_index(axis)
+    p_shard = lax.dynamic_slice_in_dim(p, idx * shard_len, shard_len)
+
+    if optimizer.max_grad_norm > 0 and grad_norm is None:
+        # global post-reduction norm from shard partials (scalar psum)
+        grad_norm = jnp.sqrt(
+            lax.psum(jnp.sum(jnp.square(g_shard)), axis))
+
+    # step/skip protocol mirrors FusedAdam._step_flat
+    if skip is None:
+        keep = None
+        step = opt_state.step + 1
+    else:
+        keep = 1.0 - jnp.asarray(skip, jnp.float32)
+        step = opt_state.step + keep.astype(jnp.int32)
+    use_pallas = (optimizer.use_pallas if optimizer.use_pallas is not None
+                  else on_tpu())
+    p2, m2, v2 = optimizer._step_group(
+        p_shard, opt_state.m, opt_state.v, g_shard,
+        optimizer._defaults(), step, scale, grad_norm, use_pallas,
+        keep=keep)
+
+    p_new = lax.all_gather(p2, axis, tiled=True)
+    return (unflatten(p_new, spec),
+            FusedAdamState(step=step, m=m2, v=v2, spec=spec))
 
 
 def unshard_optimizer_state(opt_state: Pytree, mesh: Mesh) -> Pytree:
