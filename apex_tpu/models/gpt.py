@@ -212,6 +212,19 @@ class GPTLMHeadModel(nn.Module):
         return logits.astype(jnp.float32)
 
 
+def _lm_masked_sum(logits, input_ids, attention_mask):
+    """Masked SUM of next-token cross entropy (no normalization) — the
+    microbatch-side half of the exact masked mean: each 1F1B microbatch
+    contributes its sum and the precomputed global denominator turns
+    the schedule's mean-over-microbatches into the exact global masked
+    mean, independent of padding skew (see PipelinedGPT)."""
+    import optax
+
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(
+        logits[:, :-1], input_ids[:, 1:])
+    return (per_tok * attention_mask[:, 1:].astype(per_tok.dtype)).sum()
+
+
 def lm_loss(logits, input_ids, attention_mask=None):
     """Next-token cross entropy: predict token t+1 from prefix <= t.
     Position S-1 has no target and is dropped; with a padding mask,
@@ -219,14 +232,14 @@ def lm_loss(logits, input_ids, attention_mask=None):
     positions."""
     import optax
 
-    targets = input_ids[:, 1:]
-    lg = logits[:, :-1]
-    per_tok = optax.softmax_cross_entropy_with_integer_labels(
-        lg, targets)
     if attention_mask is None:
-        return per_tok.mean()
-    keep = attention_mask[:, 1:].astype(per_tok.dtype)
-    return (per_tok * keep).sum() / jnp.maximum(keep.sum(), 1.0)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], input_ids[:, 1:]).mean()
+    # one definition of the shift-and-mask numerator (shared with the
+    # 1F1B per-microbatch contribution) so the conventions cannot drift
+    keep = attention_mask[:, 1:].sum().astype(logits.dtype)
+    return (_lm_masked_sum(logits, input_ids, attention_mask)
+            / jnp.maximum(keep, 1.0))
 
 
 class GPTStage(nn.Module):
@@ -433,13 +446,15 @@ class PipelinedGPT(PipelinedCommon):
         contributions.
 
         ``attention_mask`` reaches both the attention bias and the
-        loss (pad targets dropped).  Masked-loss caveat shared with
-        every microbatched schedule: the scheduled loss is the mean of
-        per-microbatch masked means, which equals the monolithic
-        global masked mean only when microbatches carry equal valid-
-        target counts (uniform padding per row group); with heavily
-        skewed padding, batch rows so each microbatch has a similar
-        valid count.
+        loss (pad targets dropped).  The masked loss is EXACT under
+        arbitrary padding skew: each microbatch contributes its masked
+        SUM over a precomputed global denominator (total valid targets
+        / microbatch-shard units), so the schedule's mean over
+        microbatches — and the dp pmean — reconstruct the monolithic
+        global masked mean regardless of how valid counts distribute
+        across microbatches or data shards (the naive mean of
+        per-microbatch masked means silently drifts; pinned by
+        ``test_pipelined_gpt_1f1b_mask_skewed_padding_exact``).
         """
         from jax import lax
         from jax.sharding import PartitionSpec as P
@@ -481,12 +496,18 @@ class PipelinedGPT(PipelinedCommon):
                 # of the last stage — uniform branch, mb-sized)
                 h = lax.all_gather(h, self.seq_axis, axis=1, tiled=True)
             logits = self._head(h, lp["head"], lp["wte"])
-            # the mask rides the target pytree so each microbatch's
-            # loss drops its padding targets — same semantics as
-            # lm_loss(logits, ids, attention_mask) on the monolithic
-            # model (a mask that only shaped the attention bias would
-            # silently leave pad positions in the gradients)
-            return lm_loss(logits, tgt_mb["ids"], tgt_mb.get("mask"))
+            mask = tgt_mb.get("mask")
+            if mask is not None:
+                # EXACT masked mean under arbitrary padding skew: the
+                # microbatch contributes its masked SUM over the global
+                # denominator (rides tgt as a per-row constant); the
+                # schedule's mean over microbatches and run_wrapped's
+                # dp pmean then reconstruct sum(all)/keep(all) exactly
+                # — a per-microbatch masked MEAN would silently drift
+                # whenever microbatches carry unequal valid counts
+                return (_lm_masked_sum(logits, tgt_mb["ids"], mask)
+                        / tgt_mb["denom"][0])
+            return lm_loss(logits, tgt_mb["ids"])
 
         run = onef1b_spmd(stage_fn, pl_loss, self.pipe_axis,
                           self.num_microbatches)
@@ -495,6 +516,18 @@ class PipelinedGPT(PipelinedCommon):
         tgt_tree = {"ids": targets}
         if attention_mask is not None:
             tgt_tree["mask"] = attention_mask
+            # global denominator D = total_keep / (microbatch-shard
+            # units): per-mb loss sum/D, meaned over M units per shard
+            # and pmean'd over n_dp shards, equals the monolithic
+            # global masked mean bit-for-bit in exact arithmetic
+            n_dp = (self.mesh.shape[self.batch_axis]
+                    if self.batch_axis else 1)
+            total_keep = jnp.maximum(
+                attention_mask[:, 1:].sum().astype(jnp.float32), 1.0)
+            tgt_tree["denom"] = jnp.full(
+                (targets.shape[0],),
+                total_keep / (self.num_microbatches * n_dp),
+                jnp.float32)
 
         def run_wrapped(sp, xb, tgt, lp):
             loss, g, dxb, dlp = run(

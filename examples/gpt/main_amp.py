@@ -43,6 +43,10 @@ def parse_args():
                    "parallelism (hybrid DP x SP mesh)")
     p.add_argument("--sp-attention", default="ulysses",
                    choices=("ring", "ulysses"))
+    p.add_argument("--tp", type=int, default=0, metavar="TP",
+                   help="Megatron tensor parallelism over a TP-way "
+                   "model axis (parallel.gpt_tp_rules — vocab-sharded "
+                   "tied head; composes with --sp on one mesh)")
     p.add_argument("--remat", action="store_true")
     return p.parse_args()
 
@@ -65,21 +69,28 @@ def main():
 
     devices = jax.devices()
     n_dev = len(devices)
-    sp = args.sp
+    sp, tp = args.sp, args.tp
+    model_par = (sp or 1) * (tp or 1)
+    if n_dev % model_par:
+        raise SystemExit(f"--sp {sp} x --tp {tp} must divide the "
+                         f"device count ({n_dev})")
+    if sp and args.seq_len % sp:
+        raise SystemExit(f"--sp {sp} must divide --seq-len "
+                         f"({args.seq_len})")
+    dp = n_dev // model_par
+    shape, names = [dp], ["data"]
     if sp:
-        if n_dev % sp or args.seq_len % sp:
-            raise SystemExit(f"--sp {sp} must divide the device count "
-                             f"({n_dev}) and --seq-len ({args.seq_len})")
-        dp = n_dev // sp
-        mesh = Mesh(np.array(devices).reshape(dp, sp), ("data", "sp"))
-    else:
-        dp = n_dev
-        mesh = Mesh(np.array(devices), ("data",))
+        shape.append(sp)
+        names.append("sp")
+    if tp:
+        shape.append(tp)
+        names.append("model")
+    mesh = Mesh(np.array(devices).reshape(shape), tuple(names))
     if args.b % dp:
         raise SystemExit(f"batch {args.b} must divide by dp={dp}")
-    maybe_print(f"devices: {n_dev} (dp={dp}, sp={sp or 1}), "
-                f"config: {args.config}, seq: {args.seq_len}, "
-                f"flash: {args.flash}", rank0=True)
+    maybe_print(f"devices: {n_dev} (dp={dp}, sp={sp or 1}, "
+                f"tp={tp or 1}), config: {args.config}, "
+                f"seq: {args.seq_len}, flash: {args.flash}", rank0=True)
 
     attention_fn = None
     if sp:
@@ -107,7 +118,10 @@ def main():
 
     model, optimizer = amp.initialize(
         models.GPTLMHeadModel(cfg, attention_fn=attention_fn),
-        optimizers.FusedAdam(lr=args.lr),
+        # TP'd params need the per-leaf layout: the flat concat cannot
+        # carry Megatron placements (FusedAdam docstring)
+        optimizers.FusedAdam(lr=args.lr,
+                             layout="tree" if tp else "flat"),
         opt_level=args.opt_level, loss_scale=args.loss_scale)
 
     rng = np.random.RandomState(0)
@@ -125,8 +139,21 @@ def main():
     opt_state = optimizer.init(params)
     shard = NamedSharding(mesh, P("data"))
     repl = NamedSharding(mesh, P())
-    params = jax.device_put(params, repl)
-    opt_state = jax.device_put(opt_state, repl)
+    grad_specs = None
+    if tp:
+        from apex_tpu import parallel
+        grad_specs = parallel.param_specs(
+            params, mesh, parallel.gpt_tp_rules("model"))
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            params, grad_specs)
+        # per-leaf moments inherit each param's Megatron placement by
+        # path suffix, then add ZeRO-1 data sharding on top
+        opt_state = parallel.shard_optimizer_state(
+            opt_state, mesh, axis="data", like_params=params)
+    else:
+        params = jax.device_put(params, repl)
+        opt_state = jax.device_put(opt_state, repl)
 
     import functools
 
@@ -138,6 +165,13 @@ def main():
             with amp.scale_loss(loss, opt_state) as scaled:
                 return scaled, loss
         grads, loss = jax.grad(loss_fn, has_aux=True)(params)
+        if grad_specs is not None:
+            # pin grads to the Megatron specs so the updated params
+            # keep their TP placement across steps (see PipelinedCommon
+            # .param_spec_tree for the failure mode)
+            grads = jax.tree.map(
+                lambda g, s: jax.lax.with_sharding_constraint(
+                    g, NamedSharding(mesh, s)), grads, grad_specs)
         params, opt_state = optimizer.step(params, grads, opt_state)
         return params, opt_state, loss
 

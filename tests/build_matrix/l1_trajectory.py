@@ -12,7 +12,10 @@ The input batches are routed through ``npz_loader`` so the native
 row-gather (vs numpy fancy indexing) is actually ON the trajectory
 path; the train step is the L1 harness ConvBNNet amp O2 run.
 
-Usage: python l1_trajectory.py OUT.json  (respects APEX_TPU_NO_NATIVE)
+Usage: python l1_trajectory.py OUT.json [OPT_LEVEL] [LOSS_SCALE]
+(respects APEX_TPU_NO_NATIVE; OPT_LEVEL defaults O2, LOSS_SCALE
+"dynamic" or a float literal — the r4 verdict's ask: the bitwise gate
+must cover the opt-level x loss-scale cross product, not one config)
 """
 
 import json
@@ -44,7 +47,8 @@ STEPS = 8
 BATCH = 16
 
 
-def main(out_path: str) -> None:
+def main(out_path: str, opt_level: str = "O2",
+         loss_scale: str = "dynamic") -> None:
     import jax.numpy as jnp
 
     # deterministic dataset written to an npz shard; the loader's
@@ -65,7 +69,10 @@ def main(out_path: str) -> None:
 
     model, optimizer = amp.initialize(
         harness.ConvBNNet(use_pallas=False), FusedAdam(lr=1e-2),
-        opt_level="O2", verbosity=0)
+        opt_level=opt_level,
+        loss_scale=("dynamic" if loss_scale == "dynamic"
+                    else float(loss_scale)),
+        verbosity=0)
     x0 = jnp.asarray(batches[0][0], jnp.float32) / 255.0
     variables = model.init(jax.random.PRNGKey(0), x0, train=True)
     params, batch_stats = variables["params"], variables["batch_stats"]
@@ -98,6 +105,8 @@ def main(out_path: str) -> None:
 
     record = {
         "native_loaded": bool(native.available),
+        "opt_level": opt_level,
+        "loss_scale": loss_scale,
         "losses_hex": losses,
         "final_param_checksum": np.float64(sum(
             float(np.asarray(leaf, np.float64).sum())
@@ -110,4 +119,4 @@ def main(out_path: str) -> None:
 
 
 if __name__ == "__main__":
-    main(sys.argv[1])
+    main(sys.argv[1], *(sys.argv[2:4]))

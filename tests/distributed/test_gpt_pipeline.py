@@ -261,6 +261,60 @@ def test_pipelined_gpt_1f1b_mask_in_loss():
     assert abs(float(loss_nomask) - float(loss)) > 1e-4
 
 
+def test_pipelined_gpt_1f1b_mask_skewed_padding_exact():
+    """HEAVILY skewed padding across microbatches (and dp shards): the
+    1F1B masked loss and grads still equal the monolithic global
+    masked mean — the sum-over-global-denominator construction, not
+    the mean-of-microbatch-means that silently drifts under skew
+    (VERDICT r4 #9: the caveat is now enforced by construction)."""
+    mesh = Mesh(np.asarray(jax.devices()[:NDEV]).reshape(2, 4),
+                ("data", "pipe"))
+    cfg = _cfg()
+    pg = models.PipelinedGPT(cfg, mesh, pp=4, num_microbatches=2,
+                             batch_axis="data")
+    ids = jax.random.randint(jax.random.PRNGKey(0), (8, 16), 0, 64)
+    # valid counts 15, 2, 9, 5, 16, 1, 12, 3 — microbatches and dp
+    # shards all see very different keep totals
+    lens = [15, 2, 9, 5, 16, 1, 12, 3]
+    mask = jnp.asarray(np.stack([
+        np.pad(np.ones(n), (0, 16 - n)) for n in lens]), jnp.int32)
+    variables = pg.shard_variables(pg.init(jax.random.PRNGKey(1), ids))
+    with mesh:
+        loss, grads = jax.jit(
+            lambda v, i, m: pg.loss_and_grad_1f1b(
+                v, i, i, attention_mask=m))(variables, ids, mask)
+
+    mono_p = _monolithic_params(variables, 4, 1)
+
+    def mono_loss(p):
+        logits = models.GPTLMHeadModel(cfg).apply({"params": p}, ids,
+                                                  mask)
+        return models.lm_loss(logits, ids, mask)
+
+    want_l, want_g = jax.value_and_grad(mono_loss)(mono_p)
+    np.testing.assert_allclose(float(loss), float(want_l), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(grads["embed"]["wte"]["embedding"]),
+        np.asarray(want_g["wte"]["embedding"]), rtol=3e-4, atol=1e-5)
+    for a, b in zip(jax.tree.leaves(grads["head"]),
+                    jax.tree.leaves(want_g["final_ln"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=1e-5)
+    # teeth: the naive mean-of-microbatch-masked-means is genuinely
+    # different on this batch (if it weren't, this test proves nothing)
+    per_mb = []
+    logits = models.GPTLMHeadModel(cfg).apply(
+        {"params": mono_p}, ids, mask)
+    for s in range(0, 8, 2):   # dp-shard-major microbatch split
+        per_mb.append(float(models.lm_loss(
+            logits[s:s + 2], ids[s:s + 2], mask[s:s + 2])))
+    naive = float(np.mean(per_mb))
+    # the gap is model-scale-dependent (untrained CE is near-uniform);
+    # what matters is that it clears the pin tolerance above by an
+    # order of magnitude (observed ~6.7e-4 vs the ~4e-5 loss pin)
+    assert abs(naive - float(want_l)) > 2e-4, (naive, float(want_l))
+
+
 def test_pipelined_gpt_1f1b_ulysses_dp_sp_pp_matches_monolithic():
     """dp x sp x pp GPT on the interleaved schedule (Ulysses causal):
     loss + tied-wte + stage grads equal the monolithic autodiff."""
