@@ -190,7 +190,8 @@ class GPTLMHeadModel(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None,
-                 deterministic: bool = True):
+                 deterministic: bool = True,
+                 return_hidden: bool = False):
         cfg = self.cfg
         x, wte = _embed_block(cfg, input_ids, deterministic)
         bias = None
@@ -207,6 +208,12 @@ class GPTLMHeadModel(nn.Module):
                 x, bias, deterministic)
         x = FusedLayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps,
                            name="final_ln")(x)
+        if return_hidden:
+            # for ops.vocab_parallel_lm_loss: under TP the (B, S, V)
+            # logits should never be materialized — hand back the
+            # pre-head hidden instead and let the vocab-parallel loss
+            # consume it with the sharded wte
+            return x
         # weight-tied head: logits = x @ wte^T
         logits = wte.attend(x)
         return logits.astype(jnp.float32)

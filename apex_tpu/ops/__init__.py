@@ -17,6 +17,7 @@ from apex_tpu.ops.multi_tensor import (
 )
 from apex_tpu.ops.flatten import flatten, unflatten, flatten_like
 from apex_tpu.ops.flash_attention import flash_attention, make_flash_attention
+from apex_tpu.ops.vocab_parallel import vocab_parallel_lm_loss
 from apex_tpu.ops import native
 
 __all__ = [
@@ -31,4 +32,5 @@ __all__ = [
     "flatten",
     "unflatten",
     "flatten_like",
+    "vocab_parallel_lm_loss",
 ]

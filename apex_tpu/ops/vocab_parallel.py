@@ -1,0 +1,135 @@
+"""Vocab-parallel cross entropy — the Megatron-style loss for a
+vocab-sharded LM head.
+
+With ``parallel.gpt_tp_rules`` the tied ``wte`` shards its vocab dim,
+so each device can compute only its ``(B, S, V/n)`` logits slice — but
+a plain ``softmax_cross_entropy(logits, ...)`` forces XLA to all-gather
+the full ``(B, S, V)`` fp32 logits first, and at GPT-2 scale that
+buffer dominates the step's activations (B=16, S=1024, V=50257 fp32 is
+~3.2 GB — bigger than the model).  The classic fix (Megatron-LM's
+``vocab_parallel_cross_entropy``; re-derived here for shard_map — no
+reference-code reuse, the reference library has no TP at all) needs
+only three scalar-ish collectives instead:
+
+- global max over vocab  = ``pmax``  of the local max  (stability),
+- global logsumexp       = ``psum``  of the local exp-sum,
+- the target's logit     = ``psum``  of the owning shard's gather.
+
+Loss per token = logsumexp - target_logit; everything that crosses the
+axis is (B, S), never (B, S, V).  The implementation is partial-manual:
+``jax.shard_map`` binds ONLY the model axis, so batch/sequence sharding
+(dp/sp) stays GSPMD-automatic and composes unchanged.
+
+The backward pass follows from the same pieces (softmax(local) minus
+the one-hot on the owning shard), so plain autodiff through the
+shard_map is both correct and memory-shaped like the forward — the
+full-vocab softmax never exists either.
+
+KNOWN LIMITATION (shared with ``PipelinedBert`` ``tp_axis``):
+half-precision compute inside a partial-manual shard_map region trips
+this jax build's XLA **CPU** backend ("Invalid binary instruction
+opcode copy"); fp32 hidden works everywhere, bf16 hidden needs the TPU
+backend (``tools/tp_pp_bf16_check.py`` revalidates at live windows).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+@functools.lru_cache(maxsize=32)
+def _build(mesh, axis, vshard, true_vocab, logits_dtype, has_mask):
+    """Cached jitted kernel: eager per-batch callers (eval loops) must
+    hit the jit cache, and jit keys on the function object — a closure
+    rebuilt per call would retrace + recompile the shard_map every
+    invocation."""
+
+    def per_shard(h, w_local, ids, *mask_arg):
+        # local logits slice: the matmul runs in the hidden's dtype
+        # (bf16 under amp — same as the tied head, which casts wte at
+        # apply), the reduction in fp32 (GPTLMHeadModel's
+        # .astype(float32) policy)
+        lg = jnp.einsum("bsh,vh->bsv", h,
+                        w_local.astype(h.dtype)).astype(logits_dtype)
+        if true_vocab is not None and true_vocab < vshard * mesh.shape[axis]:
+            # padded-vocab rows must not leak into the logsumexp
+            vids = (lax.axis_index(axis) * vshard
+                    + jnp.arange(vshard))
+            lg = jnp.where(vids[None, None, :] < true_vocab, lg, -1e9)
+        lg = lg[:, :-1]                      # positions with a target
+        tgt = ids[:, 1:]
+        # stable logsumexp across shards: subtract the GLOBAL max
+        # (detached — the standard stabilization, zero gradient)
+        gmax = lax.pmax(lax.stop_gradient(jnp.max(lg, axis=-1)), axis)
+        z = jnp.exp(lg - gmax[..., None])
+        lse = jnp.log(lax.psum(z.sum(-1), axis)) + gmax
+        # the target logit lives on exactly one shard
+        off = lax.axis_index(axis) * vshard
+        local_t = tgt - off
+        owned = (local_t >= 0) & (local_t < vshard)
+        picked = jnp.take_along_axis(
+            lg, jnp.clip(local_t, 0, vshard - 1)[..., None], axis=-1
+        )[..., 0]
+        tgt_logit = lax.psum(jnp.where(owned, picked, 0.0), axis)
+        per_tok = lse - tgt_logit
+        if not has_mask:
+            return per_tok.mean()
+        keep = mask_arg[0][:, 1:].astype(per_tok.dtype)
+        return (per_tok * keep).sum() / jnp.maximum(keep.sum(), 1.0)
+
+    in_specs = (P(), P(axis, None), P()) + ((P(),) if has_mask else ())
+    # jit-wrapped (inlined under an outer jit): an EAGER partial-manual
+    # shard_map rejects inputs whose committed sharding names automatic
+    # axes ("out_specs refers to 'data'"); under jit GSPMD owns them
+    return jax.jit(jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=in_specs,
+        out_specs=P(),
+        axis_names={axis},       # partial-manual: dp/sp stay automatic
+        check_vma=False))
+
+
+def vocab_parallel_lm_loss(hidden, wte, input_ids, mesh,
+                           axis: str = "model",
+                           attention_mask=None,
+                           true_vocab: Optional[int] = None,
+                           logits_dtype=jnp.float32):
+    """Next-token LM loss from the FINAL hidden states and the
+    vocab-sharded tied embedding, without materializing full logits.
+
+    Args:
+      hidden: (B, S, H) final-LN output (``GPTLMHeadModel``'s tensor
+        just before ``wte.attend``); any dp/sp sharding stays
+        automatic.
+      wte: (V, H) tied embedding, placed ``P(axis, None)``
+        (``parallel.gpt_tp_rules``).  V must divide the axis size.
+      input_ids: (B, S) int tokens — same shift semantics as
+        :func:`models.lm_loss` (predict t+1 from prefix <= t).
+      mesh / axis: the mesh and its model-axis name.
+      attention_mask: optional (B, S) 1/0; positions whose TARGET is
+        padding are dropped, mean over kept positions — exactly
+        :func:`models.lm_loss`.
+      true_vocab: real vocabulary size when ``wte`` was PADDED to make
+        V divide the axis (the Megatron ``make_vocab_size_divisible_by``
+        move — GPT-2's 50257 divides nothing): logits of padding rows
+        are masked to -inf so they cannot leak probability mass into
+        the logsumexp, making the loss exactly the true-vocab loss.
+
+    Returns the scalar loss; grads flow to ``hidden`` and ``wte``.
+    """
+    V = wte.shape[0]
+    n = mesh.shape[axis]
+    if V % n:
+        raise ValueError(f"vocab {V} must divide the {axis!r} axis ({n})")
+    f = _build(mesh, axis, V // n, true_vocab,
+               jnp.dtype(logits_dtype).name,
+               attention_mask is not None)
+    args = (hidden, wte, input_ids) + (
+        (attention_mask,) if attention_mask is not None else ())
+    return f(*args)

@@ -66,6 +66,25 @@ def main():
     if args.remat:
         import dataclasses
         cfg = dataclasses.replace(cfg, remat=True)
+    # vocab-parallel CE is partial-manual shard_map; half-precision
+    # compute inside that region trips this jax build's XLA CPU
+    # backend ("Invalid binary instruction opcode copy" — the same
+    # documented limitation as PipelinedBert's tp_axis). The TPU
+    # backend compiles it; on CPU demo runs use O0 or the dense loss.
+    use_vp = bool(args.tp) and (jax.devices()[0].platform == "tpu"
+                                or args.opt_level == "O0")
+    true_vocab = cfg.vocab_size
+    if use_vp and cfg.vocab_size % (args.tp * 128):
+        # Megatron's make_vocab_size_divisible_by move: GPT-2's 50257
+        # divides nothing — pad the embedding rows to 128*tp lanes so
+        # the vocab-parallel CE can shard them (padding rows are
+        # -inf-masked in the loss, so numerics are the true-vocab
+        # loss; the dense fallback path keeps the TRUE vocab — padded
+        # garbage rows would leak probability mass into its softmax)
+        import dataclasses
+        unit = args.tp * 128
+        cfg = dataclasses.replace(cfg, vocab_size=-(-cfg.vocab_size
+                                                    // unit) * unit)
 
     devices = jax.devices()
     n_dev = len(devices)
@@ -128,7 +147,7 @@ def main():
 
     def batches():
         while True:
-            yield rng.randint(0, cfg.vocab_size,
+            yield rng.randint(0, true_vocab,
                               (args.b, args.seq_len)).astype(np.int32)
 
     # dp-sized init dummy: a full-batch init would materialize the
@@ -157,11 +176,31 @@ def main():
 
     import functools
 
+    if tp and not use_vp:
+        maybe_print(
+            f"--tp {tp}: vocab-parallel CE disabled under "
+            f"{args.opt_level} on the {jax.devices()[0].platform} "
+            "backend (half-precision inside partial-manual shard_map "
+            "is the known CPU-backend limitation); dense loss instead",
+            rank0=True)
+
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state, ids):
         def loss_fn(p):
-            logits = model.apply({"params": p}, ids)
-            loss = models.lm_loss(logits, ids)
+            if use_vp:
+                # vocab-parallel CE: under TP the (B, S, V) logits are
+                # never materialized — each device computes its vocab
+                # slice and three (B, S) collectives make the loss
+                # (ops.vocab_parallel_lm_loss)
+                from apex_tpu import ops
+                hidden = model.apply({"params": p}, ids,
+                                     return_hidden=True)
+                loss = ops.vocab_parallel_lm_loss(
+                    hidden, p["wte"]["embedding"], ids, mesh,
+                    true_vocab=true_vocab)
+            else:
+                logits = model.apply({"params": p}, ids)
+                loss = models.lm_loss(logits, ids)
             with amp.scale_loss(loss, opt_state) as scaled:
                 return scaled, loss
         grads, loss = jax.grad(loss_fn, has_aux=True)(params)
