@@ -111,10 +111,15 @@ def test_bert_tiny_pp_1f1b_ulysses_sp():
     assert "loss" in out.lower()
 
 
-@pytest.mark.parametrize("extra", [[], ["--flash"],
-                                   ["--sp", "2", "--sp-attention",
-                                    "ulysses"]],
-                         ids=["plain", "flash", "ulysses_sp"])
+@pytest.mark.parametrize(
+    "extra",
+    [[], ["--flash"],
+     ["--sp", "2", "--sp-attention", "ulysses"],
+     # vp-CE path: O0 because half precision inside the partial-manual
+     # region is the known CPU-backend limitation (TPU compiles it)
+     ["--tp", "2", "--opt-level", "O0"],
+     ["--tp", "2"]],              # dense-loss fallback + warning path
+    ids=["plain", "flash", "ulysses_sp", "tp_vp", "tp_dense_fallback"])
 def test_gpt_tiny(extra):
     out = _run("examples/gpt/main_amp.py", "--config", "tiny", "--b", "8",
                "--seq-len", "32", "--steps", "3", *extra, ndev=8)
