@@ -53,7 +53,7 @@ BUDGET_S = {
     "o3_ceiling": 480,
     "kernel_parity": 700,    # several kernels, one compile each
     "realdata": 540,         # compile + host decode warm-up
-    "tp_pp_bf16": 540,
+    "tp_pp_bf16": 900,       # two remote compiles (bert + vp surfaces)
     "fused_adam": 900,
 }
 

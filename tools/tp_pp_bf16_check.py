@@ -12,10 +12,18 @@ cannot form — so a pass here shows the TPU compiler handles the bf16
 partial-manual lowering, not that the size-2 case is fixed; the full
 answer needs a multi-chip window.
 
-Appends one JSON line to ``BENCH_FOLLOWUP.jsonl``
-(section ``tp_pp_bf16``): {"ok": true} when the bf16 program compiles
-and runs, else the error. Run at a live tunnel window (the watcher
-queues it after kernel parity).
+Round 5 adds a second bf16 partial-manual surface: the vocab-parallel
+cross entropy (``ops.vocab_parallel_lm_loss``) with a bf16 hidden —
+the exact pattern ``examples/gpt --tp`` wants at O2 on TPU.
+
+Output contract (``BENCH_FOLLOWUP.jsonl``): one
+``tp_pp_bf16_detail`` line PER SURFACE (``section_detail`` names it;
+this section name is not in the watcher queue, so detail lines never
+affect retry state), then ONE ``tp_pp_bf16`` verdict line — ``{"ok":
+true}`` only when EVERY surface compiled and ran finite, else an
+``error`` (so the watcher retries a partially-failed leg instead of
+retiring it on the first surface's success). Run at a live tunnel
+window (the watcher queues it; budget covers two remote compiles).
 """
 
 import json
@@ -30,11 +38,17 @@ OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                    "BENCH_FOLLOWUP.jsonl")
 
 
-def log(payload):
-    line = {"section": "tp_pp_bf16", **payload}
+def log(payload, section="tp_pp_bf16"):
+    line = {"section": section, **payload}
     with open(OUT, "a") as f:
         f.write(json.dumps(line) + "\n")
     print(json.dumps(line), flush=True)
+
+
+def log_detail(payload):
+    # a non-queue section name: detail lines must never flip the
+    # watcher's success/error accounting for the real section
+    log(payload, section="tp_pp_bf16_detail")
 
 
 def main():
@@ -73,9 +87,38 @@ def main():
         mlm, nsp = jax.jit(lambda v, i: model.apply(v, i))(variables, ids)
     # axon block_until_ready is a no-op; force a sync via host fetch
     finite = bool(np.isfinite(np.asarray(mlm, np.float32)).all())
-    log({"ok": True, "bf16_partial_manual_compiles": True,
-         "outputs_finite": finite,
-         "compile_plus_step_s": round(time.perf_counter() - t0, 1)})
+    log_detail({"section_detail": "pipelined_bert_bf16", "ok": finite,
+                "bf16_partial_manual_compiles": True,
+                "compile_plus_step_s": round(
+                    time.perf_counter() - t0, 1)})
+
+    # second bf16 partial-manual surface (round 5): vocab-parallel CE
+    # with a bf16 hidden — the einsum + collectives inside the
+    # partial-manual region are exactly the pattern the CPU backend
+    # rejects; a pass here means examples/gpt --tp can run the vp loss
+    # at O2 on TPU
+    from apex_tpu import ops
+    t0 = time.perf_counter()
+    hidden = jnp.ones((2, 16, 32), jnp.bfloat16)
+    wte = jnp.ones((64, 32), jnp.float32) * 0.01
+    with mesh:
+        loss = ops.vocab_parallel_lm_loss(hidden, wte, ids, mesh,
+                                          axis="model")
+    finite_vp = bool(np.isfinite(float(loss)))
+    log_detail({"section_detail": "vocab_parallel_bf16",
+                "ok": finite_vp, "loss": float(loss),
+                "compile_plus_step_s": round(
+                    time.perf_counter() - t0, 1)})
+
+    # the ONE verdict line the watcher queue reads: success only when
+    # every surface compiled and ran finite
+    if finite and finite_vp:
+        log({"ok": True, "surfaces": ["pipelined_bert_bf16",
+                                      "vocab_parallel_bf16"]})
+    else:
+        log({"ok": False,
+             "error": f"bf16 surface failed (bert finite={finite}, "
+                      f"vp finite={finite_vp})"})
 
 
 if __name__ == "__main__":
