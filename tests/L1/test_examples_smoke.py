@@ -40,10 +40,14 @@ def test_simple_main_amp():
     assert "loss" in out.lower()
 
 
-def test_simple_distributed_ddp():
+@pytest.mark.parametrize("extra", [[], ["--zero2"]],
+                         ids=["ddp", "zero2"])
+def test_simple_distributed_ddp(extra):
     out = _run("examples/simple/distributed/distributed_data_parallel.py",
-               "--iters", "4", "--b", "16", ndev=8)
+               "--iters", "4", "--b", "16", *extra, ndev=8)
     assert "loss" in out.lower()
+    if extra:
+        assert "zero-2" in out.lower()
 
 
 def test_dcgan_multi_loss():
