@@ -61,6 +61,9 @@ MAX_STARTS = 8     # total launches, incl. ones the tunnel ate silently
 QUEUE = [
     "bert",
     "bert_large",
+    "o2_postfix",  # post-norm-seam-fix ResNet headline re-measure
+                   # (the r4 artifact already has a pre-fix "o2"
+                   # success line, so this needs its own name)
     "o3_ceiling",
     "bert_flash",
     "bert512_flash",
