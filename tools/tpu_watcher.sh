@@ -41,9 +41,10 @@ while true; do
       continue
     fi
     echo "$(date +%H:%M:%S) TUNNEL UP - running: $pending" >> "$LOG"
-    # outer timeout > sum of per-leg budgets (~7060s worst case) so a
+    # outer timeout > sum of per-leg budgets (~7840s worst case after
+    # the o2_postfix leg and the tp_pp_bf16 two-compile bump) so a
     # slow-but-healthy full-queue drain is never SIGTERMed mid-leg
-    timeout 7500 python tools/bench_followup.py --sections "$pending" >> "$LOG" 2>&1
+    timeout 8700 python tools/bench_followup.py --sections "$pending" >> "$LOG" 2>&1
     rc=$?
     echo "$(date +%H:%M:%S) invocation done rc=$rc ($(python tools/watcher_queue.py status))" >> "$LOG"
     python tools/watcher_queue.py sweep >> "$LOG" 2>&1
