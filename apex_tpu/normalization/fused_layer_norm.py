@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from apex_tpu.ops.pallas_utils import LANES, on_tpu
+from apex_tpu.ops.pallas_utils import LANES, on_tpu, pallas_auto_gate
 
 Shape = Union[int, Sequence[int]]
 
@@ -171,7 +171,9 @@ def _ln_bwd_pallas(dy2: jax.Array, xhat2: jax.Array, invvar: jax.Array,
 # ---------------------------------------------------------------------------
 
 def _use_pallas(flag: Optional[bool]) -> bool:
-    return on_tpu() if flag is None else flag
+    # partial-manual shard_map regions (pipelined TP) auto-partition
+    # every op — Mosaic calls are rejected there, jnp path instead
+    return pallas_auto_gate(flag)
 
 
 def _match_vma(cotangent, primal):

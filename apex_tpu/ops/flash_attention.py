@@ -62,7 +62,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from apex_tpu.ops.pallas_utils import on_tpu, unpatched
+from apex_tpu.ops.pallas_utils import on_tpu, pallas_auto_gate, unpatched
 
 NEG_INF = -1e30
 
@@ -98,7 +98,12 @@ def _dropout_keep(seed, bh, rows, cols, rate):
     x = x ^ (x >> jnp.uint32(13))
     x = x * jnp.uint32(0xC2B2AE35)
     x = x ^ (x >> jnp.uint32(16))
-    u = (x >> jnp.uint32(8)).astype(jnp.float32) * (2.0 ** -24)
+    # top-24-bit uniform; the cast routes through int32 because Mosaic's
+    # TPU lowering has no uint32->float32 (caught live by
+    # tools/kernel_parity.py check_flash_attention, round 5) — the value
+    # is < 2^24 so int32 then float32 is bit-exact with the direct cast
+    u = (x >> jnp.uint32(8)).astype(jnp.int32).astype(jnp.float32) \
+        * (2.0 ** -24)
     return u >= rate
 
 
@@ -677,7 +682,9 @@ def flash_attention(q, k, v, *, kv_mask: Optional[jax.Array] = None,
     else:
         seed = seed_array(dropout_seed, dropout_offsets,
                           num_heads=q.shape[2])
-    use = on_tpu() if use_pallas is None else use_pallas
+    # partial-manual shard_map regions (pipelined TP) auto-partition
+    # every op — Mosaic calls are rejected there, jnp oracle instead
+    use = pallas_auto_gate(use_pallas)
     if not use or not _HAS_PALLAS:
         return _reference(q, k, v, kv_mask, causal, scale,
                           return_lse=return_lse,

@@ -37,6 +37,38 @@ def on_tpu() -> bool:
     return plat not in ("cpu", "gpu", "cuda", "rocm")
 
 
+def gspmd_auto_axes() -> bool:
+    """True when the current trace sits under a mesh with at least one
+    GSPMD-automatic axis — i.e. inside a partial-manual ``shard_map``
+    region (pipelined Megatron TP: the model axis stays automatic so
+    XLA inserts the TP collectives).  In that regime the SPMD
+    partitioner owns every op and refuses Mosaic custom calls ("Mosaic
+    kernels cannot be automatically partitioned. Please wrap the call
+    in a shard_map."), so the Pallas kernels' ``use_pallas=None`` auto
+    gates consult this and take the jnp reference path instead — caught
+    live on v5e by ``tools/tp_pp_bf16_check.py`` (round 5); the CPU
+    mesh tier never sees it because off-TPU gates already pick jnp.
+    Fully-manual shard_map regions (all axes Manual — DDP, ZeRO
+    ``with_zero``, ring/Ulysses SP) keep the real kernels."""
+    try:
+        from jax.sharding import AxisType
+        am = jax.sharding.get_abstract_mesh()
+    except Exception:
+        return False
+    return any(t == AxisType.Auto
+               for t in getattr(am, "axis_types", ()))
+
+
+def pallas_auto_gate(flag=None) -> bool:
+    """The ONE resolution of every kernel's ``use_pallas=None`` default:
+    real kernels on TPU, except under GSPMD-automatic axes where the
+    partitioner rejects Mosaic calls (:func:`gspmd_auto_axes`).  An
+    explicit ``flag`` always wins."""
+    if flag is not None:
+        return flag
+    return on_tpu() and not gspmd_auto_axes()
+
+
 def pad_to_tiles(flat: jax.Array, rows: int = DEFAULT_ROWS):
     """Pad a 1-D array to a multiple of rows*LANES and reshape to
     (n_tiles*rows, LANES). Returns (tiled, original_length)."""

@@ -39,7 +39,8 @@ import jax.numpy as jnp
 
 from apex_tpu.ops.flatten import (FlatSpec, flatten, flatten_grouped,
                                   flatten_like, unflatten)
-from apex_tpu.ops.pallas_utils import LANES, on_tpu, pad_to_tiles, untile
+from apex_tpu.ops.pallas_utils import (LANES, on_tpu, pad_to_tiles,
+                                       pallas_auto_gate, untile)
 from apex_tpu.optimizers.param_groups import (group_hparams,
                                               resolve_group_ids)
 
@@ -613,8 +614,14 @@ class FusedAdam:
             # (the reference's patched step is a full no-op on overflow,
             # handle.py:130-150)
             step = state.step + keep.astype(jnp.int32)
+        # with_zero's kernel call sits inside its own fully-manual
+        # shard_map (legal for Mosaic even when the enclosing trace has
+        # GSPMD-automatic axes — nested binding under partial-manual
+        # fails loudly on its own); only the bare kernel needs the
+        # auto-axes gate
         use_pallas = self.use_pallas if self.use_pallas is not None \
-            else on_tpu()
+            else (on_tpu() if self._zero is not None
+                  else pallas_auto_gate())
         if use_pallas and self._zero is None:
             # eager-path guard: a sharded state meeting the un-configured
             # Pallas kernel would be silently re-gathered by GSPMD (no

@@ -202,7 +202,8 @@ def zero2_update(optimizer, params: Pytree, grads: Pytree, opt_state,
     from jax import lax
 
     from apex_tpu.ops.flatten import flatten_like, unflatten
-    from apex_tpu.optimizers.fused_adam import FusedAdamState, on_tpu
+    from apex_tpu.optimizers.fused_adam import FusedAdamState
+    from apex_tpu.ops.pallas_utils import pallas_auto_gate
 
     if getattr(optimizer, "layout", None) != "flat":
         raise ValueError("zero2_update needs a flat-layout FusedAdam "
@@ -253,8 +254,11 @@ def zero2_update(optimizer, params: Pytree, grads: Pytree, opt_state,
     else:
         keep = 1.0 - jnp.asarray(skip, jnp.float32)
         step = opt_state.step + keep.astype(jnp.int32)
-    use_pallas = (optimizer.use_pallas if optimizer.use_pallas is not None
-                  else on_tpu())
+    # the kernel call here is BARE (no with_zero wrapper — the caller's
+    # shard_map is the manual region); under a partial-manual caller
+    # (ZeRO-2 x GSPMD TP) Mosaic would be auto-partitioned and rejected,
+    # so the shared auto gate applies (pallas_utils.gspmd_auto_axes)
+    use_pallas = pallas_auto_gate(optimizer.use_pallas)
     p2, m2, v2 = optimizer._step_group(
         p_shard, opt_state.m, opt_state.v, g_shard,
         optimizer._defaults(), step, scale, grad_norm, use_pallas,
