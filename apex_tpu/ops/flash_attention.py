@@ -619,9 +619,30 @@ _flash.defvjp(lambda q, k, v, m, s, causal, scale, bq, bk, interp, rate:
               _flash_bwd)
 
 
+def _default_block(s: int) -> int:
+    """Adaptive tile default: the largest of {512, 384, 256, 128} that
+    DIVIDES the 128-padded sequence (or the whole padded sequence when
+    that is <= 512).  Measured on v5e (round-5 live sweep, BENCH_NOTES
+    session 8): fwd+bwd causal s2048 b4h8d64 runs 1.49x faster at
+    (512, 512) than the old (128, 128) default — the d=64 contraction
+    underfills the 128x128 MXU, so wider score tiles amortize it; above
+    512 the curve flattens (VMEM pressure grows with d).  The
+    divisibility rule matters: a 512 block at S=768 would re-pad the
+    sequence to 1024 and run 1.78x the real FLOPs non-causally, so
+    block choice must never add padding beyond the 128 grain."""
+    sp = _cdiv(s, 128) * 128
+    if sp <= 512:
+        return max(128, sp)
+    for b in (512, 384, 256):
+        if sp % b == 0:
+            return b
+    return 128
+
+
 def flash_attention(q, k, v, *, kv_mask: Optional[jax.Array] = None,
                     causal: bool = False, scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     use_pallas: Optional[bool] = None,
                     interpret: Optional[bool] = None,
                     return_lse: bool = False,
@@ -636,6 +657,8 @@ def flash_attention(q, k, v, *, kv_mask: Optional[jax.Array] = None,
       causal: causal masking on global positions.
       scale: logit scale, default 1/sqrt(D).
       block_q, block_k: VMEM tile sizes (multiples of 128 recommended).
+        Default None = adaptive (``_default_block``: 512 capped at the
+        padded sequence — the measured v5e sweet spot).
       use_pallas: None = auto (Pallas kernels on TPU, jnp oracle off-TPU).
       interpret: force Pallas interpret mode (defaults to not-on-TPU).
       return_lse: also return the per-row log-sum-exp (B, H, Sq) fp32
@@ -691,6 +714,10 @@ def flash_attention(q, k, v, *, kv_mask: Optional[jax.Array] = None,
                           dropout_rate=dropout_rate, seed=seed)
     if interpret is None:
         interpret = not on_tpu()
+    if block_q is None:
+        block_q = _default_block(q.shape[1])
+    if block_k is None:
+        block_k = _default_block(k.shape[1])
     mask = (jnp.zeros((q.shape[0], k.shape[1]), jnp.float32)
             if kv_mask is None else kv_mask.astype(jnp.float32))
     if return_lse:
