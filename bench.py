@@ -923,6 +923,7 @@ def main():
     if on_tpu:
         batch, stem = 256, "s2d_pre"
         result["stem"] = stem
+        result["adam_layout"] = "flat"   # may flip to "tree" (A/B tail)
     else:
         stem = "conv"
     adam_layout = "flat"
@@ -1027,16 +1028,49 @@ def main():
     # FusedAdam layout A/B on the FULL step — deliberately LAST: the
     # per-leaf tree layout's remote-compile wedged the tunnel twice on
     # 2026-07-31 (>20 min, watchdog kill), so it must never sit between
-    # the judge and the headline/ratio. Result goes to extras only; the
-    # headline stays at the flat layout the ratio was measured with.
+    # the judge and the headline/ratio: the COMPLETE flat-layout story
+    # (headline + O2/O3 ratio) is already recorded above, and a wedge
+    # here costs only this tail. When tree wins (it did on 2026-08-01:
+    # 2544-2580 vs 2433-2452 flat — XLA fuses each leaf's update into
+    # one HBM pass while flat pays concat/pad/slice-back, see
+    # docs/optimizers.md), the headline ADOPTS it together with a
+    # same-layout O3 re-measure so the ratio stays like-for-like; both
+    # compiles have been in the persistent cache since 2026-08-01.
     if on_tpu and result["value"] > 0 and \
             time.perf_counter() - START < BUDGET_S - 240:
         try:
-            ips_t, _, _ = measure("O2", result.get("batch", batch),
-                                  image_size, iters, stem=stem,
-                                  adam_layout="tree")
-            extras["adam_layout_full_step"] = {
-                "flat": result["value"], "tree": round(ips_t, 1)}
+            b = result.get("batch", batch)
+            st = result.get("stem", stem)
+            # trace the tree candidate too, so on adoption the payload's
+            # xprof pointer matches the reported headline program
+            tree_trace = "xprof_trace_tree"
+            ips_t, step_ms_t, flops_t = measure("O2", b, image_size,
+                                                iters, stem=st,
+                                                adam_layout="tree",
+                                                trace_dir=tree_trace)
+            ab = {"flat": result["value"], "tree": round(ips_t, 1)}
+            extras["adam_layout_full_step"] = ab
+            if ips_t <= result["value"]:
+                ab["adopted"] = "flat"
+            elif time.perf_counter() - START >= BUDGET_S - 120:
+                # tree won but no budget for the like-for-like O3 —
+                # labeled so a budget-skip never reads as a non-win
+                ab["adopted"] = "flat"
+                ab["skip"] = "tree faster but budget too low for the " \
+                             "same-layout O3 re-measure"
+            else:
+                ceil_t, _, _ = measure("O3", b, image_size, iters,
+                                       stem=st, adam_layout="tree")
+                record_o2(ips_t, step_ms_t, flops_t, b)
+                result["adam_layout"] = "tree"
+                result["vs_baseline"] = round(ips_t / ceil_t, 3)
+                # the ratio is now fully live same-layout; a cached-
+                # ceiling provenance note from the flat path would lie
+                result.pop("vs_baseline_source", None)
+                if os.path.isdir(tree_trace):
+                    result["xprof_trace"] = tree_trace
+                ab["adopted"] = "tree"
+                ab["o3_tree"] = round(ceil_t, 1)
         except Exception as e:
             _note("adam_layout", e)
     if not extras:
