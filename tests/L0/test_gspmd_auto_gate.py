@@ -32,6 +32,23 @@ def _mesh():
     return Mesh(dev, ("data", "model"))
 
 
+def test_default_block_divides_padded_seq():
+    """The adaptive flash tile default must never induce padding beyond
+    the 128 grain: the chosen block always divides the 128-padded
+    sequence (code-review finding, round 5 — a 512 block at S=768 would
+    silently run 1.78x the real FLOPs)."""
+    from apex_tpu.ops.flash_attention import _default_block
+
+    for s in (1, 64, 128, 200, 384, 512, 640, 768, 896, 1024, 1152,
+              1536, 2048, 4096, 16384):
+        b = _default_block(s)
+        sp = -(-s // 128) * 128
+        assert sp % b == 0, (s, b)
+        assert 128 <= b <= 512
+    assert _default_block(2048) == 512   # the measured s2048 sweet spot
+    assert _default_block(768) == 384    # not 512: divisibility rule
+
+
 def test_detector_outside_any_mesh():
     assert not gspmd_auto_axes()
     seen = []
