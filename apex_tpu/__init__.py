@@ -25,6 +25,9 @@ SunDoge/apex snapshot, see SURVEY.md) designed for TPUs from the ground up:
   ``lax.scan`` loops.
 - ``apex_tpu.reparameterization``: weight normalization as pure pytree
   transforms.
+- ``apex_tpu.serving``: batched inference — block-table KV cache,
+  jitted prefill/decode engine, continuous-batching scheduler, and the
+  ``InferenceServer`` front door.
 
 Unlike the reference (a PyTorch extension), models here are flax/JAX pytrees
 and the training step is a pure function compiled once by XLA. The apex API
@@ -44,6 +47,7 @@ from apex_tpu import fp16_utils
 from apex_tpu import multi_tensor_apply
 from apex_tpu import RNN
 from apex_tpu import reparameterization
+from apex_tpu import serving
 
 __version__ = "0.1.0"
 
@@ -60,4 +64,5 @@ __all__ = [
     "optimizers",
     "parallel",
     "reparameterization",
+    "serving",
 ]

@@ -73,20 +73,26 @@ def _init(cfg):
     return nn.initializers.normal(cfg.initializer_range)
 
 
-def _embed_block(cfg, input_ids, deterministic):
+def _embed_block(cfg, input_ids, deterministic, positions=None):
     """Token + position embeddings + dropout, shared by
     :class:`GPTLMHeadModel` and :class:`GPTEmbed` so the param names
     and math cannot drift (same discipline as ``bert._embed_block``;
     must be called inside an ``@nn.compact`` body).  Returns
-    ``(x, wte)`` — the wte module for the tied LM head."""
+    ``(x, wte)`` — the wte module for the tied LM head.
+
+    ``positions``: optional (B, S) explicit position indices — the
+    serving decode step feeds a single token per sequence at its OWN
+    position (each request sits at a different depth), where the
+    default ``arange`` would embed everything at position 0."""
     init = _init(cfg)
     s = input_ids.shape[1]
     wte = nn.Embed(cfg.vocab_size, cfg.hidden_size,
                    embedding_init=init, name="wte")
     x = wte(input_ids)
+    if positions is None:
+        positions = jnp.arange(s)[None, :]
     x = x + nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
-                     embedding_init=init, name="wpe")(
-        jnp.arange(s)[None, :])
+                     embedding_init=init, name="wpe")(positions)
     x = nn.Dropout(cfg.hidden_dropout_prob,
                    deterministic=deterministic)(x)
     return x, wte
@@ -114,7 +120,18 @@ class GPTSelfAttention(nn.Module):
     attention_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, x, attn_bias, deterministic: bool = True):
+    def __call__(self, x, attn_bias, deterministic: bool = True,
+                 cache_view=None, return_kv: bool = False):
+        """``cache_view``: decode mode — ``(k_ctx, v_ctx, ctx_bias)``
+        with k/v_ctx (B, T, H, D) gathered cache context and ctx_bias
+        (B, T) additive (0 keep / NEG_INF for unwritten slots); x is
+        then the single new token (B, 1, h) and attention runs over
+        [context; self] via ``ops.cached_attention`` — ``attention_fn``
+        (a causal full-sequence kernel) is deliberately bypassed.
+        ``return_kv``: also return this call's freshly projected
+        ``(k, v)`` so the serving engine can append them to the cache.
+        Both default off — the training path is byte-identical to
+        before."""
         cfg = self.cfg
         h, nh = cfg.hidden_size, cfg.num_attention_heads
         init = _init(cfg)
@@ -124,33 +141,56 @@ class GPTSelfAttention(nn.Module):
                                    name=name)(x)
 
         q, k, v = proj("query"), proj("key"), proj("value")
-        dropout_fn = None
-        if cfg.attention_probs_dropout_prob > 0 and not deterministic:
-            drop = nn.Dropout(cfg.attention_probs_dropout_prob,
-                              deterministic=False)
-            dropout_fn = lambda p: drop(p)
-            if self.attention_fn is not None:
-                # same (rate, seed) annotation contract as BERT so the
-                # fused kernels run dropout in-kernel
-                # (ops.flash_attention.dropout_params)
-                dropout_fn.rate = cfg.attention_probs_dropout_prob
-                dropout_fn.seed = jax.random.randint(
-                    self.make_rng("dropout"), (), 0,
-                    jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
-        attn = self.attention_fn or causal_dot_product_attention
-        ctx = attn(q, k, v, bias=attn_bias, dropout_fn=dropout_fn)
-        return nn.DenseGeneral(h, axis=(-2, -1), kernel_init=init,
-                               name="output")(ctx)
+        if cache_view is not None:
+            from apex_tpu.ops.decode_attention import cached_attention
+
+            k_ctx, v_ctx, ctx_bias = cache_view
+            # the new token attends its gathered past plus itself; the
+            # self slot is always live (bias 0)
+            k_full = jnp.concatenate(
+                [k_ctx.astype(k.dtype), k], axis=1)
+            v_full = jnp.concatenate(
+                [v_ctx.astype(v.dtype), v], axis=1)
+            bias = jnp.concatenate(
+                [ctx_bias, jnp.zeros((x.shape[0], 1), jnp.float32)],
+                axis=1)
+            ctx = cached_attention(q, k_full, v_full, kv_bias=bias)
+        else:
+            dropout_fn = None
+            if cfg.attention_probs_dropout_prob > 0 and not deterministic:
+                drop = nn.Dropout(cfg.attention_probs_dropout_prob,
+                                  deterministic=False)
+                dropout_fn = lambda p: drop(p)
+                if self.attention_fn is not None:
+                    # same (rate, seed) annotation contract as BERT so
+                    # the fused kernels run dropout in-kernel
+                    # (ops.flash_attention.dropout_params)
+                    dropout_fn.rate = cfg.attention_probs_dropout_prob
+                    dropout_fn.seed = jax.random.randint(
+                        self.make_rng("dropout"), (), 0,
+                        jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+            attn = self.attention_fn or causal_dot_product_attention
+            ctx = attn(q, k, v, bias=attn_bias, dropout_fn=dropout_fn)
+        out = nn.DenseGeneral(h, axis=(-2, -1), kernel_init=init,
+                              name="output")(ctx)
+        if return_kv:
+            return out, (k, v)
+        return out
 
 
 class GPTBlock(nn.Module):
-    """Pre-LN: x + Attn(LN(x)); x + MLP(LN(x))."""
+    """Pre-LN: x + Attn(LN(x)); x + MLP(LN(x)).
+
+    ``cache_view``/``return_kv`` thread straight through to
+    :class:`GPTSelfAttention` (serving decode/prefill); the training
+    call sites never pass them."""
 
     cfg: GPTConfig
     attention_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, x, attn_bias, deterministic: bool = True):
+    def __call__(self, x, attn_bias, deterministic: bool = True,
+                 cache_view=None, return_kv: bool = False):
         cfg = self.cfg
         init = _init(cfg)
         drop = nn.Dropout(cfg.hidden_dropout_prob,
@@ -159,7 +199,12 @@ class GPTBlock(nn.Module):
                            name="attn_ln")(x)
         h = GPTSelfAttention(cfg, self.attention_fn,
                              name="attention")(h, attn_bias,
-                                               deterministic)
+                                               deterministic,
+                                               cache_view=cache_view,
+                                               return_kv=return_kv)
+        kv = None
+        if return_kv:
+            h, kv = h
         x = x + drop(h)
         h = FusedLayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps,
                            name="mlp_ln")(x)
@@ -168,6 +213,8 @@ class GPTBlock(nn.Module):
         h = nn.gelu(h, approximate=True)
         h = nn.Dense(cfg.hidden_size, kernel_init=init,
                      name="mlp_out")(h)
+        if return_kv:
+            return x + drop(h), kv
         return x + drop(h)
 
 
@@ -183,6 +230,19 @@ class GPTLMHeadModel(nn.Module):
     express a non-causal LM here.
     ``attention_mask``: optional (B, S) 1/0 padding mask, additive on
     key positions on top of causality.
+
+    Serving hooks (``apex_tpu.serving.engine`` is the caller; training
+    code never passes them):
+
+    - ``positions``: explicit (B, S) position-embedding indices
+      (decode feeds one token per sequence at its own depth);
+    - ``cache_views``: decode mode — ``(k_ctx, v_ctx, ctx_bias)`` with
+      k/v_ctx (L, B, T, H, D) per-layer gathered KV-cache context and
+      ctx_bias (B, T); each block attends [its context; self];
+    - ``return_kv``: also return the per-layer freshly projected
+      ``(k, v)`` list so the engine can write them into the cache
+      (prefill uses this with ``cache_views=None`` — the normal causal
+      forward, optionally through the flash ``attention_fn``).
     """
 
     cfg: GPTConfig
@@ -191,21 +251,37 @@ class GPTLMHeadModel(nn.Module):
     @nn.compact
     def __call__(self, input_ids, attention_mask=None,
                  deterministic: bool = True,
-                 return_hidden: bool = False):
+                 return_hidden: bool = False,
+                 positions=None, cache_views=None,
+                 return_kv: bool = False):
         cfg = self.cfg
-        x, wte = _embed_block(cfg, input_ids, deterministic)
+        x, wte = _embed_block(cfg, input_ids, deterministic, positions)
         bias = None
         if attention_mask is not None:
             bias = jnp.where(attention_mask[:, None, None, :] > 0,
                              0.0, NEG_INF).astype(jnp.float32)
         block = GPTBlock
-        if cfg.remat:
+        if cfg.remat and not return_kv:
             # deterministic (argnum 3; self=0) is the static arg — the
-            # bias is a traced array (same as models.bert)
+            # bias is a traced array (same as models.bert). Inference
+            # (return_kv) never remats: there is no backward to save
+            # memory for, and the kv pytree output confuses the policy.
             block = nn.remat(GPTBlock, static_argnums=(3,))
+        kvs = []
         for i in range(cfg.num_hidden_layers):
-            x = block(cfg, self.attention_fn, name=f"block_{i}")(
-                x, bias, deterministic)
+            cv = None
+            if cache_views is not None:
+                k_ctx, v_ctx, ctx_bias = cache_views
+                cv = (k_ctx[i], v_ctx[i], ctx_bias)
+            if return_kv:
+                x, kv = block(cfg, self.attention_fn,
+                              name=f"block_{i}")(
+                    x, bias, deterministic, cache_view=cv,
+                    return_kv=True)
+                kvs.append(kv)
+            else:
+                x = block(cfg, self.attention_fn, name=f"block_{i}")(
+                    x, bias, deterministic)
         x = FusedLayerNorm(cfg.hidden_size, eps=cfg.layer_norm_eps,
                            name="final_ln")(x)
         if return_hidden:
@@ -216,6 +292,8 @@ class GPTLMHeadModel(nn.Module):
             return x
         # weight-tied head: logits = x @ wte^T
         logits = wte.attend(x)
+        if return_kv:
+            return logits.astype(jnp.float32), kvs
         return logits.astype(jnp.float32)
 
 

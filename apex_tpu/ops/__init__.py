@@ -17,10 +17,12 @@ from apex_tpu.ops.multi_tensor import (
 )
 from apex_tpu.ops.flatten import flatten, unflatten, flatten_like
 from apex_tpu.ops.flash_attention import flash_attention, make_flash_attention
+from apex_tpu.ops.decode_attention import cached_attention
 from apex_tpu.ops.vocab_parallel import vocab_parallel_lm_loss
 from apex_tpu.ops import native
 
 __all__ = [
+    "cached_attention",
     "flash_attention",
     "make_flash_attention",
     "native",

@@ -1,0 +1,209 @@
+"""Cached (single-token) attention — the decode half of serving.
+
+Prefill reuses ``ops.flash_attention`` unchanged (causal, O(S) memory,
+full backward).  Decode is a different animal: one NEW query token per
+sequence attends over T cached key/value positions gathered from the
+``serving.kv_cache`` block pool — Sq == 1, no causality (the cache only
+ever holds the past), no dropout, and no backward pass (inference
+only).  Specializing buys a much leaner kernel than flash-with-Sq=1:
+
+- grid ``(B*H, T/bk)``, k innermost; VMEM scratch carries the running
+  (m, l, acc) streaming-softmax state across k blocks, so the (1, T)
+  score row never exists in HBM;
+- the single query row is broadcast to the 8-sublane granularity the
+  TPU vector layout wants (rows 1..7 compute identical garbage that is
+  sliced away on writeout — sublane padding is free relative to the
+  HBM-bound K/V streaming that dominates decode);
+- scores accumulate in fp32 on the MXU regardless of cache dtype
+  (``preferred_element_type``), matching the flash numeric policy.
+
+The jnp path is the parity oracle and the CPU/GSPMD-automatic
+fallback; the kernel gate is the standard
+``pallas_utils.pallas_auto_gate`` resolution of ``use_pallas=None``.
+
+Masking: ``kv_bias`` is a (B, T) additive fp32 row (0 keep / NEG_INF
+drop) — the engine builds it from per-request context lengths so
+unwritten cache slots can never win the softmax.  Fully-masked rows
+emit zeros (the flash convention), though the serving engine never
+produces one: the new token's own k/v is always appended unmasked.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.ops.pallas_utils import pallas_auto_gate, on_tpu, unpatched
+
+NEG_INF = -1e30
+
+# fp32-accumulation einsum, immune to amp O1's half-list patch (the
+# upcasts here are deliberate numerics, not user policy — same rationale
+# as ops.flash_attention)
+_einsum = unpatched(jnp.einsum)
+
+# sublane granularity the single query row is broadcast to
+_QROWS = 8
+
+
+def _cdiv(a, b):
+    return (a + b - 1) // b
+
+
+def _reference(q, k, v, kv_bias, scale):
+    """jnp oracle: fp32 scores/softmax, output in q.dtype."""
+    s = _einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if kv_bias is not None:
+        s = s + kv_bias.astype(jnp.float32)[:, None, None, :]
+    m = jnp.max(s, axis=-1, keepdims=True)
+    # fully-masked rows (all NEG_INF) emit zeros, not NaN
+    valid = m > NEG_INF / 2
+    p = jnp.exp(s - jnp.where(valid, m, 0.0))
+    p = jnp.where(valid, p, 0.0)
+    l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    out = _einsum("bhqk,bkhd->bqhd", (p / l).astype(q.dtype), v)
+    return out.astype(q.dtype)
+
+
+def _decode_kernel(bias_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, scale, bk, nk):
+    """One (batch*head, k-block) step of the streaming softmax."""
+    ik = pl.program_id(1)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0]                                   # (_QROWS, D)
+    k = k_ref[0]                                   # (bk, D)
+    v = v_ref[0]
+    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * scale
+    s = s + bias_ref[0, 0][None, :]                # (_QROWS, bk)
+
+    m_prev = m_ref[:, 0]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+    corr = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[:, None])
+    l_new = l_ref[:, 0] * corr + jnp.sum(p, axis=1)
+    acc_ref[:] = acc_ref[:] * corr[:, None] + lax.dot_general(
+        p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    @pl.when(ik == nk - 1)
+    def _writeout():
+        # 2-D broadcast-first like flash: Mosaic cannot insert a minor
+        # dim on i1 vectors
+        m2 = m_ref[:, :1]
+        valid2 = m2 > NEG_INF / 2
+        out = acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = jnp.where(valid2, out, 0.0).astype(o_ref.dtype)
+
+
+try:  # mirrors ops.flash_attention: Pallas is TPU-only machinery
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover - environment without pallas
+    _HAVE_PALLAS = False
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "bk", "interpret"))
+def _decode_pallas(q3, k3, v3, bias, *, scale, bk, interpret):
+    """q3: (BH, _QROWS, D) broadcast query; k3/v3: (BH, Tp, D);
+    bias: (B, Tp) additive row, already NEG_INF over T padding."""
+    bh, _, d = q3.shape
+    tp = k3.shape[1]
+    nk = tp // bk
+    b = bias.shape[0]
+    h = bh // b
+    lanes = 128
+    q_spec = pl.BlockSpec((1, _QROWS, d), lambda i, j: (i, 0, 0))
+    k_spec = pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0))
+    bias_spec = pl.BlockSpec((1, 1, bk), lambda i, j: (i // h, 0, j))
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, bk=bk, nk=nk),
+        grid=(bh, nk),
+        in_specs=[bias_spec, q_spec, k_spec, k_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, _QROWS, d), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((_QROWS, d), jnp.float32),
+                        pltpu.VMEM((_QROWS, lanes), jnp.float32),
+                        pltpu.VMEM((_QROWS, lanes), jnp.float32)],
+        interpret=interpret,
+    )(bias[:, None, :], q3, k3, v3)
+    return out
+
+
+def _layout(x):
+    """(B, T, H, D) -> (B*H, T, D)."""
+    b, t, h, d = x.shape
+    return jnp.swapaxes(x, 1, 2).reshape(b * h, t, d)
+
+
+def cached_attention(q, k, v, *, kv_bias: Optional[jax.Array] = None,
+                     scale: Optional[float] = None,
+                     block_k: Optional[int] = None,
+                     use_pallas: Optional[bool] = None,
+                     interpret: Optional[bool] = None):
+    """Single-new-token attention over a gathered KV-cache context.
+
+    Args:
+      q: (B, 1, H, D) — the new token's queries.
+      k, v: (B, T, H, D) — gathered cache context, the new token's own
+        k/v included (the engine appends it; there is no causality to
+        enforce because the cache holds only the past).
+      kv_bias: optional (B, T) additive fp32 mask (0 keep / NEG_INF
+        drop) — position j masks cache slot j; unwritten slots MUST be
+        masked by the caller.
+      scale: logit scale, default 1/sqrt(D).
+      block_k: k-block tile (multiple of 128 recommended); default
+        min(512, padded T).
+      use_pallas: None = auto (:func:`pallas_utils.pallas_auto_gate`).
+      interpret: force Pallas interpret mode (defaults to not-on-TPU).
+
+    Returns (B, 1, H, D) in q.dtype.  NOT differentiable on the kernel
+    path — decode is inference-only; the jnp path differentiates like
+    any jnp code.
+    """
+    if q.ndim != 4 or q.shape[1] != 1:
+        raise ValueError(f"q must be (B, 1, H, D); got {q.shape}")
+    if k.shape != v.shape or k.shape[0] != q.shape[0] \
+            or k.shape[2:] != q.shape[2:]:
+        raise ValueError(
+            f"k/v must be (B, T, H, D) matching q; got q={q.shape} "
+            f"k={k.shape} v={v.shape}")
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if not (_HAVE_PALLAS and pallas_auto_gate(use_pallas)):
+        return _reference(q, k, v, kv_bias, scale)
+
+    if interpret is None:
+        interpret = not on_tpu()
+    b, t, h, d = k.shape
+    if block_k is None:
+        block_k = min(512, _cdiv(t, 128) * 128)
+    tp = _cdiv(t, block_k) * block_k
+    bias = (jnp.zeros((b, t), jnp.float32) if kv_bias is None
+            else kv_bias.astype(jnp.float32))
+    if tp != t:  # padded cache slots must never win the softmax
+        k = jnp.pad(k, ((0, 0), (0, tp - t), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, tp - t), (0, 0), (0, 0)))
+        bias = jnp.pad(bias, ((0, 0), (0, tp - t)),
+                       constant_values=NEG_INF)
+    q3 = jnp.broadcast_to(_layout(q), (b * h, _QROWS, d))
+    out = _decode_pallas(q3, _layout(k), _layout(v), bias,
+                         scale=float(scale), bk=int(block_k),
+                         interpret=bool(interpret))
+    # row 0 of the sublane-broadcast block is the real query
+    return out[:, :1].reshape(b, h, 1, d).swapaxes(1, 2)

@@ -620,7 +620,7 @@ _flash.defvjp(lambda q, k, v, m, s, causal, scale, bq, bk, interp, rate:
 
 
 def _default_block(s: int) -> int:
-    """Adaptive tile default: the largest of {512, 384, 256, 128} that
+    """Adaptive tile default: the largest 128-multiple <= 512 that
     DIVIDES the 128-padded sequence (or the whole padded sequence when
     that is <= 512).  Measured on v5e (round-5 live sweep, BENCH_NOTES
     session 8): fwd+bwd causal s2048 b4h8d64 runs 1.49x faster at
@@ -629,12 +629,25 @@ def _default_block(s: int) -> int:
     512 the curve flattens (VMEM pressure grows with d).  The
     divisibility rule matters: a 512 block at S=768 would re-pad the
     sequence to 1024 and run 1.78x the real FLOPs non-causally, so
-    block choice must never add padding beyond the 128 grain."""
+    block choice must not add padding much beyond the 128 grain.  The
+    candidate list covers EVERY 128-multiple <= 512 — with only
+    {512, 384, 256} above the cap, padded lengths like 640 (5*128)
+    used to fall through to 128-wide tiles even though 320 divides
+    them (ADVICE round 5).  Lengths with no wide divisor at all (1664
+    = 13*128: 13 is prime) may take the widest candidate whose
+    re-padding overhead stays <= 1/8 of the work — the kernels mask
+    padded keys exactly (``_pad_seq`` + the padded-key NEG_INF mask),
+    and a few percent of extra FLOPs is far below the measured
+    1.2-1.5x wide-tile win, while 768 -> 512 (33% overhead) stays
+    correctly rejected."""
     sp = _cdiv(s, 128) * 128
     if sp <= 512:
         return max(128, sp)
-    for b in (512, 384, 256):
+    for b in (512, 384, 320, 256, 192):
         if sp % b == 0:
+            return b
+    for b in (512, 384, 320, 256, 192):
+        if _cdiv(sp, b) * b - sp <= sp // 8:
             return b
     return 128
 

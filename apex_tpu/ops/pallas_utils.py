@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import math
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -59,14 +60,50 @@ def gspmd_auto_axes() -> bool:
                for t in getattr(am, "axis_types", ()))
 
 
+def _gspmd_auto_axis_names():
+    """Names of the GSPMD-automatic axes of the current abstract mesh
+    (empty tuple when there are none / no mesh)."""
+    try:
+        from jax.sharding import AxisType
+        am = jax.sharding.get_abstract_mesh()
+        return tuple(n for n, t in zip(getattr(am, "axis_names", ()),
+                                       getattr(am, "axis_types", ()))
+                     if t == AxisType.Auto)
+    except Exception:
+        return ()
+
+
+_warned_auto_downgrade = False
+
+
 def pallas_auto_gate(flag=None) -> bool:
     """The ONE resolution of every kernel's ``use_pallas=None`` default:
     real kernels on TPU, except under GSPMD-automatic axes where the
     partitioner rejects Mosaic calls (:func:`gspmd_auto_axes`).  An
-    explicit ``flag`` always wins."""
+    explicit ``flag`` always wins.
+
+    The TPU-but-downgraded case warns ONCE per process, naming the
+    automatic mesh axes that triggered it: users running pipelined
+    Megatron TP otherwise read full-kernel throughput numbers off a
+    silently jnp-referenced hot path (ADVICE round 5)."""
     if flag is not None:
         return flag
-    return on_tpu() and not gspmd_auto_axes()
+    if not on_tpu():
+        return False
+    if gspmd_auto_axes():
+        global _warned_auto_downgrade
+        if not _warned_auto_downgrade:
+            _warned_auto_downgrade = True
+            warnings.warn(
+                "pallas_auto_gate: on TPU but inside a shard_map region "
+                "with GSPMD-automatic mesh axes "
+                f"{_gspmd_auto_axis_names()} — the SPMD partitioner "
+                "rejects Mosaic custom calls there, so Pallas kernels "
+                "are rerouted to their jnp reference paths for this and "
+                "every later call in such regions (warned once).",
+                RuntimeWarning, stacklevel=3)
+        return False
+    return True
 
 
 def pad_to_tiles(flat: jax.Array, rows: int = DEFAULT_ROWS):

@@ -1,0 +1,53 @@
+"""apex_tpu.serving — batched inference: KV-cache + continuous batching.
+
+The training stack (amp, optimizers, parallel, models) answers "how
+fast can we learn"; this package answers "how much traffic can we
+serve".  Three layers, bottom-up:
+
+- :mod:`serving.kv_cache` — a preallocated, block-table-indexed KV
+  pool (vLLM's PagedAttention memory model, fixed-shape for
+  jit-stability; dtype from the amp half policy) with a host-side
+  free-list allocator;
+- :mod:`serving.engine` — the jitted device steps: bucketed causal
+  prefill (reusing the training forward, flash-attention pluggable)
+  and a single-token batched decode through
+  ``ops.cached_attention``;
+- :mod:`serving.scheduler` / :mod:`serving.api` — Orca-style
+  iteration-level continuous batching (admit-on-slot-free, per-request
+  EOS/max-token termination, preempt-youngest on memory pressure) and
+  the synchronous :class:`InferenceServer` front door.
+
+Quick start::
+
+    from apex_tpu.serving import InferenceServer
+    server = InferenceServer(gpt_cfg, params, max_batch_size=8)
+    completions = server.generate(prompts, max_new_tokens=64,
+                                  eos_id=eos)
+
+See ``docs/serving.md`` for cache-sizing math and the
+bucket/recompile tradeoff; ``tools/serving_bench.py`` measures
+continuous batching against naive one-request-at-a-time decoding.
+"""
+
+from apex_tpu.serving.api import InferenceServer, greedy_sample
+from apex_tpu.serving.engine import DecodeEngine, default_prefill_buckets
+from apex_tpu.serving.kv_cache import (
+    BlockAllocator,
+    KVCacheConfig,
+    init_kv_cache,
+    resolve_cache_dtype,
+)
+from apex_tpu.serving.scheduler import Request, Scheduler
+
+__all__ = [
+    "BlockAllocator",
+    "DecodeEngine",
+    "InferenceServer",
+    "KVCacheConfig",
+    "Request",
+    "Scheduler",
+    "default_prefill_buckets",
+    "greedy_sample",
+    "init_kv_cache",
+    "resolve_cache_dtype",
+]

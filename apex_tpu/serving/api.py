@@ -1,0 +1,174 @@
+"""`InferenceServer` — the synchronous front door of `apex_tpu.serving`.
+
+Composes the device half (:class:`serving.engine.DecodeEngine`: jitted
+prefill/decode over the block-pool KV cache) with the host half
+(:class:`serving.scheduler.Scheduler`: iteration-level continuous
+batching) into a step loop, and meters it (queue depth, running-batch
+occupancy, tokens/s — ``utils.RateMeter``/``GaugeMeter``).
+
+``generate()`` is batch-synchronous (submit N prompts, run the loop to
+completion, return N completions) — the shape every test and bench
+needs.  A live service would run :meth:`step` on its event loop and
+stream ``Request.generated`` as it grows; both drive the identical
+scheduler/engine machinery, so the offline numbers transfer.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from apex_tpu.serving.engine import DecodeEngine
+from apex_tpu.serving.scheduler import Request, Scheduler
+from apex_tpu.utils import GaugeMeter, RateMeter
+
+
+def greedy_sample(logits: np.ndarray) -> np.ndarray:
+    """(…, V) logits -> (…,) argmax token ids — deterministic, which
+    is what makes cached decode testable token-for-token against the
+    full-recompute forward."""
+    return np.argmax(logits, axis=-1)
+
+
+class InferenceServer:
+    """Batched GPT inference with KV-cache + continuous batching.
+
+    Args (beyond :class:`DecodeEngine`'s, which pass through):
+      sample_fn: (…, V) numpy logits -> (…,) token ids; default
+        greedy.  Runs on host — per-step logits are (B, V).
+
+    Example::
+
+        server = InferenceServer(cfg, params, max_batch_size=8)
+        outs = server.generate(prompts, max_new_tokens=64, eos_id=50256)
+    """
+
+    def __init__(self, cfg, params, *,
+                 max_batch_size: int = 8,
+                 max_context: Optional[int] = None,
+                 num_blocks: Optional[int] = None,
+                 block_size: int = 16,
+                 cache_dtype=None,
+                 attention_fn=None,
+                 prefill_buckets=None,
+                 sample_fn: Optional[Callable] = None):
+        self.engine = DecodeEngine(
+            cfg, params, max_batch_size=max_batch_size,
+            max_context=max_context, num_blocks=num_blocks,
+            block_size=block_size, cache_dtype=cache_dtype,
+            attention_fn=attention_fn, prefill_buckets=prefill_buckets)
+        self.scheduler = Scheduler(
+            self.engine.allocator,
+            max_batch_size=self.engine.max_batch_size,
+            block_size=self.engine.block_size,
+            max_context=self.engine.max_context)
+        self.sample_fn = sample_fn or greedy_sample
+        self.queue_depth = GaugeMeter()
+        self.occupancy = GaugeMeter()
+        self.tokens = RateMeter()
+
+    # -- request lifecycle ------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               eos_id: Optional[int] = None) -> Request:
+        """Enqueue one request.  ``max_new_tokens`` is silently capped
+        so prompt + completion fits ``max_context``."""
+        prompt = [int(t) for t in prompt]
+        cap = self.engine.max_context - len(prompt)
+        if cap <= 0:
+            raise ValueError(
+                f"prompt length {len(prompt)} leaves no room to "
+                f"generate within max_context={self.engine.max_context}")
+        req = Request(prompt=prompt,
+                      max_new_tokens=min(int(max_new_tokens), cap),
+                      eos_id=eos_id)
+        return self.scheduler.submit(req)
+
+    def step(self) -> int:
+        """One continuous-batching iteration: admit + prefill newly
+        schedulable requests, then one decode step across the running
+        batch.  Returns the number of tokens sampled (0 = idle)."""
+        sched, engine = self.scheduler, self.engine
+        produced = 0
+
+        for req in sched.admit():
+            ctx, discard_logits = sched.prefill_plan(req)
+            logits = engine.prefill(ctx, req.block_table)
+            req.num_cached = len(ctx)
+            if discard_logits:
+                # resumed after preemption: the pending token continues
+                continue
+            tok = int(self.sample_fn(np.asarray(logits)))
+            req.record_token(tok)
+            produced += 1
+            if req.finished:
+                sched.retire(req)
+
+        if sched.running:
+            for req in list(sched.running.values()):
+                if req.running:        # an earlier pass may have
+                    sched.ensure_decode_capacity(req)  # preempted it
+            running = list(sched.running.values())
+            if running:
+                b, mb = engine.max_batch_size, engine.blocks_per_seq
+                tokens = np.zeros((b,), np.int32)
+                positions = np.zeros((b,), np.int32)
+                tables = np.zeros((b, mb), np.int32)
+                for req in running:
+                    tokens[req.slot] = req.next_input
+                    positions[req.slot] = req.num_cached
+                    tables[req.slot, :len(req.block_table)] = \
+                        req.block_table
+                logits = np.asarray(
+                    engine.decode(tokens, positions, tables))
+                toks = self.sample_fn(logits)
+                for req in running:
+                    req.num_cached += 1
+                    req.record_token(int(toks[req.slot]))
+                    produced += 1
+                    if req.finished:
+                        sched.retire(req)
+
+        self.tokens.update(produced)
+        self.queue_depth.update(sched.num_waiting)
+        self.occupancy.update(sched.num_running
+                              / self.engine.max_batch_size)
+        return produced
+
+    # -- front door -------------------------------------------------------
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 max_new_tokens: int,
+                 eos_id: Optional[int] = None) -> List[List[int]]:
+        """Generate completions for ``prompts`` (token-id lists) and
+        return the generated ids per prompt, in input order."""
+        reqs = [self.submit(p, max_new_tokens, eos_id) for p in prompts]
+        while self.scheduler.has_work:
+            self.step()
+        return [list(r.generated) for r in reqs]
+
+    def reset_meters(self) -> None:
+        """Zero the counters (after compile warmup, before a timed
+        window) — a completed :meth:`generate` already returns every
+        slot and block, so the server itself needs no reset."""
+        self.tokens.reset()
+        self.queue_depth.reset()
+        self.occupancy.reset()
+        self.scheduler.finished.clear()
+
+    def stats(self) -> dict:
+        """Serving counters for logs and the bench harness."""
+        pre, dec = self.engine.compile_counts()
+        return {
+            "tokens_generated": self.tokens.total,
+            "tokens_per_s": round(self.tokens.rate, 1),
+            "queue_depth_peak": self.queue_depth.peak,
+            "batch_occupancy_avg": round(self.occupancy.avg, 3),
+            "prefill_compiles": pre,
+            "decode_compiles": dec,
+            "kv_blocks_free": self.engine.allocator.num_free,
+            "requests_finished": len(self.scheduler.finished),
+            "preemptions": sum(r.preemptions
+                               for r in self.scheduler.finished),
+        }

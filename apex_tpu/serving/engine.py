@@ -1,0 +1,233 @@
+"""Jit-compiled prefill + single-token decode steps over the KV cache.
+
+Two compiled programs, both fixed-shape so the continuous-batching
+loop never recompiles in steady state:
+
+- **prefill** (one request, prompt padded to a length *bucket*): the
+  ordinary causal GPT forward — optionally through the flash kernel
+  via ``attention_fn`` — with ``return_kv=True``; the per-layer K/V
+  are scattered into the request's blocks in the same program.  One
+  trace per bucket length, so the compile count is bounded by
+  ``len(prefill_buckets)``, not by the distribution of prompt lengths.
+- **decode** (the whole running batch, always ``max_batch_size``
+  wide): gather every slot's context through its block table, run the
+  model on one token per slot at its own position
+  (``ops.cached_attention`` inside), scatter the new K/V, return
+  next-token logits.  Compiled exactly once.
+
+Empty slots ride along as no-ops by construction: position 0 masks
+the whole context, the zeroed block table routes the KV write into
+the reserved garbage block, and the caller ignores their logits.
+
+The cache pytree is donated through both steps — on TPU the pool is
+the HBM hog and must be updated in place, not double-buffered.  (XLA
+on CPU ignores donation; the warning is filtered.)
+"""
+
+from __future__ import annotations
+
+import functools
+import warnings
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models.gpt import GPTConfig, GPTLMHeadModel
+from apex_tpu.serving.kv_cache import (
+    BlockAllocator,
+    KVCacheConfig,
+    context_bias,
+    gather_context,
+    init_kv_cache,
+    slot_index,
+    write_prefill,
+    write_tokens,
+)
+
+# CPU backends can't honor donation; the fallback copy is exactly the
+# pre-donation behavior, so the warning is noise off-TPU
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
+
+
+def default_prefill_buckets(max_context: int,
+                            smallest: int = 16) -> Tuple[int, ...]:
+    """Power-of-two bucket ladder capped at ``max_context`` — each
+    prompt pads to the next rung, so at most ``log2`` distinct prefill
+    shapes ever compile and no prompt pads to more than 2x its
+    length."""
+    buckets = []
+    b = smallest
+    while b < max_context:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_context)
+    return tuple(buckets)
+
+
+class DecodeEngine:
+    """The device half of the serving stack: owns the cache pool, the
+    compiled prefill/decode programs, and nothing else — admission,
+    batching composition, and termination live in
+    ``serving.scheduler``/``serving.api``.
+
+    Args:
+      cfg: the GPT architecture (params must match).
+      params: the model's ``{"params": ...}["params"]`` pytree (pass
+        amp-cast params to serve in half).
+      max_batch_size: decode batch width (running-request slots).
+      max_context: per-request token capacity; default
+        ``cfg.max_position_embeddings``.
+      num_blocks: physical blocks in the pool (incl. the reserved
+        garbage block 0); default sizes the pool for
+        ``max_batch_size`` full-context requests plus slack.
+      block_size: tokens per block.
+      cache_dtype: KV dtype; None = amp policy
+        (:func:`serving.kv_cache.resolve_cache_dtype`).
+      attention_fn: optional fused attention for the PREFILL pass
+        (``make_flash_attention(causal=True)`` on TPU); decode always
+        takes the ``ops.cached_attention`` path.
+      prefill_buckets: ascending prompt-length buckets; None =
+        :func:`default_prefill_buckets`.
+    """
+
+    def __init__(self, cfg: GPTConfig, params, *,
+                 max_batch_size: int = 8,
+                 max_context: Optional[int] = None,
+                 num_blocks: Optional[int] = None,
+                 block_size: int = 16,
+                 cache_dtype=None,
+                 attention_fn=None,
+                 prefill_buckets: Optional[Sequence[int]] = None):
+        self.cfg = cfg
+        self.params = params
+        self.max_batch_size = int(max_batch_size)
+        self.max_context = int(max_context
+                               or cfg.max_position_embeddings)
+        if self.max_context > cfg.max_position_embeddings:
+            raise ValueError(
+                f"max_context={self.max_context} exceeds the model's "
+                f"max_position_embeddings={cfg.max_position_embeddings}")
+        self.block_size = int(block_size)
+        self.blocks_per_seq = -(-self.max_context // self.block_size)
+        if num_blocks is None:
+            # every slot can hold a full-context request, +1 garbage
+            num_blocks = self.max_batch_size * self.blocks_per_seq + 1
+        self.cache_cfg = KVCacheConfig(
+            num_layers=cfg.num_hidden_layers,
+            num_heads=cfg.num_attention_heads,
+            head_dim=cfg.hidden_size // cfg.num_attention_heads,
+            num_blocks=int(num_blocks),
+            block_size=self.block_size,
+            dtype=cache_dtype)
+        self.allocator = BlockAllocator(self.cache_cfg)
+        self.cache = init_kv_cache(self.cache_cfg)
+        self.model = GPTLMHeadModel(cfg, attention_fn=attention_fn)
+        if prefill_buckets is None:
+            prefill_buckets = default_prefill_buckets(self.max_context)
+        self.prefill_buckets = tuple(sorted(int(b)
+                                            for b in prefill_buckets))
+        if self.prefill_buckets[-1] < self.max_context:
+            raise ValueError(
+                f"largest prefill bucket {self.prefill_buckets[-1]} "
+                f"< max_context {self.max_context}")
+
+        self._prefill_jit = jax.jit(self._prefill_impl,
+                                    donate_argnums=(1,))
+        self._decode_jit = jax.jit(self._decode_impl,
+                                   donate_argnums=(1,))
+
+    # -- compiled bodies --------------------------------------------------
+
+    def _prefill_impl(self, params, cache, ids, length, table):
+        """ids (1, Sb) zero-padded prompt; length (1,) true length;
+        table (1, blocks_per_seq).  Returns (cache, last-token logits
+        (1, V))."""
+        sb = ids.shape[1]
+        pos = jnp.arange(sb, dtype=jnp.int32)[None, :]
+        mask = (pos < length[:, None]).astype(jnp.int32)
+        logits, kvs = self.model.apply(
+            {"params": params}, ids, attention_mask=mask,
+            deterministic=True, return_kv=True)
+        k = jnp.stack([kv[0] for kv in kvs])          # (L, 1, Sb, H, D)
+        v = jnp.stack([kv[1] for kv in kvs])
+        # padded positions scatter into the garbage block (slot 0)
+        slots = jnp.where(mask > 0,
+                          slot_index(table, pos, self.block_size), 0)
+        cache = write_prefill(cache, (k, v), slots)
+        last = jnp.take_along_axis(
+            logits, (length[:, None, None] - 1).astype(jnp.int32),
+            axis=1)[:, 0]                             # (1, V)
+        return cache, last
+
+    def _decode_impl(self, params, cache, tokens, positions, tables):
+        """tokens (B,) current input token per slot; positions (B,)
+        its position (== cached context length); tables (B,
+        blocks_per_seq).  Returns (cache, logits (B, V))."""
+        t_ctx = self.blocks_per_seq * self.block_size
+        k_ctx, v_ctx = gather_context(cache, tables, self.block_size)
+        bias = context_bias(positions, t_ctx)
+        logits, kvs = self.model.apply(
+            {"params": params}, tokens[:, None],
+            positions=positions[:, None].astype(jnp.int32),
+            deterministic=True,
+            cache_views=(k_ctx, v_ctx, bias), return_kv=True)
+        k = jnp.stack([kv[0] for kv in kvs])          # (L, B, 1, H, D)
+        v = jnp.stack([kv[1] for kv in kvs])
+        slots = slot_index(tables, positions, self.block_size)
+        cache = write_tokens(cache, (k, v), slots)
+        return cache, logits[:, 0]                    # (B, V)
+
+    # -- host API ---------------------------------------------------------
+
+    def bucket_for(self, length: int) -> int:
+        for b in self.prefill_buckets:
+            if length <= b:
+                return b
+        raise ValueError(
+            f"prompt length {length} exceeds max_context "
+            f"{self.max_context}")
+
+    def prefill(self, prompt, block_table) -> jax.Array:
+        """Run one prompt through the bucketed prefill, writing its
+        K/V into ``block_table``'s blocks.  Returns the last-token
+        logits (V,)."""
+        import numpy as np
+
+        n = len(prompt)
+        sb = self.bucket_for(n)
+        ids = np.zeros((1, sb), np.int32)
+        ids[0, :n] = prompt
+        table = np.zeros((1, self.blocks_per_seq), np.int32)
+        table[0, :len(block_table)] = block_table
+        self.cache, last = self._prefill_jit(
+            self.params, self.cache, jnp.asarray(ids),
+            jnp.asarray([n], jnp.int32), jnp.asarray(table))
+        return last[0]
+
+    def decode(self, tokens, positions, tables) -> jax.Array:
+        """One iteration-level decode step over all slots.  Arrays are
+        (B,), (B,), (B, blocks_per_seq) with inactive slots zeroed.
+        Returns next-token logits (B, V)."""
+        self.cache, logits = self._decode_jit(
+            self.params, self.cache,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(positions, jnp.int32),
+            jnp.asarray(tables, jnp.int32))
+        return logits
+
+    # -- introspection ----------------------------------------------------
+
+    def compile_counts(self):
+        """(prefill traces, decode traces) — the recompile audit the
+        scheduler tests pin: prefill <= len(prefill_buckets), decode
+        == 1 regardless of traffic."""
+        return (self._prefill_jit._cache_size(),
+                self._decode_jit._cache_size())
+
+    def reset_cache(self):
+        """Zero the pool and refill the allocator in place (between
+        workloads; schedulers holding the allocator stay wired)."""
+        self.cache = init_kv_cache(self.cache_cfg)
+        self.allocator.reset()

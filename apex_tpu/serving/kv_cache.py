@@ -1,0 +1,248 @@
+"""Block-table-indexed KV cache — the serving memory manager.
+
+vLLM's PagedAttention insight, re-derived for jit-stability on TPU:
+the cache is ONE preallocated fixed-shape pool of ``num_blocks``
+physical blocks of ``block_size`` token slots each, per layer —
+
+    k, v: (num_layers, num_blocks * block_size, num_heads, head_dim)
+
+— and every request owns an ordered *block table* mapping its logical
+token positions to physical blocks.  Fixed shapes mean the jitted
+prefill/decode steps never recompile as requests come and go; block
+granularity means a request's memory grows in ``block_size`` quanta
+with zero copying, and a finished request's blocks return to the free
+list immediately (no compaction, no fragmentation beyond the last
+partial block).
+
+Split of responsibilities:
+
+- device side (this module's pure functions): fixed-shape gather of a
+  request batch's context (``gather_context``), scatter of freshly
+  projected K/V into flat slots (``write_tokens`` / ``write_prefill``)
+  — all jit-traceable, cache pytree in/out;
+- host side (:class:`BlockAllocator`): the free list.  Allocation is
+  control flow, not math — it stays in Python where it is O(blocks)
+  trivial, exactly like the schedulers it serves.
+
+Physical block 0 is RESERVED as the garbage sink: unallocated
+block-table entries and padded prefill positions all point at it, so
+every scatter/gather stays in-bounds with no data-dependent branching
+— reads from it are masked by the context bias (built from lengths),
+writes to it land on data nothing will ever read.
+
+Dtype policy: the cache is typically the HBM hog (2 * L * T * H * D
+per token), so it defaults to the amp "half" dtype — the active
+``amp.initialize`` policy's ``cast_model_type`` when one is installed,
+else bfloat16 (``amp.properties.HALF``).  ``KVCacheConfig(dtype=...)``
+overrides explicitly (tests pin fp32 for bit-parity runs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9
+
+
+def resolve_cache_dtype(dtype=None):
+    """The ONE resolution of ``KVCacheConfig.dtype=None``: an explicit
+    dtype wins; else the installed amp policy's half type (``O1``-``O3``
+    set ``cast_model_type``); else bfloat16 (TPU-native half)."""
+    if dtype is not None:
+        return jnp.dtype(dtype)
+    try:
+        from apex_tpu.amp._amp_state import _amp_state
+        props = _amp_state.opt_properties
+        cast = getattr(props, "cast_model_type", None) if props else None
+        if cast is not None:
+            return jnp.dtype(cast)
+    except Exception:
+        pass
+    from apex_tpu.amp.properties import HALF
+    return jnp.dtype(HALF)
+
+
+@dataclasses.dataclass(frozen=True)
+class KVCacheConfig:
+    """Geometry of the block pool.
+
+    ``num_blocks`` INCLUDES the reserved garbage block 0, so the
+    usable capacity is ``(num_blocks - 1) * block_size`` tokens.
+    ``dtype=None`` defers to :func:`resolve_cache_dtype`."""
+
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    num_blocks: int
+    block_size: int = 16
+    dtype: Optional[object] = None
+
+    def __post_init__(self):
+        if self.num_blocks < 2:
+            raise ValueError(
+                "num_blocks must be >= 2 (block 0 is the reserved "
+                f"garbage sink); got {self.num_blocks}")
+        if self.block_size < 1:
+            raise ValueError(f"block_size must be >= 1; got "
+                             f"{self.block_size}")
+
+    @property
+    def num_slots(self) -> int:
+        return self.num_blocks * self.block_size
+
+    @property
+    def usable_tokens(self) -> int:
+        return (self.num_blocks - 1) * self.block_size
+
+    def resolved_dtype(self):
+        return resolve_cache_dtype(self.dtype)
+
+    def bytes(self) -> int:
+        """HBM footprint of the pool (both K and V)."""
+        return (2 * self.num_layers * self.num_slots * self.num_heads
+                * self.head_dim * self.resolved_dtype().itemsize)
+
+
+def init_kv_cache(cfg: KVCacheConfig):
+    """Allocate the zeroed pool: ``{"k","v"}`` each
+    (L, num_slots, H, D) in the resolved cache dtype."""
+    shape = (cfg.num_layers, cfg.num_slots, cfg.num_heads, cfg.head_dim)
+    dt = cfg.resolved_dtype()
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+# ---------------------------------------------------------------------------
+# device-side pure functions (jit-traceable, cache pytree in -> out)
+# ---------------------------------------------------------------------------
+
+def slot_index(block_tables, positions, block_size: int):
+    """Flat pool slot of logical ``positions`` — (B,) one per
+    sequence, or (B, S) many per sequence — under ``block_tables``
+    (B, max_blocks): ``table[pos // bs] * bs + pos % bs``.
+    Unallocated table entries are 0, so out-of-range logical positions
+    land in the garbage block."""
+    blk = positions // block_size
+    off = positions % block_size
+    squeeze = blk.ndim == block_tables.ndim - 1
+    if squeeze:
+        blk = blk[..., None]
+    phys = jnp.take_along_axis(block_tables, blk, axis=-1)
+    if squeeze:
+        phys = phys[..., 0]
+    return phys * block_size + off
+
+
+def write_tokens(cache, kvs, slots):
+    """Scatter one new token per sequence into the pool.
+
+    kvs: (L, B, 1, H, D) stacked per-layer (k, v) pairs — i.e. a tuple
+    ``(k_new, v_new)`` of that shape; slots: (B,) flat slot indices."""
+    k_new, v_new = kvs
+    k_new = k_new[:, :, 0].astype(cache["k"].dtype)   # (L, B, H, D)
+    v_new = v_new[:, :, 0].astype(cache["v"].dtype)
+    return {"k": cache["k"].at[:, slots].set(k_new),
+            "v": cache["v"].at[:, slots].set(v_new)}
+
+
+def write_prefill(cache, kvs, slots):
+    """Scatter a whole prompt's K/V into the pool.
+
+    kvs: tuple of (L, B, S, H, D); slots: (B, S) flat slot indices with
+    padded positions pointed at the garbage block by the caller."""
+    k_new, v_new = kvs
+    L = k_new.shape[0]
+    flat = slots.reshape(-1)                          # (B*S,)
+    k2 = k_new.reshape(L, -1, *k_new.shape[3:]).astype(cache["k"].dtype)
+    v2 = v_new.reshape(L, -1, *v_new.shape[3:]).astype(cache["v"].dtype)
+    return {"k": cache["k"].at[:, flat].set(k2),
+            "v": cache["v"].at[:, flat].set(v2)}
+
+
+def gather_context(cache, block_tables, block_size: int, out_dtype=None):
+    """Gather each sequence's logical context from the pool.
+
+    block_tables: (B, max_blocks) int32 (0 = unallocated -> garbage
+    block; masked by the caller's ctx bias).  Returns ``(k_ctx,
+    v_ctx)`` of shape (L, B, max_blocks * block_size, H, D): gathered
+    position j IS logical token j because tables are ordered."""
+    b, mb = block_tables.shape
+    bs = block_size
+    slots = (block_tables[:, :, None] * bs
+             + jnp.arange(bs, dtype=block_tables.dtype)[None, None, :]
+             ).reshape(b, mb * bs)                    # (B, T)
+    k = cache["k"][:, slots]                          # (L, B, T, H, D)
+    v = cache["v"][:, slots]
+    if out_dtype is not None:
+        k = k.astype(out_dtype)
+        v = v.astype(out_dtype)
+    return k, v
+
+
+def context_bias(lengths, max_context: int):
+    """(B,) valid-token counts -> (B, T) additive bias: 0 for logical
+    slots < length, NEG_INF beyond (covers unwritten slots, freed
+    garbage, and the tail of the last partial block)."""
+    t = jnp.arange(max_context, dtype=jnp.int32)[None, :]
+    return jnp.where(t < lengths[:, None].astype(jnp.int32),
+                     0.0, NEG_INF).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# host-side allocator
+# ---------------------------------------------------------------------------
+
+class BlockAllocator:
+    """Free-list over physical blocks 1..num_blocks-1 (0 is the
+    garbage sink and is never handed out).
+
+    LIFO reuse (a stack) keeps hot blocks hot — a freed request's
+    blocks are the most recently touched HBM and the next allocation
+    gets them first."""
+
+    def __init__(self, cfg: KVCacheConfig):
+        self.cfg = cfg
+        self.reset()
+
+    def reset(self):
+        """Return every block to the free list (between workloads;
+        in-place so schedulers holding this allocator stay wired)."""
+        self._free: List[int] = list(range(self.cfg.num_blocks - 1, 0,
+                                           -1))
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        """Pop n blocks; raises :class:`MemoryError` when the pool is
+        exhausted (the scheduler checks :meth:`can_alloc` / preempts
+        first, so reaching this is a caller bug)."""
+        if n <= 0:
+            return []
+        if n > len(self._free):
+            raise MemoryError(
+                f"KV cache pool exhausted: requested {n} blocks, "
+                f"{len(self._free)} free "
+                f"(pool={self.cfg.num_blocks - 1})")
+        out = self._free[-n:][::-1]
+        del self._free[len(self._free) - n:]
+        return out
+
+    def free(self, blocks: List[int]):
+        for blk in blocks:
+            if not 1 <= blk < self.cfg.num_blocks:
+                raise ValueError(f"freeing invalid block id {blk}")
+            if blk in self._free:
+                raise ValueError(f"double free of block {blk}")
+        self._free.extend(blocks)
+
+    @staticmethod
+    def blocks_for(num_tokens: int, block_size: int) -> int:
+        return -(-max(num_tokens, 1) // block_size)

@@ -1,0 +1,221 @@
+"""Continuous-batching request scheduler (Orca-style iteration-level).
+
+The unit of scheduling is one DECODE ITERATION, not one request: every
+iteration the scheduler (1) admits waiting requests into free batch
+slots while the block pool can hold their prompts, (2) grows each
+running request's block table just-in-time for its next token —
+preempting the youngest request back to the waiting queue when the
+pool runs dry — and (3) retires finished requests immediately, so
+their slot and blocks are reusable on the very next iteration.  A
+short request never waits for a long one to finish (the ~10x
+throughput result of iteration-level batching), and memory is
+committed a block at a time instead of worst-case up front.
+
+The scheduler is pure host-side bookkeeping over the engine's
+geometry; it never touches device arrays.  ``serving.api`` composes it
+with the :class:`serving.engine.DecodeEngine` into the step loop.
+
+Preemption = recompute (vLLM's default): the victim's blocks are
+freed, and on re-admission its full sequence so far re-prefills as a
+pseudo-prompt.  The already-sampled tokens are NOT re-sampled — the
+re-prefilled context is ``prompt + generated[:-1]``, its logits are
+discarded, and the pending last token re-enters the decode loop
+unchanged — so generation is bit-stable across preemptions under
+greedy decoding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+from apex_tpu.serving.kv_cache import BlockAllocator
+
+_uid = itertools.count()
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request and its full lifecycle state."""
+
+    prompt: List[int]
+    max_new_tokens: int
+    eos_id: Optional[int] = None
+    uid: int = dataclasses.field(default_factory=lambda: next(_uid))
+
+    # runtime state (owned by the scheduler)
+    generated: List[int] = dataclasses.field(default_factory=list)
+    slot: int = -1                  # decode batch slot; -1 = not running
+    block_table: List[int] = dataclasses.field(default_factory=list)
+    num_cached: int = 0             # tokens with K/V materialized
+    next_input: Optional[int] = None  # pending token for the next decode
+    finished: bool = False
+    finish_reason: Optional[str] = None
+    preemptions: int = 0
+
+    @property
+    def running(self) -> bool:
+        return self.slot >= 0 and not self.finished
+
+    def record_token(self, token: int) -> None:
+        """Account one sampled token and evaluate termination."""
+        self.generated.append(int(token))
+        self.next_input = int(token)
+        if self.eos_id is not None and int(token) == self.eos_id:
+            self.finished = True
+            self.finish_reason = "eos"
+        elif len(self.generated) >= self.max_new_tokens:
+            self.finished = True
+            self.finish_reason = "length"
+
+
+class Scheduler:
+    """Slot + block bookkeeping for continuous batching.
+
+    Args mirror the engine's geometry: ``max_batch_size`` decode
+    slots, ``block_size`` tokens per block, ``max_context`` per
+    request, and the shared :class:`BlockAllocator`."""
+
+    def __init__(self, allocator: BlockAllocator, *,
+                 max_batch_size: int, block_size: int,
+                 max_context: int):
+        self.allocator = allocator
+        self.max_batch_size = max_batch_size
+        self.block_size = block_size
+        self.max_context = max_context
+        self.waiting: Deque[Request] = deque()
+        self.running: Dict[int, Request] = {}      # slot -> request
+        self._free_slots = list(range(max_batch_size - 1, -1, -1))
+        self.finished: List[Request] = []
+        # admission order among running requests — the preemption
+        # victim is always the youngest (LIFO), which converges:
+        # the oldest request monotonically keeps its blocks
+        self._admit_order: List[Request] = []
+
+    # -- submission -------------------------------------------------------
+
+    def submit(self, req: Request) -> Request:
+        if len(req.prompt) == 0:
+            raise ValueError("empty prompt")
+        if len(req.prompt) >= self.max_context:
+            raise ValueError(
+                f"prompt length {len(req.prompt)} must be < "
+                f"max_context {self.max_context}")
+        self.waiting.append(req)
+        return req
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def num_running(self) -> int:
+        return len(self.running)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    # -- iteration-level decisions ---------------------------------------
+
+    def admit(self) -> List[Request]:
+        """Fill free slots from the waiting queue (FIFO) while the
+        pool can hold each candidate's prefill context plus one decode
+        block.  Returns the newly admitted requests, which the caller
+        must prefill before the next decode step."""
+        admitted = []
+        while self.waiting and self._free_slots:
+            req = self.waiting[0]
+            ctx = self._prefill_context(req)
+            need = BlockAllocator.blocks_for(len(ctx) + 1,
+                                             self.block_size)
+            if not self.allocator.can_alloc(need):
+                if not self.running and not admitted:
+                    # nothing holds blocks and the head STILL doesn't
+                    # fit: waiting would spin forever
+                    raise MemoryError(
+                        f"KV pool "
+                        f"({self.allocator.cfg.num_blocks - 1} blocks "
+                        f"x {self.block_size}) cannot hold request "
+                        f"{req.uid}'s {len(ctx)}-token context")
+                break
+            self.waiting.popleft()
+            req.slot = self._free_slots.pop()
+            req.block_table = self.allocator.alloc(need)
+            req.num_cached = 0          # set by the caller post-prefill
+            self.running[req.slot] = req
+            self._admit_order.append(req)
+            admitted.append(req)
+        return admitted
+
+    def _prefill_context(self, req: Request) -> List[int]:
+        """The tokens whose K/V the prefill must materialize: the
+        prompt, plus — after a preemption — every generated token
+        except the pending one (see module docstring)."""
+        if req.generated:
+            return req.prompt + req.generated[:-1]
+        return list(req.prompt)
+
+    def prefill_plan(self, req: Request):
+        """(context_tokens, reuse_last_logits): when the context is
+        the pristine prompt the prefill's logits sample the first
+        token; after preemption they are discarded and the pending
+        ``next_input`` continues instead."""
+        ctx = self._prefill_context(req)
+        return ctx, bool(req.generated)
+
+    def ensure_decode_capacity(self, req: Request) -> bool:
+        """Grow ``req``'s block table if its next token write needs a
+        fresh block, preempting younger requests while the pool is
+        dry.  False = ``req`` itself was preempted (pool too small to
+        keep it running)."""
+        need_blocks = req.num_cached // self.block_size + 1
+        while len(req.block_table) < need_blocks:
+            if self.allocator.can_alloc(1):
+                req.block_table.extend(self.allocator.alloc(1))
+                continue
+            victim = self._youngest_running(exclude=req)
+            if victim is None:
+                # req is alone and the pool is STILL dry — geometry
+                # can't serve even one request; preempting req would
+                # livelock, so fail loudly
+                raise MemoryError(
+                    f"KV pool ({self.allocator.cfg.num_blocks - 1} "
+                    f"blocks x {self.block_size}) cannot hold a single "
+                    f"request at {req.num_cached + 1} tokens")
+            self.preempt(victim)
+            if victim is req:           # defensive; exclude above
+                return False
+        return True
+
+    def _youngest_running(self, exclude: Request) -> Optional[Request]:
+        for req in reversed(self._admit_order):
+            if req is not exclude:
+                return req
+        return None
+
+    def preempt(self, req: Request) -> None:
+        """Evict ``req`` to the waiting queue's FRONT (it has seniority
+        over never-started requests), freeing its slot and blocks."""
+        assert req.running, "can only preempt a running request"
+        req.preemptions += 1
+        self._release(req)
+        req.num_cached = 0
+        self.waiting.appendleft(req)
+
+    def retire(self, req: Request) -> None:
+        """Return a finished request's slot and blocks to the pools."""
+        assert req.finished, "retire() is for finished requests"
+        self._release(req)
+        self.finished.append(req)
+
+    def _release(self, req: Request) -> None:
+        del self.running[req.slot]
+        self._admit_order.remove(req)
+        self._free_slots.append(req.slot)
+        req.slot = -1
+        if req.block_table:
+            self.allocator.free(req.block_table)
+            req.block_table = []

@@ -8,6 +8,8 @@ package of its own): ``AverageMeter`` (reference
 conventions. Here they are first-class:
 
 - :class:`AverageMeter` — running value/average tracker;
+- :class:`RateMeter` / :class:`GaugeMeter` — serving-side tokens/s and
+  queue-depth/occupancy counters (``apex_tpu.serving``);
 - :func:`trace_annotation` / :func:`annotate_function` — xprof trace
   annotations (the TPU analog of nvtx push/pop);
 - :func:`maybe_print` — verbosity- and rank-gated printing;
@@ -17,7 +19,7 @@ conventions. Here they are first-class:
 """
 
 from apex_tpu.amp._amp_state import maybe_print
-from apex_tpu.utils.meters import AverageMeter
+from apex_tpu.utils.meters import AverageMeter, GaugeMeter, RateMeter
 from apex_tpu.utils.profiling import (
     annotate_function,
     trace_annotation,
@@ -29,6 +31,8 @@ from apex_tpu.utils.torch_interop import load_hf_bert, load_torch_resnet
 
 __all__ = [
     "AverageMeter",
+    "GaugeMeter",
+    "RateMeter",
     "annotate_function",
     "checkpoint",
     "load_hf_bert",

@@ -1,6 +1,10 @@
-"""Training-loop meters (reference ``examples/imagenet/main_amp.py:445-460``)."""
+"""Training-loop meters (reference ``examples/imagenet/main_amp.py:445-460``)
+plus the serving-side counters (``apex_tpu.serving``: tokens/s, queue
+depth)."""
 
 from __future__ import annotations
+
+import time
 
 
 class AverageMeter:
@@ -21,3 +25,55 @@ class AverageMeter:
         self.sum += val * n
         self.count += n
         self.avg = self.sum / max(self.count, 1)
+
+
+class RateMeter:
+    """Events per second over wall time — the serving tokens/s meter.
+
+    ``update(n)`` adds n events; ``rate`` is total events / elapsed
+    seconds since construction or :meth:`reset`.  A monotonic clock and
+    a floor on elapsed keep it sane for sub-millisecond smoke runs."""
+
+    def __init__(self, clock=time.perf_counter):
+        self._clock = clock
+        self.reset()
+
+    def reset(self):
+        self.total = 0
+        self._start = self._clock()
+
+    def update(self, n: int = 1):
+        self.total += n
+
+    @property
+    def elapsed(self) -> float:
+        return max(self._clock() - self._start, 1e-9)
+
+    @property
+    def rate(self) -> float:
+        return self.total / self.elapsed
+
+
+class GaugeMeter:
+    """Current / peak / running-mean of a sampled level — the serving
+    queue-depth and running-batch-occupancy meter."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.val = 0.0
+        self.peak = 0.0
+        self.sum = 0.0
+        self.count = 0
+
+    def update(self, val):
+        val = float(val)
+        self.val = val
+        self.peak = max(self.peak, val)
+        self.sum += val
+        self.count += 1
+
+    @property
+    def avg(self) -> float:
+        return self.sum / max(self.count, 1)

@@ -1052,14 +1052,18 @@ def main():
                                                 iters, stem=st,
                                                 adam_layout="tree",
                                                 trace_dir=tree_trace)
-            ab = {"flat": result["value"], "tree": round(ips_t, 1)}
+            # "adopted" starts at "flat" (the already-recorded headline)
+            # so an exception mid-adoption-sequence can't leave an
+            # ambiguous artifact; it flips to "tree" only after the
+            # FULL sequence (O3 re-measure + headline swap) succeeds
+            ab = {"flat": result["value"], "tree": round(ips_t, 1),
+                  "adopted": "flat"}
             extras["adam_layout_full_step"] = ab
             if ips_t <= result["value"]:
-                ab["adopted"] = "flat"
+                pass  # flat stands
             elif time.perf_counter() - START >= BUDGET_S - 120:
                 # tree won but no budget for the like-for-like O3 —
                 # labeled so a budget-skip never reads as a non-win
-                ab["adopted"] = "flat"
                 ab["skip"] = "tree faster but budget too low for the " \
                              "same-layout O3 re-measure"
             else:

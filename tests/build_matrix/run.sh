@@ -61,6 +61,13 @@ EOF
 done
 rm -rf "$tmpdir"
 
+# serving smoke: the inference path's CPU-safe bench — asserts the
+# continuous-batching >= 2x floor over naive decode and token parity
+# between the two (tools/serving_bench.py --smoke, docs/serving.md)
+echo "=== build-matrix axis: serving-smoke ==="
+env JAX_PLATFORMS=cpu python tools/serving_bench.py --smoke --out -
+results[serving]=$?
+
 echo
 echo "=== build-matrix results ==="
 rc=0

@@ -73,6 +73,9 @@ SMOKE = {
     ("test_pipeline.py", "test_forward_matches_sequential[4]"),
     ("test_gpt_pipeline.py",
      "test_pipelined_gpt_forward_matches_monolithic"),
+    ("test_kv_cache.py", "test_write_prefill_then_gather_roundtrip"),
+    ("test_serving_engine.py",
+     "test_cached_decode_matches_full_recompute"),
 }
 
 
@@ -81,6 +84,11 @@ def pytest_configure(config):
         "markers", "smoke: <5-min happy-path tier (one test per "
         "subsystem); the driver gate and TPU watcher run this instead "
         "of the full suite")
+    config.addinivalue_line(
+        "markers", "serving: apex_tpu.serving inference-path tests "
+        "(KV cache, decode engine, continuous-batching scheduler); "
+        "unmarked slow-wise, so they stay in the tier-1 'not slow' "
+        "selection")
 
 
 def pytest_collection_modifyitems(config, items):
