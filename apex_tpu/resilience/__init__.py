@@ -1,0 +1,51 @@
+"""apex_tpu.resilience — fault tolerance for training and serving.
+
+The reference's one robustness mechanism is dynamic loss scaling
+(``apex/amp/scaler.py``: halve on overflow, skip the step, recover) —
+it survives bad *steps*.  This package extends the same
+detect → contain → recover shape to the failures that end *runs*:
+
+- :class:`FaultPlan` / :class:`InjectedCrash` / :class:`TransientIOError`
+  (:mod:`resilience.faults`) — deterministic fault injection
+  (crash-at-step, torn checkpoint writes, transient IO errors), driven
+  by argument or the ``APEX_TPU_FAULTS`` environment variable.  Every
+  recovery guarantee in the tree is proven against these, not against
+  luck.
+- :func:`retry` (:mod:`resilience.retry`) — bounded retry with
+  decorrelated jitter for checkpoint IO.
+- :class:`TrainingSentry` (:mod:`resilience.sentry`) — wraps a jitted
+  train step: periodic crash-consistent checkpoints (via
+  :class:`apex_tpu.utils.checkpoint.CheckpointManager`) and roll-back
+  to the last good checkpoint after a sustained non-finite streak,
+  reusing the loss scaler's own overflow flag as the detector.
+
+The serving-side failure isolation (per-request ``capacity`` /
+``timeout`` / ``rejected`` / ``nonfinite`` finish reasons) lives with
+the scheduler in :mod:`apex_tpu.serving`; ``docs/resilience.md`` is the
+joint map.
+"""
+
+from apex_tpu.resilience.faults import (
+    FaultPlan,
+    InjectedCrash,
+    TransientIOError,
+    resolve_fault_plan,
+)
+from apex_tpu.resilience.retry import RetryError, retry
+from apex_tpu.resilience.sentry import (
+    DivergenceError,
+    TrainingSentry,
+    find_scaler_states,
+)
+
+__all__ = [
+    "DivergenceError",
+    "FaultPlan",
+    "InjectedCrash",
+    "RetryError",
+    "TrainingSentry",
+    "TransientIOError",
+    "find_scaler_states",
+    "resolve_fault_plan",
+    "retry",
+]

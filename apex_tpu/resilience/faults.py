@@ -1,0 +1,146 @@
+"""Deterministic fault injection — the oracle generator for resilience.
+
+A fault-tolerance layer is only as trustworthy as the failures it has
+been proven against, and proofs need failures that are *deterministic*:
+"kill the process at exactly step 7", "truncate the checkpoint written
+at step 4", "fail the next two IO calls" — the same plan replays the
+same way on every run, so crash/resume bit-parity is a testable
+equality, not a flake lottery.
+
+:class:`FaultPlan` is the one injection point.  Production code paths
+(``utils.checkpoint.CheckpointManager``, ``resilience.TrainingSentry``)
+accept a plan as an argument or pick one up from the
+``APEX_TPU_FAULTS`` environment variable (so the build-matrix smoke can
+kill a *subprocess* mid-run without the training script cooperating);
+with no plan configured every hook is a no-op costing one attribute
+check.
+
+Fault vocabulary:
+
+- ``crash_step=N`` + ``crash_kind`` — at the *start* of step N, either
+  ``raise`` :class:`InjectedCrash` (clean unwinding; finally-blocks run)
+  or ``kill`` the process with SIGKILL (nothing runs — the honest
+  model of an OOM-killer or preempted VM).
+- ``torn_write_step=N`` — after the checkpoint for step N *publishes*,
+  truncate its largest payload file to half.  Models post-publish media
+  corruption / a torn sector: the manifest survives, the data does not,
+  and ``restore_latest`` must notice and fall back.
+- ``io_errors=K`` — the next K checkpoint IO operations raise
+  :class:`TransientIOError` (then heal), exercising the
+  :func:`apex_tpu.resilience.retry` path.
+
+Environment syntax (comma-separated ``key=value``)::
+
+    APEX_TPU_FAULTS="crash_step=7,crash_kind=kill,torn_write_step=4"
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+from typing import Optional
+
+ENV_VAR = "APEX_TPU_FAULTS"
+
+
+class InjectedCrash(RuntimeError):
+    """Raised by ``crash_kind='raise'`` — a crash the caller may observe
+    unwinding (unlike SIGKILL, which models the unobservable kind)."""
+
+
+class TransientIOError(OSError):
+    """Injected in place of a real flaky-filesystem error; an
+    :class:`OSError` so production ``retry_on`` filters treat the two
+    identically."""
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """A deterministic schedule of failures (see module docstring).
+
+    Mutable on purpose: ``io_errors`` counts down as faults fire and
+    ``fired`` records what actually happened, so a test can assert the
+    plan was consumed, not just survived."""
+
+    crash_step: Optional[int] = None
+    crash_kind: str = "raise"          # "raise" | "kill"
+    torn_write_step: Optional[int] = None
+    io_errors: int = 0
+    fired: list = dataclasses.field(default_factory=list)
+
+    def __post_init__(self):
+        if self.crash_kind not in ("raise", "kill"):
+            raise ValueError(
+                f"crash_kind must be 'raise' or 'kill', got "
+                f"{self.crash_kind!r}")
+
+    # -- construction -----------------------------------------------------
+
+    @classmethod
+    def from_env(cls, env: Optional[str] = None) -> Optional["FaultPlan"]:
+        """Parse ``APEX_TPU_FAULTS`` (or the given string); None when
+        unset/empty so callers can write ``plan or FaultPlan()``."""
+        spec = os.environ.get(ENV_VAR, "") if env is None else env
+        spec = spec.strip()
+        if not spec:
+            return None
+        kwargs = {}
+        for item in spec.split(","):
+            key, _, value = item.partition("=")
+            key = key.strip()
+            value = value.strip()
+            if key in ("crash_step", "torn_write_step", "io_errors"):
+                kwargs[key] = int(value)
+            elif key == "crash_kind":
+                kwargs[key] = value
+            else:
+                raise ValueError(
+                    f"unknown fault key {key!r} in {ENV_VAR}={spec!r}")
+        return cls(**kwargs)
+
+    # -- hooks (no-ops unless the plan schedules the fault) ---------------
+
+    def tick(self, step: int) -> None:
+        """Called at the start of training step ``step``."""
+        if self.crash_step is not None and step == self.crash_step:
+            self.fired.append(("crash", step, self.crash_kind))
+            if self.crash_kind == "kill":
+                os.kill(os.getpid(), signal.SIGKILL)
+            raise InjectedCrash(f"injected crash at step {step}")
+
+    def io_gate(self, path: str) -> None:
+        """Called before a checkpoint IO operation on ``path``."""
+        if self.io_errors > 0:
+            self.io_errors -= 1
+            self.fired.append(("io_error", path))
+            raise TransientIOError(
+                f"injected transient IO error writing {path} "
+                f"({self.io_errors} left)")
+
+    def maybe_tear(self, ckpt_dir: str, step: int) -> bool:
+        """Called after the checkpoint for ``step`` is published at
+        ``ckpt_dir``; truncates its largest payload file to half.
+        Returns True when a tear happened."""
+        if self.torn_write_step is None or step != self.torn_write_step:
+            return False
+        victim, size = None, -1
+        for root, _, files in os.walk(ckpt_dir):
+            for name in files:
+                p = os.path.join(root, name)
+                s = os.path.getsize(p)
+                if s > size:
+                    victim, size = p, s
+        if victim is None:  # pragma: no cover - empty checkpoint dir
+            return False
+        with open(victim, "rb+") as f:
+            f.truncate(max(size // 2, 1))
+        self.fired.append(("torn_write", victim, step))
+        return True
+
+
+def resolve_fault_plan(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    """Explicit plan wins; else the environment; else None."""
+    if plan is not None:
+        return plan
+    return FaultPlan.from_env()

@@ -1,0 +1,166 @@
+"""TrainingSentry — the loss-scaler's recovery idea, one level up.
+
+Dynamic loss scaling already survives *single* bad steps: overflow is
+detected on device, the optimizer step is branch-free skipped, and the
+scale halves (``apex_tpu/amp/scaler.py``, after the reference
+``apex/amp/scaler.py``).  But a *sustained* non-finite streak — a
+corrupted batch, a diverged run, a poisoned activation — just halves
+the scale to its floor while the model stops learning.  The sentry
+closes that gap: it wraps the jitted train step, reuses the SAME
+overflow flag the scaler already computes (``LossScalerState.overflow``
+— one scalar device->host read per step, the only sync it adds), and
+past ``nonfinite_threshold`` consecutive bad steps rolls the whole
+train state back to the last good checkpoint instead of diverging.
+
+It is also where periodic checkpointing lives: only steps whose
+overflow flag is clean are published (a "last good checkpoint" must be
+*good*), and crash faults from a :class:`FaultPlan` fire at the top of
+the step — which is what the crash/resume bit-parity oracle
+(``tests/L0/test_resilience.py``, ``tools/crash_resume_smoke.py``)
+drives.
+
+Events are surfaced through a :class:`apex_tpu.utils.CounterMeter`:
+``steps``, ``nonfinite_steps``, ``rollbacks``, plus the manager's own
+checkpoint counters when the two share a meter (the default).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+from apex_tpu.amp.scaler import LossScalerState
+from apex_tpu.resilience.faults import FaultPlan, resolve_fault_plan
+from apex_tpu.utils.checkpoint import CheckpointManager
+from apex_tpu.utils.meters import CounterMeter
+
+Pytree = Any
+
+
+class DivergenceError(RuntimeError):
+    """The non-finite streak crossed the threshold and no good
+    checkpoint exists to roll back to."""
+
+
+def find_scaler_states(tree: Pytree) -> List[LossScalerState]:
+    """Every :class:`LossScalerState` reachable through dict / list /
+    tuple / namedtuple containers — the default overflow probe, so the
+    sentry works on any train state that embeds ``AmpOptimizerState``
+    without the caller writing an extractor."""
+    found: List[LossScalerState] = []
+
+    def rec(node):
+        if isinstance(node, LossScalerState):
+            found.append(node)
+        elif isinstance(node, dict):
+            for v in node.values():
+                rec(v)
+        elif isinstance(node, (list, tuple)):  # namedtuples included
+            for v in node:
+                rec(v)
+
+    rec(tree)
+    return found
+
+
+def _default_overflow(state: Pytree) -> bool:
+    scalers = find_scaler_states(state)
+    return any(bool(s.overflow) for s in scalers)
+
+
+class TrainingSentry:
+    """Wrap a jitted train step with crash/divergence recovery.
+
+    Args:
+      step_fn: ``state, *args -> state`` — the jitted step over ONE
+        state pytree (pack params/opt_state/etc. into a dict; the
+        roll-back restores exactly what the checkpoint saved).
+      manager: the :class:`CheckpointManager` to publish to / restore
+        from.
+      checkpoint_every: publish every N *clean* steps (overflow steps
+        never publish).
+      nonfinite_threshold: consecutive overflow steps tolerated before
+        rolling back; the scaler's halving handles anything shorter.
+      overflow_of: ``state -> bool`` probe; defaults to ORing every
+        embedded ``LossScalerState.overflow``.
+      background_save: publish checkpoints on the manager's background
+        thread (snapshot is taken synchronously either way).
+      counters / fault_plan: shared failure accounting and injected
+        faults; both default to the manager's.
+
+    Usage::
+
+        sentry = TrainingSentry(train_step, manager, checkpoint_every=50)
+        state, start = sentry.resume(init_state)
+        for step in range(start, total):
+            state = sentry.step(state, batches[step])
+    """
+
+    def __init__(self, step_fn: Callable, manager: CheckpointManager, *,
+                 checkpoint_every: int = 1,
+                 nonfinite_threshold: int = 3,
+                 overflow_of: Optional[Callable[[Pytree], bool]] = None,
+                 background_save: bool = False,
+                 counters: Optional[CounterMeter] = None,
+                 fault_plan: Optional[FaultPlan] = None):
+        if checkpoint_every < 1:
+            raise ValueError(
+                f"checkpoint_every must be >= 1, got {checkpoint_every}")
+        if nonfinite_threshold < 1:
+            raise ValueError(
+                f"nonfinite_threshold must be >= 1, got "
+                f"{nonfinite_threshold}")
+        self.step_fn = step_fn
+        self.manager = manager
+        self.checkpoint_every = int(checkpoint_every)
+        self.nonfinite_threshold = int(nonfinite_threshold)
+        self.overflow_of = overflow_of or _default_overflow
+        self.background_save = bool(background_save)
+        self.counters = counters if counters is not None \
+            else manager.counters
+        self.fault_plan = resolve_fault_plan(fault_plan) \
+            or manager.fault_plan
+        self.streak = 0           # consecutive non-finite steps
+
+    # -- lifecycle --------------------------------------------------------
+
+    def resume(self, init_state: Pytree) -> tuple:
+        """(state, next_step): the newest good checkpoint restored onto
+        ``init_state``'s structure, or ``(init_state, 0)`` on a fresh
+        run.  ``next_step`` is the first step index still to run."""
+        found = self.manager.restore_latest(target=init_state)
+        if found is None:
+            return init_state, 0
+        state, step = found
+        return state, step + 1
+
+    def step(self, step: int, state: Pytree, *args) -> Pytree:
+        """Run training step ``step``; returns the next state (possibly
+        a rolled-back one — callers must not cache pre-call state)."""
+        if self.fault_plan is not None:
+            self.fault_plan.tick(step)
+        new_state = self.step_fn(state, *args)
+        self.counters.incr("steps")
+        if self.overflow_of(new_state):
+            self.counters.incr("nonfinite_steps")
+            self.streak += 1
+            if self.streak >= self.nonfinite_threshold:
+                return self._roll_back(state)
+            return new_state
+        self.streak = 0
+        if (step + 1) % self.checkpoint_every == 0:
+            self.manager.save(step, new_state,
+                              metadata={"sentry": True},
+                              block=not self.background_save)
+        return new_state
+
+    def _roll_back(self, target: Pytree) -> Pytree:
+        found = self.manager.restore_latest(target=target)
+        if found is None:
+            raise DivergenceError(
+                f"{self.streak} consecutive non-finite steps and no "
+                f"good checkpoint under {self.manager.root} to roll "
+                f"back to")
+        state, step = found
+        self.counters.incr("rollbacks")
+        self.streak = 0
+        return state

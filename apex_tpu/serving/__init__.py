@@ -15,7 +15,11 @@ serve".  Three layers, bottom-up:
 - :mod:`serving.scheduler` / :mod:`serving.api` — Orca-style
   iteration-level continuous batching (admit-on-slot-free, per-request
   EOS/max-token termination, preempt-youngest on memory pressure) and
-  the synchronous :class:`InferenceServer` front door.
+  the synchronous :class:`InferenceServer` front door, with
+  failure isolation: one pathological request finishes alone
+  (``finish_reason`` ``capacity`` / ``timeout`` / ``rejected`` /
+  ``nonfinite``) instead of raising into the batch
+  (``docs/resilience.md``).
 
 Quick start::
 
@@ -37,13 +41,14 @@ from apex_tpu.serving.kv_cache import (
     init_kv_cache,
     resolve_cache_dtype,
 )
-from apex_tpu.serving.scheduler import Request, Scheduler
+from apex_tpu.serving.scheduler import QueueFullError, Request, Scheduler
 
 __all__ = [
     "BlockAllocator",
     "DecodeEngine",
     "InferenceServer",
     "KVCacheConfig",
+    "QueueFullError",
     "Request",
     "Scheduler",
     "default_prefill_buckets",

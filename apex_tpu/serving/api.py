@@ -11,17 +11,30 @@ completion, return N completions) — the shape every test and bench
 needs.  A live service would run :meth:`step` on its event loop and
 stream ``Request.generated`` as it grows; both drive the identical
 scheduler/engine machinery, so the offline numbers transfer.
+
+Failure isolation (``docs/resilience.md``): the step loop never lets
+one pathological request take the batch down.  Per iteration it (1)
+expires per-request deadlines (iteration or wall budget →
+``finish_reason="timeout"``), (2) routes impossible-capacity requests
+— never-fits prompts at admission, pool-outgrowers mid-flight — to
+``finish_reason="capacity"``, and (3) evicts any request whose logits
+went non-finite (``finish_reason="nonfinite"``) before sampling can
+poison the rest of the batch.  A bounded waiting queue rejects at
+submission (``finish_reason="rejected"``).  Every failure is counted
+by reason in a :class:`apex_tpu.utils.CounterMeter` surfaced through
+:meth:`InferenceServer.stats`.
 """
 
 from __future__ import annotations
 
+import time
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
 from apex_tpu.serving.engine import DecodeEngine
-from apex_tpu.serving.scheduler import Request, Scheduler
-from apex_tpu.utils import GaugeMeter, RateMeter
+from apex_tpu.serving.scheduler import QueueFullError, Request, Scheduler
+from apex_tpu.utils import CounterMeter, GaugeMeter, RateMeter
 
 
 def greedy_sample(logits: np.ndarray) -> np.ndarray:
@@ -37,6 +50,11 @@ class InferenceServer:
     Args (beyond :class:`DecodeEngine`'s, which pass through):
       sample_fn: (…, V) numpy logits -> (…,) token ids; default
         greedy.  Runs on host — per-step logits are (B, V).
+      max_waiting: bound on the waiting queue; a submit past it comes
+        back already finished with ``finish_reason="rejected"``
+        (explicit backpressure at the front door).
+      clock: wall-deadline time source (monotonic seconds) —
+        injectable so deadline tests never sleep.
 
     Example::
 
@@ -52,29 +70,50 @@ class InferenceServer:
                  cache_dtype=None,
                  attention_fn=None,
                  prefill_buckets=None,
-                 sample_fn: Optional[Callable] = None):
+                 sample_fn: Optional[Callable] = None,
+                 max_waiting: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic):
         self.engine = DecodeEngine(
             cfg, params, max_batch_size=max_batch_size,
             max_context=max_context, num_blocks=num_blocks,
             block_size=block_size, cache_dtype=cache_dtype,
             attention_fn=attention_fn, prefill_buckets=prefill_buckets)
+        self.failures = CounterMeter()
         self.scheduler = Scheduler(
             self.engine.allocator,
             max_batch_size=self.engine.max_batch_size,
             block_size=self.engine.block_size,
-            max_context=self.engine.max_context)
+            max_context=self.engine.max_context,
+            max_waiting=max_waiting,
+            counters=self.failures)
         self.sample_fn = sample_fn or greedy_sample
+        self.clock = clock
         self.queue_depth = GaugeMeter()
         self.occupancy = GaugeMeter()
         self.tokens = RateMeter()
+        self._iter = 0              # scheduler iterations served
 
     # -- request lifecycle ------------------------------------------------
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
-               eos_id: Optional[int] = None) -> Request:
-        """Enqueue one request.  ``max_new_tokens`` is silently capped
-        so prompt + completion fits ``max_context``."""
+               eos_id: Optional[int] = None, *,
+               deadline_iters: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> Request:
+        """Enqueue one request.
+
+        ``max_new_tokens`` must be >= 1 and a prompt that leaves no
+        room to generate within ``max_context`` is rejected with
+        :class:`ValueError` (never silently capped to a <= 0 budget);
+        a budget that merely overshoots the remaining context is capped
+        down to fit.  When the bounded waiting queue is full the
+        request is returned already finished with
+        ``finish_reason="rejected"`` instead of enqueued.  Optional
+        ``deadline_iters`` / ``deadline_s`` expire the request to
+        ``finish_reason="timeout"``."""
         prompt = [int(t) for t in prompt]
+        if int(max_new_tokens) < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
         cap = self.engine.max_context - len(prompt)
         if cap <= 0:
             raise ValueError(
@@ -82,15 +121,48 @@ class InferenceServer:
                 f"generate within max_context={self.engine.max_context}")
         req = Request(prompt=prompt,
                       max_new_tokens=min(int(max_new_tokens), cap),
-                      eos_id=eos_id)
-        return self.scheduler.submit(req)
+                      eos_id=eos_id,
+                      deadline_iters=deadline_iters,
+                      deadline_s=deadline_s,
+                      submit_iter=self._iter,
+                      submitted_at=self.clock())
+        try:
+            return self.scheduler.submit(req)
+        except QueueFullError:
+            req.finished = True
+            req.finish_reason = "rejected"
+            self.scheduler.finished.append(req)
+            self.failures.incr("requests_failed_rejected")
+            return req
+
+    def _expire_deadlines(self) -> None:
+        """Fail every live request whose iteration or wall budget is
+        spent — waiting requests too, so a queue stall cannot hold a
+        request past its deadline."""
+        sched = self.scheduler
+        now = self.clock()
+        for req in list(sched.waiting) + list(sched.running.values()):
+            if req.finished:
+                continue
+            over_iters = (req.deadline_iters is not None and
+                          self._iter - req.submit_iter
+                          > req.deadline_iters)
+            over_wall = (req.deadline_s is not None and
+                         now - req.submitted_at >= req.deadline_s)
+            if over_iters or over_wall:
+                sched.fail(req, "timeout")
 
     def step(self) -> int:
-        """One continuous-batching iteration: admit + prefill newly
-        schedulable requests, then one decode step across the running
-        batch.  Returns the number of tokens sampled (0 = idle)."""
+        """One continuous-batching iteration: expire deadlines, admit +
+        prefill newly schedulable requests, then one decode step across
+        the running batch.  Returns the number of tokens sampled
+        (0 = idle).  Per-request failures (capacity / timeout /
+        nonfinite) finish the affected request alone — no exception
+        escapes the step loop for them."""
         sched, engine = self.scheduler, self.engine
+        self._iter += 1
         produced = 0
+        self._expire_deadlines()
 
         for req in sched.admit():
             ctx, discard_logits = sched.prefill_plan(req)
@@ -99,7 +171,11 @@ class InferenceServer:
             if discard_logits:
                 # resumed after preemption: the pending token continues
                 continue
-            tok = int(self.sample_fn(np.asarray(logits)))
+            logits = np.asarray(logits)
+            if not np.all(np.isfinite(logits)):
+                sched.fail(req, "nonfinite")
+                continue
+            tok = int(self.sample_fn(logits))
             req.record_token(tok)
             produced += 1
             if req.finished:
@@ -108,7 +184,11 @@ class InferenceServer:
         if sched.running:
             for req in list(sched.running.values()):
                 if req.running:        # an earlier pass may have
-                    sched.ensure_decode_capacity(req)  # preempted it
+                    # preempted it; a False return means the request
+                    # outgrew the pool with no victim left — it fails
+                    # alone instead of raising into the batch
+                    if not sched.ensure_decode_capacity(req):
+                        sched.fail(req, "capacity")
             running = list(sched.running.values())
             if running:
                 b, mb = engine.max_batch_size, engine.blocks_per_seq
@@ -122,8 +202,16 @@ class InferenceServer:
                         req.block_table
                 logits = np.asarray(
                     engine.decode(tokens, positions, tables))
+                # step guard: a row of non-finite logits means this
+                # request's state is poisoned — evict it before its
+                # garbage token enters sampling/termination logic;
+                # every finite row proceeds normally
+                finite_rows = np.all(np.isfinite(logits), axis=-1)
                 toks = self.sample_fn(logits)
                 for req in running:
+                    if not finite_rows[req.slot]:
+                        sched.fail(req, "nonfinite")
+                        continue
                     req.num_cached += 1
                     req.record_token(int(toks[req.slot]))
                     produced += 1
@@ -140,12 +228,25 @@ class InferenceServer:
 
     def generate(self, prompts: Sequence[Sequence[int]],
                  max_new_tokens: int,
-                 eos_id: Optional[int] = None) -> List[List[int]]:
+                 eos_id: Optional[int] = None, *,
+                 deadline_iters: Optional[int] = None,
+                 deadline_s: Optional[float] = None,
+                 return_requests: bool = False):
         """Generate completions for ``prompts`` (token-id lists) and
-        return the generated ids per prompt, in input order."""
-        reqs = [self.submit(p, max_new_tokens, eos_id) for p in prompts]
+        return the generated ids per prompt, in input order.
+
+        A request that fails (capacity / timeout / rejected /
+        nonfinite) contributes whatever it generated before failing —
+        inspect ``finish_reason`` via ``return_requests=True`` to tell
+        a clean completion from an isolated failure."""
+        reqs = [self.submit(p, max_new_tokens, eos_id,
+                            deadline_iters=deadline_iters,
+                            deadline_s=deadline_s)
+                for p in prompts]
         while self.scheduler.has_work:
             self.step()
+        if return_requests:
+            return reqs
         return [list(r.generated) for r in reqs]
 
     def reset_meters(self) -> None:
@@ -171,4 +272,6 @@ class InferenceServer:
             "requests_finished": len(self.scheduler.finished),
             "preemptions": sum(r.preemptions
                                for r in self.scheduler.finished),
+            "requests_failed": self.failures.as_dict(),
+            "requests_failed_total": self.failures.total,
         }

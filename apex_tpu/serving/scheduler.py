@@ -22,6 +22,17 @@ re-prefilled context is ``prompt + generated[:-1]``, its logits are
 discarded, and the pending last token re-enters the decode loop
 unchanged — so generation is bit-stable across preemptions under
 greedy decoding.
+
+Failure isolation: a pathological request fails ALONE.  A request
+whose context can never fit the pool — at admission or by outgrowing
+it mid-flight with no victim left to preempt — is finished with
+``finish_reason="capacity"`` via :meth:`Scheduler.fail` instead of
+raising ``MemoryError`` into the step loop (which killed every
+in-flight request).  A bounded waiting queue (``max_waiting``) rejects
+at submission with :class:`QueueFullError`; expired deadlines and
+non-finite logits are detected by ``serving.api`` and routed through
+the same :meth:`Scheduler.fail` (reasons ``timeout`` / ``nonfinite``).
+``docs/resilience.md`` has the full failure taxonomy.
 """
 
 from __future__ import annotations
@@ -36,6 +47,12 @@ from apex_tpu.serving.kv_cache import BlockAllocator
 _uid = itertools.count()
 
 
+class QueueFullError(RuntimeError):
+    """The bounded waiting queue is at ``max_waiting``; the request was
+    NOT enqueued.  Explicit backpressure beats an unbounded queue whose
+    tail silently times out."""
+
+
 @dataclasses.dataclass
 class Request:
     """One generation request and its full lifecycle state."""
@@ -44,6 +61,15 @@ class Request:
     max_new_tokens: int
     eos_id: Optional[int] = None
     uid: int = dataclasses.field(default_factory=lambda: next(_uid))
+
+    # per-request budgets (None = unbounded).  ``deadline_iters`` is a
+    # count of scheduler iterations from submission; ``deadline_s`` a
+    # wall budget.  Both expire to ``finish_reason="timeout"``, checked
+    # by the step loop (``serving.api``) at the top of each iteration.
+    deadline_iters: Optional[int] = None
+    deadline_s: Optional[float] = None
+    submit_iter: int = 0            # server iteration at submission
+    submitted_at: float = 0.0       # server clock at submission
 
     # runtime state (owned by the scheduler)
     generated: List[int] = dataclasses.field(default_factory=list)
@@ -76,15 +102,24 @@ class Scheduler:
 
     Args mirror the engine's geometry: ``max_batch_size`` decode
     slots, ``block_size`` tokens per block, ``max_context`` per
-    request, and the shared :class:`BlockAllocator`."""
+    request, and the shared :class:`BlockAllocator`.  ``max_waiting``
+    bounds the waiting queue (:class:`QueueFullError` past it);
+    ``counters`` is an optional :class:`apex_tpu.utils.CounterMeter`
+    fed one ``requests_failed_<reason>`` increment per failure."""
 
     def __init__(self, allocator: BlockAllocator, *,
                  max_batch_size: int, block_size: int,
-                 max_context: int):
+                 max_context: int, max_waiting: Optional[int] = None,
+                 counters=None):
         self.allocator = allocator
         self.max_batch_size = max_batch_size
         self.block_size = block_size
         self.max_context = max_context
+        if max_waiting is not None and max_waiting < 1:
+            raise ValueError(
+                f"max_waiting must be >= 1, got {max_waiting}")
+        self.max_waiting = max_waiting
+        self.counters = counters
         self.waiting: Deque[Request] = deque()
         self.running: Dict[int, Request] = {}      # slot -> request
         self._free_slots = list(range(max_batch_size - 1, -1, -1))
@@ -99,10 +134,19 @@ class Scheduler:
     def submit(self, req: Request) -> Request:
         if len(req.prompt) == 0:
             raise ValueError("empty prompt")
+        if req.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got "
+                f"{req.max_new_tokens}")
         if len(req.prompt) >= self.max_context:
             raise ValueError(
                 f"prompt length {len(req.prompt)} must be < "
                 f"max_context {self.max_context}")
+        if self.max_waiting is not None \
+                and len(self.waiting) >= self.max_waiting:
+            raise QueueFullError(
+                f"waiting queue full ({self.max_waiting} requests); "
+                f"request {req.uid} rejected")
         self.waiting.append(req)
         return req
 
@@ -124,23 +168,25 @@ class Scheduler:
         """Fill free slots from the waiting queue (FIFO) while the
         pool can hold each candidate's prefill context plus one decode
         block.  Returns the newly admitted requests, which the caller
-        must prefill before the next decode step."""
+        must prefill before the next decode step.
+
+        A head request whose context can NEVER fit — it needs more
+        blocks than the whole pool owns — is failed alone with
+        ``finish_reason="capacity"`` and admission moves on to the
+        next waiting request; one oversized request must not raise
+        into the step loop or wedge the queue behind it."""
         admitted = []
+        pool_blocks = self.allocator.cfg.num_blocks - 1
         while self.waiting and self._free_slots:
             req = self.waiting[0]
             ctx = self._prefill_context(req)
             need = BlockAllocator.blocks_for(len(ctx) + 1,
                                              self.block_size)
+            if need > pool_blocks:
+                self.fail(req, "capacity")
+                continue
             if not self.allocator.can_alloc(need):
-                if not self.running and not admitted:
-                    # nothing holds blocks and the head STILL doesn't
-                    # fit: waiting would spin forever
-                    raise MemoryError(
-                        f"KV pool "
-                        f"({self.allocator.cfg.num_blocks - 1} blocks "
-                        f"x {self.block_size}) cannot hold request "
-                        f"{req.uid}'s {len(ctx)}-token context")
-                break
+                break               # fits once running requests retire
             self.waiting.popleft()
             req.slot = self._free_slots.pop()
             req.block_table = self.allocator.alloc(need)
@@ -169,8 +215,10 @@ class Scheduler:
     def ensure_decode_capacity(self, req: Request) -> bool:
         """Grow ``req``'s block table if its next token write needs a
         fresh block, preempting younger requests while the pool is
-        dry.  False = ``req`` itself was preempted (pool too small to
-        keep it running)."""
+        dry.  False = ``req`` has outgrown the pool with nothing left
+        to preempt (it is alone and the pool is STILL dry); the caller
+        must fail it with ``finish_reason="capacity"`` — preempting it
+        would livelock, and raising would take the whole batch down."""
         need_blocks = req.num_cached // self.block_size + 1
         while len(req.block_table) < need_blocks:
             if self.allocator.can_alloc(1):
@@ -178,16 +226,8 @@ class Scheduler:
                 continue
             victim = self._youngest_running(exclude=req)
             if victim is None:
-                # req is alone and the pool is STILL dry — geometry
-                # can't serve even one request; preempting req would
-                # livelock, so fail loudly
-                raise MemoryError(
-                    f"KV pool ({self.allocator.cfg.num_blocks - 1} "
-                    f"blocks x {self.block_size}) cannot hold a single "
-                    f"request at {req.num_cached + 1} tokens")
-            self.preempt(victim)
-            if victim is req:           # defensive; exclude above
                 return False
+            self.preempt(victim)
         return True
 
     def _youngest_running(self, exclude: Request) -> Optional[Request]:
@@ -210,6 +250,23 @@ class Scheduler:
         assert req.finished, "retire() is for finished requests"
         self._release(req)
         self.finished.append(req)
+
+    def fail(self, req: Request, reason: str) -> None:
+        """Finish ``req`` with ``finish_reason=reason`` wherever it is
+        in its lifecycle (waiting or running), returning any held slot
+        and blocks — the single exit for ``capacity`` / ``timeout`` /
+        ``nonfinite`` isolation.  Tokens generated so far stay on the
+        request (a timed-out request returns its partial output)."""
+        assert not req.finished, "fail() is for live requests"
+        if req.running:
+            self._release(req)
+        elif req in self.waiting:
+            self.waiting.remove(req)
+        req.finished = True
+        req.finish_reason = reason
+        self.finished.append(req)
+        if self.counters is not None:
+            self.counters.incr(f"requests_failed_{reason}")
 
     def _release(self, req: Request) -> None:
         del self.running[req.slot]

@@ -10,16 +10,28 @@ conventions. Here they are first-class:
 - :class:`AverageMeter` — running value/average tracker;
 - :class:`RateMeter` / :class:`GaugeMeter` — serving-side tokens/s and
   queue-depth/occupancy counters (``apex_tpu.serving``);
+- :class:`CounterMeter` — monotonic named counters for failure
+  accounting (checkpoints written/skipped-corrupt, IO retries, sentry
+  rollbacks, serving requests failed by reason —
+  ``apex_tpu.resilience``, ``docs/resilience.md``);
 - :func:`trace_annotation` / :func:`annotate_function` — xprof trace
   annotations (the TPU analog of nvtx push/pop);
 - :func:`maybe_print` — verbosity- and rank-gated printing;
 - :mod:`apex_tpu.utils.checkpoint` — one-call save/restore of a full
   train-state pytree including amp loss-scaler state (fixes the
-  reference's amp-state checkpoint gap, SURVEY.md §5).
+  reference's amp-state checkpoint gap, SURVEY.md §5), plus the
+  crash-consistent :class:`~apex_tpu.utils.checkpoint.CheckpointManager`
+  (atomic publish, checksummed manifest, retention, corrupt-fallback
+  restore).
 """
 
 from apex_tpu.amp._amp_state import maybe_print
-from apex_tpu.utils.meters import AverageMeter, GaugeMeter, RateMeter
+from apex_tpu.utils.meters import (
+    AverageMeter,
+    CounterMeter,
+    GaugeMeter,
+    RateMeter,
+)
 from apex_tpu.utils.profiling import (
     annotate_function,
     trace_annotation,
@@ -31,6 +43,7 @@ from apex_tpu.utils.torch_interop import load_hf_bert, load_torch_resnet
 
 __all__ = [
     "AverageMeter",
+    "CounterMeter",
     "GaugeMeter",
     "RateMeter",
     "annotate_function",

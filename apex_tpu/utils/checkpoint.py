@@ -9,27 +9,34 @@ on resume (SURVEY.md §5). Here the whole train state — params,
 batch_stats, optimizer state *including* ``AmpOptimizerState`` with its
 loss-scaler pytrees — is one pytree and checkpointing is one call.
 
-Backend: orbax-checkpoint when importable (async-capable, multi-host
-aware), else a numpy ``.npz`` + structure-pickle fallback with the same
-API. Restore always takes a ``target`` pytree so namedtuple/custom-node
-structure (AmpOptimizerState, optax states) round-trips exactly.
+Two layers:
 
-.. caution:: The npz fallback pickles the *treedef* alongside the arrays.
-   Pickled treedefs reference the defining classes by module path, so a
-   fallback checkpoint is NOT portable across jax/optax/apex_tpu version
-   bumps that move or rename state classes (orbax checkpoints restore
-   structurally via ``target`` and don't have this problem). Treat npz
-   checkpoints as same-environment restart artifacts; for archival or
-   cross-version checkpoints, install orbax. On version-mismatch
-   ``restore`` raises the underlying unpickling error rather than
-   guessing.
+- :func:`save` / :func:`restore` — one-shot pytree IO to a directory.
+  Backend: orbax-checkpoint when importable (async-capable, multi-host
+  aware), else a numpy ``.npz`` + structure-pickle fallback with the
+  same API. Restore always takes a ``target`` pytree so
+  namedtuple/custom-node structure (AmpOptimizerState, optax states)
+  round-trips exactly.  (The npz fallback's treedef pickle is NOT
+  portable across library version bumps — see ``docs/resilience.md``
+  for the full caution and when to prefer orbax.)
+- :class:`CheckpointManager` — crash-consistent step-numbered
+  checkpoints on top of the same backends: atomic publish
+  (write-to-tmp → fsync → rename), a manifest with per-leaf checksums,
+  retention, corrupt-checkpoint fallback on restore, and optional
+  background-thread saves.  ``docs/resilience.md`` documents the
+  on-disk layout and the fault-injection recipes that prove it.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import pickle
-from typing import Any, Optional
+import shutil
+import threading
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -41,10 +48,13 @@ try:  # pragma: no cover - environment probe
 except Exception:  # pragma: no cover
     _ocp = None
 
+_NPZ_FILE = "train_state.npz"
+_TREEDEF_FILE = "treedef.pkl"
+
 
 def _is_orbax_dir(path: str) -> bool:
     return os.path.isdir(path) and not os.path.exists(
-        os.path.join(path, "train_state.npz"))
+        os.path.join(path, _NPZ_FILE))
 
 
 def save(path: str, state: Pytree, *, force: bool = True) -> None:
@@ -57,9 +67,9 @@ def save(path: str, state: Pytree, *, force: bool = True) -> None:
         return
     os.makedirs(path, exist_ok=True)
     leaves, treedef = jax.tree_util.tree_flatten(state)
-    np.savez(os.path.join(path, "train_state.npz"),
+    np.savez(os.path.join(path, _NPZ_FILE),
              **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)})
-    with open(os.path.join(path, "treedef.pkl"), "wb") as f:
+    with open(os.path.join(path, _TREEDEF_FILE), "wb") as f:
         pickle.dump(treedef, f)
 
 
@@ -78,9 +88,19 @@ def restore(path: str, target: Optional[Pytree] = None) -> Pytree:
         else:
             restored = ckptr.restore(path)
         return restored
-    with open(os.path.join(path, "treedef.pkl"), "rb") as f:
+    treedef_path = os.path.join(path, _TREEDEF_FILE)
+    if not os.path.exists(treedef_path) and _is_orbax_dir(path):
+        # backend mismatch, named plainly instead of a raw unpickling /
+        # missing-file error: the directory has no npz payload, so it
+        # was written by the orbax backend, and orbax is not importable
+        # here to read it back.
+        raise ValueError(
+            f"checkpoint at {path} was written by the other backend "
+            f"(orbax), but orbax-checkpoint is not importable in this "
+            f"environment; install orbax-checkpoint to restore it")
+    with open(treedef_path, "rb") as f:
         treedef = pickle.load(f)
-    with np.load(os.path.join(path, "train_state.npz")) as z:
+    with np.load(os.path.join(path, _NPZ_FILE)) as z:
         leaves = [z[f"leaf_{i}"] for i in range(len(z.files))]
     state = jax.tree_util.tree_unflatten(treedef, leaves)
     if target is not None:
@@ -89,7 +109,299 @@ def restore(path: str, target: Optional[Pytree] = None) -> Pytree:
         s_leaves = jax.tree_util.tree_leaves(state)
         if len(t_leaves) != len(s_leaves):
             raise ValueError(
-                f"checkpoint has {len(s_leaves)} leaves; target expects "
-                f"{len(t_leaves)}")
+                f"checkpoint at {path} has {len(s_leaves)} leaves; "
+                f"target expects {len(t_leaves)}")
         state = jax.tree_util.tree_unflatten(t_def, s_leaves)
     return state
+
+
+# -- crash-consistent manager ---------------------------------------------
+
+MANIFEST_FILE = "manifest.json"
+MANIFEST_FORMAT = 1
+_STEP_PREFIX = "step_"
+_STEP_DIGITS = 8
+_TMP_PREFIX = ".tmp-"
+_PAYLOAD_DIR = "state"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A published checkpoint failed integrity verification (missing or
+    malformed manifest, leaf-count mismatch, or checksum mismatch)."""
+
+
+def leaf_checksum(leaf) -> str:
+    """``crc32:dtype:shape`` fingerprint of one pytree leaf.  Covers
+    value bytes AND geometry, so a silently re-shaped or down-cast leaf
+    fails verification even when its bytes collide."""
+    a = np.ascontiguousarray(np.asarray(leaf))
+    crc = zlib.crc32(a.tobytes()) & 0xFFFFFFFF
+    return f"{crc:08x}:{a.dtype.str}:{'x'.join(map(str, a.shape))}"
+
+
+def _fsync_path(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_tree(root: str) -> None:
+    """fsync every file and directory under ``root`` (bottom-up) so the
+    rename that follows publishes fully durable bytes."""
+    for dirpath, _, filenames in os.walk(root, topdown=False):
+        for name in filenames:
+            _fsync_path(os.path.join(dirpath, name))
+        _fsync_path(dirpath)
+
+
+def step_dir_name(step: int) -> str:
+    return f"{_STEP_PREFIX}{step:0{_STEP_DIGITS}d}"
+
+
+class CheckpointManager:
+    """Step-numbered, crash-consistent checkpoints with retention.
+
+    On-disk layout (see ``docs/resilience.md``)::
+
+        root/
+          step_00000007/
+            manifest.json          # step, backend, per-leaf checksums
+            state/                 # backend payload (orbax or npz)
+
+    Guarantees:
+
+    - **Atomic publish** — a checkpoint is written to a dot-tmp sibling,
+      fsynced file-by-file, then ``os.rename``d into place (atomic on
+      POSIX) and the root directory fsynced.  A crash at ANY point
+      leaves either the complete previous state or the complete new one;
+      stale tmp dirs are swept on the next save.
+    - **Verified restore** — :meth:`restore_latest` recomputes every
+      leaf's checksum against the manifest and silently falls back past
+      a partial/corrupt checkpoint to the newest good one (accounted in
+      ``counters['checkpoints_skipped_corrupt']``).
+    - **Retention** — ``keep_last=N`` bounds disk; ``keep_every=K``
+      additionally pins every K-th step (milestones survive the sweep).
+    - **Transient-IO tolerance** — payload writes run under
+      :func:`apex_tpu.resilience.retry` with decorrelated jitter.
+
+    ``save(..., block=False)`` snapshots the state to host and writes on
+    a background thread; :meth:`wait` joins and re-raises.  Fault hooks
+    (:class:`apex_tpu.resilience.FaultPlan`) are taken from the
+    ``fault_plan`` argument or the ``APEX_TPU_FAULTS`` environment.
+    """
+
+    def __init__(self, root: str, *,
+                 keep_last: Optional[int] = None,
+                 keep_every: Optional[int] = None,
+                 retry_attempts: int = 4,
+                 retry_backoff: float = 0.05,
+                 retry_deadline: Optional[float] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 counters=None,
+                 fault_plan=None):
+        from apex_tpu.resilience.faults import resolve_fault_plan
+        from apex_tpu.utils.meters import CounterMeter
+
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        if keep_last is not None and keep_last < 1:
+            raise ValueError(f"keep_last must be >= 1, got {keep_last}")
+        self.keep_last = keep_last
+        self.keep_every = keep_every
+        self.retry_attempts = int(retry_attempts)
+        self.retry_backoff = float(retry_backoff)
+        self.retry_deadline = retry_deadline
+        self._sleep = sleep
+        self.counters = counters if counters is not None else CounterMeter()
+        self.fault_plan = resolve_fault_plan(fault_plan)
+        self._thread: Optional[threading.Thread] = None
+        self._thread_error: Optional[BaseException] = None
+
+    # -- directory bookkeeping -------------------------------------------
+
+    def steps(self) -> List[int]:
+        """Published step numbers, ascending (tmp dirs excluded)."""
+        self.wait()
+        out = []
+        for name in os.listdir(self.root):
+            if not name.startswith(_STEP_PREFIX):
+                continue
+            try:
+                step = int(name[len(_STEP_PREFIX):])
+            except ValueError:
+                continue
+            if os.path.exists(os.path.join(self.root, name, MANIFEST_FILE)):
+                out.append(step)
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def _dir(self, step: int) -> str:
+        return os.path.join(self.root, step_dir_name(step))
+
+    def read_manifest(self, step: int) -> Dict[str, Any]:
+        with open(os.path.join(self._dir(step), MANIFEST_FILE)) as f:
+            return json.load(f)
+
+    # -- save -------------------------------------------------------------
+
+    def save(self, step: int, state: Pytree,
+             metadata: Optional[Dict[str, Any]] = None, *,
+             block: bool = True) -> None:
+        """Publish ``state`` as the checkpoint for ``step``.
+
+        ``block=False`` returns after snapshotting ``state`` to host
+        memory (so the caller may mutate/donate device buffers freely)
+        and publishes on a background thread; the next manager call —
+        or an explicit :meth:`wait` — joins it and re-raises any
+        failure.  Saves are serialized: at most one is in flight."""
+        self.wait()
+        snapshot = jax.device_get(state)
+        if not block:
+            self._thread = threading.Thread(
+                target=self._save_guarded, args=(step, snapshot, metadata),
+                name=f"ckpt-save-{step}", daemon=True)
+            self._thread.start()
+            return
+        self._save_sync(step, snapshot, metadata)
+
+    def _save_guarded(self, step, snapshot, metadata):
+        try:
+            self._save_sync(step, snapshot, metadata)
+        except BaseException as err:  # surfaced by wait()
+            self._thread_error = err
+
+    def _save_sync(self, step: int, snapshot: Pytree,
+                   metadata: Optional[Dict[str, Any]]) -> None:
+        from apex_tpu.resilience.retry import retry
+
+        final = self._dir(step)
+        tmp = os.path.join(self.root,
+                           _TMP_PREFIX + step_dir_name(step))
+        self._sweep_tmp()
+
+        leaves = jax.tree_util.tree_leaves(snapshot)
+        manifest = {
+            "format": MANIFEST_FORMAT,
+            "step": int(step),
+            "backend": "orbax" if _ocp is not None else "npz",
+            "num_leaves": len(leaves),
+            "leaf_checksums": [leaf_checksum(x) for x in leaves],
+            "metadata": metadata or {},
+            "written_unix": time.time(),
+        }
+
+        def write_tmp():
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp)
+            os.makedirs(tmp)
+            if self.fault_plan is not None:
+                self.fault_plan.io_gate(tmp)
+            save(os.path.join(tmp, _PAYLOAD_DIR), snapshot)
+            with open(os.path.join(tmp, MANIFEST_FILE), "w") as f:
+                json.dump(manifest, f, indent=1)
+            _fsync_tree(tmp)
+
+        retry(write_tmp,
+              attempts=self.retry_attempts,
+              backoff=self.retry_backoff,
+              deadline=self.retry_deadline,
+              sleep=self._sleep,
+              on_retry=lambda i, e: self.counters.incr(
+                  "checkpoint_retries"))
+
+        if os.path.exists(final):   # re-save of the same step: replace
+            shutil.rmtree(final)
+        os.rename(tmp, final)       # the publish point (atomic, POSIX)
+        _fsync_path(self.root)
+        self.counters.incr("checkpoints_written")
+        if self.fault_plan is not None:
+            self.fault_plan.maybe_tear(final, step)
+        self._apply_retention()
+
+    def wait(self) -> None:
+        """Join an in-flight background save; re-raise its failure."""
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join()
+        err, self._thread_error = self._thread_error, None
+        if err is not None:
+            raise err
+
+    def _sweep_tmp(self) -> None:
+        for name in os.listdir(self.root):
+            if name.startswith(_TMP_PREFIX):
+                shutil.rmtree(os.path.join(self.root, name),
+                              ignore_errors=True)
+
+    def _apply_retention(self) -> None:
+        if self.keep_last is None:
+            return
+        steps = []
+        for name in os.listdir(self.root):
+            if name.startswith(_STEP_PREFIX):
+                try:
+                    steps.append(int(name[len(_STEP_PREFIX):]))
+                except ValueError:
+                    continue
+        steps.sort()
+        protected = set(steps[-self.keep_last:])
+        if self.keep_every:
+            protected |= {s for s in steps if s % self.keep_every == 0}
+        for s in steps:
+            if s not in protected:
+                shutil.rmtree(self._dir(s), ignore_errors=True)
+                self.counters.incr("checkpoints_retired")
+
+    # -- restore ----------------------------------------------------------
+
+    def restore(self, step: int,
+                target: Optional[Pytree] = None) -> Pytree:
+        """Restore step ``step``, verifying the manifest's leaf count
+        and per-leaf checksums; :class:`CheckpointCorruptError` on any
+        integrity failure."""
+        self.wait()
+        ckpt_dir = self._dir(step)
+        manifest_path = os.path.join(ckpt_dir, MANIFEST_FILE)
+        try:
+            with open(manifest_path) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as err:
+            raise CheckpointCorruptError(
+                f"{ckpt_dir}: unreadable manifest ({err})") from err
+        state = restore(os.path.join(ckpt_dir, _PAYLOAD_DIR), target)
+        leaves = jax.tree_util.tree_leaves(state)
+        if len(leaves) != manifest["num_leaves"]:
+            raise CheckpointCorruptError(
+                f"{ckpt_dir}: {len(leaves)} leaves restored, manifest "
+                f"records {manifest['num_leaves']}")
+        for i, (leaf, want) in enumerate(
+                zip(leaves, manifest["leaf_checksums"])):
+            got = leaf_checksum(leaf)
+            if got != want:
+                raise CheckpointCorruptError(
+                    f"{ckpt_dir}: leaf {i} checksum mismatch "
+                    f"(manifest {want}, restored {got})")
+        return state
+
+    def restore_latest(self, target: Optional[Pytree] = None,
+                       ) -> Optional[Tuple[Pytree, int]]:
+        """(state, step) from the newest checkpoint that passes
+        verification, scanning backwards past corrupt/partial ones;
+        None when no checkpoint restores."""
+        for step in reversed(self.steps()):
+            try:
+                return self.restore(step, target), step
+            except KeyboardInterrupt:   # pragma: no cover
+                raise
+            except Exception:
+                # corrupt manifest/payload, truncated file, backend
+                # error — all mean "this checkpoint is not a safe
+                # restore point"; fall back to the previous one
+                self.counters.incr("checkpoints_skipped_corrupt")
+                continue
+        return None

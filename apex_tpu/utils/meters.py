@@ -54,6 +54,41 @@ class RateMeter:
         return self.total / self.elapsed
 
 
+class CounterMeter:
+    """Monotonic named counters — the failure-accounting meter
+    (checkpoints written / skipped-corrupt, IO retries, sentry
+    rollbacks, serving requests failed by reason).
+
+    ``incr(key)`` only ever counts up (negative increments are a bug in
+    the caller and raise), so a snapshot taken later always dominates
+    one taken earlier — the property log scrapers and the bench harness
+    rely on when they diff two readings."""
+
+    def __init__(self):
+        self._counts = {}
+
+    def incr(self, key: str, n: int = 1) -> int:
+        if n < 0:
+            raise ValueError(f"CounterMeter is monotonic; incr({key!r}, "
+                             f"{n}) would decrease it")
+        self._counts[key] = self._counts.get(key, 0) + n
+        return self._counts[key]
+
+    def count(self, key: str) -> int:
+        return self._counts.get(key, 0)
+
+    def __getitem__(self, key: str) -> int:
+        return self.count(key)
+
+    @property
+    def total(self) -> int:
+        return sum(self._counts.values())
+
+    def as_dict(self) -> dict:
+        """Stable-ordered snapshot for logs/stats."""
+        return {k: self._counts[k] for k in sorted(self._counts)}
+
+
 class GaugeMeter:
     """Current / peak / running-mean of a sampled level — the serving
     queue-depth and running-batch-occupancy meter."""

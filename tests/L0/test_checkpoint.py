@@ -95,3 +95,33 @@ def test_structure_mismatch_raises(tmp_path):
     with pytest.raises(ValueError):
         checkpoint.restore(str(tmp_path / "c"),
                            {"params": params, "extra": opt_state})
+
+
+def test_npz_leaf_count_mismatch_names_path_and_counts(
+        tmp_path, monkeypatch):
+    """The npz fallback's mismatch error must carry everything needed
+    to debug it remotely: the checkpoint path and BOTH leaf counts."""
+    monkeypatch.setattr(checkpoint, "_ocp", None)   # force npz backend
+    path = str(tmp_path / "c")
+    checkpoint.save(path, {"a": np.ones(3), "b": np.zeros(2)})
+    target = {"a": np.ones(3), "b": np.zeros(2), "c": np.zeros(1)}
+    with pytest.raises(ValueError) as exc:
+        checkpoint.restore(path, target)
+    msg = str(exc.value)
+    assert path in msg
+    assert "2 leaves" in msg and "3" in msg
+
+
+def test_orbax_checkpoint_without_orbax_names_backend(
+        tmp_path, monkeypatch):
+    """Restoring an orbax-written checkpoint through the npz fallback
+    must say 'written by the other backend', not leak a raw
+    unpickling/missing-file error."""
+    path = tmp_path / "c"
+    path.mkdir()
+    # minimal orbax-shaped directory: payload files, no npz marker
+    (path / "checkpoint").write_bytes(b"\x93ORBAX")
+    monkeypatch.setattr(checkpoint, "_ocp", None)   # orbax "missing"
+    with pytest.raises(ValueError,
+                       match="written by the other backend"):
+        checkpoint.restore(str(path))

@@ -59,6 +59,34 @@ def test_min_loss_scale_floor():
     assert float(st.loss_scale) == 1.0
 
 
+def test_sustained_nonfinite_streak_clamps_then_recovers():
+    """A long streak of non-finite grads must clamp the scale at
+    ``min_loss_scale`` — never zero, never below the floor — and the
+    dynamic machinery must still double back up once grads are finite
+    again (the survive-don't-diverge contract the resilience sentry
+    builds on, docs/resilience.md)."""
+    s = LossScaler("dynamic", init_scale=2.0 ** 6, scale_window=2,
+                   min_loss_scale=4.0)
+    st = s.init()
+    seen = []
+    for _ in range(20):                 # streak far past log2(64/4)
+        _, overflow = s.unscale(grads(bad=jnp.nan), st)
+        assert bool(overflow)
+        st = s.update(st, overflow)
+        seen.append(float(st.loss_scale))
+    assert seen[:5] == [32.0, 16.0, 8.0, 4.0, 4.0]  # halve, then clamp
+    assert all(x >= 4.0 for x in seen)              # floor holds
+    assert float(st.loss_scale) == 4.0
+    assert int(st.unskipped) == 0       # window reset by every overflow
+    # recovery: finite grads again -> doubles every scale_window steps
+    for _ in range(4):
+        g, overflow = s.unscale(grads(fill=2.0), st)
+        assert not bool(overflow)
+        st = s.update(st, overflow)
+    assert float(st.loss_scale) == 16.0             # 4 -> 8 -> 16
+    assert not bool(st.overflow)
+
+
 def test_scale_unscale_roundtrip():
     s = LossScaler("dynamic", init_scale=4.0)
     st = s.init()

@@ -1,0 +1,406 @@
+"""Fault-tolerance oracle: crash/resume bit-parity + verified restore.
+
+The headline test is the end-to-end equality the ROADMAP north star
+demands: a training run killed at an arbitrary step, resumed from the
+newest good checkpoint, must reproduce the uninterrupted run's final
+params, optimizer state, AND loss-scaler state *bit-identically* — the
+same parity bar the serving preemption path already meets
+(``test_serving_engine.py::test_preemption_is_bit_stable``).  Every
+failure here is injected deterministically through
+:class:`apex_tpu.resilience.FaultPlan`, never simulated by luck.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.amp.scaler import LossScaler, LossScalerState
+from apex_tpu.models import MLP
+from apex_tpu.resilience import (
+    DivergenceError,
+    FaultPlan,
+    InjectedCrash,
+    RetryError,
+    TrainingSentry,
+    TransientIOError,
+    find_scaler_states,
+    retry,
+)
+from apex_tpu.utils import CounterMeter
+from apex_tpu.utils.checkpoint import (
+    CheckpointCorruptError,
+    CheckpointManager,
+    leaf_checksum,
+)
+
+TOTAL_STEPS = 12
+
+
+def _no_sleep(_):
+    pass
+
+
+@pytest.fixture(scope="module")
+def train():
+    """One amp train setup shared by every crash/resume run: the runs
+    differ only in checkpoint root and injected faults, so the jitted
+    step compiles once."""
+    model, optimizer = amp.initialize(
+        MLP(features=(16,)), optax.sgd(0.1), opt_level="O2", verbosity=0)
+    params = model.init(jax.random.PRNGKey(0), jnp.ones((1, 8)))
+    opt_state = optimizer.init(params)
+    init_state = {"params": params, "opt": opt_state}
+
+    @jax.jit
+    def step_fn(state, batch):
+        x, y = batch
+
+        def loss_fn(p):
+            logits = model.apply(p, x).astype(jnp.float32)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+            with amp.scale_loss(loss, state["opt"]) as scaled:
+                return scaled
+        grads = jax.grad(loss_fn)(state["params"])
+        new_params, new_opt = optimizer.step(state["params"], grads,
+                                             state["opt"])
+        return {"params": new_params, "opt": new_opt}
+
+    def batch(i):
+        x = jax.random.normal(jax.random.PRNGKey(100 + i), (4, 8))
+        y = jnp.arange(4) % 10
+        return x, y
+
+    return init_state, step_fn, batch
+
+
+def _run(train, root, *, total=TOTAL_STEPS, checkpoint_every=2,
+         fault_plan=None):
+    """Drive the sentry from a fresh resume() to ``total`` steps."""
+    init_state, step_fn, batch = train
+    mgr = CheckpointManager(root, sleep=_no_sleep, fault_plan=fault_plan)
+    sentry = TrainingSentry(step_fn, mgr,
+                            checkpoint_every=checkpoint_every,
+                            fault_plan=fault_plan)
+    state, start = sentry.resume(init_state)
+    for i in range(start, total):
+        state = sentry.step(i, state, batch(i))
+    return state, mgr
+
+
+def _leaves_bitwise_equal(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for xa, xb in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+
+
+# -- headline: crash/resume bit-parity ------------------------------------
+
+@pytest.mark.parametrize("crash_step", [2, 5, 9])
+def test_crash_resume_bit_parity(train, tmp_path, crash_step):
+    """Kill (raise) at step k, resume from the newest checkpoint,
+    finish — final params/opt/scaler state bit-identical with the
+    uninterrupted run, for several k straddling checkpoint boundaries."""
+    reference, _ = _run(train, str(tmp_path / "ref"))
+
+    root = str(tmp_path / f"crash{crash_step}")
+    with pytest.raises(InjectedCrash):
+        _run(train, root, fault_plan=FaultPlan(crash_step=crash_step))
+    # "new process": fresh sentry + manager over the same root
+    resumed, mgr = _run(train, root)
+    _leaves_bitwise_equal(reference, resumed)
+    # the loss-scaler state specifically (the reference's missing piece)
+    ref_sc = find_scaler_states(reference)
+    res_sc = find_scaler_states(resumed)
+    assert ref_sc and len(ref_sc) == len(res_sc)
+    for a, b in zip(ref_sc, res_sc):
+        assert float(a.loss_scale) == float(b.loss_scale)
+        assert int(a.unskipped) == int(b.unskipped)
+
+
+def test_crash_before_first_checkpoint_restarts_cleanly(train, tmp_path):
+    """A crash before anything published resumes from step 0 and still
+    reaches parity."""
+    reference, _ = _run(train, str(tmp_path / "ref"))
+    root = str(tmp_path / "early")
+    with pytest.raises(InjectedCrash):
+        _run(train, root, fault_plan=FaultPlan(crash_step=1))
+    resumed, _ = _run(train, root)
+    _leaves_bitwise_equal(reference, resumed)
+
+
+# -- restore integrity ----------------------------------------------------
+
+def test_torn_write_falls_back_to_previous_good(train, tmp_path):
+    """A checkpoint truncated post-publish (injected torn write) is
+    skipped by restore_latest; the previous good step restores and
+    verifies."""
+    init_state, step_fn, batch = train
+    mgr = CheckpointManager(str(tmp_path / "c"), sleep=_no_sleep,
+                            fault_plan=FaultPlan(torn_write_step=3))
+    state = init_state
+    published = {}
+    for i in range(4):
+        state = step_fn(state, batch(i))
+        mgr.save(i, state)
+        published[i] = jax.device_get(state)
+    assert mgr.fault_plan.fired, "torn write never triggered"
+    # direct restore of the torn step must fail verification...
+    with pytest.raises(Exception):
+        mgr.restore(3, target=init_state)
+    # ...and restore_latest silently falls back past it
+    got, step = mgr.restore_latest(target=init_state)
+    assert step == 2
+    _leaves_bitwise_equal(got, published[2])
+    assert mgr.counters.count("checkpoints_skipped_corrupt") >= 1
+
+
+def test_checksum_corruption_detected(train, tmp_path):
+    """A bit-flip that keeps the payload loadable still fails the
+    manifest's per-leaf checksum."""
+    init_state, step_fn, batch = train
+    mgr = CheckpointManager(str(tmp_path / "c"), sleep=_no_sleep)
+    state = step_fn(init_state, batch(0))
+    mgr.save(0, state)
+    # doctor the manifest so a checksum no longer matches the payload
+    mpath = os.path.join(mgr.root, "step_00000000", "manifest.json")
+    with open(mpath) as f:
+        manifest = json.load(f)
+    manifest["leaf_checksums"][0] = "deadbeef:" + \
+        manifest["leaf_checksums"][0].split(":", 1)[1]
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+    with pytest.raises(CheckpointCorruptError, match="checksum mismatch"):
+        mgr.restore(0, target=init_state)
+    assert mgr.restore_latest(target=init_state) is None
+
+
+def test_atomic_publish_survives_failed_save(train, tmp_path):
+    """A save whose IO keeps failing publishes NOTHING: previously
+    published steps stay intact and no tmp debris is ever visible as a
+    checkpoint."""
+    init_state, step_fn, batch = train
+    state = step_fn(init_state, batch(0))
+    mgr = CheckpointManager(
+        str(tmp_path / "c"), sleep=_no_sleep, retry_attempts=2,
+        fault_plan=FaultPlan(io_errors=100))
+    with pytest.raises(RetryError):
+        mgr.save(0, state)
+    assert mgr.steps() == []  # nothing published — both attempts failed
+    # heal the plan, publish one good step, then fail another save
+    mgr.fault_plan.io_errors = 0
+    mgr.save(1, state)
+    mgr.fault_plan.io_errors = 100
+    with pytest.raises(Exception):
+        mgr.save(2, state)
+    assert mgr.steps() == [1]
+    got, step = mgr.restore_latest(target=init_state)
+    assert step == 1
+
+
+def test_transient_io_errors_absorbed_by_retry(train, tmp_path):
+    """K injected transient errors < retry budget: the save succeeds
+    and the retries are accounted."""
+    init_state, step_fn, batch = train
+    state = step_fn(init_state, batch(0))
+    mgr = CheckpointManager(
+        str(tmp_path / "c"), sleep=_no_sleep, retry_attempts=4,
+        fault_plan=FaultPlan(io_errors=2))
+    mgr.save(0, state)
+    assert mgr.steps() == [0]
+    assert mgr.counters.count("checkpoint_retries") == 2
+    assert mgr.counters.count("checkpoints_written") == 1
+
+
+# -- manager mechanics ----------------------------------------------------
+
+def test_retention_keep_last_and_keep_every(train, tmp_path):
+    init_state, step_fn, batch = train
+    mgr = CheckpointManager(str(tmp_path / "c"), keep_last=2,
+                            keep_every=5, sleep=_no_sleep)
+    state = init_state
+    for i in range(8):
+        state = step_fn(state, batch(i))
+        mgr.save(i, state)
+    # last 2 (6, 7) plus every 5th (0, 5) survive
+    assert mgr.steps() == [0, 5, 6, 7]
+
+
+def test_background_save_and_wait(train, tmp_path):
+    init_state, step_fn, batch = train
+    mgr = CheckpointManager(str(tmp_path / "c"), sleep=_no_sleep)
+    state = step_fn(init_state, batch(0))
+    mgr.save(0, state, block=False)
+    mgr.wait()
+    got, step = mgr.restore_latest(target=init_state)
+    assert step == 0
+    _leaves_bitwise_equal(got, jax.device_get(state))
+
+
+def test_background_save_error_surfaces_on_wait(train, tmp_path):
+    init_state, step_fn, batch = train
+    mgr = CheckpointManager(
+        str(tmp_path / "c"), sleep=_no_sleep, retry_attempts=1,
+        fault_plan=FaultPlan(io_errors=100))
+    mgr.save(0, init_state, block=False)
+    with pytest.raises(RetryError):
+        mgr.wait()
+
+
+def test_manifest_records_metadata_and_backend(train, tmp_path):
+    init_state, *_ = train
+    mgr = CheckpointManager(str(tmp_path / "c"), sleep=_no_sleep)
+    mgr.save(3, init_state, metadata={"epoch": 7})
+    manifest = mgr.read_manifest(3)
+    assert manifest["step"] == 3
+    assert manifest["metadata"] == {"epoch": 7}
+    assert manifest["backend"] in ("orbax", "npz")
+    leaves = jax.tree_util.tree_leaves(jax.device_get(init_state))
+    assert manifest["num_leaves"] == len(leaves)
+    assert manifest["leaf_checksums"] == [leaf_checksum(x)
+                                          for x in leaves]
+
+
+# -- retry helper ---------------------------------------------------------
+
+def test_retry_succeeds_after_transient_errors():
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise TransientIOError("flake")
+        return "ok"
+
+    slept = []
+    assert retry(flaky, attempts=5, sleep=slept.append) == "ok"
+    assert len(attempts) == 3 and len(slept) == 2
+    # decorrelated jitter stays within [backoff, max_backoff]
+    assert all(0.05 <= s <= 2.0 for s in slept)
+
+
+def test_retry_exhaustion_chains_last_error():
+    def always():
+        raise TransientIOError("nope")
+    with pytest.raises(RetryError) as exc:
+        retry(always, attempts=3, sleep=_no_sleep)
+    assert isinstance(exc.value.__cause__, TransientIOError)
+
+
+def test_retry_deadline_cuts_budget_short():
+    clock = {"t": 0.0}
+
+    def tick(dt):
+        clock["t"] += dt
+
+    def always():
+        raise OSError("down")
+    with pytest.raises(RetryError, match="deadline"):
+        retry(always, attempts=100, backoff=10.0, max_backoff=10.0,
+              deadline=25.0, sleep=tick, clock=lambda: clock["t"])
+    assert clock["t"] < 25.0
+
+
+def test_retry_does_not_catch_unlisted_errors():
+    def bug():
+        raise KeyError("not transient")
+    with pytest.raises(KeyError):
+        retry(bug, sleep=_no_sleep)
+
+
+# -- fault plan -----------------------------------------------------------
+
+def test_fault_plan_env_parsing():
+    plan = FaultPlan.from_env(env="crash_step=7,crash_kind=kill,"
+                                  "io_errors=2,torn_write_step=3")
+    assert plan.crash_step == 7
+    assert plan.crash_kind == "kill"
+    assert plan.io_errors == 2
+    assert plan.torn_write_step == 3
+    assert FaultPlan.from_env(env="") is None
+    with pytest.raises(ValueError):
+        FaultPlan.from_env(env="explode_at=9")
+
+
+def test_fault_plan_tick_raises_only_at_step():
+    plan = FaultPlan(crash_step=4)
+    for i in range(4):
+        plan.tick(i)
+    with pytest.raises(InjectedCrash):
+        plan.tick(4)
+
+
+# -- sentry: non-finite streak rollback -----------------------------------
+
+@pytest.fixture()
+def toy_sentry(tmp_path):
+    """Minimal state with an embedded LossScalerState: params grow by
+    the batch unless it is non-finite (the scaler-skip model)."""
+    scaler = LossScaler("dynamic", init_scale=8.0, min_loss_scale=1.0)
+
+    @jax.jit
+    def step_fn(state, x):
+        overflow = ~jnp.all(jnp.isfinite(x))
+        p = jnp.where(overflow, state["p"], state["p"] + x)
+        return {"p": p, "scaler": scaler.update(state["scaler"],
+                                                overflow)}
+
+    init = {"p": jnp.zeros(()), "scaler": scaler.init()}
+    mgr = CheckpointManager(str(tmp_path / "c"), sleep=_no_sleep)
+    counters = CounterMeter()
+    sentry = TrainingSentry(step_fn, mgr, checkpoint_every=1,
+                            nonfinite_threshold=3, counters=counters)
+    return sentry, init, counters
+
+
+def test_sentry_rolls_back_after_nonfinite_streak(toy_sentry):
+    sentry, state, counters = toy_sentry
+    for i in range(4):                       # 4 clean steps, all saved
+        state = sentry.step(i, state, jnp.asarray(1.0))
+    assert float(state["p"]) == 4.0
+    bad = jnp.asarray(jnp.inf)
+    state = sentry.step(4, state, bad)
+    state = sentry.step(5, state, bad)
+    assert counters.count("rollbacks") == 0   # below threshold: scaler
+    state = sentry.step(6, state, bad)        # handles it; 3rd trips
+    assert counters.count("rollbacks") == 1
+    assert counters.count("nonfinite_steps") == 3
+    # rolled back to the last GOOD checkpoint: params AND scaler state
+    assert float(state["p"]) == 4.0
+    assert float(state["scaler"].loss_scale) == 8.0
+    assert sentry.streak == 0
+    # training continues normally afterwards
+    state = sentry.step(7, state, jnp.asarray(1.0))
+    assert float(state["p"]) == 5.0
+
+
+def test_sentry_overflow_steps_never_publish(toy_sentry):
+    sentry, state, counters = toy_sentry
+    state = sentry.step(0, state, jnp.asarray(1.0))
+    state = sentry.step(1, state, jnp.asarray(jnp.nan))
+    assert sentry.manager.steps() == [0]      # the bad step not saved
+
+
+def test_sentry_raises_without_good_checkpoint(toy_sentry):
+    sentry, state, counters = toy_sentry
+    sentry.nonfinite_threshold = 2
+    bad = jnp.asarray(jnp.nan)
+    state = sentry.step(0, state, bad)
+    with pytest.raises(DivergenceError):
+        sentry.step(1, state, bad)
+
+
+def test_find_scaler_states_traverses_containers():
+    st = LossScalerState(loss_scale=jnp.asarray(2.0),
+                         unskipped=jnp.asarray(0, jnp.int32),
+                         overflow=jnp.asarray(False))
+    tree = {"a": [1, (st, {"b": st})], "c": None}
+    assert len(find_scaler_states(tree)) == 2
+    assert find_scaler_states({"x": 1}) == []

@@ -61,6 +61,16 @@ EOF
 done
 rm -rf "$tmpdir"
 
+# crash-resume smoke: the resilience axis (docs/resilience.md) — a
+# worker subprocess is SIGKILLed mid-training by an injected fault
+# (APEX_TPU_FAULTS=crash_step=K,crash_kind=kill), a second subprocess
+# resumes from the surviving CheckpointManager state, and the final
+# train state must be bit-identical (per-leaf crc32) to an
+# uninterrupted run — torn publishes and resume off-by-ones exit 1
+echo "=== build-matrix axis: crash-resume ==="
+env JAX_PLATFORMS=cpu python tools/crash_resume_smoke.py
+results[crash_resume]=$?
+
 # serving smoke: the inference path's CPU-safe bench — asserts the
 # continuous-batching >= 2x floor over naive decode and token parity
 # between the two (tools/serving_bench.py --smoke, docs/serving.md)
