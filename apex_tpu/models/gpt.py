@@ -122,12 +122,15 @@ class GPTSelfAttention(nn.Module):
     @nn.compact
     def __call__(self, x, attn_bias, deterministic: bool = True,
                  cache_view=None, return_kv: bool = False):
-        """``cache_view``: decode mode — ``(k_ctx, v_ctx, ctx_bias)``
+        """``cache_view``: serving mode — ``(k_ctx, v_ctx, ctx_bias)``
         with k/v_ctx (B, T, H, D) gathered cache context and ctx_bias
-        (B, T) additive (0 keep / NEG_INF for unwritten slots); x is
-        then the single new token (B, 1, h) and attention runs over
-        [context; self] via ``ops.cached_attention`` — ``attention_fn``
-        (a causal full-sequence kernel) is deliberately bypassed.
+        (B, T) additive (0 keep / NEG_INF for unwritten slots).  With x
+        a single new token (B, 1, h) — decode — attention runs over
+        [context; self] via ``ops.cached_attention``; with x a prefill
+        CHUNK (B, C, h) it runs over [context; chunk] via
+        ``ops.chunk_cached_attention`` (all cached positions precede
+        the chunk, causal within it).  ``attention_fn`` (a causal
+        full-sequence kernel) is deliberately bypassed on both.
         ``return_kv``: also return this call's freshly projected
         ``(k, v)`` so the serving engine can append them to the cache.
         Both default off — the training path is byte-identical to
@@ -142,19 +145,27 @@ class GPTSelfAttention(nn.Module):
 
         q, k, v = proj("query"), proj("key"), proj("value")
         if cache_view is not None:
-            from apex_tpu.ops.decode_attention import cached_attention
+            from apex_tpu.ops.decode_attention import (
+                cached_attention,
+                chunk_cached_attention,
+            )
 
             k_ctx, v_ctx, ctx_bias = cache_view
-            # the new token attends its gathered past plus itself; the
-            # self slot is always live (bias 0)
+            # the new token(s) attend the gathered past plus themselves
             k_full = jnp.concatenate(
                 [k_ctx.astype(k.dtype), k], axis=1)
             v_full = jnp.concatenate(
                 [v_ctx.astype(v.dtype), v], axis=1)
-            bias = jnp.concatenate(
-                [ctx_bias, jnp.zeros((x.shape[0], 1), jnp.float32)],
-                axis=1)
-            ctx = cached_attention(q, k_full, v_full, kv_bias=bias)
+            if x.shape[1] == 1:
+                # decode: the self slot is always live (bias 0)
+                bias = jnp.concatenate(
+                    [ctx_bias, jnp.zeros((x.shape[0], 1), jnp.float32)],
+                    axis=1)
+                ctx = cached_attention(q, k_full, v_full, kv_bias=bias)
+            else:
+                # chunked prefill: context masked by ctx_bias, causal
+                # within the chunk
+                ctx = chunk_cached_attention(q, k_full, v_full, ctx_bias)
         else:
             dropout_fn = None
             if cfg.attention_probs_dropout_prob > 0 and not deterministic:
@@ -236,9 +247,11 @@ class GPTLMHeadModel(nn.Module):
 
     - ``positions``: explicit (B, S) position-embedding indices
       (decode feeds one token per sequence at its own depth);
-    - ``cache_views``: decode mode — ``(k_ctx, v_ctx, ctx_bias)`` with
+    - ``cache_views``: serving mode — ``(k_ctx, v_ctx, ctx_bias)`` with
       k/v_ctx (L, B, T, H, D) per-layer gathered KV-cache context and
-      ctx_bias (B, T); each block attends [its context; self];
+      ctx_bias (B, T); each block attends [its context; self] (decode,
+      S == 1) or [its context; chunk] causally (chunked prefill,
+      S > 1);
     - ``return_kv``: also return the per-layer freshly projected
       ``(k, v)`` list so the engine can write them into the cache
       (prefill uses this with ``cache_views=None`` — the normal causal

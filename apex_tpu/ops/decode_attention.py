@@ -151,6 +151,52 @@ def _layout(x):
     return jnp.swapaxes(x, 1, 2).reshape(b * h, t, d)
 
 
+def chunk_cached_attention(q, k, v, ctx_bias,
+                           scale: Optional[float] = None):
+    """Multi-token (chunked-prefill) attention over gathered cache
+    context plus the chunk itself.
+
+    Args:
+      q: (B, C, H, D) — one prefill chunk's queries.
+      k, v: (B, T + C, H, D) — the first T positions are the gathered
+        cache context (everything already materialized precedes the
+        chunk, so every chunk query may attend all of it, masked by
+        ``ctx_bias``), the last C the chunk's own fresh K/V, attended
+        CAUSALLY within the chunk.
+      ctx_bias: (B, T) additive fp32 context mask (0 keep / NEG_INF
+        for unwritten slots — the engine builds it from the chunk's
+        start position).
+      scale: logit scale, default 1/sqrt(D).
+
+    jnp only, same fp32 numeric policy as :func:`cached_attention`'s
+    oracle: the (C, T + C) score tile is chunk-bounded and XLA handles
+    it well — decode's Sq==1 streaming kernel stays the only custom
+    kernel in the serving path.  Every query row attends at least its
+    own key (causal diagonal), so no fully-masked-row guard is needed.
+    """
+    b, c, _, d = q.shape
+    t = k.shape[1] - c
+    if t < 0 or v.shape != k.shape:
+        raise ValueError(
+            f"k/v must be (B, T + C, H, D) with T >= 0; got q={q.shape} "
+            f"k={k.shape} v={v.shape}")
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    s = _einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    causal = jnp.where(
+        jnp.arange(c)[:, None] >= jnp.arange(c)[None, :], 0.0, NEG_INF)
+    bias = jnp.concatenate(
+        [jnp.broadcast_to(ctx_bias.astype(jnp.float32)[:, None, :],
+                          (b, c, t)),
+         jnp.broadcast_to(causal[None], (b, c, c))], axis=-1)
+    s = s + bias[:, None]                              # (B, H, C, T+C)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    out = _einsum("bhqk,bkhd->bqhd", (p / l).astype(q.dtype), v)
+    return out.astype(q.dtype)
+
+
 def cached_attention(q, k, v, *, kv_bias: Optional[jax.Array] = None,
                      scale: Optional[float] = None,
                      block_k: Optional[int] = None,

@@ -619,6 +619,29 @@ _flash.defvjp(lambda q, k, v, m, s, causal, scale, bq, bk, interp, rate:
               _flash_bwd)
 
 
+# XLA/Pallas crossover for the use_pallas=None auto path: BENCH_NOTES
+# round 5 measured the Pallas kernel LOSING to XLA attention inside
+# BERT at short sequences (s128: 0.532 XLA vs 0.392 flash MFU; s512
+# post-tuning at best parity, 0.447 vs 0.438) and winning past it
+# (gpt s1024 causal 1.81x, the 16k long-context leg most of all) — the
+# wide-tile streaming softmax only pays for itself once the (Sq, Sk)
+# score tensor stops fitting XLA's fusion comfort zone.  Auto therefore
+# routes sequences of at most this length to the XLA reference path.
+FLASH_AUTO_MIN_SEQ = 512
+
+
+def _auto_use_pallas(sq: int, sk: int, dropout_rate: float = 0.0) -> bool:
+    """The decision table for ``use_pallas=None`` ON TPU (off-TPU auto
+    is already the jnp path): Pallas iff the longer sequence side
+    exceeds :data:`FLASH_AUTO_MIN_SEQ`, OR dropout is active — in-kernel
+    dropout never materializes the (Sq, Sk) probs tensor in HBM, which
+    beats raw short-sequence throughput.  Explicit ``use_pallas=True/
+    False`` bypasses this entirely."""
+    if dropout_rate > 0.0:
+        return True
+    return max(sq, sk) > FLASH_AUTO_MIN_SEQ
+
+
 def _default_block(s: int) -> int:
     """Adaptive tile default: the largest 128-multiple <= 512 that
     DIVIDES the 128-padded sequence (or the whole padded sequence when
@@ -672,7 +695,11 @@ def flash_attention(q, k, v, *, kv_mask: Optional[jax.Array] = None,
       block_q, block_k: VMEM tile sizes (multiples of 128 recommended).
         Default None = adaptive (``_default_block``: 512 capped at the
         padded sequence — the measured v5e sweet spot).
-      use_pallas: None = auto (Pallas kernels on TPU, jnp oracle off-TPU).
+      use_pallas: None = auto — Pallas kernels on TPU when the longer
+        sequence side exceeds ``FLASH_AUTO_MIN_SEQ`` (512; below it
+        XLA attention measures faster — BENCH_NOTES r5) or dropout is
+        active, jnp/XLA otherwise and always off-TPU.  True/False
+        force the path.
       interpret: force Pallas interpret mode (defaults to not-on-TPU).
       return_lse: also return the per-row log-sum-exp (B, H, Sq) fp32
         (NEG_INF for fully-masked rows) — the statistic for combining
@@ -721,6 +748,11 @@ def flash_attention(q, k, v, *, kv_mask: Optional[jax.Array] = None,
     # partial-manual shard_map regions (pipelined TP) auto-partition
     # every op — Mosaic calls are rejected there, jnp oracle instead
     use = pallas_auto_gate(use_pallas)
+    if use and use_pallas is None and not _auto_use_pallas(
+            q.shape[1], k.shape[1], dropout_rate):
+        # short-sequence auto fallback: XLA attention wins below the
+        # crossover (FLASH_AUTO_MIN_SEQ, BENCH_NOTES r5)
+        use = False
     if not use or not _HAS_PALLAS:
         return _reference(q, k, v, kv_mask, causal, scale,
                           return_lse=return_lse,

@@ -12,14 +12,23 @@ serve".  Three layers, bottom-up:
   prefill (reusing the training forward, flash-attention pluggable)
   and a single-token batched decode through
   ``ops.cached_attention``;
+- :mod:`serving.prefix_cache` — a block-level prefix index
+  (RadixAttention-style, keyed on full-block token chunks chained by
+  physical parent id) over the allocator's refcounts: shared-prefix
+  traffic maps its longest cached prefix onto shared blocks and only
+  prefills the tail, idle cached blocks evict LRU under pool
+  pressure, and whole-context hits duplicate their last block
+  copy-on-write;
 - :mod:`serving.scheduler` / :mod:`serving.api` — Orca-style
   iteration-level continuous batching (admit-on-slot-free, per-request
-  EOS/max-token termination, preempt-youngest on memory pressure) and
-  the synchronous :class:`InferenceServer` front door, with
-  failure isolation: one pathological request finishes alone
-  (``finish_reason`` ``capacity`` / ``timeout`` / ``rejected`` /
-  ``nonfinite``) instead of raising into the batch
-  (``docs/resilience.md``).
+  EOS/max-token termination, preempt-youngest on memory pressure) with
+  Sarathi-style CHUNKED PREFILL (one fixed-size chunk per prefilling
+  request per iteration, interleaved with decode, so long prompts
+  stall running requests by at most one chunk) and the synchronous
+  :class:`InferenceServer` front door, with failure isolation: one
+  pathological request finishes alone (``finish_reason`` ``capacity``
+  / ``timeout`` / ``rejected`` / ``nonfinite``) instead of raising
+  into the batch (``docs/resilience.md``).
 
 Quick start::
 
@@ -41,6 +50,7 @@ from apex_tpu.serving.kv_cache import (
     init_kv_cache,
     resolve_cache_dtype,
 )
+from apex_tpu.serving.prefix_cache import PrefixCache
 from apex_tpu.serving.scheduler import QueueFullError, Request, Scheduler
 
 __all__ = [
@@ -48,6 +58,7 @@ __all__ = [
     "DecodeEngine",
     "InferenceServer",
     "KVCacheConfig",
+    "PrefixCache",
     "QueueFullError",
     "Request",
     "Scheduler",

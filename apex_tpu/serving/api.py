@@ -12,6 +12,15 @@ needs.  A live service would run :meth:`step` on its event loop and
 stream ``Request.generated`` as it grows; both drive the identical
 scheduler/engine machinery, so the offline numbers transfer.
 
+Serving-perf layers (both ON by default; ``enable_prefix_cache=False``
+/ ``enable_chunked_prefill=False`` opt out): block-level prefix
+caching shares cached full blocks at admission so only the uncached
+tail prefills, and chunked prefill advances ONE chunk per prefilling
+request per iteration so a long prompt stalls the decode batch by at
+most one chunk.  Hit/miss/eviction/COW counters and the per-iteration
+chunk gauge surface in :meth:`InferenceServer.stats`
+(``docs/serving.md``).
+
 Failure isolation (``docs/resilience.md``): the step loop never lets
 one pathological request take the batch down.  Per iteration it (1)
 expires per-request deadlines (iteration or wall budget →
@@ -33,8 +42,14 @@ from typing import Callable, List, Optional, Sequence
 import numpy as np
 
 from apex_tpu.serving.engine import DecodeEngine
+from apex_tpu.serving.prefix_cache import PrefixCache
 from apex_tpu.serving.scheduler import QueueFullError, Request, Scheduler
 from apex_tpu.utils import CounterMeter, GaugeMeter, RateMeter
+
+# default chunked-prefill width (tokens) when the caller doesn't pick
+# one: small enough that a chunk costs roughly a decode step at typical
+# model sizes, large enough to amortize the per-chunk context gather
+DEFAULT_PREFILL_CHUNK = 256
 
 
 def greedy_sample(logits: np.ndarray) -> np.ndarray:
@@ -55,6 +70,17 @@ class InferenceServer:
         (explicit backpressure at the front door).
       clock: wall-deadline time source (monotonic seconds) —
         injectable so deadline tests never sleep.
+      enable_prefix_cache: block-level prefix sharing at admission
+        (:mod:`serving.prefix_cache`) — shared-prefix traffic skips
+        re-prefilling cached full blocks.  Opt out for strictly
+        private workloads or A/B baselines.
+      enable_chunked_prefill: split long prefill tails into
+        ``prefill_chunk``-token chunks, one per iteration, so a long
+        prompt stalls running decodes by at most one chunk.  Opt out
+        to restore monolithic bucketed prefills.
+      prefill_chunk: chunk width in tokens (default
+        ``min(256, max_context)``); ignored when chunked prefill is
+        off.
 
     Example::
 
@@ -72,24 +98,40 @@ class InferenceServer:
                  prefill_buckets=None,
                  sample_fn: Optional[Callable] = None,
                  max_waiting: Optional[int] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 enable_prefix_cache: bool = True,
+                 enable_chunked_prefill: bool = True,
+                 prefill_chunk: Optional[int] = None):
         self.engine = DecodeEngine(
             cfg, params, max_batch_size=max_batch_size,
             max_context=max_context, num_blocks=num_blocks,
             block_size=block_size, cache_dtype=cache_dtype,
             attention_fn=attention_fn, prefill_buckets=prefill_buckets)
         self.failures = CounterMeter()
+        self.prefix = CounterMeter()
+        self.prefix_cache = (
+            PrefixCache(self.engine.allocator, self.engine.block_size,
+                        counters=self.prefix)
+            if enable_prefix_cache else None)
+        self.prefill_chunk = None
+        if enable_chunked_prefill:
+            self.prefill_chunk = int(
+                prefill_chunk if prefill_chunk is not None
+                else min(DEFAULT_PREFILL_CHUNK, self.engine.max_context))
         self.scheduler = Scheduler(
             self.engine.allocator,
             max_batch_size=self.engine.max_batch_size,
             block_size=self.engine.block_size,
             max_context=self.engine.max_context,
             max_waiting=max_waiting,
-            counters=self.failures)
+            counters=self.failures,
+            prefix_cache=self.prefix_cache,
+            chunk_size=self.prefill_chunk)
         self.sample_fn = sample_fn or greedy_sample
         self.clock = clock
         self.queue_depth = GaugeMeter()
         self.occupancy = GaugeMeter()
+        self.chunk_iters = GaugeMeter()   # chunk prefills per iteration
         self.tokens = RateMeter()
         self._iter = 0              # scheduler iterations served
 
@@ -153,23 +195,47 @@ class InferenceServer:
                 sched.fail(req, "timeout")
 
     def step(self) -> int:
-        """One continuous-batching iteration: expire deadlines, admit +
-        prefill newly schedulable requests, then one decode step across
-        the running batch.  Returns the number of tokens sampled
-        (0 = idle).  Per-request failures (capacity / timeout /
-        nonfinite) finish the affected request alone — no exception
-        escapes the step loop for them."""
+        """One continuous-batching iteration: expire deadlines, admit
+        newly schedulable requests, advance ONE prefill chunk per
+        prefilling request, then one decode step across the rest of
+        the running batch.  Chunk prefills interleave with decode
+        iterations, so a long prompt stalls running requests by at
+        most one chunk — and a prefix-cache hit skips straight to its
+        uncached tail.  Returns the number of tokens sampled
+        (0 = idle, though chunk prefills may still have run).
+        Per-request failures (capacity / timeout / nonfinite) finish
+        the affected request alone — no exception escapes the step
+        loop for them."""
         sched, engine = self.scheduler, self.engine
         self._iter += 1
         produced = 0
         self._expire_deadlines()
 
-        for req in sched.admit():
-            ctx, discard_logits = sched.prefill_plan(req)
-            logits = engine.prefill(ctx, req.block_table)
-            req.num_cached = len(ctx)
-            if discard_logits:
-                # resumed after preemption: the pending token continues
+        sched.admit()
+        # whole-context cache hits first duplicate their final shared
+        # block (copy-on-write) so the tail re-write stays private
+        cows = [r for r in sched._admit_order if r.pending_cow]
+        if cows:
+            engine.copy_blocks([r.pending_cow for r in cows])
+            for req in cows:
+                sched.cow_done(req)
+
+        chunks = 0
+        for req in [r for r in sched._admit_order if r.prefilling]:
+            tokens, start, is_last = sched.prefill_plan(req)
+            if (start == 0 and is_last and self.prefill_chunk is None):
+                # no cached prefix, no chunking: the monolithic
+                # bucketed prefill (the pre-chunking path, bit-for-bit)
+                logits = engine.prefill(tokens, req.block_table)
+            else:
+                logits = engine.chunk_prefill(
+                    tokens, start, req.block_table,
+                    pad_to=self.prefill_chunk)
+                chunks += 1
+            done = sched.chunk_done(req, len(tokens))
+            if not done or not req.prefill_sample:
+                # mid-prefill, or resumed after preemption (the
+                # pending token continues instead of these logits)
                 continue
             logits = np.asarray(logits)
             if not np.all(np.isfinite(logits)):
@@ -180,16 +246,21 @@ class InferenceServer:
             produced += 1
             if req.finished:
                 sched.retire(req)
+        self.chunk_iters.update(chunks)
+        if chunks:
+            self.prefix.incr("prefill_chunks", chunks)
 
         if sched.running:
             for req in list(sched.running.values()):
-                if req.running:        # an earlier pass may have
-                    # preempted it; a False return means the request
-                    # outgrew the pool with no victim left — it fails
-                    # alone instead of raising into the batch
+                if req.running and not req.prefilling:
+                    # an earlier pass may have preempted it; a False
+                    # return means the request outgrew the pool with no
+                    # victim left — it fails alone instead of raising
+                    # into the batch
                     if not sched.ensure_decode_capacity(req):
                         sched.fail(req, "capacity")
-            running = list(sched.running.values())
+            running = [r for r in sched.running.values()
+                       if not r.prefilling]
             if running:
                 b, mb = engine.max_batch_size, engine.blocks_per_seq
                 tokens = np.zeros((b,), np.int32)
@@ -217,6 +288,10 @@ class InferenceServer:
                     produced += 1
                     if req.finished:
                         sched.retire(req)
+                    else:
+                        # index any block this token just filled so a
+                        # later shared-prefix request can match it
+                        sched.register_progress(req)
 
         self.tokens.update(produced)
         self.queue_depth.update(sched.num_waiting)
@@ -256,12 +331,19 @@ class InferenceServer:
         self.tokens.reset()
         self.queue_depth.reset()
         self.occupancy.reset()
+        self.chunk_iters.reset()
         self.scheduler.finished.clear()
 
     def stats(self) -> dict:
-        """Serving counters for logs and the bench harness."""
+        """Serving counters for logs and the bench harness.
+
+        Prefix-cache keys: ``prefix_hit_rate`` is hit tokens over all
+        admitted context tokens; ``kv_blocks_cached`` counts indexed
+        blocks (shared or evictable), ``kv_blocks_free`` only the
+        truly-free list — reclaimable capacity is their sum plus
+        evictable holds."""
         pre, dec = self.engine.compile_counts()
-        return {
+        out = {
             "tokens_generated": self.tokens.total,
             "tokens_per_s": round(self.tokens.rate, 1),
             "queue_depth_peak": self.queue_depth.peak,
@@ -274,4 +356,25 @@ class InferenceServer:
                                for r in self.scheduler.finished),
             "requests_failed": self.failures.as_dict(),
             "requests_failed_total": self.failures.total,
+            "prefill_chunks": self.prefix.count("prefill_chunks"),
+            "chunk_iters_peak": self.chunk_iters.peak,
         }
+        if self.prefix_cache is not None:
+            out.update({
+                "prefix_hit_tokens":
+                    self.prefix.count("prefix_hit_tokens"),
+                "prefix_miss_tokens":
+                    self.prefix.count("prefix_miss_tokens"),
+                "prefix_hit_requests":
+                    self.prefix.count("prefix_hit_requests"),
+                "prefix_hit_rate": round(self.prefix.ratio(
+                    "prefix_hit_tokens",
+                    "prefix_hit_tokens", "prefix_miss_tokens"), 3),
+                "prefix_evicted_blocks":
+                    self.prefix.count("prefix_evicted_blocks"),
+                "prefix_cow_blocks":
+                    self.prefix.count("prefix_cow_blocks"),
+                "kv_blocks_cached": self.prefix_cache.num_cached_blocks,
+                "kv_blocks_evictable": self.prefix_cache.num_evictable,
+            })
+        return out

@@ -1,7 +1,7 @@
 """Jit-compiled prefill + single-token decode steps over the KV cache.
 
-Two compiled programs, both fixed-shape so the continuous-batching
-loop never recompiles in steady state:
+Compiled programs, all fixed-shape so the continuous-batching loop
+never recompiles in steady state:
 
 - **prefill** (one request, prompt padded to a length *bucket*): the
   ordinary causal GPT forward — optionally through the flash kernel
@@ -9,11 +9,20 @@ loop never recompiles in steady state:
   are scattered into the request's blocks in the same program.  One
   trace per bucket length, so the compile count is bounded by
   ``len(prefill_buckets)``, not by the distribution of prompt lengths.
+- **chunk prefill** (one request, one fixed-width chunk at a carried
+  KV position): the chunked-prefill and prefix-cached-tail workhorse —
+  the chunk attends the request's ALREADY-CACHED context through its
+  block table (gather + ``ops.chunk_cached_attention``) plus itself
+  causally, and its K/V scatter at block-offset slots.  A fixed chunk
+  size means ONE trace however long prompts get.
 - **decode** (the whole running batch, always ``max_batch_size``
   wide): gather every slot's context through its block table, run the
   model on one token per slot at its own position
   (``ops.cached_attention`` inside), scatter the new K/V, return
   next-token logits.  Compiled exactly once.
+- **block copy** (fixed-width (src, dst) id batch): whole-block
+  duplication inside the pool — the device half of the prefix cache's
+  copy-on-write.  Compiled exactly once.
 
 Empty slots ride along as no-ops by construction: position 0 masks
 the whole context, the zeroed block table routes the KV write into
@@ -38,6 +47,7 @@ from apex_tpu.serving.kv_cache import (
     BlockAllocator,
     KVCacheConfig,
     context_bias,
+    copy_blocks,
     gather_context,
     init_kv_cache,
     slot_index,
@@ -64,6 +74,21 @@ def default_prefill_buckets(max_context: int,
         b *= 2
     buckets.append(max_context)
     return tuple(buckets)
+
+
+def pick_bucket(length: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= ``length`` (buckets ascending); raises past
+    the largest — one definition shared by ``DecodeEngine.bucket_for``
+    and its edge-case tests."""
+    for b in buckets:
+        if length <= b:
+            return b
+    raise ValueError(
+        f"length {length} exceeds the largest bucket {buckets[-1]}")
+
+# padded width of one copy_blocks launch: COW duplicates arrive one or
+# two at a time, so a single fixed shape keeps the program count at 1
+_COPY_WIDTH = 8
 
 
 class DecodeEngine:
@@ -137,6 +162,9 @@ class DecodeEngine:
                                     donate_argnums=(1,))
         self._decode_jit = jax.jit(self._decode_impl,
                                    donate_argnums=(1,))
+        self._chunk_jit = jax.jit(self._chunk_impl,
+                                  donate_argnums=(1,))
+        self._copy_jit = jax.jit(self._copy_impl, donate_argnums=(0,))
 
     # -- compiled bodies --------------------------------------------------
 
@@ -161,6 +189,46 @@ class DecodeEngine:
             axis=1)[:, 0]                             # (1, V)
         return cache, last
 
+    def _chunk_impl(self, params, cache, ids, start, length, table):
+        """One prefill CHUNK at a carried KV position: ids (1, Cb)
+        zero-padded chunk tokens; start (1,) absolute position of
+        ``ids[0]`` (== tokens already materialized through ``table``);
+        length (1,) valid tokens in the chunk; table (1,
+        blocks_per_seq).  Gathers the request's full cached context,
+        runs the chunk through the model's chunked ``cache_views``
+        path (context masked to slots < start, causal within the
+        chunk), scatters the chunk's K/V at its block-offset slots,
+        and returns (cache, last-valid-token logits (1, V)) — the
+        logits only matter on the final chunk."""
+        cb = ids.shape[1]
+        off = jnp.arange(cb, dtype=jnp.int32)[None, :]
+        pos = start[:, None].astype(jnp.int32) + off       # (1, Cb)
+        t_ctx = self.blocks_per_seq * self.block_size
+        k_ctx, v_ctx = gather_context(cache, table, self.block_size)
+        bias = context_bias(start, t_ctx)                  # slots < start
+        # padded tail positions can run past the embedding table; clamp
+        # them (their logits and K/V writes are discarded/garbage-sunk)
+        pos_emb = jnp.minimum(pos, self.cfg.max_position_embeddings - 1)
+        logits, kvs = self.model.apply(
+            {"params": params}, ids, positions=pos_emb,
+            deterministic=True, cache_views=(k_ctx, v_ctx, bias),
+            return_kv=True)
+        k = jnp.stack([kv[0] for kv in kvs])               # (L, 1, Cb, H, D)
+        v = jnp.stack([kv[1] for kv in kvs])
+        valid = off < length[:, None]
+        slots = jnp.where(valid,
+                          slot_index(table, pos, self.block_size), 0)
+        cache = write_prefill(cache, (k, v), slots)
+        last = jnp.take_along_axis(
+            logits, (length[:, None, None] - 1).astype(jnp.int32),
+            axis=1)[:, 0]                                  # (1, V)
+        return cache, last
+
+    def _copy_impl(self, cache, src, dst):
+        """(_COPY_WIDTH,) src/dst block ids, (0, 0)-padded — the COW
+        block duplication (``kv_cache.copy_blocks``)."""
+        return copy_blocks(cache, src, dst, self.block_size)
+
     def _decode_impl(self, params, cache, tokens, positions, tables):
         """tokens (B,) current input token per slot; positions (B,)
         its position (== cached context length); tables (B,
@@ -182,12 +250,12 @@ class DecodeEngine:
     # -- host API ---------------------------------------------------------
 
     def bucket_for(self, length: int) -> int:
-        for b in self.prefill_buckets:
-            if length <= b:
-                return b
-        raise ValueError(
-            f"prompt length {length} exceeds max_context "
-            f"{self.max_context}")
+        try:
+            return pick_bucket(length, self.prefill_buckets)
+        except ValueError:
+            raise ValueError(
+                f"prompt length {length} exceeds max_context "
+                f"{self.max_context}") from None
 
     def prefill(self, prompt, block_table) -> jax.Array:
         """Run one prompt through the bucketed prefill, writing its
@@ -206,6 +274,51 @@ class DecodeEngine:
             jnp.asarray([n], jnp.int32), jnp.asarray(table))
         return last[0]
 
+    def chunk_prefill(self, tokens, start: int, block_table,
+                      pad_to: Optional[int] = None) -> jax.Array:
+        """Run one prefill chunk — ``tokens`` at absolute positions
+        ``start..start+len-1`` — writing its K/V through
+        ``block_table``; K/V for positions < start must already be
+        materialized (earlier chunks or shared prefix-cache blocks).
+        Returns the chunk's last-token logits (V,).
+
+        ``pad_to`` is the compiled chunk width (default: the prompt
+        bucket for ``len(tokens)``); a steady chunked-prefill loop
+        passes its fixed chunk size so exactly one chunk program ever
+        compiles."""
+        import numpy as np
+
+        n = len(tokens)
+        cb = pad_to if pad_to is not None else self.bucket_for(n)
+        if n > cb:
+            raise ValueError(
+                f"chunk of {n} tokens exceeds pad_to={cb}")
+        ids = np.zeros((1, cb), np.int32)
+        ids[0, :n] = tokens
+        table = np.zeros((1, self.blocks_per_seq), np.int32)
+        table[0, :len(block_table)] = block_table
+        self.cache, last = self._chunk_jit(
+            self.params, self.cache, jnp.asarray(ids),
+            jnp.asarray([start], jnp.int32),
+            jnp.asarray([n], jnp.int32), jnp.asarray(table))
+        return last[0]
+
+    def copy_blocks(self, pairs) -> None:
+        """Duplicate physical blocks ``[(src, dst), ...]`` inside the
+        pool (copy-on-write).  Launches in fixed-width batches of
+        ``_COPY_WIDTH`` padded with (0, 0) no-op pairs, so the copy
+        program compiles once."""
+        import numpy as np
+
+        for i in range(0, len(pairs), _COPY_WIDTH):
+            batch = pairs[i:i + _COPY_WIDTH]
+            src = np.zeros((_COPY_WIDTH,), np.int32)
+            dst = np.zeros((_COPY_WIDTH,), np.int32)
+            for j, (s, d) in enumerate(batch):
+                src[j], dst[j] = s, d
+            self.cache = self._copy_jit(self.cache, jnp.asarray(src),
+                                        jnp.asarray(dst))
+
     def decode(self, tokens, positions, tables) -> jax.Array:
         """One iteration-level decode step over all slots.  Arrays are
         (B,), (B,), (B, blocks_per_seq) with inactive slots zeroed.
@@ -221,9 +334,12 @@ class DecodeEngine:
 
     def compile_counts(self):
         """(prefill traces, decode traces) — the recompile audit the
-        scheduler tests pin: prefill <= len(prefill_buckets), decode
-        == 1 regardless of traffic."""
-        return (self._prefill_jit._cache_size(),
+        scheduler tests pin: prefill (monolithic buckets + chunk
+        widths) <= len(prefill_buckets), decode == 1 regardless of
+        traffic.  A fixed-chunk loop contributes exactly one chunk
+        trace (``chunk_prefill(pad_to=...)``)."""
+        return (self._prefill_jit._cache_size()
+                + self._chunk_jit._cache_size(),
                 self._decode_jit._cache_size())
 
     def reset_cache(self):

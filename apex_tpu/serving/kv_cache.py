@@ -40,7 +40,7 @@ overrides explicitly (tests pin fp32 for bit-parity runs).
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -191,27 +191,64 @@ def context_bias(lengths, max_context: int):
                      0.0, NEG_INF).astype(jnp.float32)
 
 
+def copy_blocks(cache, src, dst, block_size: int):
+    """Whole-block copy ``src[i] -> dst[i]`` inside the pool — the
+    device half of copy-on-write duplication (a request that must
+    write into a block shared through the prefix cache first clones it
+    into a private block).
+
+    src, dst: (M,) int32 physical block ids.  Unused pairs pad with
+    (0, 0): copying the garbage block onto itself is a no-op by
+    construction, so the call stays fixed-shape."""
+    off = jnp.arange(block_size, dtype=src.dtype)[None, :]
+    s = (src[:, None] * block_size + off).reshape(-1)
+    d = (dst[:, None] * block_size + off).reshape(-1)
+    return {"k": cache["k"].at[:, d].set(cache["k"][:, s]),
+            "v": cache["v"].at[:, d].set(cache["v"][:, s])}
+
+
 # ---------------------------------------------------------------------------
 # host-side allocator
 # ---------------------------------------------------------------------------
 
 class BlockAllocator:
-    """Free-list over physical blocks 1..num_blocks-1 (0 is the
-    garbage sink and is never handed out).
+    """Refcounted free-list over physical blocks 1..num_blocks-1 (0 is
+    the garbage sink and is never handed out).
 
     LIFO reuse (a stack) keeps hot blocks hot — a freed request's
     blocks are the most recently touched HBM and the next allocation
-    gets them first."""
+    gets them first.  A parallel ``_free_set`` mirrors the list so
+    double-free detection and :meth:`free` are O(1) per block instead
+    of an O(n) list scan.
+
+    Refcounts are what make prefix caching possible: a block shared by
+    several requests' tables carries one ref per table
+    (:meth:`incref`), and :meth:`free` only returns it to the free
+    list when the last ref drops.  A block whose refcount reaches zero
+    is first offered to ``release_hook`` (the prefix cache): the hook
+    returning True keeps the block out of the free list — still
+    resident, evictable later via :meth:`release_to_free` — so cached
+    prefixes survive their original request.  Every block is therefore
+    in exactly one of three states: free (in the list+set), live
+    (refcount >= 1), or cache-held (refcount 0, hook-retained)."""
 
     def __init__(self, cfg: KVCacheConfig):
         self.cfg = cfg
+        self.release_hook = None      # blk -> bool; True = hook keeps it
+        self.reset_hooks: List = []   # called on reset() (cache clears)
         self.reset()
 
     def reset(self):
         """Return every block to the free list (between workloads;
-        in-place so schedulers holding this allocator stay wired)."""
+        in-place so schedulers holding this allocator stay wired).
+        Reset hooks fire so a prefix cache indexing the old blocks
+        drops its now-dangling entries."""
         self._free: List[int] = list(range(self.cfg.num_blocks - 1, 0,
                                            -1))
+        self._free_set = set(self._free)
+        self._refs: Dict[int, int] = {}
+        for hook in self.reset_hooks:
+            hook()
 
     @property
     def num_free(self) -> int:
@@ -221,9 +258,9 @@ class BlockAllocator:
         return n <= len(self._free)
 
     def alloc(self, n: int) -> List[int]:
-        """Pop n blocks; raises :class:`MemoryError` when the pool is
-        exhausted (the scheduler checks :meth:`can_alloc` / preempts
-        first, so reaching this is a caller bug)."""
+        """Pop n blocks at refcount 1; raises :class:`MemoryError` when
+        the pool is exhausted (the scheduler checks :meth:`can_alloc` /
+        evicts / preempts first, so reaching this is a caller bug)."""
         if n <= 0:
             return []
         if n > len(self._free):
@@ -233,15 +270,63 @@ class BlockAllocator:
                 f"(pool={self.cfg.num_blocks - 1})")
         out = self._free[-n:][::-1]
         del self._free[len(self._free) - n:]
+        for blk in out:
+            self._free_set.discard(blk)
+            self._refs[blk] = 1
         return out
 
+    def refs(self, blk: int) -> int:
+        return self._refs.get(blk, 0)
+
+    def incref(self, blocks: List[int]):
+        """Add one ref per block (a second table now references it)."""
+        for blk in blocks:
+            if blk not in self._refs:
+                raise ValueError(
+                    f"incref of unallocated block {blk}")
+            self._refs[blk] += 1
+
+    def adopt(self, blk: int):
+        """Re-own a cache-held block (refcount 0, hook-retained) at
+        refcount 1 — the prefix cache reactivating an evictable block a
+        new request just matched."""
+        if blk in self._free_set or blk in self._refs:
+            raise ValueError(
+                f"adopt of block {blk} that is not cache-held "
+                f"(free={blk in self._free_set}, "
+                f"refs={self._refs.get(blk)})")
+        self._refs[blk] = 1
+
     def free(self, blocks: List[int]):
+        """Drop one ref per block; blocks reaching zero return to the
+        free list unless ``release_hook`` claims them (prefix cache
+        hold).  All blocks validate before any state changes."""
         for blk in blocks:
             if not 1 <= blk < self.cfg.num_blocks:
                 raise ValueError(f"freeing invalid block id {blk}")
-            if blk in self._free:
+            if blk in self._free_set:
                 raise ValueError(f"double free of block {blk}")
-        self._free.extend(blocks)
+            if blk not in self._refs:
+                raise ValueError(f"freeing unallocated block {blk}")
+        for blk in blocks:
+            if self._refs[blk] > 1:
+                self._refs[blk] -= 1
+                continue
+            del self._refs[blk]
+            if self.release_hook is not None and self.release_hook(blk):
+                continue
+            self._free.append(blk)
+            self._free_set.add(blk)
+
+    def release_to_free(self, blk: int):
+        """Return a cache-held block (refcount 0) to the free list —
+        the prefix cache's eviction path."""
+        if blk in self._free_set or blk in self._refs:
+            raise ValueError(
+                f"release_to_free of block {blk} that is not "
+                f"cache-held")
+        self._free.append(blk)
+        self._free_set.add(blk)
 
     @staticmethod
     def blocks_for(num_tokens: int, block_size: int) -> int:

@@ -1,0 +1,229 @@
+"""Block-level prefix cache — RadixAttention's sharing, hash-chained.
+
+Shared-prefix traffic (system prompts, few-shot templates, multi-turn
+chat) re-prefills the same tokens for every request; SGLang's
+RadixAttention observation is that a block-granular KV cache already
+holds everything needed to skip that work — the only missing piece is
+an INDEX from token content to physical blocks.  This module is that
+index:
+
+- the unit of sharing is one FULL block (``block_size`` tokens): a
+  partial block is still being written and can never be shared;
+- the key of block i is ``(parent physical block, tuple of its
+  block_size tokens)`` — chaining on the parent's physical id makes
+  the key cover the entire prefix without hashing it (two prefixes
+  agreeing on blocks 0..i-1 share the same parent id by induction),
+  which is a flat-dict encoding of the radix tree;
+- :meth:`match` walks a new request's context down the chain and
+  returns the longest cached run of full blocks with one refcount
+  taken per block (``BlockAllocator.incref`` / ``adopt``);
+- a block whose refcount drops to zero is NOT freed if registered
+  here: the allocator's ``release_hook`` parks it in an LRU of
+  evictable holds, so a finished request's prefix keeps serving
+  matches until the pool actually needs the space;
+- :meth:`evict` reclaims LRU holds for the allocator, cascading over
+  registered descendants (their chain keys dangle once the parent id
+  is reusable — a reused id plus equal tokens would alias a stale
+  entry onto garbage).
+
+The cache never touches device memory: like the scheduler it is pure
+host bookkeeping over block ids; the KV bytes themselves were written
+by whichever request prefilled them first and are bit-identical to
+what any later request would have written (same tokens, same absolute
+positions, same jitted program).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional, Set, Tuple
+
+from apex_tpu.serving.kv_cache import BlockAllocator
+from apex_tpu.utils.meters import CounterMeter
+
+# chain parent of a sequence's first block — the reserved garbage
+# block's id, which is never allocated and so never collides
+ROOT = 0
+
+
+class PrefixCache:
+    """Content -> physical-block index over a :class:`BlockAllocator`.
+
+    Wires itself into the allocator on construction: ``release_hook``
+    parks registered ref-0 blocks in the evictable LRU instead of
+    freeing them, and a reset hook drops the whole index when the
+    allocator resets (the ids it stored are dangling after that).
+
+    ``counters`` (a :class:`CounterMeter`) accumulates
+    ``prefix_hit_tokens`` / ``prefix_miss_tokens`` /
+    ``prefix_hit_requests`` / ``prefix_miss_requests`` /
+    ``prefix_evicted_blocks`` / ``prefix_cow_blocks`` — surfaced by
+    ``InferenceServer.stats``.
+    """
+
+    def __init__(self, allocator: BlockAllocator, block_size: int,
+                 counters: Optional[CounterMeter] = None):
+        self.allocator = allocator
+        self.block_size = block_size
+        self.counters = counters if counters is not None else CounterMeter()
+        self._map: Dict[Tuple[int, tuple], int] = {}   # key -> block
+        self._key_of: Dict[int, Tuple[int, tuple]] = {}
+        self._children: Dict[int, Set[int]] = {}       # block -> blocks
+        self._lru: "OrderedDict[int, None]" = OrderedDict()  # evictable
+        allocator.release_hook = self._on_release
+        allocator.reset_hooks.append(self.clear)
+
+    # -- allocator hooks --------------------------------------------------
+
+    def _on_release(self, blk: int) -> bool:
+        """Refcount hit zero: keep registered blocks as evictable LRU
+        holds (newest at the back); unregistered blocks go free."""
+        if blk in self._key_of:
+            self._lru[blk] = None
+            return True
+        return False
+
+    def clear(self):
+        """Drop the whole index (allocator reset — every stored id is
+        dangling)."""
+        self._map.clear()
+        self._key_of.clear()
+        self._children.clear()
+        self._lru.clear()
+
+    # -- introspection ----------------------------------------------------
+
+    @property
+    def num_cached_blocks(self) -> int:
+        """Registered blocks (shared-or-shareable index size)."""
+        return len(self._key_of)
+
+    @property
+    def num_evictable(self) -> int:
+        """Ref-0 holds reclaimable by :meth:`evict`."""
+        return len(self._lru)
+
+    def held_blocks(self) -> Set[int]:
+        return set(self._lru)
+
+    def is_registered(self, blk: int) -> bool:
+        return blk in self._key_of
+
+    # -- the index --------------------------------------------------------
+
+    def match(self, tokens: List[int]) -> List[int]:
+        """Longest cached run of ``tokens``' full-block chunks, as
+        physical block ids with one ref taken per block (LRU holds are
+        reactivated out of the evictable set).  The caller either
+        commits the blocks into a table or returns them via
+        :meth:`cancel` — never both."""
+        bs = self.block_size
+        out: List[int] = []
+        parent = ROOT
+        for i in range(len(tokens) // bs):
+            blk = self._map.get((parent, tuple(tokens[i * bs:(i + 1) * bs])))
+            if blk is None:
+                break
+            if blk in self._lru:
+                del self._lru[blk]
+                self.allocator.adopt(blk)
+            else:
+                self.allocator.incref([blk])
+            out.append(blk)
+            parent = blk
+        return out
+
+    def cancel(self, blocks: List[int]):
+        """Undo :meth:`match`'s refs for an admission that didn't go
+        through (registered blocks drop back into the LRU via the
+        release hook)."""
+        self.allocator.free(blocks)
+
+    def register(self, parent: int, chunk: Tuple[int, ...],
+                 blk: int) -> bool:
+        """Index the full block ``blk`` holding ``chunk`` under its
+        chain ``parent``.  First registration wins: if the key already
+        maps to ANOTHER block (two requests prefilled the same content
+        independently) the existing entry stays and this block remains
+        private — the caller must then stop registering descendants,
+        whose chain would dangle off an unindexed id.  Returns whether
+        ``blk`` is the indexed block for this key."""
+        if len(chunk) != self.block_size:
+            raise ValueError(
+                f"register needs a full block of {self.block_size} "
+                f"tokens; got {len(chunk)}")
+        key = (parent, tuple(chunk))
+        cur = self._map.get(key)
+        if cur is not None:
+            return cur == blk
+        if blk in self._key_of:
+            # same block under two keys would corrupt eviction; keep
+            # the first registration
+            return False
+        self._map[key] = blk
+        self._key_of[blk] = key
+        self._children.setdefault(parent, set()).add(blk)
+        return True
+
+    # -- eviction ---------------------------------------------------------
+
+    def evict(self, n: int = 1) -> int:
+        """Reclaim at least ``n`` blocks from the evictable LRU
+        (oldest first) back to the allocator's free list, cascading
+        each victim's registered subtree.  Returns how many blocks
+        actually freed (0 = nothing evictable)."""
+        freed = 0
+        while freed < n and self._lru:
+            blk = next(iter(self._lru))
+            freed += self._evict_subtree(blk)
+        if freed:
+            self.counters.incr("prefix_evicted_blocks", freed)
+        return freed
+
+    def _evict_subtree(self, blk: int) -> int:
+        """Unregister ``blk`` and every registered descendant; free the
+        ones sitting in the LRU (a descendant still referenced by a
+        live table merely loses shareability)."""
+        freed = 0
+        for child in list(self._children.get(blk, ())):
+            freed += self._evict_subtree(child)
+        self._unregister(blk)
+        if blk in self._lru:
+            del self._lru[blk]
+            self.allocator.release_to_free(blk)
+            freed += 1
+        return freed
+
+    def _unregister(self, blk: int):
+        key = self._key_of.pop(blk, None)
+        if key is None:
+            return
+        del self._map[key]
+        kids = self._children.get(key[0])
+        if kids is not None:
+            kids.discard(blk)
+            if not kids:
+                del self._children[key[0]]
+        self._children.pop(blk, None)
+
+    # -- invariants (tests + bench) ---------------------------------------
+
+    def audit(self):
+        """Index consistency: map/key_of are inverse bijections, chain
+        parents are indexed (or ROOT), LRU holds are registered and
+        ref-0, and no registered block is on the free list."""
+        assert len(self._map) == len(self._key_of)
+        for key, blk in self._map.items():
+            assert self._key_of.get(blk) == key
+            parent = key[0]
+            assert parent == ROOT or parent in self._key_of, \
+                f"block {blk} chained to unindexed parent {parent}"
+            assert blk in self._children.get(parent, ()), \
+                f"block {blk} missing from parent {parent}'s children"
+        for blk in self._lru:
+            assert blk in self._key_of, f"unregistered LRU hold {blk}"
+            assert self.allocator.refs(blk) == 0, \
+                f"LRU hold {blk} has refs {self.allocator.refs(blk)}"
+        for blk in self._key_of:
+            assert blk not in self.allocator._free_set, \
+                f"registered block {blk} is on the free list"

@@ -11,6 +11,31 @@ short request never waits for a long one to finish (the ~10x
 throughput result of iteration-level batching), and memory is
 committed a block at a time instead of worst-case up front.
 
+Two serving-perf layers ride on top (``docs/serving.md``):
+
+**Prefix caching** (:mod:`serving.prefix_cache`).  At admission the
+request's context is matched against the block-level prefix index;
+matched full blocks enter the table SHARED (one
+``BlockAllocator.incref`` per table) and only the uncached tail is
+prefilled.  Blocks are registered into the index as they fill (during
+prefill chunks and as decode crosses block boundaries), and a
+finished request's registered blocks are held evictable-LRU instead
+of freed — reclaimed by :meth:`Scheduler._try_alloc` only when the
+pool actually runs low.  When the ENTIRE context is cached (token
+count block-aligned and fully matched) the last matched block is
+duplicated copy-on-write — the request must recompute the final
+token's logits and re-write its K/V, which may not touch a shared
+block; the engine performs the device copy and :meth:`cow_done` drops
+the extra ref.
+
+**Chunked prefill** (Sarathi-style).  :meth:`prefill_plan` hands out
+the uncached tail ``chunk_size`` tokens at a time; the step loop runs
+ONE chunk per prefilling request per iteration, interleaved with the
+decode step, so a long prompt stalls running decodes by at most one
+chunk rather than one full prefill.  The chunk engine program carries
+the KV position (``start``), so generation is bit-stable across any
+chunking of the same context.
+
 The scheduler is pure host-side bookkeeping over the engine's
 geometry; it never touches device arrays.  ``serving.api`` composes it
 with the :class:`serving.engine.DecodeEngine` into the step loop.
@@ -21,7 +46,9 @@ pseudo-prompt.  The already-sampled tokens are NOT re-sampled — the
 re-prefilled context is ``prompt + generated[:-1]``, its logits are
 discarded, and the pending last token re-enters the decode loop
 unchanged — so generation is bit-stable across preemptions under
-greedy decoding.
+greedy decoding.  (With the prefix cache on, the victim's registered
+blocks usually survive as LRU holds and re-admission matches them
+back — preemption recovery becomes a cache hit.)
 
 Failure isolation: a pathological request fails ALONE.  A request
 whose context can never fit the pool — at admission or by outgrowing
@@ -40,11 +67,18 @@ from __future__ import annotations
 import dataclasses
 import itertools
 from collections import deque
-from typing import Deque, Dict, List, Optional
+from typing import Deque, Dict, List, Optional, Tuple
 
 from apex_tpu.serving.kv_cache import BlockAllocator
+from apex_tpu.serving.prefix_cache import ROOT, PrefixCache
 
 _uid = itertools.count()
+
+# registration-cursor sentinel: once a request's chain breaks (COW
+# duplicate or a key collision) none of its later blocks may register —
+# their chain parent is unindexed, and an entry dangling off a reusable
+# block id could alias onto garbage after that id is reallocated
+_REG_STOPPED = 1 << 60
 
 
 class QueueFullError(RuntimeError):
@@ -81,9 +115,27 @@ class Request:
     finish_reason: Optional[str] = None
     preemptions: int = 0
 
+    # prefill state machine (owned by the scheduler): the context being
+    # chunk-prefilled, whether the final chunk's logits sample a token
+    # (False after preemption — the pending token continues instead),
+    # an admission-time COW copy the engine must perform before the
+    # first chunk, prefix-cache accounting, and the block-registration
+    # cursor (full blocks [0, _reg_blocks) are already in the index)
+    prefill_ctx: Optional[List[int]] = None
+    prefill_sample: bool = True
+    pending_cow: Optional[Tuple[int, int]] = None   # (src, dst)
+    cached_prefix_tokens: int = 0
+    _reg_blocks: int = 0
+
     @property
     def running(self) -> bool:
         return self.slot >= 0 and not self.finished
+
+    @property
+    def prefilling(self) -> bool:
+        """Admitted but with context K/V still being materialized — the
+        decode batch skips it until the last chunk lands."""
+        return self.prefill_ctx is not None
 
     def record_token(self, token: int) -> None:
         """Account one sampled token and evaluate termination."""
@@ -105,12 +157,20 @@ class Scheduler:
     request, and the shared :class:`BlockAllocator`.  ``max_waiting``
     bounds the waiting queue (:class:`QueueFullError` past it);
     ``counters`` is an optional :class:`apex_tpu.utils.CounterMeter`
-    fed one ``requests_failed_<reason>`` increment per failure."""
+    fed one ``requests_failed_<reason>`` increment per failure.
+
+    ``prefix_cache``: optional :class:`PrefixCache` enabling
+    block-level prefix sharing at admission (None = every prompt
+    prefills from scratch, the pre-cache behavior).  ``chunk_size``:
+    prefill tail chunk in tokens (None = the whole tail in one
+    :meth:`prefill_plan` call, i.e. chunked prefill off)."""
 
     def __init__(self, allocator: BlockAllocator, *,
                  max_batch_size: int, block_size: int,
                  max_context: int, max_waiting: Optional[int] = None,
-                 counters=None):
+                 counters=None,
+                 prefix_cache: Optional[PrefixCache] = None,
+                 chunk_size: Optional[int] = None):
         self.allocator = allocator
         self.max_batch_size = max_batch_size
         self.block_size = block_size
@@ -118,8 +178,13 @@ class Scheduler:
         if max_waiting is not None and max_waiting < 1:
             raise ValueError(
                 f"max_waiting must be >= 1, got {max_waiting}")
+        if chunk_size is not None and chunk_size < 1:
+            raise ValueError(
+                f"chunk_size must be >= 1, got {chunk_size}")
         self.max_waiting = max_waiting
         self.counters = counters
+        self.prefix_cache = prefix_cache
+        self.chunk_size = chunk_size
         self.waiting: Deque[Request] = deque()
         self.running: Dict[int, Request] = {}      # slot -> request
         self._free_slots = list(range(max_batch_size - 1, -1, -1))
@@ -162,13 +227,30 @@ class Scheduler:
     def has_work(self) -> bool:
         return bool(self.waiting or self.running)
 
+    # -- allocation with cache pressure -----------------------------------
+
+    def _try_alloc(self, n: int) -> Optional[List[int]]:
+        """Allocate ``n`` blocks, evicting prefix-cache LRU holds as
+        needed; None when the pool is genuinely dry (nothing free,
+        nothing evictable)."""
+        if n <= 0:
+            return []
+        while not self.allocator.can_alloc(n):
+            if self.prefix_cache is None or not self.prefix_cache.evict(1):
+                return None
+        return self.allocator.alloc(n)
+
     # -- iteration-level decisions ---------------------------------------
 
     def admit(self) -> List[Request]:
         """Fill free slots from the waiting queue (FIFO) while the
         pool can hold each candidate's prefill context plus one decode
-        block.  Returns the newly admitted requests, which the caller
-        must prefill before the next decode step.
+        block.  Matched prefix blocks come shared from the cache; only
+        the uncached tail needs fresh blocks (and one extra for a
+        whole-context match's COW duplicate).  Returns the newly
+        admitted requests, now in the prefilling state — the caller
+        runs their chunks via :meth:`prefill_plan` (resolving any
+        ``pending_cow`` first).
 
         A head request whose context can NEVER fit — it needs more
         blocks than the whole pool owns — is failed alone with
@@ -176,24 +258,57 @@ class Scheduler:
         next waiting request; one oversized request must not raise
         into the step loop or wedge the queue behind it."""
         admitted = []
+        bs = self.block_size
         pool_blocks = self.allocator.cfg.num_blocks - 1
         while self.waiting and self._free_slots:
             req = self.waiting[0]
             ctx = self._prefill_context(req)
-            need = BlockAllocator.blocks_for(len(ctx) + 1,
-                                             self.block_size)
+            need = BlockAllocator.blocks_for(len(ctx) + 1, bs)
             if need > pool_blocks:
                 self.fail(req, "capacity")
                 continue
-            if not self.allocator.can_alloc(need):
+            matched = (self.prefix_cache.match(ctx)
+                       if self.prefix_cache is not None else [])
+            hit = len(matched) * bs
+            # a whole-context match (len(ctx) block-aligned and every
+            # block cached) still must recompute the last token's
+            # logits — and its K/V write may not land in a shared
+            # block, so the final matched block is duplicated COW
+            cow = bool(matched) and hit >= len(ctx)
+            fresh = self._try_alloc(need - len(matched) + (1 if cow else 0))
+            if fresh is None:
+                if matched:
+                    self.prefix_cache.cancel(matched)
                 break               # fits once running requests retire
             self.waiting.popleft()
             req.slot = self._free_slots.pop()
-            req.block_table = self.allocator.alloc(need)
-            req.num_cached = 0          # set by the caller post-prefill
+            if cow:
+                req.pending_cow = (matched[-1], fresh[0])
+                req.block_table = matched[:-1] + [fresh[0]] + fresh[1:]
+                req.num_cached = len(ctx) - 1
+            else:
+                req.block_table = matched + fresh
+                req.num_cached = hit
+            req.cached_prefix_tokens = min(hit, len(ctx))
+            req.prefill_ctx = ctx
+            req.prefill_sample = not req.generated
+            # matched full blocks are already indexed; start the
+            # registration cursor past them.  A COW duplicate stays
+            # private (its key belongs to the original), which breaks
+            # the chain — registration stops for good (_REG_STOPPED)
+            req._reg_blocks = _REG_STOPPED if cow else len(matched)
             self.running[req.slot] = req
             self._admit_order.append(req)
             admitted.append(req)
+            if self.prefix_cache is not None:
+                c = self.prefix_cache.counters
+                c.incr("prefix_hit_tokens", req.cached_prefix_tokens)
+                c.incr("prefix_miss_tokens",
+                       len(ctx) - req.cached_prefix_tokens)
+                c.incr("prefix_hit_requests" if matched
+                       else "prefix_miss_requests")
+                if cow:
+                    c.incr("prefix_cow_blocks")
         return admitted
 
     def _prefill_context(self, req: Request) -> List[int]:
@@ -204,25 +319,73 @@ class Scheduler:
             return req.prompt + req.generated[:-1]
         return list(req.prompt)
 
-    def prefill_plan(self, req: Request):
-        """(context_tokens, reuse_last_logits): when the context is
-        the pristine prompt the prefill's logits sample the first
-        token; after preemption they are discarded and the pending
-        ``next_input`` continues instead."""
-        ctx = self._prefill_context(req)
-        return ctx, bool(req.generated)
+    def cow_done(self, req: Request) -> None:
+        """The engine finished duplicating ``pending_cow``; drop the
+        admission's extra ref on the shared source block."""
+        src, _ = req.pending_cow
+        req.pending_cow = None
+        self.allocator.free([src])
+
+    def prefill_plan(self, req: Request) -> Tuple[List[int], int, bool]:
+        """The next chunk of ``req``'s pending prefill:
+        ``(tokens, start, is_last)`` with ``start`` the absolute
+        position of ``tokens[0]`` (== K/V already materialized).
+        ``chunk_size=None`` returns the whole remaining tail at once.
+        The caller runs the chunk through the engine, then
+        :meth:`chunk_done`."""
+        ctx = req.prefill_ctx
+        assert ctx is not None, "prefill_plan on a non-prefilling request"
+        start = req.num_cached
+        n = len(ctx) - start
+        if self.chunk_size is not None:
+            n = min(n, self.chunk_size)
+        return ctx[start:start + n], start, start + n == len(ctx)
+
+    def chunk_done(self, req: Request, n: int) -> bool:
+        """Account ``n`` freshly prefilled tokens; registers any newly
+        full blocks into the prefix index.  True = the prefill is
+        complete and ``req`` joins the decode batch (the caller samples
+        from the final chunk's logits when ``req.prefill_sample``)."""
+        req.num_cached += n
+        self.register_progress(req)
+        if req.num_cached == len(req.prefill_ctx):
+            req.prefill_ctx = None
+            return True
+        return False
+
+    def register_progress(self, req: Request) -> None:
+        """Index every newly FULL block of ``req`` (prefill chunks and
+        decode steps crossing a block boundary).  Stops for good at the
+        first chain collision — descendants of an unindexed block can
+        never be matched."""
+        if self.prefix_cache is None:
+            return
+        bs = self.block_size
+        full = req.num_cached // bs
+        seq = req.prompt + req.generated
+        while req._reg_blocks < full:
+            i = req._reg_blocks
+            parent = req.block_table[i - 1] if i else ROOT
+            if not self.prefix_cache.register(
+                    parent, tuple(seq[i * bs:(i + 1) * bs]),
+                    req.block_table[i]):
+                req._reg_blocks = _REG_STOPPED  # chain broken for good
+                break
+            req._reg_blocks += 1
 
     def ensure_decode_capacity(self, req: Request) -> bool:
         """Grow ``req``'s block table if its next token write needs a
-        fresh block, preempting younger requests while the pool is
-        dry.  False = ``req`` has outgrown the pool with nothing left
-        to preempt (it is alone and the pool is STILL dry); the caller
-        must fail it with ``finish_reason="capacity"`` — preempting it
-        would livelock, and raising would take the whole batch down."""
+        fresh block — evicting idle prefix-cache holds first, then
+        preempting younger requests while the pool stays dry.  False =
+        ``req`` has outgrown the pool with nothing left to evict or
+        preempt; the caller must fail it with
+        ``finish_reason="capacity"`` — preempting it would livelock,
+        and raising would take the whole batch down."""
         need_blocks = req.num_cached // self.block_size + 1
         while len(req.block_table) < need_blocks:
-            if self.allocator.can_alloc(1):
-                req.block_table.extend(self.allocator.alloc(1))
+            fresh = self._try_alloc(1)
+            if fresh is not None:
+                req.block_table.extend(fresh)
                 continue
             victim = self._youngest_running(exclude=req)
             if victim is None:
@@ -246,8 +409,11 @@ class Scheduler:
         self.waiting.appendleft(req)
 
     def retire(self, req: Request) -> None:
-        """Return a finished request's slot and blocks to the pools."""
+        """Return a finished request's slot and blocks to the pools
+        (registered blocks become evictable cache holds — the shared
+        prefix outlives the request)."""
         assert req.finished, "retire() is for finished requests"
+        self.register_progress(req)
         self._release(req)
         self.finished.append(req)
 
@@ -273,6 +439,55 @@ class Scheduler:
         self._admit_order.remove(req)
         self._free_slots.append(req.slot)
         req.slot = -1
+        req.prefill_ctx = None
+        req._reg_blocks = 0
+        req.cached_prefix_tokens = 0
+        if req.pending_cow is not None:
+            # admission COW never executed (failed/preempted before the
+            # engine ran): drop the extra ref on the shared source
+            self.allocator.free([req.pending_cow[0]])
+            req.pending_cow = None
         if req.block_table:
             self.allocator.free(req.block_table)
             req.block_table = []
+
+    # -- invariants (tests + bench) ---------------------------------------
+
+    def audit(self) -> None:
+        """Refcount/free-list invariants, asserted after scheduler
+        steps in tests and the bench smoke: every block's refcount
+        equals the number of running tables referencing it (plus a
+        pending COW's source hold), ref-0 blocks are exactly free XOR
+        cache-held, the free list and free set mirror each other, and
+        waiting requests hold nothing."""
+        alloc = self.allocator
+        table_refs: Dict[int, int] = {}
+        for req in self.running.values():
+            for b in req.block_table:
+                table_refs[b] = table_refs.get(b, 0) + 1
+            if req.pending_cow is not None:
+                src = req.pending_cow[0]
+                table_refs[src] = table_refs.get(src, 0) + 1
+        for req in self.waiting:
+            assert not req.block_table, \
+                f"waiting request {req.uid} holds blocks"
+            assert req.pending_cow is None
+        free = set(alloc._free)
+        assert len(alloc._free) == len(free) == len(alloc._free_set)
+        assert free == alloc._free_set, "free list / free set diverged"
+        held = (self.prefix_cache.held_blocks()
+                if self.prefix_cache is not None else set())
+        for b in range(1, alloc.cfg.num_blocks):
+            r = alloc.refs(b)
+            t = table_refs.get(b, 0)
+            assert r == t, \
+                f"block {b}: refcount {r} != {t} table references"
+            if r == 0:
+                assert (b in free) != (b in held), \
+                    (f"ref-0 block {b}: free={b in free} "
+                     f"held={b in held} (must be exactly one)")
+            else:
+                assert b not in free and b not in held, \
+                    f"live block {b} also free/held"
+        if self.prefix_cache is not None:
+            self.prefix_cache.audit()

@@ -84,6 +84,14 @@ class CounterMeter:
     def total(self) -> int:
         return sum(self._counts.values())
 
+    def ratio(self, num: str, *parts: str) -> float:
+        """``count(num) / sum(count(p) for p in parts)`` with a 0.0
+        empty-denominator convention — the hit-rate helper
+        (``ratio("hits", "hits", "misses")``) for stats derived from
+        counter pairs."""
+        den = sum(self.count(p) for p in parts)
+        return self.count(num) / den if den else 0.0
+
     def as_dict(self) -> dict:
         """Stable-ordered snapshot for logs/stats."""
         return {k: self._counts[k] for k in sorted(self._counts)}

@@ -13,6 +13,8 @@ import numpy as np
 import pytest
 
 from apex_tpu.ops.flash_attention import (
+    FLASH_AUTO_MIN_SEQ,
+    _auto_use_pallas,
     _reference,
     flash_attention,
     make_flash_attention,
@@ -147,6 +149,50 @@ def test_adapter_in_bert():
     got = flash.apply(variables, ids, mask)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-4)
+
+
+class TestAutoPathDecisionTable:
+    """The use_pallas=None TPU auto path routes short sequences to XLA
+    attention (BENCH_NOTES r5: flash LOSES at s128/s512 inside BERT,
+    wins past 512 and at 16k).  The decision is a pure function pinned
+    here shape-for-shape so a threshold change is a deliberate edit,
+    not drift."""
+
+    def test_threshold_value_pinned(self):
+        assert FLASH_AUTO_MIN_SEQ == 512
+
+    @pytest.mark.parametrize("sq,sk,want", [
+        (128, 128, False),     # BERT-base s128: XLA 0.532 vs flash 0.392
+        (512, 512, False),     # s512: XLA at best ties; stay on XLA
+        (513, 513, True),      # strictly past the crossover
+        (1024, 1024, True),    # gpt s1024 causal: flash 1.81x
+        (16384, 16384, True),  # the long-context leg flash exists for
+        (1, 1, False),
+        # cross-attention: the LONGER side decides (the score tensor
+        # is Sq x Sk; one long side already blows the XLA fusion)
+        (128, 1024, True),
+        (1024, 128, True),
+        (128, 512, False),
+    ])
+    def test_seq_length_table(self, sq, sk, want):
+        assert _auto_use_pallas(sq, sk) is want
+
+    def test_dropout_always_takes_the_kernel(self):
+        # in-kernel dropout avoids the (Sq, Sk) probs tensor in HBM
+        # at ANY length — memory, not throughput, decides
+        assert _auto_use_pallas(128, 128, dropout_rate=0.1) is True
+        assert _auto_use_pallas(16, 16, dropout_rate=0.5) is True
+        assert _auto_use_pallas(128, 128, dropout_rate=0.0) is False
+
+    def test_explicit_use_pallas_bypasses_threshold(self):
+        """use_pallas=True at a short length still runs the kernel
+        (every parity test in this file relies on that)."""
+        q, k, v = _qkv(1, 64, 2, 16, seed=9)
+        got = flash_attention(q, k, v, use_pallas=True, interpret=True,
+                              block_q=BQ, block_k=BK)
+        want = _reference(q, k, v, None, False, 1.0 / math.sqrt(16))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
 
 
 def test_adapter_rejects_bad_bias_and_dropout():

@@ -159,7 +159,9 @@ def test_flash_gate_reroutes(monkeypatch):
     fa = importlib.import_module("apex_tpu.ops.flash_attention")
 
     _boobytrap(monkeypatch, fa, "_flash")
-    q = jnp.ones((2, 16, 2, 8), jnp.float32) * 0.1
+    # above FLASH_AUTO_MIN_SEQ — the auto path routes shorter
+    # sequences to XLA attention and would never reach the kernel
+    q = jnp.ones((2, 1024, 2, 8), jnp.float32) * 0.1
     k, v = q * 0.5, q * 0.25
 
     with pytest.raises(AssertionError, match="Pallas path taken"):

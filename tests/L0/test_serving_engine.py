@@ -21,7 +21,7 @@ import pytest
 
 from apex_tpu import models
 from apex_tpu.serving import InferenceServer
-from apex_tpu.serving.engine import default_prefill_buckets
+from apex_tpu.serving.engine import default_prefill_buckets, pick_bucket
 
 pytestmark = pytest.mark.serving
 
@@ -122,7 +122,10 @@ def test_preemption_is_bit_stable(tiny):
         assert o == naive_generate(oracle_step, p, 24), p
     st = server.stats()
     assert st["preemptions"] >= 1             # pressure actually hit
-    assert st["kv_blocks_free"] == 9          # everything came back
+    # everything came back: free outright or held evictable by the
+    # prefix cache (still reclaimable — the hold IS the feature)
+    assert st["kv_blocks_free"] + st["kv_blocks_evictable"] == 9
+    server.scheduler.audit()
 
 
 def test_eos_terminates_early_and_frees_resources(tiny):
@@ -137,8 +140,11 @@ def test_eos_terminates_early_and_frees_resources(tiny):
     out = server.generate([prompt], max_new_tokens=32, eos_id=eos)[0]
     assert out == ref[:stop]
     assert server.scheduler.finished[0].finish_reason == "eos"
-    assert server.engine.allocator.num_free == \
+    # all blocks reclaimable: free list + evictable prefix-cache holds
+    assert server.engine.allocator.num_free \
+        + server.scheduler.prefix_cache.num_evictable == \
         server.engine.cache_cfg.num_blocks - 1
+    server.scheduler.audit()
 
 
 def test_default_cache_dtype_is_half_and_still_generates(tiny):
@@ -171,3 +177,57 @@ def test_prefill_buckets_ladder():
     assert default_prefill_buckets(128) == (16, 32, 64, 128)
     assert default_prefill_buckets(100) == (16, 32, 64, 100)
     assert default_prefill_buckets(16) == (16,)
+
+
+def test_prefill_buckets_edge_cases():
+    """max_context off the power-of-two grid, below the first rung,
+    and between rungs — the ladder must always top out at exactly
+    max_context and never emit a rung above it."""
+    # non-power-of-two tops cap the ladder without a pow2 overshoot
+    assert default_prefill_buckets(100) == (16, 32, 64, 100)
+    assert default_prefill_buckets(33) == (16, 32, 33)
+    # smaller than the first rung: the single bucket IS max_context
+    assert default_prefill_buckets(10) == (10,)
+    assert default_prefill_buckets(1) == (1,)
+    # exactly a rung: no duplicate, no extra rung above
+    assert default_prefill_buckets(64) == (16, 32, 64)
+    for top in (1, 10, 33, 64, 100, 128):
+        buckets = default_prefill_buckets(top)
+        assert buckets[-1] == top
+        assert list(buckets) == sorted(set(buckets))
+
+
+def test_bucket_for_exact_boundaries():
+    """pick_bucket at and around every rung: exact lengths land on
+    their own rung (no padding), rung+1 rolls to the next, and lengths
+    past the top raise instead of silently clamping."""
+    buckets = (16, 32, 64, 100)
+    assert pick_bucket(1, buckets) == 16
+    assert pick_bucket(16, buckets) == 16      # exact rung: no roll
+    assert pick_bucket(17, buckets) == 32
+    assert pick_bucket(32, buckets) == 32
+    assert pick_bucket(33, buckets) == 64
+    assert pick_bucket(64, buckets) == 64
+    assert pick_bucket(65, buckets) == 100     # non-pow2 top rung
+    assert pick_bucket(100, buckets) == 100
+    with pytest.raises(ValueError):
+        pick_bucket(101, buckets)
+    # the degenerate single-rung ladder (max_context < smallest)
+    assert pick_bucket(10, (10,)) == 10
+    with pytest.raises(ValueError):
+        pick_bucket(11, (10,))
+
+
+def test_engine_bucket_for_matches_pick_bucket(tiny):
+    """DecodeEngine.bucket_for is pick_bucket over its own ladder, and
+    names max_context in its overflow error."""
+    cfg, params, _ = tiny
+    server = InferenceServer(cfg, params, max_batch_size=2,
+                             max_context=100, block_size=8,
+                             cache_dtype=jnp.float32)
+    eng = server.engine
+    assert eng.prefill_buckets == (16, 32, 64, 100)
+    for n in (1, 16, 17, 99, 100):
+        assert eng.bucket_for(n) == pick_bucket(n, eng.prefill_buckets)
+    with pytest.raises(ValueError, match="max_context"):
+        eng.bucket_for(101)

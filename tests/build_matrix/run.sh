@@ -78,6 +78,16 @@ echo "=== build-matrix axis: serving-smoke ==="
 env JAX_PLATFORMS=cpu python tools/serving_bench.py --smoke --out -
 results[serving]=$?
 
+# serving-perf smoke: prefix caching + chunked prefill — asserts the
+# >= 2x TTFT floor on a shared-system-prompt workload vs cacheless,
+# that the monolithic prefill stall is >= 2x the chunked one, and
+# cached-vs-cacheless / chunked-vs-monolithic greedy-token parity,
+# with the scheduler refcount audit after every step of both
+# workloads (tools/serving_bench.py --shared-prefix, docs/serving.md)
+echo "=== build-matrix axis: serving-prefix-smoke ==="
+env JAX_PLATFORMS=cpu python tools/serving_bench.py --smoke --shared-prefix --out -
+results[serving_prefix]=$?
+
 echo
 echo "=== build-matrix results ==="
 rc=0

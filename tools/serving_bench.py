@@ -25,11 +25,35 @@ repo root unless ``--out`` says otherwise)::
 request set, and a hard floor assertion (speedup >= 2x — the
 acceptance bar; on CPU the measured margin is far above it).
 
+``--shared-prefix`` switches to the serving-perf workloads of
+docs/serving.md's prefix-caching/chunked-prefill section (one JSON
+record to ``BENCH_serving_prefix.json``):
+
+- *shared-system-prompt TTFT*: every request = one shared prefix +
+  a private tail; median time-to-first-token with the prefix cache
+  on vs off (both chunked, same warmed compiles).  Token-for-token
+  parity between the two servers is always asserted; ``--smoke``
+  additionally asserts the >= 2x TTFT floor and that every timed
+  request hit the cache.
+- *long-prompt interference*: short requests are decoding when a
+  near-max-context prompt arrives; the stall is the worst single
+  step wall time until that prompt finishes, chunked vs monolithic
+  prefill.  Parity always asserted; ``--smoke`` asserts the
+  monolithic stall is >= 2x the chunked one (decode stalls bounded
+  by one chunk, not one full prefill).
+
+Both workloads run ``Scheduler.audit()`` after every step — the
+refcount/free-list invariant holds under the whole measured traffic,
+not just the unit tests.
+
 Usage:
     python tools/serving_bench.py --smoke
+    python tools/serving_bench.py --smoke --shared-prefix
     python tools/serving_bench.py [--requests 32] [--max-new 64]
         [--batch-size 8] [--hidden 256] [--layers 4] [--heads 8]
         [--max-context 512] [--seed 0] [--out BENCH_serving.json]
+    python tools/serving_bench.py --shared-prefix [--prefix-len 256]
+        [--tail-len 16] [--chunk 64] [--long-prompt 448] [--repeats 3]
 """
 
 import argparse
@@ -141,6 +165,195 @@ def run_naive(cfg, m, params, prompts, args):
     return total / dt, outs
 
 
+def _step_audited(server):
+    """One timed server step with the refcount invariant checked
+    AFTER the timer stops — audit cost never pollutes the numbers."""
+    t0 = time.perf_counter()
+    server.step()
+    dt = time.perf_counter() - t0
+    server.scheduler.audit()
+    return dt
+
+
+def _median(vals):
+    s = sorted(vals)
+    return s[len(s) // 2]
+
+
+def _build_prefix_servers(cfg, params, args):
+    """The three feature corners the A/Bs need: (cached+chunked,
+    cacheless+chunked, cacheless+monolithic).  The middle one is both
+    the TTFT baseline and the interference treatment, so three servers
+    cover two experiments' four arms."""
+    import jax.numpy as jnp
+    from apex_tpu.serving import InferenceServer
+
+    def mk(cache, chunk):
+        return InferenceServer(
+            cfg, params, max_batch_size=args.batch_size,
+            max_context=args.max_context, block_size=args.block_size,
+            cache_dtype=jnp.float32, enable_prefix_cache=cache,
+            enable_chunked_prefill=chunk is not None,
+            prefill_chunk=chunk)
+
+    return (mk(True, args.chunk), mk(False, args.chunk),
+            mk(False, None))
+
+
+def run_shared_prefix_ttft(servers, args):
+    """Median TTFT over a shared-system-prompt workload, prefix cache
+    on vs off.  Requests run one at a time (TTFT isolated from
+    batching effects); the warmup request both compiles every program
+    the window touches and — on the cached server — populates the
+    shared prefix, which is exactly the steady state of a
+    system-prompt deployment."""
+    rng = np.random.RandomState(args.seed + 1)
+    shared = list(rng.randint(0, args.vocab, size=args.prefix_len))
+    prompts = [shared + list(rng.randint(0, args.vocab,
+                                         size=args.tail_len))
+               for _ in range(args.requests)]
+
+    def measure(server):
+        server.generate([shared + [1]], max_new_tokens=2)
+        server.reset_meters()
+        ttfts, outs = [], []
+        for p in prompts:
+            req = server.submit(p, args.max_new)
+            ttft = 0.0
+            while not req.generated and not req.finished:
+                ttft += _step_audited(server)
+            ttfts.append(ttft)
+            while not req.finished:
+                _step_audited(server)
+            outs.append(list(req.generated))
+        return _median(ttfts), outs, server.stats()
+
+    cached_server, cacheless_server, _ = servers
+    t_cached, outs_cached, stats = measure(cached_server)
+    t_off, outs_off, _ = measure(cacheless_server)
+    return {
+        "ttft_ms_cached": round(t_cached * 1e3, 2),
+        "ttft_ms_cacheless": round(t_off * 1e3, 2),
+        "ttft_speedup": round(t_off / max(t_cached, 1e-9), 2),
+        "prefix_parity_mismatches": sum(
+            a != b for a, b in zip(outs_cached, outs_off)),
+        "prefix_hit_requests": stats.get("prefix_hit_requests", 0),
+        "prefix_hit_rate": stats.get("prefix_hit_rate", 0.0),
+        "prefix_stats": stats,
+    }
+
+
+def run_interference(servers, args):
+    """Worst decode stall while a near-max-context prompt prefills,
+    chunked vs monolithic.  The stall is the max single-step wall
+    time between the long prompt's submission and its completion —
+    with chunked prefill each such step carries one chunk; monolithic
+    carries the whole bucketed prefill.  min over repeats: the floor
+    of what each mode can do, immune to one-off scheduler noise (the
+    monolithic floor still contains a full prefill)."""
+    rng = np.random.RandomState(args.seed + 2)
+    decoders = [list(rng.randint(0, args.vocab, size=8))
+                for _ in range(2)]
+    long_prompt = list(rng.randint(0, args.vocab,
+                                   size=args.long_prompt))
+    decode_budget = 4 + 4 * max(
+        1, -(-args.long_prompt // (args.chunk or args.long_prompt)))
+
+    def measure(server):
+        server.generate([long_prompt, decoders[0]], max_new_tokens=2)
+        server.reset_meters()
+        stalls, outs = [], None
+        for _ in range(args.repeats):
+            short = [server.submit(p, decode_budget)
+                     for p in decoders]
+            for _ in range(4):          # decoders into steady decode
+                _step_audited(server)
+            longer = server.submit(long_prompt, 1)
+            window = []
+            while not longer.finished:
+                window.append(_step_audited(server))
+            stalls.append(max(window))
+            while server.scheduler.has_work:
+                _step_audited(server)
+            outs = [list(r.generated) for r in short] \
+                + [list(longer.generated)]
+        return min(stalls), outs
+
+    _, chunked_server, mono_server = servers
+    s_chunk, outs_chunk = measure(chunked_server)
+    s_mono, outs_mono = measure(mono_server)
+    return {
+        "stall_ms_chunked": round(s_chunk * 1e3, 2),
+        "stall_ms_monolithic": round(s_mono * 1e3, 2),
+        "stall_ratio": round(s_mono / max(s_chunk, 1e-9), 2),
+        "interference_parity_mismatches": sum(
+            a != b for a, b in zip(outs_chunk, outs_mono)),
+    }
+
+
+def run_shared_prefix_mode(args):
+    cfg, m, params = build_model(args)
+    servers = _build_prefix_servers(cfg, params, args)
+    record = {
+        "bench": "serving_prefix",
+        "mode": "smoke" if args.smoke else "full",
+        "config": {"requests": args.requests, "max_new": args.max_new,
+                   "batch_size": args.batch_size,
+                   "block_size": args.block_size,
+                   "hidden": args.hidden, "layers": args.layers,
+                   "heads": args.heads,
+                   "max_context": args.max_context,
+                   "vocab": args.vocab,
+                   "prefix_len": args.prefix_len,
+                   "tail_len": args.tail_len, "chunk": args.chunk,
+                   "long_prompt": args.long_prompt,
+                   "repeats": args.repeats},
+    }
+    record.update(run_shared_prefix_ttft(servers, args))
+    record.update(run_interference(servers, args))
+    print(json.dumps(record))
+
+    out = args.out
+    if out != "-":
+        if out is None:
+            out = os.path.join(
+                os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+                "BENCH_serving_prefix.json")
+        with open(out, "w") as f:
+            json.dump(record, f, indent=1)
+            f.write("\n")
+
+    rc = 0
+    if record["prefix_parity_mismatches"]:
+        print(f"FAIL: {record['prefix_parity_mismatches']} requests "
+              "diverged between cached and cacheless greedy decode",
+              file=sys.stderr)
+        rc = 1
+    if record["interference_parity_mismatches"]:
+        print(f"FAIL: {record['interference_parity_mismatches']} "
+              "requests diverged between chunked and monolithic "
+              "prefill", file=sys.stderr)
+        rc = 1
+    if args.smoke:
+        if record["ttft_speedup"] < 2.0:
+            print(f"FAIL: shared-prefix TTFT speedup "
+                  f"{record['ttft_speedup']} < 2.0x floor",
+                  file=sys.stderr)
+            rc = 1
+        if record["prefix_hit_requests"] < args.requests:
+            print(f"FAIL: only {record['prefix_hit_requests']}/"
+                  f"{args.requests} timed requests hit the prefix "
+                  "cache", file=sys.stderr)
+            rc = 1
+        if record["stall_ratio"] < 2.0:
+            print(f"FAIL: monolithic/chunked stall ratio "
+                  f"{record['stall_ratio']} < 2.0x — chunked prefill "
+                  "is not bounding the decode stall", file=sys.stderr)
+            rc = 1
+    return rc
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--smoke", action="store_true",
@@ -158,7 +371,24 @@ def main():
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default=None,
                     help="JSON record path (default: repo-root "
-                    "BENCH_serving.json; '-' = stdout only)")
+                    "BENCH_serving.json, or BENCH_serving_prefix.json "
+                    "with --shared-prefix; '-' = stdout only)")
+    ap.add_argument("--shared-prefix", action="store_true",
+                    help="run the prefix-cache TTFT and long-prompt "
+                    "interference workloads instead of the "
+                    "continuous-vs-naive throughput compare")
+    ap.add_argument("--prefix-len", type=int, default=None,
+                    help="shared system-prompt length in tokens "
+                    "(default: max_context // 2)")
+    ap.add_argument("--tail-len", type=int, default=16,
+                    help="private tail length per request")
+    ap.add_argument("--chunk", type=int, default=64,
+                    help="prefill chunk width for the chunked arms")
+    ap.add_argument("--long-prompt", type=int, default=None,
+                    help="interference prompt length (default: "
+                    "7/8 max_context)")
+    ap.add_argument("--repeats", type=int, default=3,
+                    help="interference repeats (min of maxes)")
     args = ap.parse_args()
 
     if args.smoke:
@@ -171,6 +401,24 @@ def main():
         args.layers = 2
         args.heads = 2
         args.max_context = 64
+        if args.shared_prefix:
+            # the prefix workloads need room for a long shared prefix
+            # and a near-max-context prompt; still toy-model CPU-safe
+            args.requests = 6
+            args.max_new = 8
+            args.hidden = 64
+            args.max_context = 512
+            args.prefix_len = 192
+            args.tail_len = 7
+            args.chunk = 32
+            args.long_prompt = 448
+
+    if args.shared_prefix:
+        if args.prefix_len is None:
+            args.prefix_len = args.max_context // 2
+        if args.long_prompt is None:
+            args.long_prompt = args.max_context * 7 // 8
+        return run_shared_prefix_mode(args)
 
     cfg, m, params = build_model(args)
     prompts = make_prompts(args)
