@@ -132,3 +132,26 @@ class LossScaler:
     # -- convenience -----------------------------------------------------
     def loss_scale(self, state: LossScalerState) -> jax.Array:
         return state.loss_scale
+
+    # -- telemetry --------------------------------------------------------
+    def observe(self, state: LossScalerState, registry, *,
+                prefix: str = "amp") -> None:
+        """Record the carried scaler state into a
+        :class:`apex_tpu.observability.MetricsRegistry`: the
+        loss-scale gauge (current/peak/running-mean over the calls =
+        the scale trajectory), the clean-step window gauge, and the
+        overflow-skip counter.
+
+        Host-side — reading the traced scalars forces a device sync,
+        so call it OUTSIDE jit at whatever cadence you log (every
+        step for the full trajectory, every N for cheap telemetry).
+        :class:`apex_tpu.resilience.TrainingSentry` does this per
+        step when built with ``registry=``; this hook is for training
+        loops that don't run under the sentry
+        (``docs/observability.md``)."""
+        registry.gauge(f"{prefix}_loss_scale").update(
+            float(state.loss_scale))
+        registry.gauge(f"{prefix}_unskipped_steps").update(
+            int(state.unskipped))
+        if bool(state.overflow):
+            registry.counter(f"{prefix}_overflow_steps").incr()

@@ -1,0 +1,58 @@
+"""apex_tpu.observability — unified telemetry for serving + training.
+
+Two pieces, both process-wide and dependency-free:
+
+- :mod:`observability.registry` — :class:`MetricsRegistry` of named,
+  optionally-labeled :class:`Counter` / :class:`Gauge` /
+  :class:`HistogramMeter` (log-bucketed, p50/p90/p99) metrics with
+  snapshot/diff semantics, JSON-lines emission, and Prometheus
+  text-format exposition.  The ``apex_tpu.utils`` meters become views
+  onto a registry when constructed with ``registry=``.
+- :mod:`observability.tracing` — :class:`SpanTracer`, a bounded
+  ring-buffer span tracer exporting Chrome trace-event JSON
+  (Perfetto-loadable).  Disabled by default (:data:`NULL_TRACER`,
+  zero overhead); ``APEX_TPU_TRACE=/path.json`` or
+  :func:`enable_tracing` turns it on.
+
+What is instrumented out of the box: the serving step loop (admit /
+prefix-match / chunk-prefill / decode / evict / preempt spans,
+per-request enqueue→admit→first-token→finish timelines feeding TTFT /
+queue-wait / decode-latency histograms in
+``InferenceServer.stats()``), engine compile events, checkpoint
+save/restore/publish, and the amp train step (step time, loss-scale
+trajectory, overflow skips).  See ``docs/observability.md``.
+"""
+
+from apex_tpu.observability.registry import (
+    Counter,
+    Gauge,
+    HistogramMeter,
+    MetricsRegistry,
+    series_key,
+    snapshot_diff,
+)
+from apex_tpu.observability.tracing import (
+    NULL_TRACER,
+    NullTracer,
+    SpanTracer,
+    TRACE_ENV,
+    enable_tracing,
+    get_tracer,
+    set_tracer,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "HistogramMeter",
+    "MetricsRegistry",
+    "NULL_TRACER",
+    "NullTracer",
+    "SpanTracer",
+    "TRACE_ENV",
+    "enable_tracing",
+    "get_tracer",
+    "series_key",
+    "set_tracer",
+    "snapshot_diff",
+]

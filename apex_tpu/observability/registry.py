@@ -1,0 +1,384 @@
+"""Process-wide metrics: named counters, gauges, log-bucketed histograms.
+
+The reference has no metrics story at all (nvtx ranges and
+``cudaProfilerStart/Stop`` are its whole observability surface); what
+this repo had grown — serving throughput/failure counters, checkpoint
+accounting, loss-scale state — lived in per-subsystem ad-hoc meters
+with no shared registry and no latency distributions.  This module is
+the shared substrate:
+
+- :class:`Counter` — monotonic (negative increments raise), optionally
+  labeled.
+- :class:`Gauge` — sampled level with current/peak/running-mean, the
+  semantics ``utils.GaugeMeter`` always had.
+- :class:`HistogramMeter` — log-bucketed latency distribution.  Bucket
+  boundaries are a geometric ladder (``low * growth**i`` capped at
+  ``high``); assignment is a ``bisect`` over the precomputed boundary
+  list, so the math is numpy-free, deterministic, and trivially
+  oracle-checkable.  Quantiles (:meth:`~HistogramMeter.quantile`,
+  ``p50``/``p90``/``p99``) interpolate rank position within the
+  bucket and clamp to the exact observed min/max.  The clock used by
+  :meth:`~HistogramMeter.time` is injectable for deterministic tests.
+- :class:`MetricsRegistry` — get-or-create by ``(name, labels)`` with
+  kind checking, :meth:`~MetricsRegistry.snapshot` /
+  :func:`snapshot_diff` semantics, JSON-lines emission
+  (:meth:`~MetricsRegistry.emit_jsonl`) and Prometheus text-format
+  exposition (:meth:`~MetricsRegistry.prometheus_text`).
+
+The existing ``apex_tpu.utils`` meters (``CounterMeter`` /
+``GaugeMeter``) become views onto these metrics when constructed with
+a ``registry=`` — their public behavior is unchanged
+(``docs/observability.md``).
+"""
+
+from __future__ import annotations
+
+import bisect
+import contextlib
+import json
+import math
+import threading
+import time
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+LabelItems = Tuple[Tuple[str, str], ...]
+
+
+def _label_items(labels: Dict[str, Any]) -> LabelItems:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def series_key(name: str, labels: LabelItems = ()) -> str:
+    """Prometheus-style series identity: ``name{k="v",...}`` with
+    labels sorted (``name`` alone when unlabeled) — the snapshot /
+    diff / exposition key."""
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return f"{name}{{{inner}}}"
+
+
+class Counter:
+    """Monotonic counter.  ``incr`` only counts up — a snapshot taken
+    later always dominates one taken earlier, which is what log
+    scrapers and :func:`snapshot_diff` rely on."""
+
+    __slots__ = ("name", "labels", "_value")
+    kind = "counter"
+
+    def __init__(self, name: str, labels: LabelItems = ()):
+        self.name = name
+        self.labels = labels
+        self._value = 0
+
+    def incr(self, n: int = 1) -> int:
+        if n < 0:
+            raise ValueError(
+                f"counter {series_key(self.name, self.labels)} is "
+                f"monotonic; incr({n}) would decrease it")
+        self._value += n
+        return self._value
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    def describe(self) -> Dict[str, Any]:
+        return {"type": "counter", "value": self._value}
+
+
+class Gauge:
+    """Current / peak / running-mean of a sampled level (the serving
+    queue-depth and batch-occupancy semantics)."""
+
+    __slots__ = ("name", "labels", "val", "peak", "sum", "count")
+    kind = "gauge"
+
+    def __init__(self, name: str, labels: LabelItems = ()):
+        self.name = name
+        self.labels = labels
+        self.reset()
+
+    def reset(self) -> None:
+        self.val = 0.0
+        self.peak = 0.0
+        self.sum = 0.0
+        self.count = 0
+
+    def update(self, val) -> None:
+        val = float(val)
+        self.val = val
+        self.peak = max(self.peak, val)
+        self.sum += val
+        self.count += 1
+
+    @property
+    def avg(self) -> float:
+        return self.sum / max(self.count, 1)
+
+    def describe(self) -> Dict[str, Any]:
+        return {"type": "gauge", "value": self.val, "peak": self.peak,
+                "avg": self.avg, "count": self.count}
+
+
+class HistogramMeter:
+    """Log-bucketed value distribution with interpolated quantiles.
+
+    ``bounds[i]`` is bucket ``i``'s inclusive upper edge; bucket 0
+    holds everything ``<= low`` and the last bucket everything above
+    ``high`` (clamped, never dropped).  Boundaries grow geometrically
+    by ``growth`` per bucket, so relative resolution is constant
+    across five-plus decades of latency for a few dozen integer
+    counts — no samples retained, O(1) record, numpy-free.
+
+    Defaults suit second-denominated latencies: 1us .. 60s at 2x
+    resolution (~26 buckets).
+    """
+
+    __slots__ = ("name", "labels", "bounds", "_counts", "count", "sum",
+                 "min", "max", "_clock")
+    kind = "histogram"
+
+    def __init__(self, name: str = "histogram", labels: LabelItems = (),
+                 *, low: float = 1e-6, high: float = 60.0,
+                 growth: float = 2.0, clock=time.perf_counter):
+        if low <= 0 or high <= low:
+            raise ValueError(
+                f"need 0 < low < high, got low={low} high={high}")
+        if growth <= 1.0:
+            raise ValueError(f"growth must be > 1, got {growth}")
+        self.name = name
+        self.labels = labels
+        bounds = [float(low)]
+        while bounds[-1] < high:
+            bounds.append(bounds[-1] * growth)
+        self.bounds: Tuple[float, ...] = tuple(bounds)
+        self._clock = clock
+        self.reset()
+
+    def reset(self) -> None:
+        self._counts = [0] * len(self.bounds)
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def bucket_index(self, value) -> int:
+        """The bucket ``value`` lands in: smallest ``i`` with
+        ``value <= bounds[i]``, clamped into the ladder."""
+        return min(bisect.bisect_left(self.bounds, float(value)),
+                   len(self.bounds) - 1)
+
+    def record(self, value) -> None:
+        value = float(value)
+        self._counts[self.bucket_index(value)] += 1
+        self.count += 1
+        self.sum += value
+        self.min = min(self.min, value)
+        self.max = max(self.max, value)
+
+    @contextlib.contextmanager
+    def time(self):
+        """``with hist.time(): ...`` records the block's wall time on
+        the injected clock."""
+        t0 = self._clock()
+        try:
+            yield
+        finally:
+            self.record(self._clock() - t0)
+
+    @property
+    def bucket_counts(self) -> Tuple[int, ...]:
+        return tuple(self._counts)
+
+    def quantile(self, q: float) -> float:
+        """Estimated ``q``-quantile: find the bucket holding the target
+        rank, interpolate the rank's position linearly between the
+        bucket's edges, clamp into the exact observed [min, max].  By
+        construction the estimate lands in the same bucket as the true
+        quantile."""
+        if self.count == 0:
+            return 0.0
+        q = min(max(float(q), 0.0), 1.0)
+        target = q * self.count
+        cum = 0
+        for i, c in enumerate(self._counts):
+            if c == 0:
+                continue
+            cum += c
+            if cum >= target:
+                lo = self.bounds[i - 1] if i else 0.0
+                hi = self.bounds[i]
+                frac = (target - (cum - c)) / c
+                est = lo + (hi - lo) * frac
+                return min(max(est, self.min), self.max)
+        return self.max
+
+    @property
+    def p50(self) -> float:
+        return self.quantile(0.50)
+
+    @property
+    def p90(self) -> float:
+        return self.quantile(0.90)
+
+    @property
+    def p99(self) -> float:
+        return self.quantile(0.99)
+
+    @property
+    def mean(self) -> float:
+        return self.sum / max(self.count, 1)
+
+    def describe(self) -> Dict[str, Any]:
+        out = {"type": "histogram", "count": self.count,
+               "sum": self.sum}
+        if self.count:
+            out.update(min=self.min, max=self.max, mean=self.mean,
+                       p50=self.p50, p90=self.p90, p99=self.p99)
+        return out
+
+
+class MetricsRegistry:
+    """Get-or-create metric store keyed on ``(name, labels)``.
+
+    One ``name`` is one kind for the registry's lifetime (reusing a
+    counter name as a gauge raises).  ``snapshot()`` returns a plain
+    JSON-able dict — series key to :meth:`describe` dict — and
+    :func:`snapshot_diff` turns two snapshots into per-series deltas.
+    ``clock`` stamps JSON-lines records (injectable for deterministic
+    emission tests); metric-internal clocks are per-histogram.
+    """
+
+    def __init__(self, clock=time.time):
+        self._clock = clock
+        self._metrics: Dict[Tuple[str, LabelItems], Any] = {}
+        self._kinds: Dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    # -- get-or-create ----------------------------------------------------
+
+    def _get(self, kind: str, name: str, labels: Dict[str, Any],
+             factory):
+        key = (name, _label_items(labels))
+        with self._lock:
+            have = self._kinds.setdefault(name, kind)
+            if have != kind:
+                raise ValueError(
+                    f"metric {name!r} is already registered as a "
+                    f"{have}, not a {kind}")
+            m = self._metrics.get(key)
+            if m is None:
+                m = factory(name, key[1])
+                self._metrics[key] = m
+            return m
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get("counter", name, labels, Counter)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get("gauge", name, labels, Gauge)
+
+    def histogram(self, name: str, *, low: float = 1e-6,
+                  high: float = 60.0, growth: float = 2.0,
+                  clock=time.perf_counter, **labels) -> HistogramMeter:
+        return self._get(
+            "histogram", name, labels,
+            lambda n, li: HistogramMeter(n, li, low=low, high=high,
+                                         growth=growth, clock=clock))
+
+    def metrics(self) -> Iterable:
+        with self._lock:
+            return list(self._metrics.values())
+
+    # -- snapshot / diff --------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """``{series_key: describe-dict}`` over every registered
+        metric — plain data, safe to json.dump or diff later."""
+        return {series_key(m.name, m.labels): m.describe()
+                for m in self.metrics()}
+
+    def emit_jsonl(self, path_or_file, *,
+                   extra: Optional[Dict[str, Any]] = None) -> None:
+        """Append one ``{"ts": ..., "metrics": snapshot}`` JSON line —
+        the scrape format ``tools/obs_dump.py`` pretty-prints."""
+        record = {"ts": self._clock(), "metrics": self.snapshot()}
+        if extra:
+            record.update(extra)
+        line = json.dumps(record, sort_keys=True)
+        if hasattr(path_or_file, "write"):
+            path_or_file.write(line + "\n")
+        else:
+            with open(path_or_file, "a") as f:
+                f.write(line + "\n")
+
+    # -- exposition -------------------------------------------------------
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition (v0.0.4): counters and gauges as
+        single series, histograms as cumulative ``_bucket{le=...}`` +
+        ``_sum`` / ``_count`` families."""
+        by_name: Dict[str, list] = {}
+        for m in self.metrics():
+            by_name.setdefault(m.name, []).append(m)
+        lines = []
+        for name in sorted(by_name):
+            kind = self._kinds[name]
+            lines.append(f"# TYPE {name} {kind}")
+            for m in sorted(by_name[name], key=lambda m: m.labels):
+                if kind == "counter":
+                    lines.append(
+                        f"{series_key(name, m.labels)} {m.value}")
+                elif kind == "gauge":
+                    lines.append(
+                        f"{series_key(name, m.labels)} {m.val}")
+                else:
+                    cum = 0
+                    for bound, c in zip(m.bounds, m.bucket_counts):
+                        cum += c
+                        le = m.labels + (("le", repr(bound)),)
+                        lines.append(
+                            f"{series_key(name + '_bucket', le)} {cum}")
+                    inf = m.labels + (("le", "+Inf"),)
+                    lines.append(
+                        f"{series_key(name + '_bucket', inf)} {m.count}")
+                    lines.append(
+                        f"{series_key(name + '_sum', m.labels)} {m.sum}")
+                    lines.append(
+                        f"{series_key(name + '_count', m.labels)} "
+                        f"{m.count}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+def snapshot_diff(old: Dict[str, Dict[str, Any]],
+                  new: Dict[str, Dict[str, Any]],
+                  ) -> Dict[str, Dict[str, Any]]:
+    """Per-series delta between two :meth:`MetricsRegistry.snapshot`
+    readings taken new-after-old: counters and histogram count/sum
+    report the increment (monotonic — a negative delta means the
+    snapshots were passed in the wrong order and raises), gauges
+    report the newer value.  Series absent from ``old`` diff against
+    zero."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for key, desc in new.items():
+        prev = old.get(key, {})
+        kind = desc["type"]
+        if kind == "counter":
+            delta = desc["value"] - prev.get("value", 0)
+            if delta < 0:
+                raise ValueError(
+                    f"counter {key} went backwards ({prev.get('value')}"
+                    f" -> {desc['value']}): snapshots out of order?")
+            out[key] = {"type": "counter", "delta": delta}
+        elif kind == "histogram":
+            dc = desc["count"] - prev.get("count", 0)
+            if dc < 0:
+                raise ValueError(
+                    f"histogram {key} count went backwards: snapshots "
+                    f"out of order?")
+            out[key] = {"type": "histogram", "count_delta": dc,
+                        "sum_delta": desc["sum"] - prev.get("sum", 0.0)}
+        else:
+            out[key] = {"type": "gauge", "value": desc["value"]}
+    return out

@@ -22,6 +22,18 @@ drives.
 Events are surfaced through a :class:`apex_tpu.utils.CounterMeter`:
 ``steps``, ``nonfinite_steps``, ``rollbacks``, plus the manager's own
 checkpoint counters when the two share a meter (the default).
+
+Telemetry (``docs/observability.md``): each step runs under a
+``train_step`` tracer span (checkpoint save/restore spans nest inside
+via the manager) with ``overflow_skip`` / ``rollback`` instants, and
+its wall time feeds a ``train_step_s`` histogram.  With ``registry=``
+the histogram lives on the shared
+:class:`apex_tpu.observability.MetricsRegistry` and the sentry
+additionally records the loss-scale trajectory (an ``amp_loss_scale``
+gauge read off the embedded ``LossScalerState`` each step — one more
+scalar device->host read, which is why the trajectory is opt-in
+rather than always on; the registry-less default keeps the original
+"overflow flag is the only sync" contract).
 """
 
 from __future__ import annotations
@@ -29,6 +41,7 @@ from __future__ import annotations
 from typing import Any, Callable, List, Optional
 
 from apex_tpu.amp.scaler import LossScalerState
+from apex_tpu.observability import HistogramMeter, get_tracer
 from apex_tpu.resilience.faults import FaultPlan, resolve_fault_plan
 from apex_tpu.utils.checkpoint import CheckpointManager
 from apex_tpu.utils.meters import CounterMeter
@@ -86,6 +99,12 @@ class TrainingSentry:
         thread (snapshot is taken synchronously either way).
       counters / fault_plan: shared failure accounting and injected
         faults; both default to the manager's.
+      registry: optional
+        :class:`apex_tpu.observability.MetricsRegistry` — hosts the
+        ``train_step_s`` histogram and turns on the per-step
+        ``amp_loss_scale`` gauge (the loss-scale trajectory).
+      tracer: span tracer; defaults to the manager's (which defaults
+        to the process tracer, ``APEX_TPU_TRACE``).
 
     Usage::
 
@@ -101,7 +120,9 @@ class TrainingSentry:
                  overflow_of: Optional[Callable[[Pytree], bool]] = None,
                  background_save: bool = False,
                  counters: Optional[CounterMeter] = None,
-                 fault_plan: Optional[FaultPlan] = None):
+                 fault_plan: Optional[FaultPlan] = None,
+                 registry=None,
+                 tracer=None):
         if checkpoint_every < 1:
             raise ValueError(
                 f"checkpoint_every must be >= 1, got {checkpoint_every}")
@@ -119,6 +140,14 @@ class TrainingSentry:
             else manager.counters
         self.fault_plan = resolve_fault_plan(fault_plan) \
             or manager.fault_plan
+        self.registry = registry
+        self.tracer = tracer if tracer is not None \
+            else getattr(manager, "tracer", None) or get_tracer()
+        self.step_time = (registry.histogram("train_step_s")
+                          if registry is not None
+                          else HistogramMeter("train_step_s"))
+        self.loss_scale_gauge = (registry.gauge("amp_loss_scale")
+                                 if registry is not None else None)
         self.streak = 0           # consecutive non-finite steps
 
     # -- lifecycle --------------------------------------------------------
@@ -138,19 +167,28 @@ class TrainingSentry:
         a rolled-back one — callers must not cache pre-call state)."""
         if self.fault_plan is not None:
             self.fault_plan.tick(step)
-        new_state = self.step_fn(state, *args)
-        self.counters.incr("steps")
-        if self.overflow_of(new_state):
-            self.counters.incr("nonfinite_steps")
-            self.streak += 1
-            if self.streak >= self.nonfinite_threshold:
-                return self._roll_back(state)
-            return new_state
-        self.streak = 0
-        if (step + 1) % self.checkpoint_every == 0:
-            self.manager.save(step, new_state,
-                              metadata={"sentry": True},
-                              block=not self.background_save)
+        with self.tracer.span("train_step", step=int(step)):
+            with self.step_time.time():
+                new_state = self.step_fn(state, *args)
+            self.counters.incr("steps")
+            if self.loss_scale_gauge is not None:
+                scalers = find_scaler_states(new_state)
+                if scalers:
+                    self.loss_scale_gauge.update(
+                        float(scalers[0].loss_scale))
+            if self.overflow_of(new_state):
+                if self.tracer.enabled:
+                    self.tracer.instant("overflow_skip", step=int(step))
+                self.counters.incr("nonfinite_steps")
+                self.streak += 1
+                if self.streak >= self.nonfinite_threshold:
+                    return self._roll_back(state)
+                return new_state
+            self.streak = 0
+            if (step + 1) % self.checkpoint_every == 0:
+                self.manager.save(step, new_state,
+                                  metadata={"sentry": True},
+                                  block=not self.background_save)
         return new_state
 
     def _roll_back(self, target: Pytree) -> Pytree:
@@ -162,5 +200,7 @@ class TrainingSentry:
                 f"back to")
         state, step = found
         self.counters.incr("rollbacks")
+        if self.tracer.enabled:
+            self.tracer.instant("rollback", restored_step=int(step))
         self.streak = 0
         return state

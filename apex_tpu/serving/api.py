@@ -6,6 +6,16 @@ prefill/decode over the block-pool KV cache) with the host half
 batching) into a step loop, and meters it (queue depth, running-batch
 occupancy, tokens/s — ``utils.RateMeter``/``GaugeMeter``).
 
+Telemetry (``docs/observability.md``): every meter lives in a shared
+:class:`apex_tpu.observability.MetricsRegistry` (one snapshot /
+Prometheus scrape covers the server), each request carries an
+enqueue → admit → first-token → finish timeline feeding TTFT,
+queue-wait, and per-token decode-latency histograms surfaced in
+:meth:`InferenceServer.stats`, and — when tracing is on
+(``APEX_TPU_TRACE``) — the step loop emits admit / prefix-match /
+chunk-prefill / decode / evict / preempt spans plus request-lifecycle
+and engine-compile instants into a Perfetto-loadable Chrome trace.
+
 ``generate()`` is batch-synchronous (submit N prompts, run the loop to
 completion, return N completions) — the shape every test and bench
 needs.  A live service would run :meth:`step` on its event loop and
@@ -41,15 +51,33 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from apex_tpu.observability import MetricsRegistry, get_tracer
 from apex_tpu.serving.engine import DecodeEngine
 from apex_tpu.serving.prefix_cache import PrefixCache
 from apex_tpu.serving.scheduler import QueueFullError, Request, Scheduler
 from apex_tpu.utils import CounterMeter, GaugeMeter, RateMeter
 
+# the stats() window for "tokens/s right now" (RateMeter.rate_over) —
+# long enough to smooth step-to-step jitter, short enough that a
+# traffic change shows up within seconds
+RECENT_RATE_WINDOW_S = 10.0
+
 # default chunked-prefill width (tokens) when the caller doesn't pick
 # one: small enough that a chunk costs roughly a decode step at typical
 # model sizes, large enough to amortize the per-chunk context gather
 DEFAULT_PREFILL_CHUNK = 256
+
+
+def _hist_ms(hist) -> dict:
+    """Milliseconds view of a seconds histogram for ``stats()`` /
+    bench JSON: count + p50/p90/p99 + max."""
+    if hist.count == 0:
+        return {"count": 0}
+    return {"count": hist.count,
+            "p50": round(hist.p50 * 1e3, 3),
+            "p90": round(hist.p90 * 1e3, 3),
+            "p99": round(hist.p99 * 1e3, 3),
+            "max": round(hist.max * 1e3, 3)}
 
 
 def greedy_sample(logits: np.ndarray) -> np.ndarray:
@@ -81,6 +109,15 @@ class InferenceServer:
       prefill_chunk: chunk width in tokens (default
         ``min(256, max_context)``); ignored when chunked prefill is
         off.
+      registry: the :class:`apex_tpu.observability.MetricsRegistry`
+        holding every counter/gauge/histogram this server feeds
+        (default: a fresh private one).  Pass a shared registry to
+        co-scrape serving and training metrics from one snapshot.
+      tracer: span tracer for the step-loop phases
+        (admit / prefix-match / chunk-prefill / decode / evict /
+        preempt) and per-request lifecycle instants; default is the
+        process tracer (``APEX_TPU_TRACE`` turns it on, else a
+        zero-overhead no-op — ``docs/observability.md``).
 
     Example::
 
@@ -101,14 +138,23 @@ class InferenceServer:
                  clock: Callable[[], float] = time.monotonic,
                  enable_prefix_cache: bool = True,
                  enable_chunked_prefill: bool = True,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 tracer=None):
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self.tracer = tracer if tracer is not None else get_tracer()
         self.engine = DecodeEngine(
             cfg, params, max_batch_size=max_batch_size,
             max_context=max_context, num_blocks=num_blocks,
             block_size=block_size, cache_dtype=cache_dtype,
-            attention_fn=attention_fn, prefill_buckets=prefill_buckets)
-        self.failures = CounterMeter()
-        self.prefix = CounterMeter()
+            attention_fn=attention_fn, prefill_buckets=prefill_buckets,
+            tracer=self.tracer)
+        self.failures = CounterMeter(registry=self.registry,
+                                     name="serving_failures",
+                                     label="reason")
+        self.prefix = CounterMeter(registry=self.registry,
+                                   name="serving_prefix", label="event")
         self.prefix_cache = (
             PrefixCache(self.engine.allocator, self.engine.block_size,
                         counters=self.prefix)
@@ -126,14 +172,26 @@ class InferenceServer:
             max_waiting=max_waiting,
             counters=self.failures,
             prefix_cache=self.prefix_cache,
-            chunk_size=self.prefill_chunk)
+            chunk_size=self.prefill_chunk,
+            tracer=self.tracer)
         self.sample_fn = sample_fn or greedy_sample
         self.clock = clock
-        self.queue_depth = GaugeMeter()
-        self.occupancy = GaugeMeter()
-        self.chunk_iters = GaugeMeter()   # chunk prefills per iteration
+        self.queue_depth = GaugeMeter(registry=self.registry,
+                                      name="serving_queue_depth")
+        self.occupancy = GaugeMeter(registry=self.registry,
+                                    name="serving_batch_occupancy")
+        self.chunk_iters = GaugeMeter(   # chunk prefills per iteration
+            registry=self.registry, name="serving_chunk_iters")
         self.tokens = RateMeter()
+        # latency distributions fed by the per-request timelines
+        # (enqueue -> admit -> first token -> finish) and the step loop
+        hist = self.registry.histogram
+        self.ttft = hist("serving_ttft_s")
+        self.queue_wait = hist("serving_queue_wait_s")
+        self.decode_latency = hist("serving_decode_token_s")
+        self.step_time = hist("serving_step_s")
         self._iter = 0              # scheduler iterations served
+        self._finalized = 0         # scheduler.finished timeline cursor
 
     # -- request lifecycle ------------------------------------------------
 
@@ -168,6 +226,9 @@ class InferenceServer:
                       deadline_s=deadline_s,
                       submit_iter=self._iter,
                       submitted_at=self.clock())
+        if self.tracer.enabled:
+            self.tracer.instant("request_enqueue", uid=req.uid,
+                                prompt_tokens=len(prompt))
         try:
             return self.scheduler.submit(req)
         except QueueFullError:
@@ -206,17 +267,28 @@ class InferenceServer:
         Per-request failures (capacity / timeout / nonfinite) finish
         the affected request alone — no exception escapes the step
         loop for them."""
-        sched, engine = self.scheduler, self.engine
+        sched, engine, tr = self.scheduler, self.engine, self.tracer
         self._iter += 1
         produced = 0
+        step_start = self.clock()
         self._expire_deadlines()
 
-        sched.admit()
+        with tr.span("admit"):
+            admitted = sched.admit()
+        if admitted:
+            now = self.clock()
+            for req in admitted:
+                if req.admitted_at is None:
+                    req.admitted_at = now
+                if tr.enabled:
+                    tr.instant("request_admit", uid=req.uid,
+                               cached_tokens=req.cached_prefix_tokens)
         # whole-context cache hits first duplicate their final shared
         # block (copy-on-write) so the tail re-write stays private
         cows = [r for r in sched._admit_order if r.pending_cow]
         if cows:
-            engine.copy_blocks([r.pending_cow for r in cows])
+            with tr.span("cow_copy", blocks=len(cows)):
+                engine.copy_blocks([r.pending_cow for r in cows])
             for req in cows:
                 sched.cow_done(req)
 
@@ -226,11 +298,15 @@ class InferenceServer:
             if (start == 0 and is_last and self.prefill_chunk is None):
                 # no cached prefix, no chunking: the monolithic
                 # bucketed prefill (the pre-chunking path, bit-for-bit)
-                logits = engine.prefill(tokens, req.block_table)
+                with tr.span("prefill", uid=req.uid,
+                             tokens=len(tokens)):
+                    logits = engine.prefill(tokens, req.block_table)
             else:
-                logits = engine.chunk_prefill(
-                    tokens, start, req.block_table,
-                    pad_to=self.prefill_chunk)
+                with tr.span("chunk_prefill", uid=req.uid,
+                             tokens=len(tokens), start=start):
+                    logits = engine.chunk_prefill(
+                        tokens, start, req.block_table,
+                        pad_to=self.prefill_chunk)
                 chunks += 1
             done = sched.chunk_done(req, len(tokens))
             if not done or not req.prefill_sample:
@@ -243,6 +319,7 @@ class InferenceServer:
                 continue
             tok = int(self.sample_fn(logits))
             req.record_token(tok)
+            self._note_first_token(req)
             produced += 1
             if req.finished:
                 sched.retire(req)
@@ -271,8 +348,9 @@ class InferenceServer:
                     positions[req.slot] = req.num_cached
                     tables[req.slot, :len(req.block_table)] = \
                         req.block_table
-                logits = np.asarray(
-                    engine.decode(tokens, positions, tables))
+                with tr.span("decode", batch=len(running)):
+                    logits = np.asarray(
+                        engine.decode(tokens, positions, tables))
                 # step guard: a row of non-finite logits means this
                 # request's state is poisoned — evict it before its
                 # garbage token enters sampling/termination logic;
@@ -285,6 +363,7 @@ class InferenceServer:
                         continue
                     req.num_cached += 1
                     req.record_token(int(toks[req.slot]))
+                    self._note_first_token(req)
                     produced += 1
                     if req.finished:
                         sched.retire(req)
@@ -297,7 +376,43 @@ class InferenceServer:
         self.queue_depth.update(sched.num_waiting)
         self.occupancy.update(sched.num_running
                               / self.engine.max_batch_size)
+        self.step_time.record(self.clock() - step_start)
+        self._finalize_finished()
         return produced
+
+    # -- per-request timelines --------------------------------------------
+
+    def _note_first_token(self, req: Request) -> None:
+        """Stamp the first-token edge of the request timeline (the
+        TTFT numerator) the moment its first token is sampled."""
+        if req.first_token_at is None and req.generated:
+            req.first_token_at = self.clock()
+            if self.tracer.enabled:
+                self.tracer.instant("request_first_token", uid=req.uid)
+
+    def _finalize_finished(self) -> None:
+        """Stamp ``finished_at`` on every request that finished since
+        the last call (any path: retire, fail, rejected-at-submit) and
+        feed the latency histograms from its timeline.  Cursor-based
+        over ``scheduler.finished`` so each request is accounted
+        exactly once."""
+        fin = self.scheduler.finished
+        while self._finalized < len(fin):
+            req = fin[self._finalized]
+            self._finalized += 1
+            if req.finished_at is None:
+                req.finished_at = self.clock()
+            if self.tracer.enabled:
+                self.tracer.instant("request_finish", uid=req.uid,
+                                    reason=req.finish_reason or "",
+                                    tokens=len(req.generated))
+            tl = req.timeline()
+            if "queue_wait_s" in tl:
+                self.queue_wait.record(tl["queue_wait_s"])
+            if "ttft_s" in tl:
+                self.ttft.record(tl["ttft_s"])
+            if "decode_token_s" in tl:
+                self.decode_latency.record(tl["decode_token_s"])
 
     # -- front door -------------------------------------------------------
 
@@ -332,7 +447,12 @@ class InferenceServer:
         self.queue_depth.reset()
         self.occupancy.reset()
         self.chunk_iters.reset()
+        self.ttft.reset()
+        self.queue_wait.reset()
+        self.decode_latency.reset()
+        self.step_time.reset()
         self.scheduler.finished.clear()
+        self._finalized = 0
 
     def stats(self) -> dict:
         """Serving counters for logs and the bench harness.
@@ -341,11 +461,22 @@ class InferenceServer:
         admitted context tokens; ``kv_blocks_cached`` counts indexed
         blocks (shared or evictable), ``kv_blocks_free`` only the
         truly-free list — reclaimable capacity is their sum plus
-        evictable holds."""
+        evictable holds.
+
+        Telemetry keys (``docs/observability.md``):
+        ``tokens_per_s_recent`` is the trailing-window rate (recent
+        throughput, vs the lifetime-average ``tokens_per_s``);
+        ``latency`` carries p50/p90/p99 from the TTFT / queue-wait /
+        per-token-decode / step-time histograms fed by the per-request
+        timelines.  Every pre-telemetry key is preserved unchanged
+        (asserted in ``tests/L0/test_serving_engine.py``)."""
+        self._finalize_finished()
         pre, dec = self.engine.compile_counts()
         out = {
             "tokens_generated": self.tokens.total,
             "tokens_per_s": round(self.tokens.rate, 1),
+            "tokens_per_s_recent": round(
+                self.tokens.rate_over(RECENT_RATE_WINDOW_S), 1),
             "queue_depth_peak": self.queue_depth.peak,
             "batch_occupancy_avg": round(self.occupancy.avg, 3),
             "prefill_compiles": pre,
@@ -358,6 +489,12 @@ class InferenceServer:
             "requests_failed_total": self.failures.total,
             "prefill_chunks": self.prefix.count("prefill_chunks"),
             "chunk_iters_peak": self.chunk_iters.peak,
+            "latency": {
+                "ttft_ms": _hist_ms(self.ttft),
+                "queue_wait_ms": _hist_ms(self.queue_wait),
+                "decode_token_ms": _hist_ms(self.decode_latency),
+                "step_ms": _hist_ms(self.step_time),
+            },
         }
         if self.prefix_cache is not None:
             out.update({

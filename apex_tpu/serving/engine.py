@@ -42,6 +42,7 @@ from typing import Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.observability import NULL_TRACER
 from apex_tpu.models.gpt import GPTConfig, GPTLMHeadModel
 from apex_tpu.serving.kv_cache import (
     BlockAllocator,
@@ -115,6 +116,10 @@ class DecodeEngine:
         takes the ``ops.cached_attention`` path.
       prefill_buckets: ascending prompt-length buckets; None =
         :func:`default_prefill_buckets`.
+      tracer: optional :class:`apex_tpu.observability.SpanTracer`;
+        when enabled, every first-compile of a prefill/chunk/decode/
+        copy program emits a ``compile`` instant event (recompiles in
+        steady state are exactly what the trace is for catching).
     """
 
     def __init__(self, cfg: GPTConfig, params, *,
@@ -124,8 +129,10 @@ class DecodeEngine:
                  block_size: int = 16,
                  cache_dtype=None,
                  attention_fn=None,
-                 prefill_buckets: Optional[Sequence[int]] = None):
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 tracer=None):
         self.cfg = cfg
+        self.tracer = tracer if tracer is not None else NULL_TRACER
         self.params = params
         self.max_batch_size = int(max_batch_size)
         self.max_context = int(max_context
@@ -249,6 +256,18 @@ class DecodeEngine:
 
     # -- host API ---------------------------------------------------------
 
+    def _compile_mark(self, jit_fn) -> int:
+        """Pre-call trace count (0 when tracing is off — the probe
+        itself must cost nothing on the disabled path)."""
+        return jit_fn._cache_size() if self.tracer.enabled else 0
+
+    def _note_compile(self, jit_fn, before: int, program: str,
+                      **args) -> None:
+        """Emit a ``compile`` instant if the call traced a new
+        program."""
+        if self.tracer.enabled and jit_fn._cache_size() > before:
+            self.tracer.instant("compile", program=program, **args)
+
     def bucket_for(self, length: int) -> int:
         try:
             return pick_bucket(length, self.prefill_buckets)
@@ -269,9 +288,12 @@ class DecodeEngine:
         ids[0, :n] = prompt
         table = np.zeros((1, self.blocks_per_seq), np.int32)
         table[0, :len(block_table)] = block_table
+        before = self._compile_mark(self._prefill_jit)
         self.cache, last = self._prefill_jit(
             self.params, self.cache, jnp.asarray(ids),
             jnp.asarray([n], jnp.int32), jnp.asarray(table))
+        self._note_compile(self._prefill_jit, before, "prefill",
+                           bucket=sb)
         return last[0]
 
     def chunk_prefill(self, tokens, start: int, block_table,
@@ -297,10 +319,13 @@ class DecodeEngine:
         ids[0, :n] = tokens
         table = np.zeros((1, self.blocks_per_seq), np.int32)
         table[0, :len(block_table)] = block_table
+        before = self._compile_mark(self._chunk_jit)
         self.cache, last = self._chunk_jit(
             self.params, self.cache, jnp.asarray(ids),
             jnp.asarray([start], jnp.int32),
             jnp.asarray([n], jnp.int32), jnp.asarray(table))
+        self._note_compile(self._chunk_jit, before, "chunk_prefill",
+                           width=cb)
         return last[0]
 
     def copy_blocks(self, pairs) -> None:
@@ -316,18 +341,22 @@ class DecodeEngine:
             dst = np.zeros((_COPY_WIDTH,), np.int32)
             for j, (s, d) in enumerate(batch):
                 src[j], dst[j] = s, d
+            before = self._compile_mark(self._copy_jit)
             self.cache = self._copy_jit(self.cache, jnp.asarray(src),
                                         jnp.asarray(dst))
+            self._note_compile(self._copy_jit, before, "copy_blocks")
 
     def decode(self, tokens, positions, tables) -> jax.Array:
         """One iteration-level decode step over all slots.  Arrays are
         (B,), (B,), (B, blocks_per_seq) with inactive slots zeroed.
         Returns next-token logits (B, V)."""
+        before = self._compile_mark(self._decode_jit)
         self.cache, logits = self._decode_jit(
             self.params, self.cache,
             jnp.asarray(tokens, jnp.int32),
             jnp.asarray(positions, jnp.int32),
             jnp.asarray(tables, jnp.int32))
+        self._note_compile(self._decode_jit, before, "decode")
         return logits
 
     # -- introspection ----------------------------------------------------

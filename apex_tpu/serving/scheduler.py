@@ -69,6 +69,7 @@ import itertools
 from collections import deque
 from typing import Deque, Dict, List, Optional, Tuple
 
+from apex_tpu.observability import NULL_TRACER
 from apex_tpu.serving.kv_cache import BlockAllocator
 from apex_tpu.serving.prefix_cache import ROOT, PrefixCache
 
@@ -104,6 +105,14 @@ class Request:
     deadline_s: Optional[float] = None
     submit_iter: int = 0            # server iteration at submission
     submitted_at: float = 0.0       # server clock at submission
+
+    # per-request timeline (server clock, stamped by ``serving.api``):
+    # enqueue -> admit -> first token -> finish.  ``admitted_at`` keeps
+    # its FIRST value across preemption re-admits so queue-wait and
+    # TTFT measure the user-visible request, not scheduler internals.
+    admitted_at: Optional[float] = None
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
 
     # runtime state (owned by the scheduler)
     generated: List[int] = dataclasses.field(default_factory=list)
@@ -148,6 +157,33 @@ class Request:
             self.finished = True
             self.finish_reason = "length"
 
+    def timeline(self) -> dict:
+        """The request's lifecycle timestamps (server clock seconds)
+        plus derived waits — the per-request record behind the TTFT /
+        queue-wait / decode-latency histograms
+        (``docs/observability.md``)."""
+        out = {
+            "uid": self.uid,
+            "submitted_at": self.submitted_at,
+            "admitted_at": self.admitted_at,
+            "first_token_at": self.first_token_at,
+            "finished_at": self.finished_at,
+            "finish_reason": self.finish_reason,
+            "tokens": len(self.generated),
+            "preemptions": self.preemptions,
+        }
+        if self.admitted_at is not None:
+            out["queue_wait_s"] = self.admitted_at - self.submitted_at
+        if self.first_token_at is not None:
+            out["ttft_s"] = self.first_token_at - self.submitted_at
+        if (self.finished_at is not None
+                and self.first_token_at is not None
+                and len(self.generated) >= 2):
+            out["decode_token_s"] = (
+                (self.finished_at - self.first_token_at)
+                / (len(self.generated) - 1))
+        return out
+
 
 class Scheduler:
     """Slot + block bookkeeping for continuous batching.
@@ -170,8 +206,10 @@ class Scheduler:
                  max_context: int, max_waiting: Optional[int] = None,
                  counters=None,
                  prefix_cache: Optional[PrefixCache] = None,
-                 chunk_size: Optional[int] = None):
+                 chunk_size: Optional[int] = None,
+                 tracer=None):
         self.allocator = allocator
+        self.tracer = tracer if tracer is not None else NULL_TRACER
         self.max_batch_size = max_batch_size
         self.block_size = block_size
         self.max_context = max_context
@@ -238,6 +276,8 @@ class Scheduler:
         while not self.allocator.can_alloc(n):
             if self.prefix_cache is None or not self.prefix_cache.evict(1):
                 return None
+            if self.tracer.enabled:
+                self.tracer.instant("evict", blocks=1)
         return self.allocator.alloc(n)
 
     # -- iteration-level decisions ---------------------------------------
@@ -267,8 +307,12 @@ class Scheduler:
             if need > pool_blocks:
                 self.fail(req, "capacity")
                 continue
-            matched = (self.prefix_cache.match(ctx)
-                       if self.prefix_cache is not None else [])
+            if self.prefix_cache is not None:
+                with self.tracer.span("prefix_match", uid=req.uid,
+                                      ctx_tokens=len(ctx)):
+                    matched = self.prefix_cache.match(ctx)
+            else:
+                matched = []
             hit = len(matched) * bs
             # a whole-context match (len(ctx) block-aligned and every
             # block cached) still must recompute the last token's
@@ -404,6 +448,9 @@ class Scheduler:
         over never-started requests), freeing its slot and blocks."""
         assert req.running, "can only preempt a running request"
         req.preemptions += 1
+        if self.tracer.enabled:
+            self.tracer.instant("preempt", uid=req.uid,
+                                blocks=len(req.block_table))
         self._release(req)
         req.num_cached = 0
         self.waiting.appendleft(req)

@@ -190,6 +190,15 @@ class CheckpointManager:
     a background thread; :meth:`wait` joins and re-raises.  Fault hooks
     (:class:`apex_tpu.resilience.FaultPlan`) are taken from the
     ``fault_plan`` argument or the ``APEX_TPU_FAULTS`` environment.
+
+    Telemetry (``docs/observability.md``): save/restore run under
+    ``checkpoint_save`` / ``checkpoint_restore`` tracer spans (a
+    ``checkpoint_publish`` instant marks the atomic rename) and their
+    wall time feeds ``checkpoint_save_s`` / ``checkpoint_restore_s``
+    histograms.  Pass ``registry=`` to put the histograms — and, when
+    ``counters`` is not supplied, the counter meter — on a shared
+    :class:`apex_tpu.observability.MetricsRegistry`; the tracer
+    defaults to the process one (``APEX_TPU_TRACE``).
     """
 
     def __init__(self, root: str, *,
@@ -200,7 +209,10 @@ class CheckpointManager:
                  retry_deadline: Optional[float] = None,
                  sleep: Callable[[float], None] = time.sleep,
                  counters=None,
-                 fault_plan=None):
+                 fault_plan=None,
+                 registry=None,
+                 tracer=None):
+        from apex_tpu.observability import HistogramMeter, get_tracer
         from apex_tpu.resilience.faults import resolve_fault_plan
         from apex_tpu.utils.meters import CounterMeter
 
@@ -214,7 +226,21 @@ class CheckpointManager:
         self.retry_backoff = float(retry_backoff)
         self.retry_deadline = retry_deadline
         self._sleep = sleep
-        self.counters = counters if counters is not None else CounterMeter()
+        self.registry = registry
+        self.tracer = tracer if tracer is not None else get_tracer()
+        if counters is not None:
+            self.counters = counters
+        elif registry is not None:
+            self.counters = CounterMeter(registry=registry,
+                                         name="checkpoint", label="event")
+        else:
+            self.counters = CounterMeter()
+        if registry is not None:
+            self.save_time = registry.histogram("checkpoint_save_s")
+            self.restore_time = registry.histogram("checkpoint_restore_s")
+        else:
+            self.save_time = HistogramMeter("checkpoint_save_s")
+            self.restore_time = HistogramMeter("checkpoint_restore_s")
         self.fault_plan = resolve_fault_plan(fault_plan)
         self._thread: Optional[threading.Thread] = None
         self._thread_error: Optional[BaseException] = None
@@ -277,6 +303,12 @@ class CheckpointManager:
 
     def _save_sync(self, step: int, snapshot: Pytree,
                    metadata: Optional[Dict[str, Any]]) -> None:
+        with self.tracer.span("checkpoint_save", step=int(step)):
+            with self.save_time.time():
+                self._save_body(step, snapshot, metadata)
+
+    def _save_body(self, step: int, snapshot: Pytree,
+                   metadata: Optional[Dict[str, Any]]) -> None:
         from apex_tpu.resilience.retry import retry
 
         final = self._dir(step)
@@ -318,6 +350,8 @@ class CheckpointManager:
             shutil.rmtree(final)
         os.rename(tmp, final)       # the publish point (atomic, POSIX)
         _fsync_path(self.root)
+        if self.tracer.enabled:
+            self.tracer.instant("checkpoint_publish", step=int(step))
         self.counters.incr("checkpoints_written")
         if self.fault_plan is not None:
             self.fault_plan.maybe_tear(final, step)
@@ -365,6 +399,12 @@ class CheckpointManager:
         and per-leaf checksums; :class:`CheckpointCorruptError` on any
         integrity failure."""
         self.wait()
+        with self.tracer.span("checkpoint_restore", step=int(step)):
+            with self.restore_time.time():
+                return self._restore_body(step, target)
+
+    def _restore_body(self, step: int,
+                      target: Optional[Pytree]) -> Pytree:
         ckpt_dir = self._dir(step)
         manifest_path = os.path.join(ckpt_dir, MANIFEST_FILE)
         try:

@@ -1,10 +1,23 @@
 """Training-loop meters (reference ``examples/imagenet/main_amp.py:445-460``)
 plus the serving-side counters (``apex_tpu.serving``: tokens/s, queue
-depth)."""
+depth).
+
+Since the unified-telemetry layer (``apex_tpu.observability``,
+``docs/observability.md``) the counter/gauge meters are VIEWS onto a
+shared :class:`~apex_tpu.observability.MetricsRegistry` when
+constructed with ``registry=``: the registry owns the values (so one
+snapshot / Prometheus scrape covers every subsystem) and the meter
+keeps its exact historical API — ``incr``/``count``/``as_dict``/
+``ratio``, ``update``/``peak``/``avg`` — on top.  Without a registry
+they behave standalone, byte-for-byte as before."""
 
 from __future__ import annotations
 
 import time
+from collections import deque
+from typing import Optional
+
+from apex_tpu.observability.registry import Counter, Gauge
 
 
 class AverageMeter:
@@ -31,19 +44,38 @@ class RateMeter:
     """Events per second over wall time — the serving tokens/s meter.
 
     ``update(n)`` adds n events; ``rate`` is total events / elapsed
-    seconds since construction or :meth:`reset`.  A monotonic clock and
-    a floor on elapsed keep it sane for sub-millisecond smoke runs."""
+    seconds since construction or :meth:`reset` (the lifetime
+    average), while :meth:`rate_over` is the rate over just the
+    trailing window — what "tokens/s right now" should mean on a
+    server that has been up for hours.  Recent events are kept in a
+    pruned deque bounded by ``max_window`` seconds, so memory stays
+    proportional to recent traffic, not uptime.  A monotonic clock and
+    a floor on elapsed keep both sane for sub-millisecond smoke
+    runs."""
 
-    def __init__(self, clock=time.perf_counter):
+    def __init__(self, clock=time.perf_counter, max_window: float = 120.0):
+        if max_window <= 0:
+            raise ValueError(f"max_window must be > 0, got {max_window}")
         self._clock = clock
+        self.max_window = float(max_window)
         self.reset()
 
     def reset(self):
         self.total = 0
         self._start = self._clock()
+        self._events = deque()      # (timestamp, n) within max_window
 
     def update(self, n: int = 1):
         self.total += n
+        now = self._clock()
+        self._events.append((now, n))
+        self._prune(now)
+
+    def _prune(self, now: float) -> None:
+        cutoff = now - self.max_window
+        ev = self._events
+        while ev and ev[0][0] < cutoff:
+            ev.popleft()
 
     @property
     def elapsed(self) -> float:
@@ -52,6 +84,23 @@ class RateMeter:
     @property
     def rate(self) -> float:
         return self.total / self.elapsed
+
+    def rate_over(self, last_n_seconds: float) -> float:
+        """Events per second over the trailing ``last_n_seconds``
+        (clamped to ``max_window``).  Early in the meter's life — when
+        less than a window has elapsed — the denominator is the actual
+        elapsed time, so the windowed rate converges to :attr:`rate`
+        instead of under-reporting."""
+        if last_n_seconds <= 0:
+            raise ValueError(
+                f"last_n_seconds must be > 0, got {last_n_seconds}")
+        now = self._clock()
+        window = min(float(last_n_seconds), self.max_window)
+        self._prune(now)
+        cutoff = now - window
+        n = sum(c for t, c in self._events if t >= cutoff)
+        denom = max(min(window, now - self._start), 1e-9)
+        return n / denom
 
 
 class CounterMeter:
@@ -62,27 +111,47 @@ class CounterMeter:
     ``incr(key)`` only ever counts up (negative increments are a bug in
     the caller and raise), so a snapshot taken later always dominates
     one taken earlier — the property log scrapers and the bench harness
-    rely on when they diff two readings."""
+    rely on when they diff two readings.
 
-    def __init__(self):
-        self._counts = {}
+    With ``registry=`` each key becomes a labeled
+    :class:`~apex_tpu.observability.Counter`
+    (``<name>{<label>="<key>"}``) owned by the registry; the meter is
+    then a view — same API, shared storage."""
+
+    def __init__(self, registry=None, *, name: str = "counters",
+                 label: str = "key"):
+        self._registry = registry
+        self._name = name
+        self._label = label
+        self._counts = {}           # key -> observability Counter
+
+    def _cell(self, key: str) -> Counter:
+        c = self._counts.get(key)
+        if c is None:
+            if self._registry is not None:
+                c = self._registry.counter(self._name,
+                                           **{self._label: key})
+            else:
+                c = Counter(self._name, ((self._label, str(key)),))
+            self._counts[key] = c
+        return c
 
     def incr(self, key: str, n: int = 1) -> int:
         if n < 0:
             raise ValueError(f"CounterMeter is monotonic; incr({key!r}, "
                              f"{n}) would decrease it")
-        self._counts[key] = self._counts.get(key, 0) + n
-        return self._counts[key]
+        return self._cell(key).incr(n)
 
     def count(self, key: str) -> int:
-        return self._counts.get(key, 0)
+        c = self._counts.get(key)
+        return c.value if c is not None else 0
 
     def __getitem__(self, key: str) -> int:
         return self.count(key)
 
     @property
     def total(self) -> int:
-        return sum(self._counts.values())
+        return sum(c.value for c in self._counts.values())
 
     def ratio(self, num: str, *parts: str) -> float:
         """``count(num) / sum(count(p) for p in parts)`` with a 0.0
@@ -94,29 +163,49 @@ class CounterMeter:
 
     def as_dict(self) -> dict:
         """Stable-ordered snapshot for logs/stats."""
-        return {k: self._counts[k] for k in sorted(self._counts)}
+        return {k: self._counts[k].value for k in sorted(self._counts)}
 
 
 class GaugeMeter:
     """Current / peak / running-mean of a sampled level — the serving
-    queue-depth and running-batch-occupancy meter."""
+    queue-depth and running-batch-occupancy meter.
 
-    def __init__(self):
-        self.reset()
+    With ``registry=`` + ``name=`` the backing
+    :class:`~apex_tpu.observability.Gauge` lives in the registry
+    (snapshot/exposition see it); otherwise it is standalone.  Either
+    way the meter API is unchanged."""
+
+    def __init__(self, registry=None, *,
+                 name: Optional[str] = None, **labels):
+        if registry is not None:
+            if name is None:
+                raise ValueError("GaugeMeter(registry=...) needs name=")
+            self._gauge = registry.gauge(name, **labels)
+        else:
+            self._gauge = Gauge(name or "gauge")
 
     def reset(self):
-        self.val = 0.0
-        self.peak = 0.0
-        self.sum = 0.0
-        self.count = 0
+        self._gauge.reset()
 
     def update(self, val):
-        val = float(val)
-        self.val = val
-        self.peak = max(self.peak, val)
-        self.sum += val
-        self.count += 1
+        self._gauge.update(val)
+
+    @property
+    def val(self) -> float:
+        return self._gauge.val
+
+    @property
+    def peak(self) -> float:
+        return self._gauge.peak
+
+    @property
+    def sum(self) -> float:
+        return self._gauge.sum
+
+    @property
+    def count(self) -> int:
+        return self._gauge.count
 
     @property
     def avg(self) -> float:
-        return self.sum / max(self.count, 1)
+        return self._gauge.avg

@@ -1,0 +1,452 @@
+"""Unified telemetry: metrics registry, histograms, span tracer.
+
+Three oracles, all pure-Python and deterministic:
+
+- **Histogram math** — bucket assignment and quantiles are checked
+  against a linear-scan oracle over the same geometric boundary
+  ladder; the quantile estimate must land in the same bucket as the
+  exact sample quantile (the estimator's construction guarantee).
+- **Snapshot/diff monotonicity** — counters and histogram counts only
+  grow between snapshots; ``snapshot_diff`` with the arguments
+  reversed must raise, not return negative deltas.
+- **Chrome trace validity** — exported JSON must be loadable, every
+  event carries ``ph``/``ts``/``pid``/``tid``, B/E events pair up
+  per thread, and with a fake clock the whole export is byte-stable.
+
+Plus the two contracts the serving hot path depends on: the disabled
+tracer allocates nothing per event (one shared no-op span singleton),
+and the ``utils`` meters behave identically standalone vs as registry
+views (the PR-1..3 ``stats()`` surface must not move).
+"""
+
+import io
+import json
+import math
+import random
+import tracemalloc
+
+import pytest
+
+from apex_tpu.observability import (
+    NULL_TRACER,
+    HistogramMeter,
+    MetricsRegistry,
+    SpanTracer,
+    series_key,
+    snapshot_diff,
+)
+from apex_tpu.utils.meters import CounterMeter, GaugeMeter, RateMeter
+
+
+class FakeClock:
+    """Deterministic seconds source: starts at 0, each call returns
+    the current time then advances by ``tick`` (0 = manual only)."""
+
+    def __init__(self, tick=0.0):
+        self.now = 0.0
+        self.tick = tick
+
+    def __call__(self):
+        t = self.now
+        self.now += self.tick
+        return t
+
+    def advance(self, dt):
+        self.now += dt
+
+
+# -- histogram math vs oracle ---------------------------------------------
+
+
+def oracle_bucket(bounds, v):
+    for i, b in enumerate(bounds):
+        if v <= b:
+            return i
+    return len(bounds) - 1
+
+
+def test_histogram_bucket_assignment_matches_oracle():
+    h = HistogramMeter(low=1e-6, high=60.0, growth=2.0)
+    # below low, every exact boundary, midpoints, above high
+    probes = [0.0, 1e-9, 1e-6]
+    for b in h.bounds:
+        probes += [b, b * 0.999, b * 1.001]
+    probes += [59.0, 60.0, 61.0, 1e6]
+    for v in probes:
+        assert h.bucket_index(v) == oracle_bucket(h.bounds, v), v
+    # the ladder is geometric low * growth**i, capped above high
+    assert h.bounds[0] == 1e-6
+    assert h.bounds[-1] >= 60.0
+    for a, b in zip(h.bounds, h.bounds[1:]):
+        assert b == pytest.approx(a * 2.0)
+
+
+def test_histogram_quantiles_match_sample_oracle():
+    rng = random.Random(0)
+    vals = [rng.uniform(1e-5, 5.0) for _ in range(500)]
+    vals += [rng.expovariate(10.0) + 1e-6 for _ in range(500)]
+    h = HistogramMeter(low=1e-6, high=60.0, growth=2.0)
+    for v in vals:
+        h.record(v)
+    s = sorted(vals)
+    for q in (0.01, 0.1, 0.25, 0.5, 0.9, 0.95, 0.99):
+        true = s[max(1, math.ceil(q * len(s))) - 1]
+        est = h.quantile(q)
+        # estimator guarantee: same bucket as the exact sample quantile
+        assert h.bucket_index(est) == h.bucket_index(true), q
+    # edges clamp to the exact observed extremes
+    assert h.quantile(0.0) == min(vals)
+    assert h.quantile(1.0) == max(vals)
+    assert h.p50 == h.quantile(0.5)
+    assert h.p90 == h.quantile(0.9)
+    assert h.p99 == h.quantile(0.99)
+    assert h.count == len(vals)
+    assert h.sum == pytest.approx(sum(vals))
+    assert h.mean == pytest.approx(sum(vals) / len(vals))
+
+
+def test_histogram_single_value_and_empty():
+    h = HistogramMeter()
+    assert h.quantile(0.5) == 0.0                # empty: defined, zero
+    assert h.describe() == {"type": "histogram", "count": 0, "sum": 0.0}
+    h.record(0.125)
+    for q in (0.0, 0.5, 1.0):
+        assert h.quantile(q) == 0.125            # clamped to min==max
+
+
+def test_histogram_time_uses_injected_clock():
+    clk = FakeClock()
+    h = HistogramMeter(clock=clk)
+    with h.time():
+        clk.advance(0.25)
+    assert h.count == 1 and h.min == 0.25 and h.max == 0.25
+
+
+def test_histogram_rejects_bad_ladder():
+    with pytest.raises(ValueError):
+        HistogramMeter(low=0.0, high=1.0)
+    with pytest.raises(ValueError):
+        HistogramMeter(low=1.0, high=0.5)
+    with pytest.raises(ValueError):
+        HistogramMeter(growth=1.0)
+
+
+# -- registry: snapshot / diff / exposition --------------------------------
+
+
+def test_registry_snapshot_diff_monotonic():
+    reg = MetricsRegistry(clock=FakeClock())
+    c = reg.counter("requests", outcome="ok")
+    g = reg.gauge("depth")
+    h = reg.histogram("lat_s")
+    c.incr(3)
+    g.update(5)
+    h.record(0.1)
+    s1 = reg.snapshot()
+    c.incr(2)
+    g.update(1)
+    h.record(0.2)
+    s2 = reg.snapshot()
+    d = snapshot_diff(s1, s2)
+    assert d[series_key("requests", (("outcome", "ok"),))]["delta"] == 2
+    assert d["depth"]["value"] == 1.0            # gauges: newer value
+    assert d["lat_s"]["count_delta"] == 1
+    assert d["lat_s"]["sum_delta"] == pytest.approx(0.2)
+    # reversed argument order is an error, not negative deltas
+    with pytest.raises(ValueError):
+        snapshot_diff(s2, s1)
+    # a series absent from old diffs against zero
+    d0 = snapshot_diff({}, s2)
+    assert d0[series_key("requests", (("outcome", "ok"),))]["delta"] == 5
+
+
+def test_registry_get_or_create_and_kind_conflict():
+    reg = MetricsRegistry()
+    assert reg.counter("x") is reg.counter("x")
+    assert reg.counter("x", a="1") is not reg.counter("x", a="2")
+    # labels are identity regardless of kwarg order
+    assert reg.counter("x", a="1", b="2") is reg.counter("x", b="2", a="1")
+    with pytest.raises(ValueError):
+        reg.gauge("x")                           # name is already a counter
+    with pytest.raises(ValueError):
+        reg.counter("x").incr(-1)                # counters are monotonic
+
+
+def test_prometheus_text_exposition():
+    reg = MetricsRegistry()
+    reg.counter("reqs", code="200").incr(7)
+    reg.gauge("depth").update(3)
+    h = reg.histogram("lat_s", low=0.001, high=1.0, growth=10.0)
+    for v in (0.0005, 0.005, 0.05, 0.5, 5.0):
+        h.record(v)
+    text = reg.prometheus_text()
+    lines = text.strip().split("\n")
+    assert "# TYPE reqs counter" in lines
+    assert 'reqs{code="200"} 7' in lines
+    assert "# TYPE depth gauge" in lines
+    assert "depth 3.0" in lines
+    assert "# TYPE lat_s histogram" in lines
+    # cumulative buckets end at +Inf == count, and _sum/_count close out
+    buckets = [ln for ln in lines if ln.startswith("lat_s_bucket")]
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in buckets]
+    assert counts == sorted(counts), "bucket counts must be cumulative"
+    assert buckets[-1] == 'lat_s_bucket{le="+Inf"} 5'
+    assert "lat_s_count 5" in lines
+    assert any(ln.startswith("lat_s_sum ") for ln in lines)
+
+
+def test_emit_jsonl_deterministic_with_fake_clock():
+    clk = FakeClock(tick=1.0)
+    reg = MetricsRegistry(clock=clk)
+    reg.counter("c").incr()
+    buf = io.StringIO()
+    reg.emit_jsonl(buf, extra={"step": 7})
+    reg.emit_jsonl(buf)
+    recs = [json.loads(ln) for ln in buf.getvalue().splitlines()]
+    assert [r["ts"] for r in recs] == [0.0, 1.0]
+    assert recs[0]["step"] == 7
+    assert recs[0]["metrics"]["c"] == {"type": "counter", "value": 1}
+
+
+# -- meters as registry views ---------------------------------------------
+
+
+def test_counter_meter_view_matches_standalone():
+    reg = MetricsRegistry()
+    view = CounterMeter(registry=reg, name="failures", label="reason")
+    solo = CounterMeter()
+    for cm in (view, solo):
+        cm.incr("timeout", 2)
+        cm.incr("capacity")
+        with pytest.raises(ValueError):
+            cm.incr("timeout", -1)
+    # the historical API, key for key
+    assert view.count("timeout") == solo.count("timeout") == 2
+    assert view["capacity"] == solo["capacity"] == 1
+    assert view.count("never") == solo.count("never") == 0
+    assert view.total == solo.total == 3
+    assert view.as_dict() == solo.as_dict() == {
+        "capacity": 1, "timeout": 2}
+    assert view.ratio("timeout", "timeout", "capacity") == \
+        solo.ratio("timeout", "timeout", "capacity") == pytest.approx(2 / 3)
+    # the registry sees the view's cells as labeled series
+    snap = reg.snapshot()
+    assert snap['failures{reason="timeout"}']["value"] == 2
+    assert snap['failures{reason="capacity"}']["value"] == 1
+
+
+def test_gauge_meter_view_matches_standalone():
+    reg = MetricsRegistry()
+    view = GaugeMeter(registry=reg, name="queue_depth")
+    solo = GaugeMeter()
+    for gm in (view, solo):
+        gm.update(4)
+        gm.update(2)
+    for gm in (view, solo):
+        assert (gm.val, gm.peak, gm.avg, gm.count) == (2.0, 4.0, 3.0, 2)
+    assert reg.snapshot()["queue_depth"]["peak"] == 4.0
+    view.reset()
+    assert (view.val, view.peak, view.count) == (0.0, 0.0, 0)
+    with pytest.raises(ValueError):
+        GaugeMeter(registry=reg)                 # registry needs name=
+
+
+def test_rate_meter_windowed_rate():
+    clk = FakeClock()
+    rm = RateMeter(clock=clk, max_window=60.0)
+    clk.advance(1.0)
+    rm.update(5)
+    clk.advance(10.0)
+    rm.update(10)
+    clk.advance(1.0)                             # now t=12
+    # trailing 2s holds only the n=10 burst
+    assert rm.rate_over(2.0) == pytest.approx(10 / 2.0)
+    # a window longer than the meter's life converges to the lifetime
+    # rate (denominator = actual elapsed, not the window)
+    assert rm.rate_over(59.0) == pytest.approx(15 / 12.0)
+    assert rm.rate == pytest.approx(15 / 12.0)
+    with pytest.raises(ValueError):
+        rm.rate_over(0.0)
+    with pytest.raises(ValueError):
+        RateMeter(max_window=0.0)
+
+
+def test_rate_meter_prunes_but_keeps_lifetime_total():
+    clk = FakeClock()
+    rm = RateMeter(clock=clk, max_window=5.0)
+    rm.update(100)                               # t=0, will age out
+    clk.advance(10.0)
+    rm.update(1)                                 # t=10
+    assert rm.total == 101                       # lifetime survives pruning
+    assert len(rm._events) == 1                  # memory ∝ window
+    assert rm.rate_over(5.0) == pytest.approx(1 / 5.0)
+
+
+# -- tracer: chrome export, determinism, disabled path ---------------------
+
+
+def _matched_pairs(events):
+    """Per-(pid, tid) B/E matching; returns [(b_event, e_event)] and
+    asserts no E-without-B and nothing left open."""
+    stacks, pairs = {}, []
+    for ev in events:
+        key = (ev["pid"], ev["tid"])
+        if ev["ph"] == "B":
+            stacks.setdefault(key, []).append(ev)
+        elif ev["ph"] == "E":
+            assert stacks.get(key), f"E without B on {key}"
+            pairs.append((stacks[key].pop(), ev))
+    assert not any(st for st in stacks.values()), "unclosed spans"
+    return pairs
+
+
+def test_chrome_trace_export_validates(tmp_path):
+    clk = FakeClock(tick=1.0)                    # 1s per clock read
+    tr = SpanTracer(clock=clk, pid=42)
+    with tr.span("step", n=1):
+        with tr.span("decode", batch=3):
+            tr.instant("compile", program="decode")
+    path = tr.export_chrome(str(tmp_path / "trace.json"))
+    data = json.load(open(path))
+    events = data["traceEvents"]
+    assert len(events) == 5                      # 2 B + 2 E + 1 instant
+    for ev in events:
+        assert ev["ph"] in ("B", "E", "i")
+        assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
+        assert ev["pid"] == 42 and "tid" in ev
+    pairs = _matched_pairs(events)
+    assert sorted(b["name"] for b, _ in pairs) == ["decode", "step"]
+    for b, e in pairs:
+        assert e["ts"] >= b["ts"]
+    # nesting is recorded as span/parent ids in args
+    by_name = {ev.get("name"): ev for ev in events if ev["ph"] != "E"}
+    outer = by_name["step"]["args"]["span_id"]
+    assert by_name["decode"]["args"]["parent_id"] == outer
+    assert by_name["compile"]["args"]["parent_id"] == \
+        by_name["decode"]["args"]["span_id"]
+    assert by_name["compile"]["s"] == "t"
+    assert by_name["decode"]["args"]["batch"] == 3
+    # fake clock: ts are exact microsecond multiples of the 1s ticks
+    assert [ev["ts"] for ev in events] == [
+        1e6 * i for i in range(1, 6)]
+
+
+def test_trace_is_deterministic_under_fake_clock(tmp_path):
+    def run():
+        tr = SpanTracer(clock=FakeClock(tick=0.5), pid=1)
+        with tr.span("a"):
+            tr.instant("m", k="v")
+        with tr.span("b"):
+            pass
+        return tr.chrome_events()
+
+    one, two = run(), run()
+    # tid differs only if threads do; same thread -> byte-identical
+    assert json.dumps(one, sort_keys=True) == json.dumps(two,
+                                                         sort_keys=True)
+
+
+def test_ring_buffer_bounds_memory_and_counts_drops():
+    tr = SpanTracer(capacity=8, clock=FakeClock(tick=0.001))
+    for i in range(20):
+        tr.instant("e", i=i)
+    assert len(tr.events) == 8
+    assert tr.dropped == 12
+    tr.clear()
+    assert tr.events == () and tr.dropped == 0
+    with pytest.raises(ValueError):
+        SpanTracer(capacity=1)
+
+
+def test_disabled_tracer_allocates_nothing_per_event():
+    # the no-op span is one process-wide singleton, not per call
+    s1 = NULL_TRACER.span("decode", batch=4)
+    s2 = NULL_TRACER.span("admit")
+    assert s1 is s2
+    with s1:
+        pass
+    assert NULL_TRACER.enabled is False
+    assert NULL_TRACER.events == () and NULL_TRACER.chrome_events() == []
+    # and the hot loop holds no per-event memory: peak growth over 10k
+    # disabled events stays under one small transient object
+    NULL_TRACER.instant("warm")                  # warm any lazy state
+    tracemalloc.start()
+    base = tracemalloc.get_traced_memory()[0]
+    for _ in range(10_000):
+        with NULL_TRACER.span("decode"):
+            NULL_TRACER.instant("tok")
+    cur, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert cur - base < 2048, "disabled tracer retained memory"
+    assert peak - base < 8192, "disabled tracer allocated per event"
+
+
+def test_sentry_and_scaler_telemetry(tmp_path):
+    """The training step loop end-to-end: each sentry step runs under
+    a train_step span and feeds the train_step_s histogram; overflow
+    steps emit overflow_skip instants; with registry= the loss-scale
+    trajectory lands in the amp_loss_scale gauge, and
+    LossScaler.observe records the same state for sentry-less loops."""
+    import jax.numpy as jnp
+
+    from apex_tpu.amp.scaler import LossScaler
+    from apex_tpu.resilience import TrainingSentry
+    from apex_tpu.utils.checkpoint import CheckpointManager
+
+    scaler = LossScaler("dynamic", init_scale=8.0, min_loss_scale=1.0)
+
+    def step_fn(state, x):
+        overflow = ~jnp.all(jnp.isfinite(x))
+        p = jnp.where(overflow, state["p"], state["p"] + x)
+        return {"p": p,
+                "scaler": scaler.update(state["scaler"], overflow)}
+
+    tr = SpanTracer(clock=FakeClock(tick=0.001))
+    reg = MetricsRegistry()
+    mgr = CheckpointManager(str(tmp_path / "c"), registry=reg, tracer=tr)
+    sentry = TrainingSentry(step_fn, mgr, checkpoint_every=2,
+                            nonfinite_threshold=3, registry=reg,
+                            tracer=tr)
+    state = {"p": jnp.zeros(()), "scaler": scaler.init()}
+    for i in range(3):
+        state = sentry.step(i, state, jnp.asarray(1.0))
+    state = sentry.step(3, state, jnp.asarray(jnp.inf))   # overflow
+    snap = reg.snapshot()
+    assert snap["train_step_s"]["count"] == 4
+    assert snap["amp_loss_scale"]["value"] == 4.0   # 8.0 halved by skip
+    names = [ev[1] for ev in tr.events]
+    assert names.count("train_step") >= 4
+    assert "overflow_skip" in names
+    assert "checkpoint_save" in names               # nested inside step
+    # the sentry-less hook records the same trajectory
+    reg2 = MetricsRegistry()
+    scaler.observe(state["scaler"], reg2)
+    s2 = reg2.snapshot()
+    assert s2["amp_loss_scale"]["value"] == 4.0
+    assert "amp_unskipped_steps" in s2
+
+
+def test_checkpoint_spans_recorded(tmp_path):
+    """The training-side instrumentation end-to-end: a save/restore
+    cycle emits checkpoint_save / checkpoint_restore spans and the
+    checkpoint_publish instant, and feeds the registry histograms."""
+    import numpy as np
+
+    from apex_tpu.utils.checkpoint import CheckpointManager
+
+    tr = SpanTracer(clock=FakeClock(tick=0.001))
+    reg = MetricsRegistry()
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), registry=reg,
+                            tracer=tr)
+    state = {"w": np.arange(8, dtype=np.float32)}
+    mgr.save(0, state)
+    out = mgr.restore(0, target=state)
+    assert np.array_equal(out["w"], state["w"])
+    names = [ev[1] for ev in tr.events]
+    assert "checkpoint_save" in names
+    assert "checkpoint_publish" in names
+    assert "checkpoint_restore" in names
+    snap = reg.snapshot()
+    assert snap["checkpoint_save_s"]["count"] == 1
+    assert snap["checkpoint_restore_s"]["count"] == 1
+    assert snap['checkpoint{event="checkpoints_written"}']["value"] == 1

@@ -173,6 +173,45 @@ def test_scheduler_rejects_oversized_and_empty_prompts(tiny):
     assert req.max_new_tokens == 2
 
 
+def test_stats_keys_are_backward_compatible(tiny):
+    """The telemetry migration (docs/observability.md) moved every
+    meter onto the shared MetricsRegistry; this pins the contract that
+    no pre-telemetry ``stats()`` key was renamed or dropped — log
+    scrapers and the bench harness key on these literally."""
+    cfg, params, _ = tiny
+    server = InferenceServer(cfg, params, max_batch_size=2,
+                             max_context=64, block_size=8,
+                             cache_dtype=jnp.float32)
+    server.generate([[1, 2, 3], [4, 5, 6, 7]], max_new_tokens=4)
+    st = server.stats()
+    pre_telemetry = {
+        "tokens_generated", "tokens_per_s", "queue_depth_peak",
+        "batch_occupancy_avg", "prefill_compiles", "decode_compiles",
+        "requests_finished", "preemptions", "kv_blocks_free",
+        "kv_blocks_cached", "kv_blocks_evictable", "requests_failed",
+        "requests_failed_total", "prefill_chunks", "chunk_iters_peak",
+        # prefix-cache block (default-on server)
+        "prefix_hit_requests", "prefix_hit_rate", "prefix_hit_tokens",
+        "prefix_miss_tokens", "prefix_cow_blocks",
+        "prefix_evicted_blocks",
+    }
+    missing = pre_telemetry - st.keys()
+    assert not missing, f"stats() lost pre-telemetry keys: {missing}"
+    # and the new telemetry keys ride alongside
+    assert "tokens_per_s_recent" in st
+    lat = st["latency"]
+    assert set(lat) == {"ttft_ms", "queue_wait_ms", "decode_token_ms",
+                        "step_ms"}
+    # both requests finished: their timelines fed the histograms
+    assert lat["ttft_ms"]["count"] == 2
+    assert lat["queue_wait_ms"]["count"] == 2
+    assert lat["ttft_ms"]["p50"] <= lat["ttft_ms"]["p99"]
+    for req in server.scheduler.finished:
+        tl = req.timeline()
+        assert tl["submitted_at"] <= tl["admitted_at"] \
+            <= tl["first_token_at"] <= tl["finished_at"]
+
+
 def test_prefill_buckets_ladder():
     assert default_prefill_buckets(128) == (16, 32, 64, 128)
     assert default_prefill_buckets(100) == (16, 32, 64, 100)

@@ -88,6 +88,22 @@ echo "=== build-matrix axis: serving-prefix-smoke ==="
 env JAX_PLATFORMS=cpu python tools/serving_bench.py --smoke --shared-prefix --out -
 results[serving_prefix]=$?
 
+# trace smoke: the observability axis (docs/observability.md) — the
+# serving smoke re-runs with APEX_TPU_TRACE set; the exported Chrome
+# trace must parse, its B/E spans must pair up, and it must contain
+# the scheduler-phase spans + request-lifecycle and compile instants
+# (tools/obs_dump.py trace --require, exit 1 on any missing name)
+echo "=== build-matrix axis: trace-smoke ==="
+trace_file=$(mktemp -u).trace.json
+env JAX_PLATFORMS=cpu APEX_TPU_TRACE="$trace_file" \
+    python tools/serving_bench.py --smoke --out - \
+  && python tools/obs_dump.py trace "$trace_file" \
+      --require admit --require chunk_prefill --require decode \
+      --require compile --require request_enqueue \
+      --require request_first_token --require request_finish
+results[trace]=$?
+rm -f "$trace_file"
+
 echo
 echo "=== build-matrix results ==="
 rc=0

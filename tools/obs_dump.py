@@ -1,0 +1,181 @@
+"""Pretty-print observability artifacts: registry snapshots and traces.
+
+Two subcommands over the two export formats of
+``apex_tpu.observability`` (``docs/observability.md``):
+
+``metrics PATH``
+    PATH is either a ``MetricsRegistry.emit_jsonl`` scrape file (each
+    line ``{"ts": ..., "metrics": {...}}`` — the LAST line is shown,
+    or every line with ``--all``) or a bare ``snapshot()`` JSON dict.
+    Prints one aligned row per series: counters as their value,
+    gauges as value/peak/avg, histograms as count + p50/p90/p99/max
+    in milliseconds-if-seconds-suffixed (``*_s`` series) else raw.
+
+``trace PATH [--require NAME ...]``
+    PATH is a Chrome trace-event JSON (``SpanTracer.export_chrome`` /
+    ``APEX_TPU_TRACE``).  Prints a per-span-name summary (count,
+    total/mean/max wall) built by matching B/E pairs per thread, and
+    an instant-event count table.  Each ``--require NAME`` asserts a
+    span or instant of that name exists — exit 1 otherwise — which is
+    how the build matrix checks a serve smoke actually traced its
+    scheduler phases (``tests/build_matrix/run.sh``).
+
+Usage:
+    python tools/obs_dump.py metrics scrape.jsonl
+    python tools/obs_dump.py trace trace.json --require admit --require decode
+"""
+
+import argparse
+import json
+import sys
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def _series_row(key: str, desc: dict) -> str:
+    kind = desc.get("type", "?")
+    if kind == "counter":
+        detail = str(desc.get("value", 0))
+    elif kind == "gauge":
+        detail = (f"val={_fmt(desc.get('value', 0.0))} "
+                  f"peak={_fmt(desc.get('peak', 0.0))} "
+                  f"avg={_fmt(desc.get('avg', 0.0))}")
+    elif kind == "histogram":
+        if not desc.get("count"):
+            detail = "count=0"
+        else:
+            scale, unit = ((1e3, "ms") if key.split("{")[0]
+                           .endswith("_s") else (1, ""))
+            detail = (f"count={desc['count']} "
+                      f"p50={_fmt(desc['p50'] * scale)}{unit} "
+                      f"p90={_fmt(desc['p90'] * scale)}{unit} "
+                      f"p99={_fmt(desc['p99'] * scale)}{unit} "
+                      f"max={_fmt(desc['max'] * scale)}{unit}")
+    else:
+        detail = json.dumps(desc)
+    return f"{key:<44} {kind:<9} {detail}"
+
+
+def dump_metrics(args) -> int:
+    with open(args.path) as f:
+        text = f.read()
+    records = []
+    for line in text.splitlines():
+        line = line.strip()
+        if line:
+            records.append(json.loads(line))
+    if not records:
+        print(f"{args.path}: empty", file=sys.stderr)
+        return 1
+    if not args.all:
+        records = records[-1:]
+    for rec in records:
+        metrics = rec.get("metrics", rec)   # scrape line or bare snapshot
+        if "ts" in rec:
+            print(f"-- snapshot at ts={rec['ts']} "
+                  f"({len(metrics)} series)")
+        for key in sorted(metrics):
+            print(_series_row(key, metrics[key]))
+    return 0
+
+
+def summarize_trace(events):
+    """(span_stats, instant_counts, errors): span_stats maps name ->
+    dict(count, total_us, max_us) from per-(pid, tid) B/E matching;
+    unmatched or crossed pairs land in errors."""
+    spans = {}
+    instants = {}
+    stacks = {}
+    errors = []
+    for ev in events:
+        ph = ev.get("ph")
+        key = (ev.get("pid"), ev.get("tid"))
+        if ph == "B":
+            stacks.setdefault(key, []).append(ev)
+        elif ph == "E":
+            st = stacks.get(key)
+            if not st:
+                errors.append(f"E without B on tid {key}")
+                continue
+            b = st.pop()
+            name = b.get("name", "?")
+            dur = ev["ts"] - b["ts"]
+            s = spans.setdefault(name,
+                                 {"count": 0, "total_us": 0.0,
+                                  "max_us": 0.0})
+            s["count"] += 1
+            s["total_us"] += dur
+            s["max_us"] = max(s["max_us"], dur)
+        elif ph == "i":
+            name = ev.get("name", "?")
+            instants[name] = instants.get(name, 0) + 1
+    for key, st in stacks.items():
+        for b in st:
+            errors.append(
+                f"unclosed span {b.get('name')!r} on tid {key}")
+    return spans, instants, errors
+
+
+def dump_trace(args) -> int:
+    with open(args.path) as f:
+        data = json.load(f)
+    events = data["traceEvents"] if isinstance(data, dict) else data
+    spans, instants, errors = summarize_trace(events)
+    dropped = 0
+    if isinstance(data, dict):
+        dropped = data.get("otherData", {}).get("dropped_events", 0)
+    print(f"{args.path}: {len(events)} events, {len(spans)} span "
+          f"names, {sum(instants.values())} instants"
+          + (f", {dropped} dropped by the ring buffer" if dropped
+             else ""))
+    if spans:
+        print(f"\n{'span':<20} {'count':>7} {'total ms':>10} "
+              f"{'mean ms':>9} {'max ms':>9}")
+        for name in sorted(spans, key=lambda n: -spans[n]["total_us"]):
+            s = spans[name]
+            print(f"{name:<20} {s['count']:>7} "
+                  f"{s['total_us'] / 1e3:>10.3f} "
+                  f"{s['total_us'] / s['count'] / 1e3:>9.3f} "
+                  f"{s['max_us'] / 1e3:>9.3f}")
+    if instants:
+        print(f"\n{'instant':<20} {'count':>7}")
+        for name in sorted(instants, key=lambda n: -instants[n]):
+            print(f"{name:<20} {instants[name]:>7}")
+    rc = 0
+    for err in errors:
+        print(f"WARN: {err}", file=sys.stderr)
+    for name in args.require or ():
+        if name not in spans and name not in instants:
+            print(f"FAIL: required span/instant {name!r} not in trace",
+                  file=sys.stderr)
+            rc = 1
+    return rc
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    mp = sub.add_parser("metrics",
+                        help="pretty-print a registry snapshot / "
+                        "JSON-lines scrape")
+    mp.add_argument("path")
+    mp.add_argument("--all", action="store_true",
+                    help="print every scrape line, not just the last")
+    mp.set_defaults(fn=dump_metrics)
+    tp = sub.add_parser("trace",
+                        help="summarize a Chrome trace-event JSON")
+    tp.add_argument("path")
+    tp.add_argument("--require", action="append", metavar="NAME",
+                    help="exit 1 unless a span/instant NAME exists "
+                    "(repeatable)")
+    tp.set_defaults(fn=dump_trace)
+    args = ap.parse_args()
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,7 +19,21 @@ repo root unless ``--out`` says otherwise)::
     {"bench": "serving", "mode": "smoke"|"full",
      "tokens_s_continuous": ..., "tokens_s_naive": ..., "speedup": ...,
      "p50_latency_ms": ..., "p95_latency_ms": ...,
+     "latency": {"ttft_ms": {"count", "p50", "p90", "p99", "max"},
+                 "queue_wait_ms": ..., "decode_token_ms": ...,
+                 "step_ms": ...},
      "config": {...}, "stats": {...}}
+
+The ``latency`` block comes straight from the server's log-bucketed
+histograms (``docs/observability.md``) — per-request TTFT /
+queue-wait / per-token decode quantiles, not medians hand-computed
+from completion lists (``p50_latency_ms``/``p95_latency_ms`` remain
+the whole-request completion times for continuity).  In
+``--shared-prefix`` mode the record additionally carries the
+histogram's cached-arm TTFT p50 next to the directly-measured median
+and their log-bucket distance — ``--smoke`` asserts they agree within
+one bucket (the histogram estimator's guarantee, checked against live
+traffic rather than synthetic samples).
 
 ``--smoke`` is the CPU-safe build-matrix mode: a toy GPT, a small
 request set, and a hard floor assertion (speedup >= 2x — the
@@ -226,15 +240,33 @@ def run_shared_prefix_ttft(servers, args):
             while not req.finished:
                 _step_audited(server)
             outs.append(list(req.generated))
-        return _median(ttfts), outs, server.stats()
+        return ttfts, outs, server.stats()
 
     cached_server, cacheless_server, _ = servers
-    t_cached, outs_cached, stats = measure(cached_server)
-    t_off, outs_off, _ = measure(cacheless_server)
+    ttfts_cached, outs_cached, stats = measure(cached_server)
+    ttfts_off, outs_off, stats_off = measure(cacheless_server)
+    t_cached, t_off = _median(ttfts_cached), _median(ttfts_off)
+    # the histogram's view of the same TTFT window, plus its log-bucket
+    # distance from the direct measurement — the "within one bucket"
+    # acceptance check (HistogramMeter's estimator guarantee), compared
+    # at the histogram's rank convention (rank ceil(q*n))
+    import math
+
+    from apex_tpu.observability import HistogramMeter
+
+    ladder = HistogramMeter()       # the stats() histograms' default
+    n = len(ttfts_cached)
+    direct_p50 = sorted(ttfts_cached)[max(1, math.ceil(0.5 * n)) - 1]
+    hist_p50_ms = stats["latency"]["ttft_ms"].get("p50", 0.0)
+    bucket_delta = abs(ladder.bucket_index(max(hist_p50_ms, 1e-9) / 1e3)
+                       - ladder.bucket_index(max(direct_p50, 1e-9)))
     return {
         "ttft_ms_cached": round(t_cached * 1e3, 2),
         "ttft_ms_cacheless": round(t_off * 1e3, 2),
         "ttft_speedup": round(t_off / max(t_cached, 1e-9), 2),
+        "latency": {"cached": stats["latency"],
+                    "cacheless": stats_off["latency"]},
+        "ttft_hist_bucket_delta": bucket_delta,
         "prefix_parity_mismatches": sum(
             a != b for a, b in zip(outs_cached, outs_off)),
         "prefix_hit_requests": stats.get("prefix_hit_requests", 0),
@@ -351,6 +383,12 @@ def run_shared_prefix_mode(args):
                   f"{record['stall_ratio']} < 2.0x — chunked prefill "
                   "is not bounding the decode stall", file=sys.stderr)
             rc = 1
+        if record["ttft_hist_bucket_delta"] > 1:
+            print(f"FAIL: TTFT histogram p50 is "
+                  f"{record['ttft_hist_bucket_delta']} log-buckets "
+                  "from the directly-measured median (must be <= 1)",
+                  file=sys.stderr)
+            rc = 1
     return rc
 
 
@@ -442,6 +480,7 @@ def main():
         "speedup": round(cont_tps / max(naive_tps, 1e-9), 2),
         "p50_latency_ms": pct(lats, 0.50),
         "p95_latency_ms": pct(lats, 0.95),
+        "latency": stats["latency"],
         "parity_mismatches": mismatches,
         "config": {"requests": args.requests, "max_new": args.max_new,
                    "batch_size": args.batch_size,
