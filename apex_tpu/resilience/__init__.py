@@ -13,6 +13,18 @@ detect → contain → recover shape to the failures that end *runs*:
   luck.
 - :func:`retry` (:mod:`resilience.retry`) — bounded retry with
   decorrelated jitter for checkpoint IO.
+- :class:`CircuitBreaker` (:mod:`resilience.breaker`) — closed →
+  open → half-open failure containment in front of admission; the
+  serving front door uses it to fast-reject
+  (``finish_reason="breaker_open"``) while the engine is
+  demonstrably sick.
+- :class:`ChaosConfig` / :class:`ChaosSchedule`
+  (:mod:`resilience.chaos`) — a seeded random composition of the
+  :class:`FaultPlan` vocabulary plus serving faults (non-finite
+  logit steps, MemoryError bursts, bursty arrivals, random
+  priorities/deadlines); ``tools/chaos_soak.py`` drives the full
+  serving stack against it for thousands of iterations with
+  per-step invariants.
 - :class:`TrainingSentry` (:mod:`resilience.sentry`) — wraps a jitted
   train step: periodic crash-consistent checkpoints (via
   :class:`apex_tpu.utils.checkpoint.CheckpointManager`) and roll-back
@@ -25,6 +37,8 @@ the scheduler in :mod:`apex_tpu.serving`; ``docs/resilience.md`` is the
 joint map.
 """
 
+from apex_tpu.resilience.breaker import CircuitBreaker
+from apex_tpu.resilience.chaos import ChaosConfig, ChaosSchedule
 from apex_tpu.resilience.faults import (
     FaultPlan,
     InjectedCrash,
@@ -39,6 +53,9 @@ from apex_tpu.resilience.sentry import (
 )
 
 __all__ = [
+    "ChaosConfig",
+    "ChaosSchedule",
+    "CircuitBreaker",
     "DivergenceError",
     "FaultPlan",
     "InjectedCrash",

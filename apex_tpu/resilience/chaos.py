@@ -1,0 +1,419 @@
+"""Seeded chaos composition — many faults at once, deterministically.
+
+Single-fault tests (``tests/L0/test_serving_faults.py``,
+``test_resilience.py``) prove each containment mechanism in
+isolation; what they cannot prove is that the mechanisms *compose* —
+that a non-finite logits step during an OOM burst while the queue is
+overflowing with mixed-priority traffic still leaves every invariant
+intact.  This module is the composition harness:
+
+- :class:`ChaosConfig` — rates and ranges for every fault axis;
+- :class:`ChaosSchedule` — the config expanded, via one seeded
+  ``random.Random``, into a concrete per-iteration plan: bursty
+  arrivals with random priorities/deadlines/shared prefixes, the
+  iterations whose decode row gets poisoned non-finite, the
+  iterations whose engine calls raise :class:`MemoryError`, and a
+  list of :class:`FaultPlan` crash plans (the existing training
+  fault vocabulary, composed in as ``InjectedCrash`` raised between
+  serve iterations).  The same ``(config, seed)`` always expands to
+  the same schedule — a chaos failure replays exactly;
+- :class:`ChaosEngine` — a duck-typed wrapper around
+  ``serving.DecodeEngine`` that injects the schedule's engine faults
+  (everything else delegates to the wrapped engine);
+- :func:`run_soak` — drives a full ``InferenceServer`` against the
+  schedule for thousands of iterations, asserting the global
+  invariants EVERY step (allocator/prefix-cache audits, terminal
+  uniqueness) and at the end (bit-exact healthy outputs vs an
+  unfaulted replay, counter reconciliation).  ``tools/chaos_soak.py``
+  is its CLI; the ``chaos`` build-matrix axis runs it at 2000
+  iterations.
+
+This module never imports :mod:`apex_tpu.serving` at module scope
+(``serving.api`` imports :mod:`resilience.breaker`; a top-level
+import back would cycle) — the server is passed in via factories.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from apex_tpu.resilience.faults import FaultPlan, InjectedCrash
+
+__all__ = ["Arrival", "ChaosConfig", "ChaosEngine", "ChaosSchedule",
+           "TERMINAL_REASONS", "run_soak"]
+
+# every legal way a request's life can end; any other value is a bug
+TERMINAL_REASONS = frozenset({
+    "eos", "length",                       # healthy
+    "capacity", "timeout", "nonfinite",    # isolated failures
+    "rejected", "shed", "breaker_open", "draining",  # front door
+})
+
+# reasons with zero or partial output whose tokens must still be a
+# prefix of the unfaulted replay (greedy decoding is deterministic, so
+# whatever a request produced before being cut short is bit-exact)
+HEALTHY_REASONS = frozenset({"eos", "length"})
+
+
+@dataclasses.dataclass(frozen=True)
+class Arrival:
+    """One scheduled request: submitted at iteration ``iter``."""
+
+    iter: int
+    prompt: Tuple[int, ...]
+    max_new_tokens: int
+    priority: int
+    deadline_iters: Optional[int]
+    deadline_s: Optional[float]
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosConfig:
+    """Rates and ranges for every chaos axis.  All probabilities are
+    per serve iteration; all ranges are inclusive."""
+
+    iters: int = 2000
+    vocab: int = 61
+
+    # traffic: a Bernoulli arrival per iteration, occasionally a burst
+    # (the thundering-herd shape that overflows bounded queues), with
+    # some prompts sharing a prefix so the prefix cache/COW paths run
+    arrival_rate: float = 0.3
+    burst_rate: float = 0.06
+    burst_size: Tuple[int, int] = (3, 8)
+    prompt_len: Tuple[int, int] = (2, 20)
+    max_new: Tuple[int, int] = (1, 16)
+    shared_prefix_rate: float = 0.3
+    shared_prefix_len: int = 8
+
+    # request shape: priority classes (0 = foreground .. lowest) and
+    # random deadlines (iteration budget; wall budget on the soak's
+    # deterministic iteration clock)
+    priority_max: int = 2
+    deadline_iters_rate: float = 0.1
+    deadline_iters: Tuple[int, int] = (5, 80)
+    deadline_s_rate: float = 0.05
+    deadline_s: Tuple[float, float] = (5.0, 80.0)
+
+    # faults
+    nonfinite_rate: float = 0.02     # poison one decode row
+    oom_rate: float = 0.01          # start an engine MemoryError burst
+    oom_burst: Tuple[int, int] = (1, 3)
+    crash_every: int = 500          # one FaultPlan InjectedCrash per
+    #                                 ~N iterations (0 = off)
+
+    def __post_init__(self):
+        if self.iters < 1:
+            raise ValueError(f"iters must be >= 1, got {self.iters}")
+        if self.prompt_len[0] < 1:
+            raise ValueError("prompt_len must start >= 1")
+
+
+class ChaosSchedule:
+    """A :class:`ChaosConfig` expanded into concrete per-iteration
+    events by one seeded RNG — build with :meth:`generate`."""
+
+    def __init__(self, cfg: ChaosConfig, seed: int,
+                 arrivals: Dict[int, List[Arrival]],
+                 nonfinite_iters: Set[int],
+                 oom_iters: Set[int],
+                 fault_plans: List[FaultPlan]):
+        self.cfg = cfg
+        self.seed = seed
+        self.arrivals = arrivals
+        self.nonfinite_iters = nonfinite_iters
+        self.oom_iters = oom_iters
+        self.fault_plans = fault_plans
+
+    @property
+    def num_arrivals(self) -> int:
+        return sum(len(v) for v in self.arrivals.values())
+
+    @classmethod
+    def generate(cls, cfg: ChaosConfig, seed: int) -> "ChaosSchedule":
+        rng = random.Random(seed)
+        shared = [rng.randrange(cfg.vocab)
+                  for _ in range(cfg.shared_prefix_len)]
+
+        def one_arrival(i: int) -> Arrival:
+            n = rng.randint(*cfg.prompt_len)
+            prompt = [rng.randrange(cfg.vocab) for _ in range(n)]
+            if rng.random() < cfg.shared_prefix_rate:
+                prompt = shared + prompt
+            d_it = (rng.randint(*cfg.deadline_iters)
+                    if rng.random() < cfg.deadline_iters_rate else None)
+            d_s = (rng.uniform(*cfg.deadline_s)
+                   if rng.random() < cfg.deadline_s_rate else None)
+            return Arrival(iter=i, prompt=tuple(prompt),
+                           max_new_tokens=rng.randint(*cfg.max_new),
+                           priority=rng.randint(0, cfg.priority_max),
+                           deadline_iters=d_it, deadline_s=d_s)
+
+        arrivals: Dict[int, List[Arrival]] = {}
+        nonfinite: Set[int] = set()
+        oom: Set[int] = set()
+        for i in range(cfg.iters):
+            batch: List[Arrival] = []
+            if rng.random() < cfg.arrival_rate:
+                batch.append(one_arrival(i))
+            if rng.random() < cfg.burst_rate:
+                batch.extend(one_arrival(i)
+                             for _ in range(rng.randint(*cfg.burst_size)))
+            if batch:
+                arrivals[i] = batch
+            if rng.random() < cfg.nonfinite_rate:
+                nonfinite.add(i)
+            if rng.random() < cfg.oom_rate:
+                # clamp to the schedule: a burst reaching past the
+                # last iteration would leave drain() retrying a
+                # permanently-OOM engine forever
+                oom.update(x for x in
+                           range(i, i + rng.randint(*cfg.oom_burst))
+                           if x < cfg.iters)
+        # compose the EXISTING fault vocabulary: one FaultPlan per
+        # scheduled crash, ticked by iteration number (crash_kind
+        # "raise" — SIGKILL would end the soak process, which the
+        # crash_resume build-matrix axis already covers)
+        plans: List[FaultPlan] = []
+        if cfg.crash_every:
+            step = cfg.crash_every
+            for base in range(step, cfg.iters, step):
+                plans.append(FaultPlan(
+                    crash_step=base + rng.randint(0, step // 4),
+                    crash_kind="raise"))
+        return cls(cfg, seed, arrivals, nonfinite, oom, plans)
+
+
+class ChaosEngine:
+    """Duck-typed ``DecodeEngine`` wrapper injecting schedule faults.
+
+    Installed post-construction (``server.engine = ChaosEngine(...)``)
+    so the real engine, allocator, and cache stay exactly as the
+    server built them.  Per :meth:`begin_iter`:
+
+    - a scheduled :class:`FaultPlan` crash raises
+      :class:`InjectedCrash` (the soak catches it around ``step()``
+      and carries on — no scheduler state has moved);
+    - an OOM iteration makes every engine call raise
+      :class:`MemoryError` (the serve loop's isolation skips and
+      retries bit-identically);
+    - a non-finite iteration overwrites one random decode row with
+      NaN after the real computation — the KV writes are real, only
+      the returned logits are poisoned, exactly the failure mode of
+      a numerically-diverged model.
+    """
+
+    def __init__(self, inner, schedule: ChaosSchedule):
+        self.inner = inner
+        self.schedule = schedule
+        # runtime draws (victim rows) come from a separate stream so
+        # schedule generation and injection stay independent
+        self.rng = random.Random(schedule.seed ^ 0x5EED)
+        self.iter = -1
+        self.injected = {"oom": 0, "nonfinite_rows": 0, "crashes": 0}
+
+    def begin_iter(self, i: int) -> None:
+        self.iter = i
+        for plan in self.schedule.fault_plans:
+            if plan.crash_step == i:
+                self.injected["crashes"] += 1
+            plan.tick(i)
+
+    def _oom_gate(self) -> None:
+        if self.iter in self.schedule.oom_iters:
+            self.injected["oom"] += 1
+            raise MemoryError(
+                f"chaos: injected engine OOM at iteration {self.iter}")
+
+    def prefill(self, tokens, block_table):
+        self._oom_gate()
+        return self.inner.prefill(tokens, block_table)
+
+    def chunk_prefill(self, tokens, start, block_table, pad_to=None):
+        self._oom_gate()
+        return self.inner.chunk_prefill(tokens, start, block_table,
+                                        pad_to=pad_to)
+
+    def copy_blocks(self, pairs):
+        self._oom_gate()
+        return self.inner.copy_blocks(pairs)
+
+    def decode(self, tokens, positions, tables):
+        import numpy as np
+
+        self._oom_gate()
+        out = np.asarray(self.inner.decode(tokens, positions, tables))
+        if self.iter in self.schedule.nonfinite_iters:
+            row = self.rng.randrange(out.shape[0])
+            out = out.copy()
+            out[row] = np.nan
+            self.injected["nonfinite_rows"] += 1
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
+             make_replay: Optional[Callable] = None,
+             log: Callable[[str], None] = lambda s: None) -> dict:
+    """Drive a full server through the chaos schedule, asserting the
+    global invariants; returns a report dict (raises AssertionError
+    with context on the first violation).
+
+    ``make_server(clock)`` must build a fresh ``InferenceServer``
+    whose wall clock (and breaker clock) is the given callable — the
+    soak drives it in whole iterations, so the entire run, including
+    breaker cooldowns and ``deadline_s`` expiries, is deterministic
+    for a given ``(cfg, seed)``.  ``make_replay(clock)`` (default:
+    ``make_server``) builds the unfaulted replay server — typically
+    with a roomy pool so replays never hit capacity.
+
+    Invariants, per step:
+      1. scheduler/allocator/prefix-cache ``audit()`` passes;
+      2. every newly finished request has exactly one terminal
+         ``finish_reason`` from :data:`TERMINAL_REASONS`, and no
+         request finishes twice;
+      3. no finished request lingers in the waiting queue or batch.
+    At the end (after ``drain()``):
+      4. every submitted request reached a terminal state;
+      5. healthy (eos/length) requests are bit-exact against the
+         unfaulted replay, and cut-short requests (timeout / shed /
+         capacity / nonfinite) produced a bit-exact PREFIX of it;
+      6. ``stats()`` reconciles with observed outcomes: finished
+         count, per-reason failure counters, breaker rejections, and
+         injected-vs-counted OOM events all agree.
+    """
+    schedule = ChaosSchedule.generate(cfg, seed)
+    clock_state = {"t": 0.0}
+    server = make_server(lambda: clock_state["t"])
+    chaos = ChaosEngine(server.engine, schedule)
+    server.engine = chaos
+
+    sched = server.scheduler
+    tracked: Dict[int, object] = {}     # uid -> Request
+    terminal: Dict[int, str] = {}       # uid -> finish_reason
+    report = {"iters": cfg.iters, "seed": seed, "crashes_caught": 0}
+
+    def absorb_finished():
+        """Walk newly finished requests (invariants 2 + 3)."""
+        for req in sched.finished[len(terminal):]:
+            assert req.uid not in terminal, \
+                f"request {req.uid} finished twice"
+            assert req.finished and req.finish_reason in TERMINAL_REASONS, \
+                (f"request {req.uid} finished with bad reason "
+                 f"{req.finish_reason!r}")
+            assert req.finished_at is not None, \
+                f"request {req.uid} finished without finished_at"
+            terminal[req.uid] = req.finish_reason
+
+    for i in range(cfg.iters):
+        clock_state["t"] = float(i)
+        for a in schedule.arrivals.get(i, ()):
+            req = server.submit(list(a.prompt), a.max_new_tokens,
+                                priority=a.priority,
+                                deadline_iters=a.deadline_iters,
+                                deadline_s=a.deadline_s)
+            tracked[req.uid] = (req, a)
+        try:
+            chaos.begin_iter(i)
+            server.step()
+        except InjectedCrash:
+            # a FaultPlan crash between engine steps: nothing was
+            # half-applied, so the very next iteration carries on
+            report["crashes_caught"] += 1
+        sched.audit()                                   # invariant 1
+        absorb_finished()
+        for req in sched.waiting:
+            assert not req.finished, \
+                f"finished request {req.uid} still waiting"
+        for req in sched.running.values():
+            assert not req.finished, \
+                f"finished request {req.uid} still in the batch"
+        if i and i % 500 == 0:
+            log(f"iter {i}: {len(terminal)}/{len(tracked)} terminal, "
+                f"pressure={sched.pressure():.2f}, "
+                f"breaker={server.breaker.state}")
+
+    clock_state["t"] = float(cfg.iters)
+    chaos.begin_iter(cfg.iters)     # past the schedule: drain unfaulted
+    server.drain()
+    sched.audit()
+    absorb_finished()
+    for uid, (req, _) in tracked.items():               # invariant 4
+        assert req.finished and uid in terminal, \
+            f"request {uid} never reached a terminal state"
+    assert not sched.has_work, "drained server still has work"
+
+    # invariant 5: bit-exact healthy outputs / prefixes vs an
+    # unfaulted replay of the same prompts (greedy decoding makes the
+    # comparison an equality, not a tolerance)
+    make_replay = make_replay or make_server
+    replay = make_replay(lambda: 0.0)
+    outputs: Dict[Tuple, List[int]] = {}
+    by_budget: Dict[int, List[Tuple]] = {}
+    for req, a in tracked.values():
+        key = (a.prompt, req.max_new_tokens)
+        if key not in outputs:
+            outputs[key] = None
+            by_budget.setdefault(req.max_new_tokens, []).append(key)
+    for budget, keys in sorted(by_budget.items()):
+        outs = replay.generate([list(k[0]) for k in keys], budget)
+        for key, out in zip(keys, outs):
+            outputs[key] = out
+    checked = prefix_checked = 0
+    for req, a in tracked.values():
+        ref = outputs[(a.prompt, req.max_new_tokens)]
+        if req.finish_reason in HEALTHY_REASONS:
+            assert list(req.generated) == ref, \
+                (f"healthy request {req.uid} diverged from replay: "
+                 f"{req.generated} != {ref}")
+            checked += 1
+        elif req.generated:
+            assert list(req.generated) == ref[:len(req.generated)], \
+                (f"{req.finish_reason} request {req.uid}'s partial "
+                 f"output is not a prefix of the replay")
+            prefix_checked += 1
+
+    # invariant 6: counters reconcile with observed outcomes
+    stats = server.stats()
+    tally: Dict[str, int] = {}
+    for reason in terminal.values():
+        tally[reason] = tally.get(reason, 0) + 1
+    assert stats["requests_finished"] == len(terminal), \
+        (f"stats requests_finished={stats['requests_finished']} != "
+         f"{len(terminal)} observed")
+    failure_tally = {r: n for r, n in tally.items()
+                     if r not in HEALTHY_REASONS}
+    for reason, n in failure_tally.items():
+        got = stats["requests_failed"].get(
+            f"requests_failed_{reason}", 0)
+        assert got == n, \
+            (f"counter requests_failed_{reason}={got} != {n} observed")
+    assert stats["requests_failed_total"] == sum(failure_tally.values())
+    breaker_rejects = stats["breaker_events"].get(
+        "breaker_rejections", 0)
+    assert breaker_rejects == tally.get("breaker_open", 0), \
+        (f"breaker counted {breaker_rejects} rejections, observed "
+         f"{tally.get('breaker_open', 0)} breaker_open finishes")
+    assert stats["oom_events"] == chaos.injected["oom"], \
+        (f"server counted {stats['oom_events']} OOM events, chaos "
+         f"injected {chaos.injected['oom']}")
+    assert report["crashes_caught"] == chaos.injected["crashes"]
+
+    report.update(
+        submitted=len(tracked),
+        finished=dict(sorted(tally.items())),
+        bit_exact_checked=checked,
+        prefix_checked=prefix_checked,
+        injected=dict(chaos.injected),
+        sheds=tally.get("shed", 0),
+        breaker_open=tally.get("breaker_open", 0),
+        preemptions=stats["preemptions"],
+        pressure_peak=stats["pressure_peak"],
+        breaker_state=stats["breaker_state"],
+        oom_events=stats["oom_events"],
+    )
+    return report

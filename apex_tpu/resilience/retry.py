@@ -89,4 +89,5 @@ def retry(
                 on_retry(attempt, err)
             sleep(delay)
     raise RetryError(
-        f"all {attempts} attempts failed") from last
+        f"all {attempts} attempts failed; last error: "
+        f"{type(last).__name__}: {last}") from last

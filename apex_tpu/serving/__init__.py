@@ -28,7 +28,13 @@ serve".  Three layers, bottom-up:
   :class:`InferenceServer` front door, with failure isolation: one
   pathological request finishes alone (``finish_reason`` ``capacity``
   / ``timeout`` / ``rejected`` / ``nonfinite``) instead of raising
-  into the batch (``docs/resilience.md``).
+  into the batch (``docs/resilience.md``);
+- :mod:`serving.overload` + the lifecycle layer — priority-aware load
+  shedding (``finish_reason="shed"``) under queue/pool pressure, a
+  circuit breaker in front of ``submit``
+  (``finish_reason="breaker_open"``), and graceful ``drain()`` /
+  ``close()`` with bit-identical in-flight completions
+  (``docs/resilience.md``, "Overload policy & lifecycle").
 
 Quick start::
 
@@ -50,6 +56,7 @@ from apex_tpu.serving.kv_cache import (
     init_kv_cache,
     resolve_cache_dtype,
 )
+from apex_tpu.serving.overload import OverloadPolicy
 from apex_tpu.serving.prefix_cache import PrefixCache
 from apex_tpu.serving.scheduler import QueueFullError, Request, Scheduler
 
@@ -58,6 +65,7 @@ __all__ = [
     "DecodeEngine",
     "InferenceServer",
     "KVCacheConfig",
+    "OverloadPolicy",
     "PrefixCache",
     "QueueFullError",
     "Request",

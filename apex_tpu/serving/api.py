@@ -39,20 +39,40 @@ expires per-request deadlines (iteration or wall budget →
 ``finish_reason="capacity"``, and (3) evicts any request whose logits
 went non-finite (``finish_reason="nonfinite"``) before sampling can
 poison the rest of the batch.  A bounded waiting queue rejects at
-submission (``finish_reason="rejected"``).  Every failure is counted
-by reason in a :class:`apex_tpu.utils.CounterMeter` surfaced through
+submission (``finish_reason="rejected"``).  A transient engine
+``MemoryError`` (an HBM allocation burst) skips the affected engine
+call for one iteration and retries — same inputs, same logits, so
+generation stays bit-stable — instead of killing the batch.  Every
+failure is counted by reason in a
+:class:`apex_tpu.utils.CounterMeter` surfaced through
 :meth:`InferenceServer.stats`.
+
+Overload control & lifecycle (``docs/resilience.md``, "Overload
+policy & lifecycle"; both ON by default): requests carry a
+``priority`` class and a block-cost estimate; under queue/pool
+pressure the scheduler sheds the lowest-priority, newest waiting work
+(``finish_reason="shed"``) and preempts worst-priority-first
+(:mod:`serving.overload`).  A :class:`resilience.CircuitBreaker`
+guards ``submit`` — after a streak of non-finite/OOM failures it
+fast-rejects with ``finish_reason="breaker_open"`` until a half-open
+probe succeeds.  :meth:`InferenceServer.drain` stops admissions
+(``finish_reason="draining"``) and runs every in-flight request to a
+terminal state — in-flight generation is bit-identical whether or not
+a drain begins mid-stream — and :meth:`InferenceServer.close` drains
+exactly once and makes further submission an error.
 """
 
 from __future__ import annotations
 
 import time
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from apex_tpu.observability import MetricsRegistry, get_tracer
+from apex_tpu.resilience.breaker import CircuitBreaker
 from apex_tpu.serving.engine import DecodeEngine
+from apex_tpu.serving.overload import OverloadPolicy
 from apex_tpu.serving.prefix_cache import PrefixCache
 from apex_tpu.serving.scheduler import QueueFullError, Request, Scheduler
 from apex_tpu.utils import CounterMeter, GaugeMeter, RateMeter
@@ -109,6 +129,18 @@ class InferenceServer:
       prefill_chunk: chunk width in tokens (default
         ``min(256, max_context)``); ignored when chunked prefill is
         off.
+      overload_policy: the :class:`serving.overload.OverloadPolicy`
+        driving priority-aware load shedding (queue-full
+        displacement, pressure shedding of best-effort waiting work,
+        worst-priority preemption).  Default: a policy with stock
+        thresholds; ``enable_overload=False`` opts out (queue-full
+        strictly rejects, preemption is youngest-first).
+      breaker: the :class:`apex_tpu.resilience.CircuitBreaker`
+        guarding ``submit`` (default: stock thresholds on the
+        server's ``clock``); after a streak of non-finite/OOM
+        failures submissions fast-reject with
+        ``finish_reason="breaker_open"`` until a half-open probe
+        completes.  ``enable_breaker=False`` opts out.
       registry: the :class:`apex_tpu.observability.MetricsRegistry`
         holding every counter/gauge/histogram this server feeds
         (default: a fresh private one).  Pass a shared registry to
@@ -139,6 +171,10 @@ class InferenceServer:
                  enable_prefix_cache: bool = True,
                  enable_chunked_prefill: bool = True,
                  prefill_chunk: Optional[int] = None,
+                 enable_overload: bool = True,
+                 overload_policy: Optional[OverloadPolicy] = None,
+                 enable_breaker: bool = True,
+                 breaker: Optional[CircuitBreaker] = None,
                  registry: Optional[MetricsRegistry] = None,
                  tracer=None):
         self.registry = registry if registry is not None \
@@ -164,6 +200,9 @@ class InferenceServer:
             self.prefill_chunk = int(
                 prefill_chunk if prefill_chunk is not None
                 else min(DEFAULT_PREFILL_CHUNK, self.engine.max_context))
+        self.overload_policy = (
+            overload_policy if overload_policy is not None
+            else OverloadPolicy()) if enable_overload else None
         self.scheduler = Scheduler(
             self.engine.allocator,
             max_batch_size=self.engine.max_batch_size,
@@ -173,11 +212,31 @@ class InferenceServer:
             counters=self.failures,
             prefix_cache=self.prefix_cache,
             chunk_size=self.prefill_chunk,
+            overload=self.overload_policy,
             tracer=self.tracer)
         self.sample_fn = sample_fn or greedy_sample
         self.clock = clock
+        self.breaker_events = CounterMeter(registry=self.registry,
+                                           name="serving_breaker",
+                                           label="event")
+        self.breaker = (
+            breaker if breaker is not None
+            else CircuitBreaker(clock=clock,
+                                counters=self.breaker_events)
+        ) if enable_breaker else None
+        if self.breaker is not None and self.breaker.counters is None:
+            # a caller-built breaker without its own counters reports
+            # through the server's registry, so stats() reconciles
+            self.breaker.counters = self.breaker_events
+        self.oom = CounterMeter(registry=self.registry,
+                                name="serving_oom", label="site")
+        self._draining = False
+        self._closed = False
+        self._final_stats: Optional[dict] = None
         self.queue_depth = GaugeMeter(registry=self.registry,
                                       name="serving_queue_depth")
+        self.pressure_gauge = GaugeMeter(registry=self.registry,
+                                         name="serving_pressure")
         self.occupancy = GaugeMeter(registry=self.registry,
                                     name="serving_batch_occupancy")
         self.chunk_iters = GaugeMeter(   # chunk prefills per iteration
@@ -190,6 +249,9 @@ class InferenceServer:
         self.queue_wait = hist("serving_queue_wait_s")
         self.decode_latency = hist("serving_decode_token_s")
         self.step_time = hist("serving_step_s")
+        # per-priority-class queue-wait distributions, materialized as
+        # classes are first seen (labeled series of the same metric)
+        self._queue_wait_prio: Dict[int, object] = {}
         self._iter = 0              # scheduler iterations served
         self._finalized = 0         # scheduler.finished timeline cursor
 
@@ -197,6 +259,7 @@ class InferenceServer:
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                eos_id: Optional[int] = None, *,
+               priority: int = 0,
                deadline_iters: Optional[int] = None,
                deadline_s: Optional[float] = None) -> Request:
         """Enqueue one request.
@@ -205,11 +268,25 @@ class InferenceServer:
         room to generate within ``max_context`` is rejected with
         :class:`ValueError` (never silently capped to a <= 0 budget);
         a budget that merely overshoots the remaining context is capped
-        down to fit.  When the bounded waiting queue is full the
-        request is returned already finished with
-        ``finish_reason="rejected"`` instead of enqueued.  Optional
+        down to fit.  ``priority`` is nice-style (0 = default
+        foreground class; larger = lower priority, sheddable under
+        overload — :mod:`serving.overload`).  Optional
         ``deadline_iters`` / ``deadline_s`` expire the request to
-        ``finish_reason="timeout"``."""
+        ``finish_reason="timeout"``.
+
+        A request can come back already finished instead of enqueued
+        — always with ``finished_at`` stamped at submission and never
+        entering the admission-latency histograms:
+        ``finish_reason="rejected"`` (bounded queue full, no
+        lower-priority work to displace), ``"breaker_open"`` (circuit
+        breaker tripped), or ``"draining"`` (after :meth:`drain` /
+        :meth:`close` began).  Submitting to a closed server raises
+        :class:`RuntimeError`.  A queue-full submission may instead
+        displace a lower-priority queued request, which then finishes
+        ``"shed"`` during this call."""
+        if self._closed:
+            raise RuntimeError(
+                "InferenceServer is closed; no further submissions")
         prompt = [int(t) for t in prompt]
         if int(max_new_tokens) < 1:
             raise ValueError(
@@ -222,21 +299,42 @@ class InferenceServer:
         req = Request(prompt=prompt,
                       max_new_tokens=min(int(max_new_tokens), cap),
                       eos_id=eos_id,
+                      priority=int(priority),
                       deadline_iters=deadline_iters,
                       deadline_s=deadline_s,
                       submit_iter=self._iter,
                       submitted_at=self.clock())
         if self.tracer.enabled:
             self.tracer.instant("request_enqueue", uid=req.uid,
-                                prompt_tokens=len(prompt))
+                                prompt_tokens=len(prompt),
+                                priority=req.priority)
+        if self._draining:
+            return self._finish_at_submit(req, "draining")
+        if self.breaker is not None and not self.breaker.allow():
+            return self._finish_at_submit(req, "breaker_open")
         try:
-            return self.scheduler.submit(req)
+            self.scheduler.submit(req)
         except QueueFullError:
-            req.finished = True
-            req.finish_reason = "rejected"
-            self.scheduler.finished.append(req)
-            self.failures.incr("requests_failed_rejected")
-            return req
+            return self._finish_at_submit(req, "rejected")
+        # a displaced victim may have finished "shed" inside
+        # scheduler.submit: stamp its finished_at at submission time
+        if self._finalized < len(self.scheduler.finished):
+            self._finalize_finished()
+        return req
+
+    def _finish_at_submit(self, req: Request, reason: str) -> Request:
+        """Finish ``req`` without ever enqueueing it (rejected /
+        breaker_open / draining): terminal reason set, failure
+        counted, and ``finished_at`` stamped NOW — submit-time
+        rejections must not wait for the next step to close their
+        timeline, and being never-admitted they stay out of the
+        TTFT/queue-wait histograms."""
+        req.finished = True
+        req.finish_reason = reason
+        self.scheduler.finished.append(req)
+        self.failures.incr(f"requests_failed_{reason}")
+        self._finalize_finished()
+        return req
 
     def _expire_deadlines(self) -> None:
         """Fail every live request whose iteration or wall budget is
@@ -264,14 +362,25 @@ class InferenceServer:
         most one chunk — and a prefix-cache hit skips straight to its
         uncached tail.  Returns the number of tokens sampled
         (0 = idle, though chunk prefills may still have run).
-        Per-request failures (capacity / timeout / nonfinite) finish
-        the affected request alone — no exception escapes the step
+        Per-request failures (capacity / timeout / nonfinite / shed)
+        finish the affected request alone, and a transient engine
+        ``MemoryError`` skips the affected call for one iteration
+        (retried bit-identically) — no exception escapes the step
         loop for them."""
         sched, engine, tr = self.scheduler, self.engine, self.tracer
         self._iter += 1
         produced = 0
         step_start = self.clock()
         self._expire_deadlines()
+
+        # overload: record the pressure signal at its pre-shed peak,
+        # then shed best-effort waiting work while the policy says so
+        self.pressure_gauge.update(sched.pressure())
+        shed = sched.shed_overload()
+        if shed and tr.enabled:
+            for r in shed:
+                tr.instant("request_shed", uid=r.uid,
+                           priority=r.priority)
 
         with tr.span("admit"):
             admitted = sched.admit()
@@ -287,27 +396,42 @@ class InferenceServer:
         # block (copy-on-write) so the tail re-write stays private
         cows = [r for r in sched._admit_order if r.pending_cow]
         if cows:
-            with tr.span("cow_copy", blocks=len(cows)):
-                engine.copy_blocks([r.pending_cow for r in cows])
-            for req in cows:
-                sched.cow_done(req)
+            try:
+                with tr.span("cow_copy", blocks=len(cows)):
+                    engine.copy_blocks([r.pending_cow for r in cows])
+            except MemoryError:
+                # transient HBM burst: nothing was accounted, the same
+                # copies re-launch next iteration bit-identically
+                self._note_oom("copy_blocks")
+            else:
+                for req in cows:
+                    sched.cow_done(req)
 
         chunks = 0
         for req in [r for r in sched._admit_order if r.prefilling]:
             tokens, start, is_last = sched.prefill_plan(req)
-            if (start == 0 and is_last and self.prefill_chunk is None):
-                # no cached prefix, no chunking: the monolithic
-                # bucketed prefill (the pre-chunking path, bit-for-bit)
-                with tr.span("prefill", uid=req.uid,
-                             tokens=len(tokens)):
-                    logits = engine.prefill(tokens, req.block_table)
-            else:
-                with tr.span("chunk_prefill", uid=req.uid,
-                             tokens=len(tokens), start=start):
-                    logits = engine.chunk_prefill(
-                        tokens, start, req.block_table,
-                        pad_to=self.prefill_chunk)
-                chunks += 1
+            try:
+                if (start == 0 and is_last
+                        and self.prefill_chunk is None):
+                    # no cached prefix, no chunking: the monolithic
+                    # bucketed prefill (the pre-chunking path,
+                    # bit-for-bit)
+                    with tr.span("prefill", uid=req.uid,
+                                 tokens=len(tokens)):
+                        logits = engine.prefill(tokens,
+                                                req.block_table)
+                else:
+                    with tr.span("chunk_prefill", uid=req.uid,
+                                 tokens=len(tokens), start=start):
+                        logits = engine.chunk_prefill(
+                            tokens, start, req.block_table,
+                            pad_to=self.prefill_chunk)
+                    chunks += 1
+            except MemoryError:
+                # chunk_done not called: this exact chunk replays
+                # next iteration, so generation stays bit-stable
+                self._note_oom("prefill")
+                continue
             done = sched.chunk_done(req, len(tokens))
             if not done or not req.prefill_sample:
                 # mid-prefill, or resumed after preemption (the
@@ -316,6 +440,8 @@ class InferenceServer:
             logits = np.asarray(logits)
             if not np.all(np.isfinite(logits)):
                 sched.fail(req, "nonfinite")
+                if self.breaker is not None:
+                    self.breaker.record_failure()
                 continue
             tok = int(self.sample_fn(logits))
             req.record_token(tok)
@@ -323,6 +449,8 @@ class InferenceServer:
             produced += 1
             if req.finished:
                 sched.retire(req)
+                if self.breaker is not None:
+                    self.breaker.record_success()
         self.chunk_iters.update(chunks)
         if chunks:
             self.prefix.incr("prefill_chunks", chunks)
@@ -348,29 +476,41 @@ class InferenceServer:
                     positions[req.slot] = req.num_cached
                     tables[req.slot, :len(req.block_table)] = \
                         req.block_table
-                with tr.span("decode", batch=len(running)):
-                    logits = np.asarray(
-                        engine.decode(tokens, positions, tables))
-                # step guard: a row of non-finite logits means this
-                # request's state is poisoned — evict it before its
-                # garbage token enters sampling/termination logic;
-                # every finite row proceeds normally
-                finite_rows = np.all(np.isfinite(logits), axis=-1)
-                toks = self.sample_fn(logits)
-                for req in running:
-                    if not finite_rows[req.slot]:
-                        sched.fail(req, "nonfinite")
-                        continue
-                    req.num_cached += 1
-                    req.record_token(int(toks[req.slot]))
-                    self._note_first_token(req)
-                    produced += 1
-                    if req.finished:
-                        sched.retire(req)
-                    else:
-                        # index any block this token just filled so a
-                        # later shared-prefix request can match it
-                        sched.register_progress(req)
+                try:
+                    with tr.span("decode", batch=len(running)):
+                        logits = np.asarray(
+                            engine.decode(tokens, positions, tables))
+                except MemoryError:
+                    # transient HBM burst: no request state moved, the
+                    # identical decode re-runs next iteration
+                    self._note_oom("decode")
+                else:
+                    # step guard: a row of non-finite logits means
+                    # this request's state is poisoned — evict it
+                    # before its garbage token enters
+                    # sampling/termination logic; every finite row
+                    # proceeds normally
+                    finite_rows = np.all(np.isfinite(logits), axis=-1)
+                    toks = self.sample_fn(logits)
+                    for req in running:
+                        if not finite_rows[req.slot]:
+                            sched.fail(req, "nonfinite")
+                            if self.breaker is not None:
+                                self.breaker.record_failure()
+                            continue
+                        req.num_cached += 1
+                        req.record_token(int(toks[req.slot]))
+                        self._note_first_token(req)
+                        produced += 1
+                        if req.finished:
+                            sched.retire(req)
+                            if self.breaker is not None:
+                                self.breaker.record_success()
+                        else:
+                            # index any block this token just filled
+                            # so a later shared-prefix request can
+                            # match it
+                            sched.register_progress(req)
 
         self.tokens.update(produced)
         self.queue_depth.update(sched.num_waiting)
@@ -379,6 +519,17 @@ class InferenceServer:
         self.step_time.record(self.clock() - step_start)
         self._finalize_finished()
         return produced
+
+    def _note_oom(self, site: str) -> None:
+        """Account one transient engine ``MemoryError``: the affected
+        call was skipped (nothing mutated) and will retry next
+        iteration; the circuit breaker counts it as a failure so a
+        sustained OOM burst trips fast rejection at the front door."""
+        self.oom.incr(site)
+        if self.breaker is not None:
+            self.breaker.record_failure()
+        if self.tracer.enabled:
+            self.tracer.instant("engine_oom", site=site)
 
     # -- per-request timelines --------------------------------------------
 
@@ -406,30 +557,49 @@ class InferenceServer:
                 self.tracer.instant("request_finish", uid=req.uid,
                                     reason=req.finish_reason or "",
                                     tokens=len(req.generated))
+            # never-admitted requests (rejected / shed-from-queue /
+            # breaker_open / draining / queued timeout) have no
+            # admitted_at, so timeline() emits no queue_wait_s/ttft_s
+            # — admission latency never mixes in requests that were
+            # turned away at the front door
             tl = req.timeline()
             if "queue_wait_s" in tl:
                 self.queue_wait.record(tl["queue_wait_s"])
+                self._queue_wait_for(req.priority).record(
+                    tl["queue_wait_s"])
             if "ttft_s" in tl:
                 self.ttft.record(tl["ttft_s"])
             if "decode_token_s" in tl:
                 self.decode_latency.record(tl["decode_token_s"])
+
+    def _queue_wait_for(self, priority: int):
+        """The per-priority-class queue-wait histogram (a labeled
+        series of ``serving_queue_wait_s``), created on first use."""
+        h = self._queue_wait_prio.get(priority)
+        if h is None:
+            h = self.registry.histogram("serving_queue_wait_s",
+                                        priority=str(priority))
+            self._queue_wait_prio[priority] = h
+        return h
 
     # -- front door -------------------------------------------------------
 
     def generate(self, prompts: Sequence[Sequence[int]],
                  max_new_tokens: int,
                  eos_id: Optional[int] = None, *,
+                 priority: int = 0,
                  deadline_iters: Optional[int] = None,
                  deadline_s: Optional[float] = None,
                  return_requests: bool = False):
         """Generate completions for ``prompts`` (token-id lists) and
         return the generated ids per prompt, in input order.
 
-        A request that fails (capacity / timeout / rejected /
+        A request that fails (capacity / timeout / rejected / shed /
         nonfinite) contributes whatever it generated before failing —
         inspect ``finish_reason`` via ``return_requests=True`` to tell
         a clean completion from an isolated failure."""
         reqs = [self.submit(p, max_new_tokens, eos_id,
+                            priority=priority,
                             deadline_iters=deadline_iters,
                             deadline_s=deadline_s)
                 for p in prompts]
@@ -439,16 +609,55 @@ class InferenceServer:
             return reqs
         return [list(r.generated) for r in reqs]
 
+    # -- graceful lifecycle -----------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def drain(self) -> dict:
+        """Graceful shutdown, phase one: stop admissions (subsequent
+        submits finish immediately with ``finish_reason="draining"``)
+        and run every in-flight request to a terminal state.  Draining
+        changes nothing about how in-flight work computes — the same
+        scheduler/engine steps run on the same state — so a request's
+        tokens are bit-identical whether or not a drain begins
+        mid-generation (pinned by ``tests/L0/test_overload.py``).
+        Idempotent; returns the flushed :meth:`stats` snapshot."""
+        self._draining = True
+        while self.scheduler.has_work:
+            self.step()
+        self._finalize_finished()
+        return self.stats()
+
+    def close(self) -> dict:
+        """Graceful shutdown, phase two: :meth:`drain`, then refuse
+        all further submissions (:class:`RuntimeError`).  Exactly-once:
+        the drain runs on the first call only; repeated calls return
+        the same final stats snapshot without re-running anything."""
+        if self._closed:
+            return self._final_stats
+        self._final_stats = self.drain()
+        self._closed = True
+        return self._final_stats
+
     def reset_meters(self) -> None:
         """Zero the counters (after compile warmup, before a timed
         window) — a completed :meth:`generate` already returns every
         slot and block, so the server itself needs no reset."""
         self.tokens.reset()
         self.queue_depth.reset()
+        self.pressure_gauge.reset()
         self.occupancy.reset()
         self.chunk_iters.reset()
         self.ttft.reset()
         self.queue_wait.reset()
+        for h in self._queue_wait_prio.values():
+            h.reset()
         self.decode_latency.reset()
         self.step_time.reset()
         self.scheduler.finished.clear()
@@ -489,11 +698,24 @@ class InferenceServer:
             "requests_failed_total": self.failures.total,
             "prefill_chunks": self.prefix.count("prefill_chunks"),
             "chunk_iters_peak": self.chunk_iters.peak,
+            # overload / lifecycle telemetry (docs/resilience.md,
+            # "Overload policy & lifecycle")
+            "pressure": round(self.pressure_gauge.val, 3),
+            "pressure_peak": round(self.pressure_gauge.peak, 3),
+            "breaker_state": (self.breaker.state
+                              if self.breaker is not None
+                              else "disabled"),
+            "breaker_events": self.breaker_events.as_dict(),
+            "oom_events": self.oom.total,
+            "draining": self._draining,
             "latency": {
                 "ttft_ms": _hist_ms(self.ttft),
                 "queue_wait_ms": _hist_ms(self.queue_wait),
                 "decode_token_ms": _hist_ms(self.decode_latency),
                 "step_ms": _hist_ms(self.step_time),
+                "queue_wait_by_priority_ms": {
+                    p: _hist_ms(h) for p, h in
+                    sorted(self._queue_wait_prio.items())},
             },
         }
         if self.prefix_cache is not None:

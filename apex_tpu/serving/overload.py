@@ -1,0 +1,91 @@
+"""Overload policy — who gets shed, and when, under serving pressure.
+
+A bounded queue alone turns overload into a coin flip: whichever
+request happens to arrive after the queue fills is rejected, however
+important it is, while low-value work that arrived a moment earlier
+keeps its slot.  Production schedulers in the continuous-batching
+lineage (Orca's iteration-level admission, vLLM's priority-aware
+preemption) treat overload as a *policy* decision instead: requests
+carry a priority class and a cost estimate, and when pressure crosses
+a threshold the system sheds the lowest-priority, newest work —
+explicitly, with ``finish_reason="shed"`` — rather than blindly
+bouncing the next arrival.
+
+Vocabulary (used by :mod:`serving.scheduler` and ``serving.api``):
+
+- **priority** (``Request.priority``): an integer class, nice-style —
+  ``0`` is the default/foreground class, larger numbers are *lower*
+  priority.  Anything at or above
+  :attr:`OverloadPolicy.best_effort_priority` is *best-effort*:
+  sheddable under pool pressure, first in line for displacement and
+  preemption.
+- **cost estimate** (``Request.cost_blocks``): the KV blocks the
+  request will hold if it runs to completion —
+  ``blocks_for(len(prompt) + max_new_tokens)`` — stamped at
+  submission.  Queued demand is the sum of waiting costs; it feeds
+  the pressure signal so a burst of expensive prompts registers as
+  overload *before* the pool physically fills.
+- **pressure** (:meth:`Scheduler.pressure`): the max of the queue
+  fill fraction and ``(live blocks + queued demand) / usable
+  blocks``.  May exceed 1.0 — demand is unbounded even though the
+  pool is not.
+
+Policy knobs, all with safe defaults (the layer is ON by default in
+``InferenceServer``; ``overload_policy=None`` opts out):
+
+- queue-full **displacement**: when the bounded queue is full, an
+  arrival that outranks the worst queued request displaces it (the
+  victim finishes ``"shed"``); an arrival that doesn't outrank anyone
+  is rejected exactly as before (``"rejected"``), so equal-priority
+  traffic behaves byte-for-byte like the pre-overload server.
+- pressure **shedding**: each step, while pressure is at or above
+  ``shed_threshold``, best-effort waiting work is shed worst-first
+  (highest priority number, newest first).  Foreground (priority <
+  ``best_effort_priority``) work is never pressure-shed.
+- priority-aware **preemption**: the preemption victim is the worst
+  (priority, then youngest-admitted) running request, so foreground
+  work keeps its blocks while best-effort work recomputes.  With all
+  priorities equal this degenerates to the historical
+  youngest-first choice — preemption bit-stability tests are
+  unaffected.
+
+``docs/resilience.md`` ("Overload policy & lifecycle") has the full
+shed / reject / breaker decision table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+__all__ = ["OverloadPolicy"]
+
+
+@dataclasses.dataclass(frozen=True)
+class OverloadPolicy:
+    """Thresholds for the shed/displace/preempt decisions above.
+
+    ``shed_threshold``: pressure (see module docstring) at or above
+    which best-effort waiting work is shed each step.  ``1.0`` means
+    "only when queued demand already exceeds what the pool could ever
+    deliver promptly"; the 0.9 default sheds slightly before the
+    cliff.  ``best_effort_priority``: the priority class at which
+    work becomes sheddable (default 1: every non-default class).
+    ``displace``: whether queue-full arrivals may displace
+    lower-priority queued work."""
+
+    shed_threshold: float = 0.9
+    best_effort_priority: int = 1
+    displace: bool = True
+
+    def __post_init__(self):
+        if self.shed_threshold <= 0:
+            raise ValueError(
+                f"shed_threshold must be > 0, got {self.shed_threshold}")
+        if self.best_effort_priority < 1:
+            raise ValueError(
+                "best_effort_priority must be >= 1 (priority 0 is the "
+                f"never-shed default class), got "
+                f"{self.best_effort_priority}")
+
+    def sheddable(self, priority: int) -> bool:
+        return priority >= self.best_effort_priority

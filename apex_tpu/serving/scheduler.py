@@ -60,6 +60,14 @@ at submission with :class:`QueueFullError`; expired deadlines and
 non-finite logits are detected by ``serving.api`` and routed through
 the same :meth:`Scheduler.fail` (reasons ``timeout`` / ``nonfinite``).
 ``docs/resilience.md`` has the full failure taxonomy.
+
+Overload control (:mod:`serving.overload`, on by default through
+``InferenceServer``): requests carry a priority class and a
+block-cost estimate; when the queue or pool crosses the policy's
+pressure threshold the scheduler sheds the lowest-priority, newest
+waiting work (``finish_reason="shed"``) instead of blindly bouncing
+the next arrival, queue-full arrivals displace lower-priority queued
+work, and the preemption victim is chosen worst-priority-first.
 """
 
 from __future__ import annotations
@@ -71,6 +79,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 
 from apex_tpu.observability import NULL_TRACER
 from apex_tpu.serving.kv_cache import BlockAllocator
+from apex_tpu.serving.overload import OverloadPolicy
 from apex_tpu.serving.prefix_cache import ROOT, PrefixCache
 
 _uid = itertools.count()
@@ -96,6 +105,15 @@ class Request:
     max_new_tokens: int
     eos_id: Optional[int] = None
     uid: int = dataclasses.field(default_factory=lambda: next(_uid))
+
+    # overload-control inputs (``serving.overload``): ``priority`` is
+    # nice-style — 0 is the default/foreground class, larger numbers
+    # are lower priority and sheddable under pressure.  ``cost_blocks``
+    # is the completion-size estimate (prompt + budget, in KV blocks),
+    # stamped by ``Scheduler.submit``; queued demand feeds the
+    # pressure signal.
+    priority: int = 0
+    cost_blocks: int = 0
 
     # per-request budgets (None = unbounded).  ``deadline_iters`` is a
     # count of scheduler iterations from submission; ``deadline_s`` a
@@ -164,6 +182,7 @@ class Request:
         (``docs/observability.md``)."""
         out = {
             "uid": self.uid,
+            "priority": self.priority,
             "submitted_at": self.submitted_at,
             "admitted_at": self.admitted_at,
             "first_token_at": self.first_token_at,
@@ -199,7 +218,14 @@ class Scheduler:
     block-level prefix sharing at admission (None = every prompt
     prefills from scratch, the pre-cache behavior).  ``chunk_size``:
     prefill tail chunk in tokens (None = the whole tail in one
-    :meth:`prefill_plan` call, i.e. chunked prefill off)."""
+    :meth:`prefill_plan` call, i.e. chunked prefill off).
+
+    ``overload``: optional :class:`OverloadPolicy` enabling
+    priority-aware load shedding (queue-full displacement,
+    pressure shedding of best-effort waiting work, worst-priority
+    preemption victims — :mod:`serving.overload`).  None preserves
+    the pre-overload behavior exactly: queue-full raises
+    :class:`QueueFullError`, preemption evicts the youngest."""
 
     def __init__(self, allocator: BlockAllocator, *,
                  max_batch_size: int, block_size: int,
@@ -207,6 +233,7 @@ class Scheduler:
                  counters=None,
                  prefix_cache: Optional[PrefixCache] = None,
                  chunk_size: Optional[int] = None,
+                 overload: Optional[OverloadPolicy] = None,
                  tracer=None):
         self.allocator = allocator
         self.tracer = tracer if tracer is not None else NULL_TRACER
@@ -223,6 +250,7 @@ class Scheduler:
         self.counters = counters
         self.prefix_cache = prefix_cache
         self.chunk_size = chunk_size
+        self.overload = overload
         self.waiting: Deque[Request] = deque()
         self.running: Dict[int, Request] = {}      # slot -> request
         self._free_slots = list(range(max_batch_size - 1, -1, -1))
@@ -245,13 +273,32 @@ class Scheduler:
             raise ValueError(
                 f"prompt length {len(req.prompt)} must be < "
                 f"max_context {self.max_context}")
+        req.cost_blocks = BlockAllocator.blocks_for(
+            len(req.prompt) + req.max_new_tokens, self.block_size)
         if self.max_waiting is not None \
                 and len(self.waiting) >= self.max_waiting:
-            raise QueueFullError(
-                f"waiting queue full ({self.max_waiting} requests); "
-                f"request {req.uid} rejected")
+            # overload control: an arrival that outranks the worst
+            # queued request displaces it (victim finishes "shed")
+            # instead of being bounced by arrival order; an arrival
+            # that outranks nobody is rejected exactly as before
+            victim = (self._shed_candidate()
+                      if self.overload is not None
+                      and self.overload.displace else None)
+            if victim is None or victim.priority <= req.priority:
+                raise QueueFullError(
+                    f"waiting queue full ({self.max_waiting} "
+                    f"requests); request {req.uid} rejected")
+            self.fail(victim, "shed")
         self.waiting.append(req)
         return req
+
+    def _shed_candidate(self) -> Optional[Request]:
+        """The waiting request overload policy would shed first:
+        lowest priority class (highest number), newest among equals.
+        None when the queue is empty."""
+        if not self.waiting:
+            return None
+        return max(self.waiting, key=lambda r: (r.priority, r.uid))
 
     @property
     def num_waiting(self) -> int:
@@ -264,6 +311,44 @@ class Scheduler:
     @property
     def has_work(self) -> bool:
         return bool(self.waiting or self.running)
+
+    # -- overload pressure (``serving.overload``) --------------------------
+
+    def pressure(self) -> float:
+        """The overload signal: max of the queue fill fraction and
+        ``(live blocks + queued demand) / usable blocks``.  Queued
+        demand is the sum of waiting requests' ``cost_blocks``, so a
+        burst of expensive prompts reads as pressure before the pool
+        physically fills; the value may exceed 1.0."""
+        q = (len(self.waiting) / self.max_waiting
+             if self.max_waiting else 0.0)
+        usable = self.allocator.cfg.num_blocks - 1
+        reclaimable = self.allocator.num_free + (
+            self.prefix_cache.num_evictable
+            if self.prefix_cache is not None else 0)
+        live = usable - reclaimable
+        demand = sum(r.cost_blocks for r in self.waiting)
+        return max(q, (live + demand) / usable)
+
+    def shed_overload(self) -> List[Request]:
+        """Shed best-effort waiting work (priority >=
+        ``overload.best_effort_priority``), worst-first, while
+        :meth:`pressure` sits at or above ``overload.shed_threshold``.
+        Foreground (priority-0) work is never pressure-shed.  Called
+        once per step by the serve loop; returns the shed requests
+        (each finished ``"shed"`` via :meth:`fail`)."""
+        if self.overload is None or not self.waiting:
+            return []
+        shed: List[Request] = []
+        while self.pressure() >= self.overload.shed_threshold:
+            candidates = [r for r in self.waiting
+                          if self.overload.sheddable(r.priority)]
+            if not candidates:
+                break
+            victim = max(candidates, key=lambda r: (r.priority, r.uid))
+            self.fail(victim, "shed")
+            shed.append(victim)
+        return shed
 
     # -- allocation with cache pressure -----------------------------------
 
@@ -431,17 +516,28 @@ class Scheduler:
             if fresh is not None:
                 req.block_table.extend(fresh)
                 continue
-            victim = self._youngest_running(exclude=req)
+            victim = self._preempt_victim(exclude=req)
             if victim is None:
                 return False
             self.preempt(victim)
         return True
 
-    def _youngest_running(self, exclude: Request) -> Optional[Request]:
-        for req in reversed(self._admit_order):
-            if req is not exclude:
-                return req
-        return None
+    def _preempt_victim(self, exclude: Request) -> Optional[Request]:
+        """Priority-aware victim choice: the worst priority class
+        (highest number) among running requests, youngest-admitted
+        within the class — so foreground work monotonically keeps its
+        blocks while best-effort work recomputes.  With uniform
+        priorities this is exactly the historical youngest-first
+        (LIFO) choice, so preemption bit-stability is unchanged."""
+        victim = None
+        victim_key = None
+        for i, req in enumerate(self._admit_order):
+            if req is exclude:
+                continue
+            key = (req.priority, i)
+            if victim_key is None or key > victim_key:
+                victim, victim_key = req, key
+        return victim
 
     def preempt(self, req: Request) -> None:
         """Evict ``req`` to the waiting queue's FRONT (it has seniority

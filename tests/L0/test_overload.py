@@ -1,0 +1,393 @@
+"""Overload control, circuit breaker, and graceful lifecycle.
+
+The acceptance oracles for the overload-robustness layer
+(``docs/resilience.md``, "Overload policy & lifecycle"):
+
+- priority shedding order under queue pressure (queue-full arrivals
+  displace the lowest-priority/newest queued work, which finishes
+  ``"shed"``; equal-priority arrivals still get the historical
+  ``"rejected"``) and under pool pressure (``shed_overload`` sheds
+  best-effort waiting work worst-first, never the foreground class);
+- priority-aware preemption (the victim is the worst-priority running
+  request, youngest within the class);
+- circuit breaker closed → open → half-open → closed transitions on
+  an injectable clock, both as a unit and wired through
+  ``InferenceServer.submit`` (``finish_reason="breaker_open"``);
+- ``drain()`` bit-parity — in-flight requests produce identical
+  tokens whether or not a drain begins mid-generation — and
+  ``close()`` exactly-once semantics;
+- submit-time rejections (rejected / shed / breaker_open / draining)
+  carry ``finished_at`` stamped AT submission and never pollute the
+  TTFT/queue-wait histograms;
+- transient engine ``MemoryError`` is skipped-and-retried
+  bit-identically instead of killing the batch;
+- a seeded mini chaos soak (``@pytest.mark.chaos``) composing all of
+  the above (the build-matrix ``chaos`` axis runs the full 2000-iter
+  version via ``tools/chaos_soak.py``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import models
+from apex_tpu.resilience import ChaosConfig, CircuitBreaker
+from apex_tpu.resilience.chaos import run_soak
+from apex_tpu.serving import InferenceServer, OverloadPolicy
+from apex_tpu.serving.kv_cache import BlockAllocator, KVCacheConfig
+from apex_tpu.serving.scheduler import Request, Scheduler
+
+pytestmark = pytest.mark.serving
+
+VOCAB = 61
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = models.GPTConfig(
+        vocab_size=VOCAB, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=128, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+    m = models.GPTLMHeadModel(cfg)
+    params = m.init(jax.random.PRNGKey(1),
+                    jnp.ones((1, 8), jnp.int32))["params"]
+    return cfg, params
+
+
+def _server(cfg, params, **kw):
+    kw.setdefault("cache_dtype", jnp.float32)
+    return InferenceServer(cfg, params, **kw)
+
+
+def _raw_scheduler(overload=None, num_blocks=9, block_size=4,
+                   max_batch_size=2, max_context=32, max_waiting=None):
+    alloc = BlockAllocator(KVCacheConfig(
+        num_layers=1, num_heads=2, head_dim=4, num_blocks=num_blocks,
+        block_size=block_size, dtype=jnp.float32))
+    return Scheduler(alloc, max_batch_size=max_batch_size,
+                     block_size=block_size, max_context=max_context,
+                     max_waiting=max_waiting, overload=overload)
+
+
+# -- priority shedding: queue pressure ------------------------------------
+
+def test_queue_full_arrival_displaces_lowest_priority_newest(tiny):
+    """Priority shedding order at the front door: a queue-full arrival
+    displaces the worst (priority, newest) queued request — which
+    finishes 'shed' with finished_at stamped at submission — while an
+    arrival that outranks nobody still gets 'rejected'."""
+    cfg, params = tiny
+    server = _server(cfg, params, max_batch_size=1, max_context=64,
+                     block_size=8, max_waiting=2,
+                     overload_policy=OverloadPolicy(shed_threshold=5.0))
+    a = server.submit([3, 1, 4, 1], 6, priority=0)
+    b = server.submit([5, 9, 2, 6], 6, priority=2)
+    # queue full at [a, b]; c (priority 1) outranks b -> b is shed
+    c = server.submit([2, 7, 1, 8], 6, priority=1)
+    assert b.finish_reason == "shed"
+    assert b.finished_at is not None      # stamped at submit time
+    assert not c.finished
+    # d (priority 1) outranks nobody left (a=0, c=1 older) -> rejected
+    d = server.submit([9, 8, 7, 6], 6, priority=1)
+    assert d.finish_reason == "rejected"
+    assert d.finished_at is not None
+    while server.scheduler.has_work:
+        server.step()
+    assert a.finish_reason == "length" and len(a.generated) == 6
+    assert c.finish_reason == "length" and len(c.generated) == 6
+    failed = server.stats()["requests_failed"]
+    assert failed["requests_failed_shed"] == 1
+    assert failed["requests_failed_rejected"] == 1
+    server.scheduler.audit()
+
+
+def test_shed_order_is_worst_priority_then_newest():
+    """Among equal worst-priority queued work the NEWEST is displaced
+    (oldest keeps its seniority)."""
+    sched = _raw_scheduler(overload=OverloadPolicy(shed_threshold=50.0),
+                           max_waiting=2)
+    old = sched.submit(Request(prompt=[1], max_new_tokens=2, priority=2))
+    new = sched.submit(Request(prompt=[2], max_new_tokens=2, priority=2))
+    arrival = sched.submit(
+        Request(prompt=[3], max_new_tokens=2, priority=0))
+    assert new.finish_reason == "shed"
+    assert not old.finished and not arrival.finished
+    assert list(sched.waiting) == [old, arrival]
+
+
+# -- priority shedding: pool pressure -------------------------------------
+
+def test_pool_pressure_sheds_best_effort_worst_first():
+    """shed_overload() sheds best-effort waiting work worst-priority-
+    first, newest within a class, until pressure drops below the
+    threshold — and never touches the foreground (priority-0) class.
+
+    Geometry: 8 usable blocks, block_size 4.  Demand: r0 (prio 0)
+    costs 2 blocks, r1 (prio 1) and r2 (prio 2) cost 4 each ->
+    pressure (0 live + 10 demand) / 8 = 1.25 >= 0.9."""
+    sched = _raw_scheduler(overload=OverloadPolicy())
+    r0 = sched.submit(Request(prompt=[1] * 4, max_new_tokens=4,
+                              priority=0))
+    r1 = sched.submit(Request(prompt=[1] * 8, max_new_tokens=8,
+                              priority=1))
+    r2 = sched.submit(Request(prompt=[2] * 8, max_new_tokens=8,
+                              priority=2))
+    assert (r0.cost_blocks, r1.cost_blocks, r2.cost_blocks) == (2, 4, 4)
+    assert sched.pressure() == pytest.approx(10 / 8)
+    shed = sched.shed_overload()
+    # r2 (worst class) goes first; demand drops to 6/8 = 0.75 < 0.9
+    assert shed == [r2] and r2.finish_reason == "shed"
+    assert not r0.finished and not r1.finished
+    # another best-effort arrival pushes demand back up: the NEWEST
+    # priority-1 request is shed, not the older r1
+    r3 = sched.submit(Request(prompt=[3] * 8, max_new_tokens=8,
+                              priority=1))
+    assert sched.shed_overload() == [r3]
+    assert not r1.finished
+    # a big foreground arrival pushes pressure back up: the remaining
+    # best-effort request (r1) is shed, but the foreground class is
+    # never pressure-shed however high demand stays
+    r4 = sched.submit(Request(prompt=[4] * 20, max_new_tokens=8,
+                              priority=0))
+    assert sched.shed_overload() == [r1]
+    assert sched.pressure() >= 0.9            # still over threshold...
+    assert sched.shed_overload() == []        # ...but nothing sheddable
+    assert not r0.finished and not r4.finished
+
+
+def test_preemption_victim_is_worst_priority_then_youngest():
+    """Pool-dry preemption takes the worst-priority running request
+    even when it is the OLDEST — foreground work keeps its blocks."""
+    sched = _raw_scheduler(overload=OverloadPolicy(), num_blocks=7,
+                           max_batch_size=3)
+    ra = sched.submit(Request(prompt=[1] * 4, max_new_tokens=4,
+                              priority=1))
+    rb = sched.submit(Request(prompt=[2] * 4, max_new_tokens=4,
+                              priority=0))
+    rc = sched.submit(Request(prompt=[3] * 4, max_new_tokens=4,
+                              priority=0))
+    assert sched.admit() == [ra, rb, rc]     # 2 blocks each, pool dry
+    rb.num_cached = 8                        # rb needs a third block
+    assert sched.ensure_decode_capacity(rb)
+    # the pre-overload choice was youngest-first (rc); priority-aware
+    # preemption evicts ra — the only best-effort request — instead
+    assert ra.slot == -1 and ra in sched.waiting
+    assert rc.running
+    sched.audit()
+
+
+# -- circuit breaker ------------------------------------------------------
+
+def test_breaker_transitions_on_injected_clock():
+    """closed -> open on a failure streak, open -> half-open after the
+    cooldown (injectable clock; no sleeping), half-open -> closed on
+    enough probe successes, half-open -> open again on a probe
+    failure with the cooldown restarted."""
+    clock = {"t": 0.0}
+    br = CircuitBreaker(failure_threshold=3, recovery_time=10.0,
+                        probe_successes=2, clock=lambda: clock["t"])
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    br.record_failure()
+    br.record_success()               # success resets the streak
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed"
+    br.record_failure()               # third consecutive: trip
+    assert br.state == "open" and not br.allow()
+    clock["t"] = 9.99
+    assert br.state == "open"
+    clock["t"] = 10.0                 # cooldown elapsed: probe
+    assert br.state == "half_open"
+    assert br.allow() and br.allow()  # probe quota = probe_successes
+    assert not br.allow()             # quota spent while probes fly
+    br.record_success()
+    assert br.state == "half_open"    # one success is not enough
+    br.record_success()
+    assert br.state == "closed" and br.allow()
+    # a half-open probe failure re-opens and restarts the cooldown
+    br.record_failure(); br.record_failure(); br.record_failure()
+    clock["t"] = 20.0
+    assert br.state == "half_open" and br.allow()
+    br.record_failure()
+    assert br.state == "open"
+    clock["t"] = 25.0
+    assert br.state == "open"         # cooldown restarted at t=20
+    clock["t"] = 30.0
+    assert br.state == "half_open"
+
+
+def test_breaker_guards_submit_and_recovers(tiny):
+    """A non-finite streak opens the breaker: submissions fast-reject
+    with 'breaker_open' (finished_at stamped at submit, nothing
+    enqueued); after the cooldown a healthy probe closes it and
+    serving resumes."""
+    cfg, params = tiny
+    clock = {"t": 0.0}
+    breaker = CircuitBreaker(failure_threshold=3, recovery_time=10.0,
+                             clock=lambda: clock["t"])
+    server = _server(cfg, params, max_batch_size=4, max_context=64,
+                     block_size=8, breaker=breaker)
+    poison = {"on": True}
+    orig = server.engine.decode
+
+    def decode(tokens, positions, tables):
+        out = np.array(orig(tokens, positions, tables))
+        if poison["on"]:
+            out[:] = np.nan
+        return out
+
+    server.engine.decode = decode
+    doomed = [server.submit(p, 6) for p in
+              ([3, 1, 4, 1], [5, 9, 2, 6], [2, 7, 1, 8])]
+    while server.scheduler.has_work:
+        server.step()
+    assert all(r.finish_reason == "nonfinite" for r in doomed)
+    assert breaker.state == "open"
+    fast = server.submit([1, 2, 3], 6)
+    assert fast.finish_reason == "breaker_open"
+    assert fast.generated == [] and fast.finished_at is not None
+    assert server.scheduler.num_waiting == 0
+    # cooldown + healthy engine: the probe request closes the breaker
+    poison["on"] = False
+    clock["t"] = 10.0
+    probe = server.submit([1, 2, 3], 6)
+    assert not probe.finished
+    while server.scheduler.has_work:
+        server.step()
+    assert probe.finish_reason == "length" and len(probe.generated) == 6
+    assert breaker.state == "closed"
+    st = server.stats()
+    assert st["requests_failed"]["requests_failed_breaker_open"] == 1
+    assert st["breaker_events"]["breaker_rejections"] == 1
+    assert st["breaker_events"]["breaker_opened"] == 1
+    assert st["breaker_state"] == "closed"
+
+
+# -- graceful lifecycle ---------------------------------------------------
+
+def test_drain_is_bit_exact_and_close_is_exactly_once(tiny):
+    """drain() mid-generation changes NOTHING about in-flight tokens
+    (bit-parity with an undisturbed run), rejects later submissions
+    with 'draining', and close() drains exactly once."""
+    cfg, params = tiny
+    prompts = [[3, 1, 4, 1, 5, 9, 2, 6], [2, 7, 1, 8, 2, 8, 1, 8]]
+
+    baseline = _server(cfg, params, max_batch_size=2, max_context=64,
+                       block_size=8).generate(prompts,
+                                              max_new_tokens=12)
+
+    server = _server(cfg, params, max_batch_size=2, max_context=64,
+                     block_size=8)
+    reqs = [server.submit(p, 12) for p in prompts]
+    for _ in range(4):                # mid-generation...
+        server.step()
+    assert any(r.generated for r in reqs) and not any(r.finished
+                                                      for r in reqs)
+    stats = server.drain()            # ...the drain begins
+    assert [list(r.generated) for r in reqs] == baseline
+    assert all(r.finish_reason == "length" for r in reqs)
+    assert stats["requests_finished"] == 2 and stats["draining"]
+    late = server.submit([1, 2, 3], 4)
+    assert late.finish_reason == "draining"
+    assert late.finished_at is not None
+    final = server.close()
+    assert server.close() is final    # exactly-once: same snapshot
+    with pytest.raises(RuntimeError, match="closed"):
+        server.submit([1, 2, 3], 4)
+    server.scheduler.audit()
+
+
+# -- submit-time rejection accounting (satellite) -------------------------
+
+def test_submit_time_rejections_stamped_and_excluded_from_latency(tiny):
+    """Requests finished at submit() (rejected here) get finished_at
+    stamped by the submit call itself — not lazily at the next step —
+    and never enter the TTFT/queue-wait histograms."""
+    cfg, params = tiny
+    clock = {"t": 0.0}
+    server = _server(cfg, params, max_batch_size=1, max_context=64,
+                     block_size=8, max_waiting=1,
+                     clock=lambda: clock["t"])
+    ok = server.submit([3, 1, 4, 1], 4)
+    clock["t"] = 5.0
+    rejected = server.submit([5, 9, 2, 6], 4)   # equal priority: reject
+    assert rejected.finish_reason == "rejected"
+    assert rejected.finished_at == 5.0          # stamped at submit
+    tl = rejected.timeline()
+    assert "queue_wait_s" not in tl and "ttft_s" not in tl
+    while server.scheduler.has_work:
+        server.step()
+    lat = server.stats()["latency"]
+    assert lat["queue_wait_ms"]["count"] == 1   # only the served one
+    assert lat["ttft_ms"]["count"] == 1
+    assert ok.finish_reason == "length"
+
+
+# -- transient engine OOM isolation ---------------------------------------
+
+def test_transient_engine_oom_is_retried_bit_exactly(tiny):
+    """A MemoryError out of the engine skips that call for one
+    iteration and retries — completions stay token-for-token equal to
+    an undisturbed run, and the event is counted."""
+    cfg, params = tiny
+    prompts = [[3, 1, 4, 1, 5, 9, 2, 6], [2, 7, 1, 8]]
+    baseline = _server(cfg, params, max_batch_size=2, max_context=64,
+                       block_size=8).generate(prompts,
+                                              max_new_tokens=10)
+
+    server = _server(cfg, params, max_batch_size=2, max_context=64,
+                     block_size=8)
+    orig = server.engine.decode
+    calls = {"n": 0}
+
+    def flaky(tokens, positions, tables):
+        calls["n"] += 1
+        if calls["n"] in (2, 3):      # a two-iteration OOM burst
+            raise MemoryError("injected HBM burst")
+        return orig(tokens, positions, tables)
+
+    server.engine.decode = flaky
+    outs = server.generate(prompts, max_new_tokens=10)
+    assert outs == baseline
+    st = server.stats()
+    assert st["oom_events"] == 2
+    assert st["requests_failed_total"] == 0
+    server.scheduler.audit()
+
+
+# -- seeded mini chaos soak -----------------------------------------------
+
+@pytest.mark.chaos
+def test_mini_chaos_soak_invariants_hold(tiny):
+    """A 200-iteration seeded chaos soak (the in-suite twin of the
+    build-matrix ``chaos`` axis): run_soak asserts the per-step audit,
+    terminal-uniqueness, bit-exact-replay, and counter-reconciliation
+    invariants internally; here we additionally pin that the fault
+    paths actually fired."""
+    cfg, params = tiny
+
+    def make_server(clock):
+        return InferenceServer(
+            cfg, params, max_batch_size=4, max_context=64,
+            block_size=4, num_blocks=40, cache_dtype=jnp.float32,
+            max_waiting=8, clock=clock,
+            breaker=CircuitBreaker(failure_threshold=3,
+                                   recovery_time=25.0,
+                                   probe_successes=2, clock=clock))
+
+    def make_replay(clock):
+        return InferenceServer(cfg, params, max_batch_size=4,
+                               max_context=64, block_size=4,
+                               cache_dtype=jnp.float32, clock=clock)
+
+    report = run_soak(make_server, ChaosConfig(iters=200, vocab=VOCAB),
+                      seed=0, make_replay=make_replay)
+    assert report["submitted"] > 50
+    assert report["finished"].get("length", 0) > 0
+    assert report["sheds"] > 0                  # overload fired
+    assert report["injected"]["oom"] > 0        # fault paths fired
+    assert report["injected"]["nonfinite_rows"] > 0
+    assert report["bit_exact_checked"] > 0

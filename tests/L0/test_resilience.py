@@ -294,6 +294,21 @@ def test_retry_exhaustion_chains_last_error():
     assert isinstance(exc.value.__cause__, TransientIOError)
 
 
+def test_retry_failure_message_names_attempts_and_last_error():
+    """Pins the exhaustion message: the attempt count and the last
+    underlying error are both in the text (an operator reading one
+    log line learns what failed and how hard retry tried), and the
+    exception chains (`raise ... from`) the last error."""
+    def always():
+        raise TransientIOError("disk on fire")
+    with pytest.raises(
+            RetryError,
+            match=r"all 3 attempts failed; last error: "
+                  r"TransientIOError: disk on fire") as exc:
+        retry(always, attempts=3, sleep=_no_sleep)
+    assert exc.value.__cause__.args == ("disk on fire",)
+
+
 def test_retry_deadline_cuts_budget_short():
     clock = {"t": 0.0}
 

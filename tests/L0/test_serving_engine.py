@@ -199,9 +199,20 @@ def test_stats_keys_are_backward_compatible(tiny):
     assert not missing, f"stats() lost pre-telemetry keys: {missing}"
     # and the new telemetry keys ride alongside
     assert "tokens_per_s_recent" in st
+    # overload/lifecycle keys (docs/resilience.md, "Overload policy &
+    # lifecycle") extend stats() without touching anything above
+    overload = {"pressure", "pressure_peak", "breaker_state",
+                "breaker_events", "oom_events", "draining"}
+    assert not overload - st.keys(), \
+        f"stats() lost overload keys: {overload - st.keys()}"
+    assert st["breaker_state"] == "closed"     # healthy run
+    assert st["oom_events"] == 0
     lat = st["latency"]
     assert set(lat) == {"ttft_ms", "queue_wait_ms", "decode_token_ms",
-                        "step_ms"}
+                        "step_ms", "queue_wait_by_priority_ms"}
+    # both requests ran at the default priority class
+    assert set(lat["queue_wait_by_priority_ms"]) == {0}
+    assert lat["queue_wait_by_priority_ms"][0]["count"] == 2
     # both requests finished: their timelines fed the histograms
     assert lat["ttft_ms"]["count"] == 2
     assert lat["queue_wait_ms"]["count"] == 2

@@ -149,6 +149,61 @@ def test_waiting_request_can_time_out(tiny):
     assert queued.generated == []
 
 
+def test_queued_wall_deadline_expires_as_timeout_not_rejected(tiny):
+    """Edge case: a QUEUED (never-admitted) request whose deadline_s
+    expires finishes 'timeout' — not 'rejected' — and releases no
+    blocks, because it never held any."""
+    cfg, params = tiny
+    clock = {"t": 0.0}
+    server = _server(cfg, params, max_batch_size=1, max_context=64,
+                     block_size=8, clock=lambda: clock["t"])
+    hog = server.submit([3, 1, 4, 1], 12)
+    queued = server.submit([5, 9, 2, 6], 12, deadline_s=3.0)
+    server.step()                       # hog admitted; queued waits
+    assert not queued.finished
+    clock["t"] = 10.0                   # wall budget expires in queue
+    server.step()
+    assert queued.finish_reason == "timeout"
+    assert queued.finish_reason != "rejected"
+    assert queued.generated == [] and queued.block_table == []
+    assert queued.admitted_at is None   # truly never admitted
+    assert "queue_wait_s" not in queued.timeline()
+    while server.scheduler.has_work:
+        server.step()
+    assert hog.finish_reason == "length"
+    usable = server.engine.cache_cfg.num_blocks - 1
+    assert server.engine.allocator.num_free \
+        + server.scheduler.prefix_cache.num_evictable == usable
+    server.scheduler.audit()
+    assert server.failures.count("requests_failed_timeout") == 1
+    assert server.failures.count("requests_failed_rejected") == 0
+
+
+def test_iter_deadline_on_request_preempted_at_expiry(tiny):
+    """Edge case: a request PREEMPTED right as its deadline_iters
+    expires times out from the waiting queue — keeping its partial
+    output, holding zero blocks, and never re-admitting."""
+    cfg, params = tiny
+    server = _server(cfg, params, max_batch_size=2, max_context=64,
+                     block_size=8)
+    req = server.submit([3, 1, 4, 1], 10, deadline_iters=4)
+    for _ in range(4):
+        server.step()
+    assert req.running and len(req.generated) > 0
+    server.scheduler.preempt(req)       # evicted exactly at expiry
+    assert req.block_table == []
+    partial = list(req.generated)
+    server.step()                       # expiry fires before re-admit
+    assert req.finish_reason == "timeout"
+    assert req.generated == partial     # partial output survives
+    assert req.block_table == []
+    assert not server.scheduler.has_work
+    usable = server.engine.cache_cfg.num_blocks - 1
+    assert server.engine.allocator.num_free \
+        + server.scheduler.prefix_cache.num_evictable == usable
+    server.scheduler.audit()
+
+
 # -- bounded queue --------------------------------------------------------
 
 def test_scheduler_bounded_queue_raises():

@@ -88,6 +88,19 @@ echo "=== build-matrix axis: serving-prefix-smoke ==="
 env JAX_PLATFORMS=cpu python tools/serving_bench.py --smoke --shared-prefix --out -
 results[serving_prefix]=$?
 
+# chaos soak: the overload-robustness axis (docs/resilience.md,
+# "Overload policy & lifecycle") — the full serving stack (prefix
+# cache + chunked prefill + overload control + circuit breaker, small
+# pool) runs 2000 iterations of seeded composed faults (bursty
+# mixed-priority arrivals, random deadlines, non-finite logit rows,
+# engine MemoryError bursts, FaultPlan crashes); per-step
+# allocator/prefix-cache audits, exactly-one-terminal-reason,
+# bit-exact-healthy-replay, and counter-reconciliation invariants
+# exit non-zero on any violation (tools/chaos_soak.py)
+echo "=== build-matrix axis: chaos-soak ==="
+env JAX_PLATFORMS=cpu python tools/chaos_soak.py --seed 0 --iters 2000
+results[chaos]=$?
+
 # trace smoke: the observability axis (docs/observability.md) — the
 # serving smoke re-runs with APEX_TPU_TRACE set; the exported Chrome
 # trace must parse, its B/E spans must pair up, and it must contain

@@ -79,6 +79,7 @@ SMOKE = {
     ("test_resilience.py", "test_crash_resume_bit_parity[5]"),
     ("test_observability.py", "test_histogram_quantiles_match_sample_oracle"),
     ("test_serving_faults.py", "test_never_fits_prompt_fails_alone"),
+    ("test_overload.py", "test_breaker_transitions_on_injected_clock"),
 }
 
 
@@ -92,6 +93,10 @@ def pytest_configure(config):
         "(KV cache, decode engine, continuous-batching scheduler); "
         "unmarked slow-wise, so they stay in the tier-1 'not slow' "
         "selection")
+    config.addinivalue_line(
+        "markers", "chaos: seeded randomized fault-composition soaks "
+        "(apex_tpu.resilience.chaos); the build-matrix chaos axis "
+        "runs the full-length version via tools/chaos_soak.py")
 
 
 def pytest_collection_modifyitems(config, items):
