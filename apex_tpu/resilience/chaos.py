@@ -88,6 +88,15 @@ class ChaosConfig:
     shared_prefix_rate: float = 0.3
     shared_prefix_len: int = 8
 
+    # speculation traffic class (docs/serving.md): some prompts are a
+    # short pattern repeated to length, so n-gram/prompt-lookup drafts
+    # actually fire and accept — exercising verify, greedy acceptance,
+    # and lookahead KV rollback under every composed fault.  The
+    # default 0.0 keeps legacy (config, seed) schedules byte-identical
+    # (no extra RNG draws).
+    repetitive_rate: float = 0.0
+    repetitive_period: Tuple[int, int] = (1, 4)
+
     # request shape: priority classes (0 = foreground .. lowest) and
     # random deadlines (iteration budget; wall budget on the soak's
     # deterministic iteration clock)
@@ -139,7 +148,15 @@ class ChaosSchedule:
 
         def one_arrival(i: int) -> Arrival:
             n = rng.randint(*cfg.prompt_len)
-            prompt = [rng.randrange(cfg.vocab) for _ in range(n)]
+            if cfg.repetitive_rate \
+                    and rng.random() < cfg.repetitive_rate:
+                # speculation-friendly: a short pattern repeated to
+                # length, the shape prompt-lookup drafts predict well
+                period = rng.randint(*cfg.repetitive_period)
+                pat = [rng.randrange(cfg.vocab) for _ in range(period)]
+                prompt = (pat * (n // period + 1))[:n]
+            else:
+                prompt = [rng.randrange(cfg.vocab) for _ in range(n)]
             if rng.random() < cfg.shared_prefix_rate:
                 prompt = shared + prompt
             d_it = (rng.randint(*cfg.deadline_iters)
@@ -245,6 +262,23 @@ class ChaosEngine:
 
         self._oom_gate()
         out = np.asarray(self.inner.decode(tokens, positions, tables))
+        if self.iter in self.schedule.nonfinite_iters:
+            row = self.rng.randrange(out.shape[0])
+            out = out.copy()
+            out[row] = np.nan
+            self.injected["nonfinite_rows"] += 1
+        return out
+
+    def verify(self, tokens, lengths, positions, tables):
+        # the speculative analog of decode(): same OOM gate, and the
+        # non-finite poison hits one slot's whole (K, V) logits block —
+        # the serve loop must evict exactly that request before any of
+        # its drafted tokens can be accepted
+        import numpy as np
+
+        self._oom_gate()
+        out = np.asarray(self.inner.verify(tokens, lengths,
+                                           positions, tables))
         if self.iter in self.schedule.nonfinite_iters:
             row = self.rng.randrange(out.shape[0])
             out = out.copy()
@@ -415,5 +449,10 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
         pressure_peak=stats["pressure_peak"],
         breaker_state=stats["breaker_state"],
         oom_events=stats["oom_events"],
+        speculation=stats["speculation"]["enabled"],
+        acceptance_rate=stats["speculation"]["acceptance_rate"],
+        drafted_tokens=stats["speculation"]["drafted_tokens"],
+        tokens_per_engine_step=stats["speculation"][
+            "tokens_per_engine_step"],
     )
     return report

@@ -29,6 +29,17 @@ serve".  Three layers, bottom-up:
   pathological request finishes alone (``finish_reason`` ``capacity``
   / ``timeout`` / ``rejected`` / ``nonfinite``) instead of raising
   into the batch (``docs/resilience.md``);
+- :mod:`serving.speculation` — speculative decoding with BIT-EXACT
+  greedy acceptance (on by default, ``enable_speculation=False`` opts
+  out): zero-weight n-gram/prompt-lookup drafts from each request's
+  own history (a small-model drafter plugs in via
+  :class:`~serving.speculation.DraftSource`) are scored K-at-a-time by
+  the engine's fixed-width verify program
+  (``ops.chunk_cached_attention`` over the live block-table cache);
+  the accepted tokens are exactly the drafts matching the model's own
+  argmax plus the model's next token, so output is bit-identical to
+  one-token decode while repetitive traffic decodes several tokens
+  per engine step;
 - :mod:`serving.overload` + the lifecycle layer — priority-aware load
   shedding (``finish_reason="shed"``) under queue/pool pressure, a
   circuit breaker in front of ``submit``
@@ -59,12 +70,15 @@ from apex_tpu.serving.kv_cache import (
 from apex_tpu.serving.overload import OverloadPolicy
 from apex_tpu.serving.prefix_cache import PrefixCache
 from apex_tpu.serving.scheduler import QueueFullError, Request, Scheduler
+from apex_tpu.serving.speculation import DraftSource, NgramDraft
 
 __all__ = [
     "BlockAllocator",
     "DecodeEngine",
+    "DraftSource",
     "InferenceServer",
     "KVCacheConfig",
+    "NgramDraft",
     "OverloadPolicy",
     "PrefixCache",
     "QueueFullError",

@@ -22,14 +22,20 @@ needs.  A live service would run :meth:`step` on its event loop and
 stream ``Request.generated`` as it grows; both drive the identical
 scheduler/engine machinery, so the offline numbers transfer.
 
-Serving-perf layers (both ON by default; ``enable_prefix_cache=False``
-/ ``enable_chunked_prefill=False`` opt out): block-level prefix
-caching shares cached full blocks at admission so only the uncached
-tail prefills, and chunked prefill advances ONE chunk per prefilling
-request per iteration so a long prompt stalls the decode batch by at
-most one chunk.  Hit/miss/eviction/COW counters and the per-iteration
-chunk gauge surface in :meth:`InferenceServer.stats`
-(``docs/serving.md``).
+Serving-perf layers (all ON by default; ``enable_prefix_cache=False``
+/ ``enable_chunked_prefill=False`` / ``enable_speculation=False`` opt
+out): block-level prefix caching shares cached full blocks at
+admission so only the uncached tail prefills, chunked prefill
+advances ONE chunk per prefilling request per iteration so a long
+prompt stalls the decode batch by at most one chunk, and speculative
+decoding drafts up to ``spec_tokens`` guesses per request per
+iteration (zero-weight prompt-lookup by default), scores them in one
+fixed-width verify launch, and accepts exactly the prefix matching
+the model's own argmax — several tokens per engine step on
+repetitive traffic, output bit-identical to one-token decode by
+construction.  Hit/miss/eviction/COW counters, the per-iteration
+chunk gauge, and the speculation acceptance counters/histograms
+surface in :meth:`InferenceServer.stats` (``docs/serving.md``).
 
 Failure isolation (``docs/resilience.md``): the step loop never lets
 one pathological request take the batch down.  Per iteration it (1)
@@ -75,6 +81,7 @@ from apex_tpu.serving.engine import DecodeEngine
 from apex_tpu.serving.overload import OverloadPolicy
 from apex_tpu.serving.prefix_cache import PrefixCache
 from apex_tpu.serving.scheduler import QueueFullError, Request, Scheduler
+from apex_tpu.serving.speculation import DraftSource, NgramDraft
 from apex_tpu.utils import CounterMeter, GaugeMeter, RateMeter
 
 # the stats() window for "tokens/s right now" (RateMeter.rate_over) —
@@ -86,6 +93,13 @@ RECENT_RATE_WINDOW_S = 10.0
 # one: small enough that a chunk costs roughly a decode step at typical
 # model sizes, large enough to amortize the per-chunk context gather
 DEFAULT_PREFILL_CHUNK = 256
+
+# default speculation depth (max drafted tokens per verify step).  The
+# verify program is spec_tokens + 1 columns wide; deeper speculation
+# multiplies the best-case tokens/step but also the wasted columns when
+# drafts miss, and acceptance decays geometrically with depth — 4 is
+# the classic knee (docs/serving.md, "K tuning")
+DEFAULT_SPEC_TOKENS = 4
 
 
 def _hist_ms(hist) -> dict:
@@ -100,10 +114,38 @@ def _hist_ms(hist) -> dict:
             "max": round(hist.max * 1e3, 3)}
 
 
+def _hist_counts(hist) -> dict:
+    """Unscaled view of a count-valued histogram (speculation
+    drafted/accepted depths): count + p50/p90 + mean + max."""
+    if hist.count == 0:
+        return {"count": 0}
+    return {"count": hist.count,
+            "p50": round(hist.p50, 2),
+            "p90": round(hist.p90, 2),
+            "mean": round(hist.sum / hist.count, 3),
+            "max": round(hist.max, 2)}
+
+
 def greedy_sample(logits: np.ndarray) -> np.ndarray:
     """(…, V) logits -> (…,) argmax token ids — deterministic, which
     is what makes cached decode testable token-for-token against the
-    full-recompute forward."""
+    full-recompute forward.
+
+    Ties break toward the LOWEST token id (``np.argmax`` returns the
+    first maximum).  That tie rule is part of the bit-exactness
+    contract speculative decoding relies on: greedy acceptance
+    compares drafted tokens against the verify rows' argmax, so every
+    argmax over equal logits must resolve the same way it would in a
+    plain one-token decode step — including exact ties.
+
+    Non-floating logits are rejected: an integer array here is almost
+    always token ids passed where logits belong, and argmaxing ids
+    "works" while silently decoding garbage."""
+    logits = np.asarray(logits)
+    if not np.issubdtype(logits.dtype, np.floating):
+        raise TypeError(
+            f"greedy_sample expects floating-point logits, got dtype "
+            f"{logits.dtype} (token ids passed where logits belong?)")
     return np.argmax(logits, axis=-1)
 
 
@@ -129,6 +171,27 @@ class InferenceServer:
       prefill_chunk: chunk width in tokens (default
         ``min(256, max_context)``); ignored when chunked prefill is
         off.
+      enable_speculation: speculative decoding with bit-exact greedy
+        acceptance (``docs/serving.md``): each decode iteration,
+        requests with a draft feed the pending token plus up to
+        ``spec_tokens`` guesses through the fixed-width verify program
+        and keep the longest prefix matching the model's own argmax,
+        plus the model's next token — up to ``spec_tokens + 1`` tokens
+        per engine step, bit-identical output by construction.  Greedy
+        only: a custom ``sample_fn`` disables speculation (the
+        acceptance rule compares against argmax; under real sampling
+        it would silently change the output distribution).  Opt out
+        for strictly non-repetitive traffic where drafting is pure
+        overhead.
+      spec_tokens: max drafted tokens per verify step (default 4); the
+        verify program is ``spec_tokens + 1`` columns wide and
+        compiles once.
+      draft_source: the :class:`serving.speculation.DraftSource`
+        proposing drafts (default: zero-weight
+        :class:`~serving.speculation.NgramDraft` prompt-lookup over
+        each request's own history; pass a small-model drafter to run
+        classic two-model speculation — acceptance, and therefore
+        output, is identical either way).
       overload_policy: the :class:`serving.overload.OverloadPolicy`
         driving priority-aware load shedding (queue-full
         displacement, pressure shedding of best-effort waiting work,
@@ -171,6 +234,9 @@ class InferenceServer:
                  enable_prefix_cache: bool = True,
                  enable_chunked_prefill: bool = True,
                  prefill_chunk: Optional[int] = None,
+                 enable_speculation: bool = True,
+                 spec_tokens: Optional[int] = None,
+                 draft_source: Optional[DraftSource] = None,
                  enable_overload: bool = True,
                  overload_policy: Optional[OverloadPolicy] = None,
                  enable_breaker: bool = True,
@@ -216,6 +282,28 @@ class InferenceServer:
             tracer=self.tracer)
         self.sample_fn = sample_fn or greedy_sample
         self.clock = clock
+        # speculation (docs/serving.md): greedy-only by contract — the
+        # acceptance rule compares drafts against argmax rows, which
+        # only reproduces plain decode when sampling IS argmax
+        self.spec_tokens = int(spec_tokens if spec_tokens is not None
+                               else DEFAULT_SPEC_TOKENS)
+        if self.spec_tokens < 1:
+            raise ValueError(
+                f"spec_tokens must be >= 1, got {self.spec_tokens}")
+        self.draft_source = (draft_source if draft_source is not None
+                             else NgramDraft())
+        self.speculating = bool(enable_speculation
+                                and self.sample_fn is greedy_sample)
+        self.spec = CounterMeter(registry=self.registry,
+                                 name="serving_speculation",
+                                 label="event")
+        # per-verify-step draft/accept depth distributions — token
+        # counts, not seconds, so they get a count-shaped ladder
+        # (1..64 at 2x: buckets 0/1, 2, 4, 8, ... — exact at small K)
+        self.spec_drafted_hist = self.registry.histogram(
+            "serving_spec_drafted_tokens", low=1.0, high=64.0)
+        self.spec_accepted_hist = self.registry.histogram(
+            "serving_spec_accepted_tokens", low=1.0, high=64.0)
         self.breaker_events = CounterMeter(registry=self.registry,
                                            name="serving_breaker",
                                            label="event")
@@ -467,50 +555,12 @@ class InferenceServer:
             running = [r for r in sched.running.values()
                        if not r.prefilling]
             if running:
-                b, mb = engine.max_batch_size, engine.blocks_per_seq
-                tokens = np.zeros((b,), np.int32)
-                positions = np.zeros((b,), np.int32)
-                tables = np.zeros((b, mb), np.int32)
-                for req in running:
-                    tokens[req.slot] = req.next_input
-                    positions[req.slot] = req.num_cached
-                    tables[req.slot, :len(req.block_table)] = \
-                        req.block_table
-                try:
-                    with tr.span("decode", batch=len(running)):
-                        logits = np.asarray(
-                            engine.decode(tokens, positions, tables))
-                except MemoryError:
-                    # transient HBM burst: no request state moved, the
-                    # identical decode re-runs next iteration
-                    self._note_oom("decode")
+                drafts = (self._propose_drafts(running)
+                          if self.speculating else {})
+                if drafts:
+                    produced += self._verify_step(running, drafts)
                 else:
-                    # step guard: a row of non-finite logits means
-                    # this request's state is poisoned — evict it
-                    # before its garbage token enters
-                    # sampling/termination logic; every finite row
-                    # proceeds normally
-                    finite_rows = np.all(np.isfinite(logits), axis=-1)
-                    toks = self.sample_fn(logits)
-                    for req in running:
-                        if not finite_rows[req.slot]:
-                            sched.fail(req, "nonfinite")
-                            if self.breaker is not None:
-                                self.breaker.record_failure()
-                            continue
-                        req.num_cached += 1
-                        req.record_token(int(toks[req.slot]))
-                        self._note_first_token(req)
-                        produced += 1
-                        if req.finished:
-                            sched.retire(req)
-                            if self.breaker is not None:
-                                self.breaker.record_success()
-                        else:
-                            # index any block this token just filled
-                            # so a later shared-prefix request can
-                            # match it
-                            sched.register_progress(req)
+                    produced += self._decode_step(running)
 
         self.tokens.update(produced)
         self.queue_depth.update(sched.num_waiting)
@@ -518,6 +568,185 @@ class InferenceServer:
                               / self.engine.max_batch_size)
         self.step_time.record(self.clock() - step_start)
         self._finalize_finished()
+        return produced
+
+    def _decode_step(self, running) -> int:
+        """One batched single-token decode over ``running`` (the
+        speculation-off path, and the speculation-on path on
+        iterations where no request has a draft).  Returns tokens
+        produced."""
+        sched, engine, tr = self.scheduler, self.engine, self.tracer
+        produced = 0
+        b, mb = engine.max_batch_size, engine.blocks_per_seq
+        tokens = np.zeros((b,), np.int32)
+        positions = np.zeros((b,), np.int32)
+        tables = np.zeros((b, mb), np.int32)
+        for req in running:
+            tokens[req.slot] = req.next_input
+            positions[req.slot] = req.num_cached
+            tables[req.slot, :len(req.block_table)] = req.block_table
+        try:
+            with tr.span("decode", batch=len(running)):
+                logits = np.asarray(
+                    engine.decode(tokens, positions, tables))
+        except MemoryError:
+            # transient HBM burst: no request state moved, the
+            # identical decode re-runs next iteration
+            self._note_oom("decode")
+            return 0
+        self.spec.incr("decode_steps")
+        # step guard: a row of non-finite logits means this request's
+        # state is poisoned — evict it before its garbage token enters
+        # sampling/termination logic; every finite row proceeds
+        # normally
+        finite_rows = np.all(np.isfinite(logits), axis=-1)
+        toks = self.sample_fn(logits)
+        for req in running:
+            if not finite_rows[req.slot]:
+                sched.fail(req, "nonfinite")
+                if self.breaker is not None:
+                    self.breaker.record_failure()
+                continue
+            req.num_cached += 1
+            req.record_token(int(toks[req.slot]))
+            self._note_first_token(req)
+            produced += 1
+            if req.finished:
+                sched.retire(req)
+                if self.breaker is not None:
+                    self.breaker.record_success()
+            else:
+                # index any block this token just filled so a later
+                # shared-prefix request can match it
+                sched.register_progress(req)
+        self.spec.incr("decode_tokens", produced)
+        return produced
+
+    # -- speculative decoding (docs/serving.md) ---------------------------
+
+    def _propose_drafts(self, running) -> Dict[int, List[int]]:
+        """uid -> drafted tokens for this iteration: the draft
+        source's guesses, capped by the request's remaining token
+        budget (drafting past ``max_new_tokens`` is wasted verify
+        width) and by the lookahead blocks the scheduler can grant
+        without preempting anyone."""
+        sched = self.scheduler
+        drafts: Dict[int, List[int]] = {}
+        for req in running:
+            budget = min(self.spec_tokens,
+                         req.max_new_tokens - len(req.generated) - 1)
+            if budget < 1:
+                continue
+            d = self.draft_source.propose(
+                req.prompt + req.generated, budget)[:budget]
+            # a draft is a hint from arbitrary user code: truncate at
+            # the first out-of-vocab id rather than feeding it to the
+            # embedding gather
+            for i, t in enumerate(d):
+                if not 0 <= int(t) < self.engine.cfg.vocab_size:
+                    d = d[:i]
+                    break
+            if not d:
+                continue
+            fit = sched.lookahead_capacity(req, 1 + len(d))
+            d = d[:fit - 1]
+            if d:
+                drafts[req.uid] = d
+        return drafts
+
+    def _verify_step(self, running, drafts) -> int:
+        """One speculative verify step over ``running``: every slot
+        feeds its pending token plus its drafts (none = a plain
+        one-token column) through the fixed-width verify program, and
+        greedy acceptance keeps, per slot, the longest draft prefix
+        matching the model's own argmax plus the model's next token —
+        so the emitted tokens are exactly what one-token decode would
+        have produced, just several of them per engine step.  Rejected
+        suffix K/V is rolled back (``Scheduler.rollback_lookahead``).
+        Returns tokens produced."""
+        sched, engine, tr = self.scheduler, self.engine, self.tracer
+        kw = self.spec_tokens + 1
+        b, mb = engine.max_batch_size, engine.blocks_per_seq
+        tokens = np.zeros((b, kw), np.int32)
+        lengths = np.zeros((b,), np.int32)
+        positions = np.zeros((b,), np.int32)
+        tables = np.zeros((b, mb), np.int32)
+        for req in running:
+            d = drafts.get(req.uid, ())
+            n = 1 + len(d)
+            tokens[req.slot, 0] = req.next_input
+            if d:
+                tokens[req.slot, 1:n] = d
+            lengths[req.slot] = n
+            positions[req.slot] = req.num_cached
+            tables[req.slot, :len(req.block_table)] = req.block_table
+        try:
+            with tr.span("verify", batch=len(running),
+                         drafted=sum(len(v) for v in drafts.values())):
+                logits = np.asarray(engine.verify(
+                    tokens, lengths, positions, tables))
+        except MemoryError:
+            # skip-and-retry: no request state moved, and drafts are
+            # pure functions of request history — the retry next
+            # iteration recomputes them bit-identically.  Lookahead
+            # blocks grown for this verify are returned so the skipped
+            # iteration holds no extra pool space.
+            self._note_oom("verify")
+            for req in running:
+                if req.running:
+                    sched.rollback_lookahead(req)
+            return 0
+        self.spec.incr("verify_steps")
+        produced = 0
+        for req in running:
+            n = int(lengths[req.slot])
+            rows = logits[req.slot, :n]                    # (n, V)
+            if not np.all(np.isfinite(rows)):
+                sched.fail(req, "nonfinite")
+                if self.breaker is not None:
+                    self.breaker.record_failure()
+                continue
+            row_toks = self.sample_fn(rows)                # (n,)
+            d = drafts.get(req.uid, ())
+            req.num_cached += 1        # the pending token's K/V landed
+            accepted = 0
+            for j, guess in enumerate(d):
+                if int(guess) != int(row_toks[j]):
+                    break              # model disagrees: reject the
+                    #                    rest of the draft
+                req.record_token(int(guess))
+                self._note_first_token(req)
+                produced += 1
+                req.num_cached += 1    # its verify-written K/V is valid
+                accepted += 1
+                if req.finished:
+                    break
+            if not req.finished:
+                # the model's own next token — the argmax after the
+                # last accepted token, exactly what a one-token decode
+                # would sample there (its K/V is NOT yet written; it
+                # becomes the pending token, same as decode)
+                req.record_token(int(row_toks[accepted]))
+                self._note_first_token(req)
+                produced += 1
+            if d:
+                req.spec_drafted += len(d)
+                req.spec_accepted += accepted
+                self.spec.incr("drafted_tokens", len(d))
+                self.spec.incr("accepted_tokens", accepted)
+                self.spec_drafted_hist.record(len(d))
+                self.spec_accepted_hist.record(accepted)
+            if req.finished:
+                sched.retire(req)
+                if self.breaker is not None:
+                    self.breaker.record_success()
+            else:
+                # index any blocks the accepted tokens just filled,
+                # then release lookahead blocks holding only
+                # rejected-suffix positions (KV rollback)
+                sched.register_progress(req)
+                sched.rollback_lookahead(req)
+        self.spec.incr("decode_tokens", produced)
         return produced
 
     def _note_oom(self, site: str) -> None:
@@ -660,6 +889,8 @@ class InferenceServer:
             h.reset()
         self.decode_latency.reset()
         self.step_time.reset()
+        self.spec_drafted_hist.reset()
+        self.spec_accepted_hist.reset()
         self.scheduler.finished.clear()
         self._finalized = 0
 
@@ -708,6 +939,31 @@ class InferenceServer:
             "breaker_events": self.breaker_events.as_dict(),
             "oom_events": self.oom.total,
             "draining": self._draining,
+            # speculative decoding (docs/serving.md): acceptance-rate
+            # counters, engine-step accounting, and the per-verify
+            # drafted/accepted depth histograms.  decode_tokens /
+            # decode_steps only count the decode phase (prefill-sampled
+            # first tokens excluded), so tokens_per_engine_step is the
+            # speculation speedup axis the bench floors.
+            "speculation": {
+                "enabled": self.speculating,
+                "spec_tokens": self.spec_tokens,
+                "drafted_tokens": self.spec.count("drafted_tokens"),
+                "accepted_tokens": self.spec.count("accepted_tokens"),
+                "acceptance_rate": round(self.spec.ratio(
+                    "accepted_tokens", "drafted_tokens"), 3),
+                "verify_steps": self.spec.count("verify_steps"),
+                "decode_steps": self.spec.count("decode_steps"),
+                "decode_tokens": self.spec.count("decode_tokens"),
+                "tokens_per_engine_step": round(
+                    self.spec.count("decode_tokens")
+                    / max(1, self.spec.count("verify_steps")
+                          + self.spec.count("decode_steps")), 3),
+                "verify_compiles": self.engine.verify_compiles(),
+                "drafted_per_step": _hist_counts(self.spec_drafted_hist),
+                "accepted_per_step": _hist_counts(
+                    self.spec_accepted_hist),
+            },
             "latency": {
                 "ttft_ms": _hist_ms(self.ttft),
                 "queue_wait_ms": _hist_ms(self.queue_wait),

@@ -20,6 +20,17 @@ never recompiles in steady state:
   model on one token per slot at its own position
   (``ops.cached_attention`` inside), scatter the new K/V, return
   next-token logits.  Compiled exactly once.
+- **verify** (the whole batch, ``max_batch_size`` x a fixed token
+  width): the speculative-decoding scoring step — every slot feeds its
+  pending token plus its drafted guesses at carried positions, attends
+  its cached context through its block table plus itself causally
+  (``ops.chunk_cached_attention``, the same program shape as chunk
+  prefill but batched and returning EVERY row's logits), and scatters
+  all fed tokens' K/V.  Greedy acceptance happens on the host
+  (``serving.api``); rejected suffix positions hold garbage K/V that
+  sits beyond the accepted length — masked by the context bias and
+  overwritten before the request ever advances past it.  One trace per
+  verify width, so a fixed speculation depth compiles exactly once.
 - **block copy** (fixed-width (src, dst) id batch): whole-block
   duplication inside the pool — the device half of the prefix cache's
   copy-on-write.  Compiled exactly once.
@@ -171,6 +182,8 @@ class DecodeEngine:
                                    donate_argnums=(1,))
         self._chunk_jit = jax.jit(self._chunk_impl,
                                   donate_argnums=(1,))
+        self._verify_jit = jax.jit(self._verify_impl,
+                                   donate_argnums=(1,))
         self._copy_jit = jax.jit(self._copy_impl, donate_argnums=(0,))
 
     # -- compiled bodies --------------------------------------------------
@@ -230,6 +243,42 @@ class DecodeEngine:
             logits, (length[:, None, None] - 1).astype(jnp.int32),
             axis=1)[:, 0]                                  # (1, V)
         return cache, last
+
+    def _verify_impl(self, params, cache, ids, start, length, tables):
+        """The speculative verify step: ids (B, K) — each slot's
+        pending token followed by its drafted guesses, zero-padded;
+        start (B,) absolute position of ``ids[:, 0]`` (== tokens
+        already materialized through that slot's table); length (B,)
+        valid tokens per slot (0 = idle slot); tables (B,
+        blocks_per_seq).
+
+        Each slot's K tokens attend its full cached context (masked to
+        slots < start) plus themselves causally — the batched
+        generalization of ``_chunk_impl`` — and their K/V scatter at
+        block-offset slots (invalid columns sink into the garbage
+        block).  Returns (cache, logits (B, K, V)): EVERY row's
+        logits, because greedy acceptance needs the model's argmax at
+        each drafted position, not just the last."""
+        kw = ids.shape[1]
+        off = jnp.arange(kw, dtype=jnp.int32)[None, :]
+        pos = start[:, None].astype(jnp.int32) + off       # (B, K)
+        t_ctx = self.blocks_per_seq * self.block_size
+        k_ctx, v_ctx = gather_context(cache, tables, self.block_size)
+        bias = context_bias(start, t_ctx)                  # slots < start
+        # padded columns can run past the embedding table; clamp (their
+        # logits are ignored and their K/V writes garbage-sunk)
+        pos_emb = jnp.minimum(pos, self.cfg.max_position_embeddings - 1)
+        logits, kvs = self.model.apply(
+            {"params": params}, ids, positions=pos_emb,
+            deterministic=True, cache_views=(k_ctx, v_ctx, bias),
+            return_kv=True)
+        k = jnp.stack([kv[0] for kv in kvs])               # (L, B, K, H, D)
+        v = jnp.stack([kv[1] for kv in kvs])
+        valid = off < length[:, None]
+        slots = jnp.where(valid,
+                          slot_index(tables, pos, self.block_size), 0)
+        cache = write_prefill(cache, (k, v), slots)
+        return cache, logits                               # (B, K, V)
 
     def _copy_impl(self, cache, src, dst):
         """(_COPY_WIDTH,) src/dst block ids, (0, 0)-padded — the COW
@@ -359,6 +408,26 @@ class DecodeEngine:
         self._note_compile(self._decode_jit, before, "decode")
         return logits
 
+    def verify(self, tokens, lengths, positions, tables) -> jax.Array:
+        """One speculative verify step over all slots: tokens (B, K)
+        — pending token + drafts per slot, zero-padded; lengths (B,)
+        valid tokens per slot (0 = idle); positions (B,) each slot's
+        cached context length; tables (B, blocks_per_seq).  Writes all
+        valid tokens' K/V and returns per-column logits (B, K, V); the
+        caller (``serving.api``) runs greedy acceptance and rolls back
+        rejected suffix blocks.  One trace per distinct K — a server
+        with a fixed speculation depth compiles this exactly once."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        before = self._compile_mark(self._verify_jit)
+        self.cache, logits = self._verify_jit(
+            self.params, self.cache, tokens,
+            jnp.asarray(positions, jnp.int32),
+            jnp.asarray(lengths, jnp.int32),
+            jnp.asarray(tables, jnp.int32))
+        self._note_compile(self._verify_jit, before, "verify",
+                           width=int(tokens.shape[1]))
+        return logits
+
     # -- introspection ----------------------------------------------------
 
     def compile_counts(self):
@@ -370,6 +439,13 @@ class DecodeEngine:
         return (self._prefill_jit._cache_size()
                 + self._chunk_jit._cache_size(),
                 self._decode_jit._cache_size())
+
+    def verify_compiles(self) -> int:
+        """Verify-program traces — the speculation half of the
+        compile audit: a server with a fixed speculation depth must
+        show exactly 1 (0 with speculation off/idle) no matter how
+        drafts and batch composition vary."""
+        return self._verify_jit._cache_size()
 
     def reset_cache(self):
         """Zero the pool and refill the allocator in place (between

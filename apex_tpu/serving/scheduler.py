@@ -36,6 +36,16 @@ chunk rather than one full prefill.  The chunk engine program carries
 the KV position (``start``), so generation is bit-stable across any
 chunking of the same context.
 
+**Speculative lookahead** (``serving.speculation``).  A decoding
+request with drafts needs room for up to K token writes this
+iteration, not one: :meth:`lookahead_capacity` grows the table
+opportunistically (evicting idle cache holds but never preempting — a
+bad drafter must not degrade its neighbors; a draft that doesn't fit
+is trimmed), and :meth:`rollback_lookahead` frees the blocks holding
+only rejected-suffix positions after every verify step, so
+speculation borrows pool space within an iteration instead of
+keeping it.
+
 The scheduler is pure host-side bookkeeping over the engine's
 geometry; it never touches device arrays.  ``serving.api`` composes it
 with the :class:`serving.engine.DecodeEngine` into the step loop.
@@ -141,6 +151,14 @@ class Request:
     finished: bool = False
     finish_reason: Optional[str] = None
     preemptions: int = 0
+
+    # speculation accounting (``serving.speculation``): lifetime drafted
+    # and accepted token counts for this request — the per-request view
+    # behind the server-level acceptance rate.  Drafts themselves are
+    # stateless (recomputed from history each iteration), so nothing
+    # here needs resetting across preemption.
+    spec_drafted: int = 0
+    spec_accepted: int = 0
 
     # prefill state machine (owned by the scheduler): the context being
     # chunk-prefilled, whether the final chunk's logits sample a token
@@ -521,6 +539,44 @@ class Scheduler:
                 return False
             self.preempt(victim)
         return True
+
+    def lookahead_capacity(self, req: Request, tokens: int) -> int:
+        """Grow ``req``'s table OPPORTUNISTICALLY so up to ``tokens``
+        tokens can write at positions ``num_cached..`` — the K-token
+        speculation lookahead.  Unlike :meth:`ensure_decode_capacity`
+        this never preempts: lookahead is an optimization, and taking
+        another request's blocks to verify guesses would let a bad
+        drafter degrade its neighbors.  Evicting idle prefix-cache
+        holds (via :meth:`_try_alloc`) is allowed — the same reclaim
+        decode growth makes.  Returns how many tokens actually fit
+        (>= 1 once :meth:`ensure_decode_capacity` succeeded); the
+        caller trims its draft to ``fit - 1``."""
+        bs = self.block_size
+        tokens = min(tokens, self.max_context - req.num_cached)
+        while len(req.block_table) * bs - req.num_cached < tokens:
+            fresh = self._try_alloc(1)
+            if fresh is None:
+                break
+            req.block_table.extend(fresh)
+        return max(0, min(tokens,
+                          len(req.block_table) * bs - req.num_cached))
+
+    def rollback_lookahead(self, req: Request) -> int:
+        """KV rollback after a verify step: free table blocks holding
+        ONLY rejected-suffix positions (everything past the block the
+        next token writes into).  Those blocks were lookahead-fresh —
+        allocated this iteration, never registered, refcount 1 — so
+        freeing them is exact; the garbage K/V inside the kept partial
+        block sits beyond ``num_cached`` where the context bias masks
+        it until a future write overwrites it.  Returns the number of
+        blocks released."""
+        keep = req.num_cached // self.block_size + 1
+        tail = req.block_table[keep:]
+        if not tail:
+            return 0
+        del req.block_table[keep:]
+        self.allocator.free(tail)
+        return len(tail)
 
     def _preempt_victim(self, exclude: Request) -> Optional[Request]:
         """Priority-aware victim choice: the worst priority class

@@ -1,0 +1,129 @@
+"""Draft proposers for speculative decoding (``docs/serving.md``).
+
+Speculative decoding splits one decode iteration into *draft* (guess
+the next few tokens cheaply) and *verify* (score every guess in one
+multi-token engine step — ``DecodeEngine.verify``, built on
+``ops.chunk_cached_attention``).  Greedy acceptance keeps the output
+bit-identical to plain one-token decode by construction: the accepted
+tokens are exactly the drafts that MATCH the model's own argmax at
+their position, followed by the model's own next token — so a wrong
+draft costs one wasted verify column, never a wrong output token.
+
+This module is the draft half.  The default proposer is zero-weight
+**prompt-lookup / n-gram drafting** (Saxena's prompt-lookup decoding;
+the LLMA observation): generation frequently copies spans that already
+occurred in the request's own context — few-shot templates, quoted
+retrieval passages, code identifiers, and the self-generated suffix of
+any repetitive completion — so the best free guess for "what follows
+the current suffix" is "what followed it last time it appeared".  No
+extra weights, no extra compiled programs, no second model to keep in
+HBM.
+
+:class:`DraftSource` is the pluggable interface: a small-model drafter
+(the classic Leviathan et al. setup) is a subclass whose
+:meth:`~DraftSource.propose` greedily decodes ``k`` tokens from its
+own cheap model — the verify/acceptance machinery upstream is
+identical and stays bit-exact regardless of where drafts come from,
+because acceptance only ever compares drafts against the target
+model's own argmax.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+__all__ = ["DraftSource", "NgramDraft"]
+
+
+class DraftSource:
+    """Interface for draft proposers.
+
+    ``propose(tokens, k)`` receives the request's full token history
+    (prompt + everything generated so far, INCLUDING the pending token
+    whose K/V the next engine step will write) and returns up to ``k``
+    guesses for the tokens that follow.  Returning ``[]`` means "no
+    guess" — the request decodes one token normally that iteration.
+
+    Contract notes for implementers:
+
+    - drafts are *hints*, never outputs: a wrong draft is rejected by
+      verify and costs only wasted compute, so proposers may guess
+      aggressively;
+    - ``propose`` runs on the host inside the serve loop, once per
+      decoding request per iteration — it must be cheap relative to a
+      device step;
+    - determinism matters: a request replayed with the same history
+      must get the same drafts, or OOM-retry and the chaos soak's
+      bit-exact replay would wobble (outputs stay bit-exact either
+      way, but per-step accounting would not reproduce).
+    """
+
+    def propose(self, tokens: Sequence[int], k: int) -> List[int]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        """Drop any cross-request state (stateless by default)."""
+
+
+class NgramDraft(DraftSource):
+    """Zero-weight prompt-lookup drafts from the request's own history.
+
+    For ``n`` from ``max_ngram`` down to ``min_ngram``: take the last
+    ``n`` tokens of the history, find the most recent EARLIER
+    occurrence of that n-gram, and propose the ``k`` tokens that
+    followed it.  Longer n-grams are tried first (a longer matched
+    context is a stronger predictor); the most recent occurrence wins
+    because generation drifts — what followed the suffix lately beats
+    what followed it long ago.
+
+    ``history_window`` bounds the scan (the last N tokens of history);
+    the proposer is O(window * max_ngram) per call, so the default
+    keeps drafting cost trivially small next to a device step even for
+    long-context requests.
+    """
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1,
+                 history_window: Optional[int] = 512):
+        if max_ngram < min_ngram or min_ngram < 1:
+            raise ValueError(
+                f"need max_ngram >= min_ngram >= 1; got "
+                f"max_ngram={max_ngram} min_ngram={min_ngram}")
+        if history_window is not None and history_window < 2:
+            raise ValueError(
+                f"history_window must be >= 2, got {history_window}")
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+        self.history_window = history_window
+
+    def propose(self, tokens: Sequence[int], k: int) -> List[int]:
+        hist = list(tokens)
+        if self.history_window is not None \
+                and len(hist) > self.history_window:
+            hist = hist[len(hist) - self.history_window:]
+        out: List[int] = []
+        # extend one token at a time, appending each guess to the
+        # working history — a single match only ever pins down the next
+        # token, and re-matching the EXTENDED suffix extrapolates
+        # periodic tails (the common repetitive-completion shape) to a
+        # full k-token draft instead of stopping at the history's edge
+        for _ in range(max(0, k)):
+            nxt = self._lookup_next(hist)
+            if nxt is None:
+                break
+            out.append(nxt)
+            hist.append(nxt)
+        return out
+
+    def _lookup_next(self, hist: List[int]) -> Optional[int]:
+        """The token that followed the most recent earlier occurrence
+        of the longest matching suffix n-gram (None = no occurrence of
+        any n-gram down to ``min_ngram``)."""
+        n_hist = len(hist)
+        for n in range(min(self.max_ngram, n_hist - 1),
+                       self.min_ngram - 1, -1):
+            suffix = tuple(hist[n_hist - n:])
+            # most recent occurrence strictly before the suffix itself
+            for i in range(n_hist - n - 1, -1, -1):
+                if tuple(hist[i:i + n]) == suffix:
+                    return int(hist[i + n])
+        return None

@@ -117,12 +117,16 @@ def main():
         print(f"req {i:2d}: prompt[{len(p):3d}] -> {len(o):3d} tokens: "
               f"{head}{' ...' if len(o) > 8 else ''}")
     st = server.stats()
+    sp = st["speculation"]
+    spec = (f" | speculation: {sp['tokens_per_engine_step']:.2f} "
+            f"tok/engine-step @ {sp['acceptance_rate']:.0%} accepted"
+            if sp["enabled"] and sp["drafted_tokens"] else "")
     print(f"\n{st['tokens_generated']} tokens in {dt:.2f}s = "
           f"{st['tokens_generated'] / dt:.0f} tok/s | occupancy "
           f"{st['batch_occupancy_avg']:.0%} | queue peak "
           f"{st['queue_depth_peak']:.0f} | compiles: "
           f"{st['prefill_compiles']} prefill / {st['decode_compiles']} "
-          f"decode | preemptions {st['preemptions']}")
+          f"decode | preemptions {st['preemptions']}{spec}")
 
 
 if __name__ == "__main__":

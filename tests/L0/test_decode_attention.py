@@ -1,0 +1,168 @@
+"""Direct unit tests for ``ops.chunk_cached_attention`` — the verify
+primitive of speculative decoding and the scoring step of chunked
+prefill.
+
+Until now this op was only exercised indirectly through the engine's
+``chunk_prefill`` program; these tests pin its contract in isolation:
+K=1 degenerates to single-token cached attention, a chunk whose
+positions cross a block boundary ignores the masked context tail
+exactly (gathered-but-unwritten slots can never leak into the
+softmax), and the fp32 path matches an independently-written jnp
+oracle (bf16 within half tolerance).
+"""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops.decode_attention import (
+    NEG_INF,
+    cached_attention,
+    chunk_cached_attention,
+)
+
+pytestmark = pytest.mark.serving
+
+
+def _rand(shape, seed, dtype=np.float32):
+    return np.asarray(
+        np.random.RandomState(seed).randn(*shape), dtype)
+
+
+def _oracle(q, k, v, ctx_bias):
+    """Independent fp64 reference: every chunk query attends the
+    (bias-masked) context plus the chunk causally — written against
+    the DOCSTRING, not the implementation."""
+    b, c, h, d = q.shape
+    t = k.shape[1] - c
+    q64, k64, v64 = (np.asarray(x, np.float64) for x in (q, k, v))
+    out = np.zeros_like(q64)
+    for bi in range(b):
+        for hi in range(h):
+            for ci in range(c):
+                scores = []
+                cols = []
+                for ti in range(t):              # cached context
+                    if ctx_bias[bi, ti] <= NEG_INF / 2:
+                        continue
+                    scores.append(q64[bi, ci, hi] @ k64[bi, ti, hi])
+                    cols.append(ti)
+                for cj in range(ci + 1):         # causal within chunk
+                    scores.append(q64[bi, ci, hi] @ k64[bi, t + cj, hi])
+                    cols.append(t + cj)
+                s = np.asarray(scores) / math.sqrt(d)
+                p = np.exp(s - s.max())
+                p /= p.sum()
+                out[bi, ci, hi] = sum(
+                    w * v64[bi, col, hi] for w, col in zip(p, cols))
+    return out
+
+
+def _bias(b, t, lengths):
+    bias = np.full((b, t), NEG_INF, np.float32)
+    for i, n in enumerate(lengths):
+        bias[i, :n] = 0.0
+    return bias
+
+
+def test_chunk_matches_oracle_fp32():
+    b, t, c, h, d = 2, 12, 5, 2, 8
+    q = _rand((b, c, h, d), 0)
+    kv = _rand((b, t + c, h, d), 1), _rand((b, t + c, h, d), 2)
+    bias = _bias(b, t, [12, 7])
+    got = np.asarray(chunk_cached_attention(
+        jnp.asarray(q), jnp.asarray(kv[0]), jnp.asarray(kv[1]),
+        jnp.asarray(bias)))
+    np.testing.assert_allclose(got, _oracle(q, *kv, bias),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_k1_degenerate_chunk_equals_cached_attention():
+    """C=1 is exactly single-token decode: the chunk's causal block is
+    the [[0]] self column, so the output must agree with
+    ``cached_attention`` over [context; self] — the equivalence the
+    speculative verify program leans on when a request has no draft."""
+    b, t, h, d = 2, 16, 2, 8
+    q = _rand((b, 1, h, d), 3)
+    k = _rand((b, t + 1, h, d), 4)
+    v = _rand((b, t + 1, h, d), 5)
+    lengths = [16, 9]
+    ctx_bias = _bias(b, t, lengths)
+    got = np.asarray(chunk_cached_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        jnp.asarray(ctx_bias)))
+    # decode view: same keys, self column appended live to the bias
+    kv_bias = np.concatenate(
+        [ctx_bias, np.zeros((b, 1), np.float32)], axis=1)
+    want = np.asarray(cached_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        kv_bias=jnp.asarray(kv_bias), use_pallas=False))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(got, _oracle(q, k, v, ctx_bias),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_chunk_crossing_block_boundary_ignores_masked_tail():
+    """The engine gathers context at BLOCK granularity, so a chunk
+    starting mid-block sees gathered-but-unwritten slots past
+    ``start`` — whatever garbage sits there (here: huge values) must
+    not move the output, because the ctx bias masks it.  This is the
+    exact shape of a speculative verify at a non-block-aligned
+    position."""
+    b, h, d = 1, 2, 8
+    block = 8
+    start = 13                      # mid-block: crosses the 8/16 edge
+    t = 3 * block                   # 3 gathered blocks
+    c = 5
+    q = _rand((b, c, h, d), 6)
+    k = _rand((b, t + c, h, d), 7)
+    v = _rand((b, t + c, h, d), 8)
+    bias = _bias(b, t, [start])
+    ref = np.asarray(chunk_cached_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        jnp.asarray(bias)))
+    # poison every masked context slot; output must be bit-identical
+    k2, v2 = k.copy(), v.copy()
+    k2[:, start:t] = 1e4
+    v2[:, start:t] = -1e4
+    got = np.asarray(chunk_cached_attention(
+        jnp.asarray(q), jnp.asarray(k2), jnp.asarray(v2),
+        jnp.asarray(bias)))
+    assert np.array_equal(ref, got), \
+        "masked context slots leaked into the chunk softmax"
+    np.testing.assert_allclose(ref, _oracle(q, k, v, bias),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_chunk_bf16_tracks_fp32_oracle():
+    """bf16 q/k/v (the amp-default cache dtype): fp32 score/softmax
+    policy keeps the output within half tolerance of the fp32 oracle,
+    and the output dtype follows q."""
+    b, t, c, h, d = 2, 12, 4, 2, 8
+    q = _rand((b, c, h, d), 9)
+    k = _rand((b, t + c, h, d), 10)
+    v = _rand((b, t + c, h, d), 11)
+    bias = _bias(b, t, [12, 5])
+    out = chunk_cached_attention(
+        jnp.asarray(q, jnp.bfloat16), jnp.asarray(k, jnp.bfloat16),
+        jnp.asarray(v, jnp.bfloat16), jnp.asarray(bias))
+    assert out.dtype == jnp.bfloat16
+    want = _oracle(np.asarray(jnp.asarray(q, jnp.bfloat16), np.float32),
+                   np.asarray(jnp.asarray(k, jnp.bfloat16), np.float32),
+                   np.asarray(jnp.asarray(v, jnp.bfloat16), np.float32),
+                   bias)
+    np.testing.assert_allclose(np.asarray(out, np.float32), want,
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_chunk_rejects_bad_shapes():
+    q = jnp.zeros((1, 4, 2, 8))
+    k = jnp.zeros((1, 3, 2, 8))     # T + C < C
+    with pytest.raises(ValueError, match=r"T >= 0"):
+        chunk_cached_attention(q, k, k, jnp.zeros((1, 0)))
+    k2 = jnp.zeros((1, 8, 2, 8))
+    v2 = jnp.zeros((1, 7, 2, 8))    # k/v mismatch
+    with pytest.raises(ValueError):
+        chunk_cached_attention(q, k2, v2, jnp.zeros((1, 4)))

@@ -228,8 +228,12 @@ def test_breaker_guards_submit_and_recovers(tiny):
     clock = {"t": 0.0}
     breaker = CircuitBreaker(failure_threshold=3, recovery_time=10.0,
                              clock=lambda: clock["t"])
+    # speculation off: the poison is injected through engine.decode,
+    # which a speculating server bypasses (verify-path isolation is
+    # covered by tests/L0/test_speculative.py)
     server = _server(cfg, params, max_batch_size=4, max_context=64,
-                     block_size=8, breaker=breaker)
+                     block_size=8, breaker=breaker,
+                     enable_speculation=False)
     poison = {"on": True}
     orig = server.engine.decode
 
@@ -275,12 +279,17 @@ def test_drain_is_bit_exact_and_close_is_exactly_once(tiny):
     cfg, params = tiny
     prompts = [[3, 1, 4, 1, 5, 9, 2, 6], [2, 7, 1, 8, 2, 8, 1, 8]]
 
+    # speculation off in both arms: "mid-generation after 4 steps"
+    # assumes one-token-per-iteration pacing (a speculating server can
+    # finish 12 tokens inside 4 iterations; drain bit-exactness with
+    # speculation on is covered by the chaos soak)
     baseline = _server(cfg, params, max_batch_size=2, max_context=64,
-                       block_size=8).generate(prompts,
-                                              max_new_tokens=12)
+                       block_size=8,
+                       enable_speculation=False).generate(
+                           prompts, max_new_tokens=12)
 
     server = _server(cfg, params, max_batch_size=2, max_context=64,
-                     block_size=8)
+                     block_size=8, enable_speculation=False)
     reqs = [server.submit(p, 12) for p in prompts]
     for _ in range(4):                # mid-generation...
         server.step()
@@ -334,12 +343,16 @@ def test_transient_engine_oom_is_retried_bit_exactly(tiny):
     an undisturbed run, and the event is counted."""
     cfg, params = tiny
     prompts = [[3, 1, 4, 1, 5, 9, 2, 6], [2, 7, 1, 8]]
+    # speculation off in both arms: the flaky wrapper intercepts
+    # engine.decode, which a speculating server bypasses (verify-path
+    # OOM retry is covered by tests/L0/test_speculative.py)
     baseline = _server(cfg, params, max_batch_size=2, max_context=64,
-                       block_size=8).generate(prompts,
-                                              max_new_tokens=10)
+                       block_size=8,
+                       enable_speculation=False).generate(
+                           prompts, max_new_tokens=10)
 
     server = _server(cfg, params, max_batch_size=2, max_context=64,
-                     block_size=8)
+                     block_size=8, enable_speculation=False)
     orig = server.engine.decode
     calls = {"n": 0}
 

@@ -317,8 +317,11 @@ def test_chunked_prefill_interleaves_with_decode(tiny):
     stall chunked prefill exists to remove (structurally, not by
     wall-clock)."""
     cfg, params = tiny
+    # speculation off: the per-iteration "+1 token" probe below IS the
+    # structural claim; a speculating server emits several tokens per
+    # step and would blur it
     srv = _server(cfg, params, on=True, max_batch_size=2,
-                  prefill_chunk=8)
+                  prefill_chunk=8, enable_speculation=False)
     short = srv.submit([1, 2, 3], 40)
     # get the short request decoding
     for _ in range(3):
@@ -352,11 +355,15 @@ def test_preempted_resume_is_a_cache_hit(tiny):
     so the ample-pool path is the one where resume-as-hit shows.)"""
     cfg, params = tiny
     prompt = [3, 1, 4, 1, 5, 9, 2, 6]
-    base = _server(cfg, params, on=False, max_batch_size=2)
+    # speculation off in both arms: the manual preempt below is aimed
+    # at a request mid-generation after exactly 6 one-token steps
+    base = _server(cfg, params, on=False, max_batch_size=2,
+                   enable_speculation=False)
     want = _audited_generate(base, [prompt], 24)[0]
 
     srv = _server(cfg, params, on=True, max_batch_size=2,
-                  block_size=4, prefill_chunk=8)
+                  block_size=4, prefill_chunk=8,
+                  enable_speculation=False)
     req = srv.submit(prompt, 24)
     for _ in range(6):
         srv.step()

@@ -207,6 +207,15 @@ def test_stats_keys_are_backward_compatible(tiny):
         f"stats() lost overload keys: {overload - st.keys()}"
     assert st["breaker_state"] == "closed"     # healthy run
     assert st["oom_events"] == 0
+    # speculative-decoding keys (docs/serving.md) ride alongside in
+    # their own block — the bench and dashboards key on these
+    spec = {"enabled", "spec_tokens", "drafted_tokens",
+            "accepted_tokens", "acceptance_rate", "verify_steps",
+            "decode_steps", "decode_tokens", "tokens_per_engine_step",
+            "verify_compiles", "drafted_per_step", "accepted_per_step"}
+    assert not spec - st["speculation"].keys(), \
+        f"stats() lost speculation keys: {spec - st['speculation'].keys()}"
+    assert st["speculation"]["enabled"] is True    # default-on server
     lat = st["latency"]
     assert set(lat) == {"ttft_ms", "queue_wait_ms", "decode_token_ms",
                         "step_ms", "queue_wait_by_priority_ms"}
@@ -221,6 +230,31 @@ def test_stats_keys_are_backward_compatible(tiny):
         tl = req.timeline()
         assert tl["submitted_at"] <= tl["admitted_at"] \
             <= tl["first_token_at"] <= tl["finished_at"]
+
+
+def test_greedy_sample_rejects_ints_and_breaks_ties_low(tiny):
+    """The bit-exactness contract speculation relies on: ties break
+    toward the LOWEST token id (np.argmax's first-maximum rule), so a
+    verify row's argmax resolves identically to a decode row's; and
+    non-floating inputs raise instead of silently argmaxing token
+    ids."""
+    del tiny
+    from apex_tpu.serving import greedy_sample
+
+    tied = np.zeros((3, 8), np.float32)
+    tied[0, [2, 5]] = 1.0        # tie between 2 and 5 -> 2
+    tied[1, [0, 7]] = 3.5        # tie between 0 and 7 -> 0
+    tied[2, :] = -1.0            # full tie -> 0
+    assert greedy_sample(tied).tolist() == [2, 0, 0]
+    # shape-generic: a (V,) row and a (B, K, V) verify block
+    assert int(greedy_sample(tied[0])) == 2
+    assert greedy_sample(np.stack([tied, tied])).shape == (2, 3)
+    for bad in (np.array([[1, 2, 3]], np.int32),
+                np.array([1, 2, 3], np.int64)):
+        with pytest.raises(TypeError, match="floating"):
+            greedy_sample(bad)
+    # float16/bfloat16-as-float32 logits stay accepted
+    assert greedy_sample(tied.astype(np.float16)).tolist() == [2, 0, 0]
 
 
 def test_prefill_buckets_ladder():

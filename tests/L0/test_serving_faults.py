@@ -103,8 +103,10 @@ def test_midflight_outgrow_fails_alone_and_frees_pool(tiny):
 
 def test_iteration_deadline_times_out_only_that_request(tiny):
     cfg, params = tiny
+    # speculation off: the deadline must expire MID-generation, which
+    # needs the one-token-per-iteration pacing this test is written in
     server = _server(cfg, params, max_batch_size=2, max_context=64,
-                     block_size=8)
+                     block_size=8, enable_speculation=False)
     slow = server.submit([3, 1, 4, 1], 10, deadline_iters=3)
     fast = server.submit([5, 9, 2, 6], 10)
     while server.scheduler.has_work:
@@ -119,8 +121,10 @@ def test_iteration_deadline_times_out_only_that_request(tiny):
 def test_wall_deadline_with_injected_clock(tiny):
     cfg, params = tiny
     clock = {"t": 0.0}
+    # speculation off: one-token-per-iteration pacing (see above)
     server = _server(cfg, params, max_batch_size=2, max_context=64,
-                     block_size=8, clock=lambda: clock["t"])
+                     block_size=8, clock=lambda: clock["t"],
+                     enable_speculation=False)
     doomed = server.submit([3, 1, 4, 1], 10, deadline_s=5.0)
     steady = server.submit([5, 9, 2, 6], 10)
     server.step()
@@ -184,8 +188,9 @@ def test_iter_deadline_on_request_preempted_at_expiry(tiny):
     expires times out from the waiting queue — keeping its partial
     output, holding zero blocks, and never re-admitting."""
     cfg, params = tiny
+    # speculation off: one-token-per-iteration pacing (see above)
     server = _server(cfg, params, max_batch_size=2, max_context=64,
-                     block_size=8)
+                     block_size=8, enable_speculation=False)
     req = server.submit([3, 1, 4, 1], 10, deadline_iters=4)
     for _ in range(4):
         server.step()
@@ -240,12 +245,16 @@ def test_nonfinite_decode_row_evicts_only_poisoned_request(tiny):
     cfg, params = tiny
     prompts = [[3, 1, 4, 1, 5, 9, 2, 6], [2, 7, 1, 8, 2, 8, 1, 8]]
 
+    # speculation off in both arms: the poison is injected through
+    # engine.decode, which a speculating server bypasses (the verify
+    # path's non-finite isolation has its own test in
+    # tests/L0/test_speculative.py)
     clean = _server(cfg, params, max_batch_size=2, max_context=64,
-                    block_size=8)
+                    block_size=8, enable_speculation=False)
     baseline = clean.generate(prompts, max_new_tokens=12)
 
     server = _server(cfg, params, max_batch_size=2, max_context=64,
-                     block_size=8)
+                     block_size=8, enable_speculation=False)
     victim = server.submit(prompts[0], 12)
     other = server.submit(prompts[1], 12)
     orig_decode = server.engine.decode
@@ -305,8 +314,11 @@ def test_mixed_failures_no_exception_escapes(tiny):
     in one batch — generate() completes, healthy requests get full
     completions, and only the affected ones carry capacity/timeout."""
     cfg, params = tiny
+    # speculation off: the deadline_iters=2 expiry below assumes
+    # one-token-per-iteration pacing
     server = _server(cfg, params, max_batch_size=3, max_context=64,
-                     block_size=4, num_blocks=10)  # 9 usable = 36 tok
+                     block_size=4, num_blocks=10,  # 9 usable = 36 tok
+                     enable_speculation=False)
     huge = list(np.arange(30) % VOCAB)             # needs 8 blocks; >
     doomed = server.submit([3, 1, 4, 1], 10, deadline_iters=2)
     capacity = server.submit(huge, 10)             # fits alone, but the

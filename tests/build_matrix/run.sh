@@ -88,6 +88,16 @@ echo "=== build-matrix axis: serving-prefix-smoke ==="
 env JAX_PLATFORMS=cpu python tools/serving_bench.py --smoke --shared-prefix --out -
 results[serving_prefix]=$?
 
+# serving-speculative smoke: speculative decoding with bit-exact
+# greedy acceptance (docs/serving.md) — asserts token-for-token parity
+# speculation-on vs off on both workloads and the >= 2x decoded-
+# tokens-per-engine-step floor on repetitive-suffix traffic (random
+# traffic is reported, never floored), auditing the scheduler
+# refcounts every step (tools/serving_bench.py --speculative)
+echo "=== build-matrix axis: serving-speculative-smoke ==="
+env JAX_PLATFORMS=cpu python tools/serving_bench.py --smoke --speculative --out -
+results[serving_spec]=$?
+
 # chaos soak: the overload-robustness axis (docs/resilience.md,
 # "Overload policy & lifecycle") — the full serving stack (prefix
 # cache + chunked prefill + overload control + circuit breaker, small
@@ -100,6 +110,15 @@ results[serving_prefix]=$?
 echo "=== build-matrix axis: chaos-soak ==="
 env JAX_PLATFORMS=cpu python tools/chaos_soak.py --seed 0 --iters 2000
 results[chaos]=$?
+
+# speculative chaos soak: one seeded soak with speculative decoding ON
+# and the repetitive traffic class mixed in, so verify steps, greedy
+# acceptance, and lookahead KV rollback run under the same composed
+# faults — same invariants, including bit-exact replay (speculation-on
+# output is bit-identical by construction)
+echo "=== build-matrix axis: chaos-soak-speculative ==="
+env JAX_PLATFORMS=cpu python tools/chaos_soak.py --seed 0 --iters 800 --speculative
+results[chaos_spec]=$?
 
 # trace smoke: the observability axis (docs/observability.md) — the
 # serving smoke re-runs with APEX_TPU_TRACE set; the exported Chrome

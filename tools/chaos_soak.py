@@ -21,8 +21,18 @@ Any violation exits non-zero with the failing assertion.  The same
 ``--seed`` replays the same chaos (``docs/resilience.md``, "Overload
 policy & lifecycle").
 
+``--speculative`` turns speculative decoding ON in the soaked server
+(and the replay oracle) and mixes in the repetitive-prompt traffic
+class so n-gram drafts actually fire — verify steps, greedy
+acceptance, and lookahead KV rollback then run under every composed
+fault, and the report records the acceptance rate.  The default run
+keeps speculation OFF so the legacy axis numbers stay comparable
+across PRs (speculation-on output is bit-identical anyway; this is
+about fault-surface attribution, not correctness).
+
 Usage:
     python tools/chaos_soak.py [--seed 0] [--iters 2000] [--out -]
+        [--speculative]
 """
 
 import argparse
@@ -61,6 +71,10 @@ def main(argv=None) -> int:
     parser.add_argument("--iters", type=int, default=2000)
     parser.add_argument("--out", default=None,
                         help="report JSON path ('-' for stdout)")
+    parser.add_argument("--speculative", action="store_true",
+                        help="speculation-enabled traffic class: "
+                        "serve with speculative decoding on and mix "
+                        "in repetitive prompts so drafts fire")
     args = parser.parse_args(argv)
 
     import jax.numpy as jnp
@@ -76,10 +90,14 @@ def main(argv=None) -> int:
         # displacement, and pressure shedding all actually fire.  The
         # breaker runs on the soak's iteration clock so trips and
         # half-open recoveries are deterministic per seed.
+        # Speculation follows --speculative (off by default so the
+        # legacy axis numbers stay comparable; output is bit-identical
+        # either way).
         return InferenceServer(
             cfg, params, max_batch_size=4, max_context=64,
             block_size=4, num_blocks=40,          # 39 usable blocks
             cache_dtype=jnp.float32, max_waiting=8, clock=clock,
+            enable_speculation=args.speculative,
             breaker=CircuitBreaker(failure_threshold=3,
                                    recovery_time=25.0,
                                    probe_successes=2, clock=clock))
@@ -89,9 +107,15 @@ def main(argv=None) -> int:
         # oracle (every slot can hold a full-context request)
         return InferenceServer(
             cfg, params, max_batch_size=4, max_context=64,
-            block_size=4, cache_dtype=jnp.float32, clock=clock)
+            block_size=4, cache_dtype=jnp.float32, clock=clock,
+            enable_speculation=args.speculative)
 
-    chaos_cfg = ChaosConfig(iters=args.iters, vocab=VOCAB)
+    chaos_cfg = ChaosConfig(
+        iters=args.iters, vocab=VOCAB,
+        # with speculation on, a third of the prompts are repetitive
+        # so drafts fire and the verify/acceptance/rollback machinery
+        # soaks under faults rather than idling
+        repetitive_rate=0.33 if args.speculative else 0.0)
     t0 = time.perf_counter()
     report = run_soak(make_server, chaos_cfg, args.seed,
                       make_replay=make_replay, log=print)
