@@ -13,6 +13,20 @@ Two pieces, both process-wide and dependency-free:
   (Perfetto-loadable).  Disabled by default (:data:`NULL_TRACER`,
   zero overhead); ``APEX_TPU_TRACE=/path.json`` or
   :func:`enable_tracing` turns it on.
+- :mod:`observability.flightrecorder` — :class:`FlightRecorder`, a
+  bounded ring of structured per-engine-step records (batch
+  composition, admit/shed/preempt/evict decisions, memory occupancy,
+  speculation outcomes, pressure, breaker state), disabled by default
+  (:data:`NULL_FLIGHT_RECORDER`, zero allocations per step), plus
+  :func:`write_postmortem` — the bundle (flight JSONL + metrics
+  snapshot + Chrome trace + manifest) auto-dumped on chaos invariant
+  violations, audit failures, and breaker-open transitions, rendered
+  by ``tools/postmortem.py``.
+- :mod:`observability.slo` — :class:`SLOTracker` over per-priority
+  :class:`SLOTargets`: TTFT / per-token-decode / deadline attainment
+  per class, goodput-vs-throughput token counters, and SLO-debt
+  accounting for overload shed/displace decisions
+  (``stats()["slo"]``).
 
 What is instrumented out of the box: the serving step loop (admit /
 prefix-match / chunk-prefill / decode / evict / preempt spans,
@@ -23,14 +37,23 @@ save/restore/publish, and the amp train step (step time, loss-scale
 trajectory, overflow skips).  See ``docs/observability.md``.
 """
 
+from apex_tpu.observability.flightrecorder import (
+    NULL_FLIGHT_RECORDER,
+    POSTMORTEM_ENV,
+    FlightRecorder,
+    NullFlightRecorder,
+    write_postmortem,
+)
 from apex_tpu.observability.registry import (
     Counter,
     Gauge,
     HistogramMeter,
     MetricsRegistry,
+    escape_label_value,
     series_key,
     snapshot_diff,
 )
+from apex_tpu.observability.slo import SLOPolicy, SLOTargets, SLOTracker
 from apex_tpu.observability.tracing import (
     NULL_TRACER,
     NullTracer,
@@ -43,16 +66,25 @@ from apex_tpu.observability.tracing import (
 
 __all__ = [
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "HistogramMeter",
     "MetricsRegistry",
+    "NULL_FLIGHT_RECORDER",
     "NULL_TRACER",
+    "NullFlightRecorder",
     "NullTracer",
+    "POSTMORTEM_ENV",
+    "SLOPolicy",
+    "SLOTargets",
+    "SLOTracker",
     "SpanTracer",
     "TRACE_ENV",
     "enable_tracing",
+    "escape_label_value",
     "get_tracer",
     "series_key",
     "set_tracer",
     "snapshot_diff",
+    "write_postmortem",
 ]

@@ -1,0 +1,180 @@
+"""Step-level flight recorder + postmortem bundles for the serving loop.
+
+The PR-4 telemetry layer answers "what moved" (p99 shifted, a counter
+jumped); what it cannot answer is "which scheduler decisions led up to
+it" — when a chaos invariant trips at iteration 1840 or a breaker
+opens in production, the histograms have already averaged away the
+admit/shed/preempt sequence that caused it.  This module is the
+missing black box:
+
+- :class:`FlightRecorder` — a bounded ring (``deque(maxlen=...)``) of
+  structured per-engine-step records.  ``serving.api`` assembles one
+  plain dict per :meth:`InferenceServer.step` — batch composition,
+  admit/shed/preempt/evict decisions, allocator + prefix-cache +
+  lookahead occupancy, speculation drafted/accepted, ``pressure()``,
+  breaker state, step wall time — and :meth:`record` appends it.  A
+  long-running server keeps the most recent window;
+  :attr:`FlightRecorder.dropped` counts what rolled off.
+- :data:`NULL_FLIGHT_RECORDER` — the disabled default, exactly the
+  ``NULL_TRACER`` pattern: ``record()`` is a no-op and the serve loop
+  guards record *assembly* on ``recorder.enabled``, so the disabled
+  path adds zero allocations per step
+  (``tests/L0/test_flightrecorder.py`` pins this with tracemalloc).
+- :func:`write_postmortem` — dumps a **postmortem bundle**: the
+  flight-recorder ring as JSONL, a ``MetricsRegistry.snapshot()``, the
+  tracer's Chrome trace, and a manifest tying them together.
+  ``InferenceServer`` writes bundles on demand
+  (:meth:`~InferenceServer.dump_postmortem`), on breaker-open
+  transitions, on ``audit()`` failure, and
+  :func:`resilience.chaos.run_soak` writes one on any invariant
+  violation.  ``tools/postmortem.py`` renders, slices
+  (``--request <uid>``), diffs, and gates (``--assert-complete``)
+  bundles.
+
+Recording never draws randomness and never feeds back into scheduler
+decisions, so a soak runs byte-identical with the recorder on or off
+(pinned by the chaos build-matrix axis).  See ``docs/observability.md``,
+"Flight recorder & postmortems".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import deque
+from typing import Any, Dict, Optional, Tuple
+
+POSTMORTEM_ENV = "APEX_TPU_POSTMORTEM"
+
+# bundle member names — one place, shared with tools/postmortem.py
+MANIFEST_NAME = "manifest.json"
+FLIGHT_NAME = "flight.jsonl"
+METRICS_NAME = "metrics.json"
+TRACE_NAME = "trace.json"
+
+
+class NullFlightRecorder:
+    """The disabled recorder: ``record()`` drops everything and hot
+    paths guard record assembly on :attr:`enabled`, so serving with
+    the recorder off allocates nothing per step."""
+
+    enabled = False
+    steps_recorded = 0
+    dropped = 0
+
+    def record(self, rec) -> None:
+        pass
+
+    def records(self) -> Tuple[Dict[str, Any], ...]:
+        return ()
+
+    def clear(self) -> None:
+        pass
+
+    def dump_jsonl(self, path: str) -> str:
+        with open(path, "w"):
+            pass                    # an empty, still-parseable JSONL
+        return path
+
+
+NULL_FLIGHT_RECORDER = NullFlightRecorder()
+
+
+class FlightRecorder:
+    """Bounded ring of per-step records (plain JSON-able dicts).
+
+    Args:
+      capacity: ring bound in steps.  The default (4096) keeps the
+        last few minutes of a busy server for a few MB of host memory;
+        a soak that wants the whole run sizes it to its iteration
+        count.
+    """
+
+    enabled = True
+
+    def __init__(self, capacity: int = 4096):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._ring = deque(maxlen=self.capacity)
+        self._recorded = 0
+
+    def record(self, rec: Dict[str, Any]) -> None:
+        """Append one step record (newest wins when the ring is
+        full)."""
+        self._recorded += 1
+        self._ring.append(rec)
+
+    @property
+    def steps_recorded(self) -> int:
+        """Steps recorded since construction or :meth:`clear` —
+        including those the ring has since evicted."""
+        return self._recorded
+
+    @property
+    def dropped(self) -> int:
+        """Records evicted by the ring bound."""
+        return self._recorded - len(self._ring)
+
+    def records(self) -> Tuple[Dict[str, Any], ...]:
+        return tuple(self._ring)
+
+    def clear(self) -> None:
+        self._ring.clear()
+        self._recorded = 0
+
+    def dump_jsonl(self, path: str) -> str:
+        """Write the ring as JSON lines (oldest first); returns
+        ``path``."""
+        with open(path, "w") as f:
+            for rec in self._ring:
+                f.write(json.dumps(rec, sort_keys=True) + "\n")
+        return path
+
+
+def write_postmortem(dirpath: str, *, recorder, registry=None,
+                     tracer=None, reason: str = "on_demand",
+                     extra: Optional[Dict[str, Any]] = None) -> dict:
+    """Write a postmortem bundle into ``dirpath`` (created if needed)
+    and return its manifest dict.
+
+    A bundle is four files that cross-reconcile
+    (``tools/postmortem.py --assert-complete``):
+
+    - ``flight.jsonl`` — the recorder ring, one step record per line;
+    - ``metrics.json`` — ``registry.snapshot()`` at dump time (``{}``
+      without a registry);
+    - ``trace.json`` — the tracer's Chrome trace (an empty but valid
+      trace when tracing is off, so every bundle parses the same way);
+    - ``manifest.json`` — ``reason``, step accounting
+      (``steps_recorded`` / ``steps_in_bundle`` / ``steps_dropped``),
+      the member file names, and any caller ``extra`` (chaos injection
+      counts, the violated invariant, ...).
+    """
+    os.makedirs(dirpath, exist_ok=True)
+    recorder.dump_jsonl(os.path.join(dirpath, FLIGHT_NAME))
+    snapshot = registry.snapshot() if registry is not None else {}
+    with open(os.path.join(dirpath, METRICS_NAME), "w") as f:
+        json.dump(snapshot, f, sort_keys=True)
+        f.write("\n")
+    trace_path = os.path.join(dirpath, TRACE_NAME)
+    if tracer is not None and tracer.enabled:
+        tracer.export_chrome(trace_path)
+    else:
+        with open(trace_path, "w") as f:
+            json.dump({"traceEvents": [], "displayTimeUnit": "ms"}, f)
+            f.write("\n")
+    manifest = {
+        "reason": reason,
+        "steps_recorded": recorder.steps_recorded,
+        "steps_in_bundle": len(recorder.records()),
+        "steps_dropped": recorder.dropped,
+        "files": {"flight": FLIGHT_NAME, "metrics": METRICS_NAME,
+                  "trace": TRACE_NAME},
+    }
+    if extra:
+        manifest["extra"] = extra
+    with open(os.path.join(dirpath, MANIFEST_NAME), "w") as f:
+        json.dump(manifest, f, sort_keys=True, indent=2)
+        f.write("\n")
+    return manifest

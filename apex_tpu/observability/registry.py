@@ -48,13 +48,22 @@ def _label_items(labels: Dict[str, Any]) -> LabelItems:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
+def escape_label_value(value: str) -> str:
+    """Prometheus text-format label-value escaping (v0.0.4): backslash,
+    double quote, and newline must be escaped or the exposition line is
+    unparseable — a label value carrying a path or an error message
+    would otherwise corrupt the whole scrape."""
+    return (value.replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
 def series_key(name: str, labels: LabelItems = ()) -> str:
     """Prometheus-style series identity: ``name{k="v",...}`` with
-    labels sorted (``name`` alone when unlabeled) — the snapshot /
-    diff / exposition key."""
+    labels sorted and values escaped (``name`` alone when unlabeled) —
+    the snapshot / diff / exposition key."""
     if not labels:
         return name
-    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    inner = ",".join(f'{k}="{escape_label_value(v)}"' for k, v in labels)
     return f"{name}{{{inner}}}"
 
 
@@ -254,7 +263,14 @@ class MetricsRegistry:
         self._clock = clock
         self._metrics: Dict[Tuple[str, LabelItems], Any] = {}
         self._kinds: Dict[str, str] = {}
+        self._help: Dict[str, str] = {}
         self._lock = threading.Lock()
+
+    def set_help(self, name: str, text: str) -> None:
+        """Help text for metric family ``name``, emitted as the
+        family's ``# HELP`` line by :meth:`prometheus_text` (a default
+        is synthesized when unset)."""
+        self._help[name] = str(text)
 
     # -- get-or-create ----------------------------------------------------
 
@@ -318,13 +334,23 @@ class MetricsRegistry:
     def prometheus_text(self) -> str:
         """Prometheus text exposition (v0.0.4): counters and gauges as
         single series, histograms as cumulative ``_bucket{le=...}`` +
-        ``_sum`` / ``_count`` families."""
+        ``_sum`` / ``_count`` families.  Each family gets exactly one
+        ``# HELP`` and one ``# TYPE`` line (set text via
+        :meth:`set_help`; a default is synthesized), and label values
+        are escaped per the format spec — conformance is pinned by a
+        line-parsing test in ``tests/L0/test_observability.py``."""
         by_name: Dict[str, list] = {}
         for m in self.metrics():
             by_name.setdefault(m.name, []).append(m)
         lines = []
         for name in sorted(by_name):
             kind = self._kinds[name]
+            help_text = self._help.get(name, f"apex_tpu {kind} {name}")
+            # HELP escaping differs from label values: only backslash
+            # and newline (quotes are legal in help text)
+            help_text = (help_text.replace("\\", r"\\")
+                         .replace("\n", r"\n"))
+            lines.append(f"# HELP {name} {help_text}")
             lines.append(f"# TYPE {name} {kind}")
             for m in sorted(by_name[name], key=lambda m: m.labels):
                 if kind == "counter":
@@ -356,10 +382,20 @@ def snapshot_diff(old: Dict[str, Dict[str, Any]],
                   ) -> Dict[str, Dict[str, Any]]:
     """Per-series delta between two :meth:`MetricsRegistry.snapshot`
     readings taken new-after-old: counters and histogram count/sum
-    report the increment (monotonic — a negative delta means the
-    snapshots were passed in the wrong order and raises), gauges
-    report the newer value.  Series absent from ``old`` diff against
-    zero."""
+    report the increment, gauges report the newer value.  Series
+    absent from ``old`` diff against zero.
+
+    A monotonic value that went *backwards* between the readings means
+    the metric was reset in between (``reset_meters()`` after a warmup
+    window, a histogram ``reset()``) — the pre-reset history is gone,
+    so the increment since the reset is at most the new value.  The
+    diff CLAMPS to that (``delta = new value``, counting from zero)
+    and flags the series with ``"reset": True`` instead of raising, so
+    a windowed measurement across a reset degrades to an explicit
+    partial answer rather than an exception.  (Passing the snapshots
+    in the wrong order produces the same signature — every monotonic
+    series flagged — which the flag makes visible rather than
+    silently negative.)"""
     out: Dict[str, Dict[str, Any]] = {}
     for key, desc in new.items():
         prev = old.get(key, {})
@@ -367,18 +403,26 @@ def snapshot_diff(old: Dict[str, Dict[str, Any]],
         if kind == "counter":
             delta = desc["value"] - prev.get("value", 0)
             if delta < 0:
-                raise ValueError(
-                    f"counter {key} went backwards ({prev.get('value')}"
-                    f" -> {desc['value']}): snapshots out of order?")
-            out[key] = {"type": "counter", "delta": delta}
+                out[key] = {"type": "counter", "delta": desc["value"],
+                            "reset": True}
+            else:
+                out[key] = {"type": "counter", "delta": delta}
         elif kind == "histogram":
             dc = desc["count"] - prev.get("count", 0)
             if dc < 0:
-                raise ValueError(
-                    f"histogram {key} count went backwards: snapshots "
-                    f"out of order?")
-            out[key] = {"type": "histogram", "count_delta": dc,
-                        "sum_delta": desc["sum"] - prev.get("sum", 0.0)}
+                out[key] = {"type": "histogram",
+                            "count_delta": desc["count"],
+                            "sum_delta": desc["sum"], "reset": True}
+            else:
+                out[key] = {"type": "histogram", "count_delta": dc,
+                            "sum_delta": desc["sum"]
+                            - prev.get("sum", 0.0)}
         else:
-            out[key] = {"type": "gauge", "value": desc["value"]}
+            d = {"type": "gauge", "value": desc["value"]}
+            # a gauge's cumulative sample count only moves backwards
+            # on reset — flag it so avg/peak readers know the window
+            # restarted
+            if desc.get("count", 0) < prev.get("count", 0):
+                d["reset"] = True
+            out[key] = d
     return out

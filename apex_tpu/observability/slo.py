@@ -1,0 +1,238 @@
+"""SLO attainment and goodput accounting for the serving stack.
+
+Throughput counts every token; an operator serving millions of users
+cares about **goodput** — tokens delivered *within* the latency
+contract of their priority class.  A server can post a flattering
+tokens/s while every foreground request blows its TTFT budget; the
+inverse (shedding best-effort work to protect foreground SLOs) looks
+like lost throughput but is exactly what the overload policy is paid
+to do.  This module makes that distinction first-class:
+
+- :class:`SLOTargets` — the per-priority-class contract: TTFT bound,
+  per-token decode-latency bound (the ``decode_token_s`` derived
+  metric of ``Request.timeline()``), both optional (``None`` = no
+  latency bound; only healthy completion and deadline attainment
+  count).
+- :class:`SLOPolicy` — targets per priority class with a default for
+  unlisted classes.
+- :class:`SLOTracker` — fed every finished :class:`Request` by
+  ``InferenceServer._finalize_finished``; classifies it met/missed
+  against its class targets, accumulates goodput-vs-throughput token
+  counters, keeps per-class attainment gauges in the shared
+  :class:`MetricsRegistry` (``serving_slo_attainment{priority=...}``),
+  and accounts **SLO debt** — the work the overload policy's
+  shed/displace decisions gave up (requests shed per class, tokens of
+  unearned budget) — so "how much did protecting the SLO cost" is a
+  counter, not a guess.  Surfaced as ``stats()["slo"]``.
+
+Classification rules (one request, against its class targets):
+
+- a request is **attained** iff it finished healthy (``eos`` /
+  ``length``) AND its TTFT and per-token decode latency are within
+  any configured bounds;
+- **deadline attainment** is tracked separately: a ``timeout`` finish
+  is a deadline miss, everything else a hold;
+- shed / rejected / breaker_open / draining requests are *not* SLO
+  misses — they were refused, not served late — but shed work is
+  charged to the debt counters.
+
+Everything is host-side integer/float bookkeeping at request-finish
+granularity; the step loop never touches it.
+See ``docs/observability.md``, "SLO & goodput".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+__all__ = ["SLOTargets", "SLOPolicy", "SLOTracker", "HEALTHY_REASONS"]
+
+# the two healthy terminals (mirrors resilience.chaos.HEALTHY_REASONS,
+# duplicated here so observability never imports resilience)
+HEALTHY_REASONS = frozenset({"eos", "length"})
+
+# front-door refusals: never admitted (or given up at the door), so
+# they are debt/refusal accounting, not latency-SLO misses
+REFUSED_REASONS = frozenset({"rejected", "shed", "breaker_open",
+                             "draining"})
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOTargets:
+    """Latency contract of one priority class.  ``None`` disables the
+    corresponding bound (the request then only needs a healthy finish
+    — and to hold its deadline — to count as attained)."""
+
+    ttft_s: Optional[float] = None
+    decode_token_s: Optional[float] = None
+
+    def __post_init__(self):
+        for name in ("ttft_s", "decode_token_s"):
+            v = getattr(self, name)
+            if v is not None and v <= 0:
+                raise ValueError(f"{name} must be > 0, got {v}")
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOPolicy:
+    """Targets per priority class; unlisted classes fall back to
+    ``default``.  The stock default has no latency bounds — attainment
+    then measures healthy completion and deadline holds, which is
+    always meaningful; deployments pin real budgets per class."""
+
+    targets: Dict[int, SLOTargets] = dataclasses.field(
+        default_factory=dict)
+    default: SLOTargets = dataclasses.field(default_factory=SLOTargets)
+
+    def for_priority(self, priority: int) -> SLOTargets:
+        return self.targets.get(priority, self.default)
+
+
+class _ClassStats:
+    """Per-priority-class tallies (plain ints — snapshot-friendly)."""
+
+    __slots__ = ("requests", "attained", "ttft_met", "ttft_missed",
+                 "decode_met", "decode_missed", "deadline_missed",
+                 "shed_requests", "shed_tokens")
+
+    def __init__(self):
+        self.requests = 0           # served terminals (not refused)
+        self.attained = 0
+        self.ttft_met = 0
+        self.ttft_missed = 0
+        self.decode_met = 0
+        self.decode_missed = 0
+        self.deadline_missed = 0
+        self.shed_requests = 0
+        self.shed_tokens = 0
+
+
+class SLOTracker:
+    """Accumulates SLO attainment, goodput, and shed debt.
+
+    Args:
+      policy: the :class:`SLOPolicy` to classify against.
+      registry: optional :class:`MetricsRegistry`; when given,
+        per-class attainment gauges
+        (``serving_slo_attainment{priority=...}``) and the goodput /
+        throughput counters live there too, so one Prometheus scrape
+        carries the SLO surface.
+    """
+
+    def __init__(self, policy: Optional[SLOPolicy] = None,
+                 registry=None):
+        self.policy = policy if policy is not None else SLOPolicy()
+        self._registry = registry
+        self._classes: Dict[int, _ClassStats] = {}
+        self.goodput_tokens = 0
+        self.total_tokens = 0
+        if registry is not None:
+            self._goodput_c = registry.counter("serving_goodput_tokens")
+            self._total_c = registry.counter("serving_served_tokens")
+        else:
+            self._goodput_c = self._total_c = None
+
+    def _class(self, priority: int) -> _ClassStats:
+        cs = self._classes.get(priority)
+        if cs is None:
+            cs = self._classes[priority] = _ClassStats()
+        return cs
+
+    # -- observation --------------------------------------------------------
+
+    def observe(self, req) -> bool:
+        """Classify one finished :class:`serving.scheduler.Request`;
+        returns whether it attained its class SLO.  Refused requests
+        (shed / rejected / breaker_open / draining) route to the debt
+        side instead and return False."""
+        if req.finish_reason in REFUSED_REASONS:
+            if req.finish_reason == "shed":
+                self.note_shed(req)
+            return False
+        cs = self._class(req.priority)
+        cs.requests += 1
+        tokens = len(req.generated)
+        self.total_tokens += tokens
+        if self._total_c is not None and tokens:
+            self._total_c.incr(tokens)
+        targets = self.policy.for_priority(req.priority)
+        tl = req.timeline()
+        met = req.finish_reason in HEALTHY_REASONS
+        if req.finish_reason == "timeout":
+            cs.deadline_missed += 1
+        if targets.ttft_s is not None and "ttft_s" in tl:
+            if tl["ttft_s"] <= targets.ttft_s:
+                cs.ttft_met += 1
+            else:
+                cs.ttft_missed += 1
+                met = False
+        if targets.decode_token_s is not None and "decode_token_s" in tl:
+            if tl["decode_token_s"] <= targets.decode_token_s:
+                cs.decode_met += 1
+            else:
+                cs.decode_missed += 1
+                met = False
+        if met:
+            cs.attained += 1
+            self.goodput_tokens += tokens
+            if self._goodput_c is not None and tokens:
+                self._goodput_c.incr(tokens)
+        if self._registry is not None:
+            self._registry.gauge(
+                "serving_slo_attainment",
+                priority=str(req.priority),
+            ).update(cs.attained / cs.requests)
+        return met
+
+    def note_shed(self, req) -> int:
+        """Charge one shed/displaced request to the debt counters;
+        returns the token debt (the unearned remainder of its
+        budget)."""
+        debt = max(0, req.max_new_tokens - len(req.generated))
+        cs = self._class(req.priority)
+        cs.shed_requests += 1
+        cs.shed_tokens += debt
+        return debt
+
+    # -- surface ------------------------------------------------------------
+
+    @property
+    def goodput_ratio(self) -> float:
+        return (self.goodput_tokens / self.total_tokens
+                if self.total_tokens else 0.0)
+
+    def as_stats(self) -> dict:
+        """The ``stats()["slo"]`` block: goodput vs throughput plus
+        per-class attainment and debt (``docs/observability.md``)."""
+        by_priority = {}
+        for p in sorted(self._classes):
+            cs = self._classes[p]
+            t = self.policy.for_priority(p)
+            by_priority[p] = {
+                "requests": cs.requests,
+                "attained": cs.attained,
+                "attainment": round(cs.attained / cs.requests, 3)
+                if cs.requests else 0.0,
+                "ttft_target_s": t.ttft_s,
+                "ttft_met": cs.ttft_met,
+                "ttft_missed": cs.ttft_missed,
+                "decode_token_target_s": t.decode_token_s,
+                "decode_met": cs.decode_met,
+                "decode_missed": cs.decode_missed,
+                "deadline_missed": cs.deadline_missed,
+                "shed_requests": cs.shed_requests,
+                "shed_tokens": cs.shed_tokens,
+            }
+        return {
+            "goodput_tokens": self.goodput_tokens,
+            "total_tokens": self.total_tokens,
+            "goodput_ratio": round(self.goodput_ratio, 3),
+            "by_priority": by_priority,
+            "debt": {
+                "shed_requests": sum(c.shed_requests
+                                     for c in self._classes.values()),
+                "shed_tokens": sum(c.shed_tokens
+                                   for c in self._classes.values()),
+            },
+        }

@@ -36,6 +36,7 @@ import back would cycle) — the server is passed in via factories.
 from __future__ import annotations
 
 import dataclasses
+import os
 import random
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
@@ -112,6 +113,16 @@ class ChaosConfig:
     oom_burst: Tuple[int, int] = (1, 3)
     crash_every: int = 500          # one FaultPlan InjectedCrash per
     #                                 ~N iterations (0 = off)
+
+    # forced invariant violation (the postmortem build-matrix axis,
+    # docs/observability.md): at the first iteration >= this with a
+    # finished request, the soak deliberately corrupts the terminal
+    # bookkeeping (re-appends an already-finished request) so the
+    # finished-twice invariant MUST trip — proving the violation
+    # detector and the postmortem auto-dump end-to-end.  None (the
+    # default) draws no RNG, so legacy (config, seed) schedules stay
+    # byte-identical.
+    force_violation_iter: Optional[int] = None
 
     def __post_init__(self):
         if self.iters < 1:
@@ -292,7 +303,8 @@ class ChaosEngine:
 
 def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
              make_replay: Optional[Callable] = None,
-             log: Callable[[str], None] = lambda s: None) -> dict:
+             log: Callable[[str], None] = lambda s: None,
+             postmortem_dir: Optional[str] = None) -> dict:
     """Drive a full server through the chaos schedule, asserting the
     global invariants; returns a report dict (raises AssertionError
     with context on the first violation).
@@ -304,6 +316,15 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
     for a given ``(cfg, seed)``.  ``make_replay(clock)`` (default:
     ``make_server``) builds the unfaulted replay server — typically
     with a roomy pool so replays never hit capacity.
+
+    ``postmortem_dir``: when set, ANY invariant violation dumps a
+    postmortem bundle (``docs/observability.md``, "Flight recorder &
+    postmortems") to ``<postmortem_dir>/invariant_violation`` — the
+    soaked server's flight-recorder ring, metrics snapshot, and trace
+    at the moment of the violation, plus the chaos injection counts —
+    before re-raising with the bundle path appended.  Build the server
+    with a ``FlightRecorder`` (``tools/chaos_soak.py`` does) or the
+    bundle's flight log is empty.
 
     Invariants, per step:
       1. scheduler/allocator/prefix-cache ``audit()`` passes;
@@ -343,43 +364,68 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
                 f"request {req.uid} finished without finished_at"
             terminal[req.uid] = req.finish_reason
 
-    for i in range(cfg.iters):
-        clock_state["t"] = float(i)
-        for a in schedule.arrivals.get(i, ()):
-            req = server.submit(list(a.prompt), a.max_new_tokens,
-                                priority=a.priority,
-                                deadline_iters=a.deadline_iters,
-                                deadline_s=a.deadline_s)
-            tracked[req.uid] = (req, a)
-        try:
-            chaos.begin_iter(i)
-            server.step()
-        except InjectedCrash:
-            # a FaultPlan crash between engine steps: nothing was
-            # half-applied, so the very next iteration carries on
-            report["crashes_caught"] += 1
-        sched.audit()                                   # invariant 1
-        absorb_finished()
-        for req in sched.waiting:
-            assert not req.finished, \
-                f"finished request {req.uid} still waiting"
-        for req in sched.running.values():
-            assert not req.finished, \
-                f"finished request {req.uid} still in the batch"
-        if i and i % 500 == 0:
-            log(f"iter {i}: {len(terminal)}/{len(tracked)} terminal, "
-                f"pressure={sched.pressure():.2f}, "
-                f"breaker={server.breaker.state}")
+    def _postmortem_and_reraise(e: AssertionError):
+        """Invariant tripped: preserve the black box (the soaked
+        server's flight ring + metrics + trace) before propagating."""
+        if postmortem_dir is None:
+            raise e
+        bundle = os.path.join(postmortem_dir, "invariant_violation")
+        server.dump_postmortem(
+            bundle, reason="invariant_violation",
+            extra={"error": str(e), "seed": seed,
+                   "injected": dict(chaos.injected)})
+        log(f"postmortem bundle written: {bundle}")
+        raise AssertionError(f"{e} [postmortem: {bundle}]") from e
 
-    clock_state["t"] = float(cfg.iters)
-    chaos.begin_iter(cfg.iters)     # past the schedule: drain unfaulted
-    server.drain()
-    sched.audit()
-    absorb_finished()
-    for uid, (req, _) in tracked.items():               # invariant 4
-        assert req.finished and uid in terminal, \
-            f"request {uid} never reached a terminal state"
-    assert not sched.has_work, "drained server still has work"
+    try:
+        forced = False
+        for i in range(cfg.iters):
+            clock_state["t"] = float(i)
+            for a in schedule.arrivals.get(i, ()):
+                req = server.submit(list(a.prompt), a.max_new_tokens,
+                                    priority=a.priority,
+                                    deadline_iters=a.deadline_iters,
+                                    deadline_s=a.deadline_s)
+                tracked[req.uid] = (req, a)
+            try:
+                chaos.begin_iter(i)
+                server.step()
+            except InjectedCrash:
+                # a FaultPlan crash between engine steps: nothing was
+                # half-applied, so the very next iteration carries on
+                report["crashes_caught"] += 1
+            if (cfg.force_violation_iter is not None and not forced
+                    and i >= cfg.force_violation_iter and sched.finished):
+                # deliberately corrupt the terminal bookkeeping: the
+                # duplicate MUST trip absorb_finished's finished-twice
+                # invariant (the postmortem axis proves detection +
+                # bundle dump end-to-end)
+                sched.finished.append(sched.finished[0])
+                forced = True
+            sched.audit()                               # invariant 1
+            absorb_finished()
+            for req in sched.waiting:
+                assert not req.finished, \
+                    f"finished request {req.uid} still waiting"
+            for req in sched.running.values():
+                assert not req.finished, \
+                    f"finished request {req.uid} still in the batch"
+            if i and i % 500 == 0:
+                log(f"iter {i}: {len(terminal)}/{len(tracked)} "
+                    f"terminal, pressure={sched.pressure():.2f}, "
+                    f"breaker={server.breaker.state}")
+
+        clock_state["t"] = float(cfg.iters)
+        chaos.begin_iter(cfg.iters)  # past the schedule: drain unfaulted
+        server.drain()
+        sched.audit()
+        absorb_finished()
+        for uid, (req, _) in tracked.items():           # invariant 4
+            assert req.finished and uid in terminal, \
+                f"request {uid} never reached a terminal state"
+        assert not sched.has_work, "drained server still has work"
+    except AssertionError as e:
+        _postmortem_and_reraise(e)
 
     # invariant 5: bit-exact healthy outputs / prefixes vs an
     # unfaulted replay of the same prompts (greedy decoding makes the
@@ -398,44 +444,49 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
         for key, out in zip(keys, outs):
             outputs[key] = out
     checked = prefix_checked = 0
-    for req, a in tracked.values():
-        ref = outputs[(a.prompt, req.max_new_tokens)]
-        if req.finish_reason in HEALTHY_REASONS:
-            assert list(req.generated) == ref, \
-                (f"healthy request {req.uid} diverged from replay: "
-                 f"{req.generated} != {ref}")
-            checked += 1
-        elif req.generated:
-            assert list(req.generated) == ref[:len(req.generated)], \
-                (f"{req.finish_reason} request {req.uid}'s partial "
-                 f"output is not a prefix of the replay")
-            prefix_checked += 1
+    try:
+        for req, a in tracked.values():
+            ref = outputs[(a.prompt, req.max_new_tokens)]
+            if req.finish_reason in HEALTHY_REASONS:
+                assert list(req.generated) == ref, \
+                    (f"healthy request {req.uid} diverged from replay: "
+                     f"{req.generated} != {ref}")
+                checked += 1
+            elif req.generated:
+                assert list(req.generated) == ref[:len(req.generated)], \
+                    (f"{req.finish_reason} request {req.uid}'s partial "
+                     f"output is not a prefix of the replay")
+                prefix_checked += 1
 
-    # invariant 6: counters reconcile with observed outcomes
-    stats = server.stats()
-    tally: Dict[str, int] = {}
-    for reason in terminal.values():
-        tally[reason] = tally.get(reason, 0) + 1
-    assert stats["requests_finished"] == len(terminal), \
-        (f"stats requests_finished={stats['requests_finished']} != "
-         f"{len(terminal)} observed")
-    failure_tally = {r: n for r, n in tally.items()
-                     if r not in HEALTHY_REASONS}
-    for reason, n in failure_tally.items():
-        got = stats["requests_failed"].get(
-            f"requests_failed_{reason}", 0)
-        assert got == n, \
-            (f"counter requests_failed_{reason}={got} != {n} observed")
-    assert stats["requests_failed_total"] == sum(failure_tally.values())
-    breaker_rejects = stats["breaker_events"].get(
-        "breaker_rejections", 0)
-    assert breaker_rejects == tally.get("breaker_open", 0), \
-        (f"breaker counted {breaker_rejects} rejections, observed "
-         f"{tally.get('breaker_open', 0)} breaker_open finishes")
-    assert stats["oom_events"] == chaos.injected["oom"], \
-        (f"server counted {stats['oom_events']} OOM events, chaos "
-         f"injected {chaos.injected['oom']}")
-    assert report["crashes_caught"] == chaos.injected["crashes"]
+        # invariant 6: counters reconcile with observed outcomes
+        stats = server.stats()
+        tally: Dict[str, int] = {}
+        for reason in terminal.values():
+            tally[reason] = tally.get(reason, 0) + 1
+        assert stats["requests_finished"] == len(terminal), \
+            (f"stats requests_finished={stats['requests_finished']} != "
+             f"{len(terminal)} observed")
+        failure_tally = {r: n for r, n in tally.items()
+                         if r not in HEALTHY_REASONS}
+        for reason, n in failure_tally.items():
+            got = stats["requests_failed"].get(
+                f"requests_failed_{reason}", 0)
+            assert got == n, \
+                (f"counter requests_failed_{reason}={got} != {n} "
+                 f"observed")
+        assert stats["requests_failed_total"] == \
+            sum(failure_tally.values())
+        breaker_rejects = stats["breaker_events"].get(
+            "breaker_rejections", 0)
+        assert breaker_rejects == tally.get("breaker_open", 0), \
+            (f"breaker counted {breaker_rejects} rejections, observed "
+             f"{tally.get('breaker_open', 0)} breaker_open finishes")
+        assert stats["oom_events"] == chaos.injected["oom"], \
+            (f"server counted {stats['oom_events']} OOM events, chaos "
+             f"injected {chaos.injected['oom']}")
+        assert report["crashes_caught"] == chaos.injected["crashes"]
+    except AssertionError as e:
+        _postmortem_and_reraise(e)
 
     report.update(
         submitted=len(tracked),
@@ -454,5 +505,8 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
         drafted_tokens=stats["speculation"]["drafted_tokens"],
         tokens_per_engine_step=stats["speculation"][
             "tokens_per_engine_step"],
+        flight_steps=stats["flight"]["steps_recorded"],
+        goodput_ratio=stats["slo"]["goodput_ratio"],
+        kv_live_peak=stats["memory"]["blocks_live_peak"],
     )
     return report

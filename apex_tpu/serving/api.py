@@ -16,6 +16,19 @@ queue-wait, and per-token decode-latency histograms surfaced in
 chunk-prefill / decode / evict / preempt spans plus request-lifecycle
 and engine-compile instants into a Perfetto-loadable Chrome trace.
 
+Deep observability (``docs/observability.md``): an opt-in step-level
+flight recorder (``flight_recorder=`` / ``postmortem_dir=`` /
+``APEX_TPU_POSTMORTEM``; zero-allocation null when off) captures one
+structured record per iteration — batch composition,
+admit/shed/preempt/evict decisions, memory occupancy, speculation
+outcomes, pressure, breaker state — and postmortem bundles (flight
+JSONL + metrics snapshot + Chrome trace) dump on demand
+(:meth:`InferenceServer.dump_postmortem`), on breaker-open
+transitions, and on :meth:`InferenceServer.audit` failure;
+``stats()["slo"]`` tracks per-priority-class SLO attainment and
+goodput vs throughput, and ``stats()["memory"]`` the KV pool's
+free/live/evictable occupancy, high-watermarks, and fragmentation.
+
 ``generate()`` is batch-synchronous (submit N prompts, run the loop to
 completion, return N completions) — the shape every test and bench
 needs.  A live service would run :meth:`step` on its event loop and
@@ -70,12 +83,22 @@ exactly once and makes further submission an error.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from apex_tpu.observability import MetricsRegistry, get_tracer
+from apex_tpu.observability import (
+    NULL_FLIGHT_RECORDER,
+    POSTMORTEM_ENV,
+    FlightRecorder,
+    MetricsRegistry,
+    SLOPolicy,
+    SLOTracker,
+    get_tracer,
+    write_postmortem,
+)
 from apex_tpu.resilience.breaker import CircuitBreaker
 from apex_tpu.serving.engine import DecodeEngine
 from apex_tpu.serving.overload import OverloadPolicy
@@ -213,6 +236,26 @@ class InferenceServer:
         preempt) and per-request lifecycle instants; default is the
         process tracer (``APEX_TPU_TRACE`` turns it on, else a
         zero-overhead no-op — ``docs/observability.md``).
+      slo_policy: per-priority-class SLO targets
+        (:class:`observability.SLOPolicy`) behind the
+        ``stats()["slo"]`` attainment/goodput block; the stock policy
+        has no latency bounds (attainment = healthy completion +
+        deadline holds) — pin real TTFT/decode budgets per class to
+        make goodput mean something (``docs/observability.md``,
+        "SLO & goodput").
+      flight_recorder: a
+        :class:`observability.FlightRecorder` enabling step-level
+        postmortem capture — one structured record per :meth:`step`
+        (batch composition, admit/shed/preempt/evict decisions,
+        memory occupancy, speculation outcomes, pressure, breaker
+        state) in a bounded ring.  Default: a fresh recorder when
+        ``postmortem_dir`` (or ``APEX_TPU_POSTMORTEM``) is set, else
+        the zero-allocation ``NULL_FLIGHT_RECORDER``.
+      postmortem_dir: where auto-dumped postmortem bundles land
+        (breaker-open transitions, :meth:`audit` failures; chaos-soak
+        invariant violations via :func:`resilience.chaos.run_soak`).
+        ``APEX_TPU_POSTMORTEM=/dir`` is the env twin.  On-demand
+        bundles go wherever :meth:`dump_postmortem` is pointed.
 
     Example::
 
@@ -242,10 +285,24 @@ class InferenceServer:
                  enable_breaker: bool = True,
                  breaker: Optional[CircuitBreaker] = None,
                  registry: Optional[MetricsRegistry] = None,
-                 tracer=None):
+                 tracer=None,
+                 slo_policy: Optional[SLOPolicy] = None,
+                 flight_recorder: Optional[FlightRecorder] = None,
+                 postmortem_dir: Optional[str] = None):
         self.registry = registry if registry is not None \
             else MetricsRegistry()
         self.tracer = tracer if tracer is not None else get_tracer()
+        # flight recorder (docs/observability.md, "Flight recorder &
+        # postmortems"): explicitly passed, or resolved on by a
+        # postmortem destination, else the zero-allocation null
+        self._postmortem_dir = (postmortem_dir
+                                or os.environ.get(POSTMORTEM_ENV))
+        if flight_recorder is not None:
+            self.recorder = flight_recorder
+        else:
+            self.recorder = (FlightRecorder() if self._postmortem_dir
+                             else NULL_FLIGHT_RECORDER)
+        self.slo = SLOTracker(slo_policy, registry=self.registry)
         self.engine = DecodeEngine(
             cfg, params, max_batch_size=max_batch_size,
             max_context=max_context, num_blocks=num_blocks,
@@ -340,8 +397,24 @@ class InferenceServer:
         # per-priority-class queue-wait distributions, materialized as
         # classes are first seen (labeled series of the same metric)
         self._queue_wait_prio: Dict[int, object] = {}
+        # memory observability (docs/observability.md, "Memory
+        # accounting"): per-step occupancy/fragmentation gauges — the
+        # current/peak/avg view behind stats()["memory"]; the flight
+        # recorder carries the per-step time series
+        self.mem_live = GaugeMeter(registry=self.registry,
+                                   name="serving_kv_live_blocks")
+        self.mem_free = GaugeMeter(registry=self.registry,
+                                   name="serving_kv_free_blocks")
+        self.mem_evictable = GaugeMeter(
+            registry=self.registry, name="serving_kv_evictable_blocks")
+        self.mem_frag = GaugeMeter(registry=self.registry,
+                                   name="serving_kv_frag_slots")
         self._iter = 0              # scheduler iterations served
         self._finalized = 0         # scheduler.finished timeline cursor
+        self._rec_cursor = 0        # flight-recorder finished cursor
+        self._last_breaker_state = (self.breaker.state
+                                    if self.breaker is not None
+                                    else "disabled")
 
     # -- request lifecycle ------------------------------------------------
 
@@ -456,9 +529,20 @@ class InferenceServer:
         (retried bit-identically) — no exception escapes the step
         loop for them."""
         sched, engine, tr = self.scheduler, self.engine, self.tracer
+        rec = self.recorder
         self._iter += 1
         produced = 0
         step_start = self.clock()
+        if rec.enabled:
+            # pre-step marks for the flight record's per-step deltas
+            # (plain int binds — the disabled path skips even these)
+            preempt0 = sched.preemption_count
+            lk_grant0 = sched.lookahead_granted
+            lk_roll0 = sched.lookahead_rolled_back
+            evict0 = self.prefix.count("prefix_evicted_blocks")
+            oom0 = self.oom.total
+            drafted0 = self.spec.count("drafted_tokens")
+            accepted0 = self.spec.count("accepted_tokens")
         self._expire_deadlines()
 
         # overload: record the pressure signal at its pre-shed peak,
@@ -566,8 +650,74 @@ class InferenceServer:
         self.queue_depth.update(sched.num_waiting)
         self.occupancy.update(sched.num_running
                               / self.engine.max_batch_size)
-        self.step_time.record(self.clock() - step_start)
+        step_s = self.clock() - step_start
+        self.step_time.record(step_s)
         self._finalize_finished()
+        # memory occupancy gauges (docs/observability.md, "Memory
+        # accounting") — sampled once per step like queue depth
+        alloc = engine.allocator
+        self.mem_live.update(alloc.num_live)
+        self.mem_free.update(alloc.num_free)
+        self.mem_evictable.update(
+            self.prefix_cache.num_evictable
+            if self.prefix_cache is not None else 0)
+        self.mem_frag.update(sched.frag_slots())
+        if rec.enabled:
+            fin = sched.finished
+            finished_now = [
+                {"uid": r.uid, "reason": r.finish_reason,
+                 "tokens": len(r.generated)}
+                for r in fin[self._rec_cursor:]]
+            self._rec_cursor = len(fin)
+            rec.record({
+                "iter": self._iter,
+                "produced": produced,
+                "waiting": sched.num_waiting,
+                "running": [r.uid for r in sched._admit_order],
+                "prefilling": [r.uid for r in sched._admit_order
+                               if r.prefilling],
+                "admitted": [r.uid for r in admitted],
+                "shed": [{"uid": r.uid, "priority": r.priority,
+                          "debt_tokens":
+                          OverloadPolicy.slo_debt_tokens(r)}
+                         for r in shed],
+                "finished": finished_now,
+                "preemptions": sched.preemption_count - preempt0,
+                "evicted_blocks":
+                    self.prefix.count("prefix_evicted_blocks") - evict0,
+                "oom": self.oom.total - oom0,
+                "spec": {
+                    "drafted":
+                        self.spec.count("drafted_tokens") - drafted0,
+                    "accepted":
+                        self.spec.count("accepted_tokens") - accepted0,
+                },
+                "pressure": round(self.pressure_gauge.val, 4),
+                "breaker": (self.breaker.state
+                            if self.breaker is not None
+                            else "disabled"),
+                "memory": {
+                    "free": alloc.num_free,
+                    "live": alloc.num_live,
+                    "evictable": (self.prefix_cache.num_evictable
+                                  if self.prefix_cache is not None
+                                  else 0),
+                    "frag_slots": sched.frag_slots(),
+                    "lookahead_granted":
+                        sched.lookahead_granted - lk_grant0,
+                    "lookahead_rolled_back":
+                        sched.lookahead_rolled_back - lk_roll0,
+                },
+                "step_s": step_s,
+            })
+        # breaker-open transition: the moment worth a black box — dump
+        # a bundle while the ring still holds the steps leading up
+        if self.breaker is not None:
+            state = self.breaker.state
+            if state != self._last_breaker_state:
+                self._last_breaker_state = state
+                if state == "open":
+                    self._auto_postmortem("breaker_open")
         return produced
 
     def _decode_step(self, running) -> int:
@@ -800,6 +950,10 @@ class InferenceServer:
                 self.ttft.record(tl["ttft_s"])
             if "decode_token_s" in tl:
                 self.decode_latency.record(tl["decode_token_s"])
+            # SLO/goodput classification (docs/observability.md,
+            # "SLO & goodput"): served terminals count toward
+            # attainment, shed work toward the debt counters
+            self.slo.observe(req)
 
     def _queue_wait_for(self, priority: int):
         """The per-priority-class queue-wait histogram (a labeled
@@ -810,6 +964,51 @@ class InferenceServer:
                                         priority=str(priority))
             self._queue_wait_prio[priority] = h
         return h
+
+    # -- postmortems (docs/observability.md) -------------------------------
+
+    def dump_postmortem(self, path: str, *, reason: str = "on_demand",
+                        extra: Optional[dict] = None) -> dict:
+        """Write a postmortem bundle into ``path`` — the flight ring
+        as JSONL, the full metrics snapshot, the tracer's Chrome
+        trace, and a manifest — and return the manifest.  Meaningful
+        whenever the flight recorder is on (``flight_recorder=`` /
+        ``postmortem_dir=`` / ``APEX_TPU_POSTMORTEM``); with the null
+        recorder the bundle still writes but its flight log is empty.
+        Render/inspect with ``tools/postmortem.py``."""
+        merged = {"iter": self._iter,
+                  "engine": self.engine.memory_info()}
+        if extra:
+            merged.update(extra)
+        return write_postmortem(path, recorder=self.recorder,
+                                registry=self.registry,
+                                tracer=self.tracer, reason=reason,
+                                extra=merged)
+
+    def _auto_postmortem(self, reason: str,
+                         extra: Optional[dict] = None) -> Optional[str]:
+        """Dump a bundle under ``postmortem_dir`` (when configured,
+        with a live recorder) named ``<reason>_iter<N>``; returns the
+        bundle path or None when auto-capture is off."""
+        if not (self.recorder.enabled and self._postmortem_dir):
+            return None
+        path = os.path.join(self._postmortem_dir,
+                            f"{reason}_iter{self._iter}")
+        self.dump_postmortem(path, reason=reason, extra=extra)
+        return path
+
+    def audit(self) -> None:
+        """The scheduler/allocator/prefix-cache invariant audit, with
+        postmortem capture: an :class:`AssertionError` auto-dumps a
+        bundle (when ``postmortem_dir`` + recorder are configured)
+        before re-raising, so the steps leading up to the violated
+        invariant are preserved, not just the assertion text."""
+        try:
+            self.scheduler.audit()
+        except AssertionError as e:
+            self._auto_postmortem("audit_failure",
+                                  extra={"error": str(e)})
+            raise
 
     # -- front door -------------------------------------------------------
 
@@ -883,6 +1082,10 @@ class InferenceServer:
         self.pressure_gauge.reset()
         self.occupancy.reset()
         self.chunk_iters.reset()
+        self.mem_live.reset()
+        self.mem_free.reset()
+        self.mem_evictable.reset()
+        self.mem_frag.reset()
         self.ttft.reset()
         self.queue_wait.reset()
         for h in self._queue_wait_prio.values():
@@ -893,6 +1096,49 @@ class InferenceServer:
         self.spec_accepted_hist.reset()
         self.scheduler.finished.clear()
         self._finalized = 0
+        self._rec_cursor = 0
+        # the flight ring resets with the step histograms — a bundle's
+        # step accounting must reconcile against serving_step_s
+        # (tools/postmortem.py --assert-complete), so their windows
+        # have to start together
+        self.recorder.clear()
+
+    def _memory_stats(self) -> dict:
+        """The ``stats()["memory"]`` block: live/free/evictable block
+        occupancy with high-watermarks, the fragmentation gauge
+        (allocated-but-unwritten token slots), and the speculation
+        lookahead grant/rollback tallies.  Current values are read
+        straight off the allocator/cache; the flight recorder carries
+        the per-step time series behind them."""
+        alloc = self.engine.allocator
+        sched = self.scheduler
+        usable = alloc.cfg.num_blocks - 1
+        live = alloc.num_live
+        frag = sched.frag_slots()
+        info = self.engine.memory_info()
+        out = {
+            "blocks_usable": usable,
+            "blocks_free": alloc.num_free,
+            "blocks_live": live,
+            "blocks_live_peak": alloc.live_peak,
+            "blocks_evictable": (self.prefix_cache.num_evictable
+                                 if self.prefix_cache is not None
+                                 else 0),
+            "blocks_evictable_peak": (self.prefix_cache.evictable_peak
+                                      if self.prefix_cache is not None
+                                      else 0),
+            "occupancy": round(live / usable, 3),
+            "occupancy_peak": round(alloc.live_peak / usable, 3),
+            "frag_slots": frag,
+            "frag_frac": round(
+                frag / (live * self.engine.block_size), 3)
+            if live else 0.0,
+            "lookahead_granted_blocks": sched.lookahead_granted,
+            "lookahead_rolled_back_blocks": sched.lookahead_rolled_back,
+            "pool_bytes": info["pool_bytes"],
+            "cache_dtype": info["cache_dtype"],
+        }
+        return out
 
     def stats(self) -> dict:
         """Serving counters for logs and the bench harness.
@@ -908,7 +1154,12 @@ class InferenceServer:
         throughput, vs the lifetime-average ``tokens_per_s``);
         ``latency`` carries p50/p90/p99 from the TTFT / queue-wait /
         per-token-decode / step-time histograms fed by the per-request
-        timelines.  Every pre-telemetry key is preserved unchanged
+        timelines; ``slo`` is per-priority-class attainment +
+        goodput-vs-throughput + shed debt; ``memory`` is the KV-pool
+        occupancy/high-watermark/fragmentation breakdown;
+        ``trace_dropped_events`` / ``flight`` surface ring-buffer
+        loss so a truncated trace or flight log is never mistaken for
+        the full run.  Every pre-telemetry key is preserved unchanged
         (asserted in ``tests/L0/test_serving_engine.py``)."""
         self._finalize_finished()
         pre, dec = self.engine.compile_counts()
@@ -972,6 +1223,20 @@ class InferenceServer:
                 "queue_wait_by_priority_ms": {
                     p: _hist_ms(h) for p, h in
                     sorted(self._queue_wait_prio.items())},
+            },
+            # SLO attainment + goodput-vs-throughput
+            # (docs/observability.md, "SLO & goodput")
+            "slo": self.slo.as_stats(),
+            # KV memory occupancy, high-watermarks, fragmentation
+            # (docs/observability.md, "Memory accounting")
+            "memory": self._memory_stats(),
+            # ring-buffer loss accounting: a saturated tracer or
+            # recorder silently truncates history — surface it
+            "trace_dropped_events": self.tracer.dropped,
+            "flight": {
+                "enabled": self.recorder.enabled,
+                "steps_recorded": self.recorder.steps_recorded,
+                "dropped": self.recorder.dropped,
             },
         }
         if self.prefix_cache is not None:

@@ -447,6 +447,20 @@ class DecodeEngine:
         drafts and batch composition vary."""
         return self._verify_jit._cache_size()
 
+    def memory_info(self) -> dict:
+        """Static pool geometry for ``stats()["memory"]`` and
+        postmortem manifests: usable blocks, tokens per block, and the
+        pool's HBM footprint in the resolved cache dtype (both K and
+        V)."""
+        cfg = self.cache_cfg
+        return {
+            "blocks_usable": cfg.num_blocks - 1,
+            "block_size": cfg.block_size,
+            "pool_tokens": cfg.usable_tokens,
+            "pool_bytes": cfg.bytes(),
+            "cache_dtype": str(cfg.resolved_dtype()),
+        }
+
     def reset_cache(self):
         """Zero the pool and refill the allocator in place (between
         workloads; schedulers holding the allocator stay wired)."""

@@ -247,12 +247,24 @@ class BlockAllocator:
                                            -1))
         self._free_set = set(self._free)
         self._refs: Dict[int, int] = {}
+        self.live_peak = 0          # high-watermark of live blocks
         for hook in self.reset_hooks:
             hook()
 
     @property
     def num_free(self) -> int:
         return len(self._free)
+
+    @property
+    def num_live(self) -> int:
+        """Blocks currently referenced by at least one table (memory
+        observability: ``usable - num_free - num_live`` is the
+        cache-held remainder)."""
+        return len(self._refs)
+
+    def _note_live(self) -> None:
+        if len(self._refs) > self.live_peak:
+            self.live_peak = len(self._refs)
 
     def can_alloc(self, n: int) -> bool:
         return n <= len(self._free)
@@ -273,6 +285,7 @@ class BlockAllocator:
         for blk in out:
             self._free_set.discard(blk)
             self._refs[blk] = 1
+        self._note_live()
         return out
 
     def refs(self, blk: int) -> int:
@@ -296,6 +309,7 @@ class BlockAllocator:
                 f"(free={blk in self._free_set}, "
                 f"refs={self._refs.get(blk)})")
         self._refs[blk] = 1
+        self._note_live()
 
     def free(self, blocks: List[int]):
         """Drop one ref per block; blocks reaching zero return to the

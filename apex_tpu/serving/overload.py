@@ -89,3 +89,13 @@ class OverloadPolicy:
 
     def sheddable(self, priority: int) -> bool:
         return priority >= self.best_effort_priority
+
+    @staticmethod
+    def slo_debt_tokens(req) -> int:
+        """The SLO debt one shed/displace decision incurs: the
+        unearned remainder of the victim's token budget.  Stamped into
+        flight-recorder shed annotations and accumulated by
+        :class:`observability.slo.SLOTracker` — so "what did
+        protecting the SLO cost" is a counter per priority class, not
+        a guess (``docs/observability.md``, "SLO & goodput")."""
+        return max(0, req.max_new_tokens - len(req.generated))

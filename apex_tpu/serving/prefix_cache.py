@@ -70,6 +70,7 @@ class PrefixCache:
         self._key_of: Dict[int, Tuple[int, tuple]] = {}
         self._children: Dict[int, Set[int]] = {}       # block -> blocks
         self._lru: "OrderedDict[int, None]" = OrderedDict()  # evictable
+        self.evictable_peak = 0     # high-watermark of LRU holds
         allocator.release_hook = self._on_release
         allocator.reset_hooks.append(self.clear)
 
@@ -80,6 +81,8 @@ class PrefixCache:
         holds (newest at the back); unregistered blocks go free."""
         if blk in self._key_of:
             self._lru[blk] = None
+            if len(self._lru) > self.evictable_peak:
+                self.evictable_peak = len(self._lru)
             return True
         return False
 
@@ -90,6 +93,7 @@ class PrefixCache:
         self._key_of.clear()
         self._children.clear()
         self._lru.clear()
+        self.evictable_peak = 0
 
     # -- introspection ----------------------------------------------------
 

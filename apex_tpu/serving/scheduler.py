@@ -273,6 +273,12 @@ class Scheduler:
         self.running: Dict[int, Request] = {}      # slot -> request
         self._free_slots = list(range(max_batch_size - 1, -1, -1))
         self.finished: List[Request] = []
+        # memory-observability tallies (``stats()["memory"]`` and the
+        # flight recorder): lifetime preemptions and speculative
+        # lookahead blocks granted / rolled back
+        self.preemption_count = 0
+        self.lookahead_granted = 0
+        self.lookahead_rolled_back = 0
         # admission order among running requests — the preemption
         # victim is always the youngest (LIFO), which converges:
         # the oldest request monotonically keeps its blocks
@@ -558,6 +564,7 @@ class Scheduler:
             if fresh is None:
                 break
             req.block_table.extend(fresh)
+            self.lookahead_granted += 1
         return max(0, min(tokens,
                           len(req.block_table) * bs - req.num_cached))
 
@@ -576,7 +583,18 @@ class Scheduler:
             return 0
         del req.block_table[keep:]
         self.allocator.free(tail)
+        self.lookahead_rolled_back += len(tail)
         return len(tail)
+
+    def frag_slots(self) -> int:
+        """Allocated-but-unwritten token slots across running tables —
+        each request's last partial block's slack plus any lookahead
+        slack it holds this instant.  The fragmentation numerator of
+        ``stats()["memory"]`` (``docs/observability.md``): these slots
+        cost HBM but hold no K/V yet."""
+        bs = self.block_size
+        return sum(len(r.block_table) * bs - r.num_cached
+                   for r in self.running.values())
 
     def _preempt_victim(self, exclude: Request) -> Optional[Request]:
         """Priority-aware victim choice: the worst priority class
@@ -600,6 +618,7 @@ class Scheduler:
         over never-started requests), freeing its slot and blocks."""
         assert req.running, "can only preempt a running request"
         req.preemptions += 1
+        self.preemption_count += 1
         if self.tracer.enabled:
             self.tracer.instant("preempt", uid=req.uid,
                                 blocks=len(req.block_table))

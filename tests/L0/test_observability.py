@@ -7,8 +7,10 @@ Three oracles, all pure-Python and deterministic:
   ladder; the quantile estimate must land in the same bucket as the
   exact sample quantile (the estimator's construction guarantee).
 - **Snapshot/diff monotonicity** — counters and histogram counts only
-  grow between snapshots; ``snapshot_diff`` with the arguments
-  reversed must raise, not return negative deltas.
+  grow between snapshots; a monotonic series that went backwards (a
+  ``reset()`` between readings, or reversed arguments) must never
+  yield a negative delta — ``snapshot_diff`` clamps to the new value
+  and flags the series ``"reset": True``.
 - **Chrome trace validity** — exported JSON must be loadable, every
   event carries ``ph``/``ts``/``pid``/``tid``, B/E events pair up
   per thread, and with a fake clock the whole export is byte-stable.
@@ -27,11 +29,14 @@ import tracemalloc
 
 import pytest
 
+import re
+
 from apex_tpu.observability import (
     NULL_TRACER,
     HistogramMeter,
     MetricsRegistry,
     SpanTracer,
+    escape_label_value,
     series_key,
     snapshot_diff,
 )
@@ -149,15 +154,52 @@ def test_registry_snapshot_diff_monotonic():
     s2 = reg.snapshot()
     d = snapshot_diff(s1, s2)
     assert d[series_key("requests", (("outcome", "ok"),))]["delta"] == 2
+    assert "reset" not in d[series_key("requests",
+                                       (("outcome", "ok"),))]
     assert d["depth"]["value"] == 1.0            # gauges: newer value
     assert d["lat_s"]["count_delta"] == 1
     assert d["lat_s"]["sum_delta"] == pytest.approx(0.2)
-    # reversed argument order is an error, not negative deltas
-    with pytest.raises(ValueError):
-        snapshot_diff(s2, s1)
+    # reversed argument order looks like a global reset: every
+    # monotonic series clamps to its "new" value and is flagged,
+    # never a negative delta
+    dr = snapshot_diff(s2, s1)
+    key = series_key("requests", (("outcome", "ok"),))
+    assert dr[key] == {"type": "counter", "delta": 3, "reset": True}
+    assert dr["lat_s"]["reset"] is True
+    assert dr["lat_s"]["count_delta"] == 1       # clamped, not -1
     # a series absent from old diffs against zero
     d0 = snapshot_diff({}, s2)
     assert d0[series_key("requests", (("outcome", "ok"),))]["delta"] == 5
+
+
+def test_snapshot_diff_clamps_and_flags_resets():
+    """The reset_meters()-between-snapshots case (the satellite fix):
+    a counter/gauge/histogram reset between two in-order snapshots
+    must produce a clamped, flagged delta — the increment since the
+    reset — instead of a negative delta or an exception."""
+    reg = MetricsRegistry()
+    g = reg.gauge("depth")
+    h = reg.histogram("lat_s")
+    for v in (0.1, 0.2, 0.3):
+        h.record(v)
+    g.update(7)
+    g.update(5)                         # count=2: a reset is visible
+    s1 = reg.snapshot()
+    h.reset()
+    g.reset()
+    h.record(0.4)                       # one post-reset sample
+    g.update(2)
+    s2 = reg.snapshot()
+    d = snapshot_diff(s1, s2)
+    assert d["lat_s"] == {"type": "histogram", "count_delta": 1,
+                          "sum_delta": pytest.approx(0.4),
+                          "reset": True}
+    assert d["depth"]["value"] == 2.0
+    assert d["depth"]["reset"] is True  # sample count went backwards
+    # no reset -> no flag
+    s3 = reg.snapshot()
+    assert "reset" not in snapshot_diff(s2, s3)["lat_s"]
+    assert "reset" not in snapshot_diff(s2, s3)["depth"]
 
 
 def test_registry_get_or_create_and_kind_conflict():
@@ -193,6 +235,113 @@ def test_prometheus_text_exposition():
     assert buckets[-1] == 'lat_s_bucket{le="+Inf"} 5'
     assert "lat_s_count 5" in lines
     assert any(ln.startswith("lat_s_sum ") for ln in lines)
+
+
+def test_prometheus_label_escaping():
+    """Label values carrying backslashes, quotes, or newlines must be
+    escaped per the text-format spec — unescaped they corrupt every
+    line after them in a scrape."""
+    assert escape_label_value('a"b') == r'a\"b'
+    assert escape_label_value("a\\b") == r"a\\b"
+    assert escape_label_value("a\nb") == r"a\nb"
+    reg = MetricsRegistry()
+    reg.counter("errors", path='C:\\tmp\\"x"\nboom').incr(2)
+    text = reg.prometheus_text()
+    line = [ln for ln in text.splitlines()
+            if ln.startswith("errors{")][0]
+    assert "\n" not in line             # splitlines proves no raw \n
+    assert line == (
+        'errors{path="C:\\\\tmp\\\\\\"x\\"\\nboom"} 2')
+
+
+def test_prometheus_format_conformance_line_by_line():
+    """The exposition-hardening oracle: parse the output line by line
+    — exactly one # HELP and one # TYPE per family (HELP first),
+    every sample line matches the metric-line grammar, histogram
+    bucket counts are cumulative-monotonic ending at +Inf == count,
+    and set_help text is carried through."""
+    reg = MetricsRegistry()
+    reg.set_help("reqs", "requests by code")
+    reg.counter("reqs", code="200").incr(7)
+    reg.counter("reqs", code="500").incr(1)
+    reg.gauge("depth").update(3)
+    h = reg.histogram("lat_s", low=0.001, high=1.0, growth=10.0)
+    for v in (0.0005, 0.005, 0.05, 0.5, 5.0):
+        h.record(v)
+    lines = reg.prometheus_text().splitlines()
+    assert lines, "empty exposition"
+    sample_re = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+        r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+        r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
+        r' -?[0-9.e+-]+(inf|nan)?$')
+    help_seen, type_seen = {}, {}
+    current_family = None
+    for ln in lines:
+        if ln.startswith("# HELP "):
+            fam = ln.split()[2]
+            assert fam not in help_seen, f"duplicate HELP for {fam}"
+            help_seen[fam] = ln
+            current_family = fam
+        elif ln.startswith("# TYPE "):
+            fam = ln.split()[2]
+            assert fam not in type_seen, f"duplicate TYPE for {fam}"
+            assert fam == current_family, "TYPE must follow its HELP"
+            type_seen[fam] = ln.split()[3]
+        else:
+            assert sample_re.match(ln), f"unparseable line: {ln!r}"
+            name = ln.split("{")[0].split(" ")[0]
+            # sample lines belong to the current (declared) family
+            assert name.startswith(current_family), \
+                f"{ln!r} outside its {current_family!r} family block"
+    assert set(help_seen) == set(type_seen) == \
+        {"reqs", "depth", "lat_s"}
+    assert help_seen["reqs"] == "# HELP reqs requests by code"
+    assert type_seen == {"reqs": "counter", "depth": "gauge",
+                         "lat_s": "histogram"}
+    # histogram buckets: cumulative-monotonic, closing at +Inf == count
+    buckets = [ln for ln in lines if ln.startswith("lat_s_bucket")]
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in buckets]
+    assert counts == sorted(counts), "bucket counts must be cumulative"
+    assert buckets[-1].startswith('lat_s_bucket{le="+Inf"}')
+    assert counts[-1] == 5
+
+
+def test_histogram_label_set_isolation():
+    """Same metric name, different label items: buckets, counts, and
+    quantiles stay independent through snapshot, snapshot_diff, and
+    the Prometheus exposition — one route's latency burst must not
+    bleed into another's distribution."""
+    reg = MetricsRegistry()
+    a = reg.histogram("lat_s", route="a")
+    b = reg.histogram("lat_s", route="b")
+    assert a is not b
+    assert reg.histogram("lat_s", route="a") is a   # stable identity
+    for _ in range(10):
+        a.record(0.001)                 # fast route
+    b.record(10.0)                      # one slow sample
+    assert a.count == 10 and b.count == 1
+    assert a.p99 < 0.01 and b.p50 == 10.0
+    assert a.bucket_counts != b.bucket_counts
+    s1 = reg.snapshot()
+    ka = series_key("lat_s", (("route", "a"),))
+    kb = series_key("lat_s", (("route", "b"),))
+    assert s1[ka]["count"] == 10 and s1[kb]["count"] == 1
+    a.record(0.002)
+    d = snapshot_diff(s1, reg.snapshot())
+    assert d[ka]["count_delta"] == 1 and d[kb]["count_delta"] == 0
+    text = reg.prometheus_text()
+    inf_a = [ln for ln in text.splitlines()
+             if ln.startswith("lat_s_bucket")
+             and 'route="a"' in ln and 'le="+Inf"' in ln]
+    inf_b = [ln for ln in text.splitlines()
+             if ln.startswith("lat_s_bucket")
+             and 'route="b"' in ln and 'le="+Inf"' in ln]
+    assert inf_a[0].endswith(" 11") and inf_b[0].endswith(" 1")
+    assert "lat_s_count" in text
+    counts = [ln for ln in text.splitlines()
+              if ln.startswith("lat_s_count")]
+    assert len(counts) == 2             # one _count per label set
 
 
 def test_emit_jsonl_deterministic_with_fake_clock():

@@ -120,6 +120,30 @@ echo "=== build-matrix axis: chaos-soak-speculative ==="
 env JAX_PLATFORMS=cpu python tools/chaos_soak.py --seed 0 --iters 800 --speculative
 results[chaos_spec]=$?
 
+# postmortem axis: the deep-observability gate (docs/observability.md,
+# "Flight recorder & postmortems") — a short chaos soak with a FORCED
+# invariant violation (ChaosConfig.force_violation_iter) must (1) fail,
+# (2) auto-write a postmortem bundle (flight-recorder JSONL + metrics
+# snapshot + Chrome trace + manifest), and (3) pass
+# tools/postmortem.py --assert-complete: every file parses, step
+# accounting reconciles with the metrics snapshot's step counters, and
+# per-request slices reconstruct each admit->finish path
+echo "=== build-matrix axis: postmortem ==="
+pm_dir=$(mktemp -d)
+env JAX_PLATFORMS=cpu python tools/chaos_soak.py --seed 0 --iters 150 \
+    --force-violation 100 --postmortem-dir "$pm_dir"
+if [ $? -eq 0 ]; then
+  echo "FAIL: forced invariant violation went undetected" >&2
+  results[postmortem]=1
+else
+  python tools/postmortem.py "$pm_dir/invariant_violation" \
+      --assert-complete \
+    && python tools/postmortem.py "$pm_dir/invariant_violation" \
+        --last-n-steps 5 > /dev/null
+  results[postmortem]=$?
+fi
+rm -rf "$pm_dir"
+
 # trace smoke: the observability axis (docs/observability.md) — the
 # serving smoke re-runs with APEX_TPU_TRACE set; the exported Chrome
 # trace must parse, its B/E spans must pair up, and it must contain

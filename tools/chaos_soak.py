@@ -30,9 +30,21 @@ keeps speculation OFF so the legacy axis numbers stay comparable
 across PRs (speculation-on output is bit-identical anyway; this is
 about fault-surface attribution, not correctness).
 
+The soaked server always runs with a step-level ``FlightRecorder``
+(``docs/observability.md``, "Flight recorder & postmortems") —
+recording never feeds back into scheduler decisions, so the soak's
+numbers are byte-identical recorder-on vs off.  With
+``--postmortem-dir`` any invariant violation dumps a postmortem
+bundle (flight JSONL + metrics snapshot + Chrome trace + manifest) to
+``<dir>/invariant_violation`` before exiting 1; ``--force-violation
+N`` deliberately corrupts the terminal bookkeeping at iteration >= N
+so the build matrix can prove the detector and the bundle dump
+end-to-end (``tools/postmortem.py --assert-complete`` gates the
+result).
+
 Usage:
     python tools/chaos_soak.py [--seed 0] [--iters 2000] [--out -]
-        [--speculative]
+        [--speculative] [--postmortem-dir DIR] [--force-violation N]
 """
 
 import argparse
@@ -75,10 +87,19 @@ def main(argv=None) -> int:
                         help="speculation-enabled traffic class: "
                         "serve with speculative decoding on and mix "
                         "in repetitive prompts so drafts fire")
+    parser.add_argument("--postmortem-dir", default=None,
+                        help="dump a postmortem bundle here on any "
+                        "invariant violation (docs/observability.md)")
+    parser.add_argument("--force-violation", type=int, default=None,
+                        metavar="N",
+                        help="deliberately violate the finished-twice "
+                        "invariant at iteration >= N (the postmortem "
+                        "build-matrix axis; the soak then MUST fail)")
     args = parser.parse_args(argv)
 
     import jax.numpy as jnp
 
+    from apex_tpu.observability import FlightRecorder
     from apex_tpu.resilience import CircuitBreaker
     from apex_tpu.resilience.chaos import ChaosConfig, run_soak
     from apex_tpu.serving import InferenceServer
@@ -93,11 +114,17 @@ def main(argv=None) -> int:
         # Speculation follows --speculative (off by default so the
         # legacy axis numbers stay comparable; output is bit-identical
         # either way).
+        # the flight recorder is always on here (it never feeds back
+        # into scheduling, so the soak is byte-identical either way);
+        # sized to hold the whole run so a violation bundle carries
+        # every step leading up to it
         return InferenceServer(
             cfg, params, max_batch_size=4, max_context=64,
             block_size=4, num_blocks=40,          # 39 usable blocks
             cache_dtype=jnp.float32, max_waiting=8, clock=clock,
             enable_speculation=args.speculative,
+            flight_recorder=FlightRecorder(
+                capacity=max(4096, 2 * args.iters)),
             breaker=CircuitBreaker(failure_threshold=3,
                                    recovery_time=25.0,
                                    probe_successes=2, clock=clock))
@@ -115,10 +142,12 @@ def main(argv=None) -> int:
         # with speculation on, a third of the prompts are repetitive
         # so drafts fire and the verify/acceptance/rollback machinery
         # soaks under faults rather than idling
-        repetitive_rate=0.33 if args.speculative else 0.0)
+        repetitive_rate=0.33 if args.speculative else 0.0,
+        force_violation_iter=args.force_violation)
     t0 = time.perf_counter()
     report = run_soak(make_server, chaos_cfg, args.seed,
-                      make_replay=make_replay, log=print)
+                      make_replay=make_replay, log=print,
+                      postmortem_dir=args.postmortem_dir)
     report["wall_s"] = round(time.perf_counter() - t0, 2)
 
     line = json.dumps(report, indent=2, sort_keys=True)

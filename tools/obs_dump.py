@@ -15,14 +15,22 @@ Two subcommands over the two export formats of
     PATH is a Chrome trace-event JSON (``SpanTracer.export_chrome`` /
     ``APEX_TPU_TRACE``).  Prints a per-span-name summary (count,
     total/mean/max wall) built by matching B/E pairs per thread, and
-    an instant-event count table.  Each ``--require NAME`` asserts a
-    span or instant of that name exists — exit 1 otherwise — which is
-    how the build matrix checks a serve smoke actually traced its
-    scheduler phases (``tests/build_matrix/run.sh``).
+    an instant-event count table.  When the tracer's ring buffer
+    dropped events the summary is a truncated window, so a LOUD
+    warning goes to stderr — a silently shortened trace reads as "the
+    server did less", which is worse than no trace.  Each
+    ``--require NAME`` asserts a span or instant of that name exists —
+    exit 1 otherwise — which is how the build matrix checks a serve
+    smoke actually traced its scheduler phases
+    (``tests/build_matrix/run.sh``).  ``NAME`` may carry a label
+    filter, ``name{key=value,...}``: the requirement then only matches
+    events whose ``args`` carry every listed key with that exact
+    (stringified) value — e.g. ``--require 'request_finish{reason=eos}'``.
 
 Usage:
     python tools/obs_dump.py metrics scrape.jsonl
     python tools/obs_dump.py trace trace.json --require admit --require decode
+    python tools/obs_dump.py trace trace.json --require 'engine_oom{site=decode}'
 """
 
 import argparse
@@ -120,6 +128,36 @@ def summarize_trace(events):
     return spans, instants, errors
 
 
+def parse_require(spec: str):
+    """``name`` or ``name{key=value,...}`` -> (name, {key: value});
+    raises ValueError on malformed filters."""
+    if "{" not in spec:
+        return spec, {}
+    if not spec.endswith("}"):
+        raise ValueError(f"malformed --require filter: {spec!r}")
+    name, inner = spec[:-1].split("{", 1)
+    labels = {}
+    for part in inner.split(","):
+        if "=" not in part:
+            raise ValueError(
+                f"--require filter needs key=value pairs: {spec!r}")
+        k, v = part.split("=", 1)
+        labels[k.strip()] = v.strip().strip('"')
+    return name, labels
+
+
+def require_matches(events, name: str, labels: dict) -> bool:
+    """Whether any B/i event named ``name`` carries every filter label
+    with that stringified value in its ``args``."""
+    for ev in events:
+        if ev.get("ph") not in ("B", "i") or ev.get("name") != name:
+            continue
+        args = ev.get("args", {})
+        if all(str(args.get(k)) == v for k, v in labels.items()):
+            return True
+    return False
+
+
 def dump_trace(args) -> int:
     with open(args.path) as f:
         data = json.load(f)
@@ -132,6 +170,11 @@ def dump_trace(args) -> int:
           f"names, {sum(instants.values())} instants"
           + (f", {dropped} dropped by the ring buffer" if dropped
              else ""))
+    if dropped:
+        print(f"WARNING: {dropped} events were DROPPED by the tracer "
+              f"ring buffer — this trace is a truncated window, not "
+              f"the full run (raise SpanTracer capacity, or treat "
+              f"span counts as lower bounds)", file=sys.stderr)
     if spans:
         print(f"\n{'span':<20} {'count':>7} {'total ms':>10} "
               f"{'mean ms':>9} {'max ms':>9}")
@@ -148,8 +191,19 @@ def dump_trace(args) -> int:
     rc = 0
     for err in errors:
         print(f"WARN: {err}", file=sys.stderr)
-    for name in args.require or ():
-        if name not in spans and name not in instants:
+    for spec in args.require or ():
+        try:
+            name, labels = parse_require(spec)
+        except ValueError as e:
+            print(f"FAIL: {e}", file=sys.stderr)
+            rc = 1
+            continue
+        if labels:
+            if not require_matches(events, name, labels):
+                print(f"FAIL: no span/instant matches {spec!r}",
+                      file=sys.stderr)
+                rc = 1
+        elif name not in spans and name not in instants:
             print(f"FAIL: required span/instant {name!r} not in trace",
                   file=sys.stderr)
             rc = 1
@@ -171,7 +225,8 @@ def main() -> int:
     tp.add_argument("path")
     tp.add_argument("--require", action="append", metavar="NAME",
                     help="exit 1 unless a span/instant NAME exists "
-                    "(repeatable)")
+                    "(repeatable); NAME{key=value,...} additionally "
+                    "matches event args")
     tp.set_defaults(fn=dump_trace)
     args = ap.parse_args()
     return args.fn(args)

@@ -669,6 +669,18 @@ def main():
         "p50_latency_ms": pct(lats, 0.50),
         "p95_latency_ms": pct(lats, 0.95),
         "latency": stats["latency"],
+        # memory observability headline (docs/observability.md,
+        # "Memory accounting"): pool high-watermark + fragmentation,
+        # and the goodput/throughput ratio against the (default
+        # no-latency-bound) SLO policy — the full blocks ride in
+        # "stats" below
+        "memory": {
+            "blocks_usable": stats["memory"]["blocks_usable"],
+            "blocks_live_peak": stats["memory"]["blocks_live_peak"],
+            "occupancy_peak": stats["memory"]["occupancy_peak"],
+            "frag_slots": stats["memory"]["frag_slots"],
+        },
+        "goodput_ratio": stats["slo"]["goodput_ratio"],
         "parity_mismatches": mismatches,
         "config": {"requests": args.requests, "max_new": args.max_new,
                    "batch_size": args.batch_size,
