@@ -1,0 +1,63 @@
+"""On-device sampling primitives for the serving engine.
+
+The synchronous serve loop's per-step device→host transfer is a
+``(B, V)`` logits block that exists only to be argmaxed on the host —
+the transfer (and the host argmax behind it) is what forces the step
+loop to block on ``np.asarray(logits)`` before the scheduler may plan
+the next iteration.  Fusing the argmax into the compiled program
+shrinks the transfer to a ``(B,)`` int32 vector and lets JAX async
+dispatch run the device ahead of the host (``docs/serving.md``,
+"Pipelined serve loop").
+
+Two contracts matter here, both pinned by
+``tests/L0/test_pipeline.py``:
+
+- :func:`greedy_argmax` must be BIT-EXACT against the host-side
+  ``serving.greedy_sample`` (``np.argmax``) for every logits dtype the
+  engine produces, INCLUDING exact ties — both resolve ties toward
+  the lowest token id, which is the tie rule speculative decoding's
+  acceptance comparison relies on;
+- :func:`finite_rows` must reproduce the step loop's non-finite row
+  guard (``np.all(np.isfinite(logits), axis=-1)``) so a poisoned
+  request still fails alone with ``finish_reason="nonfinite"`` even
+  though the host never sees its logits.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["finite_rows", "greedy_argmax"]
+
+
+def greedy_argmax(logits):
+    """(…, V) logits -> (…,) int32 argmax token ids, on device.
+
+    Semantics are exactly ``np.argmax``'s: the FIRST maximum along the
+    axis wins, so the fused program's token choice is bit-identical to
+    materializing the logits and sampling on the host
+    (``serving.greedy_sample``), ties included.
+
+    Implemented as max → equality → iota-min rather than
+    ``jnp.argmax``: XLA:CPU lowers the combined value+index argmax
+    reduction to a scalar loop (~5x slower than the three
+    vectorizable passes here at serving vocab sizes), and the
+    decomposition picks the LOWEST index among maxima by construction
+    — the same tie rule.  A row whose max is NaN matches nothing and
+    clamps to the last id; such rows are always flagged by
+    :func:`finite_rows` and their token is never consumed."""
+    v = logits.shape[-1]
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    iota = jax.lax.broadcasted_iota(jnp.int32, logits.shape,
+                                    logits.ndim - 1)
+    idx = jnp.min(jnp.where(logits == m, iota, jnp.int32(v)), axis=-1)
+    return jnp.minimum(idx, v - 1).astype(jnp.int32)
+
+
+def finite_rows(logits):
+    """(…, V) logits -> (…,) bool: True where every vocab entry of the
+    row is finite.  The device half of the serve loop's non-finite
+    step guard: rows flagged False are failed (``"nonfinite"``) at
+    retire time without their logits ever reaching the host."""
+    return jnp.all(jnp.isfinite(logits), axis=-1)

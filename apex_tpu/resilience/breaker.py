@@ -114,8 +114,8 @@ class CircuitBreaker:
         if self.counters is not None:
             self.counters.incr(self._TRANSITION_KEYS[state])
 
-    def _trip(self) -> None:
-        self._opened_at = self.clock()
+    def _trip(self, now: "float | None" = None) -> None:
+        self._opened_at = self.clock() if now is None else now
         self._streak = 0
         self._transition(OPEN)
 
@@ -146,17 +146,25 @@ class CircuitBreaker:
         else:
             self._streak = 0
 
-    def record_failure(self) -> None:
+    def record_failure(self, now: "float | None" = None) -> None:
         """One admitted unit of work failed (non-finite logits, engine
         OOM, ...).  A half-open probe failure re-opens immediately —
-        the backend is still sick, restart the cooldown."""
+        the backend is still sick, restart the cooldown.
+
+        ``now`` optionally backdates the trip's cooldown anchor to
+        when the failure actually HAPPENED rather than when it was
+        observed — the pipelined serve loop observes a device-side
+        failure one iteration after launching it, and anchoring the
+        recovery window at launch time keeps the breaker's trajectory
+        identical to the synchronous loop's
+        (``docs/serving.md``, "Pipelined serve loop")."""
         if self._state == HALF_OPEN:
-            self._trip()
+            self._trip(now)
             return
         if self._state == CLOSED:
             self._streak += 1
             if self._streak >= self.failure_threshold:
-                self._trip()
+                self._trip(now)
 
     def reset(self) -> None:
         """Force-close (operator override / between test cases)."""

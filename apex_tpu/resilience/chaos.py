@@ -297,6 +297,46 @@ class ChaosEngine:
             self.injected["nonfinite_rows"] += 1
         return out
 
+    # -- fused on-device-sampling twins (the pipelined serve loop) ---------
+    # Same gates, same per-iteration RNG draw sequence as the logits
+    # methods, so a (config, seed) schedule injects identical faults
+    # whichever loop the server runs.  The non-finite poison flips the
+    # victim row's finite FLAG via a lazy device op — no
+    # materialization, so injection never collapses the dispatch-ahead
+    # window it is trying to fault.
+
+    def prefill_sampled(self, tokens, block_table):
+        self._oom_gate()
+        return self.inner.prefill_sampled(tokens, block_table)
+
+    def chunk_prefill_sampled(self, tokens, start, block_table,
+                              pad_to=None):
+        self._oom_gate()
+        return self.inner.chunk_prefill_sampled(tokens, start,
+                                                block_table,
+                                                pad_to=pad_to)
+
+    def decode_sampled(self, tokens, positions, tables):
+        self._oom_gate()
+        ids, fin = self.inner.decode_sampled(tokens, positions, tables)
+        if self.iter in self.schedule.nonfinite_iters:
+            row = self.rng.randrange(int(fin.shape[0]))
+            fin = fin.at[row].set(False)
+            self.injected["nonfinite_rows"] += 1
+        return ids, fin
+
+    def verify_sampled(self, tokens, lengths, positions, tables):
+        self._oom_gate()
+        ids, fin = self.inner.verify_sampled(tokens, lengths,
+                                             positions, tables)
+        if self.iter in self.schedule.nonfinite_iters:
+            # one slot's whole flag row — the same blast radius as
+            # NaN-ing its (K, V) logits block on the logits path
+            row = self.rng.randrange(int(fin.shape[0]))
+            fin = fin.at[row].set(False)
+            self.injected["nonfinite_rows"] += 1
+        return ids, fin
+
     def __getattr__(self, name):
         return getattr(self.inner, name)
 

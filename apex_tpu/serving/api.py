@@ -29,6 +29,22 @@ transitions, and on :meth:`InferenceServer.audit` failure;
 goodput vs throughput, and ``stats()["memory"]`` the KV pool's
 free/live/evictable occupancy, high-watermarks, and fragmentation.
 
+Pipelined serve loop (``docs/serving.md``, "Pipelined serve loop"; ON
+by default, ``enable_pipeline=False`` opts out, a custom ``sample_fn``
+auto-disables): each :meth:`step` first RETIRES the previous
+iteration's launched decode/verify results (token ids + finite flags,
+sampled on device by the engine's fused programs), then plans and
+LAUNCHES this iteration's programs without materializing them — so
+host scheduling for step N+1 overlaps device compute for step N, and
+the per-step device→host transfer is a ``(B,)`` int32 vector instead
+of a ``(B, V)`` logits block.  Output is bit-identical to the
+synchronous loop: greedy argmax is computed by the same rule on
+device, every host-side decision (deadlines, admission, shedding,
+preemption, drafts) happens AFTER the prior step's results are
+applied — exactly the state the synchronous loop would have seen —
+and ``submit()`` flushes the window first so front-door decisions
+(breaker, displacement) never race the in-flight step.
+
 ``generate()`` is batch-synchronous (submit N prompts, run the loop to
 completion, return N completions) — the shape every test and bench
 needs.  A live service would run :meth:`step` on its event loop and
@@ -172,6 +188,28 @@ def greedy_sample(logits: np.ndarray) -> np.ndarray:
     return np.argmax(logits, axis=-1)
 
 
+class _InflightStep:
+    """One launched-but-not-retired device step (the depth-1
+    dispatch-ahead window): the requests it covers, the draft map and
+    per-slot lengths (verify only), the un-materialized device arrays
+    (token ids + finite flags), and the launch-time clock — the
+    timestamp device-side failures are anchored to when they are
+    observed a step later."""
+
+    __slots__ = ("kind", "running", "drafts", "lengths", "ids",
+                 "finite", "launched_at")
+
+    def __init__(self, kind, running, ids, finite, launched_at,
+                 drafts=None, lengths=None):
+        self.kind = kind                  # "decode" | "verify"
+        self.running = running
+        self.ids = ids
+        self.finite = finite
+        self.launched_at = launched_at
+        self.drafts = drafts
+        self.lengths = lengths
+
+
 class InferenceServer:
     """Batched GPT inference with KV-cache + continuous batching.
 
@@ -209,6 +247,17 @@ class InferenceServer:
       spec_tokens: max drafted tokens per verify step (default 4); the
         verify program is ``spec_tokens + 1`` columns wide and
         compiles once.
+      enable_pipeline: the dispatch-ahead step loop
+        (``docs/serving.md``, "Pipelined serve loop"): decode/verify
+        steps launch the engine's fused on-device-sampling programs
+        and their results are retired at the START of the next
+        iteration, so host scheduling overlaps device compute and the
+        per-step transfer is token ids, not logits.  Output is
+        bit-identical to the synchronous loop (greedy argmax is
+        order-independent; every host decision sees post-retire
+        state).  Greedy only: a custom ``sample_fn`` needs the logits
+        on host and falls back to the synchronous path unchanged.
+        Opt out to restore the strictly serial loop.
       draft_source: the :class:`serving.speculation.DraftSource`
         proposing drafts (default: zero-weight
         :class:`~serving.speculation.NgramDraft` prompt-lookup over
@@ -280,6 +329,7 @@ class InferenceServer:
                  enable_speculation: bool = True,
                  spec_tokens: Optional[int] = None,
                  draft_source: Optional[DraftSource] = None,
+                 enable_pipeline: bool = True,
                  enable_overload: bool = True,
                  overload_policy: Optional[OverloadPolicy] = None,
                  enable_breaker: bool = True,
@@ -351,6 +401,16 @@ class InferenceServer:
                              else NgramDraft())
         self.speculating = bool(enable_speculation
                                 and self.sample_fn is greedy_sample)
+        # pipelined serve loop (docs/serving.md, "Pipelined serve
+        # loop"): greedy-only by contract — sampling must happen on
+        # device for the host to skip materializing logits, and the
+        # fused programs sample by argmax
+        self.pipelining = bool(enable_pipeline
+                               and self.sample_fn is greedy_sample)
+        self._inflight: Optional[_InflightStep] = None
+        self._pending_produced = 0   # retired outside step() (submit)
+        self.pipe = CounterMeter(registry=self.registry,
+                                 name="serving_pipeline", label="event")
         self.spec = CounterMeter(registry=self.registry,
                                  name="serving_speculation",
                                  label="event")
@@ -394,6 +454,14 @@ class InferenceServer:
         self.queue_wait = hist("serving_queue_wait_s")
         self.decode_latency = hist("serving_decode_token_s")
         self.step_time = hist("serving_step_s")
+        # pipeline overlap split (stats()["pipeline"]): retire-wait is
+        # the host blocked on device results (device-bound time); plan
+        # is the host's scheduling+launch work, which the device
+        # overlaps when pipelining is on (host-bound time).  A
+        # well-overlapped step costs ~max of the two, a serial step
+        # their sum.
+        self.retire_wait = hist("serving_retire_wait_s")
+        self.plan_time = hist("serving_plan_s")
         # per-priority-class queue-wait distributions, materialized as
         # classes are first seen (labeled series of the same metric)
         self._queue_wait_prio: Dict[int, object] = {}
@@ -448,6 +516,14 @@ class InferenceServer:
         if self._closed:
             raise RuntimeError(
                 "InferenceServer is closed; no further submissions")
+        # retire any launched-but-unretired step BEFORE the front
+        # door decides anything: the breaker state, displacement
+        # victims, and queue pressure must reflect the results of the
+        # step the device already ran — the same state the synchronous
+        # loop would show this submission (docs/serving.md,
+        # "Pipelined serve loop")
+        if self._inflight is not None:
+            self._pending_produced += self._flush_window()
         prompt = [int(t) for t in prompt]
         if int(max_new_tokens) < 1:
             raise ValueError(
@@ -515,14 +591,19 @@ class InferenceServer:
                 sched.fail(req, "timeout")
 
     def step(self) -> int:
-        """One continuous-batching iteration: expire deadlines, admit
-        newly schedulable requests, advance ONE prefill chunk per
-        prefilling request, then one decode step across the rest of
-        the running batch.  Chunk prefills interleave with decode
-        iterations, so a long prompt stalls running requests by at
-        most one chunk — and a prefix-cache hit skips straight to its
-        uncached tail.  Returns the number of tokens sampled
-        (0 = idle, though chunk prefills may still have run).
+        """One continuous-batching iteration: retire the previous
+        iteration's launched decode/verify results (pipelined loop),
+        expire deadlines, admit newly schedulable requests, advance
+        ONE prefill chunk per prefilling request, then one decode step
+        across the rest of the running batch — LAUNCHED without
+        materialization when pipelining is on (its tokens retire at
+        the start of the next step), sampled synchronously otherwise.
+        Chunk prefills interleave with decode iterations, so a long
+        prompt stalls running requests by at most one chunk — and a
+        prefix-cache hit skips straight to its uncached tail.  Returns
+        the number of tokens applied to requests this call (0 = idle,
+        though chunk prefills may still have run; under pipelining a
+        token counts when it is RETIRED, one step after its launch).
         Per-request failures (capacity / timeout / nonfinite / shed)
         finish the affected request alone, and a transient engine
         ``MemoryError`` skips the affected call for one iteration
@@ -531,7 +612,7 @@ class InferenceServer:
         sched, engine, tr = self.scheduler, self.engine, self.tracer
         rec = self.recorder
         self._iter += 1
-        produced = 0
+        produced, self._pending_produced = self._pending_produced, 0
         step_start = self.clock()
         if rec.enabled:
             # pre-step marks for the flight record's per-step deltas
@@ -543,6 +624,14 @@ class InferenceServer:
             oom0 = self.oom.total
             drafted0 = self.spec.count("drafted_tokens")
             accepted0 = self.spec.count("accepted_tokens")
+        # RETIRE: consume the previous iteration's launched step before
+        # any host decision — deadlines, shedding, admission, and
+        # drafts below then see exactly the state the synchronous loop
+        # would have had at this point (docs/serving.md, "Pipelined
+        # serve loop")
+        retired = self._flush_window()
+        produced += retired
+        plan_start = self.clock()
         self._expire_deadlines()
 
         # overload: record the pressure signal at its pre-shed peak,
@@ -580,6 +669,7 @@ class InferenceServer:
                     sched.cow_done(req)
 
         chunks = 0
+        pipelined = self.pipelining
         for req in [r for r in sched._admit_order if r.prefilling]:
             tokens, start, is_last = sched.prefill_plan(req)
             try:
@@ -590,14 +680,19 @@ class InferenceServer:
                     # bit-for-bit)
                     with tr.span("prefill", uid=req.uid,
                                  tokens=len(tokens)):
-                        logits = engine.prefill(tokens,
-                                                req.block_table)
+                        out = (engine.prefill_sampled(
+                            tokens, req.block_table) if pipelined
+                            else engine.prefill(tokens,
+                                                req.block_table))
                 else:
                     with tr.span("chunk_prefill", uid=req.uid,
                                  tokens=len(tokens), start=start):
-                        logits = engine.chunk_prefill(
+                        out = (engine.chunk_prefill_sampled(
                             tokens, start, req.block_table,
-                            pad_to=self.prefill_chunk)
+                            pad_to=self.prefill_chunk) if pipelined
+                            else engine.chunk_prefill(
+                                tokens, start, req.block_table,
+                                pad_to=self.prefill_chunk))
                     chunks += 1
             except MemoryError:
                 # chunk_done not called: this exact chunk replays
@@ -609,13 +704,27 @@ class InferenceServer:
                 # mid-prefill, or resumed after preemption (the
                 # pending token continues instead of these logits)
                 continue
-            logits = np.asarray(logits)
-            if not np.all(np.isfinite(logits)):
-                sched.fail(req, "nonfinite")
-                if self.breaker is not None:
-                    self.breaker.record_failure()
-                continue
-            tok = int(self.sample_fn(logits))
+            # prefill sampling stays synchronous either way — the
+            # sampled twin just shrinks the transfer to one id + one
+            # flag; only decode/verify dispatch ahead (a prefill's
+            # token gates whether the request joins THIS iteration's
+            # decode launch, so deferring it would change scheduling)
+            if pipelined:
+                ids, fin = out
+                if not bool(np.asarray(fin)[0]):
+                    sched.fail(req, "nonfinite")
+                    if self.breaker is not None:
+                        self.breaker.record_failure()
+                    continue
+                tok = int(np.asarray(ids)[0])
+            else:
+                logits = np.asarray(out)
+                if not np.all(np.isfinite(logits)):
+                    sched.fail(req, "nonfinite")
+                    if self.breaker is not None:
+                        self.breaker.record_failure()
+                    continue
+                tok = int(self.sample_fn(logits))
             req.record_token(tok)
             self._note_first_token(req)
             produced += 1
@@ -641,11 +750,22 @@ class InferenceServer:
             if running:
                 drafts = (self._propose_drafts(running)
                           if self.speculating else {})
-                if drafts:
+                if pipelined:
+                    # LAUNCH: enqueue the device step and stash the
+                    # un-materialized result handles; its tokens
+                    # retire at the start of the next step() (or at
+                    # the next submit(), whichever comes first)
+                    if drafts:
+                        self._launch_verify(running, drafts)
+                    else:
+                        self._launch_decode(running)
+                elif drafts:
                     produced += self._verify_step(running, drafts)
                 else:
                     produced += self._decode_step(running)
 
+        if pipelined:
+            self.plan_time.record(self.clock() - plan_start)
         self.tokens.update(produced)
         self.queue_depth.update(sched.num_waiting)
         self.occupancy.update(sched.num_running
@@ -708,6 +828,10 @@ class InferenceServer:
                     "lookahead_rolled_back":
                         sched.lookahead_rolled_back - lk_roll0,
                 },
+                "pipeline": {
+                    "pending": 1 if self._inflight is not None else 0,
+                    "retired_tokens": retired,
+                },
                 "step_s": step_s,
             })
         # breaker-open transition: the moment worth a black box — dump
@@ -720,13 +844,11 @@ class InferenceServer:
                     self._auto_postmortem("breaker_open")
         return produced
 
-    def _decode_step(self, running) -> int:
-        """One batched single-token decode over ``running`` (the
-        speculation-off path, and the speculation-on path on
-        iterations where no request has a draft).  Returns tokens
-        produced."""
-        sched, engine, tr = self.scheduler, self.engine, self.tracer
-        produced = 0
+    def _decode_inputs(self, running):
+        """The decode launch arrays — (tokens, positions, tables),
+        inactive slots zeroed — shared by the synchronous and
+        pipelined paths."""
+        engine = self.engine
         b, mb = engine.max_batch_size, engine.blocks_per_seq
         tokens = np.zeros((b,), np.int32)
         positions = np.zeros((b,), np.int32)
@@ -735,6 +857,15 @@ class InferenceServer:
             tokens[req.slot] = req.next_input
             positions[req.slot] = req.num_cached
             tables[req.slot, :len(req.block_table)] = req.block_table
+        return tokens, positions, tables
+
+    def _decode_step(self, running) -> int:
+        """One batched single-token decode over ``running``,
+        materialized and applied in the same call (the synchronous
+        loop; also the custom-``sample_fn`` path).  Returns tokens
+        produced."""
+        engine, tr = self.engine, self.tracer
+        tokens, positions, tables = self._decode_inputs(running)
         try:
             with tr.span("decode", batch=len(running)):
                 logits = np.asarray(
@@ -745,17 +876,55 @@ class InferenceServer:
             self._note_oom("decode")
             return 0
         self.spec.incr("decode_steps")
-        # step guard: a row of non-finite logits means this request's
-        # state is poisoned — evict it before its garbage token enters
-        # sampling/termination logic; every finite row proceeds
-        # normally
-        finite_rows = np.all(np.isfinite(logits), axis=-1)
+        finite = np.all(np.isfinite(logits), axis=-1)
         toks = self.sample_fn(logits)
+        return self._apply_decode_results(running, toks, finite)
+
+    def _launch_decode(self, running) -> bool:
+        """The pipelined decode launch: enqueue the fused sampled
+        program and stash its un-materialized (ids, finite) handles as
+        the in-flight window — the host returns immediately and the
+        results retire next step.  False = the launch OOMed (skipped
+        and retried bit-identically, exactly like the synchronous
+        path)."""
+        sched, engine, tr = self.scheduler, self.engine, self.tracer
+        tokens, positions, tables = self._decode_inputs(running)
+        try:
+            with tr.span("launch", program="decode",
+                         batch=len(running)):
+                ids, fin = engine.decode_sampled(tokens, positions,
+                                                 tables)
+        except MemoryError:
+            self._note_oom("decode")
+            return False
+        self.spec.incr("decode_steps")
+        self._inflight = _InflightStep(
+            "decode", list(running), ids, fin, self.clock())
+        sched.hold_inflight(running)
+        self.pipe.incr("launches")
+        return True
+
+    def _apply_decode_results(self, running, toks, finite,
+                              now: Optional[float] = None) -> int:
+        """Apply one decode step's sampled results to ``running`` —
+        the retire half shared by both loops.  ``toks``/``finite`` are
+        (B,) host arrays; ``now`` backdates breaker failures to the
+        launch time (pipelined retire observes them a step late).
+        Returns tokens produced.
+
+        Step guard: a False ``finite`` flag means that row's logits
+        went non-finite — the request is evicted before its garbage
+        token enters termination logic; every finite row proceeds
+        normally."""
+        sched = self.scheduler
+        produced = 0
         for req in running:
-            if not finite_rows[req.slot]:
+            if req.finished or not req.running:
+                continue      # failed between launch and retire
+            if not finite[req.slot]:
                 sched.fail(req, "nonfinite")
                 if self.breaker is not None:
-                    self.breaker.record_failure()
+                    self.breaker.record_failure(now)
                 continue
             req.num_cached += 1
             req.record_token(int(toks[req.slot]))
@@ -804,17 +973,12 @@ class InferenceServer:
                 drafts[req.uid] = d
         return drafts
 
-    def _verify_step(self, running, drafts) -> int:
-        """One speculative verify step over ``running``: every slot
-        feeds its pending token plus its drafts (none = a plain
-        one-token column) through the fixed-width verify program, and
-        greedy acceptance keeps, per slot, the longest draft prefix
-        matching the model's own argmax plus the model's next token —
-        so the emitted tokens are exactly what one-token decode would
-        have produced, just several of them per engine step.  Rejected
-        suffix K/V is rolled back (``Scheduler.rollback_lookahead``).
-        Returns tokens produced."""
-        sched, engine, tr = self.scheduler, self.engine, self.tracer
+    def _verify_inputs(self, running, drafts):
+        """The verify launch arrays — (tokens, lengths, positions,
+        tables): every slot's pending token plus its drafts (none = a
+        plain one-token column), zero-padded — shared by the
+        synchronous and pipelined paths."""
+        engine = self.engine
         kw = self.spec_tokens + 1
         b, mb = engine.max_batch_size, engine.blocks_per_seq
         tokens = np.zeros((b, kw), np.int32)
@@ -830,6 +994,22 @@ class InferenceServer:
             lengths[req.slot] = n
             positions[req.slot] = req.num_cached
             tables[req.slot, :len(req.block_table)] = req.block_table
+        return tokens, lengths, positions, tables
+
+    def _verify_step(self, running, drafts) -> int:
+        """One speculative verify step over ``running``, materialized
+        and applied in the same call (the synchronous loop): every
+        slot feeds its pending token plus its drafts through the
+        fixed-width verify program, and greedy acceptance keeps, per
+        slot, the longest draft prefix matching the model's own argmax
+        plus the model's next token — so the emitted tokens are
+        exactly what one-token decode would have produced, just
+        several of them per engine step.  Rejected suffix K/V is
+        rolled back (``Scheduler.rollback_lookahead``).  Returns
+        tokens produced."""
+        sched, engine, tr = self.scheduler, self.engine, self.tracer
+        tokens, lengths, positions, tables = self._verify_inputs(
+            running, drafts)
         try:
             with tr.span("verify", batch=len(running),
                          drafted=sum(len(v) for v in drafts.values())):
@@ -847,21 +1027,70 @@ class InferenceServer:
                     sched.rollback_lookahead(req)
             return 0
         self.spec.incr("verify_steps")
+        finite = np.all(np.isfinite(logits), axis=-1)      # (B, K)
+        row_toks = self.sample_fn(logits)                  # (B, K)
+        return self._apply_verify_results(running, drafts, lengths,
+                                          row_toks, finite)
+
+    def _launch_verify(self, running, drafts) -> bool:
+        """The pipelined verify launch: enqueue the fused sampled
+        program (every row's argmax + finite flag on device) and
+        stash the un-materialized handles plus the draft map as the
+        in-flight window; greedy acceptance runs at retire, next step.
+        False = the launch OOMed — lookahead blocks grown for it are
+        rolled back and the identical verify (drafts are deterministic
+        functions of request history) retries next iteration."""
+        sched, engine, tr = self.scheduler, self.engine, self.tracer
+        tokens, lengths, positions, tables = self._verify_inputs(
+            running, drafts)
+        try:
+            with tr.span("launch", program="verify",
+                         batch=len(running),
+                         drafted=sum(len(v) for v in drafts.values())):
+                ids, fin = engine.verify_sampled(tokens, lengths,
+                                                 positions, tables)
+        except MemoryError:
+            self._note_oom("verify")
+            for req in running:
+                if req.running:
+                    sched.rollback_lookahead(req)
+            return False
+        self.spec.incr("verify_steps")
+        self._inflight = _InflightStep(
+            "verify", list(running), ids, fin, self.clock(),
+            drafts=drafts, lengths=lengths)
+        sched.hold_inflight(running)
+        self.pipe.incr("launches")
+        return True
+
+    def _apply_verify_results(self, running, drafts, lengths,
+                              row_toks, finite,
+                              now: Optional[float] = None) -> int:
+        """Greedy acceptance over one verify step's sampled results —
+        the retire half shared by both loops.  ``row_toks``/``finite``
+        are (B, K) host arrays (the model's argmax and finite flag at
+        every fed position); ``now`` backdates breaker failures to
+        launch time.  Accepts, per slot, the longest draft prefix
+        matching the model's own argmax plus the model's next token,
+        then rolls back rejected-suffix K/V blocks.  Returns tokens
+        produced."""
+        sched = self.scheduler
         produced = 0
         for req in running:
+            if req.finished or not req.running:
+                continue      # failed between launch and retire
             n = int(lengths[req.slot])
-            rows = logits[req.slot, :n]                    # (n, V)
-            if not np.all(np.isfinite(rows)):
+            if not np.all(finite[req.slot, :n]):
                 sched.fail(req, "nonfinite")
                 if self.breaker is not None:
-                    self.breaker.record_failure()
+                    self.breaker.record_failure(now)
                 continue
-            row_toks = self.sample_fn(rows)                # (n,)
+            toks = row_toks[req.slot]                      # (K,)
             d = drafts.get(req.uid, ())
             req.num_cached += 1        # the pending token's K/V landed
             accepted = 0
             for j, guess in enumerate(d):
-                if int(guess) != int(row_toks[j]):
+                if int(guess) != int(toks[j]):
                     break              # model disagrees: reject the
                     #                    rest of the draft
                 req.record_token(int(guess))
@@ -876,7 +1105,7 @@ class InferenceServer:
                 # last accepted token, exactly what a one-token decode
                 # would sample there (its K/V is NOT yet written; it
                 # becomes the pending token, same as decode)
-                req.record_token(int(row_toks[accepted]))
+                req.record_token(int(toks[accepted]))
                 self._note_first_token(req)
                 produced += 1
             if d:
@@ -898,6 +1127,34 @@ class InferenceServer:
                 sched.rollback_lookahead(req)
         self.spec.incr("decode_tokens", produced)
         return produced
+
+    def _flush_window(self) -> int:
+        """RETIRE: materialize and apply the in-flight launched step
+        (no-op when the window is empty).  Blocks until the device
+        finishes it — which, one step after launch, it usually already
+        has; the measured wait is the device-bound share of the step
+        (``stats()["pipeline"]["host_stall_ms"]``).  Returns tokens
+        produced."""
+        inf = self._inflight
+        if inf is None:
+            return 0
+        self._inflight = None
+        t0 = self.clock()
+        with self.tracer.span("retire", program=inf.kind,
+                              batch=len(inf.running)):
+            toks = np.asarray(inf.ids)
+            finite = np.asarray(inf.finite)
+        self.retire_wait.record(self.clock() - t0)
+        # the device step is fully consumed: its K/V writes landed, so
+        # the window's block pin lifts before any request state moves
+        self.scheduler.release_inflight()
+        self.pipe.incr("retired_behind")
+        if inf.kind == "decode":
+            return self._apply_decode_results(
+                inf.running, toks, finite, now=inf.launched_at)
+        return self._apply_verify_results(
+            inf.running, inf.drafts, inf.lengths, toks, finite,
+            now=inf.launched_at)
 
     def _note_oom(self, site: str) -> None:
         """Account one transient engine ``MemoryError``: the affected
@@ -1059,8 +1316,18 @@ class InferenceServer:
         self._draining = True
         while self.scheduler.has_work:
             self.step()
+        self._account_pending_produced()
         self._finalize_finished()
         return self.stats()
+
+    def _account_pending_produced(self) -> None:
+        """Feed the token meter any production retired OUTSIDE a step
+        (a ``submit()``-time window flush whose tokens no later step
+        picked up — e.g. the submission was turned away and the
+        server went idle)."""
+        if self._pending_produced:
+            self.tokens.update(self._pending_produced)
+            self._pending_produced = 0
 
     def close(self) -> dict:
         """Graceful shutdown, phase two: :meth:`drain`, then refuse
@@ -1092,6 +1359,8 @@ class InferenceServer:
             h.reset()
         self.decode_latency.reset()
         self.step_time.reset()
+        self.retire_wait.reset()
+        self.plan_time.reset()
         self.spec_drafted_hist.reset()
         self.spec_accepted_hist.reset()
         self.scheduler.finished.clear()
@@ -1161,6 +1430,7 @@ class InferenceServer:
         loss so a truncated trace or flight log is never mistaken for
         the full run.  Every pre-telemetry key is preserved unchanged
         (asserted in ``tests/L0/test_serving_engine.py``)."""
+        self._account_pending_produced()
         self._finalize_finished()
         pre, dec = self.engine.compile_counts()
         out = {
@@ -1214,6 +1484,22 @@ class InferenceServer:
                 "drafted_per_step": _hist_counts(self.spec_drafted_hist),
                 "accepted_per_step": _hist_counts(
                     self.spec_accepted_hist),
+            },
+            # pipelined serve loop (docs/serving.md, "Pipelined serve
+            # loop"): dispatch-ahead depth and the host-stall /
+            # device-stall split — host_stall_ms is the retire-time
+            # wait on device results (device-bound share),
+            # host_plan_ms the host scheduling+launch work the device
+            # overlaps (host-bound share); a well-overlapped step
+            # costs ~max of the two, a serial one their sum.
+            "pipeline": {
+                "enabled": self.pipelining,
+                "depth": 1 if self.pipelining else 0,
+                "launches": self.pipe.count("launches"),
+                "retired_behind": self.pipe.count("retired_behind"),
+                "pending": 1 if self._inflight is not None else 0,
+                "host_stall_ms": _hist_ms(self.retire_wait),
+                "host_plan_ms": _hist_ms(self.plan_time),
             },
             "latency": {
                 "ttft_ms": _hist_ms(self.ttft),

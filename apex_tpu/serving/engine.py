@@ -34,6 +34,19 @@ never recompiles in steady state:
 - **block copy** (fixed-width (src, dst) id batch): whole-block
   duplication inside the pool — the device half of the prefix cache's
   copy-on-write.  Compiled exactly once.
+- **sampled variants** (``prefill_sampled`` / ``chunk_prefill_sampled``
+  / ``decode_sampled`` / ``verify_sampled``): the same programs with
+  greedy argmax and the non-finite row guard fused in
+  (:func:`ops.greedy_argmax` / :func:`ops.finite_rows`), returning
+  token ids + per-row finite flags instead of logits.  The per-step
+  device→host transfer shrinks from a ``(B, V)`` float block to a
+  ``(B,)`` int32 vector, and — because the host never has to
+  materialize logits to sample — the pipelined serve loop
+  (``serving.api``, ``enable_pipeline``) can leave the returned arrays
+  as futures and let JAX async dispatch run the device a full
+  iteration ahead of host scheduling.  Bit-exact against the host
+  path by construction: ``jnp.argmax`` and ``np.argmax`` share the
+  lowest-index tie rule (pinned by ``tests/L0/test_pipeline.py``).
 
 Empty slots ride along as no-ops by construction: position 0 masks
 the whole context, the zeroed block table routes the KV write into
@@ -52,9 +65,11 @@ from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from apex_tpu.observability import NULL_TRACER
 from apex_tpu.models.gpt import GPTConfig, GPTLMHeadModel
+from apex_tpu.ops.sampling import finite_rows, greedy_argmax
 from apex_tpu.serving.kv_cache import (
     BlockAllocator,
     KVCacheConfig,
@@ -185,6 +200,25 @@ class DecodeEngine:
         self._verify_jit = jax.jit(self._verify_impl,
                                    donate_argnums=(1,))
         self._copy_jit = jax.jit(self._copy_impl, donate_argnums=(0,))
+        # the fused on-device-sampling twins (docs/serving.md,
+        # "Pipelined serve loop"): same bodies + argmax/finite-guard,
+        # so a greedy server transfers token ids, never logits.
+        # Donation policy differs from the logits programs: on TPU the
+        # pool is the HBM hog and must be updated in place, but on the
+        # CPU backend a donated call executes SYNCHRONOUSLY — which
+        # would serialize host and device again and defeat the
+        # pipelined loop's dispatch-ahead.  CPU pools are test-scale,
+        # so trading the (already-copied-anyway) in-place update for
+        # an async launch is the right side of the bargain there.
+        sampled_cache = (1,) if jax.default_backend() != "cpu" else ()
+        self._prefill_sampled_jit = jax.jit(self._prefill_sampled_impl,
+                                            donate_argnums=sampled_cache)
+        self._chunk_sampled_jit = jax.jit(self._chunk_sampled_impl,
+                                          donate_argnums=sampled_cache)
+        self._decode_sampled_jit = jax.jit(self._decode_sampled_impl,
+                                           donate_argnums=sampled_cache)
+        self._verify_sampled_jit = jax.jit(self._verify_sampled_impl,
+                                           donate_argnums=sampled_cache)
 
     # -- compiled bodies --------------------------------------------------
 
@@ -303,6 +337,36 @@ class DecodeEngine:
         cache = write_tokens(cache, (k, v), slots)
         return cache, logits[:, 0]                    # (B, V)
 
+    # -- fused on-device-sampling bodies ----------------------------------
+    # Each composes its logits twin with greedy argmax + the finite-row
+    # guard INSIDE the trace, so the (B, V) logits block never leaves
+    # the device — only (B,) int32 ids and (B,) bool flags transfer,
+    # and only when the caller eventually materializes them.
+
+    def _prefill_sampled_impl(self, params, cache, ids, length, table):
+        cache, last = self._prefill_impl(params, cache, ids, length,
+                                         table)
+        return cache, greedy_argmax(last), finite_rows(last)   # (1,)
+
+    def _chunk_sampled_impl(self, params, cache, ids, start, length,
+                            table):
+        cache, last = self._chunk_impl(params, cache, ids, start,
+                                       length, table)
+        return cache, greedy_argmax(last), finite_rows(last)   # (1,)
+
+    def _decode_sampled_impl(self, params, cache, tokens, positions,
+                             tables):
+        cache, logits = self._decode_impl(params, cache, tokens,
+                                          positions, tables)
+        return cache, greedy_argmax(logits), finite_rows(logits)  # (B,)
+
+    def _verify_sampled_impl(self, params, cache, ids, start, length,
+                             tables):
+        cache, logits = self._verify_impl(params, cache, ids, start,
+                                          length, tables)
+        return (cache, greedy_argmax(logits),
+                finite_rows(logits))                           # (B, K)
+
     # -- host API ---------------------------------------------------------
 
     def _compile_mark(self, jit_fn) -> int:
@@ -325,25 +389,65 @@ class DecodeEngine:
                 f"prompt length {length} exceeds max_context "
                 f"{self.max_context}") from None
 
-    def prefill(self, prompt, block_table) -> jax.Array:
-        """Run one prompt through the bucketed prefill, writing its
-        K/V into ``block_table``'s blocks.  Returns the last-token
-        logits (V,)."""
-        import numpy as np
+    def _put(self, *arrays):
+        """ONE host→device handoff for a launch's whole argument
+        struct (the per-step host-overhead fix): the prepared numpy
+        arrays ship as a single ``jax.device_put`` pytree instead of
+        one ``jnp.asarray`` dispatch per array.  Compile counts are
+        untouched — shapes/dtypes are identical to the per-array
+        path."""
+        return jax.device_put(arrays)
 
+    def _prefill_args(self, prompt, block_table):
+        """The prefill launch struct: (ids, length, table) on device
+        in one transfer, plus the bucket the prompt padded to."""
         n = len(prompt)
         sb = self.bucket_for(n)
         ids = np.zeros((1, sb), np.int32)
         ids[0, :n] = prompt
         table = np.zeros((1, self.blocks_per_seq), np.int32)
         table[0, :len(block_table)] = block_table
+        return self._put(ids, np.asarray([n], np.int32), table), sb
+
+    def _chunk_args(self, tokens, start, block_table, pad_to):
+        """The chunk launch struct: (ids, start, length, table) on
+        device in one transfer, plus the compiled chunk width."""
+        n = len(tokens)
+        cb = pad_to if pad_to is not None else self.bucket_for(n)
+        if n > cb:
+            raise ValueError(
+                f"chunk of {n} tokens exceeds pad_to={cb}")
+        ids = np.zeros((1, cb), np.int32)
+        ids[0, :n] = tokens
+        table = np.zeros((1, self.blocks_per_seq), np.int32)
+        table[0, :len(block_table)] = block_table
+        return self._put(ids, np.asarray([start], np.int32),
+                         np.asarray([n], np.int32), table), cb
+
+    def prefill(self, prompt, block_table) -> jax.Array:
+        """Run one prompt through the bucketed prefill, writing its
+        K/V into ``block_table``'s blocks.  Returns the last-token
+        logits (V,)."""
+        args, sb = self._prefill_args(prompt, block_table)
         before = self._compile_mark(self._prefill_jit)
-        self.cache, last = self._prefill_jit(
-            self.params, self.cache, jnp.asarray(ids),
-            jnp.asarray([n], jnp.int32), jnp.asarray(table))
+        self.cache, last = self._prefill_jit(self.params, self.cache,
+                                             *args)
         self._note_compile(self._prefill_jit, before, "prefill",
                            bucket=sb)
         return last[0]
+
+    def prefill_sampled(self, prompt, block_table):
+        """The fused-sampling twin of :meth:`prefill`: returns
+        ``(token_ids (1,) int32, finite (1,) bool)`` device arrays —
+        the prompt's greedy next token and its non-finite guard —
+        without materializing logits on the host."""
+        args, sb = self._prefill_args(prompt, block_table)
+        before = self._compile_mark(self._prefill_sampled_jit)
+        self.cache, ids, fin = self._prefill_sampled_jit(
+            self.params, self.cache, *args)
+        self._note_compile(self._prefill_sampled_jit, before,
+                           "prefill_sampled", bucket=sb)
+        return ids, fin
 
     def chunk_prefill(self, tokens, start: int, block_table,
                       pad_to: Optional[int] = None) -> jax.Array:
@@ -357,56 +461,79 @@ class DecodeEngine:
         bucket for ``len(tokens)``); a steady chunked-prefill loop
         passes its fixed chunk size so exactly one chunk program ever
         compiles."""
-        import numpy as np
-
-        n = len(tokens)
-        cb = pad_to if pad_to is not None else self.bucket_for(n)
-        if n > cb:
-            raise ValueError(
-                f"chunk of {n} tokens exceeds pad_to={cb}")
-        ids = np.zeros((1, cb), np.int32)
-        ids[0, :n] = tokens
-        table = np.zeros((1, self.blocks_per_seq), np.int32)
-        table[0, :len(block_table)] = block_table
+        args, cb = self._chunk_args(tokens, start, block_table, pad_to)
         before = self._compile_mark(self._chunk_jit)
-        self.cache, last = self._chunk_jit(
-            self.params, self.cache, jnp.asarray(ids),
-            jnp.asarray([start], jnp.int32),
-            jnp.asarray([n], jnp.int32), jnp.asarray(table))
+        self.cache, last = self._chunk_jit(self.params, self.cache,
+                                           *args)
         self._note_compile(self._chunk_jit, before, "chunk_prefill",
                            width=cb)
         return last[0]
+
+    def chunk_prefill_sampled(self, tokens, start: int, block_table,
+                              pad_to: Optional[int] = None):
+        """The fused-sampling twin of :meth:`chunk_prefill`: returns
+        ``(token_ids (1,) int32, finite (1,) bool)`` device arrays for
+        the chunk's last valid token (only meaningful on the final
+        chunk, exactly like the logits twin)."""
+        args, cb = self._chunk_args(tokens, start, block_table, pad_to)
+        before = self._compile_mark(self._chunk_sampled_jit)
+        self.cache, ids, fin = self._chunk_sampled_jit(
+            self.params, self.cache, *args)
+        self._note_compile(self._chunk_sampled_jit, before,
+                           "chunk_prefill_sampled", width=cb)
+        return ids, fin
 
     def copy_blocks(self, pairs) -> None:
         """Duplicate physical blocks ``[(src, dst), ...]`` inside the
         pool (copy-on-write).  Launches in fixed-width batches of
         ``_COPY_WIDTH`` padded with (0, 0) no-op pairs, so the copy
         program compiles once."""
-        import numpy as np
-
         for i in range(0, len(pairs), _COPY_WIDTH):
             batch = pairs[i:i + _COPY_WIDTH]
             src = np.zeros((_COPY_WIDTH,), np.int32)
             dst = np.zeros((_COPY_WIDTH,), np.int32)
             for j, (s, d) in enumerate(batch):
                 src[j], dst[j] = s, d
+            args = self._put(src, dst)
             before = self._compile_mark(self._copy_jit)
-            self.cache = self._copy_jit(self.cache, jnp.asarray(src),
-                                        jnp.asarray(dst))
+            self.cache = self._copy_jit(self.cache, *args)
             self._note_compile(self._copy_jit, before, "copy_blocks")
+
+    def _decode_args(self, tokens, positions, tables):
+        return self._put(np.asarray(tokens, np.int32),
+                         np.asarray(positions, np.int32),
+                         np.asarray(tables, np.int32))
 
     def decode(self, tokens, positions, tables) -> jax.Array:
         """One iteration-level decode step over all slots.  Arrays are
         (B,), (B,), (B, blocks_per_seq) with inactive slots zeroed.
         Returns next-token logits (B, V)."""
+        args = self._decode_args(tokens, positions, tables)
         before = self._compile_mark(self._decode_jit)
-        self.cache, logits = self._decode_jit(
-            self.params, self.cache,
-            jnp.asarray(tokens, jnp.int32),
-            jnp.asarray(positions, jnp.int32),
-            jnp.asarray(tables, jnp.int32))
+        self.cache, logits = self._decode_jit(self.params, self.cache,
+                                              *args)
         self._note_compile(self._decode_jit, before, "decode")
         return logits
+
+    def decode_sampled(self, tokens, positions, tables):
+        """The fused-sampling twin of :meth:`decode`: returns
+        ``(token_ids (B,) int32, finite (B,) bool)`` DEVICE arrays.
+        Nothing is materialized — the pipelined serve loop stashes the
+        handles and consumes them next iteration, so the device runs
+        this step while the host plans the next one."""
+        args = self._decode_args(tokens, positions, tables)
+        before = self._compile_mark(self._decode_sampled_jit)
+        self.cache, ids, fin = self._decode_sampled_jit(
+            self.params, self.cache, *args)
+        self._note_compile(self._decode_sampled_jit, before,
+                           "decode_sampled")
+        return ids, fin
+
+    def _verify_args(self, tokens, lengths, positions, tables):
+        return self._put(np.asarray(tokens, np.int32),
+                         np.asarray(positions, np.int32),
+                         np.asarray(lengths, np.int32),
+                         np.asarray(tables, np.int32))
 
     def verify(self, tokens, lengths, positions, tables) -> jax.Array:
         """One speculative verify step over all slots: tokens (B, K)
@@ -417,16 +544,29 @@ class DecodeEngine:
         caller (``serving.api``) runs greedy acceptance and rolls back
         rejected suffix blocks.  One trace per distinct K — a server
         with a fixed speculation depth compiles this exactly once."""
-        tokens = jnp.asarray(tokens, jnp.int32)
+        args = self._verify_args(tokens, lengths, positions, tables)
         before = self._compile_mark(self._verify_jit)
-        self.cache, logits = self._verify_jit(
-            self.params, self.cache, tokens,
-            jnp.asarray(positions, jnp.int32),
-            jnp.asarray(lengths, jnp.int32),
-            jnp.asarray(tables, jnp.int32))
+        self.cache, logits = self._verify_jit(self.params, self.cache,
+                                              *args)
         self._note_compile(self._verify_jit, before, "verify",
-                           width=int(tokens.shape[1]))
+                           width=int(np.asarray(tokens).shape[1]))
         return logits
+
+    def verify_sampled(self, tokens, lengths, positions, tables):
+        """The fused-sampling twin of :meth:`verify`: returns
+        ``(token_ids (B, K) int32, finite (B, K) bool)`` device
+        arrays — every row's argmax and finite flag, the exact inputs
+        greedy acceptance needs — without materializing the
+        ``(B, K, V)`` logits block.  Same one-trace-per-width compile
+        discipline as :meth:`verify`."""
+        args = self._verify_args(tokens, lengths, positions, tables)
+        before = self._compile_mark(self._verify_sampled_jit)
+        self.cache, ids, fin = self._verify_sampled_jit(
+            self.params, self.cache, *args)
+        self._note_compile(self._verify_sampled_jit, before,
+                           "verify_sampled",
+                           width=int(np.asarray(tokens).shape[1]))
+        return ids, fin
 
     # -- introspection ----------------------------------------------------
 
@@ -435,17 +575,24 @@ class DecodeEngine:
         scheduler tests pin: prefill (monolithic buckets + chunk
         widths) <= len(prefill_buckets), decode == 1 regardless of
         traffic.  A fixed-chunk loop contributes exactly one chunk
-        trace (``chunk_prefill(pad_to=...)``)."""
+        trace (``chunk_prefill(pad_to=...)``).  Logits and sampled
+        twins count together: a server runs exactly one of the two
+        paths per program, so the audit's bounds are unchanged by the
+        pipelined loop."""
         return (self._prefill_jit._cache_size()
-                + self._chunk_jit._cache_size(),
-                self._decode_jit._cache_size())
+                + self._chunk_jit._cache_size()
+                + self._prefill_sampled_jit._cache_size()
+                + self._chunk_sampled_jit._cache_size(),
+                self._decode_jit._cache_size()
+                + self._decode_sampled_jit._cache_size())
 
     def verify_compiles(self) -> int:
-        """Verify-program traces — the speculation half of the
-        compile audit: a server with a fixed speculation depth must
-        show exactly 1 (0 with speculation off/idle) no matter how
-        drafts and batch composition vary."""
-        return self._verify_jit._cache_size()
+        """Verify-program traces (logits + sampled twins) — the
+        speculation half of the compile audit: a server with a fixed
+        speculation depth must show exactly 1 (0 with speculation
+        off/idle) no matter how drafts and batch composition vary."""
+        return (self._verify_jit._cache_size()
+                + self._verify_sampled_jit._cache_size())
 
     def memory_info(self) -> dict:
         """Static pool geometry for ``stats()["memory"]`` and

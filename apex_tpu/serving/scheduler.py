@@ -283,6 +283,14 @@ class Scheduler:
         # victim is always the youngest (LIFO), which converges:
         # the oldest request monotonically keeps its blocks
         self._admit_order: List[Request] = []
+        # in-flight hold (docs/serving.md, "Pipelined serve loop"):
+        # requests whose launched device step has NOT been retired yet.
+        # Their blocks are pinned — the pending program is still going
+        # to write K/V through those tables, so preempting or failing
+        # them out from under the launch would let the write land in
+        # reallocated blocks.  The serve loop holds at launch and
+        # releases at retire; audit() checks the pin.
+        self.inflight: Dict[int, Request] = {}      # uid -> request
 
     # -- submission -------------------------------------------------------
 
@@ -586,6 +594,27 @@ class Scheduler:
         self.lookahead_rolled_back += len(tail)
         return len(tail)
 
+    # -- pipelined in-flight hold (docs/serving.md) ------------------------
+
+    def hold_inflight(self, reqs: List[Request]) -> None:
+        """Pin ``reqs`` for the duration of a launched-but-not-retired
+        device step: until :meth:`release_inflight`, they may not be
+        preempted (their pending K/V writes would land in reallocated
+        blocks).  One launch window at a time — holding while a hold
+        is live is a serve-loop sequencing bug."""
+        assert not self.inflight, \
+            "hold_inflight while a launch window is already held"
+        for req in reqs:
+            assert req.running, \
+                f"in-flight hold on non-running request {req.uid}"
+            self.inflight[req.uid] = req
+
+    def release_inflight(self) -> None:
+        """The launched step's results were consumed (or its launch
+        failed before enqueue): the window's requests are ordinary
+        running requests again."""
+        self.inflight.clear()
+
     def frag_slots(self) -> int:
         """Allocated-but-unwritten token slots across running tables —
         each request's last partial block's slack plus any lookahead
@@ -607,6 +636,12 @@ class Scheduler:
         victim_key = None
         for i, req in enumerate(self._admit_order):
             if req is exclude:
+                continue
+            if req.uid in self.inflight:
+                # a launched-but-not-retired request's blocks are
+                # pinned: its pending device step still writes K/V
+                # through them (docs/serving.md, "Pipelined serve
+                # loop")
                 continue
             key = (req.priority, i)
             if victim_key is None or key > victim_key:
@@ -655,6 +690,7 @@ class Scheduler:
     def _release(self, req: Request) -> None:
         del self.running[req.slot]
         self._admit_order.remove(req)
+        self.inflight.pop(req.uid, None)
         self._free_slots.append(req.slot)
         req.slot = -1
         req.prefill_ctx = None
@@ -690,6 +726,16 @@ class Scheduler:
             assert not req.block_table, \
                 f"waiting request {req.uid} holds blocks"
             assert req.pending_cow is None
+        # the pipelined launch window: every in-flight request must
+        # still be running with its table intact — a preempted/failed/
+        # retired request lingering in the hold means the pending
+        # device step will write through blocks the scheduler already
+        # recycled (docs/serving.md, "Pipelined serve loop")
+        for uid, req in self.inflight.items():
+            assert req.running and self.running.get(req.slot) is req, \
+                f"in-flight request {uid} is no longer running"
+            assert req.block_table, \
+                f"in-flight request {uid} holds no blocks"
         free = set(alloc._free)
         assert len(alloc._free) == len(free) == len(alloc._free_set)
         assert free == alloc._free_set, "free list / free set diverged"

@@ -464,8 +464,11 @@ def test_breaker_open_transition_dumps_bundle(tiny, tmp_path):
         def __getattr__(self, name):
             return getattr(self.inner, name)
 
+    # pipeline off: PoisonEngine poisons decode logits, which the
+    # pipelined loop bypasses via the fused sampled program
     server = _server(cfg, params, clock=clock, breaker=breaker,
-                     postmortem_dir=pm, enable_speculation=False)
+                     postmortem_dir=pm, enable_speculation=False,
+                     enable_pipeline=False)
     server.engine = PoisonEngine(server.engine)
     server.submit([1, 2, 3], max_new_tokens=4)
     while server.scheduler.has_work:

@@ -228,12 +228,13 @@ def test_breaker_guards_submit_and_recovers(tiny):
     clock = {"t": 0.0}
     breaker = CircuitBreaker(failure_threshold=3, recovery_time=10.0,
                              clock=lambda: clock["t"])
-    # speculation off: the poison is injected through engine.decode,
-    # which a speculating server bypasses (verify-path isolation is
-    # covered by tests/L0/test_speculative.py)
+    # speculation and pipeline off: the poison is injected through
+    # engine.decode, which a speculating or pipelined server bypasses
+    # (verify-path isolation: tests/L0/test_speculative.py; fused-path
+    # breaker behavior: tests/L0/test_pipeline.py)
     server = _server(cfg, params, max_batch_size=4, max_context=64,
                      block_size=8, breaker=breaker,
-                     enable_speculation=False)
+                     enable_speculation=False, enable_pipeline=False)
     poison = {"on": True}
     orig = server.engine.decode
 
@@ -343,16 +344,19 @@ def test_transient_engine_oom_is_retried_bit_exactly(tiny):
     an undisturbed run, and the event is counted."""
     cfg, params = tiny
     prompts = [[3, 1, 4, 1, 5, 9, 2, 6], [2, 7, 1, 8]]
-    # speculation off in both arms: the flaky wrapper intercepts
-    # engine.decode, which a speculating server bypasses (verify-path
-    # OOM retry is covered by tests/L0/test_speculative.py)
+    # speculation and pipeline off in both arms: the flaky wrapper
+    # intercepts engine.decode, which a speculating or pipelined
+    # server bypasses (verify-path OOM retry:
+    # tests/L0/test_speculative.py; launch-time OOM retry:
+    # tests/L0/test_pipeline.py)
     baseline = _server(cfg, params, max_batch_size=2, max_context=64,
-                       block_size=8,
-                       enable_speculation=False).generate(
+                       block_size=8, enable_speculation=False,
+                       enable_pipeline=False).generate(
                            prompts, max_new_tokens=10)
 
     server = _server(cfg, params, max_batch_size=2, max_context=64,
-                     block_size=8, enable_speculation=False)
+                     block_size=8, enable_speculation=False,
+                     enable_pipeline=False)
     orig = server.engine.decode
     calls = {"n": 0}
 

@@ -203,8 +203,10 @@ def test_shared_prefix_parity_64_tokens_and_hits(tiny):
     assert st["prefix_hit_requests"] >= 1
     assert 0.0 < st["prefix_hit_rate"] <= 1.0
     assert st["prefill_chunks"] > len(prompts)  # chunking actually ran
-    # exactly ONE chunk program despite many chunk lengths
-    assert srv.engine._chunk_jit._cache_size() == 1
+    # exactly ONE chunk program despite many chunk lengths (the
+    # default pipelined loop compiles the fused sampled twin)
+    assert (srv.engine._chunk_jit._cache_size()
+            + srv.engine._chunk_sampled_jit._cache_size()) == 1
 
 
 def test_multi_chunk_long_prompt_parity(tiny):
@@ -302,8 +304,10 @@ def test_opt_out_flags_restore_cacheless_behavior(tiny):
     assert srv.scheduler.prefix_cache is None
     assert srv.prefill_chunk is None
     out = _audited_generate(srv, prompts, 16)
-    assert srv.engine._chunk_jit._cache_size() == 0    # never traced
-    assert srv.engine._prefill_jit._cache_size() >= 1  # monolithic ran
+    assert (srv.engine._chunk_jit._cache_size()
+            + srv.engine._chunk_sampled_jit._cache_size()) == 0
+    assert (srv.engine._prefill_jit._cache_size()        # monolithic
+            + srv.engine._prefill_sampled_jit._cache_size()) >= 1
     st = srv.stats()
     assert "prefix_hit_tokens" not in st
     assert st["prefill_chunks"] == 0
@@ -320,8 +324,11 @@ def test_chunked_prefill_interleaves_with_decode(tiny):
     # speculation off: the per-iteration "+1 token" probe below IS the
     # structural claim; a speculating server emits several tokens per
     # step and would blur it
+    # pipeline off for the same pacing reason: retired-one-step-late
+    # tokens would break the per-iteration "+1 token" probe
     srv = _server(cfg, params, on=True, max_batch_size=2,
-                  prefill_chunk=8, enable_speculation=False)
+                  prefill_chunk=8, enable_speculation=False,
+                  enable_pipeline=False)
     short = srv.submit([1, 2, 3], 40)
     # get the short request decoding
     for _ in range(3):

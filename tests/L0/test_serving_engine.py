@@ -216,6 +216,14 @@ def test_stats_keys_are_backward_compatible(tiny):
     assert not spec - st["speculation"].keys(), \
         f"stats() lost speculation keys: {spec - st['speculation'].keys()}"
     assert st["speculation"]["enabled"] is True    # default-on server
+    # pipelined serve loop keys (docs/serving.md) ride alongside in
+    # their own block — the pipeline bench and dashboards key on these
+    pipe = {"enabled", "depth", "launches", "retired_behind",
+            "pending", "host_stall_ms", "host_plan_ms"}
+    assert not pipe - st["pipeline"].keys(), \
+        f"stats() lost pipeline keys: {pipe - st['pipeline'].keys()}"
+    assert st["pipeline"]["enabled"] is True       # default-on server
+    assert st["pipeline"]["pending"] == 0          # idle server
     lat = st["latency"]
     assert set(lat) == {"ttft_ms", "queue_wait_ms", "decode_token_ms",
                         "step_ms", "queue_wait_by_priority_ms"}

@@ -249,12 +249,17 @@ def test_nonfinite_decode_row_evicts_only_poisoned_request(tiny):
     # engine.decode, which a speculating server bypasses (the verify
     # path's non-finite isolation has its own test in
     # tests/L0/test_speculative.py)
+    # pipeline off in both arms too: the poison injects through
+    # engine.decode, which the pipelined loop bypasses (finite-flag
+    # poisoning of the fused path: tests/L0/test_pipeline.py)
     clean = _server(cfg, params, max_batch_size=2, max_context=64,
-                    block_size=8, enable_speculation=False)
+                    block_size=8, enable_speculation=False,
+                    enable_pipeline=False)
     baseline = clean.generate(prompts, max_new_tokens=12)
 
     server = _server(cfg, params, max_batch_size=2, max_context=64,
-                     block_size=8, enable_speculation=False)
+                     block_size=8, enable_speculation=False,
+                     enable_pipeline=False)
     victim = server.submit(prompts[0], 12)
     other = server.submit(prompts[1], 12)
     orig_decode = server.engine.decode
@@ -286,9 +291,12 @@ def test_nonfinite_decode_row_evicts_only_poisoned_request(tiny):
 
 def test_nonfinite_prefill_fails_request_before_first_token(tiny):
     # chunked prefill is the default path, so the fault injects there
+    # (pipeline off: the pipelined loop samples prefills through the
+    # fused chunk_prefill_sampled twin instead — covered by
+    # tests/L0/test_pipeline.py)
     cfg, params = tiny
     server = _server(cfg, params, max_batch_size=2, max_context=64,
-                     block_size=8)
+                     block_size=8, enable_pipeline=False)
     orig_chunk = server.engine.chunk_prefill
 
     def poisoned(tokens, start, block_table, pad_to=None):

@@ -98,6 +98,28 @@ echo "=== build-matrix axis: serving-speculative-smoke ==="
 env JAX_PLATFORMS=cpu python tools/serving_bench.py --smoke --speculative --out -
 results[serving_spec]=$?
 
+# pipelined serve loop: the dispatch-ahead axis (docs/serving.md,
+# "Pipelined serve loop") — three gates in one:
+#   1. serving_bench --pipeline: pipelined-vs-synchronous A/B over
+#      identical decode-heavy traffic; bit-exact greedy parity always,
+#      >= 1.25x step-throughput floor on overlap-capable (>= 2 core)
+#      hosts, no-regression floor on single-core ones;
+#   2. an 800-iteration seed-0 chaos soak with pipelining explicitly
+#      on — every composed fault retires across the dispatch-ahead
+#      window with the same invariants as the main soak;
+#   3. the traced bench run must emit the pipelined loop's launch and
+#      retire spans (tools/obs_dump.py --require, exit 1 if missing).
+echo "=== build-matrix axis: pipeline ==="
+pipe_trace=$(mktemp -u).trace.json
+env JAX_PLATFORMS=cpu APEX_TPU_TRACE="$pipe_trace" \
+    python tools/serving_bench.py --smoke --pipeline --out - \
+  && python tools/obs_dump.py trace "$pipe_trace" \
+      --require launch --require retire \
+  && env JAX_PLATFORMS=cpu python tools/chaos_soak.py --seed 0 \
+      --iters 800 --pipeline
+results[pipeline]=$?
+rm -f "$pipe_trace"
+
 # chaos soak: the overload-robustness axis (docs/resilience.md,
 # "Overload policy & lifecycle") — the full serving stack (prefix
 # cache + chunked prefill + overload control + circuit breaker, small

@@ -87,6 +87,17 @@ def main(argv=None) -> int:
                         help="speculation-enabled traffic class: "
                         "serve with speculative decoding on and mix "
                         "in repetitive prompts so drafts fire")
+    parser.add_argument("--pipeline", dest="pipeline",
+                        action="store_true", default=True,
+                        help="soak the pipelined (dispatch-ahead) "
+                        "serve loop — the server default; outputs "
+                        "and the report's healthy numbers are "
+                        "byte-identical either way "
+                        "(docs/serving.md, 'Pipelined serve loop')")
+    parser.add_argument("--no-pipeline", dest="pipeline",
+                        action="store_false",
+                        help="soak the strictly synchronous step "
+                        "loop instead")
     parser.add_argument("--postmortem-dir", default=None,
                         help="dump a postmortem bundle here on any "
                         "invariant violation (docs/observability.md)")
@@ -123,6 +134,7 @@ def main(argv=None) -> int:
             block_size=4, num_blocks=40,          # 39 usable blocks
             cache_dtype=jnp.float32, max_waiting=8, clock=clock,
             enable_speculation=args.speculative,
+            enable_pipeline=args.pipeline,
             flight_recorder=FlightRecorder(
                 capacity=max(4096, 2 * args.iters)),
             breaker=CircuitBreaker(failure_threshold=3,
@@ -135,7 +147,8 @@ def main(argv=None) -> int:
         return InferenceServer(
             cfg, params, max_batch_size=4, max_context=64,
             block_size=4, cache_dtype=jnp.float32, clock=clock,
-            enable_speculation=args.speculative)
+            enable_speculation=args.speculative,
+            enable_pipeline=args.pipeline)
 
     chaos_cfg = ChaosConfig(
         iters=args.iters, vocab=VOCAB,
