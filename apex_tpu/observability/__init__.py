@@ -27,6 +27,21 @@ Two pieces, both process-wide and dependency-free:
   per class, goodput-vs-throughput token counters, and SLO-debt
   accounting for overload shed/displace decisions
   (``stats()["slo"]``).
+- :mod:`observability.opsplane` — :class:`OpsServer`, the embedded
+  loopback HTTP ops endpoint (``/healthz``, ``/metrics``,
+  ``/statusz``, ``/debug/flight``, ``/debug/requests/<uid>``,
+  ``POST /drain`` / ``/postmortem``); off by default
+  (``ops_port=`` / ``APEX_TPU_OPS_PORT``), probed by
+  ``tools/ops_probe.py``.
+- :mod:`observability.watchdog` — :class:`HangWatchdog`, the serve
+  loop's dead-man's switch: step-loop heartbeats, a no-progress
+  deadline, thread-stack + postmortem capture on stall, and a 503
+  ``/healthz`` flip; disabled by default at zero cost
+  (:data:`NULL_WATCHDOG`).
+- :mod:`observability.programs` — :class:`ProgramAccounting`,
+  per-compiled-program call/wall/compile tallies behind the pinned
+  ``stats()["programs"]`` table and the
+  ``serving_program_*`` registry counters.
 
 What is instrumented out of the box: the serving step loop (admit /
 prefix-match / chunk-prefill / decode / evict / preempt spans,
@@ -44,14 +59,26 @@ from apex_tpu.observability.flightrecorder import (
     NullFlightRecorder,
     write_postmortem,
 )
+from apex_tpu.observability.opsplane import OPS_PORT_ENV, OpsServer
+from apex_tpu.observability.programs import (
+    NULL_PROGRAM_ACCOUNTING,
+    NullProgramAccounting,
+    ProgramAccounting,
+)
 from apex_tpu.observability.registry import (
     Counter,
     Gauge,
     HistogramMeter,
     MetricsRegistry,
+    PROMETHEUS_CONTENT_TYPE,
     escape_label_value,
     series_key,
     snapshot_diff,
+)
+from apex_tpu.observability.watchdog import (
+    NULL_WATCHDOG,
+    HangWatchdog,
+    NullWatchdog,
 )
 from apex_tpu.observability.slo import SLOPolicy, SLOTargets, SLOTracker
 from apex_tpu.observability.tracing import (
@@ -68,13 +95,22 @@ __all__ = [
     "Counter",
     "FlightRecorder",
     "Gauge",
+    "HangWatchdog",
     "HistogramMeter",
     "MetricsRegistry",
     "NULL_FLIGHT_RECORDER",
+    "NULL_PROGRAM_ACCOUNTING",
     "NULL_TRACER",
+    "NULL_WATCHDOG",
     "NullFlightRecorder",
+    "NullProgramAccounting",
     "NullTracer",
+    "NullWatchdog",
+    "OPS_PORT_ENV",
+    "OpsServer",
     "POSTMORTEM_ENV",
+    "PROMETHEUS_CONTENT_TYPE",
+    "ProgramAccounting",
     "SLOPolicy",
     "SLOTargets",
     "SLOTracker",

@@ -1,0 +1,290 @@
+"""Embedded HTTP ops plane for a live :class:`InferenceServer`.
+
+Everything the observability stack accumulated so far —
+``stats()``, ``prometheus_text()``, the flight ring, per-request
+timelines, postmortem bundles — was reachable only by code already
+holding the server object.  The ops plane puts those signals on the
+wire: a dependency-free stdlib ``http.server`` on a daemon thread,
+loopback-bound, OFF by default (``ops_port=`` or
+``APEX_TPU_OPS_PORT``; port 0 binds an ephemeral port, readable back
+from :attr:`OpsServer.port`).  This is what the ROADMAP's
+multi-replica front door scrapes to load-balance and fail over — and
+what an operator curls at 3am.
+
+Endpoints:
+
+- ``GET /healthz`` — liveness/readiness in one probe: 200
+  ``{"status": "ok"}`` on a healthy server, 503 with ``"draining"``
+  / ``"breaker_open"`` / ``"stalled"`` (watchdog) / ``"closed"``
+  otherwise, so a router can pull the replica on status code alone.
+  Deliberately **lock-free** (plain attribute reads): the one moment
+  health must answer is while the serve loop is wedged holding the
+  ops lock.
+- ``GET /metrics`` — ``MetricsRegistry.prometheus_text()`` under the
+  proper ``text/plain; version=0.0.4`` content type (scrapers key on
+  it).  Also lock-free: a scrape must not block behind a slow step.
+- ``GET /statusz`` — the full ``stats()`` JSON (programs table,
+  watchdog, SLO, memory, ...), serialized against the step loop.
+- ``GET /debug/flight?n=N`` — the flight-recorder tail as JSONL
+  (empty with the null recorder).
+- ``GET /debug/requests/<uid>`` — one request's ``timeline()`` (the
+  slice ``tools/postmortem.py --request`` renders from bundles, but
+  live) plus its current state; 404 for unknown uids.
+- ``POST /drain`` / ``POST /postmortem`` — authenticated-by-loopback
+  triggers into :meth:`InferenceServer.drain` /
+  :meth:`~InferenceServer.dump_postmortem` (non-loopback peers get
+  403; the listener is loopback-bound anyway — defense in depth).
+
+Mutating reads (``/statusz``, ``/debug/*``) and the POST triggers
+serialize against the serve loop through :attr:`OpsServer.lock` —
+``InferenceServer.step()`` holds it per iteration *only while an ops
+plane is attached*, so servers without one pay nothing.  Request
+handling is bounded: loopback bind, per-connection socket timeout,
+a request-body cap, and one-shot HTTP/1.0 connections.
+
+``tools/ops_probe.py`` is the CLI client (poll, ``--assert-healthy``
+gate, program-table rendering).  See ``docs/observability.md``,
+"Ops plane & watchdog".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from apex_tpu.observability.registry import PROMETHEUS_CONTENT_TYPE
+
+OPS_PORT_ENV = "APEX_TPU_OPS_PORT"
+
+_LOOPBACK = ("127.0.0.1", "::1", "::ffff:127.0.0.1")
+
+# one request body bound — the POST triggers carry no payload, so
+# anything large is abuse, not traffic
+_MAX_BODY = 64 * 1024
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Routes every request through the owning :class:`OpsServer`."""
+
+    timeout = 10.0            # per-connection socket budget (bounded)
+
+    def do_GET(self):         # noqa: N802 — http.server API
+        self.server.ops._handle(self, "GET")
+
+    def do_POST(self):        # noqa: N802
+        self.server.ops._handle(self, "POST")
+
+    def log_message(self, fmt, *args):
+        pass                  # counted in the registry, not stderr
+
+
+class OpsServer:
+    """The embedded ops endpoint for one ``InferenceServer``.
+
+    Args:
+      server: the (duck-typed) ``InferenceServer`` to expose.
+      port: TCP port on loopback; 0 binds an ephemeral port
+        (:attr:`port` holds the real one).
+      host: bind address — loopback by default and by intent.
+      clock: injectable seconds source for ``/healthz`` uptime
+        (default: the serving server's own clock).
+      counters: optional ``CounterMeter`` (label ``endpoint``)
+        counting handled requests into the shared registry.
+    """
+
+    def __init__(self, server, *, port: int = 0,
+                 host: str = "127.0.0.1", clock=None, counters=None):
+        self.server = server
+        self.lock = threading.RLock()
+        self.counters = counters
+        self._clock = clock if clock is not None else server.clock
+        self._started_at = self._clock()
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.ops = self
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "OpsServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                kwargs={"poll_interval": 0.2},
+                name="apex-tpu-ops", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        t, self._thread = self._thread, None
+        if t is not None:
+            self._httpd.shutdown()
+            t.join(timeout=5.0)
+        self._httpd.server_close()
+
+    # -- routing -----------------------------------------------------------
+
+    def _handle(self, h: BaseHTTPRequestHandler, method: str) -> None:
+        url = urlparse(h.path)
+        path, query = url.path.rstrip("/") or "/", parse_qs(url.query)
+        try:
+            if method == "GET":
+                if path == "/healthz":
+                    return self._count_send(h, "healthz",
+                                            *self._healthz())
+                if path == "/metrics":
+                    text = self.server.registry.prometheus_text()
+                    return self._count_send(
+                        h, "metrics", 200, text.encode(),
+                        PROMETHEUS_CONTENT_TYPE)
+                if path == "/statusz":
+                    with self.lock:
+                        stats = self.server.stats()
+                    return self._count_send(h, "statusz",
+                                            *_json(200, stats))
+                if path == "/debug/flight":
+                    return self._count_send(h, "debug_flight",
+                                            *self._flight(query))
+                if path.startswith("/debug/requests/"):
+                    return self._count_send(
+                        h, "debug_requests",
+                        *self._request(path.rsplit("/", 1)[1]))
+            elif method == "POST":
+                if h.client_address[0] not in _LOOPBACK:
+                    return self._count_send(h, "forbidden", *_json(
+                        403, {"error": "loopback only"}))
+                body = self._read_body(h)
+                if body is None:
+                    return self._count_send(h, "too_large", *_json(
+                        413, {"error": "request body too large"}))
+                if path == "/drain":
+                    return self._count_send(h, "drain",
+                                            *self._drain())
+                if path == "/postmortem":
+                    return self._count_send(h, "postmortem",
+                                            *self._postmortem())
+            self._count_send(h, "unknown", *_json(
+                404, {"error": f"no such endpoint: {method} {path}"}))
+        except (BrokenPipeError, ConnectionResetError):
+            pass              # client went away mid-reply; nothing owed
+        except Exception as e:  # noqa: BLE001 — a handler bug must
+            #                     not kill the ops thread pool
+            try:
+                self._count_send(h, "error",
+                                 *_json(500, {"error": repr(e)}))
+            except OSError:
+                pass
+
+    def _count_send(self, h, endpoint: str, code: int, body: bytes,
+                    content_type: str) -> None:
+        if self.counters is not None:
+            self.counters.incr(endpoint)
+        h.send_response(code)
+        h.send_header("Content-Type", content_type)
+        h.send_header("Content-Length", str(len(body)))
+        h.end_headers()
+        h.wfile.write(body)
+
+    @staticmethod
+    def _read_body(h) -> Optional[bytes]:
+        """Bounded body read; None = over the cap (413)."""
+        n = int(h.headers.get("Content-Length") or 0)
+        if n > _MAX_BODY:
+            return None
+        return h.rfile.read(n) if n else b""
+
+    # -- endpoint bodies ---------------------------------------------------
+
+    def _healthz(self) -> Tuple[int, bytes, str]:
+        """Lock-free health: readable even while the serve loop is
+        wedged inside a step holding the ops lock."""
+        srv = self.server
+        if srv.watchdog.stalled:
+            status = "stalled"
+        elif srv.closed:
+            status = "closed"
+        elif srv.draining:
+            status = "draining"
+        elif srv.breaker is not None and srv.breaker.state == "open":
+            status = "breaker_open"
+        else:
+            status = "ok"
+        body = {
+            "status": status,
+            "iter": srv._iter,
+            "breaker": (srv.breaker.state if srv.breaker is not None
+                        else "disabled"),
+            "pressure": round(srv.pressure_gauge.val, 4),
+            "watchdog_stalls": srv.watchdog.stalls,
+            "uptime_s": round(self._clock() - self._started_at, 3),
+        }
+        return _json(200 if status == "ok" else 503, body)
+
+    def _flight(self, query) -> Tuple[int, bytes, str]:
+        try:
+            n = int(query.get("n", ["50"])[0])
+        except ValueError:
+            return _json(400, {"error": "n must be an integer"})
+        with self.lock:
+            records = self.server.recorder.records()
+        tail = records[-n:] if n > 0 else ()
+        body = "".join(json.dumps(r, sort_keys=True) + "\n"
+                       for r in tail)
+        return 200, body.encode(), "application/jsonl; charset=utf-8"
+
+    def _request(self, uid_text: str) -> Tuple[int, bytes, str]:
+        try:
+            uid = int(uid_text)
+        except ValueError:
+            return _json(400, {"error": f"bad uid: {uid_text!r}"})
+        with self.lock:
+            sched = self.server.scheduler
+            req, state = None, None
+            for r in sched.finished:
+                if r.uid == uid:
+                    req, state = r, "finished"
+                    break
+            if req is None:
+                r = sched.running.get(uid)
+                if r is not None:
+                    req, state = r, "running"
+            if req is None:
+                for r in sched.waiting:
+                    if r.uid == uid:
+                        req, state = r, "waiting"
+                        break
+            if req is None:
+                return _json(404, {"error": f"unknown request {uid}"})
+            body = {"state": state, "timeline": req.timeline()}
+        return _json(200, body)
+
+    def _drain(self) -> Tuple[int, bytes, str]:
+        with self.lock:
+            stats = self.server.drain()
+        return _json(200, {
+            "status": "drained",
+            "requests_finished": stats["requests_finished"]})
+
+    def _postmortem(self) -> Tuple[int, bytes, str]:
+        srv = self.server
+        base = srv._postmortem_dir or tempfile.gettempdir()
+        path = os.path.join(base, f"ops_postmortem_iter{srv._iter}")
+        i = 1
+        while os.path.exists(path):
+            path = os.path.join(
+                base, f"ops_postmortem_iter{srv._iter}_{i}")
+            i += 1
+        with self.lock:
+            manifest = srv.dump_postmortem(path, reason="ops_request")
+        return _json(200, {"path": path, "manifest": manifest})
+
+
+def _json(code: int, payload) -> Tuple[int, bytes, str]:
+    body = json.dumps(payload, sort_keys=True, default=str).encode()
+    return code, body, "application/json; charset=utf-8"

@@ -43,6 +43,13 @@ from typing import Any, Dict, Iterable, Optional, Tuple
 
 LabelItems = Tuple[Tuple[str, str], ...]
 
+# the exposition-format content type an HTTP scrape must be served
+# under (Prometheus content negotiation keys on the version token;
+# bare "text/plain" is parsed by some scrapers and rejected by
+# others).  One definition, shared by the ops plane's /metrics
+# endpoint and the conformance tests.
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
 
 def _label_items(labels: Dict[str, Any]) -> LabelItems:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
@@ -338,7 +345,9 @@ class MetricsRegistry:
         ``# HELP`` and one ``# TYPE`` line (set text via
         :meth:`set_help`; a default is synthesized), and label values
         are escaped per the format spec — conformance is pinned by a
-        line-parsing test in ``tests/L0/test_observability.py``."""
+        line-parsing test in ``tests/L0/test_observability.py``.
+        Serve this over HTTP under :data:`PROMETHEUS_CONTENT_TYPE`
+        (the ops plane's ``/metrics`` endpoint does)."""
         by_name: Dict[str, list] = {}
         for m in self.metrics():
             by_name.setdefault(m.name, []).append(m)

@@ -1,0 +1,191 @@
+"""Hang/stall watchdog for the serving step loop.
+
+Everything observability had until now — metrics, traces, the flight
+recorder — only works while the loop keeps *running*.  A wedged
+engine (a device hang, a deadlocked host thread, an engine call that
+never returns) produces the one failure mode none of it can report:
+silence.  This module is the dead-man's switch:
+
+- :class:`HangWatchdog` — a daemon thread fed step-loop heartbeats by
+  ``InferenceServer.step()`` (``step_started`` / ``step_finished``,
+  plain attribute stores on the hot path).  It declares a stall when
+  either (a) a step has been *in flight* longer than ``deadline_s``
+  (hung inside an engine call), or (b) the last completed step left
+  work pending and no new step started within ``deadline_s`` (the
+  loop itself died).  An idle server — no step in flight, no work
+  pending — is never a stall: a front door with no traffic is healthy
+  silence, not a hang.  Detection is one-shot per stall (latched
+  until the next completed step clears it), so a single hang fires
+  exactly once no matter how long it lasts.
+- On a stall the server-installed handler dumps every thread's stack
+  (:mod:`faulthandler`) plus a postmortem bundle through the PR-7
+  machinery, flips ``/healthz`` to 503, and increments the
+  ``serving_watchdog_stalls`` counter — the black box is preserved
+  *by the watchdog thread* while the serve thread is still stuck in
+  whatever wedged it.
+- :data:`NULL_WATCHDOG` — the disabled default, the
+  ``NULL_FLIGHT_RECORDER`` pattern: the step loop guards heartbeats
+  on ``watchdog.enabled``, so the disabled path adds zero work and
+  zero allocations per step (tracemalloc-pinned).
+
+``poll_interval_s=None`` runs no thread at all — tests drive
+:meth:`check` directly on an injected clock, so stall detection is
+provable without sleeping.  The chaos build-matrix soak runs with the
+watchdog armed on the real clock; a healthy soak must never fire it
+(asserted by :func:`resilience.chaos.run_soak`).  See
+``docs/observability.md``, "Ops plane & watchdog".
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+
+class NullWatchdog:
+    """The disabled watchdog: heartbeats are guarded out by
+    ``enabled`` and every hook is a no-op."""
+
+    enabled = False
+    stalled = False
+    stalls = 0
+    deadline_s = None
+
+    def step_started(self) -> None:
+        pass
+
+    def step_finished(self, has_work: bool = False) -> None:
+        pass
+
+    def check(self, now: Optional[float] = None) -> bool:
+        return False
+
+    def start(self) -> "NullWatchdog":
+        return self
+
+    def stop(self) -> None:
+        pass
+
+
+NULL_WATCHDOG = NullWatchdog()
+
+
+class HangWatchdog:
+    """Step-loop heartbeat monitor with one-shot stall detection.
+
+    Args:
+      deadline_s: no-progress budget — a step in flight (or pending
+        work with no step starting) for longer than this is a stall.
+        Size it to worst-case legitimate step time with margin: a
+        first-call compile is the slowest healthy "step" a server
+        ever runs.
+      poll_interval_s: the watchdog thread's check cadence (default
+        ``min(1, deadline_s / 4)``).  ``None`` = no thread; the owner
+        calls :meth:`check` itself (deterministic tests).
+      clock: injectable monotonic-seconds source.
+      on_stall: ``callable(info_dict)`` run on the watchdog thread at
+        detection (``InferenceServer`` installs its own handler:
+        thread-stack dump + postmortem bundle + stall counter).  A
+        raising handler is reported to stderr, never propagated — the
+        watchdog must not take the process down.
+    """
+
+    enabled = True
+
+    def __init__(self, deadline_s: float = 30.0, *,
+                 poll_interval_s: Optional[float] = 1.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 on_stall: Optional[Callable[[dict], None]] = None):
+        if deadline_s <= 0:
+            raise ValueError(
+                f"deadline_s must be > 0, got {deadline_s}")
+        self.deadline_s = float(deadline_s)
+        self.poll_interval_s = (
+            None if poll_interval_s is None
+            else min(float(poll_interval_s), self.deadline_s / 4))
+        self._clock = clock
+        self.on_stall = on_stall
+        self.stalls = 0
+        self.stalled = False
+        self._in_step = False
+        self._step_started_at: Optional[float] = None
+        self._last_progress: Optional[float] = None
+        self._pending = False
+        self._fired = False          # latched: one detection per stall
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+
+    # -- hot-path heartbeats (serve thread; attribute stores only) --------
+
+    def step_started(self) -> None:
+        self._step_started_at = self._clock()
+        self._in_step = True
+
+    def step_finished(self, has_work: bool = False) -> None:
+        """One step completed: record progress, note whether the loop
+        is obligated to step again (``has_work``), and clear any
+        latched stall — the loop is demonstrably moving again."""
+        now = self._clock()
+        self._in_step = False
+        self._last_progress = now
+        self._pending = bool(has_work)
+        if self._fired:
+            self._fired = False
+            self.stalled = False
+
+    # -- detection (watchdog thread, or tests directly) --------------------
+
+    def check(self, now: Optional[float] = None) -> bool:
+        """One watchdog evaluation; True exactly when a NEW stall is
+        declared (the handler has already run by then)."""
+        if self._fired:
+            return False             # latched until progress resumes
+        if now is None:
+            now = self._clock()
+        if self._in_step:
+            mark, where = self._step_started_at, "in_step"
+        elif self._pending:
+            mark, where = self._last_progress, "between_steps"
+        else:
+            return False             # idle: silence is healthy
+        if mark is None or now - mark < self.deadline_s:
+            return False
+        self._fired = True
+        self.stalled = True
+        self.stalls += 1
+        info = {"where": where,
+                "age_s": round(now - mark, 3),
+                "deadline_s": self.deadline_s,
+                "stalls": self.stalls}
+        if self.on_stall is not None:
+            try:
+                self.on_stall(info)
+            except Exception as e:   # noqa: BLE001 — never kill the dog
+                print(f"apex_tpu watchdog: on_stall handler failed: "
+                      f"{e!r}", file=sys.stderr)
+        return True
+
+    # -- thread lifecycle ---------------------------------------------------
+
+    def start(self) -> "HangWatchdog":
+        """Spawn the daemon check thread (no-op in manual mode or if
+        already running); returns self for chaining."""
+        if self.poll_interval_s is None or self._thread is not None:
+            return self
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="apex-tpu-watchdog", daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop_evt.wait(self.poll_interval_s):
+            self.check()
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)

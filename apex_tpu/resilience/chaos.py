@@ -379,7 +379,11 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
          capacity / nonfinite) produced a bit-exact PREFIX of it;
       6. ``stats()`` reconciles with observed outcomes: finished
          count, per-reason failure counters, breaker rejections, and
-         injected-vs-counted OOM events all agree.
+         injected-vs-counted OOM events all agree;
+      7. an armed hang watchdog (``tools/chaos_soak.py`` arms one on
+         the real clock) recorded ZERO stalls — composed faults are
+         not hangs, and a soak is the strongest false-positive trial
+         the detector gets.
     """
     schedule = ChaosSchedule.generate(cfg, seed)
     clock_state = {"t": 0.0}
@@ -525,6 +529,15 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
             (f"server counted {stats['oom_events']} OOM events, chaos "
              f"injected {chaos.injected['oom']}")
         assert report["crashes_caught"] == chaos.injected["crashes"]
+        # an armed hang watchdog must ride the whole soak — thousands
+        # of iterations of composed faults, none of them a hang —
+        # without a single false positive (docs/observability.md,
+        # "Ops plane & watchdog")
+        if stats["watchdog"]["enabled"]:
+            assert stats["watchdog"]["stalls"] == 0, \
+                (f"watchdog fired {stats['watchdog']['stalls']} "
+                 f"time(s) on a healthy soak (deadline "
+                 f"{stats['watchdog']['deadline_s']}s)")
     except AssertionError as e:
         _postmortem_and_reraise(e)
 
@@ -548,5 +561,7 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
         flight_steps=stats["flight"]["steps_recorded"],
         goodput_ratio=stats["slo"]["goodput_ratio"],
         kv_live_peak=stats["memory"]["blocks_live_peak"],
+        watchdog_armed=stats["watchdog"]["enabled"],
+        watchdog_stalls=stats["watchdog"]["stalls"],
     )
     return report

@@ -29,6 +29,20 @@ transitions, and on :meth:`InferenceServer.audit` failure;
 goodput vs throughput, and ``stats()["memory"]`` the KV pool's
 free/live/evictable occupancy, high-watermarks, and fragmentation.
 
+Ops plane (``docs/observability.md``, "Ops plane & watchdog"): an
+opt-in loopback HTTP endpoint (``ops_port=`` / ``APEX_TPU_OPS_PORT``)
+serves ``/healthz`` (status-code health a router can key on),
+``/metrics`` (Prometheus text under the proper content type),
+``/statusz`` (full ``stats()``), ``/debug/flight`` and
+``/debug/requests/<uid>`` live slices, and loopback-authenticated
+``POST /drain`` / ``POST /postmortem`` triggers; an opt-in
+:class:`observability.HangWatchdog` turns step-loop silence into a
+detection — thread stacks + postmortem bundle + a 503 ``/healthz`` —
+exactly once per stall; and per-compiled-program accounting
+(``stats()["programs"]``, on by default) tallies every engine launch
+per program/shape key so "where does the step go" is answerable per
+program, not just per phase.
+
 Pipelined serve loop (``docs/serving.md``, "Pipelined serve loop"; ON
 by default, ``enable_pipeline=False`` opts out, a custom ``sample_fn``
 auto-disables): each :meth:`step` first RETIRES the previous
@@ -99,6 +113,8 @@ exactly once and makes further submission an error.
 
 from __future__ import annotations
 
+import contextlib
+import faulthandler
 import os
 import time
 from typing import Callable, Dict, List, Optional, Sequence
@@ -107,9 +123,15 @@ import numpy as np
 
 from apex_tpu.observability import (
     NULL_FLIGHT_RECORDER,
+    NULL_PROGRAM_ACCOUNTING,
+    NULL_WATCHDOG,
+    OPS_PORT_ENV,
     POSTMORTEM_ENV,
     FlightRecorder,
+    HangWatchdog,
     MetricsRegistry,
+    OpsServer,
+    ProgramAccounting,
     SLOPolicy,
     SLOTracker,
     get_tracer,
@@ -132,6 +154,10 @@ RECENT_RATE_WINDOW_S = 10.0
 # one: small enough that a chunk costs roughly a decode step at typical
 # model sizes, large enough to amortize the per-chunk context gather
 DEFAULT_PREFILL_CHUNK = 256
+
+# the no-ops-plane lock stand-in: reusable, reentrant, allocation-free
+# on entry — servers without an ops endpoint never take a real lock
+_NO_LOCK = contextlib.nullcontext()
 
 # default speculation depth (max drafted tokens per verify step).  The
 # verify program is spec_tokens + 1 columns wide; deeper speculation
@@ -301,10 +327,39 @@ class InferenceServer:
         ``postmortem_dir`` (or ``APEX_TPU_POSTMORTEM``) is set, else
         the zero-allocation ``NULL_FLIGHT_RECORDER``.
       postmortem_dir: where auto-dumped postmortem bundles land
-        (breaker-open transitions, :meth:`audit` failures; chaos-soak
-        invariant violations via :func:`resilience.chaos.run_soak`).
+        (breaker-open transitions, :meth:`audit` failures, watchdog
+        stalls; chaos-soak invariant violations via
+        :func:`resilience.chaos.run_soak`).
         ``APEX_TPU_POSTMORTEM=/dir`` is the env twin.  On-demand
         bundles go wherever :meth:`dump_postmortem` is pointed.
+      enable_program_accounting: per-compiled-program launch tallies
+        (``docs/observability.md``, "Ops plane & watchdog"; ON by
+        default): every engine program launch — prefill / chunk /
+        decode / verify, logits and sampled twins, per bucket/width
+        key — feeds the pinned ``stats()["programs"]`` table and the
+        ``serving_program_*`` registry counters with call count, host
+        wall time, and compile count/time, so "where does the step
+        go" is answerable per program.  Accounting never feeds back
+        into scheduling; opt out to shave the per-launch clock reads.
+      watchdog: a :class:`observability.HangWatchdog` arming hang
+        detection on this server's step loop: :meth:`step` feeds it
+        heartbeats, and a step (or a step *gap* with work pending)
+        exceeding the watchdog's deadline dumps every thread's stack
+        plus a postmortem bundle (under ``postmortem_dir``, when
+        set), flips the ops plane's ``/healthz`` to 503, and
+        increments ``serving_watchdog_stalls`` — exactly once per
+        stall.  Default: disabled at zero per-step cost
+        (``NULL_WATCHDOG``).  The server installs its stall handler
+        and starts the watchdog thread; :meth:`close` stops it.
+      ops_port: turn on the embedded HTTP ops plane
+        (:class:`observability.OpsServer`) on this loopback port
+        (0 = ephemeral; the bound port is ``server.ops.port``):
+        ``/healthz``, ``/metrics``, ``/statusz``,
+        ``/debug/flight``, ``/debug/requests/<uid>``,
+        ``POST /drain`` / ``/postmortem``.  Default: off
+        (``APEX_TPU_OPS_PORT`` is the env twin).  While attached,
+        :meth:`step` serializes against ops reads through the ops
+        lock; without it the loop takes no lock at all.
 
     Example::
 
@@ -338,7 +393,10 @@ class InferenceServer:
                  tracer=None,
                  slo_policy: Optional[SLOPolicy] = None,
                  flight_recorder: Optional[FlightRecorder] = None,
-                 postmortem_dir: Optional[str] = None):
+                 postmortem_dir: Optional[str] = None,
+                 enable_program_accounting: bool = True,
+                 watchdog: Optional[HangWatchdog] = None,
+                 ops_port: Optional[int] = None):
         self.registry = registry if registry is not None \
             else MetricsRegistry()
         self.tracer = tracer if tracer is not None else get_tracer()
@@ -353,12 +411,17 @@ class InferenceServer:
             self.recorder = (FlightRecorder() if self._postmortem_dir
                              else NULL_FLIGHT_RECORDER)
         self.slo = SLOTracker(slo_policy, registry=self.registry)
+        # per-compiled-program accounting (docs/observability.md,
+        # "Ops plane & watchdog"): observation only, so on by default
+        self.programs = (ProgramAccounting(registry=self.registry)
+                         if enable_program_accounting
+                         else NULL_PROGRAM_ACCOUNTING)
         self.engine = DecodeEngine(
             cfg, params, max_batch_size=max_batch_size,
             max_context=max_context, num_blocks=num_blocks,
             block_size=block_size, cache_dtype=cache_dtype,
             attention_fn=attention_fn, prefill_buckets=prefill_buckets,
-            tracer=self.tracer)
+            tracer=self.tracer, programs=self.programs)
         self.failures = CounterMeter(registry=self.registry,
                                      name="serving_failures",
                                      label="reason")
@@ -483,6 +546,35 @@ class InferenceServer:
         self._last_breaker_state = (self.breaker.state
                                     if self.breaker is not None
                                     else "disabled")
+        # hang watchdog (docs/observability.md, "Ops plane &
+        # watchdog"): the server owns the stall handler — thread
+        # stacks + postmortem bundle + counter — and the thread's
+        # lifecycle; step() feeds heartbeats behind an
+        # `enabled` guard, so the disabled default costs nothing
+        self.watchdog = watchdog if watchdog is not None \
+            else NULL_WATCHDOG
+        self._watchdog_stalls = self.registry.counter(
+            "serving_watchdog_stalls")
+        if self.watchdog.enabled:
+            self.watchdog.on_stall = self._on_watchdog_stall
+            self.watchdog.start()
+        # embedded HTTP ops plane: resolved off unless a port is
+        # given (kwarg wins over APEX_TPU_OPS_PORT; 0 = ephemeral).
+        # While attached, step()/stats() serialize through its lock.
+        if ops_port is None:
+            env_port = os.environ.get(OPS_PORT_ENV)
+            if env_port not in (None, ""):
+                ops_port = int(env_port)
+        self.ops_requests = CounterMeter(registry=self.registry,
+                                         name="serving_ops_requests",
+                                         label="endpoint")
+        self.ops: Optional[OpsServer] = None
+        self._ops_lock = None
+        if ops_port is not None:
+            self.ops = OpsServer(self, port=ops_port,
+                                 counters=self.ops_requests)
+            self._ops_lock = self.ops.lock
+            self.ops.start()
 
     # -- request lifecycle ------------------------------------------------
 
@@ -513,6 +605,16 @@ class InferenceServer:
         :class:`RuntimeError`.  A queue-full submission may instead
         displace a lower-priority queued request, which then finishes
         ``"shed"`` during this call."""
+        with (self._ops_lock or _NO_LOCK):
+            return self._submit(prompt, max_new_tokens, eos_id,
+                                priority=priority,
+                                deadline_iters=deadline_iters,
+                                deadline_s=deadline_s)
+
+    def _submit(self, prompt, max_new_tokens, eos_id, *, priority,
+                deadline_iters, deadline_s) -> Request:
+        """The :meth:`submit` body (runs under the ops lock when the
+        HTTP ops plane is attached)."""
         if self._closed:
             raise RuntimeError(
                 "InferenceServer is closed; no further submissions")
@@ -608,7 +710,27 @@ class InferenceServer:
         finish the affected request alone, and a transient engine
         ``MemoryError`` skips the affected call for one iteration
         (retried bit-identically) — no exception escapes the step
-        loop for them."""
+        loop for them.
+
+        Ops-plane integration (``docs/observability.md``, "Ops plane
+        & watchdog"): an armed watchdog gets a heartbeat pair around
+        every step — attribute stores, guarded out entirely when
+        disabled — and, when the HTTP ops plane is attached, the step
+        body runs under the ops lock so ``/statusz`` and the POST
+        triggers read consistent state; a server without an ops plane
+        takes no lock at all."""
+        wd = self.watchdog
+        if wd.enabled:
+            wd.step_started()
+        try:
+            with (self._ops_lock or _NO_LOCK):
+                return self._step()
+        finally:
+            if wd.enabled:
+                wd.step_finished(self.scheduler.has_work)
+
+    def _step(self) -> int:
+        """The :meth:`step` body (see its docstring)."""
         sched, engine, tr = self.scheduler, self.engine, self.tracer
         rec = self.recorder
         self._iter += 1
@@ -1254,6 +1376,32 @@ class InferenceServer:
         self.dump_postmortem(path, reason=reason, extra=extra)
         return path
 
+    def _on_watchdog_stall(self, info: dict) -> Optional[str]:
+        """The armed watchdog's stall handler — runs ON THE WATCHDOG
+        THREAD while the serve thread is still stuck, so it takes no
+        locks: count the stall, then (when ``postmortem_dir`` is
+        configured) capture every thread's stack via
+        :mod:`faulthandler` — the wedged serve thread's frames are
+        the payload — alongside a postmortem bundle whose manifest
+        names the stall and the stack attachment
+        (``tools/postmortem.py`` renders and gates both).  Returns
+        the bundle path, or None when capture is off."""
+        self._watchdog_stalls.incr()
+        if self.tracer.enabled:
+            self.tracer.instant("watchdog_stall", **info)
+        if not self._postmortem_dir:
+            return None
+        path = os.path.join(self._postmortem_dir,
+                            f"watchdog_stall_iter{self._iter}")
+        os.makedirs(path, exist_ok=True)
+        threads_name = "threads.txt"
+        with open(os.path.join(path, threads_name), "w") as f:
+            faulthandler.dump_traceback(file=f, all_threads=True)
+        self.dump_postmortem(path, reason="watchdog_stall",
+                             extra={"stall": info,
+                                    "thread_stacks": threads_name})
+        return path
+
     def audit(self) -> None:
         """The scheduler/allocator/prefix-cache invariant audit, with
         postmortem capture: an :class:`AssertionError` auto-dumps a
@@ -1333,11 +1481,18 @@ class InferenceServer:
         """Graceful shutdown, phase two: :meth:`drain`, then refuse
         all further submissions (:class:`RuntimeError`).  Exactly-once:
         the drain runs on the first call only; repeated calls return
-        the same final stats snapshot without re-running anything."""
+        the same final stats snapshot without re-running anything.
+        An armed watchdog and an attached ops plane are stopped AFTER
+        the drain completes, so ``/healthz`` reports ``draining``
+        through the drain and the final scrape still answers."""
         if self._closed:
             return self._final_stats
         self._final_stats = self.drain()
         self._closed = True
+        if self.watchdog.enabled:
+            self.watchdog.stop()
+        if self.ops is not None:
+            self.ops.stop()
         return self._final_stats
 
     def reset_meters(self) -> None:
@@ -1409,6 +1564,21 @@ class InferenceServer:
         }
         return out
 
+    def _program_stats(self) -> dict:
+        """The ``stats()["programs"]`` block: the per-compiled-program
+        table (call count, host wall time, compile count/time,
+        steady-state per-call ms per program/shape key) plus the
+        totals — empty ``by_program`` when accounting is off."""
+        table = self.programs.table()
+        return {
+            "enabled": self.programs.enabled,
+            "by_program": table,
+            "total_wall_ms": round(
+                sum(r["wall_ms"] for r in table.values()), 3),
+            "total_compile_ms": round(
+                sum(r["compile_ms"] for r in table.values()), 3),
+        }
+
     def stats(self) -> dict:
         """Serving counters for logs and the bench harness.
 
@@ -1428,8 +1598,18 @@ class InferenceServer:
         occupancy/high-watermark/fragmentation breakdown;
         ``trace_dropped_events`` / ``flight`` surface ring-buffer
         loss so a truncated trace or flight log is never mistaken for
-        the full run.  Every pre-telemetry key is preserved unchanged
-        (asserted in ``tests/L0/test_serving_engine.py``)."""
+        the full run.  ``programs`` is the per-compiled-program
+        call/wall/compile table, ``watchdog`` the hang detector's
+        state, and ``ops`` the embedded HTTP endpoint's
+        (``docs/observability.md``, "Ops plane & watchdog").  Every
+        pre-telemetry key is preserved unchanged (asserted in
+        ``tests/L0/test_serving_engine.py``)."""
+        with (self._ops_lock or _NO_LOCK):
+            return self._stats()
+
+    def _stats(self) -> dict:
+        """The :meth:`stats` body (runs under the ops lock when the
+        HTTP ops plane is attached — ``/statusz`` serves this)."""
         self._account_pending_produced()
         self._finalize_finished()
         pre, dec = self.engine.compile_counts()
@@ -1509,6 +1689,24 @@ class InferenceServer:
                 "queue_wait_by_priority_ms": {
                     p: _hist_ms(h) for p, h in
                     sorted(self._queue_wait_prio.items())},
+            },
+            # per-compiled-program accounting (docs/observability.md,
+            # "Ops plane & watchdog"): where does the step go, per
+            # program and shape key — steady_ms excludes compile calls
+            "programs": self._program_stats(),
+            # hang watchdog: armed state, latched stall flag (what
+            # /healthz keys on), and the exactly-once stall count
+            "watchdog": {
+                "enabled": self.watchdog.enabled,
+                "stalled": self.watchdog.stalled,
+                "stalls": self.watchdog.stalls,
+                "deadline_s": self.watchdog.deadline_s,
+            },
+            # embedded HTTP ops plane: bound port + served requests
+            "ops": {
+                "enabled": self.ops is not None,
+                "port": self.ops.port if self.ops is not None else None,
+                "requests": self.ops_requests.total,
             },
             # SLO attainment + goodput-vs-throughput
             # (docs/observability.md, "SLO & goodput")

@@ -67,7 +67,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from apex_tpu.observability import NULL_TRACER
+from apex_tpu.observability import NULL_PROGRAM_ACCOUNTING, NULL_TRACER
 from apex_tpu.models.gpt import GPTConfig, GPTLMHeadModel
 from apex_tpu.ops.sampling import finite_rows, greedy_argmax
 from apex_tpu.serving.kv_cache import (
@@ -146,6 +146,14 @@ class DecodeEngine:
         when enabled, every first-compile of a prefill/chunk/decode/
         copy program emits a ``compile`` instant event (recompiles in
         steady state are exactly what the trace is for catching).
+      programs: optional
+        :class:`apex_tpu.observability.ProgramAccounting` — every
+        host-API launch is tallied per program key
+        (``prefill[<bucket>]`` / ``chunk_prefill[<width>]`` /
+        ``decode`` / ``verify[<width>]`` / sampled twins /
+        ``copy_blocks``): call count, host wall time, compile count,
+        compile time.  Default: the zero-overhead disabled instance
+        (``InferenceServer`` passes a registry-backed one).
     """
 
     def __init__(self, cfg: GPTConfig, params, *,
@@ -156,9 +164,12 @@ class DecodeEngine:
                  cache_dtype=None,
                  attention_fn=None,
                  prefill_buckets: Optional[Sequence[int]] = None,
-                 tracer=None):
+                 tracer=None,
+                 programs=None):
         self.cfg = cfg
         self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.programs = (programs if programs is not None
+                         else NULL_PROGRAM_ACCOUNTING)
         self.params = params
         self.max_batch_size = int(max_batch_size)
         self.max_context = int(max_context
@@ -369,17 +380,36 @@ class DecodeEngine:
 
     # -- host API ---------------------------------------------------------
 
-    def _compile_mark(self, jit_fn) -> int:
-        """Pre-call trace count (0 when tracing is off — the probe
-        itself must cost nothing on the disabled path)."""
-        return jit_fn._cache_size() if self.tracer.enabled else 0
+    def _mark(self, jit_fn):
+        """Pre-call ``(t0, trace count)`` for the tracer's compile
+        instants and the per-program accounting — ``(0.0, 0)`` when
+        both are off, so the disabled path skips even the clock
+        read."""
+        acct = self.programs.enabled
+        if not acct and not self.tracer.enabled:
+            return 0.0, 0
+        return ((self.programs.begin() if acct else 0.0),
+                jit_fn._cache_size())
 
-    def _note_compile(self, jit_fn, before: int, program: str,
-                      **args) -> None:
-        """Emit a ``compile`` instant if the call traced a new
-        program."""
-        if self.tracer.enabled and jit_fn._cache_size() > before:
-            self.tracer.instant("compile", program=program, **args)
+    def _account(self, jit_fn, mark, program: str, key=None,
+                 **trace_args) -> None:
+        """Post-call bookkeeping for one launch: a ``compile``
+        instant if the call traced a new program, and a
+        :class:`ProgramAccounting` tally under
+        ``program[key]`` (wall time attributed to compile when the
+        jit cache grew)."""
+        acct, traced = self.programs.enabled, self.tracer.enabled
+        if not (acct or traced):
+            return
+        t0, before = mark
+        compiled = jit_fn._cache_size() > before
+        if traced and compiled:
+            self.tracer.instant("compile", program=program,
+                                **trace_args)
+        if acct:
+            self.programs.note(
+                program if key is None else f"{program}[{key}]",
+                t0, compiled)
 
     def bucket_for(self, length: int) -> int:
         try:
@@ -429,11 +459,11 @@ class DecodeEngine:
         K/V into ``block_table``'s blocks.  Returns the last-token
         logits (V,)."""
         args, sb = self._prefill_args(prompt, block_table)
-        before = self._compile_mark(self._prefill_jit)
+        mark = self._mark(self._prefill_jit)
         self.cache, last = self._prefill_jit(self.params, self.cache,
                                              *args)
-        self._note_compile(self._prefill_jit, before, "prefill",
-                           bucket=sb)
+        self._account(self._prefill_jit, mark, "prefill", key=sb,
+                      bucket=sb)
         return last[0]
 
     def prefill_sampled(self, prompt, block_table):
@@ -442,11 +472,11 @@ class DecodeEngine:
         the prompt's greedy next token and its non-finite guard —
         without materializing logits on the host."""
         args, sb = self._prefill_args(prompt, block_table)
-        before = self._compile_mark(self._prefill_sampled_jit)
+        mark = self._mark(self._prefill_sampled_jit)
         self.cache, ids, fin = self._prefill_sampled_jit(
             self.params, self.cache, *args)
-        self._note_compile(self._prefill_sampled_jit, before,
-                           "prefill_sampled", bucket=sb)
+        self._account(self._prefill_sampled_jit, mark,
+                      "prefill_sampled", key=sb, bucket=sb)
         return ids, fin
 
     def chunk_prefill(self, tokens, start: int, block_table,
@@ -462,11 +492,11 @@ class DecodeEngine:
         passes its fixed chunk size so exactly one chunk program ever
         compiles."""
         args, cb = self._chunk_args(tokens, start, block_table, pad_to)
-        before = self._compile_mark(self._chunk_jit)
+        mark = self._mark(self._chunk_jit)
         self.cache, last = self._chunk_jit(self.params, self.cache,
                                            *args)
-        self._note_compile(self._chunk_jit, before, "chunk_prefill",
-                           width=cb)
+        self._account(self._chunk_jit, mark, "chunk_prefill", key=cb,
+                      width=cb)
         return last[0]
 
     def chunk_prefill_sampled(self, tokens, start: int, block_table,
@@ -476,11 +506,11 @@ class DecodeEngine:
         the chunk's last valid token (only meaningful on the final
         chunk, exactly like the logits twin)."""
         args, cb = self._chunk_args(tokens, start, block_table, pad_to)
-        before = self._compile_mark(self._chunk_sampled_jit)
+        mark = self._mark(self._chunk_sampled_jit)
         self.cache, ids, fin = self._chunk_sampled_jit(
             self.params, self.cache, *args)
-        self._note_compile(self._chunk_sampled_jit, before,
-                           "chunk_prefill_sampled", width=cb)
+        self._account(self._chunk_sampled_jit, mark,
+                      "chunk_prefill_sampled", key=cb, width=cb)
         return ids, fin
 
     def copy_blocks(self, pairs) -> None:
@@ -495,9 +525,9 @@ class DecodeEngine:
             for j, (s, d) in enumerate(batch):
                 src[j], dst[j] = s, d
             args = self._put(src, dst)
-            before = self._compile_mark(self._copy_jit)
+            mark = self._mark(self._copy_jit)
             self.cache = self._copy_jit(self.cache, *args)
-            self._note_compile(self._copy_jit, before, "copy_blocks")
+            self._account(self._copy_jit, mark, "copy_blocks")
 
     def _decode_args(self, tokens, positions, tables):
         return self._put(np.asarray(tokens, np.int32),
@@ -509,10 +539,10 @@ class DecodeEngine:
         (B,), (B,), (B, blocks_per_seq) with inactive slots zeroed.
         Returns next-token logits (B, V)."""
         args = self._decode_args(tokens, positions, tables)
-        before = self._compile_mark(self._decode_jit)
+        mark = self._mark(self._decode_jit)
         self.cache, logits = self._decode_jit(self.params, self.cache,
                                               *args)
-        self._note_compile(self._decode_jit, before, "decode")
+        self._account(self._decode_jit, mark, "decode")
         return logits
 
     def decode_sampled(self, tokens, positions, tables):
@@ -522,11 +552,11 @@ class DecodeEngine:
         handles and consumes them next iteration, so the device runs
         this step while the host plans the next one."""
         args = self._decode_args(tokens, positions, tables)
-        before = self._compile_mark(self._decode_sampled_jit)
+        mark = self._mark(self._decode_sampled_jit)
         self.cache, ids, fin = self._decode_sampled_jit(
             self.params, self.cache, *args)
-        self._note_compile(self._decode_sampled_jit, before,
-                           "decode_sampled")
+        self._account(self._decode_sampled_jit, mark,
+                      "decode_sampled")
         return ids, fin
 
     def _verify_args(self, tokens, lengths, positions, tables):
@@ -545,11 +575,12 @@ class DecodeEngine:
         rejected suffix blocks.  One trace per distinct K — a server
         with a fixed speculation depth compiles this exactly once."""
         args = self._verify_args(tokens, lengths, positions, tables)
-        before = self._compile_mark(self._verify_jit)
+        kw = int(np.asarray(tokens).shape[1])
+        mark = self._mark(self._verify_jit)
         self.cache, logits = self._verify_jit(self.params, self.cache,
                                               *args)
-        self._note_compile(self._verify_jit, before, "verify",
-                           width=int(np.asarray(tokens).shape[1]))
+        self._account(self._verify_jit, mark, "verify", key=kw,
+                      width=kw)
         return logits
 
     def verify_sampled(self, tokens, lengths, positions, tables):
@@ -560,12 +591,12 @@ class DecodeEngine:
         ``(B, K, V)`` logits block.  Same one-trace-per-width compile
         discipline as :meth:`verify`."""
         args = self._verify_args(tokens, lengths, positions, tables)
-        before = self._compile_mark(self._verify_sampled_jit)
+        kw = int(np.asarray(tokens).shape[1])
+        mark = self._mark(self._verify_sampled_jit)
         self.cache, ids, fin = self._verify_sampled_jit(
             self.params, self.cache, *args)
-        self._note_compile(self._verify_sampled_jit, before,
-                           "verify_sampled",
-                           width=int(np.asarray(tokens).shape[1]))
+        self._account(self._verify_sampled_jit, mark,
+                      "verify_sampled", key=kw, width=kw)
         return ids, fin
 
     # -- introspection ----------------------------------------------------

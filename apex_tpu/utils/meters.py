@@ -90,16 +90,27 @@ class RateMeter:
         (clamped to ``max_window``).  Early in the meter's life — when
         less than a window has elapsed — the denominator is the actual
         elapsed time, so the windowed rate converges to :attr:`rate`
-        instead of under-reporting."""
+        instead of under-reporting.
+
+        Degenerate windows answer 0.0, never raise or explode: an
+        empty window (no events yet, or everything aged out) has no
+        rate, and a single sample with zero elapsed time (an update in
+        the same clock instant as the read — every first scrape on an
+        injected clock) must not divide ~0 into a huge number that a
+        dashboard then renders as a traffic spike."""
         if last_n_seconds <= 0:
             raise ValueError(
                 f"last_n_seconds must be > 0, got {last_n_seconds}")
         now = self._clock()
         window = min(float(last_n_seconds), self.max_window)
         self._prune(now)
+        if not self._events:
+            return 0.0
         cutoff = now - window
         n = sum(c for t, c in self._events if t >= cutoff)
-        denom = max(min(window, now - self._start), 1e-9)
+        denom = min(window, now - self._start)
+        if n == 0 or denom <= 0.0:
+            return 0.0
         return n / denom
 
 

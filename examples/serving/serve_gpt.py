@@ -49,6 +49,12 @@ def parse_args():
                    help="flash-attention prefill (Pallas on TPU)")
     p.add_argument("--eos", type=int, default=None,
                    help="stop token id (default: run to --max-new)")
+    p.add_argument("--ops-port", type=int, default=None,
+                   help="serve the HTTP ops plane on this loopback "
+                   "port while the demo runs (0 = ephemeral): curl "
+                   "/healthz, /metrics, /statusz, /debug/flight "
+                   "live, or point tools/ops_probe.py at it "
+                   "(docs/observability.md)")
     p.add_argument("--seed", type=int, default=0)
     return p.parse_args()
 
@@ -85,7 +91,10 @@ def main():
     server = InferenceServer(
         cfg, params, max_batch_size=args.batch_size,
         max_context=args.max_context, block_size=args.block_size,
-        attention_fn=attention_fn)
+        attention_fn=attention_fn, ops_port=args.ops_port)
+    if server.ops is not None:
+        print(f"ops plane: http://127.0.0.1:{server.ops.port} "
+              f"(/healthz /metrics /statusz /debug/flight)")
     kv = server.engine.cache_cfg
     print(f"model={args.config} ({cfg.num_hidden_layers}x"
           f"{cfg.hidden_size})  kv pool: {kv.num_blocks - 1} blocks x "

@@ -24,12 +24,20 @@ views (the PR-1..3 ``stats()`` surface must not move).
 import io
 import json
 import math
+import os
 import random
+import sys
 import tracemalloc
 
 import pytest
 
 import re
+
+# tools/ops_probe.py owns the Prometheus line-grammar checker shared
+# by the in-process conformance test here and the live-endpoint test
+# in test_opsplane.py
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "tools"))
 
 from apex_tpu.observability import (
     NULL_TRACER,
@@ -305,6 +313,14 @@ def test_prometheus_format_conformance_line_by_line():
     assert counts == sorted(counts), "bucket counts must be cumulative"
     assert buckets[-1].startswith('lat_s_bucket{le="+Inf"}')
     assert counts[-1] == 5
+    # the probe's shared checker judges the same text the same way —
+    # tests/L0/test_opsplane.py applies it to the LIVE /metrics
+    # endpoint, so the two must agree on what conformant means
+    import ops_probe
+    assert ops_probe.check_prometheus_text(
+        reg.prometheus_text()) == []
+    broken = reg.prometheus_text() + "not a metric line !!\n"
+    assert ops_probe.check_prometheus_text(broken)
 
 
 def test_histogram_label_set_isolation():
@@ -429,6 +445,31 @@ def test_rate_meter_prunes_but_keeps_lifetime_total():
     assert rm.total == 101                       # lifetime survives pruning
     assert len(rm._events) == 1                  # memory ∝ window
     assert rm.rate_over(5.0) == pytest.approx(1 / 5.0)
+
+
+def test_rate_meter_degenerate_windows_answer_zero():
+    """Edge contract: an empty window and a single sample at zero
+    elapsed time both answer 0.0 — never a ZeroDivisionError, never a
+    ~1e9 'rate' from a 1e-9 denominator (the first scrape on an
+    injected clock hits exactly this)."""
+    clk = FakeClock()
+    rm = RateMeter(clock=clk, max_window=60.0)
+    # empty deque: no events at all
+    assert rm.rate_over(10.0) == 0.0
+    # single sample in the same clock instant as the read
+    rm.update(5)
+    assert rm.rate_over(10.0) == 0.0
+    # once time actually passes, the sample counts normally
+    clk.advance(2.0)
+    assert rm.rate_over(10.0) == pytest.approx(5 / 2.0)
+    # a window whose events all aged out is empty again
+    rm2 = RateMeter(clock=clk, max_window=5.0)
+    rm2.update(7)
+    clk.advance(100.0)
+    assert rm2.rate_over(5.0) == 0.0
+    # reset() restores the empty-window answer
+    rm.reset()
+    assert rm.rate_over(10.0) == 0.0
 
 
 # -- tracer: chrome export, determinism, disabled path ---------------------

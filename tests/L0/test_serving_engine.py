@@ -224,6 +224,23 @@ def test_stats_keys_are_backward_compatible(tiny):
         f"stats() lost pipeline keys: {pipe - st['pipeline'].keys()}"
     assert st["pipeline"]["enabled"] is True       # default-on server
     assert st["pipeline"]["pending"] == 0          # idle server
+    # ops-plane tier (docs/observability.md, "Ops plane & watchdog"):
+    # the programs/watchdog/ops blocks ride alongside — the router,
+    # ops_probe, and dashboards key on these
+    progs = {"enabled", "by_program", "total_wall_ms",
+             "total_compile_ms"}
+    assert not progs - st["programs"].keys(), \
+        f"stats() lost programs keys: {progs - st['programs'].keys()}"
+    assert st["programs"]["enabled"] is True       # default-on server
+    assert st["programs"]["by_program"]            # launches tallied
+    wd = {"enabled", "stalled", "stalls", "deadline_s"}
+    assert not wd - st["watchdog"].keys(), \
+        f"stats() lost watchdog keys: {wd - st['watchdog'].keys()}"
+    assert st["watchdog"]["enabled"] is False      # off by default
+    ops = {"enabled", "port", "requests"}
+    assert not ops - st["ops"].keys(), \
+        f"stats() lost ops keys: {ops - st['ops'].keys()}"
+    assert st["ops"]["enabled"] is False           # off by default
     lat = st["latency"]
     assert set(lat) == {"ttft_ms", "queue_wait_ms", "decode_token_ms",
                         "step_ms", "queue_wait_by_priority_ms"}

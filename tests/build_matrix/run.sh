@@ -166,6 +166,28 @@ else
 fi
 rm -rf "$pm_dir"
 
+# ops-plane axis: live introspection + hang watchdog
+# (docs/observability.md, "Ops plane & watchdog") — two gates:
+#   1. a live serve loop with the HTTP ops endpoint up is probed OVER
+#      THE WIRE by tools/ops_probe.py --assert-healthy (healthz ok,
+#      /metrics conformant under the Prometheus text/plain;
+#      version=0.0.4 content type, pinned /statusz blocks) plus the
+#      /debug endpoints, with zero watchdog false positives;
+#   2. a forced hang (one engine launch wedged past the tightened
+#      deadline, after warmup) must trip the watchdog EXACTLY once,
+#      flip /healthz to 503 "stalled" during the hang, recover, and
+#      leave a watchdog_stall_* postmortem bundle — thread stacks
+#      attached — that tools/postmortem.py --assert-complete gates.
+echo "=== build-matrix axis: opsplane ==="
+ops_pm=$(mktemp -d)
+env JAX_PLATFORMS=cpu python tools/ops_smoke.py \
+  && env JAX_PLATFORMS=cpu python tools/ops_smoke.py --force-hang \
+      --postmortem-dir "$ops_pm" \
+  && python tools/postmortem.py "$ops_pm"/watchdog_stall_* \
+      --assert-complete
+results[opsplane]=$?
+rm -rf "$ops_pm"
+
 # trace smoke: the observability axis (docs/observability.md) — the
 # serving smoke re-runs with APEX_TPU_TRACE set; the exported Chrome
 # trace must parse, its B/E spans must pair up, and it must contain

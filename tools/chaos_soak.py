@@ -33,7 +33,14 @@ about fault-surface attribution, not correctness).
 The soaked server always runs with a step-level ``FlightRecorder``
 (``docs/observability.md``, "Flight recorder & postmortems") —
 recording never feeds back into scheduler decisions, so the soak's
-numbers are byte-identical recorder-on vs off.  With
+numbers are byte-identical recorder-on vs off.  The full ops tier
+soaks alongside it: a real-clock ``HangWatchdog`` is armed (a healthy
+soak must record ZERO stalls — asserted by ``run_soak``; faults are
+not hangs), the embedded HTTP ops plane serves on an ephemeral
+loopback port for the whole run (``--no-ops`` opts out), and
+per-program accounting tallies every engine launch — all observation
+only, so the seed-0 report stays byte-identical with the whole tier
+enabled.  With
 ``--postmortem-dir`` any invariant violation dumps a postmortem
 bundle (flight JSONL + metrics snapshot + Chrome trace + manifest) to
 ``<dir>/invariant_violation`` before exiting 1; ``--force-violation
@@ -101,6 +108,18 @@ def main(argv=None) -> int:
     parser.add_argument("--postmortem-dir", default=None,
                         help="dump a postmortem bundle here on any "
                         "invariant violation (docs/observability.md)")
+    parser.add_argument("--watchdog-deadline", type=float, default=60.0,
+                        metavar="S",
+                        help="arm the soaked server's hang watchdog "
+                        "with this real-clock no-progress deadline "
+                        "(default 60s — far above any healthy step "
+                        "incl. first-call compiles; a healthy soak "
+                        "must record zero stalls)")
+    parser.add_argument("--no-ops", dest="ops", action="store_false",
+                        default=True,
+                        help="run without the embedded HTTP ops "
+                        "plane (default: serve it on an ephemeral "
+                        "loopback port for the whole soak)")
     parser.add_argument("--force-violation", type=int, default=None,
                         metavar="N",
                         help="deliberately violate the finished-twice "
@@ -108,9 +127,11 @@ def main(argv=None) -> int:
                         "build-matrix axis; the soak then MUST fail)")
     args = parser.parse_args(argv)
 
+    import time as _time
+
     import jax.numpy as jnp
 
-    from apex_tpu.observability import FlightRecorder
+    from apex_tpu.observability import FlightRecorder, HangWatchdog
     from apex_tpu.resilience import CircuitBreaker
     from apex_tpu.resilience.chaos import ChaosConfig, run_soak
     from apex_tpu.serving import InferenceServer
@@ -128,7 +149,12 @@ def main(argv=None) -> int:
         # the flight recorder is always on here (it never feeds back
         # into scheduling, so the soak is byte-identical either way);
         # sized to hold the whole run so a violation bundle carries
-        # every step leading up to it
+        # every step leading up to it.
+        # the ops tier soaks too: real-clock watchdog (the soak's
+        # iteration clock is frozen per step — useless for measuring
+        # wall stalls), ephemeral-port ops plane, and per-program
+        # accounting (the server default) — observation only, so the
+        # per-seed report stays byte-identical with all of it on
         return InferenceServer(
             cfg, params, max_batch_size=4, max_context=64,
             block_size=4, num_blocks=40,          # 39 usable blocks
@@ -137,6 +163,9 @@ def main(argv=None) -> int:
             enable_pipeline=args.pipeline,
             flight_recorder=FlightRecorder(
                 capacity=max(4096, 2 * args.iters)),
+            watchdog=HangWatchdog(deadline_s=args.watchdog_deadline,
+                                  clock=_time.monotonic),
+            ops_port=0 if args.ops else None,
             breaker=CircuitBreaker(failure_threshold=3,
                                    recovery_time=25.0,
                                    probe_successes=2, clock=clock))

@@ -1,0 +1,260 @@
+"""Poll a live apex_tpu serving ops endpoint (``docs/observability.md``).
+
+The client half of the ops plane (``apex_tpu.observability.opsplane``;
+enable it server-side with ``ops_port=`` / ``APEX_TPU_OPS_PORT``).
+Pure stdlib, so it runs anywhere a shell does:
+
+``--assert-healthy``
+    The gate mode (the ``opsplane`` build-matrix axis and any
+    readiness probe): ``GET /healthz`` must answer 200 with
+    ``status == "ok"``, ``GET /metrics`` must carry the Prometheus
+    ``text/plain; version=0.0.4`` content type AND pass the
+    line-grammar conformance check below, and ``GET /statusz`` must
+    parse with the pinned ``programs`` / ``watchdog`` / ``ops``
+    blocks present.  Exit 1 naming the first failure.
+
+``--programs``
+    Render ``/statusz``'s per-compiled-program table — calls,
+    compiles, total/compile wall ms, and the steady-state per-call
+    ms per program key ("where does the step go").
+
+``--flight N`` / ``--request UID`` / ``--statusz`` / ``--metrics``
+    Raw views of the corresponding endpoints.
+
+Default (no mode flag): one ``/healthz`` summary line.
+
+The Prometheus conformance checker (:func:`check_prometheus_text`)
+lives here so the probe, the in-process exposition test, and the
+live-endpoint test all judge scrapes by the same grammar: one
+``# HELP`` + one ``# TYPE`` per family (HELP first), every sample
+line matching the metric-line grammar, and histogram buckets
+cumulative-monotonic closing at ``+Inf == count`` per series.
+
+Usage:
+    python tools/ops_probe.py --port 9109 --assert-healthy
+    python tools/ops_probe.py --port 9109 --programs
+    python tools/ops_probe.py --port 9109 --flight 20
+"""
+
+import argparse
+import json
+import re
+import sys
+import urllib.error
+import urllib.request
+
+PROM_CONTENT_TYPE_RE = re.compile(
+    r"text/plain\s*;.*version=0\.0\.4", re.IGNORECASE)
+
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
+    r' -?[0-9.e+-]+(inf|nan)?$')
+
+
+def check_prometheus_text(text):
+    """Line-by-line conformance check of a Prometheus text scrape;
+    returns a list of problem strings (empty = conformant)."""
+    problems = []
+    lines = text.splitlines()
+    if not lines:
+        return ["empty exposition"]
+    help_seen, type_seen = set(), set()
+    current_family = None
+    # histogram bucket series: (family, labels-sans-le) -> counts
+    buckets = {}
+    for ln in lines:
+        if ln.startswith("# HELP "):
+            fam = ln.split()[2]
+            if fam in help_seen:
+                problems.append(f"duplicate HELP for {fam}")
+            help_seen.add(fam)
+            current_family = fam
+        elif ln.startswith("# TYPE "):
+            fam = ln.split()[2]
+            if fam in type_seen:
+                problems.append(f"duplicate TYPE for {fam}")
+            if fam != current_family:
+                problems.append(f"TYPE for {fam} does not follow "
+                                f"its HELP")
+            type_seen.add(fam)
+        elif ln.startswith("#"):
+            problems.append(f"unknown comment line: {ln!r}")
+        else:
+            if not _SAMPLE_RE.match(ln):
+                problems.append(f"unparseable line: {ln!r}")
+                continue
+            name = ln.split("{")[0].split(" ")[0]
+            if current_family is None or \
+                    not name.startswith(current_family):
+                problems.append(
+                    f"{ln!r} outside its declared family block")
+            if "_bucket{" in ln:
+                labels, value = ln.rsplit(" ", 1)
+                key = re.sub(r'le="[^"]*",?', "", labels)
+                buckets.setdefault(key, []).append(float(value))
+    if help_seen != type_seen:
+        problems.append(f"HELP/TYPE families differ: "
+                        f"{sorted(help_seen ^ type_seen)}")
+    for key, counts in buckets.items():
+        if counts != sorted(counts):
+            problems.append(
+                f"bucket counts not cumulative for {key}: {counts}")
+    return problems
+
+
+def fetch(base, path, timeout):
+    """(status, headers, body-bytes) — HTTP errors return their
+    status instead of raising (503 IS the healthz answer)."""
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def render_programs(stats) -> None:
+    prog = stats.get("programs", {})
+    table = prog.get("by_program", {})
+    if not table:
+        print("program table empty "
+              f"(accounting enabled={prog.get('enabled')})")
+        return
+    w = max(len(k) for k in table)
+    print(f"{'program':<{w}} {'calls':>7} {'compiles':>8} "
+          f"{'wall_ms':>10} {'compile_ms':>10} {'steady_ms':>9}")
+    for key, row in table.items():
+        print(f"{key:<{w}} {row['calls']:>7} {row['compiles']:>8} "
+              f"{row['wall_ms']:>10.3f} {row['compile_ms']:>10.3f} "
+              f"{row['steady_ms']:>9.4f}")
+    print(f"total wall {prog.get('total_wall_ms')}ms, "
+          f"compile {prog.get('total_compile_ms')}ms")
+
+
+def assert_healthy(base, timeout) -> int:
+    """The gate: healthz ok + conformant metrics + pinned statusz
+    blocks.  Prints what failed; 0 only when everything holds."""
+    code, _, body = fetch(base, "/healthz", timeout)
+    try:
+        health = json.loads(body)
+    except ValueError:
+        print(f"FAIL: /healthz returned unparseable body: {body!r}",
+              file=sys.stderr)
+        return 1
+    if code != 200 or health.get("status") != "ok":
+        print(f"FAIL: /healthz {code} status={health.get('status')!r}",
+              file=sys.stderr)
+        return 1
+    code, headers, body = fetch(base, "/metrics", timeout)
+    ctype = headers.get("Content-Type", "")
+    if code != 200:
+        print(f"FAIL: /metrics {code}", file=sys.stderr)
+        return 1
+    if not PROM_CONTENT_TYPE_RE.search(ctype):
+        print(f"FAIL: /metrics content type {ctype!r} is not the "
+              f"Prometheus text/plain; version=0.0.4 exposition type",
+              file=sys.stderr)
+        return 1
+    problems = check_prometheus_text(body.decode())
+    if problems:
+        print(f"FAIL: /metrics not conformant: {problems[:5]}",
+              file=sys.stderr)
+        return 1
+    code, _, body = fetch(base, "/statusz", timeout)
+    if code != 200:
+        print(f"FAIL: /statusz {code}", file=sys.stderr)
+        return 1
+    try:
+        stats = json.loads(body)
+    except ValueError as e:
+        print(f"FAIL: /statusz is not JSON: {e}", file=sys.stderr)
+        return 1
+    missing = {"programs", "watchdog", "ops", "latency",
+               "memory"} - stats.keys()
+    if missing:
+        print(f"FAIL: /statusz missing blocks: {sorted(missing)}",
+              file=sys.stderr)
+        return 1
+    print(f"OK: healthz ok (iter={health.get('iter')}, "
+          f"breaker={health.get('breaker')}, "
+          f"pressure={health.get('pressure')}), metrics conformant "
+          f"({len(body)}B statusz, "
+          f"{len(stats['programs']['by_program'])} programs)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--timeout", type=float, default=10.0)
+    ap.add_argument("--assert-healthy", action="store_true",
+                    help="gate mode: exit 1 unless healthz is ok, "
+                    "/metrics is conformant Prometheus text under "
+                    "the right content type, and /statusz carries "
+                    "the pinned blocks")
+    ap.add_argument("--programs", action="store_true",
+                    help="render /statusz's per-compiled-program "
+                    "table")
+    ap.add_argument("--statusz", action="store_true",
+                    help="print the full /statusz JSON")
+    ap.add_argument("--metrics", action="store_true",
+                    help="print the raw /metrics exposition")
+    ap.add_argument("--flight", type=int, default=None, metavar="N",
+                    help="print the newest N flight records "
+                    "(/debug/flight)")
+    ap.add_argument("--request", type=int, default=None, metavar="UID",
+                    help="print one request's live timeline "
+                    "(/debug/requests/UID)")
+    args = ap.parse_args(argv)
+    base = f"http://{args.host}:{args.port}"
+
+    if args.assert_healthy:
+        rc = assert_healthy(base, args.timeout)
+        if rc:
+            return rc
+    if args.programs or args.statusz:
+        code, _, body = fetch(base, "/statusz", args.timeout)
+        if code != 200:
+            print(f"FAIL: /statusz {code}", file=sys.stderr)
+            return 1
+        stats = json.loads(body)
+        if args.statusz:
+            print(json.dumps(stats, indent=2, sort_keys=True))
+        if args.programs:
+            render_programs(stats)
+    if args.metrics:
+        code, _, body = fetch(base, "/metrics", args.timeout)
+        if code != 200:
+            print(f"FAIL: /metrics {code}", file=sys.stderr)
+            return 1
+        sys.stdout.write(body.decode())
+    if args.flight is not None:
+        code, _, body = fetch(base, f"/debug/flight?n={args.flight}",
+                              args.timeout)
+        if code != 200:
+            print(f"FAIL: /debug/flight {code}", file=sys.stderr)
+            return 1
+        sys.stdout.write(body.decode())
+    if args.request is not None:
+        code, _, body = fetch(
+            base, f"/debug/requests/{args.request}", args.timeout)
+        if code != 200:
+            print(f"FAIL: /debug/requests/{args.request} {code}: "
+                  f"{body.decode()}", file=sys.stderr)
+            return 1
+        print(json.dumps(json.loads(body), indent=2, sort_keys=True))
+    if not any((args.assert_healthy, args.programs, args.statusz,
+                args.metrics, args.flight is not None,
+                args.request is not None)):
+        code, _, body = fetch(base, "/healthz", args.timeout)
+        health = json.loads(body)
+        print(f"{base}/healthz -> {code} "
+              f"{json.dumps(health, sort_keys=True)}")
+        return 0 if code == 200 else 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,242 @@
+"""Ops-plane smoke — the server side of the ``opsplane`` build-matrix axis.
+
+Two modes over a tiny GPT behind a full ``InferenceServer`` (flight
+recorder on, watchdog armed, program accounting on, HTTP ops plane on
+an ephemeral loopback port):
+
+default (probe smoke)
+    Runs a live serve loop (a feeder keeps the batch busy for the
+    whole window) and probes it OVER THE WIRE: ``tools/ops_probe.py
+    --assert-healthy --programs`` runs as a real subprocess against
+    the bound port (healthz ok + conformant ``/metrics`` under the
+    Prometheus content type + pinned ``/statusz`` blocks), then the
+    driver itself fetches ``/debug/flight`` and
+    ``/debug/requests/<uid>`` mid-loop — all five endpoints must
+    serve live data while the loop is actually stepping.  Finishes
+    with a drain and exits non-zero on any failed check.
+
+``--force-hang --postmortem-dir DIR``
+    The watchdog proof: after a WARMED-UP server (first-call compiles
+    are the slowest *healthy* steps a server runs — the deadline is
+    tightened only once they are done, which is exactly how the knob
+    should be sized in production) one engine launch is wedged for
+    longer than the deadline.  The axis then requires: the watchdog
+    fires EXACTLY once, ``/healthz`` answers 503 ``"stalled"``
+    *during* the hang (the health endpoint is lock-free for
+    precisely this moment), the loop recovers and ``/healthz``
+    returns to 200, and a ``watchdog_stall_*`` postmortem bundle —
+    thread stacks attached — lands under DIR for
+    ``tools/postmortem.py --assert-complete`` to gate.
+
+Usage:
+    python tools/ops_smoke.py
+    python tools/ops_smoke.py --force-hang --postmortem-dir /tmp/pm
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+VOCAB = 61
+
+
+def build_server(**kw):
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import models
+    from apex_tpu.observability import FlightRecorder, HangWatchdog
+    from apex_tpu.serving import InferenceServer
+
+    cfg = models.GPTConfig(
+        vocab_size=VOCAB, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=128, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+    m = models.GPTLMHeadModel(cfg)
+    params = m.init(jax.random.PRNGKey(1),
+                    jnp.ones((1, 8), jnp.int32))["params"]
+    kw.setdefault("watchdog", HangWatchdog(deadline_s=60.0,
+                                           poll_interval_s=0.05))
+    return InferenceServer(
+        cfg, params, max_batch_size=4, max_context=64, block_size=8,
+        cache_dtype=jnp.float32, flight_recorder=FlightRecorder(),
+        ops_port=0, **kw)
+
+
+def fetch(base, path, timeout=5.0):
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def probe_smoke() -> int:
+    server = build_server()
+    base = f"http://127.0.0.1:{server.ops.port}"
+    stop = threading.Event()
+
+    def loop():
+        # keep the batch busy for the whole probe window so every
+        # endpoint answers from a LIVE loop, not an idle server
+        i = 0
+        while not stop.is_set():
+            if server.scheduler.num_waiting < 2:
+                server.submit([i % VOCAB, (i + 1) % VOCAB, 7],
+                              max_new_tokens=24)
+                i += 1
+            server.step()
+        while server.scheduler.has_work:
+            server.step()
+
+    t = threading.Thread(target=loop)
+    t.start()
+    try:
+        # the real gate: the probe CLI as a subprocess — over-the-wire
+        # HTTP against the live port, no shared interpreter state
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools/ops_probe.py"),
+             "--port", str(server.ops.port),
+             "--assert-healthy", "--programs"],
+            capture_output=True, text=True, timeout=120)
+        sys.stdout.write(proc.stdout)
+        sys.stderr.write(proc.stderr)
+        if proc.returncode != 0:
+            print("FAIL: ops_probe --assert-healthy failed",
+                  file=sys.stderr)
+            return 1
+        # debug endpoints mid-loop: the flight tail is non-empty
+        # JSONL, and a finished request's timeline resolves by uid
+        code, body = fetch(base, "/debug/flight?n=5")
+        records = [json.loads(ln) for ln in body.splitlines()]
+        if code != 200 or not records:
+            print(f"FAIL: /debug/flight {code} with "
+                  f"{len(records)} records", file=sys.stderr)
+            return 1
+        finished = server.scheduler.finished
+        if not finished:
+            print("FAIL: no finished request to slice",
+                  file=sys.stderr)
+            return 1
+        uid = finished[0].uid
+        code, body = fetch(base, f"/debug/requests/{uid}")
+        if code != 200 or json.loads(body)["state"] != "finished":
+            print(f"FAIL: /debug/requests/{uid} {code}: {body!r}",
+                  file=sys.stderr)
+            return 1
+    finally:
+        stop.set()
+        t.join(timeout=60)
+    stats = server.close()
+    if stats["watchdog"]["stalls"] != 0:
+        print(f"FAIL: watchdog false positive on a healthy smoke "
+              f"({stats['watchdog']['stalls']} stalls)",
+              file=sys.stderr)
+        return 1
+    print(f"ops smoke PASS: {stats['requests_finished']} requests, "
+          f"{stats['ops']['requests']} ops requests served, "
+          f"{len(stats['programs']['by_program'])} programs "
+          f"accounted, 0 watchdog stalls")
+    return 0
+
+
+def force_hang(postmortem_dir: str, deadline: float) -> int:
+    server = build_server(postmortem_dir=postmortem_dir)
+    base = f"http://127.0.0.1:{server.ops.port}"
+    # warm up every program first: a first-call compile is the slowest
+    # healthy step there is — the deadline tightens only after it
+    server.generate([[1, 2, 3], [4, 5, 6, 7]], max_new_tokens=6)
+    if server.stats()["watchdog"]["stalls"]:
+        print("FAIL: watchdog fired during warmup", file=sys.stderr)
+        return 1
+    server.watchdog.deadline_s = deadline
+
+    class HangOnce:
+        """Wedges exactly one decode launch well past the deadline."""
+
+        def __init__(self, inner):
+            self.inner = inner
+            self.hung = False
+
+        def decode_sampled(self, *a, **kw):
+            if not self.hung:
+                self.hung = True
+                time.sleep(4 * deadline)
+            return self.inner.decode_sampled(*a, **kw)
+
+        def __getattr__(self, name):
+            return getattr(self.inner, name)
+
+    server.engine = HangOnce(server.engine)
+    server.submit([1, 2, 3], max_new_tokens=8)
+    t = threading.Thread(target=lambda: [
+        server.step() for _ in iter(
+            lambda: server.scheduler.has_work, False)])
+    t.start()
+    saw = None
+    for _ in range(int(200 * deadline) + 200):
+        code, body = fetch(base, "/healthz", timeout=2)
+        if code == 503:
+            saw = json.loads(body).get("status")
+            break
+        time.sleep(0.02)
+    t.join(timeout=120)
+    if saw != "stalled":
+        print(f"FAIL: /healthz never reported the stall (saw {saw!r})",
+              file=sys.stderr)
+        return 1
+    code, _ = fetch(base, "/healthz")
+    stats = server.close()
+    stalls = stats["watchdog"]["stalls"]
+    bundles = [d for d in os.listdir(postmortem_dir)
+               if d.startswith("watchdog_stall")]
+    if stalls != 1:
+        print(f"FAIL: expected exactly one stall, got {stalls}",
+              file=sys.stderr)
+        return 1
+    if code != 200:
+        print(f"FAIL: /healthz did not recover after the hang "
+              f"({code})", file=sys.stderr)
+        return 1
+    if len(bundles) != 1:
+        print(f"FAIL: expected one watchdog bundle, got {bundles}",
+              file=sys.stderr)
+        return 1
+    bundle = os.path.join(postmortem_dir, bundles[0])
+    print(f"forced hang PASS: 1 stall, healthz 503->200, "
+          f"bundle {bundle}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--force-hang", action="store_true",
+                    help="wedge one engine launch past the watchdog "
+                    "deadline and require exactly-once detection + "
+                    "a thread-stack postmortem bundle")
+    ap.add_argument("--postmortem-dir", default=None,
+                    help="bundle destination (required with "
+                    "--force-hang)")
+    ap.add_argument("--deadline", type=float, default=0.5,
+                    help="tightened watchdog deadline for the forced "
+                    "hang (seconds; the hang sleeps 4x this)")
+    args = ap.parse_args(argv)
+    if args.force_hang:
+        if not args.postmortem_dir:
+            ap.error("--force-hang requires --postmortem-dir")
+        return force_hang(args.postmortem_dir, args.deadline)
+    return probe_smoke()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

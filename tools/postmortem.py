@@ -17,7 +17,11 @@ Modes:
     ``--last-n-steps N`` bounds it, default 10): iteration, tokens
     produced, queue/batch composition, pressure, breaker state, and
     memory occupancy per step, with admit/shed/finish decisions
-    called out.
+    called out.  A watchdog-triggered bundle
+    (``reason="watchdog_stall"``) additionally renders the stall
+    (where it hung, for how long, against what deadline) and the
+    head of the attached thread-stack dump — the wedged serve
+    thread's frames are the point of the capture.
 
 ``BUNDLE --request UID``
     The per-request step slice: only the steps in which request
@@ -30,8 +34,11 @@ Modes:
     snapshot's step counters, iterations are strictly increasing,
     per-request events are consistent (at most one finish per uid;
     admit precedes finish; nothing runs before its admission when the
-    ring dropped nothing), and the trace is structurally valid.
-    Exit 1 with the failing check otherwise.
+    ring dropped nothing), and the trace is structurally valid.  A
+    watchdog bundle must additionally carry its stall record and a
+    non-empty thread-stack attachment (the ``opsplane`` build-matrix
+    axis gates a forced hang through this).  Exit 1 with the failing
+    check otherwise.
 
 ``BUNDLE --diff OTHER``
     Metrics delta between two bundles (``snapshot_diff`` semantics:
@@ -78,6 +85,17 @@ def load_bundle(dirpath: str) -> dict:
                 out[key] = json.load(f)
         except (OSError, ValueError) as e:
             raise BundleError(f"{path}: {e}")
+    # a watchdog bundle names a thread-stack attachment in its
+    # manifest extra; load it alongside (None when absent/named-but-
+    # missing — assert_complete turns the latter into a failure)
+    out["threads"] = None
+    attach = (out["manifest"].get("extra") or {}).get("thread_stacks")
+    if attach:
+        try:
+            with open(os.path.join(dirpath, os.path.basename(attach))) as f:
+                out["threads"] = f.read()
+        except OSError:
+            out["threads"] = None
     path = os.path.join(dirpath, FLIGHT_NAME)
     steps = []
     try:
@@ -155,6 +173,23 @@ def render(bundle, args) -> int:
     extra = man.get("extra")
     if extra:
         print(f"  extra: {json.dumps(extra, sort_keys=True)}")
+    if man.get("reason") == "watchdog_stall":
+        stall = (extra or {}).get("stall", {})
+        print(f"  watchdog stall: where={stall.get('where')} "
+              f"age={stall.get('age_s')}s "
+              f"deadline={stall.get('deadline_s')}s "
+              f"(stall #{stall.get('stalls')})")
+        threads = bundle.get("threads")
+        if threads:
+            lines = threads.splitlines()
+            print(f"  thread stacks ({len(lines)} lines; "
+                  f"{(extra or {}).get('thread_stacks')}):")
+            for ln in lines[:8]:
+                print(f"    {ln}")
+            if len(lines) > 8:
+                print(f"    ... {len(lines) - 8} more lines")
+        else:
+            print("  thread stacks: MISSING", file=sys.stderr)
     steps = bundle["steps"]
     if args.request is not None:
         ev = request_events(steps).get(args.request)
@@ -234,6 +269,25 @@ def assert_complete(bundle) -> int:
             if runs and not admits:
                 return fail(f"request {uid} runs at iter {min(runs)} "
                             f"with no admission in a complete window")
+    # watchdog bundles: the stall record and the thread-stack
+    # attachment are the capture's payload — a bundle without them is
+    # a detector that fired blind
+    if man.get("reason") == "watchdog_stall":
+        extra = man.get("extra") or {}
+        stall = extra.get("stall")
+        if not stall or "where" not in stall:
+            return fail("watchdog bundle carries no stall record")
+        if not extra.get("thread_stacks"):
+            return fail("watchdog bundle names no thread-stack "
+                        "attachment")
+        threads = bundle.get("threads")
+        if not threads or not threads.strip():
+            return fail(f"thread-stack attachment "
+                        f"{extra['thread_stacks']!r} is missing or "
+                        f"empty")
+        if "thread" not in threads.lower():
+            return fail("thread-stack attachment holds no thread "
+                        "frames")
     # trace structure: a dict with an event list; every event carries
     # ph/ts (pairing can be legitimately unbalanced when the trace
     # ring dropped events)
