@@ -19,7 +19,11 @@ from apex_tpu.ops.flatten import flatten, unflatten, flatten_like
 from apex_tpu.ops.flash_attention import flash_attention, make_flash_attention
 from apex_tpu.ops.decode_attention import cached_attention
 from apex_tpu.ops.sampling import finite_rows, greedy_argmax
-from apex_tpu.ops.vocab_parallel import vocab_parallel_lm_loss
+from apex_tpu.ops.vocab_parallel import (
+    vocab_parallel_argmax,
+    vocab_parallel_lm_loss,
+    vocab_parallel_sample,
+)
 from apex_tpu.ops import native
 
 __all__ = [
@@ -37,5 +41,7 @@ __all__ = [
     "flatten",
     "unflatten",
     "flatten_like",
+    "vocab_parallel_argmax",
     "vocab_parallel_lm_loss",
+    "vocab_parallel_sample",
 ]

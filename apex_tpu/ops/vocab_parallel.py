@@ -1,5 +1,8 @@
-"""Vocab-parallel cross entropy — the Megatron-style loss for a
-vocab-sharded LM head.
+"""Vocab-parallel ops for a vocab-sharded LM head: the Megatron-style
+training loss (:func:`vocab_parallel_lm_loss`) and the serving-side
+greedy sampler (:func:`vocab_parallel_sample` /
+:func:`vocab_parallel_argmax`) — nothing ``(…, V)``-shaped ever
+crosses the model axis in either.
 
 With ``parallel.gpt_tp_rules`` the tied ``wte`` shards its vocab dim,
 so each device can compute only its ``(B, S, V/n)`` logits slice — but
@@ -41,6 +44,123 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
+
+
+def _shard_map(f, mesh, in_specs, out_specs, axis: str):
+    """Partial-manual ``shard_map`` across jax API generations: the
+    current API spells it ``axis_names={axis}``/``check_vma``; this
+    build's ``jax.experimental`` API spells the same thing
+    ``auto=<every other axis>``/``check_rep``.  Only ``axis`` is
+    manual either way — dp/sp sharding stays GSPMD-automatic."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, axis_names={axis},
+                             check_vma=False)
+    from jax.experimental.shard_map import shard_map
+    return shard_map(f, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=False,
+                     auto=frozenset(mesh.axis_names) - {axis})
+
+
+@functools.lru_cache(maxsize=32)
+def _build_sample(mesh, axis, ndim, true_vocab):
+    """Cached jitted vocab-parallel greedy sampler for rank-``ndim``
+    logits: per-shard argmax + finite guard, then one scalar-ish
+    cross-shard reduction each — the serving analog of the loss above
+    (nothing (…, V)-shaped crosses the axis).  ``true_vocab`` is None
+    for an exactly-divisible vocab, else the real width (columns past
+    it are -inf padding the caller appended: excluded from the argmax
+    candidates and the finite check).  Same caching discipline as
+    :func:`_build`: jit keys on the function object, so eager per-step
+    callers must hit one build per (mesh, axis, rank, pad)."""
+    n = mesh.shape[axis]
+
+    def per_shard(lg):
+        # sub-fp32 logits compare exactly after an (exact) upcast; it
+        # also sidesteps the half-precision-inside-partial-manual-
+        # shard_map XLA:CPU limitation noted in the module docstring
+        if jnp.issubdtype(lg.dtype, jnp.floating) \
+                and jnp.finfo(lg.dtype).bits < 32:
+            lg = lg.astype(jnp.float32)
+        vshard = lg.shape[-1]
+        v_pad = vshard * n            # padded global vocab
+        v_true = true_vocab if true_vocab is not None else v_pad
+        off = lax.axis_index(axis) * vshard
+        gidx = (lax.broadcasted_iota(jnp.int32, lg.shape, lg.ndim - 1)
+                + off)                # this shard's GLOBAL token ids
+        valid = gidx < v_true
+        gmax = lax.pmax(jnp.max(lg, axis=-1, keepdims=True), axis)
+        # lowest-global-id tie rule in two exact stages: min global id
+        # among this shard's valid maxima, then min across shards.  A
+        # row whose global max is NaN matches nothing and clamps to
+        # the last TRUE id — exactly ops.greedy_argmax's rule (such
+        # rows are always flagged non-finite and never consumed).
+        cand = jnp.min(jnp.where((lg == gmax) & valid, gidx,
+                                 jnp.int32(v_pad)), axis=-1)
+        ids = jnp.minimum(lax.pmin(cand, axis),
+                          v_true - 1).astype(jnp.int32)
+        # jnp.max propagates NaN but lax.pmax does not: a NaN on one
+        # shard must poison the whole row's max exactly as it does in
+        # the unsharded reduction, clamping the row to the last id
+        row_nan = lax.pmax(
+            jnp.any(jnp.isnan(lg) & valid, axis=-1).astype(jnp.int32),
+            axis) > 0
+        ids = jnp.where(row_nan, jnp.int32(v_true - 1), ids)
+        fin = lax.pmin(
+            jnp.all(jnp.isfinite(lg) | ~valid, axis=-1)
+            .astype(jnp.int32), axis).astype(bool)
+        return ids, fin
+
+    spec = P(*([None] * (ndim - 1) + [axis]))
+    return jax.jit(_shard_map(per_shard, mesh, (spec,), (P(), P()),
+                              axis))
+
+
+def vocab_parallel_sample(logits, mesh, axis: str = "model"):
+    """Greedy argmax + finite-row guard over vocab-sharded logits —
+    the serving engine's fused on-device sampling for a tensor-parallel
+    LM head (``serving.engine.DecodeEngine(mesh=...)``).
+
+    With ``parallel.gpt_tp_rules`` the tied head's logits come out of
+    the matmul sharded on their vocab dim; a plain
+    ``ops.greedy_argmax`` would force GSPMD to all-gather the full
+    ``(…, V)`` block first.  This runs the argmax and the finite guard
+    per shard (each shard reduces over its ``V/n`` slice with GLOBAL
+    token ids) and crosses the axis with three (…,)-shaped collectives
+    (pmax of the shard maxima, pmin of the candidate ids, pmin of the
+    finite flags) — never the logits.
+
+    Semantics are bit-exact :func:`ops.greedy_argmax` +
+    :func:`ops.finite_rows` by construction, INCLUDING exact ties that
+    straddle shard boundaries: each shard nominates the lowest global
+    id among its rows' global maxima and the cross-shard pmin picks
+    the lowest nominee — ``np.argmax``'s first-maximum rule, which the
+    speculative-acceptance comparison relies on
+    (``tests/L0/test_vocab_parallel.py``).
+
+    ``logits``: (…, V) floating point.  A vocab that does not divide
+    the ``axis`` size is padded here with -inf columns (excluded from
+    both the argmax candidates and the finite check, so the result is
+    exactly the unpadded one — the serving twin of the loss's
+    ``true_vocab`` masking).  Returns ``(ids (…,) int32,
+    finite (…,) bool)``, replicated.
+    """
+    v = logits.shape[-1]
+    n = mesh.shape[axis]
+    pad, true_vocab = (-v) % n, None
+    if pad:
+        true_vocab = v
+        widths = [(0, 0)] * (logits.ndim - 1) + [(0, pad)]
+        logits = jnp.pad(logits, widths, constant_values=-jnp.inf)
+    return _build_sample(mesh, axis, logits.ndim, true_vocab)(logits)
+
+
+def vocab_parallel_argmax(logits, mesh, axis: str = "model"):
+    """The ids half of :func:`vocab_parallel_sample` — sharded greedy
+    argmax, bit-exact against :func:`ops.greedy_argmax` ties
+    included.  (Under jit the unused finite guard is dead-code
+    eliminated, so this costs nothing over the fused pair.)"""
+    return vocab_parallel_sample(logits, mesh, axis)[0]
 
 
 @functools.lru_cache(maxsize=32)
@@ -87,12 +207,7 @@ def _build(mesh, axis, vshard, true_vocab, logits_dtype, has_mask):
     # jit-wrapped (inlined under an outer jit): an EAGER partial-manual
     # shard_map rejects inputs whose committed sharding names automatic
     # axes ("out_specs refers to 'data'"); under jit GSPMD owns them
-    return jax.jit(jax.shard_map(
-        per_shard, mesh=mesh,
-        in_specs=in_specs,
-        out_specs=P(),
-        axis_names={axis},       # partial-manual: dp/sp stay automatic
-        check_vma=False))
+    return jax.jit(_shard_map(per_shard, mesh, in_specs, P(), axis))
 
 
 def vocab_parallel_lm_loss(hidden, wte, input_ids, mesh,

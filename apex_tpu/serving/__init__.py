@@ -40,6 +40,16 @@ serve".  Three layers, bottom-up:
   argmax plus the model's next token, so output is bit-identical to
   one-token decode while repetitive traffic decodes several tokens
   per engine step;
+- tensor-parallel sharded serving (``docs/serving.md``,
+  "Tensor-parallel serving"): pass ``mesh=`` (+ optional
+  ``tp_rules=``) and the engine lowers every compiled program through
+  GSPMD over a device mesh — params split Megatron-style
+  (``parallel.gpt_tp_rules``), the KV pool shards its heads dim while
+  block tables stay replicated host state, and the fused sampling
+  twins take the vocab-parallel argmax path
+  (``ops.vocab_parallel_sample``) so logits never gather; greedy
+  output is bit-identical to the unsharded engine
+  (``tests/L0/test_serving_tp.py``);
 - :mod:`serving.overload` + the lifecycle layer — priority-aware load
   shedding (``finish_reason="shed"``) under queue/pool pressure, a
   circuit breaker in front of ``submit``

@@ -375,6 +375,9 @@ class InferenceServer:
                  cache_dtype=None,
                  attention_fn=None,
                  prefill_buckets=None,
+                 mesh=None,
+                 tp_rules=None,
+                 tp_axis: str = "model",
                  sample_fn: Optional[Callable] = None,
                  max_waiting: Optional[int] = None,
                  clock: Callable[[], float] = time.monotonic,
@@ -421,7 +424,8 @@ class InferenceServer:
             max_context=max_context, num_blocks=num_blocks,
             block_size=block_size, cache_dtype=cache_dtype,
             attention_fn=attention_fn, prefill_buckets=prefill_buckets,
-            tracer=self.tracer, programs=self.programs)
+            tracer=self.tracer, programs=self.programs,
+            mesh=mesh, tp_rules=tp_rules, tp_axis=tp_axis)
         self.failures = CounterMeter(registry=self.registry,
                                      name="serving_failures",
                                      label="reason")
@@ -1560,6 +1564,10 @@ class InferenceServer:
             "lookahead_granted_blocks": sched.lookahead_granted,
             "lookahead_rolled_back_blocks": sched.lookahead_rolled_back,
             "pool_bytes": info["pool_bytes"],
+            # the ACTUAL per-chip HBM cost, from the live arrays'
+            # shard shape/dtype — equals pool_bytes unsharded, and
+            # pool_bytes/tp under tensor parallelism
+            "pool_bytes_per_device": info["pool_bytes_per_device"],
             "cache_dtype": info["cache_dtype"],
         }
         return out
@@ -1708,6 +1716,12 @@ class InferenceServer:
                 "port": self.ops.port if self.ops is not None else None,
                 "requests": self.ops_requests.total,
             },
+            # tensor-parallel serving (docs/serving.md,
+            # "Tensor-parallel serving"): mesh geometry, tp degree,
+            # per-shard KV bytes, and the mesh-lowered program count —
+            # pinned like the blocks above; {enabled: False, tp: 1}
+            # on a single-chip server
+            "sharding": self.engine.sharding_info(),
             # SLO attainment + goodput-vs-throughput
             # (docs/observability.md, "SLO & goodput")
             "slo": self.slo.as_stats(),

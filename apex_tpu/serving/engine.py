@@ -66,10 +66,12 @@ from typing import Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from apex_tpu.observability import NULL_PROGRAM_ACCOUNTING, NULL_TRACER
 from apex_tpu.models.gpt import GPTConfig, GPTLMHeadModel
 from apex_tpu.ops.sampling import finite_rows, greedy_argmax
+from apex_tpu.ops.vocab_parallel import vocab_parallel_sample
 from apex_tpu.serving.kv_cache import (
     BlockAllocator,
     KVCacheConfig,
@@ -154,6 +156,25 @@ class DecodeEngine:
         ``copy_blocks``): call count, host wall time, compile count,
         compile time.  Default: the zero-overhead disabled instance
         (``InferenceServer`` passes a registry-backed one).
+      mesh: optional :class:`jax.sharding.Mesh` — tensor-parallel
+        serving (``docs/serving.md``, "Tensor-parallel serving").
+        Params place per ``tp_rules`` (Megatron column/row split), the
+        KV pool shards its HEADS dim over ``tp_axis`` (each device
+        holds ``num_heads/tp`` heads of EVERY block, so block tables,
+        the allocator, and the whole scheduler stay replicated
+        host-side state), and all compiled programs lower through
+        GSPMD with sharded in/out placements — XLA inserts the
+        attention all-reduce and the lm-head all-gather; the sampled
+        twins take the fused :func:`ops.vocab_parallel_sample` path
+        (per-shard argmax, one (B,)-shaped cross-shard reduction)
+        instead of ever gathering logits.  Greedy token streams are
+        bit-exact vs the unsharded engine
+        (``tests/L0/test_serving_tp.py``).
+      tp_rules: the ``(regex, PartitionSpec)`` param-sharding rules
+        for ``mesh`` (default :func:`parallel.gpt_tp_rules` on
+        ``tp_axis``).
+      tp_axis: the mesh axis tensor parallelism shards over
+        (default ``"model"``).
     """
 
     def __init__(self, cfg: GPTConfig, params, *,
@@ -165,11 +186,41 @@ class DecodeEngine:
                  attention_fn=None,
                  prefill_buckets: Optional[Sequence[int]] = None,
                  tracer=None,
-                 programs=None):
+                 programs=None,
+                 mesh=None,
+                 tp_rules=None,
+                 tp_axis: str = "model"):
         self.cfg = cfg
         self.tracer = tracer if tracer is not None else NULL_TRACER
         self.programs = (programs if programs is not None
                          else NULL_PROGRAM_ACCOUNTING)
+        self.mesh = mesh
+        self.tp_axis = tp_axis if mesh is not None else None
+        self.tp = 1
+        self._repl = None         # replicated placement for launch args
+        self._pool_shard = None   # the pool's head-sharded placement
+        if mesh is not None:
+            if tp_axis not in mesh.shape:
+                raise ValueError(
+                    f"tp_axis {tp_axis!r} is not an axis of the mesh "
+                    f"(axes: {tuple(mesh.shape)})")
+            self.tp = int(mesh.shape[tp_axis])
+            if cfg.num_attention_heads % self.tp:
+                raise ValueError(
+                    f"num_attention_heads={cfg.num_attention_heads} "
+                    f"must divide the {tp_axis!r} axis ({self.tp}) — "
+                    "the KV pool shards its heads dim, so every "
+                    "device must hold a whole number of heads")
+            from apex_tpu.parallel.tensor_parallel import (
+                gpt_tp_rules,
+                shard_params,
+            )
+            if tp_rules is None:
+                tp_rules = gpt_tp_rules(tp_axis)
+            params = shard_params(params, mesh, tp_rules)
+            self._repl = NamedSharding(mesh, P())
+            self._pool_shard = NamedSharding(
+                mesh, P(None, None, tp_axis, None))
         self.params = params
         self.max_batch_size = int(max_batch_size)
         self.max_context = int(max_context
@@ -191,7 +242,8 @@ class DecodeEngine:
             block_size=self.block_size,
             dtype=cache_dtype)
         self.allocator = BlockAllocator(self.cache_cfg)
-        self.cache = init_kv_cache(self.cache_cfg)
+        self.cache = init_kv_cache(self.cache_cfg,
+                                   sharding=self._pool_shard)
         self.model = GPTLMHeadModel(cfg, attention_fn=attention_fn)
         if prefill_buckets is None:
             prefill_buckets = default_prefill_buckets(self.max_context)
@@ -202,15 +254,29 @@ class DecodeEngine:
                 f"largest prefill bucket {self.prefill_buckets[-1]} "
                 f"< max_context {self.max_context}")
 
-        self._prefill_jit = jax.jit(self._prefill_impl,
-                                    donate_argnums=(1,))
-        self._decode_jit = jax.jit(self._decode_impl,
-                                   donate_argnums=(1,))
-        self._chunk_jit = jax.jit(self._chunk_impl,
-                                  donate_argnums=(1,))
-        self._verify_jit = jax.jit(self._verify_impl,
-                                   donate_argnums=(1,))
-        self._copy_jit = jax.jit(self._copy_impl, donate_argnums=(0,))
+        # under a mesh every program pins its output placements so
+        # GSPMD keeps the (donated) pool head-sharded and replicates
+        # exactly what the host consumes (logits / token ids / flags);
+        # without one the jits are byte-identical to the single-chip
+        # engine
+        def _jit(fn, donate, outs):
+            if self.mesh is None:
+                return jax.jit(fn, donate_argnums=donate)
+            return jax.jit(fn, donate_argnums=donate,
+                           out_shardings=outs)
+
+        cache_sh = ({"k": self._pool_shard, "v": self._pool_shard}
+                    if self.mesh is not None else None)
+        repl = self._repl
+        self._prefill_jit = _jit(self._prefill_impl, (1,),
+                                 (cache_sh, repl))
+        self._decode_jit = _jit(self._decode_impl, (1,),
+                                (cache_sh, repl))
+        self._chunk_jit = _jit(self._chunk_impl, (1,),
+                               (cache_sh, repl))
+        self._verify_jit = _jit(self._verify_impl, (1,),
+                                (cache_sh, repl))
+        self._copy_jit = _jit(self._copy_impl, (0,), cache_sh)
         # the fused on-device-sampling twins (docs/serving.md,
         # "Pipelined serve loop"): same bodies + argmax/finite-guard,
         # so a greedy server transfers token ids, never logits.
@@ -222,14 +288,18 @@ class DecodeEngine:
         # so trading the (already-copied-anyway) in-place update for
         # an async launch is the right side of the bargain there.
         sampled_cache = (1,) if jax.default_backend() != "cpu" else ()
-        self._prefill_sampled_jit = jax.jit(self._prefill_sampled_impl,
-                                            donate_argnums=sampled_cache)
-        self._chunk_sampled_jit = jax.jit(self._chunk_sampled_impl,
-                                          donate_argnums=sampled_cache)
-        self._decode_sampled_jit = jax.jit(self._decode_sampled_impl,
-                                           donate_argnums=sampled_cache)
-        self._verify_sampled_jit = jax.jit(self._verify_sampled_impl,
-                                           donate_argnums=sampled_cache)
+        self._prefill_sampled_jit = _jit(self._prefill_sampled_impl,
+                                         sampled_cache,
+                                         (cache_sh, repl, repl))
+        self._chunk_sampled_jit = _jit(self._chunk_sampled_impl,
+                                       sampled_cache,
+                                       (cache_sh, repl, repl))
+        self._decode_sampled_jit = _jit(self._decode_sampled_impl,
+                                        sampled_cache,
+                                        (cache_sh, repl, repl))
+        self._verify_sampled_jit = _jit(self._verify_sampled_impl,
+                                        sampled_cache,
+                                        (cache_sh, repl, repl))
 
     # -- compiled bodies --------------------------------------------------
 
@@ -354,29 +424,40 @@ class DecodeEngine:
     # the device — only (B,) int32 ids and (B,) bool flags transfer,
     # and only when the caller eventually materializes them.
 
+    def _sample(self, logits):
+        """The fused argmax + finite guard: plain on one chip; under a
+        mesh the :func:`ops.vocab_parallel_sample` path — per-shard
+        argmax over the lm-head's OWN vocab slice and one (B,)-shaped
+        cross-shard reduction (documented lowest-global-id tie rule),
+        so the vocab-sharded logits are never all-gathered just to be
+        argmaxed."""
+        if self.mesh is not None:
+            return vocab_parallel_sample(logits, self.mesh,
+                                         self.tp_axis)
+        return greedy_argmax(logits), finite_rows(logits)
+
     def _prefill_sampled_impl(self, params, cache, ids, length, table):
         cache, last = self._prefill_impl(params, cache, ids, length,
                                          table)
-        return cache, greedy_argmax(last), finite_rows(last)   # (1,)
+        return (cache,) + self._sample(last)                   # (1,)
 
     def _chunk_sampled_impl(self, params, cache, ids, start, length,
                             table):
         cache, last = self._chunk_impl(params, cache, ids, start,
                                        length, table)
-        return cache, greedy_argmax(last), finite_rows(last)   # (1,)
+        return (cache,) + self._sample(last)                   # (1,)
 
     def _decode_sampled_impl(self, params, cache, tokens, positions,
                              tables):
         cache, logits = self._decode_impl(params, cache, tokens,
                                           positions, tables)
-        return cache, greedy_argmax(logits), finite_rows(logits)  # (B,)
+        return (cache,) + self._sample(logits)                 # (B,)
 
     def _verify_sampled_impl(self, params, cache, ids, start, length,
                              tables):
         cache, logits = self._verify_impl(params, cache, ids, start,
                                           length, tables)
-        return (cache, greedy_argmax(logits),
-                finite_rows(logits))                           # (B, K)
+        return (cache,) + self._sample(logits)                 # (B, K)
 
     # -- host API ---------------------------------------------------------
 
@@ -425,7 +506,12 @@ class DecodeEngine:
         arrays ship as a single ``jax.device_put`` pytree instead of
         one ``jnp.asarray`` dispatch per array.  Compile counts are
         untouched — shapes/dtypes are identical to the per-array
-        path."""
+        path.  Under a mesh the struct commits REPLICATED: token ids,
+        positions, and block tables are host-side scheduler state that
+        every shard consumes whole (docs/serving.md, "Tensor-parallel
+        serving")."""
+        if self._repl is not None:
+            return jax.device_put(arrays, self._repl)
         return jax.device_put(arrays)
 
     def _prefill_args(self, prompt, block_table):
@@ -625,22 +711,65 @@ class DecodeEngine:
         return (self._verify_jit._cache_size()
                 + self._verify_sampled_jit._cache_size())
 
+    def collective_programs(self) -> int:
+        """Compiled traces currently lowered THROUGH the mesh (all
+        program families, logits + sampled twins + block copy) — the
+        ``stats()["sharding"]`` audit that sharded serving compiled
+        one program per logical (program, shape) key, not per shard.
+        0 on an unsharded engine: nothing it compiles carries a
+        collective."""
+        if self.mesh is None:
+            return 0
+        return sum(j._cache_size() for j in (
+            self._prefill_jit, self._chunk_jit, self._decode_jit,
+            self._verify_jit, self._copy_jit,
+            self._prefill_sampled_jit, self._chunk_sampled_jit,
+            self._decode_sampled_jit, self._verify_sampled_jit))
+
     def memory_info(self) -> dict:
         """Static pool geometry for ``stats()["memory"]`` and
-        postmortem manifests: usable blocks, tokens per block, and the
-        pool's HBM footprint in the resolved cache dtype (both K and
-        V)."""
+        postmortem manifests: usable blocks, tokens per block, the
+        pool's LOGICAL footprint (both K and V, all shards), and —
+        what per-chip HBM budgeting must use — the ACTUAL per-device
+        bytes, read off the live arrays' shard shape and dtype (under
+        tensor parallelism each device holds ``num_heads/tp`` heads of
+        the pool, so the logical size overstates per-chip HBM by
+        tp×)."""
         cfg = self.cache_cfg
+        k = self.cache["k"]
+        shard_elems = int(np.prod(k.sharding.shard_shape(k.shape)))
+        per_device = 2 * shard_elems * jnp.dtype(k.dtype).itemsize
         return {
             "blocks_usable": cfg.num_blocks - 1,
             "block_size": cfg.block_size,
             "pool_tokens": cfg.usable_tokens,
             "pool_bytes": cfg.bytes(),
-            "cache_dtype": str(cfg.resolved_dtype()),
+            "pool_bytes_per_device": per_device,
+            "cache_dtype": str(jnp.dtype(k.dtype)),
+        }
+
+    def sharding_info(self) -> dict:
+        """The pinned ``stats()["sharding"]`` block: tensor-parallel
+        degree and axis, mesh geometry, per-shard KV bytes, and the
+        mesh-lowered program count (``docs/serving.md``,
+        "Tensor-parallel serving")."""
+        return {
+            "enabled": self.mesh is not None,
+            "tp": self.tp,
+            "axis": self.tp_axis,
+            "devices": (int(self.mesh.size)
+                        if self.mesh is not None else 1),
+            "mesh": ({name: int(n)
+                      for name, n in self.mesh.shape.items()}
+                     if self.mesh is not None else None),
+            "kv_pool_bytes_per_device":
+                self.memory_info()["pool_bytes_per_device"],
+            "collective_programs": self.collective_programs(),
         }
 
     def reset_cache(self):
         """Zero the pool and refill the allocator in place (between
         workloads; schedulers holding the allocator stay wired)."""
-        self.cache = init_kv_cache(self.cache_cfg)
+        self.cache = init_kv_cache(self.cache_cfg,
+                                   sharding=self._pool_shard)
         self.allocator.reset()

@@ -107,12 +107,22 @@ class KVCacheConfig:
                 * self.head_dim * self.resolved_dtype().itemsize)
 
 
-def init_kv_cache(cfg: KVCacheConfig):
+def init_kv_cache(cfg: KVCacheConfig, sharding=None):
     """Allocate the zeroed pool: ``{"k","v"}`` each
-    (L, num_slots, H, D) in the resolved cache dtype."""
+    (L, num_slots, H, D) in the resolved cache dtype.
+
+    ``sharding``: optional ``jax.sharding.Sharding`` for each leaf —
+    tensor-parallel serving passes the head-sharded pool placement
+    (``P(None, None, model, None)``) so every device materializes ONLY
+    its ``H/tp`` heads of every block; the zeros are created sharded
+    (jit ``out_shardings``), never allocated whole and scattered."""
     shape = (cfg.num_layers, cfg.num_slots, cfg.num_heads, cfg.head_dim)
     dt = cfg.resolved_dtype()
-    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+    if sharding is None:
+        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+    return jax.jit(
+        lambda: {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)},
+        out_shardings={"k": sharding, "v": sharding})()
 
 
 # ---------------------------------------------------------------------------

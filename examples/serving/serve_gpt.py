@@ -47,6 +47,12 @@ def parse_args():
                    "(default: random init)")
     p.add_argument("--flash", action="store_true",
                    help="flash-attention prefill (Pallas on TPU)")
+    p.add_argument("--tp", type=int, default=None, metavar="N",
+                   help="tensor-parallel serving over the first N "
+                   "devices (docs/serving.md, 'Tensor-parallel "
+                   "serving'): params shard Megatron-style, the KV "
+                   "pool shards its heads, decode runs GSPMD; greedy "
+                   "output is bit-identical to unsharded")
     p.add_argument("--eos", type=int, default=None,
                    help="stop token id (default: run to --max-new)")
     p.add_argument("--ops-port", type=int, default=None,
@@ -88,10 +94,20 @@ def main():
         from apex_tpu.ops import make_flash_attention
         attention_fn = make_flash_attention(causal=True)
 
+    mesh = None
+    if args.tp:
+        from jax.sharding import Mesh
+        if len(jax.devices()) < args.tp:
+            raise SystemExit(
+                f"--tp {args.tp} needs {args.tp} devices, have "
+                f"{len(jax.devices())} (on CPU, set XLA_FLAGS="
+                f"--xla_force_host_platform_device_count={args.tp})")
+        mesh = Mesh(np.asarray(jax.devices()[:args.tp]), ("model",))
+
     server = InferenceServer(
         cfg, params, max_batch_size=args.batch_size,
         max_context=args.max_context, block_size=args.block_size,
-        attention_fn=attention_fn, ops_port=args.ops_port)
+        attention_fn=attention_fn, ops_port=args.ops_port, mesh=mesh)
     if server.ops is not None:
         print(f"ops plane: http://127.0.0.1:{server.ops.port} "
               f"(/healthz /metrics /statusz /debug/flight)")
@@ -100,6 +116,12 @@ def main():
           f"{cfg.hidden_size})  kv pool: {kv.num_blocks - 1} blocks x "
           f"{kv.block_size} tokens, {kv.resolved_dtype().name}, "
           f"{kv.bytes() / 2 ** 20:.1f} MiB")
+    if mesh is not None:
+        sh = server.engine.sharding_info()
+        print(f"tensor parallel: tp={sh['tp']} over "
+              f"{sh['devices']} devices "
+              f"({sh['kv_pool_bytes_per_device'] / 2 ** 20:.1f} MiB "
+              "KV per device)")
 
     rng = np.random.RandomState(args.seed)
     max_ctx = server.engine.max_context

@@ -289,7 +289,7 @@ def test_stats_slo_and_memory_blocks_pinned(tiny):
                 "blocks_evictable_peak", "occupancy", "occupancy_peak",
                 "frag_slots", "frag_frac", "lookahead_granted_blocks",
                 "lookahead_rolled_back_blocks", "pool_bytes",
-                "cache_dtype"} - mem.keys()
+                "pool_bytes_per_device", "cache_dtype"} - mem.keys()
     assert mem["blocks_live_peak"] >= 1
     assert mem["occupancy_peak"] == pytest.approx(
         mem["blocks_live_peak"] / mem["blocks_usable"], abs=1e-3)

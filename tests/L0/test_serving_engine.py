@@ -241,6 +241,19 @@ def test_stats_keys_are_backward_compatible(tiny):
     assert not ops - st["ops"].keys(), \
         f"stats() lost ops keys: {ops - st['ops'].keys()}"
     assert st["ops"]["enabled"] is False           # off by default
+    # tensor-parallel serving block (docs/serving.md,
+    # "Tensor-parallel serving"): pinned even unsharded — the tp
+    # bench and dashboards key on these
+    shard = {"enabled", "tp", "axis", "devices", "mesh",
+             "kv_pool_bytes_per_device", "collective_programs"}
+    assert not shard - st["sharding"].keys(), \
+        f"stats() lost sharding keys: {shard - st['sharding'].keys()}"
+    assert st["sharding"]["enabled"] is False      # no mesh passed
+    assert st["sharding"]["tp"] == 1
+    assert st["sharding"]["collective_programs"] == 0
+    # unsharded: the per-device pool IS the logical pool
+    assert st["memory"]["pool_bytes_per_device"] == \
+        st["memory"]["pool_bytes"]
     lat = st["latency"]
     assert set(lat) == {"ttft_ms", "queue_wait_ms", "decode_token_ms",
                         "step_ms", "queue_wait_by_priority_ms"}

@@ -1,0 +1,168 @@
+"""ops.vocab_parallel under serving-shaped calls: the sharded lm-head
+argmax must be BIT-EXACT against the unsharded on-device argmax
+(``ops.greedy_argmax``) and the host sampler
+(``serving.greedy_sample``) — including exact ties that straddle
+shard boundaries, which is where a vocab-parallel reduction can
+silently diverge (each shard's local argmax is blind to the other
+shards' equal maxima; the lowest GLOBAL id must still win).
+
+These are the direct unit tests behind the tensor-parallel serving
+engine's fused sampling path (``serving.engine.DecodeEngine(mesh=)``
+→ :func:`ops.vocab_parallel_sample`); the end-to-end token-stream
+parity lives in ``tests/L0/test_serving_tp.py``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from apex_tpu.ops import vocab_parallel_argmax, vocab_parallel_sample
+from apex_tpu.ops.sampling import finite_rows, greedy_argmax
+from apex_tpu.serving import greedy_sample
+
+pytestmark = pytest.mark.serving
+
+
+def _mesh(tp):
+    return Mesh(np.asarray(jax.devices()[:tp]), ("model",))
+
+
+def _check(x, mesh, dtype):
+    """One oracle triangle: sharded sample == unsharded device argmax
+    == host argmax, and the finite flags match the host guard — on
+    the SAME (possibly rounded) values the device sees."""
+    dev = jnp.asarray(x).astype(dtype)
+    ids, fin = vocab_parallel_sample(dev, mesh, "model")
+    want_ids = np.asarray(greedy_argmax(dev))
+    assert (np.asarray(ids) == want_ids).all(), \
+        (np.asarray(ids), want_ids)
+    host = np.asarray(dev).astype(np.float32)
+    finite_host = np.all(np.isfinite(host), axis=-1)
+    assert (np.asarray(fin) == np.asarray(finite_rows(dev))).all()
+    assert (np.asarray(fin) == finite_host).all()
+    # rows the guard passes must match the host sampler exactly
+    assert (np.asarray(ids)[finite_host]
+            == greedy_sample(host)[finite_host]).all()
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("tp", [2, 4])
+def test_decode_shaped_logits_match_unsharded(tp, dtype):
+    """(B, V) decode-step logits, fp32 and bf16, across 30 seeded
+    draws — the steady-state shape of the sharded decode program."""
+    mesh = _mesh(tp)
+    for trial in range(30):
+        rng = np.random.RandomState(trial)
+        x = rng.randn(4, 64).astype(np.float32)
+        if trial % 3 == 0:
+            # exact ties at the row max, anywhere
+            row = trial % 4
+            x[row, rng.choice(64, 5, replace=False)] = x[row].max()
+        _check(x, mesh, dtype)
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+def test_cross_shard_boundary_ties_take_lowest_global_id(tp):
+    """The documented tie rule at its hardest: equal maxima placed
+    exactly at shard boundaries (last id of shard s, first id of
+    shard s+1) and spanning non-adjacent shards — the lowest global
+    id must win, which is what speculative acceptance's
+    argmax-to-argmax comparison relies on."""
+    v, vshard = 64, 64 // tp
+    mesh = _mesh(tp)
+    for lo, hi in [(vshard - 1, vshard),          # adjacent boundary
+                   (0, v - 1),                    # first vs last shard
+                   (vshard, 2 * vshard - 1),      # within shard 1
+                   (3, vshard + 3)]:
+        x = np.zeros((2, v), np.float32)
+        x[0, [lo, hi]] = 7.5
+        x[1, :] = -1.0                            # full-row tie -> 0
+        for dtype in (jnp.float32, jnp.bfloat16):
+            dev = jnp.asarray(x).astype(dtype)
+            ids, fin = vocab_parallel_sample(dev, mesh, "model")
+            assert np.asarray(ids).tolist() == [lo, 0], (tp, lo, hi)
+            assert np.asarray(fin).all()
+            assert int(vocab_parallel_argmax(dev, mesh)[0]) == lo
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+def test_verify_shaped_and_single_row_logits(tp):
+    """(B, K, V) verify-step logits and a bare (V,) row — the sampler
+    is rank-generic like ``greedy_sample``."""
+    mesh = _mesh(tp)
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 5, 64).astype(np.float32)
+    x[1, 2, [7, 40]] = x[1, 2].max() + 1          # cross-shard tie
+    dev = jnp.asarray(x)
+    ids, fin = vocab_parallel_sample(dev, mesh, "model")
+    assert ids.shape == (3, 5) and fin.shape == (3, 5)
+    assert (np.asarray(ids) == np.asarray(greedy_argmax(dev))).all()
+    assert int(np.asarray(ids)[1, 2]) == 7
+    row = jnp.asarray(x[0, 0])
+    rid, rfin = vocab_parallel_sample(row, mesh, "model")
+    assert int(rid) == int(greedy_argmax(row)) and bool(rfin)
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+def test_nonfinite_rows_flagged_without_poisoning_neighbors(tp):
+    """A NaN anywhere in a row (even on one shard only) must flag
+    exactly that row and clamp its id to the last token — the
+    unsharded ``greedy_argmax`` rule — while finite rows sample
+    normally; an inf row flags but still argmaxes to the inf."""
+    mesh = _mesh(tp)
+    x = np.tile(np.arange(64, dtype=np.float32), (4, 1))
+    x[1, 3] = np.nan                               # shard 0 only
+    x[2, 60] = np.nan                              # last shard only
+    x[3, 10] = np.inf
+    dev = jnp.asarray(x)
+    ids, fin = vocab_parallel_sample(dev, mesh, "model")
+    assert np.asarray(fin).tolist() == [True, False, False, False]
+    assert (np.asarray(ids) == np.asarray(greedy_argmax(dev))).all()
+    assert np.asarray(ids).tolist() == [63, 63, 63, 10]
+
+
+@pytest.mark.parametrize("v", [61, 3, 65])
+def test_indivisible_vocab_pads_exactly(v):
+    """A vocab that does not divide the axis pads internally with
+    -inf columns: ids, ties, NaN clamping (to the TRUE last id), and
+    finite flags are exactly the unpadded semantics."""
+    mesh = _mesh(4)
+    rng = np.random.RandomState(v)
+    x = rng.randn(5, v).astype(np.float32)
+    x[0, [0, v - 1]] = x[0].max() + 2              # tie incl last id
+    x[1, 0] = np.nan
+    x[2, :] = x[2].max()                           # full-row tie
+    for dtype in (jnp.float32, jnp.bfloat16):
+        _check(x, mesh, dtype)
+        dev = jnp.asarray(x).astype(dtype)
+        ids, fin = vocab_parallel_sample(dev, mesh, "model")
+        assert int(np.asarray(ids)[1]) == v - 1    # true last id
+        assert bool(np.asarray(fin)[1]) is False   # -inf pad excluded
+        assert int(np.asarray(ids)[2]) == 0
+
+
+def test_engine_decode_step_logits_roundtrip():
+    """Serving-shaped end-to-end slice: real decode-step logits from
+    the tiny GPT lm head, sampled sharded vs unsharded — the exact
+    tensors the fused sampled programs argmax."""
+    from apex_tpu import models
+
+    cfg = models.GPTConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=32, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+    m = models.GPTLMHeadModel(cfg)
+    params = m.init(jax.random.PRNGKey(2),
+                    jnp.ones((1, 8), jnp.int32))["params"]
+    ids = jnp.asarray([[3, 1, 4, 1, 5, 9, 2, 6],
+                       [2, 7, 1, 8, 2, 8, 1, 8]], jnp.int32)
+    logits = m.apply({"params": params}, ids,
+                     deterministic=True)[:, -1]    # (B, V) decode row
+    for tp in (2, 4):
+        got, fin = vocab_parallel_sample(logits, _mesh(tp), "model")
+        assert (np.asarray(got)
+                == np.asarray(greedy_argmax(logits))).all()
+        assert np.asarray(fin).all()

@@ -120,6 +120,34 @@ env JAX_PLATFORMS=cpu APEX_TPU_TRACE="$pipe_trace" \
 results[pipeline]=$?
 rm -f "$pipe_trace"
 
+# tensor-parallel serving: the GSPMD sharding axis (docs/serving.md,
+# "Tensor-parallel serving") — three gates under an emulated 8-device
+# host-platform mesh (the same trick tests/conftest.py uses):
+#   1. the L0 sharding tier: bit-exact tp∈{2,4} greedy parity vs the
+#      unsharded engine (incl. prefix-cache COW hits, forced
+#      preemption/eviction, chunked prefill, speculation, pipeline,
+#      per-step audits) plus the vocab-parallel argmax unit oracle
+#      incl. cross-shard lowest-global-id ties;
+#   2. serving_bench --tp 2: parity always asserted + the
+#      backend-aware throughput floor (>= 0.9x no-regression on the
+#      emulated CPU mesh; the >= scaling floor arms itself on real
+#      multi-chip backends — BENCH_NOTES);
+#   3. an 800-iteration seed-0 chaos soak with the soaked server
+#      sharded tp=2 while the replay oracle stays UNSHARDED — every
+#      healthy bit-exact replay doubles as sharded-vs-unsharded
+#      parity under the full composed-fault surface.
+echo "=== build-matrix axis: serving-tp ==="
+env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/L0/test_serving_tp.py \
+      tests/L0/test_vocab_parallel.py -q -x --no-header \
+  && env JAX_PLATFORMS=cpu \
+      XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+      python tools/serving_bench.py --smoke --tp 2 --out - \
+  && env JAX_PLATFORMS=cpu \
+      XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+      python tools/chaos_soak.py --seed 0 --iters 800 --tp 2
+results[serving_tp]=$?
+
 # chaos soak: the overload-robustness axis (docs/resilience.md,
 # "Overload policy & lifecycle") — the full serving stack (prefix
 # cache + chunked prefill + overload control + circuit breaker, small

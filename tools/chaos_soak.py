@@ -94,6 +94,14 @@ def main(argv=None) -> int:
                         help="speculation-enabled traffic class: "
                         "serve with speculative decoding on and mix "
                         "in repetitive prompts so drafts fire")
+    parser.add_argument("--tp", type=int, default=None, metavar="N",
+                        help="soak a TENSOR-PARALLEL server: shard "
+                        "the soaked server over an N-device mesh "
+                        "(docs/serving.md, 'Tensor-parallel "
+                        "serving') while the bit-exactness replay "
+                        "oracle stays UNSHARDED — so every healthy "
+                        "output also proves sharded-vs-unsharded "
+                        "greedy parity under composed faults")
     parser.add_argument("--pipeline", dest="pipeline",
                         action="store_true", default=True,
                         help="soak the pipelined (dispatch-ahead) "
@@ -127,6 +135,15 @@ def main(argv=None) -> int:
                         "build-matrix axis; the soak then MUST fail)")
     args = parser.parse_args(argv)
 
+    if args.tp:
+        # the emulated mesh must exist before jax initializes its
+        # backend (same trick as tests/conftest.py)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{max(8, args.tp)}").strip()
+
     import time as _time
 
     import jax.numpy as jnp
@@ -137,6 +154,18 @@ def main(argv=None) -> int:
     from apex_tpu.serving import InferenceServer
 
     cfg, params = build_model()
+
+    mesh = None
+    if args.tp:
+        import jax
+        import numpy as _np
+        from jax.sharding import Mesh
+
+        if len(jax.devices()) < args.tp:
+            print(f"--tp {args.tp} needs {args.tp} devices, have "
+                  f"{len(jax.devices())}", file=sys.stderr)
+            return 2
+        mesh = Mesh(_np.asarray(jax.devices()[:args.tp]), ("model",))
 
     def make_server(clock):
         # small pool + bounded queue: preemption, eviction, capacity,
@@ -155,10 +184,14 @@ def main(argv=None) -> int:
         # wall stalls), ephemeral-port ops plane, and per-program
         # accounting (the server default) — observation only, so the
         # per-seed report stays byte-identical with all of it on
+        # --tp shards the SOAKED server only: the roomy replay oracle
+        # below stays unsharded, so the bit-exact-replay invariant
+        # doubles as sharded-vs-unsharded parity under every fault
         return InferenceServer(
             cfg, params, max_batch_size=4, max_context=64,
             block_size=4, num_blocks=40,          # 39 usable blocks
             cache_dtype=jnp.float32, max_waiting=8, clock=clock,
+            mesh=mesh,
             enable_speculation=args.speculative,
             enable_pipeline=args.pipeline,
             flight_recorder=FlightRecorder(
@@ -191,6 +224,7 @@ def main(argv=None) -> int:
                       make_replay=make_replay, log=print,
                       postmortem_dir=args.postmortem_dir)
     report["wall_s"] = round(time.perf_counter() - t0, 2)
+    report["tp"] = args.tp or 1
 
     line = json.dumps(report, indent=2, sort_keys=True)
     if args.out == "-":
