@@ -215,12 +215,22 @@ class OpsServer:
             status = "breaker_open"
         else:
             status = "ok"
+        sched = srv.scheduler
         body = {
             "status": status,
             "iter": srv._iter,
             "breaker": (srv.breaker.state if srv.breaker is not None
                         else "disabled"),
             "pressure": round(srv.pressure_gauge.val, 4),
+            # the router-scrape trio (docs/serving.md, "Multi-replica
+            # routing"): one cheap machine-readable probe carries the
+            # placement signal (pressure), the lifecycle flag
+            # (draining), and the occupancy (waiting + running) a
+            # balancer keys on — no /statusz parse needed.  Plain
+            # attribute reads, same lock-free contract as the rest of
+            # this body.
+            "draining": bool(srv.draining),
+            "live_requests": len(sched.waiting) + len(sched.running),
             "watchdog_stalls": srv.watchdog.stalls,
             "uptime_s": round(self._clock() - self._started_at, 3),
         }

@@ -91,6 +91,10 @@ class CircuitBreaker:
         self._opened_at = 0.0
         self._probes_out = 0        # half-open admissions this episode
         self._probe_ok = 0          # half-open successes this episode
+        # lifetime transition tallies, kept breaker-side so callers
+        # without a CounterMeter (the router's per-replica breakers)
+        # still get them from state_snapshot()
+        self._transitions = {CLOSED: 0, OPEN: 0, HALF_OPEN: 0}
 
     # -- state ------------------------------------------------------------
 
@@ -111,6 +115,7 @@ class CircuitBreaker:
 
     def _transition(self, state: str) -> None:
         self._state = state
+        self._transitions[state] += 1
         if self.counters is not None:
             self.counters.incr(self._TRANSITION_KEYS[state])
 
@@ -165,6 +170,29 @@ class CircuitBreaker:
             self._streak += 1
             if self._streak >= self.failure_threshold:
                 self._trip(now)
+
+    def state_snapshot(self) -> dict:
+        """The breaker's full observable state as one JSON-safe dict —
+        state (advanced through any due open -> half-open transition),
+        the closed-state failure streak, the half-open probe budget
+        and how much of it is out/succeeded, and lifetime transition
+        counts.  This is how composite owners (the serving router's
+        per-replica breakers) surface breaker health in their
+        ``stats()`` without reaching into privates."""
+        return {
+            "state": self.state,
+            "failure_streak": self._streak,
+            "failure_threshold": self.failure_threshold,
+            "probes_out": self._probes_out,
+            "probe_ok": self._probe_ok,
+            "probe_quota": self.probe_quota,
+            "recovery_time": self.recovery_time,
+            "transitions": {
+                "opened": self._transitions[OPEN],
+                "half_open": self._transitions[HALF_OPEN],
+                "closed": self._transitions[CLOSED],
+            },
+        }
 
     def reset(self) -> None:
         """Force-close (operator override / between test cases)."""

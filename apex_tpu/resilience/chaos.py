@@ -43,7 +43,8 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 from apex_tpu.resilience.faults import FaultPlan, InjectedCrash
 
 __all__ = ["Arrival", "ChaosConfig", "ChaosEngine", "ChaosSchedule",
-           "TERMINAL_REASONS", "run_soak"]
+           "ReplicaKillSwitch", "ROUTER_TERMINAL_REASONS",
+           "TERMINAL_REASONS", "run_router_soak", "run_soak"]
 
 # every legal way a request's life can end; any other value is a bug
 TERMINAL_REASONS = frozenset({
@@ -56,6 +57,11 @@ TERMINAL_REASONS = frozenset({
 # prefix of the unfaulted replay (greedy decoding is deterministic, so
 # whatever a request produced before being cut short is bit-exact)
 HEALTHY_REASONS = frozenset({"eos", "length"})
+
+# the router tier adds one terminal reason: a mid-stream request on a
+# killed replica fails "replica_failed" (its cache cannot move; its
+# partial output must still be a bit-exact prefix of the replay)
+ROUTER_TERMINAL_REASONS = TERMINAL_REASONS | {"replica_failed"}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -339,6 +345,258 @@ class ChaosEngine:
 
     def __getattr__(self, name):
         return getattr(self.inner, name)
+
+
+class ReplicaKillSwitch:
+    """Engine wrapper that makes EVERY device call raise while armed —
+    the router chaos arm's replica kill (``docs/serving.md``,
+    "Multi-replica routing").  Unlike :class:`ChaosEngine`'s transient
+    ``MemoryError`` (which the serve loop skips-and-retries in place),
+    a :class:`RuntimeError` escapes the step loop entirely — the
+    in-process analogue of a replica process dying — so the ROUTER's
+    per-replica breaker, not the server's internal isolation, must
+    contain it.  Disarming models the replica coming back (a restart
+    that kept its host state), which the router's half-open probes
+    must discover on their own."""
+
+    _GATED = ("prefill", "chunk_prefill", "copy_blocks", "decode",
+              "verify", "prefill_sampled", "chunk_prefill_sampled",
+              "decode_sampled", "verify_sampled")
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.dead = False
+        self.kills = 0          # engine calls refused while dead
+
+    def __getattr__(self, name):
+        target = getattr(self.inner, name)
+        if name in self._GATED and callable(target):
+            def gated(*a, _t=target, **k):
+                if self.dead:
+                    self.kills += 1
+                    raise RuntimeError("chaos: replica killed")
+                return _t(*a, **k)
+            return gated
+        return target
+
+
+def run_router_soak(make_fleet: Callable, cfg: ChaosConfig, seed: int,
+                    *, kill_iter: int, recover_iter: int,
+                    victim: int = 0,
+                    make_replay: Optional[Callable] = None,
+                    log: Callable[[str], None] = lambda s: None,
+                    postmortem_dir: Optional[str] = None) -> dict:
+    """The multi-replica front door's chaos soak: seeded
+    mixed-priority traffic routed through a fleet while one replica is
+    KILLED (every engine call raises from ``kill_iter``) and later
+    RECOVERED (``recover_iter``), asserting the router invariants
+    (``docs/serving.md``, "Multi-replica routing"):
+
+      1. per-replica scheduler/allocator/prefix-cache ``audit()``
+         passes every step — including on the killed replica, whose
+         host bookkeeping must stay consistent through evacuation;
+      2. every routed request reaches EXACTLY ONE terminal state, on
+         exactly one replica, with a reason from
+         :data:`ROUTER_TERMINAL_REASONS` — re-enqueued requests
+         neither vanish nor double-finish;
+      3. the sum of per-replica finished counts equals the number of
+         requests injected (nothing lost at the router: every routed
+         request's final underlying request finished on exactly one
+         replica, and none went unplaced);
+      4. surviving (eos/length) outputs are bit-exact against a
+         SINGLE-replica unfaulted replay oracle — routing, failover,
+         and re-enqueue may move work but never change tokens — and
+         cut-short requests (incl. ``replica_failed``) produced a
+         bit-exact prefix of it;
+      5. per-replica failure counters reconcile with the observed
+         terminal reasons, and the router failed over at least once
+         (the kill window is not allowed to pass silently);
+      6. the killed replica RECOVERED: its router-side breaker is
+         closed again at the end and the replica is back in rotation.
+
+    ``make_fleet(clock)`` builds the ``RouterFleet`` on the soak's
+    deterministic iteration clock (per-replica breakers must run on
+    it too — the fleet default does); ``make_replay(clock)`` builds
+    the roomy single-replica oracle.  Engine-fault injection beyond
+    the kill is deliberately off: this soak attributes failures to
+    the ROUTER tier (``tools/chaos_soak.py`` keeps the single-replica
+    fault classes on their own axes)."""
+    if not 0 <= kill_iter < recover_iter <= cfg.iters:
+        raise ValueError(
+            f"need 0 <= kill_iter ({kill_iter}) < recover_iter "
+            f"({recover_iter}) <= iters ({cfg.iters})")
+    schedule = ChaosSchedule.generate(cfg, seed)
+    clock_state = {"t": 0.0}
+    fleet = make_fleet(lambda: clock_state["t"])
+    if not 0 <= victim < len(fleet.replicas):
+        raise ValueError(f"victim {victim} out of range")
+    vic = fleet.replicas[victim]
+    kill = ReplicaKillSwitch(vic.server.engine)
+    vic.server.engine = kill
+
+    tracked: Dict[int, Tuple] = {}      # rid -> (RouterRequest, Arrival)
+    terminal: Dict[int, str] = {}       # rid -> finish_reason
+    seen_uids: Set[int] = set()         # finished underlying uids
+    cursors = [0] * len(fleet.replicas)
+    report = {"iters": cfg.iters, "seed": seed,
+              "replicas": len(fleet.replicas),
+              "kill_iter": kill_iter, "recover_iter": recover_iter,
+              "victim": vic.name}
+    victim_finished_at_recovery = 0
+
+    def absorb_finished():
+        """Invariant 2's per-step half: every newly finished
+        underlying request finishes once, with a legal reason."""
+        for i, rep in enumerate(fleet.replicas):
+            fin = rep.server.scheduler.finished
+            for req in fin[cursors[i]:]:
+                assert req.uid not in seen_uids, \
+                    f"request uid {req.uid} finished twice"
+                seen_uids.add(req.uid)
+                assert req.finished and \
+                    req.finish_reason in ROUTER_TERMINAL_REASONS, \
+                    (f"request {req.uid} finished with bad reason "
+                     f"{req.finish_reason!r} on {rep.name}")
+            cursors[i] = len(fin)
+        for rid, (rr, _a) in tracked.items():
+            if rr.finished and rid not in terminal:
+                terminal[rid] = rr.finish_reason
+
+    def _postmortem_and_reraise(e: AssertionError):
+        if postmortem_dir is None:
+            raise e
+        bundle = os.path.join(postmortem_dir,
+                              "router_invariant_violation")
+        fleet.dump_postmortem(bundle, reason="invariant_violation",
+                              extra={"error": str(e), "seed": seed})
+        log(f"postmortem bundle written: {bundle}")
+        raise AssertionError(f"{e} [postmortem: {bundle}]") from e
+
+    try:
+        for i in range(cfg.iters):
+            clock_state["t"] = float(i)
+            if i == kill_iter:
+                kill.dead = True
+                log(f"iter {i}: KILLED {vic.name}")
+            if i == recover_iter:
+                kill.dead = False
+                victim_finished_at_recovery = len(
+                    vic.server.scheduler.finished)
+                log(f"iter {i}: recovered {vic.name}")
+            for a in schedule.arrivals.get(i, ()):
+                rr = fleet.submit(list(a.prompt), a.max_new_tokens,
+                                  priority=a.priority,
+                                  deadline_iters=a.deadline_iters,
+                                  deadline_s=a.deadline_s)
+                tracked[rr.rid] = (rr, a)
+            fleet.step()
+            for rep in fleet.replicas:              # invariant 1
+                rep.server.scheduler.audit()
+            absorb_finished()
+            if i and i % 200 == 0:
+                log(f"iter {i}: {len(terminal)}/{len(tracked)} "
+                    f"terminal, victim breaker="
+                    f"{vic.breaker.state}")
+
+        clock_state["t"] = float(cfg.iters)
+        fleet.drain()
+        for rep in fleet.replicas:
+            rep.server.scheduler.audit()
+        absorb_finished()
+
+        router = fleet.stats()["router"]
+        for rid, (rr, _a) in tracked.items():       # invariant 2
+            assert rr.finished and rid in terminal, \
+                f"routed request {rid} never reached a terminal state"
+            assert terminal[rid] == rr.finish_reason, \
+                (f"routed request {rid} changed terminal reason "
+                 f"{terminal[rid]!r} -> {rr.finish_reason!r}")
+        per_replica_finished = {
+            rep.name: len(rep.server.scheduler.finished)
+            for rep in fleet.replicas}
+        assert router["unplaced"] == 0, \
+            (f"{router['unplaced']} requests went unplaced — the "
+             f"fleet had healthy replicas the whole soak")
+        assert sum(per_replica_finished.values()) == len(tracked), \
+            (f"per-replica finished {per_replica_finished} sums to "
+             f"{sum(per_replica_finished.values())} != "
+             f"{len(tracked)} injected")           # invariant 3
+        assert router["failovers"] >= 1, \
+            "the kill window passed without a failover"  # invariant 5
+        assert vic.breaker.state == "closed", \
+            (f"victim breaker still {vic.breaker.state} after "
+             f"recovery")                           # invariant 6
+
+        # invariant 5's counter half: per-replica failure counters
+        # reconcile with the reasons actually observed
+        tally: Dict[str, int] = {}
+        for reason in terminal.values():
+            tally[reason] = tally.get(reason, 0) + 1
+        for reason, n in tally.items():
+            if reason in HEALTHY_REASONS:
+                continue
+            got = sum(rep.server.failures.count(
+                f"requests_failed_{reason}")
+                for rep in fleet.replicas)
+            assert got == n, \
+                (f"counter requests_failed_{reason}={got} != {n} "
+                 f"observed")
+    except AssertionError as e:
+        _postmortem_and_reraise(e)
+
+    # invariant 4: bit-exact survivors / prefixes vs a single-replica
+    # unfaulted replay — the oracle never saw a router, so equality
+    # proves routing/failover changed placement, not tokens
+    make_replay_fn = make_replay or make_fleet
+    replay = make_replay_fn(lambda: 0.0)
+    outputs: Dict[Tuple, List[int]] = {}
+    by_budget: Dict[int, List[Tuple]] = {}
+    for rr, a in tracked.values():
+        key = (a.prompt, rr.max_new_tokens)
+        if key not in outputs:
+            outputs[key] = None
+            by_budget.setdefault(rr.max_new_tokens, []).append(key)
+    for budget, keys in sorted(by_budget.items()):
+        outs = replay.generate([list(k[0]) for k in keys], budget)
+        for key, out in zip(keys, outs):
+            outputs[key] = out
+    checked = prefix_checked = 0
+    try:
+        for rr, a in tracked.values():
+            ref = outputs[(a.prompt, rr.max_new_tokens)]
+            if rr.finish_reason in HEALTHY_REASONS:
+                assert list(rr.generated) == ref, \
+                    (f"surviving request {rr.rid} diverged from the "
+                     f"single-replica replay: {rr.generated} != {ref}")
+                checked += 1
+            elif rr.generated:
+                assert list(rr.generated) == ref[:len(rr.generated)], \
+                    (f"{rr.finish_reason} request {rr.rid}'s partial "
+                     f"output is not a prefix of the replay")
+                prefix_checked += 1
+    except AssertionError as e:
+        _postmortem_and_reraise(e)
+
+    stats = fleet.stats()
+    report.update(
+        submitted=len(tracked),
+        finished=dict(sorted(tally.items())),
+        per_replica_finished=per_replica_finished,
+        bit_exact_checked=checked,
+        prefix_checked=prefix_checked,
+        reenqueued=router["reenqueued"],
+        failovers=router["failovers"],
+        replica_failed=router["replica_failed"],
+        unplaced=router["unplaced"],
+        kills_refused=kill.kills,
+        victim_breaker=vic.breaker.state_snapshot(),
+        victim_finished_post_recovery=(
+            per_replica_finished[vic.name]
+            - victim_finished_at_recovery),
+        affinity=router["affinity"],
+        pressure_peak=stats["pressure_peak"],
+    )
+    return report
 
 
 def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,

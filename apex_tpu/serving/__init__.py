@@ -55,7 +55,19 @@ serve".  Three layers, bottom-up:
   circuit breaker in front of ``submit``
   (``finish_reason="breaker_open"``), and graceful ``drain()`` /
   ``close()`` with bit-identical in-flight completions
-  (``docs/resilience.md``, "Overload policy & lifecycle").
+  (``docs/resilience.md``, "Overload policy & lifecycle");
+- :mod:`serving.router` — the multi-replica front door
+  (``docs/serving.md``, "Multi-replica routing"):
+  :class:`~serving.router.RouterFleet` fronts N in-process replicas
+  with one ``submit()/step()/drain()/stats()`` surface —
+  least-pressure placement on the scheduler's ``pressure()`` signal,
+  prefix AFFINITY via a router-side radix index (shared-prefix
+  sessions land on the replica already holding their cached blocks,
+  spilling under pressure), per-replica circuit breakers with
+  exactly-once failover (queued work re-enqueues onto survivors
+  bit-identically), rolling-restart ``drain_replica()``/``revive()``,
+  and Router x TP composition (each replica on its own disjoint
+  device mesh).
 
 Quick start::
 
@@ -79,6 +91,12 @@ from apex_tpu.serving.kv_cache import (
 )
 from apex_tpu.serving.overload import OverloadPolicy
 from apex_tpu.serving.prefix_cache import PrefixCache
+from apex_tpu.serving.router import (
+    ReplicaRouter,
+    RouterFleet,
+    RouterPolicy,
+    RouterRequest,
+)
 from apex_tpu.serving.scheduler import QueueFullError, Request, Scheduler
 from apex_tpu.serving.speculation import DraftSource, NgramDraft
 
@@ -92,7 +110,11 @@ __all__ = [
     "OverloadPolicy",
     "PrefixCache",
     "QueueFullError",
+    "ReplicaRouter",
     "Request",
+    "RouterFleet",
+    "RouterPolicy",
+    "RouterRequest",
     "Scheduler",
     "default_prefill_buckets",
     "greedy_sample",

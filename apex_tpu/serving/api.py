@@ -1456,6 +1456,19 @@ class InferenceServer:
     def closed(self) -> bool:
         return self._closed
 
+    def begin_drain(self) -> None:
+        """The NON-BLOCKING half of :meth:`drain`: stop admissions
+        (subsequent submits finish ``"draining"``) but leave running
+        the work off to the caller's step loop.  This is the rolling-
+        restart shape the multi-replica router needs
+        (``serving.router``): the router keeps stepping a draining
+        replica alongside the healthy ones until its in-flight work
+        reaches terminal states, instead of blocking the whole fleet
+        inside one replica's synchronous :meth:`drain`.  Idempotent;
+        in-flight generation is bit-identical either way (the same
+        scheduler/engine steps run on the same state)."""
+        self._draining = True
+
     def drain(self) -> dict:
         """Graceful shutdown, phase one: stop admissions (subsequent
         submits finish immediately with ``finish_reason="draining"``)
@@ -1465,12 +1478,62 @@ class InferenceServer:
         tokens are bit-identical whether or not a drain begins
         mid-generation (pinned by ``tests/L0/test_overload.py``).
         Idempotent; returns the flushed :meth:`stats` snapshot."""
-        self._draining = True
+        self.begin_drain()
         while self.scheduler.has_work:
             self.step()
         self._account_pending_produced()
         self._finalize_finished()
         return self.stats()
+
+    def withdraw_queued(self) -> List[Request]:
+        """Remove and return every WAITING request without finishing
+        it — the router's drain-time re-enqueue source
+        (``serving.router``): queued work has generated nothing, so it
+        restarts bit-identically on another replica instead of waiting
+        behind this one's drain.  Flushes the pipelined window first
+        so the withdrawal sees post-retire queue state."""
+        if self._inflight is not None:
+            self._pending_produced += self._flush_window()
+        moved = self.scheduler.withdraw_waiting()
+        self._finalize_finished()
+        return moved
+
+    def evacuate(self, reason: str = "replica_failed") -> tuple:
+        """Failover surgery for a server whose ENGINE is presumed dead
+        (the router's circuit breaker tripped on repeated step
+        failures — ``serving.router``).  Returns
+        ``(requeueable, failed)``:
+
+        - the launched-but-unretired window (if any) is dropped
+          unconsumed — its device step belongs to a dead engine;
+        - every admitted request that has not sampled a token yet
+          (prefilling or pending its first decode) is preempted back
+          to the queue — its K/V here is abandoned, and a fresh
+          prefill elsewhere is bit-identical — then withdrawn along
+          with the ordinary queued work as ``requeueable``;
+        - every mid-stream request (tokens already emitted) fails
+          with ``finish_reason=reason`` — its cache cannot move, and
+          silently re-decoding it elsewhere would emit duplicate
+          tokens to whoever is consuming the stream.  Its partial
+          output stays on the request (the chaos oracle prefix-checks
+          it).
+
+        Host bookkeeping (scheduler/allocator/prefix cache) is purely
+        host-side, so it stays audit-clean even when the engine is
+        wedged — the pool is left consistent for a later recovery."""
+        sched = self.scheduler
+        self._inflight = None
+        sched.release_inflight()
+        failed = []
+        for req in list(sched.running.values()):
+            if req.generated:
+                sched.fail(req, reason)
+                failed.append(req)
+            else:
+                sched.preempt(req)
+        requeueable = sched.withdraw_waiting()
+        self._finalize_finished()
+        return requeueable, failed
 
     def _account_pending_produced(self) -> None:
         """Feed the token meter any production retired OUTSIDE a step

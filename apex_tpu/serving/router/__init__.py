@@ -1,0 +1,57 @@
+"""apex_tpu.serving.router — the multi-replica front door.
+
+Request-level data parallelism for serving (``docs/serving.md``,
+"Multi-replica routing"): N in-process
+:class:`~apex_tpu.serving.InferenceServer` replicas behind one
+``submit()/step()/drain()/stats()`` surface — the serving analogue of
+the survey's ``apex.parallel`` DDP pillar, where the unit replicated
+is the whole engine and the unit balanced is the request.
+
+Four modules, bottom-up:
+
+- :mod:`~serving.router.policy` — placement:
+  :class:`RouterPolicy` (least-pressure balancing on the PR-5
+  ``Scheduler.pressure()`` signal, a spill threshold, seeded-random
+  control arm) and :class:`AffinityIndex` (a router-side radix index
+  over submitted prompts — hash-chained full-token chunks mapping
+  content -> replica — so shared-prefix sessions land on the replica
+  whose prefix cache already holds their blocks);
+- :mod:`~serving.router.replica` — :class:`Replica`: one wrapped
+  server plus its router-side circuit breaker (step failures are the
+  in-process "connection refused") and health scrape (in-process or
+  over its ops plane's ``GET /healthz``);
+- :mod:`~serving.router.router` — :class:`ReplicaRouter` /
+  :class:`RouterRequest`: routing, exactly-once failover (queued and
+  zero-token work re-enqueues onto survivors bit-identically,
+  mid-stream work fails ``replica_failed`` with partial output kept),
+  rolling-restart drains, and the pinned ``stats()["router"]`` block;
+- :mod:`~serving.router.fleet` — :class:`RouterFleet`: construction
+  (incl. Router x TP: per-replica disjoint device meshes), the
+  round-robin / threaded step loop, fleet ``generate()`` /
+  ``drain()`` / ``close()``, and the aggregate ops plane.
+
+Quick start::
+
+    from apex_tpu.serving.router import RouterFleet
+    fleet = RouterFleet(cfg, params, replicas=3, max_batch_size=4)
+    outs = fleet.generate(prompts, max_new_tokens=64)
+
+Every replica runs the full single-replica stack (prefix cache,
+chunked prefill, speculation, pipelined loop, overload control), and
+greedy output through the fleet is bit-identical to a single replica
+(``tests/L0/test_router.py``).
+"""
+
+from apex_tpu.serving.router.fleet import RouterFleet
+from apex_tpu.serving.router.policy import AffinityIndex, RouterPolicy
+from apex_tpu.serving.router.replica import Replica
+from apex_tpu.serving.router.router import ReplicaRouter, RouterRequest
+
+__all__ = [
+    "AffinityIndex",
+    "Replica",
+    "ReplicaRouter",
+    "RouterFleet",
+    "RouterPolicy",
+    "RouterRequest",
+]

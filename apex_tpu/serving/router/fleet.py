@@ -1,0 +1,444 @@
+"""`RouterFleet` — N in-process replicas behind one front door.
+
+The driver half of the router subsystem: builds the replicas (one
+:class:`~serving.InferenceServer` each, optionally each carrying its
+own ``mesh=``/``tp=`` slice — the replicas-of-shards topology), wires
+them into a :class:`~serving.router.router.ReplicaRouter`, and
+exposes the same four-call surface as one server:
+
+- ``submit()`` — routed by pressure/affinity/health
+  (:mod:`serving.router.policy`);
+- ``step()`` — one round-robin pass over the replicas (each replica
+  advances one continuous-batching iteration; the rotation point
+  moves every fleet step so no replica systematically retires first).
+  ``threaded=True`` steps the replicas concurrently on a private
+  thread pool — each replica's device step is independent, so on a
+  multi-core host (or N real device sets) the fleet step costs ~the
+  slowest replica, not the sum.  Breaker bookkeeping and failover
+  stay serial either way (``ReplicaRouter.absorb_step``), so the two
+  modes make identical routing decisions;
+- ``drain()`` — fleet-wide graceful shutdown (every replica stops
+  admitting, in-flight work runs to terminal states);
+  ``drain_replica()`` / ``revive()`` are the rolling-restart pair;
+- ``stats()`` — fleet aggregates plus the pinned ``stats()["router"]``
+  block (per-replica pressure/live/finished, affinity
+  hit/spill/re-enqueue counters, per-replica breaker snapshots).
+
+Router × TP (``docs/serving.md``, "Multi-replica routing"): pass
+``tp=K`` and each replica gets its OWN ``jax.sharding.Mesh`` over a
+disjoint ``K``-device slice — ``replicas * tp`` devices total — so
+request-level data parallelism composes with tensor-parallel decode
+exactly as it would across real hosts.
+
+An optional aggregate ops plane (``ops_port=``) serves the fleet the
+same way a single server's does: ``/healthz`` answers for the fleet
+(ok / draining / closed) with the router's pressure gauge,
+``/statusz`` is the fleet ``stats()``, ``/metrics`` the router
+registry, and ``/debug/requests/<uid>`` finds a request on whichever
+replica holds it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, List, Optional, Sequence
+
+from apex_tpu.observability import (
+    NULL_FLIGHT_RECORDER,
+    NULL_WATCHDOG,
+    MetricsRegistry,
+    OpsServer,
+    get_tracer,
+    write_postmortem,
+)
+from apex_tpu.resilience.breaker import CircuitBreaker
+from apex_tpu.serving.api import InferenceServer
+from apex_tpu.serving.router.policy import RouterPolicy
+from apex_tpu.serving.router.replica import Replica
+from apex_tpu.serving.router.router import ReplicaRouter, RouterRequest
+from apex_tpu.serving.scheduler import Request
+from apex_tpu.utils import GaugeMeter
+
+__all__ = ["RouterFleet"]
+
+_NO_LOCK = contextlib.nullcontext()
+
+
+class _FleetSchedView:
+    """Duck-typed aggregate ``scheduler`` for the ops plane: the
+    endpoints only read ``waiting`` / ``running`` / ``finished`` /
+    ``has_work``, so the view concatenates the replicas' live state
+    on access (``running`` keyed by uid — what ``/debug/requests``
+    actually looks up)."""
+
+    def __init__(self, fleet: "RouterFleet"):
+        self._fleet = fleet
+
+    @property
+    def waiting(self):
+        return [r for rep in self._fleet.replicas
+                for r in rep.server.scheduler.waiting]
+
+    @property
+    def running(self):
+        return {r.uid: r for rep in self._fleet.replicas
+                for r in rep.server.scheduler.running.values()}
+
+    @property
+    def finished(self):
+        return [r for rep in self._fleet.replicas
+                for r in rep.server.scheduler.finished]
+
+    @property
+    def has_work(self):
+        return self._fleet.has_work
+
+
+class RouterFleet:
+    """N routed replicas with one ``submit/step/drain/stats`` door.
+
+    Args:
+      cfg, params: the model every replica serves (shared host-side;
+        each replica holds its own device arrays and compiled
+        programs — that is the point of a replica).
+      replicas: fleet size (>= 1).
+      policy: the :class:`RouterPolicy`; default stock affinity with
+        ``affinity_block`` snapped to the replicas' KV block size so
+        router-side matches predict replica-side cache hits.
+      make_server: optional ``make_server(i) -> InferenceServer``
+        factory overriding replica construction entirely (mutually
+        exclusive with ``tp=``); the default builds
+        ``InferenceServer(cfg, params, clock=clock, **server_kwargs)``
+        per replica — each with its OWN private registry, so
+        per-replica counters never alias.
+      tp: tensor-parallel degree PER REPLICA — each replica gets a
+        disjoint ``tp``-device mesh slice (Router × TP; needs
+        ``replicas * tp`` visible devices).
+      tp_axis: the mesh axis name (default ``"model"``).
+      breaker_factory: ``(i) -> CircuitBreaker`` for the router-side
+        per-replica breakers (default: 3-failure threshold on
+        ``clock``).
+      threaded: step replicas concurrently on a private thread pool
+        (identical routing decisions either way; see module
+        docstring).
+      clock / registry / tracer: the fleet's time source, metrics
+        registry (router counters + per-replica pressure gauges), and
+        span tracer.
+      ops_port: serve the aggregate ops plane on this loopback port
+        (0 = ephemeral), mirroring ``InferenceServer(ops_port=)``.
+      **server_kwargs: passed to every default-built replica
+        (``max_batch_size``, ``block_size``, ``cache_dtype``, ...).
+    """
+
+    def __init__(self, cfg, params, *, replicas: int = 2,
+                 policy: Optional[RouterPolicy] = None,
+                 make_server: Optional[Callable] = None,
+                 names: Optional[Sequence[str]] = None,
+                 tp: Optional[int] = None, tp_axis: str = "model",
+                 breaker_factory: Optional[Callable] = None,
+                 threaded: bool = False,
+                 clock: Callable[[], float] = time.monotonic,
+                 registry: Optional[MetricsRegistry] = None,
+                 tracer=None,
+                 ops_port: Optional[int] = None,
+                 **server_kwargs):
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        if make_server is not None and tp:
+            raise ValueError(
+                "pass either make_server= or tp= — a custom factory "
+                "owns its replicas' meshes")
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self.tracer = tracer if tracer is not None else get_tracer()
+        self.clock = clock
+        meshes: List = [None] * replicas
+        if tp:
+            import jax
+            import numpy as np
+            from jax.sharding import Mesh
+
+            devs = jax.devices()
+            need = tp * replicas
+            if len(devs) < need:
+                raise ValueError(
+                    f"Router x TP needs replicas*tp = {need} devices "
+                    f"for {replicas} replicas of tp={tp}, have "
+                    f"{len(devs)}")
+            meshes = [Mesh(np.asarray(devs[i * tp:(i + 1) * tp]),
+                           (tp_axis,)) for i in range(replicas)]
+
+        def default_server(i: int) -> InferenceServer:
+            kw = dict(server_kwargs)
+            if meshes[i] is not None:
+                kw.setdefault("mesh", meshes[i])
+                kw.setdefault("tp_axis", tp_axis)
+            return InferenceServer(cfg, params, clock=clock, **kw)
+
+        build = make_server or default_server
+        self.replicas: List[Replica] = []
+        for i in range(replicas):
+            srv = build(i)
+            breaker = (breaker_factory(i) if breaker_factory is not None
+                       else CircuitBreaker(failure_threshold=3,
+                                           clock=clock))
+            name = names[i] if names else None
+            self.replicas.append(
+                Replica(i, srv, name=name, breaker=breaker))
+        if policy is None:
+            policy = RouterPolicy(
+                affinity_block=self.replicas[0].server.engine.block_size)
+        self.router = ReplicaRouter(self.replicas, policy=policy,
+                                    clock=clock,
+                                    registry=self.registry,
+                                    tracer=self.tracer)
+        self.threaded = bool(threaded)
+        self._pool = (ThreadPoolExecutor(
+            max_workers=replicas,
+            thread_name_prefix="apex-tpu-router")
+            if self.threaded and replicas > 1 else None)
+        self._iter = 0
+        self._draining = False
+        self._closed = False
+        self._final_stats: Optional[dict] = None
+        # fleet-level pressure (max over alive replicas) — the ops
+        # plane's /healthz pressure field, and the router's own
+        # saturation signal
+        self.pressure_gauge = GaugeMeter(registry=self.registry,
+                                         name="router_pressure")
+        self._replica_pressure = [
+            GaugeMeter(registry=self.registry,
+                       name="router_replica_pressure",
+                       replica=rep.name)
+            for rep in self.replicas]
+        # ops-plane duck-type surface (the aggregate view): the fleet
+        # has no single flight ring / watchdog / submit breaker — the
+        # per-replica ones live behind each replica's own ops plane
+        self.watchdog = NULL_WATCHDOG
+        self.recorder = NULL_FLIGHT_RECORDER
+        self.breaker = None
+        self.scheduler = _FleetSchedView(self)
+        self._postmortem_dir = None
+        self.ops: Optional[OpsServer] = None
+        self._ops_lock = None
+        if ops_port is not None:
+            self.ops = OpsServer(self, port=ops_port)
+            self._ops_lock = self.ops.lock
+            self.ops.start()
+
+    # -- the one-door surface ----------------------------------------------
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               eos_id: Optional[int] = None, *,
+               priority: int = 0,
+               deadline_iters: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> RouterRequest:
+        """Route one request (see :meth:`ReplicaRouter.submit`)."""
+        if self._closed:
+            raise RuntimeError(
+                "RouterFleet is closed; no further submissions")
+        with (self._ops_lock or _NO_LOCK):
+            if self._draining:
+                # fleet-level drain: finish at the front door exactly
+                # like a draining single server would — without
+                # consuming a placement
+                now = self.clock()
+                inner = Request(prompt=[int(t) for t in prompt],
+                                max_new_tokens=int(max_new_tokens),
+                                eos_id=eos_id,
+                                priority=int(priority),
+                                submitted_at=now)
+                inner.finished = True
+                inner.finish_reason = "draining"
+                inner.finished_at = now
+                rr = RouterRequest(inner, None)
+                self.router.requests.append(rr)
+                return rr
+            return self.router.submit(
+                prompt, max_new_tokens, eos_id, priority=priority,
+                deadline_iters=deadline_iters, deadline_s=deadline_s)
+
+    def step(self) -> int:
+        """One fleet iteration: every non-open replica advances one
+        continuous-batching step (rotating the start point for
+        fairness), then breaker bookkeeping and any failover run
+        serially.  Returns tokens produced across the fleet."""
+        with (self._ops_lock or _NO_LOCK):
+            return self._step()
+
+    def _step(self) -> int:
+        self._iter += 1
+        n = len(self.replicas)
+        k = self._iter % n
+        order = self.replicas[k:] + self.replicas[:k]
+        router = self.router
+        if self._pool is not None:
+            futures = {rep: self._pool.submit(router.try_step, rep)
+                       for rep in order}
+            results = {rep: f.result() for rep, f in futures.items()}
+        else:
+            results = {rep: router.try_step(rep) for rep in order}
+        produced = 0
+        for rep in order:
+            produced += router.absorb_step(rep, results[rep])
+        peak = 0.0
+        for rep, gauge in zip(self.replicas, self._replica_pressure):
+            p = rep.pressure()
+            gauge.update(p)
+            if rep.alive and p > peak:
+                peak = p
+        self.pressure_gauge.update(peak)
+        return produced
+
+    @property
+    def has_work(self) -> bool:
+        """Any live (non-open) replica still holding queued, running,
+        or launched-but-unretired work.  Open replicas never count:
+        failover already evacuated them."""
+        return any(
+            rep.server.scheduler.has_work
+            or rep.server._inflight is not None
+            for rep in self.replicas if rep.breaker.state != "open")
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 max_new_tokens: int,
+                 eos_id: Optional[int] = None, *,
+                 priority: int = 0,
+                 return_requests: bool = False):
+        """Batch-synchronous front door, fleet edition: route all
+        prompts, run the fleet to completion, return the generated
+        ids per prompt in input order (or the proxies with
+        ``return_requests=True``)."""
+        reqs = [self.submit(p, max_new_tokens, eos_id,
+                            priority=priority) for p in prompts]
+        while self.has_work:
+            self.step()
+        if return_requests:
+            return reqs
+        return [list(r.generated) for r in reqs]
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def drain_replica(self, which) -> int:
+        """Rolling-restart drain of one replica (index or name):
+        placement stops, queued work moves to the survivors, in-flight
+        work finishes in place over normal stepping.  Returns requests
+        moved."""
+        with (self._ops_lock or _NO_LOCK):
+            return self.router.drain_replica(self._resolve(which))
+
+    def replica_drained(self, which) -> bool:
+        """True once a draining replica has run all its work off —
+        safe to swap (:meth:`revive`)."""
+        rep = self._resolve(which)
+        return (rep.draining
+                and not rep.server.scheduler.has_work
+                and rep.server._inflight is None)
+
+    def revive(self, which, server=None) -> None:
+        """Return a replica to the rotation, optionally swapping in a
+        fresh server (the rolling-restart second half)."""
+        with (self._ops_lock or _NO_LOCK):
+            self.router.revive(self._resolve(which), server)
+
+    def _resolve(self, which) -> Replica:
+        if isinstance(which, Replica):
+            return which
+        if isinstance(which, str):
+            for rep in self.replicas:
+                if rep.name == which:
+                    return rep
+            raise KeyError(f"no replica named {which!r}")
+        return self.replicas[int(which)]
+
+    def drain(self) -> dict:
+        """Fleet-wide graceful shutdown: every replica stops
+        admitting, then the fleet steps until all in-flight work
+        reaches terminal states.  Idempotent; returns the final
+        :meth:`stats`."""
+        self._draining = True
+        for rep in self.replicas:
+            rep.server.begin_drain()
+        while self.has_work:
+            self.step()
+        return self.stats()
+
+    def close(self) -> dict:
+        """Drain, then close every replica, stop the thread pool and
+        the ops plane, and refuse further submissions.  Exactly-once;
+        repeated calls return the same final stats."""
+        if self._closed:
+            return self._final_stats
+        self._final_stats = self.drain()
+        self._closed = True
+        for rep in self.replicas:
+            srv = rep.server
+            if not srv.closed and not srv.scheduler.has_work:
+                srv.close()
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+        if self.ops is not None:
+            self.ops.stop()
+        return self._final_stats
+
+    # -- observability -----------------------------------------------------
+
+    def dump_postmortem(self, path: str, *, reason: str = "on_demand",
+                        extra: Optional[dict] = None) -> dict:
+        """The aggregate ops plane's postmortem hook: the router
+        registry snapshot + trace + a manifest carrying the router
+        block (per-replica flight rings live behind each replica's
+        own ops plane)."""
+        merged = {"iter": self._iter,
+                  "router": self.router.router_stats()}
+        if extra:
+            merged.update(extra)
+        return write_postmortem(path, recorder=self.recorder,
+                                registry=self.registry,
+                                tracer=self.tracer, reason=reason,
+                                extra=merged)
+
+    def stats(self) -> dict:
+        """Fleet aggregates + the pinned ``stats()["router"]`` block
+        (``docs/serving.md``, "Multi-replica routing").  Aggregate
+        prefix-cache counters sum the replicas' — the fleet-level
+        hit rate is what the affinity policy exists to raise
+        (``tools/serving_bench.py --router`` floors it vs random
+        placement)."""
+        with (self._ops_lock or _NO_LOCK):
+            return self._stats()
+
+    def _stats(self) -> dict:
+        router = self.router.router_stats()
+        router["steps"] = self._iter
+        router["threaded"] = self.threaded
+        hit = miss = finished = tokens = 0
+        for rep in self.replicas:
+            srv = rep.server
+            hit += srv.prefix.count("prefix_hit_tokens")
+            miss += srv.prefix.count("prefix_miss_tokens")
+            finished += len(srv.scheduler.finished)
+            tokens += srv.tokens.total
+        return {
+            "router": router,
+            "requests_finished": finished,
+            "requests_unplaced": router["unplaced"],
+            "tokens_generated": tokens,
+            "prefix_hit_tokens": hit,
+            "prefix_miss_tokens": miss,
+            "prefix_hit_rate": round(hit / (hit + miss), 3)
+            if hit + miss else 0.0,
+            "pressure": round(self.pressure_gauge.val, 3),
+            "pressure_peak": round(self.pressure_gauge.peak, 3),
+            "draining": self._draining,
+        }

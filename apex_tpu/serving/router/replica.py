@@ -1,0 +1,155 @@
+"""One routed replica — an :class:`~serving.InferenceServer` plus the
+router-side state that decides whether to trust it.
+
+The router deliberately does NOT reuse the server's own submit-guard
+breaker: that one watches the ENGINE's numerics (non-finite logits,
+OOM bursts) from inside a healthy process, while the router's
+per-replica breaker watches the replica AS A WHOLE from outside — a
+step() that raises is the in-process analogue of a connection refused.
+Three states, standard semantics (:class:`resilience.CircuitBreaker`):
+closed replicas serve, a failure streak opens the breaker (the router
+fails over: queued work re-enqueues onto healthy replicas), and after
+the cooldown the half-open probe quota lets a little traffic test the
+replica before it rejoins the rotation.
+
+Health comes in two flavors: :meth:`Replica.health` reads the live
+server in-process (the default — replicas are in-process objects), or
+scrapes its ops plane's ``GET /healthz`` over real HTTP
+(``via_http=True``) when one is attached — the one-cheap-endpoint
+contract (``pressure`` / ``draining`` / ``live_requests`` are
+machine-readable in the body) a cross-process router would live on.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Callable, Optional
+
+from apex_tpu.resilience.breaker import CircuitBreaker
+
+__all__ = ["Replica"]
+
+
+class Replica:
+    """Router-side wrapper around one in-process ``InferenceServer``.
+
+    Args:
+      index: position in the fleet (stable — placement and the
+        affinity index refer to it).
+      server: the wrapped ``InferenceServer``.
+      name: display name for stats/logs (default ``replica<index>``).
+      breaker: the router-side :class:`CircuitBreaker` for THIS
+        replica (default: 3-failure threshold on ``clock``).
+      clock: monotonic-seconds source for the default breaker.
+    """
+
+    def __init__(self, index: int, server, *,
+                 name: Optional[str] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 clock: Optional[Callable[[], float]] = None):
+        self.index = int(index)
+        self.name = name or f"replica{index}"
+        self.server = server
+        self.breaker = breaker if breaker is not None else \
+            CircuitBreaker(failure_threshold=3,
+                           clock=clock or server.clock)
+        # router-side lifecycle: `draining` stops placement while the
+        # replica runs its in-flight work off (rolling restart);
+        # `last_error` is the most recent step failure, for stats()
+        self.draining = False
+        self.steps = 0
+        self.step_failures = 0
+        self.last_error: Optional[str] = None
+        # breaker-state edge detection: the router fails over exactly
+        # once per closed/half_open -> open transition
+        self.last_breaker_state = self.breaker.state
+
+    # -- placement signals -------------------------------------------------
+
+    @property
+    def alive(self) -> bool:
+        """Steppable right now (breaker not open) — NOT the same as
+        placeable (:meth:`can_accept` also checks drain/close and the
+        half-open probe quota)."""
+        return self.breaker.state != "open"
+
+    def pressure(self) -> float:
+        """The replica's PR-5 overload signal (queue fill vs pool
+        demand) — the router's balancing key."""
+        return self.server.scheduler.pressure()
+
+    def live_requests(self) -> int:
+        """Waiting + running requests (the ``/healthz`` occupancy
+        field, read in-process)."""
+        sched = self.server.scheduler
+        return len(sched.waiting) + len(sched.running)
+
+    def placeable(self) -> bool:
+        """May this replica receive NEW work, breaker aside?  (The
+        breaker's ``allow()`` is consumed separately, only on the
+        replica placement actually picks — a half-open probe admission
+        must not be burned on replicas that merely got scanned.)"""
+        return (not self.draining
+                and not self.server.draining
+                and not self.server.closed)
+
+    # -- health ------------------------------------------------------------
+
+    def health(self, *, via_http: bool = False,
+               timeout: float = 2.0) -> dict:
+        """The replica's health view — status / pressure / draining /
+        live_requests.  In-process reads by default; ``via_http=True``
+        scrapes the attached ops plane's ``GET /healthz`` (the wire
+        contract a cross-process router uses), raising
+        :class:`RuntimeError` when no ops plane is attached."""
+        if via_http:
+            ops = getattr(self.server, "ops", None)
+            if ops is None:
+                raise RuntimeError(
+                    f"{self.name} has no ops plane attached "
+                    f"(ops_port=) to scrape /healthz from")
+            url = f"http://{ops.host}:{ops.port}/healthz"
+            try:
+                with urllib.request.urlopen(url,
+                                            timeout=timeout) as r:
+                    return json.loads(r.read())
+            except urllib.error.HTTPError as e:      # 503 still has
+                return json.loads(e.read())          # a JSON body
+        srv = self.server
+        if srv.closed:
+            status = "closed"
+        elif srv.draining or self.draining:
+            status = "draining"
+        elif not self.alive:
+            status = "breaker_open"
+        else:
+            status = "ok"
+        return {
+            "status": status,
+            "pressure": round(self.pressure(), 4),
+            "draining": bool(srv.draining or self.draining),
+            "live_requests": self.live_requests(),
+        }
+
+    # -- stats -------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The per-replica row of ``stats()["router"]`` — cheap direct
+        reads, never a full ``server.stats()``."""
+        sched = self.server.scheduler
+        return {
+            "name": self.name,
+            "alive": self.alive,
+            "draining": bool(self.draining or self.server.draining),
+            "pressure": round(self.pressure(), 4),
+            "live_requests": self.live_requests(),
+            "waiting": len(sched.waiting),
+            "running": len(sched.running),
+            "finished": len(sched.finished),
+            "steps": self.steps,
+            "step_failures": self.step_failures,
+            "last_error": self.last_error,
+            "breaker": self.breaker.state_snapshot(),
+        }

@@ -1,0 +1,408 @@
+"""`ReplicaRouter` — placement, failover, and re-enqueue over N
+replicas.
+
+The router is request-level data parallelism: where the scheduler
+decides *which iteration* serves a request and the engine decides
+*which device step*, the router decides *which replica* — by pressure
+(:class:`~serving.router.policy.RouterPolicy` least-pressure
+balancing), by prefix affinity (the router-side radix index steering
+shared-prefix sessions at the replica already holding their cached
+blocks), and by health (per-replica circuit breakers
+— :class:`~serving.router.replica.Replica`).
+
+Callers hold :class:`RouterRequest` proxies, not raw scheduler
+``Request`` objects: failover can MOVE a queued request to another
+replica (a fresh underlying ``Request``), and the proxy is the stable
+handle that follows it.  The failover contract
+(``docs/serving.md``, "Multi-replica routing"):
+
+- a replica whose ``step()`` keeps raising trips its router-side
+  breaker; the router then **evacuates** it exactly once per open
+  transition — queued work and zero-token admissions re-enqueue onto
+  healthy replicas (bit-identical restarts: nothing was emitted yet),
+  mid-stream requests finish ``finish_reason="replica_failed"`` with
+  their partial output intact;
+- every request reaches exactly ONE terminal state, on exactly one
+  replica (the chaos soak's router invariants —
+  :func:`resilience.chaos.run_router_soak`);
+- re-enqueued requests keep their priority and their REMAINING
+  deadline budget (wall and iteration), so failover never silently
+  extends an SLA.
+
+:class:`~serving.router.fleet.RouterFleet` owns construction and the
+step loop; this class is the policy/bookkeeping core and is directly
+testable with hand-built replicas.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from apex_tpu.serving.router.policy import AffinityIndex, RouterPolicy
+from apex_tpu.serving.router.replica import Replica
+from apex_tpu.serving.scheduler import Request
+from apex_tpu.utils import CounterMeter
+
+__all__ = ["ReplicaRouter", "RouterRequest"]
+
+_rid = itertools.count()
+
+
+class RouterRequest:
+    """The caller's stable handle on one routed request.
+
+    Delegates the read surface (``generated`` / ``finished`` /
+    ``finish_reason`` / ``timeline()``) to the CURRENT underlying
+    scheduler ``Request`` — which failover may replace when the
+    request is re-enqueued onto another replica.  ``rid`` is the
+    router-level id (underlying ``uid`` changes on a move);
+    ``replica`` is the index currently serving it (None = never
+    placed); ``moves`` counts re-enqueues."""
+
+    __slots__ = ("rid", "inner", "replica", "moves")
+
+    def __init__(self, inner: Request, replica: Optional[int]):
+        self.rid = next(_rid)
+        self.inner = inner
+        self.replica = replica
+        self.moves = 0
+
+    @property
+    def prompt(self) -> List[int]:
+        return self.inner.prompt
+
+    @property
+    def generated(self) -> List[int]:
+        return self.inner.generated
+
+    @property
+    def finished(self) -> bool:
+        return self.inner.finished
+
+    @property
+    def finish_reason(self) -> Optional[str]:
+        return self.inner.finish_reason
+
+    @property
+    def priority(self) -> int:
+        return self.inner.priority
+
+    @property
+    def max_new_tokens(self) -> int:
+        return self.inner.max_new_tokens
+
+    def timeline(self) -> dict:
+        return self.inner.timeline()
+
+    def __repr__(self):
+        return (f"RouterRequest(rid={self.rid}, "
+                f"replica={self.replica}, moves={self.moves}, "
+                f"finished={self.finished})")
+
+
+class ReplicaRouter:
+    """Placement + failover core over a fixed replica list.
+
+    Args:
+      replicas: the :class:`Replica` wrappers (index order is the
+        deterministic tiebreak everywhere).
+      policy: the :class:`RouterPolicy` (default: stock affinity).
+      clock: the router's monotonic-seconds source (deadline
+        re-budgeting on re-enqueue).
+      registry: the :class:`~observability.MetricsRegistry` holding
+        the router's counters (``router_placements{outcome=}``,
+        ``router_events{event=}``).
+      tracer: span tracer (``route`` spans, ``router_failover`` /
+        ``router_reenqueue`` instants).
+    """
+
+    def __init__(self, replicas: Sequence[Replica], *,
+                 policy: Optional[RouterPolicy] = None,
+                 clock=None, registry=None, tracer=None):
+        if not replicas:
+            raise ValueError("ReplicaRouter needs >= 1 replica")
+        self.replicas = list(replicas)
+        self.policy = policy if policy is not None else RouterPolicy()
+        self.clock = clock if clock is not None \
+            else self.replicas[0].server.clock
+        self.tracer = tracer
+        self.affinity = AffinityIndex(self.policy.affinity_block,
+                                      self.policy.max_entries)
+        self._rng = random.Random(self.policy.seed)
+        self.placements = CounterMeter(registry=registry,
+                                       name="router_placements",
+                                       label="outcome")
+        self.events = CounterMeter(registry=registry,
+                                   name="router_events", label="event")
+        self.requests: List[RouterRequest] = []
+        self._by_uid: Dict[int, RouterRequest] = {}
+
+    # -- placement ---------------------------------------------------------
+
+    def place(self, prompt: Sequence[int], *,
+              exclude: Optional[Replica] = None
+              ) -> Tuple[Optional[Replica], str]:
+        """Pick the replica for ``prompt``: ``(replica, outcome)``
+        with ``replica=None`` when nobody can take it.  Outcomes:
+        ``affinity_hit`` (the matched replica takes it),
+        ``affinity_spill`` (matched but over ``spill_threshold`` —
+        least-pressure instead), ``affinity_dead`` (matched but
+        dead/draining/probe-exhausted), ``affinity_miss`` (no match),
+        ``least_pressure`` / ``random`` (the non-affinity kinds), or
+        ``unplaced``.  The chosen replica's breaker ``allow()`` is
+        consumed; merely-scanned replicas' are not."""
+        cands = [rep for rep in self.replicas
+                 if rep is not exclude and rep.placeable()]
+        if not cands:
+            return None, "unplaced"
+        kind = self.policy.kind
+        if kind == "random":
+            for rep in self._rng.sample(cands, len(cands)):
+                if rep.breaker.allow():
+                    return rep, "random"
+            return None, "unplaced"
+        outcome = "least_pressure"
+        if kind == "affinity":
+            ridx, _matched = self.affinity.match(list(prompt))
+            if ridx is None:
+                outcome = "affinity_miss"
+            else:
+                target = self.replicas[ridx]
+                if (target is exclude or not target.placeable()
+                        or not target.alive):
+                    outcome = "affinity_dead"
+                elif target.pressure() >= self.policy.spill_threshold:
+                    outcome = "affinity_spill"
+                elif target.breaker.allow():
+                    return target, "affinity_hit"
+                else:
+                    outcome = "affinity_dead"   # probe quota spent
+        for rep in sorted(cands,
+                          key=lambda r: (r.pressure(), r.index)):
+            if rep.breaker.allow():
+                return rep, outcome
+        return None, "unplaced"
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               eos_id: Optional[int] = None, *,
+               priority: int = 0,
+               deadline_iters: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> RouterRequest:
+        """Route one request through the fleet's front door.  The
+        chosen replica's own ``submit`` runs the usual per-replica
+        gates (budget validation, bounded queue, its own breaker,
+        draining) — a submit-time rejection there comes back through
+        the proxy exactly as it would single-replica.  When NO replica
+        can accept (all dead/draining), the proxy comes back already
+        finished ``finish_reason="breaker_open"`` — the fleet-wide
+        fast-fail — without touching any replica."""
+        prompt = [int(t) for t in prompt]
+        tr = self.tracer
+        if tr is not None and tr.enabled:
+            with tr.span("route", tokens=len(prompt)):
+                rep, outcome = self.place(prompt)
+        else:
+            rep, outcome = self.place(prompt)
+        self.placements.incr(outcome)
+        if rep is None:
+            now = self.clock()
+            inner = Request(prompt=prompt,
+                            max_new_tokens=int(max_new_tokens),
+                            eos_id=eos_id, priority=int(priority),
+                            submitted_at=now)
+            inner.finished = True
+            inner.finish_reason = "breaker_open"
+            inner.finished_at = now
+            rr = RouterRequest(inner, None)
+            self.requests.append(rr)
+            return rr
+        inner = rep.server.submit(prompt, max_new_tokens, eos_id,
+                                  priority=priority,
+                                  deadline_iters=deadline_iters,
+                                  deadline_s=deadline_s)
+        rr = RouterRequest(inner, rep.index)
+        self.requests.append(rr)
+        self._by_uid[inner.uid] = rr
+        if self.policy.kind == "affinity" and not inner.finished:
+            self.affinity.record(prompt, rep.index)
+        return rr
+
+    # -- stepping (driven by RouterFleet) ----------------------------------
+
+    def try_step(self, rep: Replica):
+        """The concurrency-safe half of stepping one replica: run its
+        ``step()`` and capture the outcome WITHOUT touching shared
+        router state (the fleet's threaded mode calls this from worker
+        threads).  Returns ``None`` for a skipped (breaker-open)
+        replica, else ``(had_work, produced, exception_or_None)``."""
+        if rep.breaker.state == "open":
+            return None
+        srv = rep.server
+        had_work = (srv.scheduler.has_work
+                    or srv._inflight is not None)
+        try:
+            return had_work, srv.step(), None
+        except Exception as e:  # noqa: BLE001 — a replica blowing up
+            #                     is exactly the event to contain
+            return had_work, 0, e
+
+    def absorb_step(self, rep: Replica, result) -> int:
+        """The serial half: breaker bookkeeping over one
+        :meth:`try_step` result, firing failover on the
+        closed/half-open -> open edge.  An idle step never counts as
+        breaker evidence (a dead engine answers empty steps just
+        fine), so a sick replica cannot vacuously probe itself
+        healthy.  Returns tokens produced."""
+        if result is None:
+            return 0
+        had_work, produced, exc = result
+        if exc is not None:
+            rep.step_failures += 1
+            rep.last_error = repr(exc)
+            self.events.incr("step_errors")
+            rep.breaker.record_failure()
+        else:
+            rep.steps += 1
+            if had_work:
+                rep.breaker.record_success()
+        state = rep.breaker.state
+        if state == "open" and rep.last_breaker_state != "open":
+            self._failover(rep)
+        rep.last_breaker_state = state
+        return produced
+
+    # -- failover / lifecycle ----------------------------------------------
+
+    def _failover(self, rep: Replica) -> None:
+        """The replica's breaker just opened: evacuate it (queued +
+        zero-token work re-enqueues, mid-stream work fails
+        ``replica_failed`` with partial output kept) and place the
+        evacuees on the survivors."""
+        self.events.incr("failovers")
+        if self.tracer is not None and self.tracer.enabled:
+            self.tracer.instant("router_failover", replica=rep.name)
+        moved, failed = rep.server.evacuate("replica_failed")
+        if failed:
+            self.events.incr("replica_failed", len(failed))
+        self.reenqueue(moved, exclude=rep)
+
+    def reenqueue(self, reqs: Sequence[Request], *,
+                  exclude: Optional[Replica] = None) -> int:
+        """Place withdrawn (never-finished, zero-output) requests on
+        replicas other than ``exclude``, rebinding their proxies.
+        Deadlines carry their REMAINING budget: wall deadlines shrink
+        by the time already spent, iteration deadlines by the
+        iterations already burned on the old replica.  A request
+        nobody can take finishes ``breaker_open`` at the router.
+        Returns the number successfully re-placed."""
+        now = self.clock()
+        placed = 0
+        for old in reqs:
+            rr = self._by_uid.pop(old.uid, None)
+            rep, _outcome = self.place(old.prompt, exclude=exclude)
+            if rep is None:
+                old.finished = True
+                old.finish_reason = "breaker_open"
+                old.finished_at = now
+                self.events.incr("reenqueue_unplaced")
+                if rr is not None:
+                    rr.replica = None
+                continue
+            d_s = d_iters = None
+            if old.deadline_s is not None:
+                d_s = max(0.0,
+                          old.deadline_s - (now - old.submitted_at))
+            if old.deadline_iters is not None and exclude is not None:
+                burned = exclude.server._iter - old.submit_iter
+                d_iters = max(0, old.deadline_iters - burned)
+            elif old.deadline_iters is not None:
+                d_iters = old.deadline_iters
+            new = rep.server.submit(old.prompt, old.max_new_tokens,
+                                    old.eos_id,
+                                    priority=old.priority,
+                                    deadline_iters=d_iters,
+                                    deadline_s=d_s)
+            self.events.incr("reenqueued")
+            if self.tracer is not None and self.tracer.enabled:
+                self.tracer.instant("router_reenqueue",
+                                    to=rep.name, uid=new.uid)
+            if rr is not None:
+                rr.inner = new
+                rr.replica = rep.index
+                rr.moves += 1
+                self._by_uid[new.uid] = rr
+            else:
+                self._by_uid[new.uid] = RouterRequest(new, rep.index)
+            if self.policy.kind == "affinity" and not new.finished:
+                self.affinity.record(old.prompt, rep.index)
+            placed += 1
+        return placed
+
+    def drain_replica(self, rep: Replica) -> int:
+        """Rolling-restart drain: stop placing on ``rep`` (router-side
+        flag + server ``begin_drain``), move its QUEUED work to the
+        survivors, and leave its in-flight work to finish in place
+        over the fleet's normal stepping — zero healthy-request loss.
+        Returns the number of requests moved."""
+        rep.draining = True
+        rep.server.begin_drain()
+        moved = rep.server.withdraw_queued()
+        self.events.incr("drains")
+        self.reenqueue(moved, exclude=rep)
+        return len(moved)
+
+    def revive(self, rep: Replica, server=None) -> None:
+        """Return ``rep`` to the rotation — after a drain (rolling
+        restart: pass the fresh ``server`` replacing the drained one)
+        or to force-close a recovered breaker.  A replaced server's
+        affinity entries are dropped (the fresh cache is cold); the
+        old server is closed when it is safely drainable."""
+        if server is not None:
+            old = rep.server
+            if not old.closed and not old.scheduler.has_work:
+                old.close()
+            rep.server = server
+            self.affinity.drop_replica(rep.index)
+        rep.draining = False
+        rep.breaker.reset()
+        rep.last_breaker_state = rep.breaker.state
+        self.events.incr("revives")
+
+    # -- stats -------------------------------------------------------------
+
+    def router_stats(self) -> dict:
+        """The pinned ``stats()["router"]`` block (minus the fleet
+        driver's own keys — :meth:`RouterFleet.stats` adds those)."""
+        p = self.placements
+        hit = p.count("affinity_hit")
+        miss = p.count("affinity_miss")
+        spill = p.count("affinity_spill")
+        dead = p.count("affinity_dead")
+        looked = hit + miss + spill + dead
+        return {
+            "replicas": len(self.replicas),
+            "alive": sum(1 for r in self.replicas if r.alive),
+            "policy": {
+                "kind": self.policy.kind,
+                "spill_threshold": self.policy.spill_threshold,
+                "affinity_block": self.policy.affinity_block,
+                "index_entries": len(self.affinity),
+            },
+            "placements": p.as_dict(),
+            "affinity": {
+                "hits": hit,
+                "misses": miss,
+                "spills": spill,
+                "dead": dead,
+                "hit_rate": round(hit / looked, 3) if looked else 0.0,
+            },
+            "reenqueued": self.events.count("reenqueued"),
+            "failovers": self.events.count("failovers"),
+            "replica_failed": self.events.count("replica_failed"),
+            "unplaced": (p.count("unplaced")
+                         + self.events.count("reenqueue_unplaced")),
+            "per_replica": {rep.name: rep.snapshot()
+                            for rep in self.replicas},
+        }

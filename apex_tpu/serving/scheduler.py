@@ -324,6 +324,20 @@ class Scheduler:
         self.waiting.append(req)
         return req
 
+    def withdraw_waiting(self) -> List[Request]:
+        """Remove and return EVERY waiting request WITHOUT finishing
+        it — the multi-replica router's failover/drain re-enqueue
+        path (``serving.router``): queued work on a sick or draining
+        replica has generated nothing yet, so it can restart on a
+        healthy replica bit-identically instead of dying here.  The
+        withdrawn requests hold no slots or blocks (waiting requests
+        never do — :meth:`audit` pins that), so this is pure queue
+        surgery; the caller owns re-submission and the terminal
+        exactly-once guarantee."""
+        out = list(self.waiting)
+        self.waiting.clear()
+        return out
+
     def _shed_candidate(self) -> Optional[Request]:
         """The waiting request overload policy would shed first:
         lowest priority class (highest number), newest among equals.

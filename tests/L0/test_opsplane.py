@@ -131,6 +131,13 @@ def test_ops_endpoints_serve_live_data(tiny, tmp_path):
         assert code == 200 and health["status"] == "ok"
         assert health["breaker"] == "closed"
         assert health["watchdog_stalls"] == 0
+        # the router-scrape trio (docs/serving.md, "Multi-replica
+        # routing"): one cheap endpoint carries the placement signal,
+        # the lifecycle flag, and the occupancy — machine-readable,
+        # no /statusz parse
+        assert isinstance(health["pressure"], float)
+        assert health["draining"] is False
+        assert health["live_requests"] == 0      # idle post-generate
 
         code, headers, body = _get(base, "/metrics")
         assert code == 200
@@ -183,7 +190,9 @@ def test_ops_endpoints_serve_live_data(tiny, tmp_path):
         assert json.loads(body)["status"] == "drained"
         code, _, body = _get(base, "/healthz")
         assert code == 503
-        assert json.loads(body)["status"] == "draining"
+        health = json.loads(body)
+        assert health["status"] == "draining"
+        assert health["draining"] is True
     finally:
         server.close()
 

@@ -148,6 +148,33 @@ env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
       python tools/chaos_soak.py --seed 0 --iters 800 --tp 2
 results[serving_tp]=$?
 
+# multi-replica router: the front-door axis (docs/serving.md,
+# "Multi-replica routing") — three gates under the emulated 8-device
+# mesh flags (the Router x TP test shards 2 replicas x tp=2):
+#   1. the L0 router tier: 64-token greedy parity through a 3-replica
+#      fleet vs the single-replica engine — incl. a forced replica
+#      failure mid-stream (queued work re-enqueued onto survivors)
+#      and a rolling drain with zero healthy-request loss — plus the
+#      pinned stats()["router"] block, breaker snapshots, affinity
+#      index units, and the Router x TP parity oracle;
+#   2. serving_bench --router 3: affinity-vs-random placement A/B on
+#      grouped shared-prefix traffic (>= 1.5x aggregate prefix-hit
+#      ratio floor, parity always);
+#   3. an 800-iteration seed-0 router chaos soak over a
+#      killed-then-recovered replica (exactly-once terminals,
+#      per-replica finished == injected, bit-exact single-replica
+#      replay, failover + recovery asserted).
+echo "=== build-matrix axis: router ==="
+env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/L0/test_router.py -q -x --no-header \
+  && env JAX_PLATFORMS=cpu \
+      XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+      python tools/serving_bench.py --smoke --router 3 --out - \
+  && env JAX_PLATFORMS=cpu \
+      XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+      python tools/chaos_soak.py --seed 0 --iters 800 --replicas 3
+results[router]=$?
+
 # chaos soak: the overload-robustness axis (docs/resilience.md,
 # "Overload policy & lifecycle") — the full serving stack (prefix
 # cache + chunked prefill + overload control + circuit breaker, small

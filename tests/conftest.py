@@ -97,6 +97,12 @@ def pytest_configure(config):
         "markers", "chaos: seeded randomized fault-composition soaks "
         "(apex_tpu.resilience.chaos); the build-matrix chaos axis "
         "runs the full-length version via tools/chaos_soak.py")
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 'not slow' "
+        "selection (its wall budget is already saturated); every "
+        "slow-marked test still runs in full on its build-matrix "
+        "axis (tests/build_matrix/run.sh invokes the file without "
+        "the marker filter)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -49,9 +49,27 @@ so the build matrix can prove the detector and the bundle dump
 end-to-end (``tools/postmortem.py --assert-complete`` gates the
 result).
 
+``--replicas N`` switches to the ROUTER soak (``docs/serving.md``,
+"Multi-replica routing"): the same seeded mixed-priority traffic is
+routed through an N-replica ``RouterFleet`` while one replica is
+KILLED mid-run (every engine call raises — the in-process analogue of
+a replica process dying) and later RECOVERED.  The router's
+per-replica breaker must contain it: queued work re-enqueues onto the
+survivors, mid-stream work on the victim fails ``replica_failed``
+with its partial output intact, and the half-open probes must
+re-discover the recovered replica.  Invariants
+(:func:`resilience.chaos.run_router_soak`): per-replica audits every
+step, every routed request reaches exactly one terminal state, the
+sum of per-replica finished counts equals injected, surviving outputs
+are bit-exact (cut-short ones bit-exact prefixes) vs a SINGLE-replica
+replay oracle, per-replica failure counters reconcile, at least one
+failover fired, and the victim's breaker closed again.
+
 Usage:
     python tools/chaos_soak.py [--seed 0] [--iters 2000] [--out -]
         [--speculative] [--postmortem-dir DIR] [--force-violation N]
+    python tools/chaos_soak.py --replicas 3 [--iters 800]
+        [--kill-iter N] [--recover-iter N]
 """
 
 import argparse
@@ -81,6 +99,78 @@ def build_model():
     params = m.init(jax.random.PRNGKey(1),
                     jnp.ones((1, 8), jnp.int32))["params"]
     return cfg, params
+
+
+def run_router(args) -> int:
+    """The ``--replicas N`` arm: seeded traffic through a RouterFleet
+    over a killed-then-recovered replica (module docstring)."""
+    import jax.numpy as jnp
+
+    from apex_tpu.resilience import CircuitBreaker
+    from apex_tpu.resilience.chaos import ChaosConfig, run_router_soak
+    from apex_tpu.serving import RouterFleet
+
+    cfg, params = build_model()
+    kill_iter = (args.kill_iter if args.kill_iter is not None
+                 else args.iters // 4)
+    recover_iter = (args.recover_iter if args.recover_iter is not None
+                    else args.iters // 2)
+
+    def make_fleet(clock):
+        # each replica mirrors the single-replica soak's small-pool
+        # shape (preemption/eviction/shedding all fire per replica);
+        # router-side breakers run on the soak's iteration clock so
+        # trips, cooldowns, and half-open probes replay per seed
+        return RouterFleet(
+            cfg, params, replicas=args.replicas,
+            threaded=args.threaded,
+            max_batch_size=4, max_context=64, block_size=4,
+            num_blocks=40, cache_dtype=jnp.float32, max_waiting=8,
+            clock=clock,
+            breaker_factory=lambda i: CircuitBreaker(
+                failure_threshold=3, recovery_time=25.0,
+                clock=clock))
+
+    def make_replay(clock):
+        from apex_tpu.serving import InferenceServer
+
+        # the oracle is ONE roomy replica with no router in front:
+        # routed outputs equal to it prove placement never changed
+        # tokens
+        return InferenceServer(
+            cfg, params, max_batch_size=4, max_context=64,
+            block_size=4, cache_dtype=jnp.float32, clock=clock)
+
+    chaos_cfg = ChaosConfig(
+        iters=args.iters, vocab=VOCAB,
+        # engine-fault classes stay on the single-replica axes; the
+        # router soak's fault is the replica kill itself
+        nonfinite_rate=0.0, oom_rate=0.0, crash_every=0)
+    t0 = time.perf_counter()
+    report = run_router_soak(make_fleet, chaos_cfg, args.seed,
+                             kill_iter=kill_iter,
+                             recover_iter=recover_iter,
+                             make_replay=make_replay, log=print,
+                             postmortem_dir=args.postmortem_dir)
+    report["wall_s"] = round(time.perf_counter() - t0, 2)
+    report["threaded"] = args.threaded
+
+    line = json.dumps(report, indent=2, sort_keys=True)
+    if args.out == "-":
+        print(line)
+    elif args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    print(f"router chaos soak PASS: {report['submitted']} requests "
+          f"over {args.iters} iterations x {args.replicas} replicas, "
+          f"{report['bit_exact_checked']} bit-exact + "
+          f"{report['prefix_checked']} prefix-checked vs replay, "
+          f"failovers={report['failovers']}, "
+          f"reenqueued={report['reenqueued']}, "
+          f"replica_failed={report['replica_failed']}, "
+          f"per_replica={report['per_replica_finished']} "
+          f"({report['wall_s']}s)")
+    return 0
 
 
 def main(argv=None) -> int:
@@ -133,7 +223,27 @@ def main(argv=None) -> int:
                         help="deliberately violate the finished-twice "
                         "invariant at iteration >= N (the postmortem "
                         "build-matrix axis; the soak then MUST fail)")
+    parser.add_argument("--replicas", type=int, default=None,
+                        metavar="N",
+                        help="soak the MULTI-REPLICA ROUTER instead: "
+                        "route the seeded traffic through an "
+                        "N-replica RouterFleet with one replica "
+                        "killed mid-run then recovered "
+                        "(docs/serving.md, 'Multi-replica routing')")
+    parser.add_argument("--kill-iter", type=int, default=None,
+                        help="router soak: iteration the victim dies "
+                        "(default iters // 4)")
+    parser.add_argument("--recover-iter", type=int, default=None,
+                        help="router soak: iteration the victim "
+                        "recovers (default iters // 2)")
+    parser.add_argument("--threaded", action="store_true",
+                        help="router soak: step replicas on the "
+                        "fleet's thread pool (routing decisions are "
+                        "identical either way)")
     args = parser.parse_args(argv)
+
+    if args.replicas:
+        return run_router(args)
 
     if args.tp:
         # the emulated mesh must exist before jax initializes its

@@ -791,6 +791,146 @@ def run_tp_mode(args):
     return rc
 
 
+def _router_fleet(cfg, params, args, kind):
+    from apex_tpu.serving import RouterFleet, RouterPolicy
+
+    import jax.numpy as jnp
+
+    # both arms run the identical fleet — same replica geometry, same
+    # full default stack per replica (prefix cache on: it is the thing
+    # affinity concentrates) — differing ONLY in placement kind
+    return RouterFleet(
+        cfg, params, replicas=args.router,
+        policy=RouterPolicy(kind=kind, seed=args.seed,
+                            affinity_block=args.block_size),
+        max_batch_size=args.batch_size,
+        max_context=args.max_context, block_size=args.block_size,
+        num_blocks=args.router_blocks, cache_dtype=jnp.float32)
+
+
+def _run_router_arm(cfg, params, args, kind, groups):
+    """Drive one placement arm over the grouped shared-prefix
+    traffic: each round submits one request per group (shared
+    ``prefix_len``-token group prefix + a private tail), then runs
+    the fleet idle so finished requests' blocks become evictable
+    cache holds before the next round — the steady multi-session
+    shape affinity exists for.  Per-replica audits every step.
+    Returns (outputs in submit order, fleet stats, wall seconds)."""
+    fleet = _router_fleet(cfg, params, args, kind)
+    reqs = []
+    t0 = time.perf_counter()
+    for r in range(args.router_rounds):
+        for prefix, tails in groups:
+            reqs.append(fleet.submit(prefix + tails[r], args.max_new))
+        while fleet.has_work:
+            fleet.step()
+            for rep in fleet.replicas:
+                rep.server.scheduler.audit()
+    wall = time.perf_counter() - t0
+    outs = [list(r.generated) for r in reqs]
+    st = fleet.stats()
+    fleet.close()
+    return outs, st, wall
+
+
+def run_router_mode(args):
+    """The multi-replica placement A/B (docs/serving.md,
+    "Multi-replica routing"): identical grouped shared-prefix traffic
+    through an N-replica RouterFleet under AFFINITY placement vs
+    seeded RANDOM placement.  Affinity keeps each group's sessions on
+    one replica, so the group prefix prefills once per group; random
+    placement sprays a group across the fleet and re-prefills its
+    prefix once per replica it touches.  The measured axis is the
+    aggregate prefix-cache hit ratio; ``--smoke`` floors
+    affinity >= 1.5x random.  Token-for-token parity between the two
+    arms is ALWAYS asserted — placement may move work, never change
+    tokens."""
+    cfg, m, params = build_model(args)
+    rng = np.random.RandomState(args.seed + 7)
+    groups = []
+    for _ in range(args.router_groups):
+        prefix = list(rng.randint(0, args.vocab,
+                                  size=args.prefix_len))
+        tails = [list(rng.randint(0, args.vocab, size=args.tail_len))
+                 for _ in range(args.router_rounds)]
+        groups.append((prefix, tails))
+
+    outs_aff, st_aff, wall_aff = _run_router_arm(
+        cfg, params, args, "affinity", groups)
+    outs_rnd, st_rnd, wall_rnd = _run_router_arm(
+        cfg, params, args, "random", groups)
+    mismatches = sum(a != b for a, b in zip(outs_aff, outs_rnd))
+    tokens = sum(len(o) for o in outs_aff)
+
+    ratio = (st_aff["prefix_hit_rate"]
+             / max(st_rnd["prefix_hit_rate"], 1e-9))
+    record = {
+        "bench": "serving_router",
+        "mode": "smoke" if args.smoke else "full",
+        "replicas": args.router,
+        "config": {"router_groups": args.router_groups,
+                   "router_rounds": args.router_rounds,
+                   "prefix_len": args.prefix_len,
+                   "tail_len": args.tail_len,
+                   "max_new": args.max_new,
+                   "batch_size": args.batch_size,
+                   "block_size": args.block_size,
+                   "num_blocks": args.router_blocks,
+                   "hidden": args.hidden, "layers": args.layers,
+                   "heads": args.heads,
+                   "max_context": args.max_context,
+                   "vocab": args.vocab},
+        "affinity": {
+            "prefix_hit_rate": st_aff["prefix_hit_rate"],
+            "prefix_hit_tokens": st_aff["prefix_hit_tokens"],
+            "prefix_miss_tokens": st_aff["prefix_miss_tokens"],
+            "tokens_s": round(tokens / max(wall_aff, 1e-9), 1),
+            "placements": st_aff["router"]["placements"],
+            "affinity_counters": st_aff["router"]["affinity"],
+        },
+        "random": {
+            "prefix_hit_rate": st_rnd["prefix_hit_rate"],
+            "prefix_hit_tokens": st_rnd["prefix_hit_tokens"],
+            "prefix_miss_tokens": st_rnd["prefix_miss_tokens"],
+            "tokens_s": round(tokens / max(wall_rnd, 1e-9), 1),
+            "placements": st_rnd["router"]["placements"],
+        },
+        "hit_ratio_affinity_over_random": round(ratio, 2),
+        "parity_mismatches": mismatches,
+        "router": st_aff["router"],
+    }
+    print(json.dumps(record))
+
+    out = args.out
+    if out != "-":
+        if out is None:
+            out = os.path.join(
+                os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+                "BENCH_serving_router.json")
+        with open(out, "w") as f:
+            json.dump(record, f, indent=1)
+            f.write("\n")
+
+    rc = 0
+    if mismatches:
+        print(f"FAIL: {mismatches} requests diverged between "
+              "affinity and random placement — placement must never "
+              "change tokens", file=sys.stderr)
+        rc = 1
+    if args.smoke:
+        if record["affinity"]["prefix_hit_rate"] <= 0.0:
+            print("FAIL: affinity arm recorded no prefix-cache hits",
+                  file=sys.stderr)
+            rc = 1
+        if ratio < 1.5:
+            print(f"FAIL: affinity/random prefix-hit ratio "
+                  f"{record['hit_ratio_affinity_over_random']} < "
+                  "1.5x floor", file=sys.stderr)
+            rc = 1
+    return rc
+
+
 def run_shared_prefix_mode(args):
     cfg, m, params = build_model(args)
     servers = _build_prefix_servers(cfg, params, args)
@@ -901,6 +1041,22 @@ def main():
                     "continuous-vs-naive compare — emulated CPU "
                     "meshes auto-provision via "
                     "--xla_force_host_platform_device_count")
+    ap.add_argument("--router", type=int, default=None, metavar="N",
+                    help="run the multi-replica placement A/B "
+                    "(affinity vs seeded-random routing of grouped "
+                    "shared-prefix traffic through an N-replica "
+                    "RouterFleet; aggregate prefix-hit ratio floored "
+                    ">= 1.5x under --smoke, parity always) instead "
+                    "of the continuous-vs-naive compare")
+    ap.add_argument("--router-groups", type=int, default=6,
+                    help="router mode: shared-prefix session groups")
+    ap.add_argument("--router-rounds", type=int, default=3,
+                    help="router mode: requests per group (arrive "
+                    "one per group per round)")
+    ap.add_argument("--router-blocks", type=int, default=None,
+                    help="router mode: KV blocks per replica "
+                    "(default: roomy enough to hold every group's "
+                    "prefix as cache holds)")
     ap.add_argument("--spec-tokens", type=int, default=4,
                     help="max drafted tokens per verify step")
     ap.add_argument("--prompt-tokens", type=int, default=None,
@@ -978,6 +1134,37 @@ def main():
             args.tail_len = 7
             args.chunk = 32
             args.long_prompt = 448
+        if args.router:
+            # grouped multi-session traffic: few rounds keep the
+            # random arm's accidental same-replica revisits rare (the
+            # honest control), block-aligned prefixes keep the hit
+            # accounting exact
+            args.requests = 18
+            args.max_new = 8
+            args.batch_size = 2
+            args.block_size = 8
+            args.vocab = 61
+            args.hidden = 32
+            args.layers = 2
+            args.heads = 2
+            args.max_context = 128
+            args.prefix_len = 48
+            args.tail_len = 7
+
+    if args.router:
+        if args.prefix_len is None:
+            args.prefix_len = args.max_context // 4
+        if args.router_blocks is None:
+            # every group's prefix must survive as evictable holds
+            # across rounds on whichever replicas hold it, plus live
+            # decode headroom — a starved pool would measure eviction,
+            # not placement
+            per_prefix = -(-args.prefix_len // args.block_size)
+            args.router_blocks = (
+                args.router_groups * (per_prefix + 4)
+                + args.batch_size * (
+                    -(-args.max_context // args.block_size)) + 1)
+        return run_router_mode(args)
 
     if args.shared_prefix:
         if args.prefix_len is None:
