@@ -121,7 +121,8 @@ class GPTSelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, attn_bias, deterministic: bool = True,
-                 cache_view=None, return_kv: bool = False):
+                 cache_view=None, return_kv: bool = False,
+                 kv_quant: bool = False):
         """``cache_view``: serving mode — ``(k_ctx, v_ctx, ctx_bias)``
         with k/v_ctx (B, T, H, D) gathered cache context and ctx_bias
         (B, T) additive (0 keep / NEG_INF for unwritten slots).  With x
@@ -134,7 +135,24 @@ class GPTSelfAttention(nn.Module):
         ``return_kv``: also return this call's freshly projected
         ``(k, v)`` so the serving engine can append them to the cache.
         Both default off — the training path is byte-identical to
-        before."""
+        before.
+
+        ``kv_quant``: int8-quantized-pool serving (``docs/serving.md``,
+        "Quantized KV cache").  The freshly projected K/V quantize AT
+        THE SOURCE (:func:`ops.kv_quant.quantize_kv`, per token per
+        head) and attention everywhere operates on the QUANTIZED grid
+        — the cache context arrives int8 with its scale sidecar
+        (``cache_view`` is then the 5-tuple ``(k_ctx, v_ctx, ctx_bias,
+        k_scale_ctx, v_scale_ctx)``), the token's own / within-chunk
+        K/V concatenate as int8 with their fresh scales, and the
+        no-cache causal forward attends the dequantized values.  That
+        uniformity is the bit-stability argument: a (query, key)
+        pair's score is identical whether the key is fresh this call,
+        fresh earlier in the same chunk, or read back from the pool —
+        so chunking boundaries, preemption re-prefill, COW, and
+        speculation cannot move a logit.  ``return_kv`` then returns
+        ``((k_q, k_scale), (v_q, v_scale))`` — byte-for-byte what
+        attention just used, ready to scatter."""
         cfg = self.cfg
         h, nh = cfg.hidden_size, cfg.num_attention_heads
         init = _init(cfg)
@@ -144,28 +162,52 @@ class GPTSelfAttention(nn.Module):
                                    name=name)(x)
 
         q, k, v = proj("query"), proj("key"), proj("value")
+        kv_out = (k, v)
+        if kv_quant:
+            from apex_tpu.ops.kv_quant import dequantize_kv, quantize_kv
+
+            (k_q, k_s), (v_q, v_s) = quantize_kv(k), quantize_kv(v)
+            kv_out = ((k_q, k_s), (v_q, v_s))
         if cache_view is not None:
             from apex_tpu.ops.decode_attention import (
                 cached_attention,
                 chunk_cached_attention,
             )
 
-            k_ctx, v_ctx, ctx_bias = cache_view
-            # the new token(s) attend the gathered past plus themselves
-            k_full = jnp.concatenate(
-                [k_ctx.astype(k.dtype), k], axis=1)
-            v_full = jnp.concatenate(
-                [v_ctx.astype(v.dtype), v], axis=1)
+            if kv_quant:
+                # int8 end to end: quantized context + the chunk's own
+                # quantized K/V concatenate with their scale rows; the
+                # attention ops widen at read (in-kernel on the Pallas
+                # path), so no dequantized context ever materializes
+                k_ctx, v_ctx, ctx_bias, ks_ctx, vs_ctx = cache_view
+                k_full = jnp.concatenate([k_ctx, k_q], axis=1)
+                v_full = jnp.concatenate([v_ctx, v_q], axis=1)
+                ks_full = jnp.concatenate([ks_ctx, k_s], axis=1)
+                vs_full = jnp.concatenate([vs_ctx, v_s], axis=1)
+            else:
+                k_ctx, v_ctx, ctx_bias = cache_view
+                # the new token(s) attend the gathered past plus
+                # themselves
+                k_full = jnp.concatenate(
+                    [k_ctx.astype(k.dtype), k], axis=1)
+                v_full = jnp.concatenate(
+                    [v_ctx.astype(v.dtype), v], axis=1)
+                ks_full = vs_full = None
             if x.shape[1] == 1:
                 # decode: the self slot is always live (bias 0)
                 bias = jnp.concatenate(
                     [ctx_bias, jnp.zeros((x.shape[0], 1), jnp.float32)],
                     axis=1)
-                ctx = cached_attention(q, k_full, v_full, kv_bias=bias)
+                ctx = cached_attention(q, k_full, v_full, kv_bias=bias,
+                                       k_scale=ks_full,
+                                       v_scale=vs_full)
             else:
                 # chunked prefill: context masked by ctx_bias, causal
                 # within the chunk
-                ctx = chunk_cached_attention(q, k_full, v_full, ctx_bias)
+                ctx = chunk_cached_attention(q, k_full, v_full,
+                                             ctx_bias,
+                                             k_scale=ks_full,
+                                             v_scale=vs_full)
         else:
             dropout_fn = None
             if cfg.attention_probs_dropout_prob > 0 and not deterministic:
@@ -181,11 +223,22 @@ class GPTSelfAttention(nn.Module):
                         self.make_rng("dropout"), (), 0,
                         jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
             attn = self.attention_fn or causal_dot_product_attention
-            ctx = attn(q, k, v, bias=attn_bias, dropout_fn=dropout_fn)
+            if kv_quant:
+                # quantized serving's monolithic prefill: attend the
+                # DEQUANTIZED k/v — the same grid every later chunk,
+                # decode, or verify step reads back from the pool —
+                # through the unchanged causal path (attention_fn
+                # included; it is just a different k/v operand)
+                k_at = dequantize_kv(k_q, k_s, k.dtype)
+                v_at = dequantize_kv(v_q, v_s, v.dtype)
+            else:
+                k_at, v_at = k, v
+            ctx = attn(q, k_at, v_at, bias=attn_bias,
+                       dropout_fn=dropout_fn)
         out = nn.DenseGeneral(h, axis=(-2, -1), kernel_init=init,
                               name="output")(ctx)
         if return_kv:
-            return out, (k, v)
+            return out, kv_out
         return out
 
 
@@ -201,7 +254,8 @@ class GPTBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, attn_bias, deterministic: bool = True,
-                 cache_view=None, return_kv: bool = False):
+                 cache_view=None, return_kv: bool = False,
+                 kv_quant: bool = False):
         cfg = self.cfg
         init = _init(cfg)
         drop = nn.Dropout(cfg.hidden_dropout_prob,
@@ -212,7 +266,8 @@ class GPTBlock(nn.Module):
                              name="attention")(h, attn_bias,
                                                deterministic,
                                                cache_view=cache_view,
-                                               return_kv=return_kv)
+                                               return_kv=return_kv,
+                                               kv_quant=kv_quant)
         kv = None
         if return_kv:
             h, kv = h
@@ -255,7 +310,13 @@ class GPTLMHeadModel(nn.Module):
     - ``return_kv``: also return the per-layer freshly projected
       ``(k, v)`` list so the engine can write them into the cache
       (prefill uses this with ``cache_views=None`` — the normal causal
-      forward, optionally through the flash ``attention_fn``).
+      forward, optionally through the flash ``attention_fn``);
+    - ``kv_quant``: int8-quantized-pool serving — ``cache_views``
+      grows per-layer fp32 scale sidecars (a 5-tuple), fresh K/V
+      quantize at projection and attention runs on the quantized grid
+      everywhere, and ``return_kv`` yields per-layer
+      ``((k_q, k_scale), (v_q, v_scale))`` (``docs/serving.md``,
+      "Quantized KV cache").
     """
 
     cfg: GPTConfig
@@ -266,7 +327,8 @@ class GPTLMHeadModel(nn.Module):
                  deterministic: bool = True,
                  return_hidden: bool = False,
                  positions=None, cache_views=None,
-                 return_kv: bool = False):
+                 return_kv: bool = False,
+                 kv_quant: bool = False):
         cfg = self.cfg
         x, wte = _embed_block(cfg, input_ids, deterministic, positions)
         bias = None
@@ -284,13 +346,22 @@ class GPTLMHeadModel(nn.Module):
         for i in range(cfg.num_hidden_layers):
             cv = None
             if cache_views is not None:
-                k_ctx, v_ctx, ctx_bias = cache_views
-                cv = (k_ctx[i], v_ctx[i], ctx_bias)
+                if kv_quant:
+                    # quantized serving: (k, v, bias, k_scale,
+                    # v_scale) with int8 payloads and the per-layer
+                    # scale sidecar riding along
+                    k_ctx, v_ctx, ctx_bias, ks_ctx, vs_ctx = \
+                        cache_views
+                    cv = (k_ctx[i], v_ctx[i], ctx_bias,
+                          ks_ctx[i], vs_ctx[i])
+                else:
+                    k_ctx, v_ctx, ctx_bias = cache_views
+                    cv = (k_ctx[i], v_ctx[i], ctx_bias)
             if return_kv:
                 x, kv = block(cfg, self.attention_fn,
                               name=f"block_{i}")(
                     x, bias, deterministic, cache_view=cv,
-                    return_kv=True)
+                    return_kv=True, kv_quant=kv_quant)
                 kvs.append(kv)
             else:
                 x = block(cfg, self.attention_fn, name=f"block_{i}")(

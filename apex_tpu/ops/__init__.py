@@ -18,6 +18,7 @@ from apex_tpu.ops.multi_tensor import (
 from apex_tpu.ops.flatten import flatten, unflatten, flatten_like
 from apex_tpu.ops.flash_attention import flash_attention, make_flash_attention
 from apex_tpu.ops.decode_attention import cached_attention
+from apex_tpu.ops.kv_quant import dequantize_kv, quantize_kv
 from apex_tpu.ops.sampling import finite_rows, greedy_argmax
 from apex_tpu.ops.vocab_parallel import (
     vocab_parallel_argmax,
@@ -28,6 +29,8 @@ from apex_tpu.ops import native
 
 __all__ = [
     "cached_attention",
+    "dequantize_kv",
+    "quantize_kv",
     "finite_rows",
     "flash_attention",
     "greedy_argmax",

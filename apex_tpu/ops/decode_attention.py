@@ -21,6 +21,15 @@ The jnp path is the parity oracle and the CPU/GSPMD-automatic
 fallback; the kernel gate is the standard
 ``pallas_utils.pallas_auto_gate`` resolution of ``use_pallas=None``.
 
+Quantized KV (``docs/serving.md``, "Quantized KV cache"): when the
+pool stores int8, both entry points take the per-slot per-head fp32
+scale sidecar (``k_scale`` / ``v_scale``, (B, T, H)) and widen
+int8 -> compute dtype AT READ — the jnp oracle with one fp32 multiply
+and a single cast (:func:`ops.kv_quant.dequantize_kv`), the Pallas
+streaming kernel per K-block in VMEM right after the int8 HBM read —
+so decode streams HALF the cache bytes and logits never see a
+separately-materialized dequantized pool.
+
 Masking: ``kv_bias`` is a (B, T) additive fp32 row (0 keep / NEG_INF
 drop) — the engine builds it from per-request context lengths so
 unwritten cache slots can never win the softmax.  Fully-masked rows
@@ -38,6 +47,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.ops.kv_quant import dequantize_kv
 from apex_tpu.ops.pallas_utils import pallas_auto_gate, on_tpu, unpatched
 
 NEG_INF = -1e30
@@ -55,8 +65,13 @@ def _cdiv(a, b):
     return (a + b - 1) // b
 
 
-def _reference(q, k, v, kv_bias, scale):
-    """jnp oracle: fp32 scores/softmax, output in q.dtype."""
+def _reference(q, k, v, kv_bias, scale, k_scale=None, v_scale=None):
+    """jnp oracle: fp32 scores/softmax, output in q.dtype.  With
+    scales, k/v arrive int8 and widen to q.dtype first — the same
+    dequantization rule the kernel applies per block in VMEM."""
+    if k_scale is not None:
+        k = dequantize_kv(k, k_scale, q.dtype)
+        v = dequantize_kv(v, v_scale, q.dtype)
     s = _einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if kv_bias is not None:
         s = s + kv_bias.astype(jnp.float32)[:, None, None, :]
@@ -70,9 +85,10 @@ def _reference(q, k, v, kv_bias, scale):
     return out.astype(q.dtype)
 
 
-def _decode_kernel(bias_ref, q_ref, k_ref, v_ref, o_ref,
-                   acc_ref, m_ref, l_ref, *, scale, bk, nk):
-    """One (batch*head, k-block) step of the streaming softmax."""
+def _stream_step(q, k, v, bias_row, o_ref, acc_ref, m_ref, l_ref, *,
+                 scale, nk):
+    """One (batch*head, k-block) step of the streaming softmax —
+    shared by the plain and the int8-dequantizing kernel fronts."""
     ik = pl.program_id(1)
 
     @pl.when(ik == 0)
@@ -81,12 +97,9 @@ def _decode_kernel(bias_ref, q_ref, k_ref, v_ref, o_ref,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    q = q_ref[0]                                   # (_QROWS, D)
-    k = k_ref[0]                                   # (bk, D)
-    v = v_ref[0]
     s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                         preferred_element_type=jnp.float32) * scale
-    s = s + bias_ref[0, 0][None, :]                # (_QROWS, bk)
+    s = s + bias_row[None, :]                      # (_QROWS, bk)
 
     m_prev = m_ref[:, 0]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
@@ -109,6 +122,28 @@ def _decode_kernel(bias_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = jnp.where(valid2, out, 0.0).astype(o_ref.dtype)
 
 
+def _decode_kernel(bias_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, scale, bk, nk):
+    _stream_step(q_ref[0], k_ref[0], v_ref[0], bias_ref[0, 0],
+                 o_ref, acc_ref, m_ref, l_ref, scale=scale, nk=nk)
+
+
+def _decode_kernel_q8(bias_ref, ks_ref, vs_ref, q_ref, k_ref, v_ref,
+                      o_ref, acc_ref, m_ref, l_ref, *, scale, bk, nk):
+    """The int8 front: the K/V block specs stream INT8 bytes from HBM
+    (half the bf16 traffic decode is bound by) and widen to the
+    compute dtype here in VMEM — one fp32 multiply by the block's
+    per-slot scale row and a single cast, the exact
+    :func:`ops.kv_quant.dequantize_kv` rule, so kernel and jnp oracle
+    dequantize identically."""
+    k = (k_ref[0].astype(jnp.float32)
+         * ks_ref[0, 0][:, None]).astype(q_ref.dtype)
+    v = (v_ref[0].astype(jnp.float32)
+         * vs_ref[0, 0][:, None]).astype(q_ref.dtype)
+    _stream_step(q_ref[0], k, v, bias_ref[0, 0],
+                 o_ref, acc_ref, m_ref, l_ref, scale=scale, nk=nk)
+
+
 try:  # mirrors ops.flash_attention: Pallas is TPU-only machinery
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -119,9 +154,12 @@ except Exception:  # pragma: no cover - environment without pallas
 
 @functools.partial(jax.jit,
                    static_argnames=("scale", "bk", "interpret"))
-def _decode_pallas(q3, k3, v3, bias, *, scale, bk, interpret):
+def _decode_pallas(q3, k3, v3, bias, ksc=None, vsc=None, *,
+                   scale, bk, interpret):
     """q3: (BH, _QROWS, D) broadcast query; k3/v3: (BH, Tp, D);
-    bias: (B, Tp) additive row, already NEG_INF over T padding."""
+    bias: (B, Tp) additive row, already NEG_INF over T padding;
+    ksc/vsc: optional (BH, Tp) fp32 dequant scale rows — k3/v3 are
+    then int8 and the q8 kernel widens each block in VMEM."""
     bh, _, d = q3.shape
     tp = k3.shape[1]
     nk = tp // bk
@@ -131,17 +169,31 @@ def _decode_pallas(q3, k3, v3, bias, *, scale, bk, interpret):
     q_spec = pl.BlockSpec((1, _QROWS, d), lambda i, j: (i, 0, 0))
     k_spec = pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0))
     bias_spec = pl.BlockSpec((1, 1, bk), lambda i, j: (i // h, 0, j))
+    if ksc is None:
+        kernel = functools.partial(_decode_kernel, scale=scale,
+                                   bk=bk, nk=nk)
+        in_specs = [bias_spec, q_spec, k_spec, k_spec]
+        args = (bias[:, None, :], q3, k3, v3)
+    else:
+        # scale rows are per (batch*head, slot), so they index like
+        # the K blocks, not like the per-batch bias
+        s_spec = pl.BlockSpec((1, 1, bk), lambda i, j: (i, 0, j))
+        kernel = functools.partial(_decode_kernel_q8, scale=scale,
+                                   bk=bk, nk=nk)
+        in_specs = [bias_spec, s_spec, s_spec, q_spec, k_spec, k_spec]
+        args = (bias[:, None, :], ksc[:, None, :], vsc[:, None, :],
+                q3, k3, v3)
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, scale=scale, bk=bk, nk=nk),
+        kernel,
         grid=(bh, nk),
-        in_specs=[bias_spec, q_spec, k_spec, k_spec],
+        in_specs=in_specs,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((bh, _QROWS, d), q3.dtype),
         scratch_shapes=[pltpu.VMEM((_QROWS, d), jnp.float32),
                         pltpu.VMEM((_QROWS, lanes), jnp.float32),
                         pltpu.VMEM((_QROWS, lanes), jnp.float32)],
         interpret=interpret,
-    )(bias[:, None, :], q3, k3, v3)
+    )(*args)
     return out
 
 
@@ -151,8 +203,30 @@ def _layout(x):
     return jnp.swapaxes(x, 1, 2).reshape(b * h, t, d)
 
 
+def _layout_scale(x):
+    """(B, T, H) -> (B*H, T) — the scale-row analogue of
+    :func:`_layout`."""
+    b, t, h = x.shape
+    return jnp.swapaxes(x, 1, 2).reshape(b * h, t)
+
+
+def _check_scales(k, k_scale, v_scale, what):
+    """Both-or-neither scales, shaped like k minus its head_dim."""
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError(
+            f"{what}: k_scale and v_scale must be passed together")
+    if k_scale is not None and (k_scale.shape != k.shape[:3]
+                                or v_scale.shape != k.shape[:3]):
+        raise ValueError(
+            f"{what}: scales must be (B, T, H) matching k; got "
+            f"k={k.shape} k_scale={k_scale.shape} "
+            f"v_scale={v_scale.shape}")
+
+
 def chunk_cached_attention(q, k, v, ctx_bias,
-                           scale: Optional[float] = None):
+                           scale: Optional[float] = None,
+                           k_scale: Optional[jax.Array] = None,
+                           v_scale: Optional[jax.Array] = None):
     """Multi-token (chunked-prefill) attention over gathered cache
     context plus the chunk itself.
 
@@ -167,6 +241,10 @@ def chunk_cached_attention(q, k, v, ctx_bias,
         for unwritten slots — the engine builds it from the chunk's
         start position).
       scale: logit scale, default 1/sqrt(D).
+      k_scale, v_scale: optional (B, T + C, H) fp32 dequantization
+        scales — k/v are then int8 (quantized cache context AND the
+        chunk's own already-quantized fresh K/V, concatenated by the
+        model) and widen to q.dtype here before the score einsum.
 
     jnp only, same fp32 numeric policy as :func:`cached_attention`'s
     oracle: the (C, T + C) score tile is chunk-bounded and XLA handles
@@ -180,6 +258,10 @@ def chunk_cached_attention(q, k, v, ctx_bias,
         raise ValueError(
             f"k/v must be (B, T + C, H, D) with T >= 0; got q={q.shape} "
             f"k={k.shape} v={v.shape}")
+    _check_scales(k, k_scale, v_scale, "chunk_cached_attention")
+    if k_scale is not None:
+        k = dequantize_kv(k, k_scale, q.dtype)
+        v = dequantize_kv(v, v_scale, q.dtype)
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     s = _einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
@@ -199,6 +281,8 @@ def chunk_cached_attention(q, k, v, ctx_bias,
 
 def cached_attention(q, k, v, *, kv_bias: Optional[jax.Array] = None,
                      scale: Optional[float] = None,
+                     k_scale: Optional[jax.Array] = None,
+                     v_scale: Optional[jax.Array] = None,
                      block_k: Optional[int] = None,
                      use_pallas: Optional[bool] = None,
                      interpret: Optional[bool] = None):
@@ -213,6 +297,12 @@ def cached_attention(q, k, v, *, kv_bias: Optional[jax.Array] = None,
         drop) — position j masks cache slot j; unwritten slots MUST be
         masked by the caller.
       scale: logit scale, default 1/sqrt(D).
+      k_scale, v_scale: optional (B, T, H) fp32 dequantization scales
+        (the quantized pool's per-slot per-head sidecar) — k/v are
+        then int8 and widen to q.dtype at read: per K-block in VMEM
+        inside the streaming kernel, with one fp32 multiply on the
+        jnp oracle.  The logits path never materializes a dequantized
+        pool.
       block_k: k-block tile (multiple of 128 recommended); default
         min(512, padded T).
       use_pallas: None = auto (:func:`pallas_utils.pallas_auto_gate`).
@@ -229,10 +319,11 @@ def cached_attention(q, k, v, *, kv_bias: Optional[jax.Array] = None,
         raise ValueError(
             f"k/v must be (B, T, H, D) matching q; got q={q.shape} "
             f"k={k.shape} v={v.shape}")
+    _check_scales(k, k_scale, v_scale, "cached_attention")
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if not (_HAVE_PALLAS and pallas_auto_gate(use_pallas)):
-        return _reference(q, k, v, kv_bias, scale)
+        return _reference(q, k, v, kv_bias, scale, k_scale, v_scale)
 
     if interpret is None:
         interpret = not on_tpu()
@@ -247,8 +338,13 @@ def cached_attention(q, k, v, *, kv_bias: Optional[jax.Array] = None,
         v = jnp.pad(v, ((0, 0), (0, tp - t), (0, 0), (0, 0)))
         bias = jnp.pad(bias, ((0, 0), (0, tp - t)),
                        constant_values=NEG_INF)
+        if k_scale is not None:  # zero scale: padding dequants to 0
+            k_scale = jnp.pad(k_scale, ((0, 0), (0, tp - t), (0, 0)))
+            v_scale = jnp.pad(v_scale, ((0, 0), (0, tp - t), (0, 0)))
     q3 = jnp.broadcast_to(_layout(q), (b * h, _QROWS, d))
-    out = _decode_pallas(q3, _layout(k), _layout(v), bias,
+    ksc = _layout_scale(k_scale) if k_scale is not None else None
+    vsc = _layout_scale(v_scale) if v_scale is not None else None
+    out = _decode_pallas(q3, _layout(k), _layout(v), bias, ksc, vsc,
                          scale=float(scale), bk=int(block_k),
                          interpret=bool(interpret))
     # row 0 of the sublane-broadcast block is the real query

@@ -26,7 +26,12 @@ intact.  This module is the composition harness:
   uniqueness) and at the end (bit-exact healthy outputs vs an
   unfaulted replay, counter reconciliation).  ``tools/chaos_soak.py``
   is its CLI; the ``chaos`` build-matrix axis runs it at 2000
-  iterations.
+  iterations.  The replay oracle is whatever ``make_replay`` builds —
+  the ``--kv-quant`` soak variant builds a QUANT-ON replica
+  (``docs/serving.md``, "Quantized KV cache"), so bit-exact replay
+  continues to hold on the int8 pool: both computations live on the
+  same quantized grid, and the invariant then proves quantized
+  blocks+scales survive every composed fault path bit-consistently.
 
 This module never imports :mod:`apex_tpu.serving` at module scope
 (``serving.api`` imports :mod:`resilience.breaker`; a top-level

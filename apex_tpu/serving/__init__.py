@@ -50,6 +50,16 @@ serve".  Three layers, bottom-up:
   (``ops.vocab_parallel_sample``) so logits never gather; greedy
   output is bit-identical to the unsharded engine
   (``tests/L0/test_serving_tp.py``);
+- quantized int8 KV cache (``docs/serving.md``, "Quantized KV
+  cache"): ``kv_quant="int8"`` (env twin ``APEX_TPU_KV_QUANT``)
+  stores the pool int8 with a per-slot per-head fp32 absmax scale
+  sidecar — quantization fused into every write program,
+  dequantization fused into every read (in-kernel on the Pallas
+  decode path), ~1.9x concurrent live blocks per HBM byte net of the
+  sidecar at head_dim 64; quant-on output is held to a decode-parity
+  tolerance budget vs the full-width pool and is BIT-STABLE across
+  COW / preemption / eviction / chunking / speculation / pipeline /
+  tensor parallelism (``tests/L0/test_kv_quant.py``);
 - :mod:`serving.overload` + the lifecycle layer — priority-aware load
   shedding (``finish_reason="shed"``) under queue/pool pressure, a
   circuit breaker in front of ``submit``
@@ -86,8 +96,11 @@ from apex_tpu.serving.engine import DecodeEngine, default_prefill_buckets
 from apex_tpu.serving.kv_cache import (
     BlockAllocator,
     KVCacheConfig,
+    dequantize_kv,
     init_kv_cache,
+    quantize_kv,
     resolve_cache_dtype,
+    resolve_kv_quant,
 )
 from apex_tpu.serving.overload import OverloadPolicy
 from apex_tpu.serving.prefix_cache import PrefixCache
@@ -117,7 +130,10 @@ __all__ = [
     "RouterRequest",
     "Scheduler",
     "default_prefill_buckets",
+    "dequantize_kv",
     "greedy_sample",
     "init_kv_cache",
+    "quantize_kv",
     "resolve_cache_dtype",
+    "resolve_kv_quant",
 ]

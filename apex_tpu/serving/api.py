@@ -139,6 +139,7 @@ from apex_tpu.observability import (
 )
 from apex_tpu.resilience.breaker import CircuitBreaker
 from apex_tpu.serving.engine import DecodeEngine
+from apex_tpu.serving.kv_cache import KV_QUANT_ENV, resolve_kv_quant
 from apex_tpu.serving.overload import OverloadPolicy
 from apex_tpu.serving.prefix_cache import PrefixCache
 from apex_tpu.serving.scheduler import QueueFullError, Request, Scheduler
@@ -239,7 +240,11 @@ class _InflightStep:
 class InferenceServer:
     """Batched GPT inference with KV-cache + continuous batching.
 
-    Args (beyond :class:`DecodeEngine`'s, which pass through):
+    Args (beyond :class:`DecodeEngine`'s, which pass through —
+    including ``kv_quant="int8"``, the quantized KV pool with its
+    per-slot per-head scale sidecar; ``APEX_TPU_KV_QUANT=int8`` is
+    its env twin, the kwarg wins — ``docs/serving.md``, "Quantized
+    KV cache"):
       sample_fn: (…, V) numpy logits -> (…,) token ids; default
         greedy.  Runs on host — per-step logits are (B, V).
       max_waiting: bound on the waiting queue; a submit past it comes
@@ -373,6 +378,7 @@ class InferenceServer:
                  num_blocks: Optional[int] = None,
                  block_size: int = 16,
                  cache_dtype=None,
+                 kv_quant: Optional[str] = None,
                  attention_fn=None,
                  prefill_buckets=None,
                  mesh=None,
@@ -419,10 +425,20 @@ class InferenceServer:
         self.programs = (ProgramAccounting(registry=self.registry)
                          if enable_program_accounting
                          else NULL_PROGRAM_ACCOUNTING)
+        # quantized KV pool (docs/serving.md, "Quantized KV cache"):
+        # the APEX_TPU_KV_QUANT env twin turns it on fleet-wide
+        # without touching call sites; a PROVIDED kwarg wins — None
+        # means "not provided" (defer to the env), so a caller that
+        # must stay full-width under any environment pins
+        # kv_quant="off" (the bench's legacy arms do)
+        if kv_quant is None:
+            kv_quant = os.environ.get(KV_QUANT_ENV)
+        self.kv_quant = resolve_kv_quant(kv_quant)
         self.engine = DecodeEngine(
             cfg, params, max_batch_size=max_batch_size,
             max_context=max_context, num_blocks=num_blocks,
             block_size=block_size, cache_dtype=cache_dtype,
+            kv_quant=self.kv_quant,
             attention_fn=attention_fn, prefill_buckets=prefill_buckets,
             tracer=self.tracer, programs=self.programs,
             mesh=mesh, tp_rules=tp_rules, tp_axis=tp_axis)
@@ -1629,9 +1645,16 @@ class InferenceServer:
             "pool_bytes": info["pool_bytes"],
             # the ACTUAL per-chip HBM cost, from the live arrays'
             # shard shape/dtype — equals pool_bytes unsharded, and
-            # pool_bytes/tp under tensor parallelism
+            # pool_bytes/tp under tensor parallelism; under
+            # quantization both include the fp32 scale sidecar
             "pool_bytes_per_device": info["pool_bytes_per_device"],
+            "bytes_per_block": info["bytes_per_block"],
             "cache_dtype": info["cache_dtype"],
+            # quantized KV pool (docs/serving.md, "Quantized KV
+            # cache"): storage mode + the compute dtype values widen
+            # to at read (None / == cache_dtype when off)
+            "quantize": info["quantize"],
+            "compute_dtype": info["compute_dtype"],
         }
         return out
 

@@ -78,7 +78,9 @@ from apex_tpu.serving.kv_cache import (
     context_bias,
     copy_blocks,
     gather_context,
+    gather_scales,
     init_kv_cache,
+    resolve_kv_quant,
     slot_index,
     write_prefill,
     write_tokens,
@@ -137,8 +139,15 @@ class DecodeEngine:
         garbage block 0); default sizes the pool for
         ``max_batch_size`` full-context requests plus slack.
       block_size: tokens per block.
-      cache_dtype: KV dtype; None = amp policy
+      cache_dtype: KV COMPUTE dtype; None = amp policy
         (:func:`serving.kv_cache.resolve_cache_dtype`).
+      kv_quant: ``"int8"`` stores the pool quantized — int8 payload
+        plus a per-slot per-head fp32 scale sidecar sharded with its
+        heads — with quantization fused into every write program and
+        dequantization fused into every read (``docs/serving.md``,
+        "Quantized KV cache").  ``cache_dtype`` keeps naming the
+        compute dtype the values widen to.  Default ``None`` (the
+        historical full-width pool, byte-identical programs).
       attention_fn: optional fused attention for the PREFILL pass
         (``make_flash_attention(causal=True)`` on TPU); decode always
         takes the ``ops.cached_attention`` path.
@@ -183,6 +192,7 @@ class DecodeEngine:
                  num_blocks: Optional[int] = None,
                  block_size: int = 16,
                  cache_dtype=None,
+                 kv_quant: Optional[str] = None,
                  attention_fn=None,
                  prefill_buckets: Optional[Sequence[int]] = None,
                  tracer=None,
@@ -197,8 +207,11 @@ class DecodeEngine:
         self.mesh = mesh
         self.tp_axis = tp_axis if mesh is not None else None
         self.tp = 1
+        self.kv_quant = resolve_kv_quant(kv_quant)
+        self.quantized = self.kv_quant is not None
         self._repl = None         # replicated placement for launch args
         self._pool_shard = None   # the pool's head-sharded placement
+        self._scale_shard = None  # the scale sidecar's (heads last)
         if mesh is not None:
             if tp_axis not in mesh.shape:
                 raise ValueError(
@@ -221,6 +234,10 @@ class DecodeEngine:
             self._repl = NamedSharding(mesh, P())
             self._pool_shard = NamedSharding(
                 mesh, P(None, None, tp_axis, None))
+            # scale sidecar (L, num_slots, H): heads are its LAST
+            # dim, so it shards alongside the heads it dequantizes
+            self._scale_shard = NamedSharding(
+                mesh, P(None, None, tp_axis))
         self.params = params
         self.max_batch_size = int(max_batch_size)
         self.max_context = int(max_context
@@ -240,10 +257,12 @@ class DecodeEngine:
             head_dim=cfg.hidden_size // cfg.num_attention_heads,
             num_blocks=int(num_blocks),
             block_size=self.block_size,
-            dtype=cache_dtype)
+            dtype=cache_dtype,
+            quantize=self.kv_quant)
         self.allocator = BlockAllocator(self.cache_cfg)
         self.cache = init_kv_cache(self.cache_cfg,
-                                   sharding=self._pool_shard)
+                                   sharding=self._pool_shard,
+                                   scale_sharding=self._scale_shard)
         self.model = GPTLMHeadModel(cfg, attention_fn=attention_fn)
         if prefill_buckets is None:
             prefill_buckets = default_prefill_buckets(self.max_context)
@@ -265,8 +284,12 @@ class DecodeEngine:
             return jax.jit(fn, donate_argnums=donate,
                            out_shardings=outs)
 
-        cache_sh = ({"k": self._pool_shard, "v": self._pool_shard}
-                    if self.mesh is not None else None)
+        cache_sh = None
+        if self.mesh is not None:
+            cache_sh = {"k": self._pool_shard, "v": self._pool_shard}
+            if self.quantized:
+                cache_sh["k_scale"] = self._scale_shard
+                cache_sh["v_scale"] = self._scale_shard
         repl = self._repl
         self._prefill_jit = _jit(self._prefill_impl, (1,),
                                  (cache_sh, repl))
@@ -303,6 +326,30 @@ class DecodeEngine:
 
     # -- compiled bodies --------------------------------------------------
 
+    def _cache_views(self, cache, tables, bias):
+        """The model's ``cache_views`` struct for one gathered
+        context: (k, v, bias) plain, plus the per-layer scale sidecar
+        legs under quantization (int8 payload + fp32 scales — the
+        attention ops widen at read)."""
+        k_ctx, v_ctx = gather_context(cache, tables, self.block_size)
+        if not self.quantized:
+            return (k_ctx, v_ctx, bias)
+        ks_ctx, vs_ctx = gather_scales(cache, tables, self.block_size)
+        return (k_ctx, v_ctx, bias, ks_ctx, vs_ctx)
+
+    def _stack_kvs(self, kvs):
+        """Stack the model's per-layer fresh K/V into the scatter
+        layout ``write_prefill``/``write_tokens`` expect: plain
+        (k, v) arrays, or the quantized
+        ``((k_q, k_scale), (v_q, v_scale))`` quadruple."""
+        if self.quantized:
+            return ((jnp.stack([kv[0][0] for kv in kvs]),
+                     jnp.stack([kv[0][1] for kv in kvs])),
+                    (jnp.stack([kv[1][0] for kv in kvs]),
+                     jnp.stack([kv[1][1] for kv in kvs])))
+        return (jnp.stack([kv[0] for kv in kvs]),
+                jnp.stack([kv[1] for kv in kvs]))
+
     def _prefill_impl(self, params, cache, ids, length, table):
         """ids (1, Sb) zero-padded prompt; length (1,) true length;
         table (1, blocks_per_seq).  Returns (cache, last-token logits
@@ -312,13 +359,13 @@ class DecodeEngine:
         mask = (pos < length[:, None]).astype(jnp.int32)
         logits, kvs = self.model.apply(
             {"params": params}, ids, attention_mask=mask,
-            deterministic=True, return_kv=True)
-        k = jnp.stack([kv[0] for kv in kvs])          # (L, 1, Sb, H, D)
-        v = jnp.stack([kv[1] for kv in kvs])
+            deterministic=True, return_kv=True,
+            kv_quant=self.quantized)
+        kv_new = self._stack_kvs(kvs)                 # (L, 1, Sb, H, D)
         # padded positions scatter into the garbage block (slot 0)
         slots = jnp.where(mask > 0,
                           slot_index(table, pos, self.block_size), 0)
-        cache = write_prefill(cache, (k, v), slots)
+        cache = write_prefill(cache, kv_new, slots)
         last = jnp.take_along_axis(
             logits, (length[:, None, None] - 1).astype(jnp.int32),
             axis=1)[:, 0]                             # (1, V)
@@ -339,21 +386,20 @@ class DecodeEngine:
         off = jnp.arange(cb, dtype=jnp.int32)[None, :]
         pos = start[:, None].astype(jnp.int32) + off       # (1, Cb)
         t_ctx = self.blocks_per_seq * self.block_size
-        k_ctx, v_ctx = gather_context(cache, table, self.block_size)
         bias = context_bias(start, t_ctx)                  # slots < start
+        views = self._cache_views(cache, table, bias)
         # padded tail positions can run past the embedding table; clamp
         # them (their logits and K/V writes are discarded/garbage-sunk)
         pos_emb = jnp.minimum(pos, self.cfg.max_position_embeddings - 1)
         logits, kvs = self.model.apply(
             {"params": params}, ids, positions=pos_emb,
-            deterministic=True, cache_views=(k_ctx, v_ctx, bias),
-            return_kv=True)
-        k = jnp.stack([kv[0] for kv in kvs])               # (L, 1, Cb, H, D)
-        v = jnp.stack([kv[1] for kv in kvs])
+            deterministic=True, cache_views=views,
+            return_kv=True, kv_quant=self.quantized)
+        kv_new = self._stack_kvs(kvs)                      # (L, 1, Cb, H, D)
         valid = off < length[:, None]
         slots = jnp.where(valid,
                           slot_index(table, pos, self.block_size), 0)
-        cache = write_prefill(cache, (k, v), slots)
+        cache = write_prefill(cache, kv_new, slots)
         last = jnp.take_along_axis(
             logits, (length[:, None, None] - 1).astype(jnp.int32),
             axis=1)[:, 0]                                  # (1, V)
@@ -378,21 +424,20 @@ class DecodeEngine:
         off = jnp.arange(kw, dtype=jnp.int32)[None, :]
         pos = start[:, None].astype(jnp.int32) + off       # (B, K)
         t_ctx = self.blocks_per_seq * self.block_size
-        k_ctx, v_ctx = gather_context(cache, tables, self.block_size)
         bias = context_bias(start, t_ctx)                  # slots < start
+        views = self._cache_views(cache, tables, bias)
         # padded columns can run past the embedding table; clamp (their
         # logits are ignored and their K/V writes garbage-sunk)
         pos_emb = jnp.minimum(pos, self.cfg.max_position_embeddings - 1)
         logits, kvs = self.model.apply(
             {"params": params}, ids, positions=pos_emb,
-            deterministic=True, cache_views=(k_ctx, v_ctx, bias),
-            return_kv=True)
-        k = jnp.stack([kv[0] for kv in kvs])               # (L, B, K, H, D)
-        v = jnp.stack([kv[1] for kv in kvs])
+            deterministic=True, cache_views=views,
+            return_kv=True, kv_quant=self.quantized)
+        kv_new = self._stack_kvs(kvs)                      # (L, B, K, H, D)
         valid = off < length[:, None]
         slots = jnp.where(valid,
                           slot_index(tables, pos, self.block_size), 0)
-        cache = write_prefill(cache, (k, v), slots)
+        cache = write_prefill(cache, kv_new, slots)
         return cache, logits                               # (B, K, V)
 
     def _copy_impl(self, cache, src, dst):
@@ -405,17 +450,17 @@ class DecodeEngine:
         its position (== cached context length); tables (B,
         blocks_per_seq).  Returns (cache, logits (B, V))."""
         t_ctx = self.blocks_per_seq * self.block_size
-        k_ctx, v_ctx = gather_context(cache, tables, self.block_size)
         bias = context_bias(positions, t_ctx)
+        views = self._cache_views(cache, tables, bias)
         logits, kvs = self.model.apply(
             {"params": params}, tokens[:, None],
             positions=positions[:, None].astype(jnp.int32),
             deterministic=True,
-            cache_views=(k_ctx, v_ctx, bias), return_kv=True)
-        k = jnp.stack([kv[0] for kv in kvs])          # (L, B, 1, H, D)
-        v = jnp.stack([kv[1] for kv in kvs])
+            cache_views=views, return_kv=True,
+            kv_quant=self.quantized)
+        kv_new = self._stack_kvs(kvs)                 # (L, B, 1, H, D)
         slots = slot_index(tables, positions, self.block_size)
-        cache = write_tokens(cache, (k, v), slots)
+        cache = write_tokens(cache, kv_new, slots)
         return cache, logits[:, 0]                    # (B, V)
 
     # -- fused on-device-sampling bodies ----------------------------------
@@ -492,6 +537,17 @@ class DecodeEngine:
                 program if key is None else f"{program}[{key}]",
                 t0, compiled)
 
+    def _qkey(self, key=None):
+        """The :class:`ProgramAccounting` bucket/width key for one
+        launch, grown a ``q8`` tag under quantization — quant-on
+        traces account under distinct keys (``prefill[64q8]``,
+        ``decode[q8]``) so compile-count and wall-time audits can
+        bound the quantized program variants separately
+        (``tools/ops_probe.py --programs``)."""
+        if not self.quantized:
+            return key
+        return "q8" if key is None else f"{key}q8"
+
     def bucket_for(self, length: int) -> int:
         try:
             return pick_bucket(length, self.prefill_buckets)
@@ -548,8 +604,8 @@ class DecodeEngine:
         mark = self._mark(self._prefill_jit)
         self.cache, last = self._prefill_jit(self.params, self.cache,
                                              *args)
-        self._account(self._prefill_jit, mark, "prefill", key=sb,
-                      bucket=sb)
+        self._account(self._prefill_jit, mark, "prefill",
+                      key=self._qkey(sb), bucket=sb)
         return last[0]
 
     def prefill_sampled(self, prompt, block_table):
@@ -562,7 +618,8 @@ class DecodeEngine:
         self.cache, ids, fin = self._prefill_sampled_jit(
             self.params, self.cache, *args)
         self._account(self._prefill_sampled_jit, mark,
-                      "prefill_sampled", key=sb, bucket=sb)
+                      "prefill_sampled", key=self._qkey(sb),
+                      bucket=sb)
         return ids, fin
 
     def chunk_prefill(self, tokens, start: int, block_table,
@@ -581,8 +638,8 @@ class DecodeEngine:
         mark = self._mark(self._chunk_jit)
         self.cache, last = self._chunk_jit(self.params, self.cache,
                                            *args)
-        self._account(self._chunk_jit, mark, "chunk_prefill", key=cb,
-                      width=cb)
+        self._account(self._chunk_jit, mark, "chunk_prefill",
+                      key=self._qkey(cb), width=cb)
         return last[0]
 
     def chunk_prefill_sampled(self, tokens, start: int, block_table,
@@ -596,7 +653,8 @@ class DecodeEngine:
         self.cache, ids, fin = self._chunk_sampled_jit(
             self.params, self.cache, *args)
         self._account(self._chunk_sampled_jit, mark,
-                      "chunk_prefill_sampled", key=cb, width=cb)
+                      "chunk_prefill_sampled", key=self._qkey(cb),
+                      width=cb)
         return ids, fin
 
     def copy_blocks(self, pairs) -> None:
@@ -613,7 +671,8 @@ class DecodeEngine:
             args = self._put(src, dst)
             mark = self._mark(self._copy_jit)
             self.cache = self._copy_jit(self.cache, *args)
-            self._account(self._copy_jit, mark, "copy_blocks")
+            self._account(self._copy_jit, mark, "copy_blocks",
+                          key=self._qkey())
 
     def _decode_args(self, tokens, positions, tables):
         return self._put(np.asarray(tokens, np.int32),
@@ -628,7 +687,8 @@ class DecodeEngine:
         mark = self._mark(self._decode_jit)
         self.cache, logits = self._decode_jit(self.params, self.cache,
                                               *args)
-        self._account(self._decode_jit, mark, "decode")
+        self._account(self._decode_jit, mark, "decode",
+                      key=self._qkey())
         return logits
 
     def decode_sampled(self, tokens, positions, tables):
@@ -642,7 +702,7 @@ class DecodeEngine:
         self.cache, ids, fin = self._decode_sampled_jit(
             self.params, self.cache, *args)
         self._account(self._decode_sampled_jit, mark,
-                      "decode_sampled")
+                      "decode_sampled", key=self._qkey())
         return ids, fin
 
     def _verify_args(self, tokens, lengths, positions, tables):
@@ -665,8 +725,8 @@ class DecodeEngine:
         mark = self._mark(self._verify_jit)
         self.cache, logits = self._verify_jit(self.params, self.cache,
                                               *args)
-        self._account(self._verify_jit, mark, "verify", key=kw,
-                      width=kw)
+        self._account(self._verify_jit, mark, "verify",
+                      key=self._qkey(kw), width=kw)
         return logits
 
     def verify_sampled(self, tokens, lengths, positions, tables):
@@ -682,7 +742,8 @@ class DecodeEngine:
         self.cache, ids, fin = self._verify_sampled_jit(
             self.params, self.cache, *args)
         self._account(self._verify_sampled_jit, mark,
-                      "verify_sampled", key=kw, width=kw)
+                      "verify_sampled", key=self._qkey(kw),
+                      width=kw)
         return ids, fin
 
     # -- introspection ----------------------------------------------------
@@ -734,18 +795,27 @@ class DecodeEngine:
         bytes, read off the live arrays' shard shape and dtype (under
         tensor parallelism each device holds ``num_heads/tp`` heads of
         the pool, so the logical size overstates per-chip HBM by
-        tp×)."""
+        tp×).  Under quantization every count includes the scale
+        sidecar — summed over ALL live cache leaves' shard shapes, so
+        ``pool_bytes_per_device`` is what the int8 pool plus its fp32
+        scales actually pin on each chip, and ``bytes_per_block`` is
+        the true per-block HBM price headroom math divides by."""
         cfg = self.cache_cfg
         k = self.cache["k"]
-        shard_elems = int(np.prod(k.sharding.shard_shape(k.shape)))
-        per_device = 2 * shard_elems * jnp.dtype(k.dtype).itemsize
+        per_device = sum(
+            int(np.prod(arr.sharding.shard_shape(arr.shape)))
+            * jnp.dtype(arr.dtype).itemsize
+            for arr in self.cache.values())
         return {
             "blocks_usable": cfg.num_blocks - 1,
             "block_size": cfg.block_size,
             "pool_tokens": cfg.usable_tokens,
             "pool_bytes": cfg.bytes(),
             "pool_bytes_per_device": per_device,
+            "bytes_per_block": cfg.bytes_per_block,
             "cache_dtype": str(jnp.dtype(k.dtype)),
+            "quantize": cfg.quantize,
+            "compute_dtype": str(cfg.resolved_dtype()),
         }
 
     def sharding_info(self) -> dict:
@@ -771,5 +841,6 @@ class DecodeEngine:
         """Zero the pool and refill the allocator in place (between
         workloads; schedulers holding the allocator stay wired)."""
         self.cache = init_kv_cache(self.cache_cfg,
-                                   sharding=self._pool_shard)
+                                   sharding=self._pool_shard,
+                                   scale_sharding=self._scale_shard)
         self.allocator.reset()

@@ -35,6 +35,23 @@ per token), so it defaults to the amp "half" dtype — the active
 ``amp.initialize`` policy's ``cast_model_type`` when one is installed,
 else bfloat16 (``amp.properties.HALF``).  ``KVCacheConfig(dtype=...)``
 overrides explicitly (tests pin fp32 for bit-parity runs).
+
+Quantized mode (``docs/serving.md``, "Quantized KV cache"):
+``KVCacheConfig(quantize="int8")`` stores the pool as int8 with a
+per-token-slot, per-head fp32 absmax scale SIDECAR — two extra cache
+leaves ``k_scale`` / ``v_scale`` of shape (L, num_slots, H), allocated
+block-granular alongside the pool so every block-lifecycle path (COW
+duplication, prefix-cache holds, speculation rollback, preemption
+re-prefill) carries scales with their blocks by construction, and
+head-sharded with their heads under tensor parallelism.  ``dtype``
+keeps meaning the COMPUTE dtype the dequantized values widen to; the
+STORAGE dtype becomes int8 (:meth:`KVCacheConfig.storage_dtype`).
+Scales are per token slot — not one scalar per block — because a
+block fills incrementally (decode writes one token at a time) and a
+shared per-block scalar would have to requantize earlier tokens from
+their already-lossy int8, destroying the bit-stability the serving
+stack pins across preemption / chunked prefill / COW (BENCH_NOTES,
+kv-quant decision table).
 """
 
 from __future__ import annotations
@@ -45,15 +62,56 @@ from typing import Dict, List, Optional
 import jax
 import jax.numpy as jnp
 
+# the quantization numeric contract lives with the kernels that widen
+# it back (ops); re-exported here because the cache is what stores it
+from apex_tpu.ops.kv_quant import (  # noqa: F401  (re-export)
+    INT8_QMAX,
+    dequantize_kv,
+    quantize_kv,
+)
+
 NEG_INF = -1e9
+
+# env twin of the ``kv_quant=`` knob (InferenceServer reads it)
+KV_QUANT_ENV = "APEX_TPU_KV_QUANT"
+
+_QUANT_MODES = (None, "int8")
+
+
+def resolve_kv_quant(value):
+    """Normalize a ``kv_quant`` knob / ``APEX_TPU_KV_QUANT`` env value
+    to ``None`` or ``"int8"``; anything else is a loud error."""
+    if value is None:
+        return None
+    if isinstance(value, str):
+        v = value.strip().lower()
+        if v in ("", "0", "none", "off"):
+            return None
+        if v in ("1", "int8"):
+            return "int8"
+    raise ValueError(
+        f"unknown KV quantization mode {value!r} "
+        f"(expected one of: None/'', 'int8')")
 
 
 def resolve_cache_dtype(dtype=None):
     """The ONE resolution of ``KVCacheConfig.dtype=None``: an explicit
     dtype wins; else the installed amp policy's half type (``O1``-``O3``
-    set ``cast_model_type``); else bfloat16 (TPU-native half)."""
+    set ``cast_model_type``); else bfloat16 (TPU-native half).
+
+    Integer dtypes are rejected: ``dtype`` is the COMPUTE dtype the
+    pool's values carry through attention, and an int pool here would
+    silently store garbage K/V — int8 storage is a quantization mode
+    (``KVCacheConfig(quantize="int8")``), not a cache dtype."""
     if dtype is not None:
-        return jnp.dtype(dtype)
+        dt = jnp.dtype(dtype)
+        if not jnp.issubdtype(dt, jnp.floating):
+            raise TypeError(
+                f"cache dtype must be a floating-point compute dtype, "
+                f"got {dt}; for an int8-quantized KV pool pass "
+                f"KVCacheConfig(quantize='int8') (per-block-scaled "
+                f"storage), not dtype={dt}")
+        return dt
     try:
         from apex_tpu.amp._amp_state import _amp_state
         props = _amp_state.opt_properties
@@ -72,7 +130,15 @@ class KVCacheConfig:
 
     ``num_blocks`` INCLUDES the reserved garbage block 0, so the
     usable capacity is ``(num_blocks - 1) * block_size`` tokens.
-    ``dtype=None`` defers to :func:`resolve_cache_dtype`."""
+    ``dtype=None`` defers to :func:`resolve_cache_dtype`.
+
+    ``quantize="int8"`` turns on quantized storage: the pool leaves
+    become int8 and a per-slot, per-head fp32 scale sidecar
+    (``k_scale`` / ``v_scale``, shape (L, num_slots, H)) rides along;
+    ``dtype`` then names the COMPUTE dtype dequantized values widen
+    to.  All byte accounting (:meth:`bytes`, :attr:`bytes_per_block`)
+    includes the sidecar — occupancy and headroom math must price a
+    block at what it actually costs in HBM."""
 
     num_layers: int
     num_heads: int
@@ -80,6 +146,7 @@ class KVCacheConfig:
     num_blocks: int
     block_size: int = 16
     dtype: Optional[object] = None
+    quantize: Optional[str] = None
 
     def __post_init__(self):
         if self.num_blocks < 2:
@@ -89,6 +156,11 @@ class KVCacheConfig:
         if self.block_size < 1:
             raise ValueError(f"block_size must be >= 1; got "
                              f"{self.block_size}")
+        if self.quantize not in _QUANT_MODES:
+            raise ValueError(
+                f"quantize must be one of {_QUANT_MODES}; got "
+                f"{self.quantize!r}")
+        self.resolved_dtype()   # reject int compute dtypes loudly
 
     @property
     def num_slots(self) -> int:
@@ -98,31 +170,82 @@ class KVCacheConfig:
     def usable_tokens(self) -> int:
         return (self.num_blocks - 1) * self.block_size
 
+    @property
+    def quantized(self) -> bool:
+        return self.quantize is not None
+
     def resolved_dtype(self):
         return resolve_cache_dtype(self.dtype)
 
+    def storage_dtype(self):
+        """The dtype the pool's K/V payload is actually stored in:
+        int8 under quantization, the compute dtype otherwise."""
+        if self.quantized:
+            return jnp.dtype(jnp.int8)
+        return self.resolved_dtype()
+
+    @property
+    def scale_bytes_per_block(self) -> int:
+        """HBM cost of one block's share of the scale sidecar (both
+        K and V legs); 0 when quantization is off."""
+        if not self.quantized:
+            return 0
+        return 2 * self.num_layers * self.block_size * self.num_heads \
+            * jnp.dtype(jnp.float32).itemsize
+
+    @property
+    def bytes_per_block(self) -> int:
+        """TRUE HBM cost of one physical block — K + V payload plus
+        the scale sidecar under quantization.  The allocator's
+        occupancy/fragmentation math and the fixed-pool-bytes bench
+        arms price blocks with this, so quantized headroom claims are
+        net of the sidecar."""
+        payload = (2 * self.num_layers * self.block_size
+                   * self.num_heads * self.head_dim
+                   * self.storage_dtype().itemsize)
+        return payload + self.scale_bytes_per_block
+
     def bytes(self) -> int:
-        """HBM footprint of the pool (both K and V)."""
-        return (2 * self.num_layers * self.num_slots * self.num_heads
-                * self.head_dim * self.resolved_dtype().itemsize)
+        """HBM footprint of the pool (both K and V, scale sidecar
+        included when quantized)."""
+        return self.num_blocks * self.bytes_per_block
 
 
-def init_kv_cache(cfg: KVCacheConfig, sharding=None):
+def init_kv_cache(cfg: KVCacheConfig, sharding=None,
+                  scale_sharding=None):
     """Allocate the zeroed pool: ``{"k","v"}`` each
-    (L, num_slots, H, D) in the resolved cache dtype.
+    (L, num_slots, H, D) in the storage dtype, plus — under
+    ``quantize="int8"`` — the fp32 scale sidecar ``{"k_scale",
+    "v_scale"}`` each (L, num_slots, H).
 
-    ``sharding``: optional ``jax.sharding.Sharding`` for each leaf —
-    tensor-parallel serving passes the head-sharded pool placement
-    (``P(None, None, model, None)``) so every device materializes ONLY
-    its ``H/tp`` heads of every block; the zeros are created sharded
-    (jit ``out_shardings``), never allocated whole and scattered."""
+    ``sharding``: optional ``jax.sharding.Sharding`` for the pool
+    leaves — tensor-parallel serving passes the head-sharded pool
+    placement (``P(None, None, model, None)``) so every device
+    materializes ONLY its ``H/tp`` heads of every block; the zeros are
+    created sharded (jit ``out_shardings``), never allocated whole and
+    scattered.  ``scale_sharding`` is the sidecar's placement
+    (``P(None, None, model)`` — heads are its LAST dim), so scales
+    live on the same shard as the heads they dequantize."""
     shape = (cfg.num_layers, cfg.num_slots, cfg.num_heads, cfg.head_dim)
-    dt = cfg.resolved_dtype()
+    dt = cfg.storage_dtype()
+
+    def build():
+        cache = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+        if cfg.quantized:
+            sshape = shape[:-1]
+            cache["k_scale"] = jnp.zeros(sshape, jnp.float32)
+            cache["v_scale"] = jnp.zeros(sshape, jnp.float32)
+        return cache
+
     if sharding is None:
-        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
-    return jax.jit(
-        lambda: {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)},
-        out_shardings={"k": sharding, "v": sharding})()
+        return build()
+    outs = {"k": sharding, "v": sharding}
+    if cfg.quantized:
+        outs["k_scale"] = scale_sharding
+        outs["v_scale"] = scale_sharding
+    return jax.jit(build, out_shardings=outs)()
+
+
 
 
 # ---------------------------------------------------------------------------
@@ -150,8 +273,19 @@ def write_tokens(cache, kvs, slots):
     """Scatter one new token per sequence into the pool.
 
     kvs: (L, B, 1, H, D) stacked per-layer (k, v) pairs — i.e. a tuple
-    ``(k_new, v_new)`` of that shape; slots: (B,) flat slot indices."""
+    ``(k_new, v_new)`` of that shape; slots: (B,) flat slot indices.
+    Under quantization kvs is ``((k_q, k_scale), (v_q, v_scale))``
+    with the payloads (L, B, 1, H, D) int8 and the scales
+    (L, B, 1, H) fp32 — ALREADY quantized by the model's projection
+    path, so the pool receives byte-for-byte the values attention just
+    used."""
     k_new, v_new = kvs
+    if "k_scale" in cache:
+        (kq, ks), (vq, vs) = k_new, v_new
+        return {"k": cache["k"].at[:, slots].set(kq[:, :, 0]),
+                "v": cache["v"].at[:, slots].set(vq[:, :, 0]),
+                "k_scale": cache["k_scale"].at[:, slots].set(ks[:, :, 0]),
+                "v_scale": cache["v_scale"].at[:, slots].set(vs[:, :, 0])}
     k_new = k_new[:, :, 0].astype(cache["k"].dtype)   # (L, B, H, D)
     v_new = v_new[:, :, 0].astype(cache["v"].dtype)
     return {"k": cache["k"].at[:, slots].set(k_new),
@@ -162,8 +296,24 @@ def write_prefill(cache, kvs, slots):
     """Scatter a whole prompt's K/V into the pool.
 
     kvs: tuple of (L, B, S, H, D); slots: (B, S) flat slot indices with
-    padded positions pointed at the garbage block by the caller."""
+    padded positions pointed at the garbage block by the caller.
+    Under quantization kvs is ``((k_q, k_scale), (v_q, v_scale))``
+    exactly as in :func:`write_tokens` (payloads (L, B, S, H, D),
+    scales (L, B, S, H))."""
     k_new, v_new = kvs
+    if "k_scale" in cache:
+        (kq, ks), (vq, vs) = k_new, v_new
+        L = kq.shape[0]
+        flat = slots.reshape(-1)                      # (B*S,)
+        out = {"k": cache["k"].at[:, flat].set(
+                   kq.reshape(L, -1, *kq.shape[3:])),
+               "v": cache["v"].at[:, flat].set(
+                   vq.reshape(L, -1, *vq.shape[3:]))}
+        out["k_scale"] = cache["k_scale"].at[:, flat].set(
+            ks.reshape(L, -1, *ks.shape[3:]))
+        out["v_scale"] = cache["v_scale"].at[:, flat].set(
+            vs.reshape(L, -1, *vs.shape[3:]))
+        return out
     L = k_new.shape[0]
     flat = slots.reshape(-1)                          # (B*S,)
     k2 = k_new.reshape(L, -1, *k_new.shape[3:]).astype(cache["k"].dtype)
@@ -192,6 +342,21 @@ def gather_context(cache, block_tables, block_size: int, out_dtype=None):
     return k, v
 
 
+def gather_scales(cache, block_tables, block_size: int):
+    """The scale-sidecar leg of :func:`gather_context`: gather each
+    sequence's per-slot dequantization scales with the SAME slot map
+    the payload gather uses.  Returns ``(k_scale, v_scale)`` of shape
+    (L, B, max_blocks * block_size, H) fp32 — position j is logical
+    token j's scales, garbage slots carry garbage scales that the
+    context bias masks exactly like the payload they scale."""
+    b, mb = block_tables.shape
+    bs = block_size
+    slots = (block_tables[:, :, None] * bs
+             + jnp.arange(bs, dtype=block_tables.dtype)[None, None, :]
+             ).reshape(b, mb * bs)                    # (B, T)
+    return cache["k_scale"][:, slots], cache["v_scale"][:, slots]
+
+
 def context_bias(lengths, max_context: int):
     """(B,) valid-token counts -> (B, T) additive bias: 0 for logical
     slots < length, NEG_INF beyond (covers unwritten slots, freed
@@ -209,12 +374,16 @@ def copy_blocks(cache, src, dst, block_size: int):
 
     src, dst: (M,) int32 physical block ids.  Unused pairs pad with
     (0, 0): copying the garbage block onto itself is a no-op by
-    construction, so the call stays fixed-shape."""
+    construction, so the call stays fixed-shape.
+
+    Copies EVERY cache leaf — under quantization the scale sidecar
+    legs duplicate with their payload in the same program, so a COW
+    clone dequantizes bit-identically to its source block."""
     off = jnp.arange(block_size, dtype=src.dtype)[None, :]
     s = (src[:, None] * block_size + off).reshape(-1)
     d = (dst[:, None] * block_size + off).reshape(-1)
-    return {"k": cache["k"].at[:, d].set(cache["k"][:, s]),
-            "v": cache["v"].at[:, d].set(cache["v"][:, s])}
+    return {name: arr.at[:, d].set(arr[:, s])
+            for name, arr in cache.items()}
 
 
 # ---------------------------------------------------------------------------

@@ -31,6 +31,16 @@ host bookkeeping over block ids; the KV bytes themselves were written
 by whichever request prefilled them first and are bit-identical to
 what any later request would have written (same tokens, same absolute
 positions, same jitted program).
+
+Quantized pools (``docs/serving.md``, "Quantized KV cache") need no
+special handling here: the int8 payload and its fp32 scale sidecar
+are both indexed by the SAME flat slot (block * block_size + offset),
+so a block id in this index names its scales too — registration,
+LRU holds, adoption, eviction, and COW duplication
+(``kv_cache.copy_blocks`` copies every cache leaf) all carry scales
+with their blocks by construction.  Quantization is elementwise and
+deterministic, so the first-writer-wins sharing argument above holds
+byte-for-byte for quantized blocks as well.
 """
 
 from __future__ import annotations

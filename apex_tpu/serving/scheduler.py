@@ -44,7 +44,11 @@ bad drafter must not degrade its neighbors; a draft that doesn't fit
 is trimmed), and :meth:`rollback_lookahead` frees the blocks holding
 only rejected-suffix positions after every verify step, so
 speculation borrows pool space within an iteration instead of
-keeping it.
+keeping it.  Under a quantized pool (``docs/serving.md``, "Quantized
+KV cache") a freed block releases its scale-sidecar rows with it —
+scales are indexed by the same slots — and the rejected-suffix
+garbage (int8 payload AND scales) sits beyond ``num_cached`` where
+the context bias masks it, exactly like the full-width pool's.
 
 The scheduler is pure host-side bookkeeping over the engine's
 geometry; it never touches device arrays.  ``serving.api`` composes it

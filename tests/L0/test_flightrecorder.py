@@ -289,8 +289,16 @@ def test_stats_slo_and_memory_blocks_pinned(tiny):
                 "blocks_evictable_peak", "occupancy", "occupancy_peak",
                 "frag_slots", "frag_frac", "lookahead_granted_blocks",
                 "lookahead_rolled_back_blocks", "pool_bytes",
-                "pool_bytes_per_device", "cache_dtype"} - mem.keys()
+                "pool_bytes_per_device", "bytes_per_block",
+                "cache_dtype", "quantize",
+                "compute_dtype"} - mem.keys()
     assert mem["blocks_live_peak"] >= 1
+    # quantization off on this server: storage == compute dtype, the
+    # per-block price is sidecar-free, and byte totals reconcile
+    assert mem["quantize"] is None
+    assert mem["cache_dtype"] == mem["compute_dtype"]
+    assert mem["pool_bytes"] == \
+        server.engine.cache_cfg.num_blocks * mem["bytes_per_block"]
     assert mem["occupancy_peak"] == pytest.approx(
         mem["blocks_live_peak"] / mem["blocks_usable"], abs=1e-3)
     assert mem["pool_bytes"] > 0

@@ -175,6 +175,32 @@ env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
       python tools/chaos_soak.py --seed 0 --iters 800 --replicas 3
 results[router]=$?
 
+# quantized KV cache: the int8-pool axis (docs/serving.md, "Quantized
+# KV cache") — three gates under the emulated 8-device mesh flags
+# (the L0 tier's tp∈{1,2,4} stability oracle head-shards the scale
+# sidecar):
+#   1. the L0 quant tier: quantize/dequantize unit oracles (absmax
+#      round-trip bound, zero-block guard, bf16/fp32 dequant parity,
+#      Pallas-vs-jnp on int8 inputs), the 64-token decode-parity
+#      tolerance oracle, and quant-on bit-stability across COW /
+#      preemption / eviction / chunked prefill / speculation /
+#      pipeline / tp (slow tier included — this axis owns it);
+#   2. serving_bench --kv-quant: the decode-parity budget (always)
+#      plus the fixed-pool-bytes capacity A/B (>= 1.8x usable-block
+#      headroom net of the fp32 scale sidecar, preemptions/evictions
+#      on the quant arm bounded by the baseline's);
+#   3. an 800-iteration seed-0 chaos soak with kv_quant=int8 in BOTH
+#      the soaked server and the replay oracle — bit-exact replay
+#      proves quantized blocks survive every composed fault.
+echo "=== build-matrix axis: kv-quant ==="
+env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/L0/test_kv_quant.py -q -x --no-header \
+  && env JAX_PLATFORMS=cpu python tools/serving_bench.py --smoke \
+      --kv-quant --out - \
+  && env JAX_PLATFORMS=cpu python tools/chaos_soak.py --seed 0 \
+      --iters 800 --kv-quant
+results[kv_quant]=$?
+
 # chaos soak: the overload-robustness axis (docs/resilience.md,
 # "Overload policy & lifecycle") — the full serving stack (prefix
 # cache + chunked prefill + overload control + circuit breaker, small

@@ -30,6 +30,14 @@ keeps speculation OFF so the legacy axis numbers stay comparable
 across PRs (speculation-on output is bit-identical anyway; this is
 about fault-surface attribution, not correctness).
 
+``--kv-quant`` soaks the int8-quantized KV pool (``docs/serving.md``,
+"Quantized KV cache"): the soaked server AND the replay oracle both
+run ``kv_quant="int8"``, so the bit-exact-replay invariant holds
+unchanged — quantization moves both computations onto the same
+quantized grid, and any divergence means a quantized block's bytes or
+scales were corrupted by a lifecycle path (COW, eviction, rollback,
+preemption re-prefill) rather than by the quantization itself.
+
 The soaked server always runs with a step-level ``FlightRecorder``
 (``docs/observability.md``, "Flight recorder & postmortems") —
 recording never feeds back into scheduler decisions, so the soak's
@@ -184,6 +192,14 @@ def main(argv=None) -> int:
                         help="speculation-enabled traffic class: "
                         "serve with speculative decoding on and mix "
                         "in repetitive prompts so drafts fire")
+    parser.add_argument("--kv-quant", dest="kv_quant",
+                        action="store_true",
+                        help="soak the int8-QUANTIZED KV pool: the "
+                        "soaked server and the replay oracle both "
+                        "run kv_quant='int8', so bit-exact replay "
+                        "proves quantized blocks survive every "
+                        "composed fault (docs/serving.md, "
+                        "'Quantized KV cache')")
     parser.add_argument("--tp", type=int, default=None, metavar="N",
                         help="soak a TENSOR-PARALLEL server: shard "
                         "the soaked server over an N-device mesh "
@@ -302,6 +318,7 @@ def main(argv=None) -> int:
             block_size=4, num_blocks=40,          # 39 usable blocks
             cache_dtype=jnp.float32, max_waiting=8, clock=clock,
             mesh=mesh,
+            kv_quant="int8" if args.kv_quant else None,
             enable_speculation=args.speculative,
             enable_pipeline=args.pipeline,
             flight_recorder=FlightRecorder(
@@ -315,10 +332,14 @@ def main(argv=None) -> int:
 
     def make_replay(clock):
         # roomy pool, unbounded queue, no chaos: the bit-exactness
-        # oracle (every slot can hold a full-context request)
+        # oracle (every slot can hold a full-context request).  With
+        # --kv-quant the oracle is a QUANT-ON replica — the invariant
+        # then proves quantized blocks survive every lifecycle path
+        # bit-consistently, not that quantization is lossless
         return InferenceServer(
             cfg, params, max_batch_size=4, max_context=64,
             block_size=4, cache_dtype=jnp.float32, clock=clock,
+            kv_quant="int8" if args.kv_quant else None,
             enable_speculation=args.speculative,
             enable_pipeline=args.pipeline)
 
@@ -335,6 +356,7 @@ def main(argv=None) -> int:
                       postmortem_dir=args.postmortem_dir)
     report["wall_s"] = round(time.perf_counter() - t0, 2)
     report["tp"] = args.tp or 1
+    report["kv_quant"] = "int8" if args.kv_quant else None
 
     line = json.dumps(report, indent=2, sort_keys=True)
     if args.out == "-":

@@ -115,6 +115,12 @@ def fetch(base, path, timeout):
 
 
 def render_programs(stats) -> None:
+    """The per-compiled-program table.  Keys are per program AND
+    shape (``prefill[64]``, ``verify[5]``); a quantized-pool server
+    tags every key ``q8`` (``decode[q8]``, ``prefill[64q8]`` —
+    docs/serving.md, "Quantized KV cache"), so compile-count audits
+    bound quant-on traces separately from full-width ones when both
+    have run in one process."""
     prog = stats.get("programs", {})
     table = prog.get("by_program", {})
     if not table:

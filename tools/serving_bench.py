@@ -98,6 +98,16 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# --kv-quant gates (docs/serving.md, "Quantized KV cache"; pinned in
+# the BENCH_NOTES kv-quant decision table): the decode-parity budget
+# is the minimum mean agreeing-prefix fraction quant-on greedy decode
+# must keep vs the full-width pool (measured 1.0 on the smoke config —
+# the budget leaves tolerance-oracle margin), and the headroom floor
+# is the usable-live-block multiple a fixed byte budget must buy net
+# of the fp32 scale sidecar (2D/(D+4) per head — 1.88x at head_dim 64)
+KVQ_PARITY_BUDGET = 0.75
+KVQ_HEADROOM_FLOOR = 1.8
+
 
 def build_model(args):
     import jax
@@ -136,6 +146,7 @@ def run_continuous(cfg, params, prompts, args):
         cfg, params, max_batch_size=args.batch_size,
         max_context=args.max_context,
         block_size=args.block_size, cache_dtype=jnp.float32,
+        kv_quant="off",       # the quant axis has its own mode
         # speculation and pipelining are measured by their own modes
         # (--speculative / --pipeline); the continuous-vs-naive record
         # keeps comparing the same synchronous one-token decode it
@@ -229,7 +240,8 @@ def _build_prefix_servers(cfg, params, args):
         return InferenceServer(
             cfg, params, max_batch_size=args.batch_size,
             max_context=args.max_context, block_size=args.block_size,
-            cache_dtype=jnp.float32, enable_prefix_cache=cache,
+            cache_dtype=jnp.float32, kv_quant="off",
+            enable_prefix_cache=cache,
             enable_chunked_prefill=chunk is not None,
             prefill_chunk=chunk,
             # isolate the prefix-cache/chunking axes from speculation
@@ -357,7 +369,8 @@ def _spec_server(cfg, params, args, spec):
     return InferenceServer(
         cfg, params, max_batch_size=args.batch_size,
         max_context=args.max_context, block_size=args.block_size,
-        cache_dtype=jnp.float32, enable_speculation=spec,
+        cache_dtype=jnp.float32, kv_quant="off",
+        enable_speculation=spec,
         spec_tokens=args.spec_tokens,
         # the speculation A/B isolates drafting from loop overlap
         # (--pipeline measures that axis)
@@ -502,7 +515,8 @@ def _pipeline_server(cfg, params, args, on):
     return InferenceServer(
         cfg, params, max_batch_size=args.batch_size,
         max_context=args.max_context, block_size=args.block_size,
-        cache_dtype=jnp.float32, enable_pipeline=on,
+        cache_dtype=jnp.float32, kv_quant="off",
+        enable_pipeline=on,
         # one-token decode in both arms: the pipeline axis measures
         # loop overlap, not speculation
         enable_speculation=False)
@@ -637,7 +651,7 @@ def _tp_server(cfg, params, args, mesh):
     return InferenceServer(
         cfg, params, max_batch_size=args.batch_size,
         max_context=args.max_context, block_size=args.block_size,
-        cache_dtype=jnp.float32, mesh=mesh)
+        cache_dtype=jnp.float32, kv_quant="off", mesh=mesh)
 
 
 def _run_tp_workload(server, prompts, args):
@@ -791,6 +805,210 @@ def run_tp_mode(args):
     return rc
 
 
+def _kvq_server(cfg, params, args, quant, num_blocks=None,
+                cache_dtype=None):
+    import jax.numpy as jnp
+    from apex_tpu.serving import InferenceServer
+
+    # both arms run the full default stack (prefix cache + chunked
+    # prefill + speculation + pipeline): quantization must compose
+    # with everything that ships on, not with a stripped-down loop
+    return InferenceServer(
+        cfg, params, max_batch_size=args.batch_size,
+        max_context=args.max_context, block_size=args.block_size,
+        cache_dtype=(cache_dtype if cache_dtype is not None
+                     else jnp.float32),
+        kv_quant="int8" if quant else "off",
+        num_blocks=num_blocks)
+
+
+def _run_kvq_workload(server, prompts, args):
+    """Drive one arm over the request set, auditing every step;
+    returns (outputs, stats)."""
+    reqs = [server.submit(p, args.max_new) for p in prompts]
+    while server.scheduler.has_work:
+        _step_audited(server)
+    return [list(r.generated) for r in reqs], server.stats()
+
+
+def _lcp(a, b):
+    n = 0
+    for x, y in zip(a, b):
+        if x != y:
+            break
+        n += 1
+    return n
+
+
+def run_kv_quant_mode(args):
+    """The int8-KV-cache A/B (docs/serving.md, "Quantized KV cache").
+    Two gates in one record (``BENCH_serving_kvquant.json``):
+
+    - *decode-parity budget* (ALWAYS asserted, smoke or full):
+      quant-on vs quant-off greedy generations over identical traffic
+      on roomy fp32-compute pools; the agreement metric is the mean
+      agreeing-prefix fraction, floored at the pinned budget
+      (BENCH_NOTES, kv-quant decision table).  Quantization is lossy
+      by design, so this is a tolerance oracle, never bit parity.
+    - *capacity at fixed pool bytes* (the headline): the bf16
+      production pool's byte budget re-spent on int8+scale blocks
+      must yield >= 1.8x usable live-block headroom NET of the fp32
+      scale sidecar — asserted from the config price math AND
+      reconciled against the live arrays' actual bytes — and an
+      over-committed shared-prefix workload on the two equal-byte
+      pools records what the headroom buys: preemptions and
+      prefix-cache evictions on the quantized arm must not exceed
+      the baseline's (the ~2x-concurrency-per-HBM-byte claim,
+      observed rather than asserted from geometry alone).
+    """
+    import jax.numpy as jnp
+
+    from apex_tpu.serving import KVCacheConfig
+
+    cfg, m, params = build_model(args)
+    rng = np.random.RandomState(args.seed + 6)
+    shared = list(rng.randint(0, args.vocab, size=16))
+    prompts = []
+    for i in range(args.requests):
+        if i % 2 == 0:
+            # shared-prefix sessions: the prefix-cache capacity half
+            prompts.append(shared + list(rng.randint(
+                0, args.vocab, size=8)))
+        else:
+            # repetitive tails: the speculation traffic class rides
+            # along, so drafts/rollback run quantized too
+            period = int(rng.randint(1, 4))
+            pat = list(rng.randint(0, args.vocab, size=period))
+            prompts.append((pat * 24)[:24])
+
+    # -- gate 1: the decode-parity tolerance budget (roomy pools) ----
+    on_srv = _kvq_server(cfg, params, args, quant=True)
+    outs_on, stats_on = _run_kvq_workload(on_srv, prompts, args)
+    off_srv = _kvq_server(cfg, params, args, quant=False)
+    outs_off, _ = _run_kvq_workload(off_srv, prompts, args)
+    total = sum(len(o) for o in outs_off)
+    agree = sum(_lcp(a, b) for a, b in zip(outs_on, outs_off))
+    agreement = agree / max(total, 1)
+
+    # -- gate 2: capacity at fixed pool bytes ------------------------
+    bps = -(-args.max_context // args.block_size)
+    # a deliberately TIGHT baseline pool (half of full provisioning):
+    # the regime where HBM bounds concurrency — the premise of the
+    # whole mode
+    base_blocks = args.batch_size * bps // 2 + 1
+    ck = dict(num_layers=args.layers, num_heads=args.heads,
+              head_dim=args.hidden // args.heads,
+              block_size=args.block_size)
+    base_cfg = KVCacheConfig(num_blocks=base_blocks,
+                             dtype=jnp.bfloat16, **ck)
+    budget = base_cfg.bytes()
+    quant_bpb = KVCacheConfig(num_blocks=2, dtype=jnp.bfloat16,
+                              quantize="int8", **ck).bytes_per_block
+    quant_blocks = budget // quant_bpb
+    headroom = (quant_blocks - 1) / (base_blocks - 1)
+
+    base_arm = _kvq_server(cfg, params, args, quant=False,
+                           num_blocks=base_blocks,
+                           cache_dtype=jnp.bfloat16)
+    outs_base, stats_base = _run_kvq_workload(base_arm, prompts, args)
+    quant_arm = _kvq_server(cfg, params, args, quant=True,
+                            num_blocks=quant_blocks,
+                            cache_dtype=jnp.bfloat16)
+    outs_q, stats_q = _run_kvq_workload(quant_arm, prompts, args)
+    # the live arrays must actually fit the budget (price math and
+    # allocation reconcile — no headroom claimed on paper only)
+    live_bytes = stats_q["memory"]["pool_bytes"]
+    assert live_bytes <= budget + quant_bpb, \
+        f"quant pool {live_bytes}B exceeds the {budget}B budget"
+    cap_agree = sum(_lcp(a, b) for a, b in zip(outs_q, outs_base)) \
+        / max(sum(len(o) for o in outs_base), 1)
+
+    def _cap(st):
+        return {
+            "blocks_usable": st["memory"]["blocks_usable"],
+            "pool_bytes": st["memory"]["pool_bytes"],
+            "bytes_per_block": st["memory"]["bytes_per_block"],
+            "preemptions": st["preemptions"],
+            "capacity_failures": st["requests_failed"].get(
+                "requests_failed_capacity", 0),
+            "blocks_live_peak": st["memory"]["blocks_live_peak"],
+            "evicted_blocks": st.get("prefix_evicted_blocks", 0),
+            "evictable_peak":
+                st["memory"]["blocks_evictable_peak"],
+            "prefix_hit_rate": st.get("prefix_hit_rate", 0.0),
+        }
+
+    record = {
+        "bench": "serving_kvquant",
+        "mode": "smoke" if args.smoke else "full",
+        "kv_quant": "int8",
+        "config": {"requests": args.requests, "max_new": args.max_new,
+                   "batch_size": args.batch_size,
+                   "block_size": args.block_size,
+                   "hidden": args.hidden, "layers": args.layers,
+                   "heads": args.heads,
+                   "head_dim": args.hidden // args.heads,
+                   "max_context": args.max_context,
+                   "vocab": args.vocab},
+        # gate 1
+        "token_agreement": round(agreement, 4),
+        "parity_budget": KVQ_PARITY_BUDGET,
+        "quant_speculation":
+            stats_on["speculation"]["accepted_tokens"],
+        # gate 2
+        "pool_budget_bytes": int(budget),
+        "baseline_blocks_usable": base_blocks - 1,
+        "quant_blocks_usable": int(quant_blocks - 1),
+        "live_block_headroom": round(headroom, 3),
+        "headroom_floor": KVQ_HEADROOM_FLOOR,
+        "capacity_token_agreement": round(cap_agree, 4),
+        "baseline_arm": _cap(stats_base),
+        "quant_arm": _cap(stats_q),
+    }
+    print(json.dumps(record))
+
+    out = args.out
+    if out != "-":
+        if out is None:
+            out = os.path.join(
+                os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+                "BENCH_serving_kvquant.json")
+        with open(out, "w") as f:
+            json.dump(record, f, indent=1)
+            f.write("\n")
+
+    rc = 0
+    # the parity budget is ALWAYS checked — a quantization scheme
+    # that moves too many tokens is rejected no matter how much
+    # memory it saves (the BENCH_NOTES decision table)
+    if agreement < KVQ_PARITY_BUDGET:
+        print(f"FAIL: quant-on token agreement {agreement:.3f} < "
+              f"{KVQ_PARITY_BUDGET} parity budget", file=sys.stderr)
+        rc = 1
+    if headroom < KVQ_HEADROOM_FLOOR:
+        print(f"FAIL: live-block headroom {headroom:.2f}x < "
+              f"{KVQ_HEADROOM_FLOOR}x at fixed pool bytes "
+              f"(head_dim {args.hidden // args.heads} — the sidecar "
+              "overhead shrinks as head_dim grows)", file=sys.stderr)
+        rc = 1
+    if args.smoke:
+        # what the headroom must BUY on the over-committed workload:
+        # never more memory churn than the baseline at equal bytes
+        if record["quant_arm"]["preemptions"] > \
+                record["baseline_arm"]["preemptions"]:
+            print("FAIL: quantized arm preempted more than the "
+                  "baseline at the same pool bytes", file=sys.stderr)
+            rc = 1
+        if record["quant_arm"]["evicted_blocks"] > \
+                record["baseline_arm"]["evicted_blocks"]:
+            print("FAIL: quantized arm evicted more cached blocks "
+                  "than the baseline at the same pool bytes",
+                  file=sys.stderr)
+            rc = 1
+    return rc
+
+
 def _router_fleet(cfg, params, args, kind):
     from apex_tpu.serving import RouterFleet, RouterPolicy
 
@@ -805,7 +1023,8 @@ def _router_fleet(cfg, params, args, kind):
                             affinity_block=args.block_size),
         max_batch_size=args.batch_size,
         max_context=args.max_context, block_size=args.block_size,
-        num_blocks=args.router_blocks, cache_dtype=jnp.float32)
+        num_blocks=args.router_blocks, cache_dtype=jnp.float32,
+        kv_quant="off")
 
 
 def _run_router_arm(cfg, params, args, kind, groups):
@@ -1041,6 +1260,14 @@ def main():
                     "continuous-vs-naive compare — emulated CPU "
                     "meshes auto-provision via "
                     "--xla_force_host_platform_device_count")
+    ap.add_argument("--kv-quant", dest="kv_quant",
+                    action="store_true",
+                    help="run the int8-KV-cache A/B (quant-on vs "
+                    "quant-off parity budget + fixed-pool-bytes "
+                    "capacity headroom, >= 1.8x usable-block floor "
+                    "net of the scale sidecar; docs/serving.md, "
+                    "'Quantized KV cache') instead of the "
+                    "continuous-vs-naive compare")
     ap.add_argument("--router", type=int, default=None, metavar="N",
                     help="run the multi-replica placement A/B "
                     "(affinity vs seeded-random routing of grouped "
@@ -1123,6 +1350,21 @@ def main():
             args.heads = 4
             args.max_context = 128
             args.prompt_tokens = 16
+        if args.kv_quant:
+            # head_dim 64 (the TPU-native lane width): the fp32 scale
+            # sidecar costs 4/(64+4) of an int8 block, so the
+            # bf16->int8 headroom (2D/(D+4) = 1.88x) clears the 1.8x
+            # floor; the over-committed capacity workload needs
+            # context room for long completions
+            args.requests = 8
+            args.max_new = 48
+            args.batch_size = 4
+            args.block_size = 8
+            args.vocab = 61
+            args.hidden = 128
+            args.layers = 2
+            args.heads = 2
+            args.max_context = 128
         if args.shared_prefix:
             # the prefix workloads need room for a long shared prefix
             # and a near-max-context prompt; still toy-model CPU-safe
@@ -1165,6 +1407,9 @@ def main():
                 + args.batch_size * (
                     -(-args.max_context // args.block_size)) + 1)
         return run_router_mode(args)
+
+    if args.kv_quant:
+        return run_kv_quant_mode(args)
 
     if args.shared_prefix:
         if args.prefix_len is None:
