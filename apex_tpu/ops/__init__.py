@@ -19,19 +19,27 @@ from apex_tpu.ops.flatten import flatten, unflatten, flatten_like
 from apex_tpu.ops.flash_attention import flash_attention, make_flash_attention
 from apex_tpu.ops.decode_attention import cached_attention
 from apex_tpu.ops.kv_quant import dequantize_kv, quantize_kv
-from apex_tpu.ops.sampling import finite_rows, greedy_argmax
+from apex_tpu.ops.sampling import (
+    SamplingParams,
+    finite_rows,
+    greedy_argmax,
+    sample_tokens,
+)
 from apex_tpu.ops.vocab_parallel import (
     vocab_parallel_argmax,
     vocab_parallel_lm_loss,
     vocab_parallel_sample,
+    vocab_parallel_sample_tokens,
 )
 from apex_tpu.ops import native
 
 __all__ = [
+    "SamplingParams",
     "cached_attention",
     "dequantize_kv",
     "quantize_kv",
     "finite_rows",
+    "sample_tokens",
     "flash_attention",
     "greedy_argmax",
     "make_flash_attention",
@@ -47,4 +55,5 @@ __all__ = [
     "vocab_parallel_argmax",
     "vocab_parallel_lm_loss",
     "vocab_parallel_sample",
+    "vocab_parallel_sample_tokens",
 ]

@@ -45,6 +45,18 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from apex_tpu.ops.sampling import sampling_noise
+
+# per-shard candidate width for the stochastic sampler's threshold
+# merge: each shard nominates its local top-C values, the merge is the
+# only thing (beyond (B,)-shaped scalars) that crosses the model axis.
+# Exactness holds while the kept set lives inside the global top-C
+# (always true for top_k <= C; true for top_p whenever the nucleus
+# fits in C tokens — the realistic serving regime by orders of
+# magnitude).  top_k is CLAMPED to C on the sharded path (documented;
+# the unsharded sampler honors any k).
+SHARD_CANDIDATES = 128
+
 
 def _shard_map(f, mesh, in_specs, out_specs, axis: str):
     """Partial-manual ``shard_map`` across jax API generations: the
@@ -161,6 +173,160 @@ def vocab_parallel_argmax(logits, mesh, axis: str = "model"):
     included.  (Under jit the unused finite guard is dead-code
     eliminated, so this costs nothing over the fused pair.)"""
     return vocab_parallel_sample(logits, mesh, axis)[0]
+
+
+@functools.lru_cache(maxsize=32)
+def _build_sample_tokens(mesh, axis, ndim, true_vocab):
+    """Cached jitted vocab-parallel STOCHASTIC sampler for
+    rank-``ndim`` logits — the no-gather serving twin of
+    :func:`ops.sampling.sample_tokens` (``docs/serving.md``,
+    "Stochastic sampling").  Per shard:
+
+    - greedy rows run the exact :func:`_build_sample` lane (bit-exact
+      argmax + finite guard, lowest-global-id ties);
+    - stochastic rows compute the temperature-scaled local slice, each
+      shard nominates its local top-``SHARD_CANDIDATES`` values, and
+      ONE small ``all_gather`` merges the nominations so every shard
+      derives the same global top-k / nucleus VALUE thresholds (the
+      kth merged value; the nucleus boundary from the merged cumsum
+      against the psum'd global normalizer).  The kept-set mask is
+      then applied shard-locally, per-position counter-keyed Gumbel
+      noise is generated from the SAME ``(V,)`` stream as the
+      unsharded sampler (:func:`ops.sampling.sampling_noise` — noise
+      is compute, not communication; each shard slices its own vocab
+      range), and the winner crosses the axis through the existing
+      three-(…,)-shaped-collective argmax pattern.
+
+    Nothing ``(…, V)``-shaped ever crosses the model axis: the
+    collectives are the candidate merge (``n x SHARD_CANDIDATES``
+    values per row), two scalar reductions (global max, global
+    exp-sum), and the argmax pmax/pmin pair.  ``true_vocab`` is None
+    for an exactly-divisible vocab, else the real width (the -inf
+    padding columns the caller appended are excluded from candidates,
+    thresholds, the finite check, and the noise stream — the noise is
+    generated at the TRUE width so sharded draws match unsharded ones
+    bit-for-bit)."""
+    n = mesh.shape[axis]
+
+    def per_shard(lg, temp, tk, tp_, seed, pos):
+        if jnp.issubdtype(lg.dtype, jnp.floating) \
+                and jnp.finfo(lg.dtype).bits < 32:
+            lg = lg.astype(jnp.float32)
+        vshard = lg.shape[-1]
+        v_pad = vshard * n
+        v_true = true_vocab if true_vocab is not None else v_pad
+        off = lax.axis_index(axis) * vshard
+        gidx = (lax.broadcasted_iota(jnp.int32, lg.shape, lg.ndim - 1)
+                + off)
+        valid = gidx < v_true
+
+        # -- greedy lane: byte-for-byte _build_sample ------------------
+        gmax_raw = lax.pmax(jnp.max(lg, axis=-1, keepdims=True), axis)
+        cand_g = jnp.min(jnp.where((lg == gmax_raw) & valid, gidx,
+                                   jnp.int32(v_pad)), axis=-1)
+        ids_g = jnp.minimum(lax.pmin(cand_g, axis),
+                            v_true - 1).astype(jnp.int32)
+        row_nan = lax.pmax(
+            jnp.any(jnp.isnan(lg) & valid, axis=-1).astype(jnp.int32),
+            axis) > 0
+        ids_g = jnp.where(row_nan, jnp.int32(v_true - 1), ids_g)
+        fin = lax.pmin(
+            jnp.all(jnp.isfinite(lg) | ~valid, axis=-1)
+            .astype(jnp.int32), axis).astype(bool)
+
+        # -- stochastic lane -------------------------------------------
+        t = jnp.maximum(temp, 1e-6)[..., None]
+        scaled = jnp.where(valid, lg / t, -jnp.inf)
+        c = min(vshard, SHARD_CANDIDATES)
+        local_top = lax.top_k(scaled, c)[0]              # (…, C) desc
+        cand = lax.all_gather(local_top, axis,
+                              axis=lg.ndim - 1, tiled=True)
+        merged = -jnp.sort(-cand, axis=-1)               # (…, nC) desc
+        nc = merged.shape[-1]
+        gmax = merged[..., :1]                           # global max
+        z = lax.psum(
+            jnp.sum(jnp.where(valid, jnp.exp(scaled - gmax), 0.0),
+                    axis=-1), axis)
+        k = jnp.clip(jnp.where(tk <= 0, 1, tk), 1, c)
+        kth = jnp.take_along_axis(merged, (k - 1)[..., None], axis=-1)
+        kth = jnp.where((tk <= 0)[..., None], -jnp.inf, kth)
+        cum = jnp.cumsum(jnp.exp(merged - gmax), axis=-1) \
+            / z[..., None]
+        bnd = jnp.minimum(
+            jnp.sum((cum < tp_[..., None]).astype(jnp.int32), axis=-1,
+                    keepdims=True), nc - 1)
+        pth = jnp.take_along_axis(merged, bnd, axis=-1)
+        pth = jnp.where((tp_ >= 1.0)[..., None], -jnp.inf, pth)
+        thresh = jnp.maximum(kth, pth)
+        keep = valid & (scaled >= thresh)
+        # the unsharded noise stream, generated at the TRUE vocab
+        # width on every shard (identical bits), -inf-padded to the
+        # padded width, then sliced to this shard's range
+        g = sampling_noise(seed, pos, v_true)
+        if v_pad > v_true:
+            g = jnp.concatenate(
+                [g, jnp.full(g.shape[:-1] + (v_pad - v_true,),
+                             -jnp.inf, g.dtype)], axis=-1)
+        g_loc = lax.dynamic_slice_in_dim(g, off, vshard, axis=-1)
+        noisy = jnp.where(keep, scaled + g_loc, -jnp.inf)
+        m = lax.pmax(jnp.max(noisy, axis=-1, keepdims=True), axis)
+        cand_s = jnp.min(jnp.where((noisy == m) & keep, gidx,
+                                   jnp.int32(v_pad)), axis=-1)
+        ids_s = jnp.minimum(lax.pmin(cand_s, axis),
+                            v_true - 1).astype(jnp.int32)
+
+        ids = jnp.where(temp <= 0.0, ids_g, ids_s)
+        return ids.astype(jnp.int32), fin
+
+    vspec = P(*([None] * (ndim - 1) + [axis]))
+    pspec = P()
+    return jax.jit(_shard_map(
+        per_shard, mesh,
+        (vspec, pspec, pspec, pspec, pspec, pspec), (P(), P()), axis))
+
+
+def vocab_parallel_sample_tokens(logits, temperature, top_k, top_p,
+                                 seeds, positions, mesh,
+                                 axis: str = "model"):
+    """Stochastic sampling over vocab-sharded logits — the
+    tensor-parallel twin of :func:`ops.sampling.sample_tokens`, fused
+    into the serving engine's sampled programs so TP decode never
+    materializes (or gathers) full logits for stochastic traffic
+    either (``serving.engine.DecodeEngine(mesh=...)``).
+
+    Semantics: greedy rows (``temperature <= 0``) are bit-exact
+    :func:`vocab_parallel_sample` (itself bit-exact
+    :func:`ops.greedy_argmax`); stochastic rows draw via the same
+    counter-keyed Gumbel-max as the unsharded sampler, over the same
+    value-threshold keep set, with the same per-position noise stream
+    — so sharded and unsharded token streams agree, ties and all,
+    whenever the kept set lives inside the global
+    top-:data:`SHARD_CANDIDATES` (``tests/L0/test_sampling.py``
+    asserts tp∈{2,4} parity).  Documented caps of the no-gather path:
+    ``top_k`` clamps to :data:`SHARD_CANDIDATES`, and a nucleus wider
+    than the merged candidate set truncates to it (both far outside
+    the serving regime; the unsharded sampler is exact at any width).
+
+    ``logits``: ``(…, V)`` floating point; params/seeds/positions
+    ``(…,)`` as in :func:`ops.sampling.sample_tokens`.  A vocab that
+    does not divide the ``axis`` size is padded here with -inf columns
+    exactly like :func:`vocab_parallel_sample`.  Returns
+    ``(ids (…,) int32, finite (…,) bool)``, replicated."""
+    v = logits.shape[-1]
+    n = mesh.shape[axis]
+    pad, true_vocab = (-v) % n, None
+    if pad:
+        true_vocab = v
+        widths = [(0, 0)] * (logits.ndim - 1) + [(0, pad)]
+        logits = jnp.pad(logits, widths, constant_values=-jnp.inf)
+    f = _build_sample_tokens(mesh, axis, logits.ndim, true_vocab)
+    b = logits.shape[:-1]
+    return f(logits,
+             jnp.broadcast_to(temperature, b).astype(jnp.float32),
+             jnp.broadcast_to(top_k, b).astype(jnp.int32),
+             jnp.broadcast_to(top_p, b).astype(jnp.float32),
+             jnp.broadcast_to(seeds, b).astype(jnp.int32),
+             jnp.broadcast_to(positions, b).astype(jnp.int32))
 
 
 @functools.lru_cache(maxsize=32)

@@ -71,7 +71,13 @@ ROUTER_TERMINAL_REASONS = TERMINAL_REASONS | {"replica_failed"}
 
 @dataclasses.dataclass(frozen=True)
 class Arrival:
-    """One scheduled request: submitted at iteration ``iter``."""
+    """One scheduled request: submitted at iteration ``iter``.
+
+    ``sampling`` is the stochastic-traffic class's parameter tuple
+    ``(temperature, top_k_or_None, top_p, seed)`` (None = greedy, the
+    historical default) — kept as a plain tuple so the schedule stays
+    import-light; :func:`_sampling_params` inflates it to a
+    ``SamplingParams`` at submit time."""
 
     iter: int
     prompt: Tuple[int, ...]
@@ -79,6 +85,18 @@ class Arrival:
     priority: int
     deadline_iters: Optional[int]
     deadline_s: Optional[float]
+    sampling: Optional[Tuple] = None
+
+
+def _sampling_params(sampling: Optional[Tuple]):
+    """Inflate an :class:`Arrival`'s sampling tuple (lazy import: this
+    module must not pull the serving/ops stack at module scope)."""
+    if sampling is None:
+        return None
+    from apex_tpu.ops.sampling import SamplingParams
+
+    t, k, p, s = sampling
+    return SamplingParams(temperature=t, top_k=k, top_p=p, seed=s)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -108,6 +126,21 @@ class ChaosConfig:
     # (no extra RNG draws).
     repetitive_rate: float = 0.0
     repetitive_period: Tuple[int, int] = (1, 4)
+
+    # stochastic-sampling traffic class (docs/serving.md, "Stochastic
+    # sampling"): this fraction of arrivals carries per-request
+    # temperature/top-k/top-p params with a seeded per-request PRNG
+    # seed — so stochastic requests soak the sampled-stochastic
+    # programs, the rejection-sampling acceptance path, and the
+    # counter-key determinism (the bit-exact-replay oracle holds
+    # UNCHANGED: the Gumbel-max coupling makes the stream a pure
+    # function of (prompt, params, seed)).  The default 0.0 keeps
+    # legacy (config, seed) schedules byte-identical (no extra RNG
+    # draws).
+    stochastic_rate: float = 0.0
+    stochastic_temperature: Tuple[float, float] = (0.3, 1.2)
+    stochastic_top_k: Tuple = (None, None, 8, 2)
+    stochastic_top_p: Tuple = (1.0, 0.95, 0.8)
 
     # request shape: priority classes (0 = foreground .. lowest) and
     # random deadlines (iteration budget; wall budget on the soak's
@@ -185,10 +218,22 @@ class ChaosSchedule:
                     if rng.random() < cfg.deadline_iters_rate else None)
             d_s = (rng.uniform(*cfg.deadline_s)
                    if rng.random() < cfg.deadline_s_rate else None)
+            sampling = None
+            if cfg.stochastic_rate \
+                    and rng.random() < cfg.stochastic_rate:
+                # per-request temperature/top-k/top-p mix, seeded: the
+                # stream stays a pure function of (prompt, params,
+                # seed), so the replay oracle holds bit-exactly
+                sampling = (
+                    round(rng.uniform(*cfg.stochastic_temperature), 3),
+                    rng.choice(cfg.stochastic_top_k),
+                    rng.choice(cfg.stochastic_top_p),
+                    rng.randrange(1 << 31))
             return Arrival(iter=i, prompt=tuple(prompt),
                            max_new_tokens=rng.randint(*cfg.max_new),
                            priority=rng.randint(0, cfg.priority_max),
-                           deadline_iters=d_it, deadline_s=d_s)
+                           deadline_iters=d_it, deadline_s=d_s,
+                           sampling=sampling)
 
         arrivals: Dict[int, List[Arrival]] = {}
         nonfinite: Set[int] = set()
@@ -316,30 +361,36 @@ class ChaosEngine:
     # materialization, so injection never collapses the dispatch-ahead
     # window it is trying to fault.
 
-    def prefill_sampled(self, tokens, block_table):
+    def prefill_sampled(self, tokens, block_table, sampling=None):
         self._oom_gate()
-        return self.inner.prefill_sampled(tokens, block_table)
+        return self.inner.prefill_sampled(tokens, block_table,
+                                          sampling=sampling)
 
     def chunk_prefill_sampled(self, tokens, start, block_table,
-                              pad_to=None):
+                              pad_to=None, sampling=None):
         self._oom_gate()
         return self.inner.chunk_prefill_sampled(tokens, start,
                                                 block_table,
-                                                pad_to=pad_to)
+                                                pad_to=pad_to,
+                                                sampling=sampling)
 
-    def decode_sampled(self, tokens, positions, tables):
+    def decode_sampled(self, tokens, positions, tables,
+                       sampling=None):
         self._oom_gate()
-        ids, fin = self.inner.decode_sampled(tokens, positions, tables)
+        ids, fin = self.inner.decode_sampled(tokens, positions,
+                                             tables, sampling=sampling)
         if self.iter in self.schedule.nonfinite_iters:
             row = self.rng.randrange(int(fin.shape[0]))
             fin = fin.at[row].set(False)
             self.injected["nonfinite_rows"] += 1
         return ids, fin
 
-    def verify_sampled(self, tokens, lengths, positions, tables):
+    def verify_sampled(self, tokens, lengths, positions, tables,
+                       sampling=None):
         self._oom_gate()
         ids, fin = self.inner.verify_sampled(tokens, lengths,
-                                             positions, tables)
+                                             positions, tables,
+                                             sampling=sampling)
         if self.iter in self.schedule.nonfinite_iters:
             # one slot's whole flag row — the same blast radius as
             # NaN-ing its (K, V) logits block on the logits path
@@ -692,7 +743,9 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
                 req = server.submit(list(a.prompt), a.max_new_tokens,
                                     priority=a.priority,
                                     deadline_iters=a.deadline_iters,
-                                    deadline_s=a.deadline_s)
+                                    deadline_s=a.deadline_s,
+                                    sampling=_sampling_params(
+                                        a.sampling))
                 tracked[req.uid] = (req, a)
             try:
                 chaos.begin_iter(i)
@@ -735,25 +788,31 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
         _postmortem_and_reraise(e)
 
     # invariant 5: bit-exact healthy outputs / prefixes vs an
-    # unfaulted replay of the same prompts (greedy decoding makes the
-    # comparison an equality, not a tolerance)
+    # unfaulted replay of the same prompts.  Greedy decoding makes
+    # the comparison an equality — and so does stochastic sampling:
+    # counter-based keys make each stream a pure function of
+    # (prompt, params, seed), so the replay key carries the sampling
+    # tuple and equality still means "the fault surface never
+    # corrupted a token", not a tolerance
     make_replay = make_replay or make_server
     replay = make_replay(lambda: 0.0)
     outputs: Dict[Tuple, List[int]] = {}
     by_budget: Dict[int, List[Tuple]] = {}
     for req, a in tracked.values():
-        key = (a.prompt, req.max_new_tokens)
+        key = (a.prompt, req.max_new_tokens, a.sampling)
         if key not in outputs:
             outputs[key] = None
             by_budget.setdefault(req.max_new_tokens, []).append(key)
     for budget, keys in sorted(by_budget.items()):
-        outs = replay.generate([list(k[0]) for k in keys], budget)
+        outs = replay.generate(
+            [list(k[0]) for k in keys], budget,
+            sampling=[_sampling_params(k[2]) for k in keys])
         for key, out in zip(keys, outs):
             outputs[key] = out
     checked = prefix_checked = 0
     try:
         for req, a in tracked.values():
-            ref = outputs[(a.prompt, req.max_new_tokens)]
+            ref = outputs[(a.prompt, req.max_new_tokens, a.sampling)]
             if req.finish_reason in HEALTHY_REASONS:
                 assert list(req.generated) == ref, \
                     (f"healthy request {req.uid} diverged from replay: "
@@ -818,6 +877,10 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
         oom_events=stats["oom_events"],
         speculation=stats["speculation"]["enabled"],
         acceptance_rate=stats["speculation"]["acceptance_rate"],
+        sampling_requests=stats["sampling"]["requests"],
+        stoch_acceptance_rate=stats["sampling"]["rejection"][
+            "acceptance_rate"],
+        stoch_resamples=stats["sampling"]["rejection"]["resamples"],
         drafted_tokens=stats["speculation"]["drafted_tokens"],
         tokens_per_engine_step=stats["speculation"][
             "tokens_per_engine_step"],

@@ -40,6 +40,18 @@ serve".  Three layers, bottom-up:
   argmax plus the model's next token, so output is bit-identical to
   one-token decode while repetitive traffic decodes several tokens
   per engine step;
+- on-device stochastic sampling (``docs/serving.md``, "Stochastic
+  sampling"): per-request :class:`~apex_tpu.ops.sampling.SamplingParams`
+  (temperature / top-k / top-p / seed; default greedy, bit-identical
+  to the historical argmax path) sample INSIDE the fused programs
+  with counter-based PRNG keys — streams are pure functions of
+  (prompt, params, seed), so same-seed replay, preemption resume,
+  and the chaos oracle stay byte-exact — and speculation generalizes
+  to stochastic drafts via rejection sampling (Gumbel-max coupling:
+  accept a draft iff it equals the column's own sample), so sampled
+  traffic keeps BOTH fast paths instead of falling back to the
+  synchronous logits path (a legacy custom ``sample_fn`` still
+  forces the fallback, now with a loud warning);
 - tensor-parallel sharded serving (``docs/serving.md``,
   "Tensor-parallel serving"): pass ``mesh=`` (+ optional
   ``tp_rules=``) and the engine lowers every compiled program through
@@ -91,6 +103,7 @@ bucket/recompile tradeoff; ``tools/serving_bench.py`` measures
 continuous batching against naive one-request-at-a-time decoding.
 """
 
+from apex_tpu.ops.sampling import SamplingParams
 from apex_tpu.serving.api import InferenceServer, greedy_sample
 from apex_tpu.serving.engine import DecodeEngine, default_prefill_buckets
 from apex_tpu.serving.kv_cache import (
@@ -128,6 +141,7 @@ __all__ = [
     "RouterFleet",
     "RouterPolicy",
     "RouterRequest",
+    "SamplingParams",
     "Scheduler",
     "default_prefill_buckets",
     "dequantize_kv",

@@ -117,7 +117,8 @@ import contextlib
 import faulthandler
 import os
 import time
-from typing import Callable, Dict, List, Optional, Sequence
+import warnings
+from typing import Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
@@ -137,6 +138,7 @@ from apex_tpu.observability import (
     get_tracer,
     write_postmortem,
 )
+from apex_tpu.ops.sampling import SamplingParams, sample_tokens_host
 from apex_tpu.resilience.breaker import CircuitBreaker
 from apex_tpu.serving.engine import DecodeEngine
 from apex_tpu.serving.kv_cache import KV_QUANT_ENV, resolve_kv_quant
@@ -245,8 +247,15 @@ class InferenceServer:
     per-slot per-head scale sidecar; ``APEX_TPU_KV_QUANT=int8`` is
     its env twin, the kwarg wins — ``docs/serving.md``, "Quantized
     KV cache"):
-      sample_fn: (…, V) numpy logits -> (…,) token ids; default
-        greedy.  Runs on host — per-step logits are (B, V).
+      sample_fn: LEGACY escape hatch — (…, V) numpy logits -> (…,)
+        token ids, run on host with per-step (B, V) logits.  Passing
+        one warns loudly: it forces the synchronous logits path
+        (speculation + pipeline OFF) and ignores per-request
+        ``SamplingParams``.  For temperature/top-k/top-p use
+        ``submit(..., sampling=SamplingParams(...))`` instead — the
+        on-device sampling suite keeps both fast paths ON with
+        deterministic counter-keyed streams (``docs/serving.md``,
+        "Stochastic sampling").
       max_waiting: bound on the waiting queue; a submit past it comes
         back already finished with ``finish_reason="rejected"``
         (explicit backpressure at the front door).
@@ -269,12 +278,14 @@ class InferenceServer:
         ``spec_tokens`` guesses through the fixed-width verify program
         and keep the longest prefix matching the model's own argmax,
         plus the model's next token — up to ``spec_tokens + 1`` tokens
-        per engine step, bit-identical output by construction.  Greedy
-        only: a custom ``sample_fn`` disables speculation (the
-        acceptance rule compares against argmax; under real sampling
-        it would silently change the output distribution).  Opt out
-        for strictly non-repetitive traffic where drafting is pure
-        overhead.
+        per engine step, bit-identical output by construction.
+        Stochastic requests (``SamplingParams``) keep speculation ON
+        via rejection sampling — acceptance compares drafts against
+        each column's counter-keyed sample, so the output
+        distribution (and, by the Gumbel-max coupling, the exact
+        stream) is unchanged.  A legacy custom ``sample_fn`` still
+        disables speculation, loudly.  Opt out for strictly
+        non-repetitive traffic where drafting is pure overhead.
       spec_tokens: max drafted tokens per verify step (default 4); the
         verify program is ``spec_tokens + 1`` columns wide and
         compiles once.
@@ -284,11 +295,13 @@ class InferenceServer:
         and their results are retired at the START of the next
         iteration, so host scheduling overlaps device compute and the
         per-step transfer is token ids, not logits.  Output is
-        bit-identical to the synchronous loop (greedy argmax is
-        order-independent; every host decision sees post-retire
-        state).  Greedy only: a custom ``sample_fn`` needs the logits
-        on host and falls back to the synchronous path unchanged.
-        Opt out to restore the strictly serial loop.
+        bit-identical to the synchronous loop (sampling — argmax or
+        counter-keyed stochastic — is computed by the same rule on
+        device; every host decision sees post-retire state).
+        Stochastic requests keep the pipeline ON; a legacy custom
+        ``sample_fn`` needs the logits on host and falls back to the
+        synchronous path, loudly.  Opt out to restore the strictly
+        serial loop.
       draft_source: the :class:`serving.speculation.DraftSource`
         proposing drafts (default: zero-weight
         :class:`~serving.speculation.NgramDraft` prompt-lookup over
@@ -471,6 +484,29 @@ class InferenceServer:
             overload=self.overload_policy,
             tracer=self.tracer)
         self.sample_fn = sample_fn or greedy_sample
+        if self.sample_fn is not greedy_sample:
+            # the historical escape hatch, now a LOUD downgrade: a
+            # custom sample_fn needs materialized host logits, which
+            # turns OFF speculative decoding AND the pipelined loop
+            # and ignores per-request SamplingParams.  The supported
+            # stochastic path (docs/serving.md, "Stochastic
+            # sampling") keeps both fast paths on.
+            warnings.warn(
+                "custom sample_fn disables the serving fast paths: "
+                "speculative decoding and the pipelined "
+                "(dispatch-ahead) serve loop fall back to the "
+                "synchronous logits path, and per-request "
+                "SamplingParams are ignored.  Pass "
+                "SamplingParams(temperature=..., top_k=..., "
+                "top_p=..., seed=...) per request instead — the "
+                "on-device sampling suite keeps speculation and the "
+                "pipeline ON (docs/serving.md, 'Stochastic "
+                "sampling').", UserWarning, stacklevel=2)
+        # per-class request accounting for stats()["sampling"]
+        # (greedy / temperature / top_k / top_p / top_k_top_p)
+        self.sampling_classes = CounterMeter(
+            registry=self.registry, name="serving_sampling_requests",
+            label="class")
         self.clock = clock
         # speculation (docs/serving.md): greedy-only by contract — the
         # acceptance rule compares drafts against argmax rows, which
@@ -602,7 +638,8 @@ class InferenceServer:
                eos_id: Optional[int] = None, *,
                priority: int = 0,
                deadline_iters: Optional[int] = None,
-               deadline_s: Optional[float] = None) -> Request:
+               deadline_s: Optional[float] = None,
+               sampling: Optional[SamplingParams] = None) -> Request:
         """Enqueue one request.
 
         ``max_new_tokens`` must be >= 1 and a prompt that leaves no
@@ -614,6 +651,15 @@ class InferenceServer:
         overload — :mod:`serving.overload`).  Optional
         ``deadline_iters`` / ``deadline_s`` expire the request to
         ``finish_reason="timeout"``.
+
+        ``sampling``: per-request :class:`SamplingParams`
+        (temperature / top-k / top-p / seed; default greedy,
+        bit-identical to the historical argmax path).  Stochastic
+        requests keep BOTH fast paths — speculation and the pipelined
+        loop — and are deterministic per (prompt, params, seed)
+        thanks to counter-based keys (``docs/serving.md``,
+        "Stochastic sampling").  Ignored (with a construction-time
+        warning) when the server runs a legacy custom ``sample_fn``.
 
         A request can come back already finished instead of enqueued
         — always with ``finished_at`` stamped at submission and never
@@ -629,10 +675,11 @@ class InferenceServer:
             return self._submit(prompt, max_new_tokens, eos_id,
                                 priority=priority,
                                 deadline_iters=deadline_iters,
-                                deadline_s=deadline_s)
+                                deadline_s=deadline_s,
+                                sampling=sampling)
 
     def _submit(self, prompt, max_new_tokens, eos_id, *, priority,
-                deadline_iters, deadline_s) -> Request:
+                deadline_iters, deadline_s, sampling=None) -> Request:
         """The :meth:`submit` body (runs under the ops lock when the
         HTTP ops plane is attached)."""
         if self._closed:
@@ -655,6 +702,11 @@ class InferenceServer:
             raise ValueError(
                 f"prompt length {len(prompt)} leaves no room to "
                 f"generate within max_context={self.engine.max_context}")
+        if sampling is not None and not isinstance(sampling,
+                                                   SamplingParams):
+            raise TypeError(
+                f"sampling must be a SamplingParams (or None for "
+                f"greedy), got {type(sampling).__name__}")
         req = Request(prompt=prompt,
                       max_new_tokens=min(int(max_new_tokens), cap),
                       eos_id=eos_id,
@@ -662,7 +714,10 @@ class InferenceServer:
                       deadline_iters=deadline_iters,
                       deadline_s=deadline_s,
                       submit_iter=self._iter,
-                      submitted_at=self.clock())
+                      submitted_at=self.clock(),
+                      sampling=sampling if sampling is not None
+                      else SamplingParams())
+        self.sampling_classes.incr(req.sampling.klass)
         if self.tracer.enabled:
             self.tracer.instant("request_enqueue", uid=req.uid,
                                 prompt_tokens=len(prompt),
@@ -814,6 +869,16 @@ class InferenceServer:
         pipelined = self.pipelining
         for req in [r for r in sched._admit_order if r.prefilling]:
             tokens, start, is_last = sched.prefill_plan(req)
+            # the per-request stochastic params ride the fused twin
+            # only when this launch's token will actually be sampled
+            # (final chunk of a fresh prefill) — mid-prefill chunks
+            # and preemption re-prefills keep the greedy program
+            samp1 = (sched.prefill_sampling(req)
+                     if pipelined and is_last and req.prefill_sample
+                     else None)
+            # kwarg omitted when greedy so duck-typed engine wrappers
+            # predating the stochastic twins keep working
+            skw = {"sampling": samp1} if samp1 is not None else {}
             try:
                 if (start == 0 and is_last
                         and self.prefill_chunk is None):
@@ -823,7 +888,8 @@ class InferenceServer:
                     with tr.span("prefill", uid=req.uid,
                                  tokens=len(tokens)):
                         out = (engine.prefill_sampled(
-                            tokens, req.block_table) if pipelined
+                            tokens, req.block_table,
+                            **skw) if pipelined
                             else engine.prefill(tokens,
                                                 req.block_table))
                 else:
@@ -831,7 +897,8 @@ class InferenceServer:
                                  tokens=len(tokens), start=start):
                         out = (engine.chunk_prefill_sampled(
                             tokens, start, req.block_table,
-                            pad_to=self.prefill_chunk) if pipelined
+                            pad_to=self.prefill_chunk,
+                            **skw) if pipelined
                             else engine.chunk_prefill(
                                 tokens, start, req.block_table,
                                 pad_to=self.prefill_chunk))
@@ -866,7 +933,7 @@ class InferenceServer:
                     if self.breaker is not None:
                         self.breaker.record_failure()
                     continue
-                tok = int(self.sample_fn(logits))
+                tok = self._sample_prefill_host(req, logits)
             req.record_token(tok)
             self._note_first_token(req)
             produced += 1
@@ -986,6 +1053,23 @@ class InferenceServer:
                     self._auto_postmortem("breaker_open")
         return produced
 
+    def _sample_prefill_host(self, req, logits) -> int:
+        """Sample one request's prefill token from materialized
+        ``(V,)`` logits — the synchronous loop's half of the sampling
+        contract.  Greedy requests (and every request on a legacy
+        custom ``sample_fn``) keep the historical ``sample_fn`` call
+        byte-for-byte; stochastic requests draw through the SAME
+        jitted :func:`ops.sample_tokens` the fused programs use, with
+        the same counter key (the token's sequence index ==
+        ``num_cached`` after the final chunk accounted), so the two
+        loops emit identical streams."""
+        if req.sampling.is_greedy or self.sample_fn is not greedy_sample:
+            return int(self.sample_fn(logits))
+        samp = self.scheduler.prefill_sampling(req)
+        counter = np.asarray([req.num_cached], np.int32)
+        ids, _fin = sample_tokens_host(logits[None], *samp, counter)
+        return int(np.asarray(ids)[0])
+
     def _decode_inputs(self, running):
         """The decode launch arrays — (tokens, positions, tables),
         inactive slots zeroed — shared by the synchronous and
@@ -1019,7 +1103,20 @@ class InferenceServer:
             return 0
         self.spec.incr("decode_steps")
         finite = np.all(np.isfinite(logits), axis=-1)
-        toks = self.sample_fn(logits)
+        samp = (self.scheduler.sampling_inputs(running)
+                if self.sample_fn is greedy_sample else None)
+        if samp is None:
+            toks = self.sample_fn(logits)
+        else:
+            # the synchronous stochastic path: the SAME jitted
+            # sampler as the fused twin, fed the same counter keys
+            # (each slot's next sequence index), so sync and
+            # pipelined streams agree byte-for-byte
+            counters = np.zeros((logits.shape[0],), np.int32)
+            for req in running:
+                counters[req.slot] = req.num_cached + 1
+            toks = np.asarray(sample_tokens_host(
+                logits, *samp, counters)[0])
         return self._apply_decode_results(running, toks, finite)
 
     def _launch_decode(self, running) -> bool:
@@ -1031,11 +1128,16 @@ class InferenceServer:
         path)."""
         sched, engine, tr = self.scheduler, self.engine, self.tracer
         tokens, positions, tables = self._decode_inputs(running)
+        samp = sched.sampling_inputs(running)
+        # the kwarg is omitted on all-greedy launches so duck-typed
+        # engine wrappers (chaos injection, tests) predating the
+        # stochastic twins keep working unchanged
+        kw = {"sampling": samp} if samp is not None else {}
         try:
             with tr.span("launch", program="decode",
                          batch=len(running)):
                 ids, fin = engine.decode_sampled(tokens, positions,
-                                                 tables)
+                                                 tables, **kw)
         except MemoryError:
             self._note_oom("decode")
             return False
@@ -1170,7 +1272,23 @@ class InferenceServer:
             return 0
         self.spec.incr("verify_steps")
         finite = np.all(np.isfinite(logits), axis=-1)      # (B, K)
-        row_toks = self.sample_fn(logits)                  # (B, K)
+        samp = (self.scheduler.sampling_inputs(running)
+                if self.sample_fn is greedy_sample else None)
+        if samp is None:
+            row_toks = self.sample_fn(logits)              # (B, K)
+        else:
+            # every verify column sampled with its own positional
+            # counter key — acceptance below compares drafts to these
+            # samples, which IS rejection sampling (the Gumbel-max
+            # coupling, ops.sample_tokens) and keeps the stream
+            # identical to plain decode
+            b, kw = logits.shape[:2]
+            counters = (positions[:, None].astype(np.int32) + 1
+                        + np.arange(kw, dtype=np.int32)[None, :])
+            samp2 = tuple(np.broadcast_to(a[:, None], (b, kw))
+                          for a in samp)
+            row_toks = np.asarray(sample_tokens_host(
+                logits, *samp2, counters)[0])
         return self._apply_verify_results(running, drafts, lengths,
                                           row_toks, finite)
 
@@ -1185,12 +1303,15 @@ class InferenceServer:
         sched, engine, tr = self.scheduler, self.engine, self.tracer
         tokens, lengths, positions, tables = self._verify_inputs(
             running, drafts)
+        samp = sched.sampling_inputs(running)
+        kw = {"sampling": samp} if samp is not None else {}
         try:
             with tr.span("launch", program="verify",
                          batch=len(running),
                          drafted=sum(len(v) for v in drafts.values())):
                 ids, fin = engine.verify_sampled(tokens, lengths,
-                                                 positions, tables)
+                                                 positions, tables,
+                                                 **kw)
         except MemoryError:
             self._note_oom("verify")
             for req in running:
@@ -1242,11 +1363,19 @@ class InferenceServer:
                 accepted += 1
                 if req.finished:
                     break
+            resampled = False
             if not req.finished:
-                # the model's own next token — the argmax after the
+                # the model's own next token — the sample after the
                 # last accepted token, exactly what a one-token decode
-                # would sample there (its K/V is NOT yet written; it
-                # becomes the pending token, same as decode)
+                # would draw there (its K/V is NOT yet written; it
+                # becomes the pending token, same as decode).  Under
+                # greedy this is the argmax correction; under
+                # stochastic sampling a draft rejection makes it the
+                # residual resample of rejection sampling (the
+                # Gumbel-max coupling: the column's own sample, which
+                # conditional on differing from the draft is exactly
+                # the normalized-residual draw)
+                resampled = accepted < len(d)
                 req.record_token(int(toks[accepted]))
                 self._note_first_token(req)
                 produced += 1
@@ -1257,6 +1386,14 @@ class InferenceServer:
                 self.spec.incr("accepted_tokens", accepted)
                 self.spec_drafted_hist.record(len(d))
                 self.spec_accepted_hist.record(accepted)
+                if not req.sampling.is_greedy:
+                    # the stats()["sampling"]["rejection"] block:
+                    # stochastic drafts accepted with prob p(draft),
+                    # each rejection emitting one residual resample
+                    self.spec.incr("stoch_drafted_tokens", len(d))
+                    self.spec.incr("stoch_accepted_tokens", accepted)
+                    if resampled:
+                        self.spec.incr("stoch_resamples")
             if req.finished:
                 sched.retire(req)
                 if self.breaker is not None:
@@ -1443,19 +1580,35 @@ class InferenceServer:
                  priority: int = 0,
                  deadline_iters: Optional[int] = None,
                  deadline_s: Optional[float] = None,
+                 sampling: Union[SamplingParams,
+                                 Sequence[Optional[SamplingParams]],
+                                 None] = None,
                  return_requests: bool = False):
         """Generate completions for ``prompts`` (token-id lists) and
         return the generated ids per prompt, in input order.
+
+        ``sampling``: one :class:`SamplingParams` for every prompt, or
+        a per-prompt sequence (None entries = greedy) — the batch
+        twin of :meth:`submit`'s ``sampling``.
 
         A request that fails (capacity / timeout / rejected / shed /
         nonfinite) contributes whatever it generated before failing —
         inspect ``finish_reason`` via ``return_requests=True`` to tell
         a clean completion from an isolated failure."""
+        if sampling is None or isinstance(sampling, SamplingParams):
+            per_prompt = [sampling] * len(prompts)
+        else:
+            per_prompt = list(sampling)
+            if len(per_prompt) != len(prompts):
+                raise ValueError(
+                    f"sampling sequence length {len(per_prompt)} != "
+                    f"{len(prompts)} prompts")
         reqs = [self.submit(p, max_new_tokens, eos_id,
                             priority=priority,
                             deadline_iters=deadline_iters,
-                            deadline_s=deadline_s)
-                for p in prompts]
+                            deadline_s=deadline_s,
+                            sampling=s)
+                for p, s in zip(prompts, per_prompt)]
         while self.scheduler.has_work:
             self.step()
         if return_requests:
@@ -1758,6 +1911,28 @@ class InferenceServer:
                 "drafted_per_step": _hist_counts(self.spec_drafted_hist),
                 "accepted_per_step": _hist_counts(
                     self.spec_accepted_hist),
+            },
+            # stochastic sampling (docs/serving.md, "Stochastic
+            # sampling"): per-class request traffic, the legacy
+            # custom-sample_fn downgrade flag, and the
+            # rejection-sampling accounting — stochastic drafts
+            # accept with prob p(draft) under the Gumbel-max
+            # coupling, each first rejection emitting one residual
+            # resample
+            "sampling": {
+                "requests": self.sampling_classes.as_dict(),
+                "custom_sample_fn":
+                    self.sample_fn is not greedy_sample,
+                "rejection": {
+                    "drafted_tokens":
+                        self.spec.count("stoch_drafted_tokens"),
+                    "accepted_tokens":
+                        self.spec.count("stoch_accepted_tokens"),
+                    "acceptance_rate": round(self.spec.ratio(
+                        "stoch_accepted_tokens",
+                        "stoch_drafted_tokens"), 3),
+                    "resamples": self.spec.count("stoch_resamples"),
+                },
             },
             # pipelined serve loop (docs/serving.md, "Pipelined serve
             # loop"): dispatch-ahead depth and the host-stall /

@@ -70,8 +70,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from apex_tpu.observability import NULL_PROGRAM_ACCOUNTING, NULL_TRACER
 from apex_tpu.models.gpt import GPTConfig, GPTLMHeadModel
-from apex_tpu.ops.sampling import finite_rows, greedy_argmax
-from apex_tpu.ops.vocab_parallel import vocab_parallel_sample
+from apex_tpu.ops.sampling import finite_rows, greedy_argmax, sample_tokens
+from apex_tpu.ops.vocab_parallel import (
+    vocab_parallel_sample,
+    vocab_parallel_sample_tokens,
+)
 from apex_tpu.serving.kv_cache import (
     BlockAllocator,
     KVCacheConfig,
@@ -323,6 +326,28 @@ class DecodeEngine:
         self._verify_sampled_jit = _jit(self._verify_sampled_impl,
                                         sampled_cache,
                                         (cache_sh, repl, repl))
+        # the STOCHASTIC twins (docs/serving.md, "Stochastic
+        # sampling"): the same bodies + in-trace temperature/top-k/
+        # top-p sampling with per-request counter-based keys
+        # (ops.sample_tokens; the vocab-parallel no-gather path under
+        # a mesh).  Distinct traces from the greedy twins on purpose:
+        # an all-greedy step keeps launching the argmax-only program
+        # — zero sort/noise cost for the default traffic — and the
+        # stochastic program only compiles once the first stochastic
+        # request is actually batched.  Greedy rows INSIDE a
+        # stochastic launch still take the bit-exact argmax lane.
+        self._prefill_stoch_jit = _jit(self._prefill_stoch_impl,
+                                       sampled_cache,
+                                       (cache_sh, repl, repl))
+        self._chunk_stoch_jit = _jit(self._chunk_stoch_impl,
+                                     sampled_cache,
+                                     (cache_sh, repl, repl))
+        self._decode_stoch_jit = _jit(self._decode_stoch_impl,
+                                      sampled_cache,
+                                      (cache_sh, repl, repl))
+        self._verify_stoch_jit = _jit(self._verify_stoch_impl,
+                                      sampled_cache,
+                                      (cache_sh, repl, repl))
 
     # -- compiled bodies --------------------------------------------------
 
@@ -504,6 +529,78 @@ class DecodeEngine:
                                           length, tables)
         return (cache,) + self._sample(logits)                 # (B, K)
 
+    # -- stochastic twins (docs/serving.md, "Stochastic sampling") ---------
+    # Same bodies, but the fused sampler is ops.sample_tokens with the
+    # per-slot SamplingParams arrays and the COUNTER position of each
+    # sampled token (the sequence index of the token being drawn —
+    # what makes replay/preemption/speculation deterministic).  Rows
+    # whose temperature is 0 (greedy requests, idle slots) take the
+    # bit-exact argmax lane inside the same trace.
+
+    def _sample_stoch(self, logits, counters, temp, tk, tp_, seed):
+        """The fused stochastic sampler: plain
+        :func:`ops.sample_tokens` on one chip; the no-gather
+        :func:`ops.vocab_parallel_sample_tokens` under a mesh, so the
+        vocab-sharded logits are never gathered for stochastic
+        traffic either."""
+        b = logits.shape[:-1]
+        extra = logits.ndim - 1 - temp.ndim     # 1 on verify's (B, K)
+
+        def bc(x):
+            return jnp.broadcast_to(x.reshape(x.shape + (1,) * extra),
+                                    b)
+
+        args = (bc(temp), bc(tk), bc(tp_), bc(seed))
+        if self.mesh is not None:
+            return vocab_parallel_sample_tokens(
+                logits, *args, counters, self.mesh, self.tp_axis)
+        return sample_tokens(logits, *args, counters)
+
+    def _prefill_stoch_impl(self, params, cache, ids, length, table,
+                            temp, tk, tp_, seed):
+        cache, last = self._prefill_impl(params, cache, ids, length,
+                                         table)
+        # the prefill-sampled token's sequence index == prompt length
+        ids_out, fin = self._sample_stoch(last, length, temp, tk,
+                                          tp_, seed)
+        return cache, ids_out, fin                             # (1,)
+
+    def _chunk_stoch_impl(self, params, cache, ids, start, length,
+                          table, temp, tk, tp_, seed):
+        cache, last = self._chunk_impl(params, cache, ids, start,
+                                       length, table)
+        # final chunk: start + length == the full context length
+        ids_out, fin = self._sample_stoch(last, start + length, temp,
+                                          tk, tp_, seed)
+        return cache, ids_out, fin                             # (1,)
+
+    def _decode_stoch_impl(self, params, cache, tokens, positions,
+                           tables, temp, tk, tp_, seed):
+        cache, logits = self._decode_impl(params, cache, tokens,
+                                          positions, tables)
+        # the input token sits at `positions`; the drawn token is the
+        # next sequence index
+        ids_out, fin = self._sample_stoch(logits, positions + 1, temp,
+                                          tk, tp_, seed)
+        return cache, ids_out, fin                             # (B,)
+
+    def _verify_stoch_impl(self, params, cache, ids, start, length,
+                           tables, temp, tk, tp_, seed):
+        cache, logits = self._verify_impl(params, cache, ids, start,
+                                          length, tables)
+        # column j's logits predict the token at index start + j + 1;
+        # sampling EVERY column with its own positional key is the
+        # whole speculation story: the host accepts a draft iff it
+        # equals the column's sample (the Gumbel-max coupling of
+        # ops.sample_tokens — rejection sampling's exact accept/
+        # residual probabilities, with a draft-independent stream)
+        kw = ids.shape[1]
+        counters = (start[:, None].astype(jnp.int32) + 1
+                    + jnp.arange(kw, dtype=jnp.int32)[None, :])
+        ids_out, fin = self._sample_stoch(logits, counters, temp, tk,
+                                          tp_, seed)
+        return cache, ids_out, fin                             # (B, K)
+
     # -- host API ---------------------------------------------------------
 
     def _mark(self, jit_fn):
@@ -570,20 +667,25 @@ class DecodeEngine:
             return jax.device_put(arrays, self._repl)
         return jax.device_put(arrays)
 
-    def _prefill_args(self, prompt, block_table):
-        """The prefill launch struct: (ids, length, table) on device
-        in one transfer, plus the bucket the prompt padded to."""
+    def _prefill_args(self, prompt, block_table, sampling=None):
+        """The prefill launch struct: (ids, length, table[, sampling
+        params]) on device in one transfer, plus the bucket the
+        prompt padded to."""
         n = len(prompt)
         sb = self.bucket_for(n)
         ids = np.zeros((1, sb), np.int32)
         ids[0, :n] = prompt
         table = np.zeros((1, self.blocks_per_seq), np.int32)
         table[0, :len(block_table)] = block_table
-        return self._put(ids, np.asarray([n], np.int32), table), sb
+        extra = tuple(sampling) if sampling is not None else ()
+        return self._put(ids, np.asarray([n], np.int32), table,
+                         *extra), sb
 
-    def _chunk_args(self, tokens, start, block_table, pad_to):
-        """The chunk launch struct: (ids, start, length, table) on
-        device in one transfer, plus the compiled chunk width."""
+    def _chunk_args(self, tokens, start, block_table, pad_to,
+                    sampling=None):
+        """The chunk launch struct: (ids, start, length, table[,
+        sampling params]) on device in one transfer, plus the
+        compiled chunk width."""
         n = len(tokens)
         cb = pad_to if pad_to is not None else self.bucket_for(n)
         if n > cb:
@@ -593,8 +695,9 @@ class DecodeEngine:
         ids[0, :n] = tokens
         table = np.zeros((1, self.blocks_per_seq), np.int32)
         table[0, :len(block_table)] = block_table
+        extra = tuple(sampling) if sampling is not None else ()
         return self._put(ids, np.asarray([start], np.int32),
-                         np.asarray([n], np.int32), table), cb
+                         np.asarray([n], np.int32), table, *extra), cb
 
     def prefill(self, prompt, block_table) -> jax.Array:
         """Run one prompt through the bucketed prefill, writing its
@@ -608,17 +711,25 @@ class DecodeEngine:
                       key=self._qkey(sb), bucket=sb)
         return last[0]
 
-    def prefill_sampled(self, prompt, block_table):
+    def prefill_sampled(self, prompt, block_table, sampling=None):
         """The fused-sampling twin of :meth:`prefill`: returns
         ``(token_ids (1,) int32, finite (1,) bool)`` device arrays —
-        the prompt's greedy next token and its non-finite guard —
-        without materializing logits on the host."""
-        args, sb = self._prefill_args(prompt, block_table)
-        mark = self._mark(self._prefill_sampled_jit)
-        self.cache, ids, fin = self._prefill_sampled_jit(
-            self.params, self.cache, *args)
-        self._account(self._prefill_sampled_jit, mark,
-                      "prefill_sampled", key=self._qkey(sb),
+        the prompt's next token and its non-finite guard — without
+        materializing logits on the host.  ``sampling=None`` (the
+        default) launches the greedy argmax program; a
+        ``(temperature, top_k, top_p, seed)`` tuple of ``(1,)``
+        arrays launches the stochastic twin (``docs/serving.md``,
+        "Stochastic sampling"; a 0-temperature row inside it is still
+        bit-exact argmax)."""
+        args, sb = self._prefill_args(prompt, block_table,
+                                      sampling=sampling)
+        if sampling is None:
+            jit_fn, name = self._prefill_sampled_jit, "prefill_sampled"
+        else:
+            jit_fn, name = self._prefill_stoch_jit, "prefill_stoch"
+        mark = self._mark(jit_fn)
+        self.cache, ids, fin = jit_fn(self.params, self.cache, *args)
+        self._account(jit_fn, mark, name, key=self._qkey(sb),
                       bucket=sb)
         return ids, fin
 
@@ -643,17 +754,23 @@ class DecodeEngine:
         return last[0]
 
     def chunk_prefill_sampled(self, tokens, start: int, block_table,
-                              pad_to: Optional[int] = None):
+                              pad_to: Optional[int] = None,
+                              sampling=None):
         """The fused-sampling twin of :meth:`chunk_prefill`: returns
         ``(token_ids (1,) int32, finite (1,) bool)`` device arrays for
         the chunk's last valid token (only meaningful on the final
-        chunk, exactly like the logits twin)."""
-        args, cb = self._chunk_args(tokens, start, block_table, pad_to)
-        mark = self._mark(self._chunk_sampled_jit)
-        self.cache, ids, fin = self._chunk_sampled_jit(
-            self.params, self.cache, *args)
-        self._account(self._chunk_sampled_jit, mark,
-                      "chunk_prefill_sampled", key=self._qkey(cb),
+        chunk, exactly like the logits twin).  ``sampling`` as in
+        :meth:`prefill_sampled`."""
+        args, cb = self._chunk_args(tokens, start, block_table,
+                                    pad_to, sampling=sampling)
+        if sampling is None:
+            jit_fn, name = (self._chunk_sampled_jit,
+                            "chunk_prefill_sampled")
+        else:
+            jit_fn, name = self._chunk_stoch_jit, "chunk_prefill_stoch"
+        mark = self._mark(jit_fn)
+        self.cache, ids, fin = jit_fn(self.params, self.cache, *args)
+        self._account(jit_fn, mark, name, key=self._qkey(cb),
                       width=cb)
         return ids, fin
 
@@ -674,10 +791,11 @@ class DecodeEngine:
             self._account(self._copy_jit, mark, "copy_blocks",
                           key=self._qkey())
 
-    def _decode_args(self, tokens, positions, tables):
+    def _decode_args(self, tokens, positions, tables, sampling=None):
+        extra = tuple(sampling) if sampling is not None else ()
         return self._put(np.asarray(tokens, np.int32),
                          np.asarray(positions, np.int32),
-                         np.asarray(tables, np.int32))
+                         np.asarray(tables, np.int32), *extra)
 
     def decode(self, tokens, positions, tables) -> jax.Array:
         """One iteration-level decode step over all slots.  Arrays are
@@ -691,25 +809,36 @@ class DecodeEngine:
                       key=self._qkey())
         return logits
 
-    def decode_sampled(self, tokens, positions, tables):
+    def decode_sampled(self, tokens, positions, tables, sampling=None):
         """The fused-sampling twin of :meth:`decode`: returns
         ``(token_ids (B,) int32, finite (B,) bool)`` DEVICE arrays.
         Nothing is materialized — the pipelined serve loop stashes the
         handles and consumes them next iteration, so the device runs
-        this step while the host plans the next one."""
-        args = self._decode_args(tokens, positions, tables)
-        mark = self._mark(self._decode_sampled_jit)
-        self.cache, ids, fin = self._decode_sampled_jit(
-            self.params, self.cache, *args)
-        self._account(self._decode_sampled_jit, mark,
-                      "decode_sampled", key=self._qkey())
+        this step while the host plans the next one.
+
+        ``sampling=None`` launches the greedy argmax program; a
+        ``(temperature, top_k, top_p, seed)`` tuple of per-slot
+        ``(B,)`` arrays launches the stochastic twin — greedy/idle
+        slots (temperature 0) stay bit-exact argmax inside it
+        (``docs/serving.md``, "Stochastic sampling")."""
+        args = self._decode_args(tokens, positions, tables,
+                                 sampling=sampling)
+        if sampling is None:
+            jit_fn, name = self._decode_sampled_jit, "decode_sampled"
+        else:
+            jit_fn, name = self._decode_stoch_jit, "decode_stoch"
+        mark = self._mark(jit_fn)
+        self.cache, ids, fin = jit_fn(self.params, self.cache, *args)
+        self._account(jit_fn, mark, name, key=self._qkey())
         return ids, fin
 
-    def _verify_args(self, tokens, lengths, positions, tables):
+    def _verify_args(self, tokens, lengths, positions, tables,
+                     sampling=None):
+        extra = tuple(sampling) if sampling is not None else ()
         return self._put(np.asarray(tokens, np.int32),
                          np.asarray(positions, np.int32),
                          np.asarray(lengths, np.int32),
-                         np.asarray(tables, np.int32))
+                         np.asarray(tables, np.int32), *extra)
 
     def verify(self, tokens, lengths, positions, tables) -> jax.Array:
         """One speculative verify step over all slots: tokens (B, K)
@@ -729,20 +858,32 @@ class DecodeEngine:
                       key=self._qkey(kw), width=kw)
         return logits
 
-    def verify_sampled(self, tokens, lengths, positions, tables):
+    def verify_sampled(self, tokens, lengths, positions, tables,
+                       sampling=None):
         """The fused-sampling twin of :meth:`verify`: returns
         ``(token_ids (B, K) int32, finite (B, K) bool)`` device
-        arrays — every row's argmax and finite flag, the exact inputs
-        greedy acceptance needs — without materializing the
+        arrays — every row's sampled token and finite flag, the exact
+        inputs acceptance needs — without materializing the
         ``(B, K, V)`` logits block.  Same one-trace-per-width compile
-        discipline as :meth:`verify`."""
-        args = self._verify_args(tokens, lengths, positions, tables)
+        discipline as :meth:`verify`.
+
+        ``sampling=None``: every row is argmax (greedy acceptance
+        compares drafts to argmax).  With per-slot params, each column
+        is sampled with its own positional counter key — acceptance
+        then compares drafts to the column's SAMPLE, which realizes
+        rejection sampling's accept/residual probabilities exactly
+        while keeping the emitted stream draft-independent
+        (``ops.sample_tokens``, the Gumbel-max coupling)."""
+        args = self._verify_args(tokens, lengths, positions, tables,
+                                 sampling=sampling)
         kw = int(np.asarray(tokens).shape[1])
-        mark = self._mark(self._verify_sampled_jit)
-        self.cache, ids, fin = self._verify_sampled_jit(
-            self.params, self.cache, *args)
-        self._account(self._verify_sampled_jit, mark,
-                      "verify_sampled", key=self._qkey(kw),
+        if sampling is None:
+            jit_fn, name = self._verify_sampled_jit, "verify_sampled"
+        else:
+            jit_fn, name = self._verify_stoch_jit, "verify_stoch"
+        mark = self._mark(jit_fn)
+        self.cache, ids, fin = jit_fn(self.params, self.cache, *args)
+        self._account(jit_fn, mark, name, key=self._qkey(kw),
                       width=kw)
         return ids, fin
 
@@ -753,39 +894,49 @@ class DecodeEngine:
         scheduler tests pin: prefill (monolithic buckets + chunk
         widths) <= len(prefill_buckets), decode == 1 regardless of
         traffic.  A fixed-chunk loop contributes exactly one chunk
-        trace (``chunk_prefill(pad_to=...)``).  Logits and sampled
-        twins count together: a server runs exactly one of the two
-        paths per program, so the audit's bounds are unchanged by the
-        pipelined loop."""
+        trace (``chunk_prefill(pad_to=...)``).  Logits, sampled, and
+        stochastic twins count together: greedy-only traffic runs
+        exactly one path per program (the historical bounds hold
+        unchanged), and the first stochastic request adds at most one
+        extra trace per program family — still O(1) per shape key,
+        never per request."""
         return (self._prefill_jit._cache_size()
                 + self._chunk_jit._cache_size()
                 + self._prefill_sampled_jit._cache_size()
-                + self._chunk_sampled_jit._cache_size(),
+                + self._chunk_sampled_jit._cache_size()
+                + self._prefill_stoch_jit._cache_size()
+                + self._chunk_stoch_jit._cache_size(),
                 self._decode_jit._cache_size()
-                + self._decode_sampled_jit._cache_size())
+                + self._decode_sampled_jit._cache_size()
+                + self._decode_stoch_jit._cache_size())
 
     def verify_compiles(self) -> int:
-        """Verify-program traces (logits + sampled twins) — the
-        speculation half of the compile audit: a server with a fixed
-        speculation depth must show exactly 1 (0 with speculation
-        off/idle) no matter how drafts and batch composition vary."""
+        """Verify-program traces (logits + sampled + stochastic
+        twins) — the speculation half of the compile audit: a
+        greedy-only server with a fixed speculation depth must show
+        exactly 1 (0 with speculation off/idle) no matter how drafts
+        and batch composition vary; stochastic traffic adds at most
+        one more trace per width."""
         return (self._verify_jit._cache_size()
-                + self._verify_sampled_jit._cache_size())
+                + self._verify_sampled_jit._cache_size()
+                + self._verify_stoch_jit._cache_size())
 
     def collective_programs(self) -> int:
         """Compiled traces currently lowered THROUGH the mesh (all
-        program families, logits + sampled twins + block copy) — the
-        ``stats()["sharding"]`` audit that sharded serving compiled
-        one program per logical (program, shape) key, not per shard.
-        0 on an unsharded engine: nothing it compiles carries a
-        collective."""
+        program families, logits + sampled + stochastic twins + block
+        copy) — the ``stats()["sharding"]`` audit that sharded serving
+        compiled one program per logical (program, shape) key, not per
+        shard.  0 on an unsharded engine: nothing it compiles carries
+        a collective."""
         if self.mesh is None:
             return 0
         return sum(j._cache_size() for j in (
             self._prefill_jit, self._chunk_jit, self._decode_jit,
             self._verify_jit, self._copy_jit,
             self._prefill_sampled_jit, self._chunk_sampled_jit,
-            self._decode_sampled_jit, self._verify_sampled_jit))
+            self._decode_sampled_jit, self._verify_sampled_jit,
+            self._prefill_stoch_jit, self._chunk_stoch_jit,
+            self._decode_stoch_jit, self._verify_stoch_jit))
 
     def memory_info(self) -> dict:
         """Static pool geometry for ``stats()["memory"]`` and

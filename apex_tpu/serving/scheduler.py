@@ -91,7 +91,10 @@ import itertools
 from collections import deque
 from typing import Deque, Dict, List, Optional, Tuple
 
+import numpy as np
+
 from apex_tpu.observability import NULL_TRACER
+from apex_tpu.ops.sampling import SamplingParams
 from apex_tpu.serving.kv_cache import BlockAllocator
 from apex_tpu.serving.overload import OverloadPolicy
 from apex_tpu.serving.prefix_cache import ROOT, PrefixCache
@@ -119,6 +122,15 @@ class Request:
     max_new_tokens: int
     eos_id: Optional[int] = None
     uid: int = dataclasses.field(default_factory=lambda: next(_uid))
+
+    # per-request sampling knobs (``docs/serving.md``, "Stochastic
+    # sampling"): the default instance is greedy argmax, bit-identical
+    # to the historical path.  Stochastic params keep BOTH fast paths
+    # (pipelined loop + speculation) — the scheduler batches them into
+    # per-slot launch arrays, and the counter-keyed draws make the
+    # stream deterministic across preemption/replay/speculation.
+    sampling: SamplingParams = dataclasses.field(
+        default_factory=SamplingParams)
 
     # overload-control inputs (``serving.overload``): ``priority`` is
     # nice-style — 0 is the default/foreground class, larger numbers
@@ -632,6 +644,47 @@ class Scheduler:
         failed before enqueue): the window's requests are ordinary
         running requests again."""
         self.inflight.clear()
+
+    # -- sampling-param batching (docs/serving.md, "Stochastic sampling") --
+
+    @staticmethod
+    def _pack_sampling(by_slot, width: int) -> Tuple[np.ndarray, ...]:
+        """``{slot: SamplingParams}`` -> the per-slot launch arrays
+        ``(temperature f32, top_k i32, top_p f32, seed i32)``, each
+        ``(width,)``.  Unlisted slots get temperature 0 — the in-trace
+        greedy lane — so idle and greedy rows cost the argmax path
+        they always did."""
+        temp = np.zeros((width,), np.float32)
+        tk = np.zeros((width,), np.int32)
+        tp = np.ones((width,), np.float32)
+        seed = np.zeros((width,), np.int32)
+        for slot, s in by_slot.items():
+            temp[slot] = s.temperature
+            tk[slot] = 0 if s.top_k is None else int(s.top_k)
+            tp[slot] = s.top_p
+            seed[slot] = int(s.seed) & 0x7FFFFFFF
+        return temp, tk, tp, seed
+
+    def sampling_inputs(self, requests) -> Optional[Tuple]:
+        """The per-slot :class:`SamplingParams` arrays for one batched
+        decode/verify launch — part of the engine's ONE-``device_put``
+        launch struct.  None when every request is greedy: the caller
+        then launches the historical argmax-only program (zero
+        stochastic-lane cost for default traffic)."""
+        if all(r.sampling.is_greedy for r in requests):
+            return None
+        return self._pack_sampling(
+            {r.slot: r.sampling for r in requests},
+            self.max_batch_size)
+
+    @staticmethod
+    def prefill_sampling(req: Request) -> Optional[Tuple]:
+        """The ``(1,)``-wide sampling arrays for one request's
+        prefill/chunk launch (None = greedy, the historical
+        program)."""
+        if req.sampling.is_greedy:
+            return None
+        return Scheduler._pack_sampling({0: req.sampling}, 1)
 
     def frag_slots(self) -> int:
         """Allocated-but-unwritten token slots across running tables —

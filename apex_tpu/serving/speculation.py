@@ -26,6 +26,16 @@ own cheap model — the verify/acceptance machinery upstream is
 identical and stays bit-exact regardless of where drafts come from,
 because acceptance only ever compares drafts against the target
 model's own argmax.
+
+Stochastic requests (``docs/serving.md``, "Stochastic sampling")
+use the SAME drafts and the same acceptance comparison, but against
+each verify column's counter-keyed SAMPLE instead of its argmax —
+rejection sampling with the proposer's tokens as a delta ``q``
+(accept prob ``p(draft)``, residual resample on first rejection),
+realized via the Gumbel-max coupling so the emitted stream is
+byte-identical with speculation on or off.  Draft determinism (the
+contract below) matters doubly there: the chaos soak replays
+per-step accounting, and drafts must be pure functions of history.
 """
 
 from __future__ import annotations

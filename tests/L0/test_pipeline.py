@@ -25,7 +25,7 @@ import pytest
 
 from apex_tpu import models
 from apex_tpu.ops.sampling import finite_rows, greedy_argmax
-from apex_tpu.serving import InferenceServer, greedy_sample
+from apex_tpu.serving import InferenceServer, SamplingParams, greedy_sample
 
 pytestmark = pytest.mark.serving
 
@@ -56,6 +56,9 @@ def _server(cfg, params, *, pipeline, **kw):
 
 
 def _audited_generate(server, prompts, n, **kw):
+    # these parity oracles assume argmax pacing: pin default-greedy
+    # sampling explicitly (docs/serving.md, "Stochastic sampling")
+    kw.setdefault("sampling", SamplingParams())
     reqs = [server.submit(p, n, **kw) for p in prompts]
     while server.scheduler.has_work:
         server.step()

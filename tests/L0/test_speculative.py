@@ -23,7 +23,7 @@ import numpy as np
 import pytest
 
 from apex_tpu import models
-from apex_tpu.serving import InferenceServer, NgramDraft
+from apex_tpu.serving import InferenceServer, NgramDraft, SamplingParams
 from apex_tpu.serving.speculation import DraftSource
 
 pytestmark = pytest.mark.serving
@@ -70,7 +70,11 @@ def _server(cfg, params, spec=True, **kw):
 
 
 def _audited_generate(server, prompts, max_new, eos_id=None):
-    reqs = [server.submit(p, max_new, eos_id) for p in prompts]
+    # these parity oracles assume argmax pacing: pin default-greedy
+    # sampling explicitly (docs/serving.md, "Stochastic sampling")
+    reqs = [server.submit(p, max_new, eos_id,
+                          sampling=SamplingParams())
+            for p in prompts]
     while server.scheduler.has_work:
         server.step()
         server.scheduler.audit()

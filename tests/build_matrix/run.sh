@@ -201,6 +201,36 @@ env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
       --iters 800 --kv-quant
 results[kv_quant]=$?
 
+# stochastic sampling: the on-device sampling axis (docs/serving.md,
+# "Stochastic sampling") — three gates under the emulated 8-device
+# mesh flags (the L0 tier's vocab-parallel stochastic parity oracle
+# shards tp∈{2,4}):
+#   1. the L0 sampling tier: SamplingParams validation, fixed-key
+#      distribution oracles vs numpy (temperature scaling, top-k mask
+#      exactness, top-p boundary inclusion), greedy-default bit-parity
+#      vs the argmax path, deterministic replay across preemption /
+#      eviction / speculation / pipelining, rejection-sampling
+#      exactness (chi-square on a small vocab), and the sharded
+#      sampler's bit-parity vs unsharded;
+#   2. serving_bench --sampling: seeded stochastic traffic with
+#      pipeline+speculation ON vs the forced logits fallback —
+#      cross-arm stream parity + same-seed replay always, the
+#      per-axis floors (pipeline wall ratio, speculation
+#      tokens-per-engine-step >= 1.25x) asserted;
+#   3. an 800-iteration seed-0 chaos soak with the stochastic traffic
+#      class ON (40% of arrivals carry seeded temperature/top-k/top-p
+#      params, speculation + pipeline + repetitive prompts on) — the
+#      bit-exact-replay oracle holds unchanged because counter-keyed
+#      streams are pure functions of (prompt, params, seed).
+echo "=== build-matrix axis: sampling ==="
+env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/L0/test_sampling.py -q -x --no-header \
+  && env JAX_PLATFORMS=cpu python tools/serving_bench.py --smoke \
+      --sampling --out - \
+  && env JAX_PLATFORMS=cpu python tools/chaos_soak.py --seed 0 \
+      --iters 800 --sampling
+results[sampling]=$?
+
 # chaos soak: the overload-robustness axis (docs/resilience.md,
 # "Overload policy & lifecycle") — the full serving stack (prefix
 # cache + chunked prefill + overload control + circuit breaker, small

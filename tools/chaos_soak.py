@@ -192,6 +192,17 @@ def main(argv=None) -> int:
                         help="speculation-enabled traffic class: "
                         "serve with speculative decoding on and mix "
                         "in repetitive prompts so drafts fire")
+    parser.add_argument("--sampling", action="store_true",
+                        help="stochastic-sampling traffic class "
+                        "(docs/serving.md, 'Stochastic sampling'): a "
+                        "mix of arrivals carries per-request seeded "
+                        "temperature/top-k/top-p params, served with "
+                        "speculation AND the pipelined loop ON plus "
+                        "repetitive prompts so the rejection-sampling "
+                        "acceptance path actually fires — the "
+                        "bit-exact-replay oracle holds unchanged "
+                        "(counter-keyed draws make every stream a "
+                        "pure function of (prompt, params, seed))")
     parser.add_argument("--kv-quant", dest="kv_quant",
                         action="store_true",
                         help="soak the int8-QUANTIZED KV pool: the "
@@ -281,6 +292,13 @@ def main(argv=None) -> int:
 
     cfg, params = build_model()
 
+    # the sampling axis soaks the full fast-path stack: stochastic
+    # requests must keep speculation (rejection-sampling acceptance)
+    # and the pipelined loop ON — the whole point of the on-device
+    # sampling suite — so --sampling implies --speculative traffic
+    if args.sampling:
+        args.speculative = True
+
     mesh = None
     if args.tp:
         import jax
@@ -349,6 +367,11 @@ def main(argv=None) -> int:
         # so drafts fire and the verify/acceptance/rollback machinery
         # soaks under faults rather than idling
         repetitive_rate=0.33 if args.speculative else 0.0,
+        # with --sampling, 40% of arrivals carry seeded stochastic
+        # params — the temperature/top-p million-user-chat mix —
+        # while the rest stay greedy, so mixed batches run both the
+        # argmax lane and the stochastic lane in one launch
+        stochastic_rate=0.4 if args.sampling else 0.0,
         force_violation_iter=args.force_violation)
     t0 = time.perf_counter()
     report = run_soak(make_server, chaos_cfg, args.seed,
@@ -357,6 +380,7 @@ def main(argv=None) -> int:
     report["wall_s"] = round(time.perf_counter() - t0, 2)
     report["tp"] = args.tp or 1
     report["kv_quant"] = "int8" if args.kv_quant else None
+    report["sampling_traffic"] = bool(args.sampling)
 
     line = json.dumps(report, indent=2, sort_keys=True)
     if args.out == "-":

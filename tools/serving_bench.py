@@ -77,10 +77,23 @@ docs/serving.md's speculation section (one JSON record to
   nothing to look up; the number documents the no-win case instead of
   hiding it).
 
+``--sampling`` switches to the stochastic-sampling A/B of
+docs/serving.md's "Stochastic sampling" section (one JSON record to
+``BENCH_serving_sampling.json``): seeded temperature/top-p/top-k
+traffic through three arms — pipeline+speculation ON (the default
+stack), pipeline-only, and the forced synchronous-logits fallback a
+legacy custom ``sample_fn`` used to cost.  Byte-identical same-seed
+replay and cross-arm stream parity are always asserted (the
+Gumbel-max coupling makes the fast paths invisible to outputs);
+``--smoke`` floors the pipeline contribution on wall throughput
+(PR-8 shape) and the speculation contribution on
+decoded-tokens-per-engine-step (PR-6 shape, hardware-independent).
+
 Usage:
     python tools/serving_bench.py --smoke
     python tools/serving_bench.py --smoke --shared-prefix
     python tools/serving_bench.py --smoke --speculative
+    python tools/serving_bench.py --smoke --sampling
     python tools/serving_bench.py [--requests 32] [--max-new 64]
         [--batch-size 8] [--hidden 256] [--layers 4] [--heads 8]
         [--max-context 512] [--seed 0] [--out BENCH_serving.json]
@@ -140,7 +153,7 @@ def run_continuous(cfg, params, prompts, args):
     """Timed InferenceServer.generate over the request set; returns
     (tokens_s, per-request latencies, stats, outputs)."""
     import jax.numpy as jnp
-    from apex_tpu.serving import InferenceServer
+    from apex_tpu.serving import InferenceServer, SamplingParams
 
     server = InferenceServer(
         cfg, params, max_batch_size=args.batch_size,
@@ -152,6 +165,10 @@ def run_continuous(cfg, params, prompts, args):
         # keeps comparing the same synchronous one-token decode it
         # always has
         enable_speculation=False, enable_pipeline=False)
+    # arm isolation (the PR-6/PR-12 pinning precedent): legacy arms
+    # pin default-greedy sampling explicitly — stochastic sampling is
+    # measured by its own mode (--sampling)
+    greedy = SamplingParams()
     # warmup: compile every bucket the workload will touch + decode.
     # A warm prompt of length b lands exactly in bucket b (length b-1
     # for the top bucket — a full-length prompt leaves no room to
@@ -165,7 +182,8 @@ def run_continuous(cfg, params, prompts, args):
     # latency per request: submit all up front (offline batch), track
     # finish step. For per-request wall latency, wrap generate: run
     # step loop manually recording completion times.
-    reqs = [server.submit(p, args.max_new) for p in prompts]
+    reqs = [server.submit(p, args.max_new, sampling=greedy)
+            for p in prompts]
     t0 = time.perf_counter()
     done_at = {}
     while server.scheduler.has_work:
@@ -526,12 +544,17 @@ def _run_pipeline_workload(server, prompts, args):
     """Drive one server over a decode-heavy request set (audited
     every step); returns (window numbers, outputs).  Warmup compiles
     every program the arm's loop uses before the timed window."""
+    from apex_tpu.serving import SamplingParams
+
     warm = sorted({server.engine.bucket_for(len(p)) for p in prompts})
     server.generate([[1] * (b if b < args.max_context else b - 1)
                      for b in warm], max_new_tokens=4)
     server.engine.reset_cache()
     server.reset_meters()
-    reqs = [server.submit(p, args.max_new) for p in prompts]
+    # legacy-arm isolation: default greedy sampling pinned explicitly
+    reqs = [server.submit(p, args.max_new,
+                          sampling=SamplingParams())
+            for p in prompts]
     t0 = time.perf_counter()
     steps = 0
     while server.scheduler.has_work:
@@ -638,6 +661,226 @@ def run_pipeline_mode(args):
     return rc
 
 
+def _sampling_server(cfg, params, args, pipeline, speculation):
+    import jax.numpy as jnp
+    from apex_tpu.serving import InferenceServer
+
+    # (True, True): the server DEFAULT stack — stochastic requests
+    # keep speculation and the pipelined loop ON (the on-device
+    # sampling suite, docs/serving.md "Stochastic sampling").
+    # (False, False): the forced logits fallback — exactly what the
+    # legacy custom sample_fn escape hatch cost (both fast paths off,
+    # per-step (B, V) host logits + host sampling).  (True, False):
+    # the pipeline-contribution arm, isolating dispatch-ahead overlap
+    # from speculation width (the two floors below are per-axis).
+    return InferenceServer(
+        cfg, params, max_batch_size=args.batch_size,
+        max_context=args.max_context, block_size=args.block_size,
+        cache_dtype=jnp.float32, kv_quant="off",
+        enable_pipeline=pipeline, enable_speculation=speculation,
+        spec_tokens=args.spec_tokens)
+
+
+def _sampling_traffic(args):
+    """The stochastic chat mix: repetitive prompts (so prompt-lookup
+    drafts fire) with per-request seeded temperature/top-p/top-k
+    params — low-ish temperatures, the peaked-distribution regime
+    where rejection sampling actually accepts."""
+    from apex_tpu.serving import SamplingParams
+
+    rng = np.random.RandomState(args.seed + 11)
+    prompts, params = [], []
+    for i in range(args.requests):
+        period = int(rng.randint(1, 4))
+        pat = [int(x) for x in rng.randint(0, args.vocab, size=period)]
+        prompts.append((pat * (args.prompt_tokens // period + 1))
+                       [:args.prompt_tokens])
+        # low temperatures: the toy bench model is random-init, so
+        # only near-argmax distributions give drafts a real accept
+        # probability (p(draft) is what rejection sampling accepts
+        # with) — the same peaked-regime argument behind the PR-6
+        # repetitive-traffic floor.  A trained model is peaked at
+        # chat temperatures; a random one needs help.
+        params.append(SamplingParams(
+            temperature=float(rng.uniform(0.02, 0.15)),
+            top_k=int(rng.choice([0, 16, 64])) or None,
+            top_p=float(rng.choice([1.0, 0.95, 0.9])),
+            seed=int(rng.randint(1 << 30))))
+    return prompts, params
+
+
+def _run_sampling_workload(server, prompts, params, args):
+    """Drive one arm over the stochastic request set (audited every
+    step) TWICE — the second pass is the same-seed replay, asserted
+    byte-identical (the counter-key determinism contract).  Returns
+    (window numbers of the best pass, outputs)."""
+    warm = sorted({server.engine.bucket_for(len(p)) for p in prompts})
+    server.generate([[1] * (b if b < args.max_context else b - 1)
+                     for b in warm], max_new_tokens=4)
+    # one stochastic warmup so the stochastic twins compile outside
+    # the timed window, mirroring the greedy warmup above
+    server.engine.reset_cache()
+    server.generate(prompts[:1], max_new_tokens=4,
+                    sampling=params[:1])
+    outs, best = None, None
+    for _ in range(2):
+        server.engine.reset_cache()
+        server.reset_meters()
+        reqs = [server.submit(p, args.max_new, sampling=s)
+                for p, s in zip(prompts, params)]
+        t0 = time.perf_counter()
+        steps = 0
+        while server.scheduler.has_work:
+            _step_audited(server)
+            steps += 1
+        dt = time.perf_counter() - t0
+        run_outs = [list(r.generated) for r in reqs]
+        if outs is not None and run_outs != outs:
+            raise AssertionError(
+                "same-seed stochastic replay diverged — counter-key "
+                "determinism is broken")
+        outs = run_outs
+        toks = sum(len(o) for o in run_outs)
+        if best is None or toks / max(dt, 1e-9) > best["tokens_s"]:
+            st = server.stats()
+            best = {
+                "tokens_s": round(toks / max(dt, 1e-9), 1),
+                "steps_per_s": round(steps / max(dt, 1e-9), 1),
+                "steps": steps,
+                "tokens": toks,
+                "wall_s": round(dt, 3),
+                "tokens_per_engine_step":
+                    st["speculation"]["tokens_per_engine_step"],
+                "stoch_acceptance_rate":
+                    st["sampling"]["rejection"]["acceptance_rate"],
+                "stoch_resamples":
+                    st["sampling"]["rejection"]["resamples"],
+                "requests_by_class": st["sampling"]["requests"],
+                "pipeline": st["pipeline"]["enabled"],
+                "speculation": st["speculation"]["enabled"],
+            }
+    return best, outs
+
+
+def run_sampling_mode(args):
+    """Stochastic traffic A/B (docs/serving.md, "Stochastic
+    sampling"): the on-device sampling suite with pipeline +
+    speculation ON vs the forced synchronous-logits fallback (what a
+    legacy custom ``sample_fn`` used to silently cost) over identical
+    seeded temperature/top-p/top-k traffic, plus a pipeline-only
+    middle arm that isolates the two fast paths' contributions.
+
+    Oracles: each arm replays byte-identically under the same seeds
+    (asserted always), and ALL arms emit IDENTICAL streams — the
+    Gumbel-max coupling makes the sampled stream independent of
+    speculation and pipelining (asserted always).  ``--smoke`` floors
+    each fast path on the axis it actually accelerates, mirroring its
+    own bench's precedent:
+
+    - pipeline (PR-8 floor shape, wall time): pipeline-on /
+      fallback tokens/s >= 1.25x on overlap-capable (>= 2 core)
+      hosts; single-core hosts record ``overlap_capable: false`` and
+      floor >= 0.9x no-regression (dispatch-ahead can't overlap on
+      one core, and speculation is held out of both arms because its
+      verify width is a deliberate compute-for-latency trade that
+      serial hardware can't amortize);
+    - speculation (PR-6 floor shape, tokens per engine step): full
+      fast path / fallback decoded-tokens-per-engine-step >= 1.25x
+      on EVERY host — the hardware-independent statement that
+      rejection sampling multiplies tokens per launch on this
+      traffic.  The full fast/fallback wall ratio is recorded
+      unfloored alongside (on wide accelerators the verify columns
+      ride the same matmul the single token would, so the
+      tokens-per-step multiple converges to wall — the PR-6
+      argument)."""
+    cfg, m, params = build_model(args)
+    prompts, sparams = _sampling_traffic(args)
+
+    fast, outs_fast = _run_sampling_workload(
+        _sampling_server(cfg, params, args, True, True), prompts,
+        sparams, args)
+    pipe, outs_pipe = _run_sampling_workload(
+        _sampling_server(cfg, params, args, True, False), prompts,
+        sparams, args)
+    fallback, outs_fb = _run_sampling_workload(
+        _sampling_server(cfg, params, args, False, False), prompts,
+        sparams, args)
+    mismatches = sum(a != b for a, b in zip(outs_fast, outs_fb))
+    mismatches += sum(a != b for a, b in zip(outs_pipe, outs_fb))
+    overlap_capable = (os.cpu_count() or 1) >= 2
+    record = {
+        "bench": "serving_sampling",
+        "mode": "smoke" if args.smoke else "full",
+        "overlap_capable": overlap_capable,
+        "cpu_count": os.cpu_count() or 1,
+        "config": {"requests": args.requests, "max_new": args.max_new,
+                   "batch_size": args.batch_size,
+                   "block_size": args.block_size,
+                   "hidden": args.hidden, "layers": args.layers,
+                   "heads": args.heads,
+                   "max_context": args.max_context,
+                   "vocab": args.vocab,
+                   "prompt_tokens": args.prompt_tokens,
+                   "spec_tokens": args.spec_tokens},
+        "fast": fast,               # pipeline + speculation ON
+        "pipeline_only": pipe,      # dispatch-ahead, no speculation
+        "fallback": fallback,       # forced synchronous logits path
+        "speedup_wall": round(fast["tokens_s"]
+                              / max(fallback["tokens_s"], 1e-9), 2),
+        "speedup_pipeline": round(
+            pipe["tokens_s"] / max(fallback["tokens_s"], 1e-9), 2),
+        "speedup_tokens_per_step": round(
+            fast["tokens_per_engine_step"]
+            / max(fallback["tokens_per_engine_step"], 1e-9), 2),
+        "parity_mismatches": mismatches,
+    }
+    print(json.dumps(record))
+
+    out = args.out
+    if out != "-":
+        if out is None:
+            out = os.path.join(
+                os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+                "BENCH_serving_sampling.json")
+        with open(out, "w") as f:
+            json.dump(record, f, indent=1)
+            f.write("\n")
+
+    rc = 0
+    if mismatches:
+        print(f"FAIL: {mismatches} request streams diverged across "
+              "the stochastic arms (the Gumbel-max coupling should "
+              "make pipeline/speculation invisible to outputs)",
+              file=sys.stderr)
+        rc = 1
+    if args.smoke:
+        if record["speedup_tokens_per_step"] < 1.25:
+            print(f"FAIL: stochastic speculation tokens-per-engine-"
+                  f"step ratio {record['speedup_tokens_per_step']} "
+                  f"< 1.25x floor", file=sys.stderr)
+            rc = 1
+        if overlap_capable and record["speedup_pipeline"] < 1.25:
+            print(f"FAIL: stochastic pipeline/fallback "
+                  f"step-throughput ratio "
+                  f"{record['speedup_pipeline']} < 1.25x floor",
+                  file=sys.stderr)
+            rc = 1
+        elif not overlap_capable \
+                and record["speedup_pipeline"] < 0.9:
+            print(f"FAIL: the stochastic pipelined loop regressed "
+                  f"the logits fallback "
+                  f"({record['speedup_pipeline']}x < 0.9x) on a "
+                  "single-core host", file=sys.stderr)
+            rc = 1
+        if not overlap_capable:
+            print("note: single-core host — dispatch-ahead overlap "
+                  "cannot run; the 1.25x wall floor is asserted only "
+                  "on >= 2 cores (speculation's tokens-per-step "
+                  "floor is asserted everywhere)", file=sys.stderr)
+    return rc
+
+
 def _tp_server(cfg, params, args, mesh):
     import jax.numpy as jnp
     from apex_tpu.serving import InferenceServer
@@ -660,12 +903,17 @@ def _run_tp_workload(server, prompts, args):
     numbers, outputs).  Best-of-repeats is the PR-3 interference
     precedent: the floor of what the arm can do, immune to one-off
     scheduler noise on a shared host."""
+    from apex_tpu.serving import SamplingParams
+
     server.generate([prompts[0]], max_new_tokens=4)     # warm compiles
     best_tps, outs = 0.0, None
     for _ in range(args.repeats):
         server.engine.reset_cache()
         server.reset_meters()
-        reqs = [server.submit(p, args.max_new) for p in prompts]
+        # legacy-arm isolation: default greedy pinned explicitly
+        reqs = [server.submit(p, args.max_new,
+                              sampling=SamplingParams())
+                for p in prompts]
         t0 = time.perf_counter()
         steps = 0
         while server.scheduler.has_work:
@@ -1246,6 +1494,15 @@ def main():
                     help="run the speculative-decoding workloads "
                     "(repetitive-suffix floor + random report) "
                     "instead of the continuous-vs-naive compare")
+    ap.add_argument("--sampling", action="store_true",
+                    help="stochastic-sampling A/B (docs/serving.md, "
+                    "'Stochastic sampling'): seeded temperature/"
+                    "top-p/top-k traffic with pipeline+speculation ON "
+                    "vs the forced synchronous-logits fallback; "
+                    "byte-identical same-seed replay and cross-arm "
+                    "parity always asserted, --smoke floors the "
+                    "step-throughput ratio (BENCH_serving_sampling."
+                    "json)")
     ap.add_argument("--pipeline", action="store_true",
                     help="run the pipelined-vs-synchronous step-loop "
                     "A/B (decode-heavy traffic, >= 1.25x "
@@ -1335,6 +1592,21 @@ def main():
             args.heads = 4
             args.max_context = 64
             args.prompt_tokens = 8
+        if args.sampling:
+            # the pipeline smoke shape (the overlap balance point)
+            # with longer completions so the repetitive self-suffix
+            # settles and stochastic drafts get accepts at low
+            # temperature
+            args.requests = 12
+            args.max_new = 40
+            args.batch_size = 6
+            args.block_size = 8
+            args.vocab = 2048
+            args.hidden = 128
+            args.layers = 2
+            args.heads = 4
+            args.max_context = 128
+            args.prompt_tokens = 12
         if args.tp:
             # the tp A/B wants compute large enough that partitioned
             # dispatch doesn't dominate a sub-millisecond step, with
@@ -1417,6 +1689,11 @@ def main():
         if args.long_prompt is None:
             args.long_prompt = args.max_context * 7 // 8
         return run_shared_prefix_mode(args)
+
+    if args.sampling:
+        if args.prompt_tokens is None:
+            args.prompt_tokens = max(4, args.max_context // 8)
+        return run_sampling_mode(args)
 
     if args.speculative:
         if args.prompt_tokens is None:
