@@ -1,0 +1,51 @@
+"""apexlint — repo-specific static analysis for apex_tpu invariants.
+
+The serving stack's guarantees (bit-exact replay, dispatch-ahead
+overlap, one-trace-per-bucket, RLock-guarded ops access) are enforced
+dynamically by soaks and pinned tests; this package checks them
+*statically*, at the AST level, so a regression is caught as a class
+instead of as one seed's instance — the same move the reference
+Apex's amp pillar makes with its whitelist/blacklist cast
+classification (PAPER.md).
+
+Entry points:
+
+- ``python tools/apexlint.py [paths...]`` — the CLI (``--rule``,
+  ``--json``, ``--baseline``, ``--update-baseline``; exit 1 on new
+  findings).  The ``lint`` build-matrix axis and the L0 clean-repo
+  test both run it against ``[tool.apexlint]`` in pyproject.toml.
+- :func:`apex_tpu.analysis.run` over :data:`RULES` — the library
+  surface the tests use.
+
+Stdlib-only on purpose: analysis must not import jax or the code it
+analyzes.  See ``docs/analysis.md`` for the rule catalogue, the
+pragma/baseline workflow, and how to add a rule.
+"""
+
+from .core import (
+    AnalysisConfig,
+    Baseline,
+    DEFAULT_BASELINE,
+    Finding,
+    PARSE_RULE,
+    SourceModule,
+    in_scope,
+    load_config,
+    parse_toml_tables,
+    run,
+)
+from .rules import RULES
+
+__all__ = [
+    "AnalysisConfig",
+    "Baseline",
+    "DEFAULT_BASELINE",
+    "Finding",
+    "PARSE_RULE",
+    "RULES",
+    "SourceModule",
+    "in_scope",
+    "load_config",
+    "parse_toml_tables",
+    "run",
+]

@@ -1,0 +1,525 @@
+"""apexlint core — AST-level invariant analysis for the serving stack.
+
+Every guarantee the serving stack makes is enforced *dynamically*
+today: the chaos soak's bit-exact-replay oracle, the compile-count
+audits, the pinned-stats tests.  A soak only catches the instance a
+seed happens to exercise; the invariants themselves — counter-keyed
+determinism, zero host syncs between LAUNCH and RETIRE, one trace per
+bucket, RLock-guarded ops access — are *statically checkable
+properties of the source*.  This package checks them at the AST
+level, the same move the reference Apex makes for mixed precision
+(``amp.lists`` is a static whitelist/blacklist classification pass
+deciding casts before execution — PAPER.md): classify the code, not
+the execution.
+
+This module is the rule-agnostic substrate (``docs/analysis.md``):
+
+- :class:`SourceModule` — one parsed file: the AST, an import-alias
+  map (so ``np.asarray`` / ``numpy.asarray`` / ``from numpy import
+  asarray`` all resolve to ``numpy.asarray``), and the inline-pragma
+  index (``# apexlint: disable=RULE`` on a line, a ``def``/``class``
+  header, or the comment line above one; ``disable-file=RULE`` for
+  the whole file).
+- :class:`Finding` — one diagnostic: ``path:line [rule] message``.
+- :class:`Baseline` — the accepted-findings file
+  (``apex_tpu/analysis/baseline.json``): every entry carries a
+  written ``justification``; matching is count-aware on
+  (rule, path, message) so line drift never churns it.
+- :class:`AnalysisConfig` / :func:`load_config` — the
+  ``[tool.apexlint]`` block of ``pyproject.toml`` (rule
+  enable/disable, path excludes, per-rule options), parsed by a
+  dependency-free TOML-subset reader (this interpreter predates
+  ``tomllib``), so CI and local runs read one source of truth.
+- :func:`run` — walk files, run every enabled rule in scope, apply
+  pragma suppression, return sorted findings.
+
+Deliberately **stdlib-only** (``ast`` + ``json``): the linter must
+run in any environment that can read the source, without importing
+jax or the package under analysis.  Intra-package imports are
+relative so ``tools/apexlint.py`` can load it standalone.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# the rule id every file-parse failure is reported under (always
+# enabled: an unparseable file silently skipped would un-lint itself)
+PARSE_RULE = "parse-error"
+
+DEFAULT_BASELINE = "apex_tpu/analysis/baseline.json"
+
+_PRAGMA_RE = re.compile(
+    r"#\s*apexlint:\s*(disable-file|disable)\s*=\s*"
+    r"([A-Za-z0-9_,\- ]+?)(?=\s*(?:—|--|#|$))")
+
+_COMMENT_ONLY_RE = re.compile(r"^\s*#")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic.  ``key()`` deliberately omits the line number:
+    baseline matching survives unrelated edits shifting code."""
+
+    rule: str
+    path: str                      # repo-relative, posix separators
+    line: int
+    message: str
+    col: int = 0
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.message)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "path": self.path,
+                "line": self.line, "col": self.col,
+                "message": self.message}
+
+
+class SourceModule:
+    """One parsed source file plus the resolution context rules need:
+    import aliases, pragma suppression spans, and the raw lines."""
+
+    def __init__(self, relpath: str, text: str):
+        self.relpath = relpath.replace("\\", "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text)
+        self.aliases: Dict[str, str] = {}
+        self._file_rules: Set[str] = set()
+        self._line_rules: Dict[int, Set[str]] = {}
+        self._span_rules: List[Tuple[int, int, Set[str]]] = []
+        self._build_aliases()
+        self._build_pragmas()
+
+    @classmethod
+    def from_file(cls, path: Path, root: Path) -> "SourceModule":
+        return cls(relpath_under(path, root), path.read_text())
+
+    @classmethod
+    def from_source(cls, text: str, relpath: str) -> "SourceModule":
+        """Test fixture entry: analyze an inline snippet as if it
+        lived at ``relpath`` (rule path scoping keys on it)."""
+        return cls(relpath, text)
+
+    # -- alias resolution --------------------------------------------------
+
+    def _build_aliases(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.aliases[a.asname or a.name] = (
+                        f"{node.module}.{a.name}")
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted name of a Name/Attribute chain, resolved
+        through the module's import aliases (``np.asarray`` →
+        ``numpy.asarray``); None when the chain is not rooted at a
+        plain name (``self.x``, calls, subscripts)."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        base = self.aliases.get(node.id, node.id)
+        return ".".join([base] + parts[::-1])
+
+    # -- pragma suppression ------------------------------------------------
+
+    def _def_spans(self) -> Dict[int, Tuple[int, int]]:
+        spans: Dict[int, Tuple[int, int]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                spans[node.lineno] = (node.lineno, node.end_lineno
+                                      or node.lineno)
+        return spans
+
+    def _build_pragmas(self) -> None:
+        spans = self._def_spans()
+        for i, raw in enumerate(self.lines, start=1):
+            m = _PRAGMA_RE.search(raw)
+            if not m:
+                continue
+            kind = m.group(1)
+            # comma-separated rule ids; anything after whitespace in
+            # a segment is justification text, not a rule name
+            rules = {r.split()[0] for r in m.group(2).split(",")
+                     if r.split()}
+            if kind == "disable-file":
+                self._file_rules |= rules
+                continue
+            target = i
+            if _COMMENT_ONLY_RE.match(raw):
+                target = i + 1        # comment line governs the next
+            span = spans.get(target)
+            if span is not None:
+                self._span_rules.append((span[0], span[1], rules))
+            self._line_rules.setdefault(i, set()).update(rules)
+            self._line_rules.setdefault(target, set()).update(rules)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self._file_rules or "all" in self._file_rules:
+            return True
+        at = self._line_rules.get(line, ())
+        if rule in at or "all" in at:
+            return True
+        for lo, hi, rules in self._span_rules:
+            if lo <= line <= hi and (rule in rules or "all" in rules):
+                return True
+        return False
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(rule=rule, path=self.relpath,
+                       line=getattr(node, "lineno", 0),
+                       col=getattr(node, "col_offset", 0),
+                       message=message)
+
+
+# -- configuration (the [tool.apexlint] block) ----------------------------
+
+
+@dataclass
+class AnalysisConfig:
+    """What to run, where, and what's accepted — one object shared by
+    the CLI, the build-matrix axis, and the L0 clean-repo test."""
+
+    root: Path
+    enable: Optional[List[str]] = None     # None = every registered rule
+    exclude: List[str] = field(default_factory=list)
+    baseline: str = DEFAULT_BASELINE
+    rule_options: Dict[str, dict] = field(default_factory=dict)
+
+    def enabled_rules(self, registry: Dict[str, object],
+                      only: Optional[Sequence[str]] = None) -> List[str]:
+        names = list(self.enable) if self.enable is not None \
+            else sorted(registry)
+        unknown = [n for n in names if n not in registry]
+        if unknown:
+            raise KeyError(f"unknown rule(s) in config: {unknown}; "
+                           f"known: {sorted(registry)}")
+        if only:
+            bad = [n for n in only if n not in registry]
+            if bad:
+                raise KeyError(f"unknown rule(s): {bad}; "
+                               f"known: {sorted(registry)}")
+            names = [n for n in names if n in set(only)]
+        return names
+
+    def options_for(self, rule) -> dict:
+        merged = dict(rule.default_options)
+        merged.update(self.rule_options.get(rule.name, {}))
+        return merged
+
+
+def _strip_comment(line: str) -> str:
+    out, quote = [], None
+    for ch in line:
+        if quote:
+            out.append(ch)
+            if ch == quote:
+                quote = None
+        elif ch in "\"'":
+            quote = ch
+            out.append(ch)
+        elif ch == "#":
+            break
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def _parse_scalar(text: str):
+    text = text.strip()
+    if text.startswith(("\"", "'")):
+        return text[1:-1]
+    if text in ("true", "false"):
+        return text == "true"
+    try:
+        return int(text)
+    except ValueError:
+        pass
+    try:
+        return float(text)
+    except ValueError:
+        pass
+    return text
+
+
+def _split_array_items(inner: str) -> List[str]:
+    items, depth, quote, cur = [], 0, None, []
+    for ch in inner:
+        if quote:
+            cur.append(ch)
+            if ch == quote:
+                quote = None
+        elif ch in "\"'":
+            quote = ch
+            cur.append(ch)
+        elif ch == "[":
+            depth += 1
+            cur.append(ch)
+        elif ch == "]":
+            depth -= 1
+            cur.append(ch)
+        elif ch == "," and depth == 0:
+            items.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if "".join(cur).strip():
+        items.append("".join(cur))
+    return [i.strip() for i in items if i.strip()]
+
+
+def _parse_value(text: str):
+    text = text.strip()
+    if text.startswith("["):
+        return [_parse_value(i)
+                for i in _split_array_items(text[1:-1])]
+    return _parse_scalar(text)
+
+
+def _header_parts(header: str) -> List[str]:
+    parts, cur, quote = [], [], None
+    for ch in header:
+        if quote:
+            if ch == quote:
+                quote = None
+            else:
+                cur.append(ch)
+        elif ch in "\"'":
+            quote = ch
+        elif ch == ".":
+            parts.append("".join(cur).strip())
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur).strip())
+    return [p for p in parts if p]
+
+
+def parse_toml_tables(text: str) -> Dict[str, dict]:
+    """A TOML-subset reader for ``pyproject.toml``'s apexlint block:
+    ``[dotted."quoted".headers]`` + ``key = scalar-or-string-array``
+    (arrays may span lines).  Not a general TOML parser — just enough
+    for configuration this repo writes, with zero dependencies on an
+    interpreter that predates ``tomllib``."""
+    tables: Dict[str, dict] = {}
+    current: Optional[dict] = None
+    pending_key, pending_val = None, None
+    for raw in text.splitlines():
+        line = _strip_comment(raw).strip()
+        if not line:
+            continue
+        if pending_key is not None:
+            pending_val += " " + line
+            if pending_val.count("[") == pending_val.count("]"):
+                current[pending_key] = _parse_value(pending_val)
+                pending_key = pending_val = None
+            continue
+        if line.startswith("["):
+            name = ".".join(_header_parts(line.strip("[]")))
+            current = tables.setdefault(name, {})
+            continue
+        if current is None or "=" not in line:
+            continue
+        key, val = line.split("=", 1)
+        key = key.strip().strip("\"'")
+        val = val.strip()
+        if val.startswith("[") and val.count("[") != val.count("]"):
+            pending_key, pending_val = key, val
+            continue
+        current[key] = _parse_value(val)
+    return tables
+
+
+def load_config(root: Path,
+                pyproject: Optional[Path] = None) -> AnalysisConfig:
+    """The shared config entry: ``[tool.apexlint]`` (+ per-rule
+    ``[tool.apexlint."<rule>"]`` sub-tables) from the repo's
+    pyproject.  A missing file or block yields defaults."""
+    root = Path(root)
+    path = pyproject if pyproject is not None else root / "pyproject.toml"
+    cfg = AnalysisConfig(root=root)
+    if not Path(path).exists():
+        return cfg
+    tables = parse_toml_tables(Path(path).read_text())
+    top = tables.get("tool.apexlint", {})
+    if "enable" in top:
+        cfg.enable = list(top["enable"])
+    if "exclude" in top:
+        cfg.exclude = list(top["exclude"])
+    if "baseline" in top:
+        cfg.baseline = str(top["baseline"])
+    prefix = "tool.apexlint."
+    for name, table in tables.items():
+        if name.startswith(prefix):
+            cfg.rule_options[name[len(prefix):]] = dict(table)
+    return cfg
+
+
+# -- baseline -------------------------------------------------------------
+
+
+class Baseline:
+    """The accepted-findings ledger.  Every entry must carry a
+    human-written ``justification`` (the L0 tier asserts it); matching
+    is count-aware on (rule, path, message) so identical findings on
+    N lines need N entries, while pure line drift costs nothing."""
+
+    def __init__(self, entries: Optional[List[dict]] = None,
+                 path: Optional[Path] = None):
+        self.entries = list(entries or [])
+        self.path = path
+
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        path = Path(path)
+        if not path.exists():
+            return cls([], path=path)
+        data = json.loads(path.read_text())
+        return cls(list(data.get("findings", [])), path=path)
+
+    def match(self, findings: Sequence[Finding]):
+        """Split ``findings`` into (new, accepted) and report stale
+        baseline entries that matched nothing (fixed code whose
+        suppression should be deleted)."""
+        budget: Dict[Tuple[str, str, str], int] = {}
+        for e in self.entries:
+            k = (e.get("rule", ""), e.get("path", ""),
+                 e.get("message", ""))
+            budget[k] = budget.get(k, 0) + 1
+        new, accepted = [], []
+        for f in findings:
+            if budget.get(f.key(), 0) > 0:
+                budget[f.key()] -= 1
+                accepted.append(f)
+            else:
+                new.append(f)
+        stale = [k for k, n in budget.items() if n > 0
+                 for _ in range(n)]
+        return new, accepted, stale
+
+    def write(self, findings: Sequence[Finding], path: Path) -> None:
+        """``--update-baseline``: rewrite with the current findings,
+        keeping existing justifications for entries that still match
+        and stamping ``TODO: justify`` on new ones (the L0 baseline
+        test fails until a human replaces it)."""
+        just: Dict[Tuple[str, str, str], List[str]] = {}
+        for e in self.entries:
+            k = (e.get("rule", ""), e.get("path", ""),
+                 e.get("message", ""))
+            just.setdefault(k, []).append(
+                e.get("justification", ""))
+        out = []
+        for f in sorted(findings,
+                        key=lambda f: (f.path, f.line, f.rule)):
+            pool = just.get(f.key(), [])
+            j = pool.pop(0) if pool else "TODO: justify"
+            out.append({"rule": f.rule, "path": f.path,
+                        "line": f.line, "message": f.message,
+                        "justification": j})
+        payload = {"version": 1, "findings": out}
+        Path(path).write_text(json.dumps(payload, indent=2,
+                                         sort_keys=True) + "\n")
+
+
+# -- driving --------------------------------------------------------------
+
+
+def relpath_under(path: Path, root: Path) -> str:
+    """Repo-relative posix path, or the absolute posix path for files
+    outside the root (scratch fixtures still analyze; rule scoping
+    then matches on any path component via fnmatch patterns or the
+    suffix-matching in :func:`in_scope`)."""
+    try:
+        return Path(path).resolve().relative_to(
+            Path(root).resolve()).as_posix()
+    except ValueError:
+        return Path(path).resolve().as_posix()
+
+
+def in_scope(relpath: str, prefixes: Sequence[str]) -> bool:
+    """Path-scope check shared by every rule: ``prefixes`` entries are
+    repo-relative file paths, directory prefixes, or fnmatch
+    patterns."""
+    rooted = "/" + relpath
+    for p in prefixes:
+        p = p.rstrip("/")
+        if relpath == p or relpath.startswith(p + "/") \
+                or fnmatch.fnmatch(relpath, p):
+            return True
+        # absolute scratch paths (test fixtures under /tmp) match the
+        # scope as a path infix/suffix
+        if rooted.endswith("/" + p) or ("/" + p + "/") in rooted:
+            return True
+    return False
+
+
+def iter_source_files(paths: Sequence[Path],
+                      config: AnalysisConfig) -> Iterable[Path]:
+    seen = set()
+    for p in paths:
+        p = Path(p)
+        files = [p] if p.is_file() else sorted(p.rglob("*.py"))
+        for f in files:
+            if "__pycache__" in f.parts or f.suffix != ".py":
+                continue
+            rel = relpath_under(f, config.root)
+            if any(fnmatch.fnmatch(rel, pat) or in_scope(rel, [pat])
+                   for pat in config.exclude):
+                continue
+            if f not in seen:
+                seen.add(f)
+                yield f
+
+
+def run(paths: Sequence[Path], config: AnalysisConfig,
+        registry: Dict[str, object],
+        rule_names: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Analyze every file under ``paths`` with the enabled rules whose
+    path scope matches; pragma-suppressed findings are dropped and the
+    rest deduplicated per (rule, path, line) and sorted."""
+    names = config.enabled_rules(registry, rule_names)
+    findings: List[Finding] = []
+    for f in iter_source_files(paths, config):
+        try:
+            mod = SourceModule.from_file(f, config.root)
+        except SyntaxError as e:
+            findings.append(Finding(
+                rule=PARSE_RULE,
+                path=relpath_under(f, config.root),
+                line=e.lineno or 0,
+                message=f"file does not parse: {e.msg}"))
+            continue
+        for name in names:
+            rule = registry[name]
+            opts = config.options_for(rule)
+            if not in_scope(mod.relpath, opts.get("paths", ["."])):
+                continue
+            for finding in rule.check(mod, opts):
+                if not mod.suppressed(finding.rule, finding.line):
+                    findings.append(finding)
+    deduped: Dict[Tuple[str, str, int], Finding] = {}
+    for f in findings:
+        deduped.setdefault((f.rule, f.path, f.line), f)
+    return sorted(deduped.values(),
+                  key=lambda f: (f.path, f.line, f.rule))

@@ -1,0 +1,18 @@
+"""apexlint rule registry (``docs/analysis.md``, "Adding a rule").
+
+Each rule is a module exporting ``name`` (the pragma/CLI id),
+``summary`` (one line for ``--list-rules``), ``default_options``
+(must include ``paths`` — the repo-relative scope the rule runs
+over; overridable per rule from ``[tool.apexlint."<name>"]``), and
+``check(SourceModule, options) -> list[Finding]``.  Registering is
+importing + listing here.
+"""
+
+from . import determinism, donation, host_sync, locks, retrace
+
+_MODULES = (host_sync, determinism, retrace, locks, donation)
+
+RULES = {m.name: m for m in _MODULES}
+
+__all__ = ["RULES", "determinism", "donation", "host_sync", "locks",
+           "retrace"]

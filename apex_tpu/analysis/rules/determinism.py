@@ -1,0 +1,180 @@
+"""``determinism`` — decision-making code must be replayable.
+
+The invariant (PR 5, ``resilience/chaos.py``): the chaos soak's
+strongest oracle replays every healthy request against a fresh server
+and demands *bit-exact* output, and PR 13 extended it to stochastic
+sampling by keying every stream on ``(prompt, params, seed)`` counters.
+Both collapse the moment any scheduling/failure decision under
+``serving/`` or ``resilience/`` reads an unseeded RNG, the wall
+clock, or hash-randomized iteration order:
+
+- ``random.*`` module-level calls draw from the process-global RNG —
+  seeded by whoever ran first, perturbed by any library; a decision
+  made on it replays differently.  Use an owned, seeded
+  ``random.Random(seed)`` (the ``ChaosSchedule``/``retry`` pattern).
+- ``np.random.*`` legacy global calls, and *seedless*
+  ``default_rng()`` / ``RandomState()`` constructions, same class.
+- ``time.time()`` / ``time.monotonic()`` / ``time.perf_counter()``
+  called directly in decision code: deadlines and breaker windows
+  must flow through the injectable-clock pattern (a ``clock=``
+  parameter / ``self._clock`` attribute — every server, breaker,
+  watchdog, and meter in this repo takes one) or fake-clock tests
+  and replay can't pin them.  *References* (``clock=time.monotonic``
+  as a default) are the pattern itself and are not flagged.
+- Iterating a ``set`` (literal, ``set()``/``frozenset()`` call, set
+  comprehension, or a local assigned from one — including through
+  ``list()``/``tuple()``/``iter()``/``reversed()``) makes the visit
+  order hash-randomized across processes (PYTHONHASHSEED): eviction
+  scans, victim selection, and failover sweeps silently diverge
+  between the soak and its replay.  ``sorted(...)`` restores a total
+  order and is the sanctioned spelling.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from ..core import Finding, SourceModule, in_scope
+
+name = "determinism"
+summary = ("unseeded RNGs, direct wall-clock reads, and set-order "
+           "iteration silently break the bit-exact replay oracle")
+
+default_options = {
+    "paths": ["apex_tpu/serving", "apex_tpu/resilience"],
+}
+
+_ALLOWED_RANDOM = {"random.Random", "random.SystemRandom",
+                   "random.getstate", "random.setstate"}
+_SEEDED_NP_CTORS = {"numpy.random.default_rng",
+                    "numpy.random.RandomState",
+                    "numpy.random.Generator"}
+_TIME_CALLS = {"time.time", "time.monotonic", "time.perf_counter",
+               "time.time_ns", "time.monotonic_ns",
+               "time.perf_counter_ns"}
+_SET_CALLS = {"set", "frozenset"}
+_ORDER_PRESERVERS = {"list", "tuple", "iter", "reversed"}
+
+
+def _set_valued(node: ast.AST, local_sets: Dict[str, ast.AST],
+                mod: SourceModule, depth: int = 0) -> bool:
+    """Whether ``node`` evaluates to a set (or an order-preserving
+    view of one).  ``sorted(...)`` breaks the chain — a sorted set is
+    deterministic."""
+    if depth > 6:
+        return False
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        fn = mod.resolve(node.func)
+        if fn in _SET_CALLS:
+            return True
+        if fn in _ORDER_PRESERVERS and node.args:
+            return _set_valued(node.args[0], local_sets, mod,
+                               depth + 1)
+        return False
+    if isinstance(node, ast.Name):
+        src = local_sets.get(node.id)
+        if src is not None:
+            return _set_valued(src, local_sets, mod, depth + 1)
+    if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)):
+        return (_set_valued(node.left, local_sets, mod, depth + 1)
+                or _set_valued(node.right, local_sets, mod,
+                               depth + 1))
+    return False
+
+
+def _walk_scope(scope: ast.AST):
+    """Walk ``scope`` without descending into nested function scopes
+    (their locals are theirs; each gets its own pass)."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef, ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _local_set_assignments(scope: ast.AST,
+                           mod: SourceModule) -> Dict[str, ast.AST]:
+    """name -> value for simple assignments whose value is (possibly)
+    a set; one level of scope-local dataflow."""
+    out: Dict[str, ast.AST] = {}
+    for node in _walk_scope(scope):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            out[node.targets[0].id] = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                and isinstance(node.target, ast.Name):
+            out[node.target.id] = node.value
+    return out
+
+
+def _check_iteration(scope: ast.AST, mod: SourceModule,
+                     findings: List[Finding]) -> None:
+    local_sets = _local_set_assignments(scope, mod)
+    iters: List[ast.AST] = []
+    for node in _walk_scope(scope):
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            iters.append(node.iter)
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            iters.extend(g.iter for g in node.generators)
+    for it in iters:
+        if _set_valued(it, local_sets, mod):
+            findings.append(mod.finding(
+                name, it,
+                "iteration over a set is hash-order-randomized "
+                "across processes (PYTHONHASHSEED): a decision made "
+                "in this order diverges between the soak and its "
+                "bit-exact replay; wrap in sorted(...)"))
+
+
+def check(mod: SourceModule, options: dict) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        resolved = mod.resolve(node.func)
+        if resolved is None:
+            continue
+        if resolved.startswith("random.") \
+                and resolved not in _ALLOWED_RANDOM:
+            findings.append(mod.finding(
+                name, node,
+                f"{resolved}() draws from the process-global RNG; "
+                f"replay cannot reproduce it — use an owned seeded "
+                f"random.Random(seed) (the ChaosSchedule pattern)"))
+        elif resolved.startswith("numpy.random."):
+            if resolved in _SEEDED_NP_CTORS:
+                if not node.args and not node.keywords:
+                    findings.append(mod.finding(
+                        name, node,
+                        f"{resolved}() without a seed is entropy-"
+                        f"seeded; pass an explicit seed so the "
+                        f"replay oracle holds"))
+            else:
+                findings.append(mod.finding(
+                    name, node,
+                    f"{resolved}() uses numpy's global RNG; use a "
+                    f"seeded default_rng(seed) generator instead"))
+        elif resolved in _TIME_CALLS:
+            findings.append(mod.finding(
+                name, node,
+                f"direct {resolved}() read in decision code; route "
+                f"through the injectable clock (clock= parameter / "
+                f"self._clock) so fake-clock tests and replay can "
+                f"pin it"))
+    scopes = [mod.tree] + [
+        n for n in ast.walk(mod.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for scope in scopes:
+        _check_iteration(scope, mod, findings)
+    return findings
+
+
+def applies(relpath: str, options: dict) -> bool:
+    return in_scope(relpath, options.get("paths", []))

@@ -1,0 +1,103 @@
+"""``donation`` — buffer donation must be backend-gated.
+
+The invariant (PR 8, BENCH_NOTES r8): on the CPU backend a donated
+jit call executes **synchronously** (measured 10.2ms call / 0.06ms
+wait donated vs 0.08 / 10.5 plain) — donation re-serializes exactly
+the dispatch-ahead overlap the pipelined loop exists for.  On TPU the
+KV pool is the HBM hog and *must* donate for the in-place update.
+The shipped pattern (``DecodeEngine.__init__``):
+
+    donate = (1,) if jax.default_backend() != "cpu" else ()
+    jax.jit(fn, donate_argnums=donate)
+
+This rule flags ``jax.jit(..., donate_argnums=<literal>)`` — an
+*unconditional* donation — unless the enclosing function (or the
+module top level, for module-scope jits) visibly consults the
+backend (``jax.default_backend()`` or a ``.platform`` attribute).
+A donation spec that arrives as a name/expression is presumed
+computed from such a gate and stays silent; ``donate_argnums=()``
+is donation turned off and always fine.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..core import Finding, SourceModule, in_scope
+
+name = "donation"
+summary = ("unconditional donate_argnums serializes the CPU backend "
+           "and defeats the pipelined loop's dispatch-ahead")
+
+default_options = {
+    "paths": ["apex_tpu"],
+}
+
+
+def _literal_donation(node: ast.AST) -> Optional[str]:
+    """Repr of a literal, *non-empty* donate spec; None when the spec
+    is computed (presumed gated) or empty (donation off)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return repr(node.value)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        if not node.elts:
+            return None                      # () — donation off
+        if all(isinstance(e, ast.Constant) for e in node.elts):
+            return ast.unparse(node)
+    return None
+
+
+def _has_backend_gate(scope: ast.AST, mod: SourceModule) -> bool:
+    for n in ast.walk(scope):
+        if isinstance(n, ast.Call) \
+                and mod.resolve(n.func) == "jax.default_backend":
+            return True
+        if isinstance(n, ast.Attribute) and n.attr == "platform":
+            return True
+    return False
+
+
+def check(mod: SourceModule, options: dict) -> List[Finding]:
+    findings: List[Finding] = []
+    # enclosing-function map: lineno spans -> function node
+    funcs = [n for n in ast.walk(mod.tree)
+             if isinstance(n, (ast.FunctionDef,
+                               ast.AsyncFunctionDef))]
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = mod.resolve(node.func)
+        if fn not in ("jax.jit", "jit") \
+                and not (fn in ("functools.partial", "partial")
+                         and node.args
+                         and mod.resolve(node.args[0]) in ("jax.jit",
+                                                           "jit")):
+            continue
+        for kw in node.keywords:
+            if kw.arg != "donate_argnums":
+                continue
+            lit = _literal_donation(kw.value)
+            if lit is None:
+                continue
+            enclosing = [f for f in funcs
+                         if f.lineno <= node.lineno
+                         <= (f.end_lineno or f.lineno)]
+            scope: ast.AST = min(
+                enclosing,
+                key=lambda f: (f.end_lineno or f.lineno) - f.lineno,
+            ) if enclosing else mod.tree
+            if _has_backend_gate(scope, mod):
+                continue
+            findings.append(mod.finding(
+                name, node,
+                f"unconditional donate_argnums={lit}: a donated jit "
+                f"call executes synchronously on the CPU backend "
+                f"(BENCH_NOTES r8) and re-serializes the pipelined "
+                f"loop — gate on jax.default_backend() like "
+                f"DecodeEngine._jit"))
+    return findings
+
+
+def applies(relpath: str, options: dict) -> bool:
+    return in_scope(relpath, options.get("paths", []))

@@ -1,0 +1,203 @@
+"""``lock-discipline`` — cross-thread state goes through the RLock.
+
+The invariant (PR 9, docs/observability.md "Ops plane & watchdog"):
+while an ops plane is attached, ``step()`` / ``submit()`` / ``stats()``
+serialize through ``OpsServer.lock``, and the handler threads reach
+server state only under that lock — except the two *documented*
+lock-free paths (``/healthz``, ``/metrics``), which must stay
+answerable while the serve loop is wedged holding it.  The same
+contract covers the watchdog thread's stall handler and the router
+fleet's front-door/ops methods (``RouterFleet`` takes the fleet ops
+lock around placement and stats).
+
+The rule builds an attribute-access map per configured class: inside
+each **thread method** (a method that runs on a foreign thread —
+HTTP handler, watchdog, client caller), every attribute read/write
+rooted at the class's **state expression** (``self`` for the servers,
+``self.server`` for the ops plane, followed through local aliases
+like ``srv = self.server`` and ``sched = srv.scheduler``) must be
+lexically inside ``with self.<lock>`` (the ``with (self._ops_lock or
+_NO_LOCK)`` spelling counts).  Documented lock-free paths carry
+``# apexlint: disable=lock-discipline`` with the justification.
+
+Class specs are configurable (``[tool.apexlint."lock-discipline"]``
+``classes`` as ``"Class:lock:state:method,method"`` strings) so new
+threaded surfaces opt in as they land.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..core import Finding, SourceModule, in_scope
+
+name = "lock-discipline"
+summary = ("cross-thread attribute access outside the documented "
+           "RLock path races the step loop")
+
+# "Class:lock_attr:state_expr:method,method,..." — state_expr is
+# "self" or "self.<attr>" (the object whose attributes are the
+# cross-thread state)
+DEFAULT_CLASSES = [
+    "OpsServer:lock:self.server:"
+    "_handle,_healthz,_flight,_request,_drain,_postmortem",
+    "InferenceServer:_ops_lock:self:_on_watchdog_stall",
+    "RouterFleet:_ops_lock:self:"
+    "submit,stats,drain,drain_replica,replica_drained,revive,close",
+    "ReplicaRouter:_ops_lock:self:",
+]
+
+default_options = {
+    "paths": ["apex_tpu/serving", "apex_tpu/observability"],
+    "classes": DEFAULT_CLASSES,
+}
+
+
+def _parse_specs(specs) -> Dict[str, dict]:
+    out = {}
+    for s in specs:
+        cls, lock, state, methods = (s.split(":") + ["", "", ""])[:4]
+        out[cls] = {
+            "lock": lock,
+            "state": state or "self",
+            "methods": [m for m in methods.split(",") if m],
+        }
+    return out
+
+
+def _attr_chain(node: ast.AST) -> Optional[List[str]]:
+    """['self', 'server', 'scheduler'] for ``self.server.scheduler``;
+    None when not a pure name/attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+class _MethodChecker(ast.NodeVisitor):
+    """Walk one thread method tracking (a) whether the lexical
+    position is under ``with self.<lock>`` and (b) local aliases of
+    the state expression, flagging unguarded state access."""
+
+    def __init__(self, mod: SourceModule, spec: dict, cls_name: str,
+                 method: ast.FunctionDef, findings: List[Finding]):
+        self.mod = mod
+        self.spec = spec
+        self.cls = cls_name
+        self.method = method
+        self.findings = findings
+        self.locked = 0
+        # names aliasing the guarded object (or sub-objects of it)
+        self.state_aliases: Set[str] = set()
+        state = spec["state"].split(".")
+        self.state_chain = state          # ["self"] or ["self","server"]
+
+    # -- state rooting ------------------------------------------------------
+
+    def _is_state_rooted(self, chain: Optional[List[str]]) -> bool:
+        if not chain:
+            return False
+        if chain[:2] == ["self", self.spec["lock"]]:
+            return False              # the lock itself is not state
+        if chain[0] in self.state_aliases:
+            return True
+        n = len(self.state_chain)
+        return chain[:n] == self.state_chain and len(chain) > n
+
+    def _is_lock_expr(self, node: ast.AST) -> bool:
+        """``self.<lock>`` — possibly wrapped in the ``(self._ops_lock
+        or _NO_LOCK)`` BoolOp spelling."""
+        if isinstance(node, ast.BoolOp):
+            return any(self._is_lock_expr(v) for v in node.values)
+        chain = _attr_chain(node)
+        return bool(chain) and len(chain) == 2 \
+            and chain[0] == "self" and chain[1] == self.spec["lock"]
+
+    # -- visitors -----------------------------------------------------------
+
+    def visit_With(self, node: ast.With) -> None:
+        holds = any(self._is_lock_expr(item.context_expr)
+                    for item in node.items)
+        for item in node.items:
+            if not self._is_lock_expr(item.context_expr):
+                self.visit(item.context_expr)
+        if holds:
+            self.locked += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if holds:
+            self.locked -= 1
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        chain = _attr_chain(node.value)
+        # aliasing the state root itself (``srv = self.server``) or a
+        # sub-object of it (``sched = srv.scheduler``) taints the name
+        if chain and (chain == self.state_chain
+                      or self._is_state_rooted(chain)):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.state_aliases.add(tgt.id)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # ``self.other_method(...)`` is delegation, not state access:
+        # the callee is auditable on its own (and self-locks when it
+        # must).  Only same-object single-hop calls qualify — a call
+        # THROUGH guarded state (``self.server.stats()``) is still a
+        # state read of the receiver chain.
+        if (self.state_chain == ["self"]
+                and isinstance(node.func, ast.Attribute)
+                and _attr_chain(node.func) == ["self",
+                                               node.func.attr]):
+            for arg in node.args:
+                self.visit(arg)
+            for kw in node.keywords:
+                self.visit(kw.value)
+            return
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        chain = _attr_chain(node)
+        if self.locked == 0 and self._is_state_rooted(chain):
+            verb = ("write" if isinstance(node.ctx,
+                                          (ast.Store, ast.Del))
+                    else "read")
+            self.findings.append(self.mod.finding(
+                name, node,
+                f"{self.cls}.{self.method.name}() runs on a foreign "
+                f"thread but {verb}s {'.'.join(chain)} outside "
+                f"'with self.{self.spec['lock']}': races the step "
+                f"loop — take the lock, or document the lock-free "
+                f"contract with a pragma"))
+            return                     # one finding per chain root
+        self.generic_visit(node)
+
+
+def check(mod: SourceModule, options: dict) -> List[Finding]:
+    findings: List[Finding] = []
+    specs = _parse_specs(options.get("classes", DEFAULT_CLASSES))
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ClassDef) or node.name not in specs:
+            continue
+        spec = specs[node.name]
+        methods = {m.name: m for m in node.body
+                   if isinstance(m, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+        for mname in spec["methods"]:
+            m = methods.get(mname)
+            if m is None:
+                continue
+            checker = _MethodChecker(mod, spec, node.name, m,
+                                     findings)
+            for stmt in m.body:
+                checker.visit(stmt)
+    return findings
+
+
+def applies(relpath: str, options: dict) -> bool:
+    return in_scope(relpath, options.get("paths", []))

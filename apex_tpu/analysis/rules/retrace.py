@@ -1,0 +1,314 @@
+"""``retrace`` — one trace per program/bucket, enforced at the source.
+
+The invariant (PR 1's compile-count audit, hardened every PR since):
+each engine program compiles once per (bucket, width) key, and
+``stats()["programs"]`` + ``verify_compiles()`` audit the *count*
+after the fact.  The audits catch a retrace storm only once a test
+happens to drive the offending shape twice; the hazards themselves
+are visible in the source:
+
+- **Scalar arguments outside static_argnums.**  A Python
+  scalar/``len(...)`` passed in a *dynamic* position traces as a
+  weak-typed constant: drift between ``3`` and ``3.0`` (or an
+  occasional ``np.int32``) silently forks the jit cache, and marking
+  it static instead retraces per *value*.  The repo convention is to
+  ship everything through one committed ``device_put`` struct
+  (``DecodeEngine._put``) — flag literal/``len()`` args at non-static
+  positions of known-jitted callables.
+- **f-string-shaped arguments** — a string built per call
+  (``JoinedStr``) in a jit argument is a new static value per
+  formatting, a guaranteed per-call retrace.
+- **Inline-jitted lambdas with free variables** — ``jax.jit(lambda
+  x: x * scale)`` closes over ``scale`` at trace time; rebinding the
+  name never retraces (stale constant) and an unhashable capture
+  makes the cache miss every call.  Hoist to a named function taking
+  the state as an argument.
+- **Jitted functions reading module-level mutable state** — a dict /
+  list / set global read inside a ``@jax.jit`` body is captured at
+  trace time; later mutation is silently ignored (the PR-8 donation
+  finding's cousin: invisible until someone diffs outputs).
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Finding, SourceModule, in_scope
+
+name = "retrace"
+summary = ("jit call-site and closure patterns that fork or stale the "
+           "trace cache behind the compile-count audits' back")
+
+default_options = {
+    "paths": ["apex_tpu/serving", "apex_tpu/ops"],
+}
+
+_MUTABLE_CTORS = {"dict", "list", "set", "collections.defaultdict",
+                  "collections.deque", "collections.OrderedDict"}
+
+
+def _is_jax_jit(node: ast.AST, mod: SourceModule) -> Optional[ast.Call]:
+    """The ``jax.jit(...)`` call inside ``node`` when node is
+    ``jax.jit(...)`` itself or ``functools.partial(jax.jit, ...)``;
+    None otherwise."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = mod.resolve(node.func)
+    if fn in ("jax.jit", "jit"):
+        return node
+    if fn in ("functools.partial", "partial") and node.args \
+            and mod.resolve(node.args[0]) in ("jax.jit", "jit"):
+        return node
+    return None
+
+
+def _static_spec(jit_call: ast.Call) -> Tuple[Set[int], Set[str], bool]:
+    """(static positions, static names, fully_known): literal
+    static_argnums/static_argnames pulled off the jit call.  Non-
+    literal specs return fully_known=False and disable the call-site
+    scalar check (conservative silence)."""
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    known = True
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnums":
+            vals = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) \
+                        and isinstance(v.value, int):
+                    nums.add(v.value)
+                else:
+                    known = False
+        elif kw.arg == "static_argnames":
+            vals = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) \
+                        and isinstance(v.value, str):
+                    names.add(v.value)
+                else:
+                    known = False
+    return nums, names, known
+
+
+def _scalar_arg(node: ast.AST) -> Optional[str]:
+    """A description when ``node`` is a retrace-hazard argument —
+    a bare Python numeric literal, a ``len(...)`` host int, or an
+    f-string; None for anything else."""
+    if isinstance(node, ast.Constant) and isinstance(
+            node.value, (int, float)) \
+            and not isinstance(node.value, bool):
+        return f"Python scalar literal {node.value!r}"
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id == "len":
+        return "host int from len(...)"
+    if isinstance(node, ast.JoinedStr):
+        return "f-string (new static value per formatting)"
+    return None
+
+
+_BUILTIN_NAMES = set(dir(builtins))
+
+
+def _lambda_free_names(lam: ast.Lambda, mod: SourceModule) -> List[str]:
+    bound = {a.arg for a in (lam.args.args + lam.args.kwonlyargs
+                             + lam.args.posonlyargs)}
+    if lam.args.vararg:
+        bound.add(lam.args.vararg.arg)
+    if lam.args.kwarg:
+        bound.add(lam.args.kwarg.arg)
+    free = []
+    for n in ast.walk(lam.body):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                and n.id not in bound and n.id not in mod.aliases \
+                and n.id not in _BUILTIN_NAMES:
+            free.append(n.id)
+    return free
+
+
+class _JitIndex:
+    """Module-wide map of jitted callables: plain names (module defs
+    and module-level assignments) and ``self.<attr>`` slots, each with
+    its literal static spec and, when resolvable, the wrapped
+    function's positional parameter names."""
+
+    def __init__(self, mod: SourceModule):
+        self.mod = mod
+        self.by_name: Dict[str, dict] = {}
+        self.by_attr: Dict[str, dict] = {}
+        self.defs: Dict[str, ast.FunctionDef] = {
+            n.name: n for n in ast.walk(mod.tree)
+            if isinstance(n, ast.FunctionDef)}
+        self.jit_bodies: List[ast.FunctionDef] = []
+        self._build()
+
+    def _spec_for(self, jit_call: ast.Call,
+                  fn_node: Optional[ast.AST]) -> dict:
+        nums, names, known = _static_spec(jit_call)
+        params: Optional[List[str]] = None
+        if isinstance(fn_node, ast.Name) \
+                and fn_node.id in self.defs:
+            fd = self.defs[fn_node.id]
+            params = [a.arg for a in fd.args.args]
+        elif isinstance(fn_node, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+            params = [a.arg for a in fn_node.args.args]
+        if names and params is not None:
+            nums |= {params.index(n) for n in names if n in params}
+        elif names and params is None:
+            known = False        # static-by-name at unknown positions
+        return {"static_nums": nums, "static_names": names,
+                "known": known, "params": params}
+
+    def _build(self) -> None:
+        for node in ast.walk(self.mod.tree):
+            # X = jax.jit(f, ...) / X = partial(jax.jit, ...) and
+            # self._x = jax.jit(...)
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                jit_call = _is_jax_jit(node.value, self.mod)
+                if jit_call is None:
+                    continue
+                if self.mod.resolve(jit_call.func) in (
+                        "functools.partial", "partial"):
+                    wrapped = (jit_call.args[1]
+                               if len(jit_call.args) > 1 else None)
+                else:
+                    wrapped = (jit_call.args[0]
+                               if jit_call.args else None)
+                spec = self._spec_for(jit_call, wrapped)
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name):
+                    self.by_name[tgt.id] = spec
+                elif isinstance(tgt, ast.Attribute) and isinstance(
+                        tgt.value, ast.Name) and tgt.value.id == "self":
+                    self.by_attr[tgt.attr] = spec
+            # @jax.jit / @functools.partial(jax.jit, ...) decorators
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    jit_call = _is_jax_jit(dec, self.mod)
+                    is_bare = self.mod.resolve(dec) in ("jax.jit",
+                                                        "jit")
+                    if jit_call is None and not is_bare:
+                        continue
+                    if jit_call is None:
+                        spec = {"static_nums": set(),
+                                "static_names": set(),
+                                "known": True,
+                                "params": [a.arg
+                                           for a in node.args.args]}
+                    else:
+                        spec = self._spec_for(jit_call, node)
+                    self.by_name[node.name] = spec
+                    self.jit_bodies.append(node)
+                    break
+
+    def lookup(self, call: ast.Call) -> Optional[dict]:
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            return self.by_name.get(fn.id)
+        if isinstance(fn, ast.Attribute) and isinstance(
+                fn.value, ast.Name) and fn.value.id == "self":
+            return self.by_attr.get(fn.attr)
+        return None
+
+
+def _mutable_globals(mod: SourceModule) -> Set[str]:
+    out: Set[str] = set()
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign):
+            targets = [t for t in node.targets
+                       if isinstance(t, ast.Name)]
+            v = node.value
+            mutable = isinstance(v, (ast.Dict, ast.List, ast.Set,
+                                     ast.DictComp, ast.ListComp,
+                                     ast.SetComp))
+            if isinstance(v, ast.Call) \
+                    and mod.resolve(v.func) in _MUTABLE_CTORS:
+                mutable = True
+            if mutable:
+                out.update(t.id for t in targets)
+    return out
+
+
+def check(mod: SourceModule, options: dict) -> List[Finding]:
+    findings: List[Finding] = []
+    index = _JitIndex(mod)
+    mutables = _mutable_globals(mod)
+
+    # (a) inline-jitted lambdas with free variables
+    for node in ast.walk(mod.tree):
+        jit_call = _is_jax_jit(node, mod)
+        if jit_call is None:
+            continue
+        target = jit_call.args[0] if jit_call.args else None
+        if mod.resolve(jit_call.func) in ("functools.partial",
+                                          "partial"):
+            target = jit_call.args[1] if len(jit_call.args) > 1 \
+                else None
+        if isinstance(target, ast.Lambda):
+            free = _lambda_free_names(target, mod)
+            if free:
+                findings.append(mod.finding(
+                    name, node,
+                    f"inline-jitted lambda closes over "
+                    f"{sorted(set(free))}: captured at trace time, "
+                    f"never retraced on rebind (stale constant) — "
+                    f"hoist to a named function and pass state as "
+                    f"arguments"))
+
+    # (b) jitted bodies reading module-level mutable state
+    for body in index.jit_bodies:
+        params = {a.arg for a in body.args.args}
+        for n in ast.walk(body):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                    and n.id in mutables and n.id not in params:
+                findings.append(mod.finding(
+                    name, n,
+                    f"jitted function {body.name}() reads module-"
+                    f"level mutable {n.id!r}: captured once at trace "
+                    f"time, later mutation silently ignored — pass "
+                    f"it as an argument or freeze it"))
+
+    # (c) call-site scalars outside static positions
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        spec = index.lookup(node)
+        if spec is None or not spec["known"]:
+            continue
+        for i, arg in enumerate(node.args):
+            desc = _scalar_arg(arg)
+            if desc is None or i in spec["static_nums"]:
+                continue
+            findings.append(mod.finding(
+                name, arg,
+                f"{desc} passed at dynamic position {i} of a jitted "
+                f"callable: weak-type/dtype drift forks the trace "
+                f"cache behind the compile-count audit — ship a "
+                f"committed device array (engine._put) or mark the "
+                f"position static"))
+        for kw in node.keywords:
+            if kw.arg is None or kw.arg in spec["static_names"]:
+                continue
+            if spec["params"] is not None \
+                    and kw.arg in spec["params"] \
+                    and spec["params"].index(kw.arg) \
+                    in spec["static_nums"]:
+                continue
+            desc = _scalar_arg(kw.value)
+            if desc is not None:
+                findings.append(mod.finding(
+                    name, kw.value,
+                    f"{desc} passed as dynamic keyword "
+                    f"{kw.arg!r} of a jitted callable: weak-type/"
+                    f"dtype drift forks the trace cache — ship a "
+                    f"device array or mark it static"))
+    return findings
+
+
+def applies(relpath: str, options: dict) -> bool:
+    return in_scope(relpath, options.get("paths", []))

@@ -139,6 +139,7 @@ class OpsServer:
                     return self._count_send(h, "healthz",
                                             *self._healthz())
                 if path == "/metrics":
+                    # apexlint: disable=lock-discipline — documented lock-free: the registry serializes internally and a scrape must not block behind a wedged step
                     text = self.server.registry.prometheus_text()
                     return self._count_send(
                         h, "metrics", 200, text.encode(),
@@ -201,6 +202,7 @@ class OpsServer:
 
     # -- endpoint bodies ---------------------------------------------------
 
+    # apexlint: disable=lock-discipline — documented lock-free contract: health MUST answer while the serve loop is wedged holding the ops lock
     def _healthz(self) -> Tuple[int, bytes, str]:
         """Lock-free health: readable even while the serve loop is
         wedged inside a step holding the ops lock."""
@@ -282,15 +284,20 @@ class OpsServer:
             "requests_finished": stats["requests_finished"]})
 
     def _postmortem(self) -> Tuple[int, bytes, str]:
+        """Bundle-path choice AND the dump run under one lock hold:
+        picking the name from an unlocked ``_iter`` read raced the
+        step loop (apexlint lock-discipline) and left a TOCTOU
+        between the exists() scan and the write."""
         srv = self.server
-        base = srv._postmortem_dir or tempfile.gettempdir()
-        path = os.path.join(base, f"ops_postmortem_iter{srv._iter}")
-        i = 1
-        while os.path.exists(path):
-            path = os.path.join(
-                base, f"ops_postmortem_iter{srv._iter}_{i}")
-            i += 1
         with self.lock:
+            base = srv._postmortem_dir or tempfile.gettempdir()
+            path = os.path.join(base,
+                                f"ops_postmortem_iter{srv._iter}")
+            i = 1
+            while os.path.exists(path):
+                path = os.path.join(
+                    base, f"ops_postmortem_iter{srv._iter}_{i}")
+                i += 1
             manifest = srv.dump_postmortem(path, reason="ops_request")
         return _json(200, {"path": path, "manifest": manifest})
 

@@ -1533,6 +1533,7 @@ class InferenceServer:
         self.dump_postmortem(path, reason=reason, extra=extra)
         return path
 
+    # apexlint: disable=lock-discipline — documented lock-free: runs on the watchdog thread while the serve thread is wedged, possibly holding the ops lock; taking it here would deadlock the black box
     def _on_watchdog_stall(self, info: dict) -> Optional[str]:
         """The armed watchdog's stall handler — runs ON THE WATCHDOG
         THREAD while the serve thread is still stuck, so it takes no

@@ -236,10 +236,10 @@ class RouterFleet:
                deadline_iters: Optional[int] = None,
                deadline_s: Optional[float] = None) -> RouterRequest:
         """Route one request (see :meth:`ReplicaRouter.submit`)."""
-        if self._closed:
-            raise RuntimeError(
-                "RouterFleet is closed; no further submissions")
         with (self._ops_lock or _NO_LOCK):
+            if self._closed:
+                raise RuntimeError(
+                    "RouterFleet is closed; no further submissions")
             if self._draining:
                 # fleet-level drain: finish at the front door exactly
                 # like a draining single server would — without
@@ -366,9 +366,17 @@ class RouterFleet:
         admitting, then the fleet steps until all in-flight work
         reaches terminal states.  Idempotent; returns the final
         :meth:`stats`."""
-        self._draining = True
-        for rep in self.replicas:
-            rep.server.begin_drain()
+        # admissions stop atomically w.r.t. concurrent submit()/step()
+        # holders of the ops lock (apexlint lock-discipline: the flag
+        # write used to race the handler threads)
+        with (self._ops_lock or _NO_LOCK):
+            self._draining = True
+            for rep in self.replicas:
+                rep.server.begin_drain()
+        # the convergence loop runs unlocked on purpose: step()
+        # re-locks per iteration, and holding across it would starve
+        # ops handlers; a stale has_work read only costs one extra step
+        # apexlint: disable=lock-discipline — convergence loop; step() self-locks per iteration
         while self.has_work:
             self.step()
         return self.stats()
@@ -377,19 +385,29 @@ class RouterFleet:
         """Drain, then close every replica, stop the thread pool and
         the ops plane, and refuse further submissions.  Exactly-once;
         repeated calls return the same final stats."""
-        if self._closed:
-            return self._final_stats
-        self._final_stats = self.drain()
-        self._closed = True
-        for rep in self.replicas:
+        with (self._ops_lock or _NO_LOCK):
+            if self._closed:
+                return self._final_stats
+        final = self.drain()
+        with (self._ops_lock or _NO_LOCK):
+            if self._closed:       # lost a concurrent close(): keep
+                return self._final_stats        # the first result
+            self._final_stats = final
+            self._closed = True
+            replicas = list(self.replicas)
+            pool, ops = self._pool, self.ops
+        for rep in replicas:
             srv = rep.server
             if not srv.closed and not srv.scheduler.has_work:
                 srv.close()
-        if self._pool is not None:
-            self._pool.shutdown(wait=True)
-        if self.ops is not None:
-            self.ops.stop()
-        return self._final_stats
+        # teardown after the flag flip, unlocked: joining the ops
+        # thread while holding its own lock would deadlock any
+        # handler blocked on that lock
+        if pool is not None:
+            pool.shutdown(wait=True)
+        if ops is not None:
+            ops.stop()
+        return final
 
     # -- observability -----------------------------------------------------
 

@@ -1,0 +1,562 @@
+"""apexlint unit + clean-repo tier (``docs/analysis.md``).
+
+Per-rule oracles on inline snippet fixtures: every rule must FIRE on
+a known-bad snippet and stay SILENT on the matching known-good one —
+the same pairing discipline the amp list tests apply to the cast
+classifier.  Fixtures marked "regression:" reproduce findings
+apexlint surfaced (and this PR fixed) in the real tree, so the fixed
+pattern can never quietly return.
+
+The repo-level half pins the workflow: ``apex_tpu/`` is clean modulo
+the baseline, every baseline entry carries a written justification,
+and the CLI reads the same ``[tool.apexlint]`` block as this test
+(CI and local runs cannot drift).
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from apex_tpu.analysis import (
+    RULES,
+    AnalysisConfig,
+    Baseline,
+    Finding,
+    SourceModule,
+    load_config,
+    parse_toml_tables,
+    run,
+)
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+def check(rule_name, source, relpath=None, **option_overrides):
+    """Run one rule over an inline snippet 'located' at ``relpath``
+    (defaults to the first path in the rule's scope)."""
+    rule = RULES[rule_name]
+    opts = dict(rule.default_options)
+    opts.update(option_overrides)
+    if relpath is None:
+        p = opts["paths"][0]
+        relpath = p if p.endswith(".py") else p + "/fixture.py"
+    mod = SourceModule.from_source(source, relpath)
+    return [f for f in rule.check(mod, opts)
+            if not mod.suppressed(f.rule, f.line)]
+
+
+# -- host-sync -------------------------------------------------------------
+
+
+HOST_SYNC_BAD = """
+import numpy as np
+import jax
+
+class InferenceServer:
+    def _step(self):
+        ids, fin = self.engine.decode_sampled(t, p, tb)
+        tok = int(np.asarray(ids)[0])          # sync in PLAN
+        if bool(np.asarray(fin)[0]):
+            pass
+        x = ids.item()
+        jax.device_get(ids)
+"""
+
+HOST_SYNC_GOOD = """
+import numpy as np
+
+class InferenceServer:
+    def _step(self):
+        b = self.engine.max_batch_size
+        tokens = np.zeros((b,), np.int32)      # host array prep: fine
+        n = len(tokens)
+        self._inflight = ("decode", tokens)
+
+    def _flush_window(self):
+        import jax
+        return jax.device_get(self._inflight)  # RETIRE may sync
+"""
+
+
+def test_host_sync_fires_on_plan_section_syncs():
+    msgs = [f.message for f in check("host-sync", HOST_SYNC_BAD)]
+    assert any("int(...)" in m for m in msgs)
+    assert any("numpy.asarray" in m for m in msgs)
+    assert any(".item()" in m for m in msgs)
+    assert any("jax.device_get" in m for m in msgs)
+
+
+def test_host_sync_silent_on_host_prep_and_retire():
+    assert check("host-sync", HOST_SYNC_GOOD) == []
+
+
+def test_host_sync_flags_numpy_inside_jitted_impl_body():
+    src = ("import numpy as np\n"
+           "class E:\n"
+           "    def _decode_impl(self, params, cache, tokens):\n"
+           "        return np.asarray(tokens)\n")
+    found = check("host-sync", src,
+                  relpath="apex_tpu/serving/engine.py")
+    assert len(found) == 1 and "jitted program body" in \
+        found[0].message
+
+
+# -- determinism -----------------------------------------------------------
+
+
+DETERMINISM_BAD = """
+import random
+import time
+import numpy as np
+
+def pick_victim(requests):
+    t = time.monotonic()                 # direct wall-clock read
+    jitter = random.random()             # process-global RNG
+    noise = np.random.rand(3)            # numpy global RNG
+    rng = np.random.default_rng()        # seedless generator
+    return t + jitter
+"""
+
+DETERMINISM_GOOD = """
+import random
+import time
+import numpy as np
+
+class Sched:
+    def __init__(self, seed, clock=time.monotonic):
+        self.rng = random.Random(seed)   # owned, seeded
+        self._clock = clock              # injectable reference
+
+    def pick(self):
+        now = self._clock()
+        g = np.random.default_rng(0)     # seeded generator
+        return now, self.rng.random(), g.random()
+"""
+
+
+def test_determinism_fires_on_global_rng_and_wall_clock():
+    msgs = [f.message for f in check("determinism", DETERMINISM_BAD)]
+    assert any("random.random" in m for m in msgs)
+    assert any("time.monotonic" in m for m in msgs)
+    assert any("numpy.random.rand" in m for m in msgs)
+    assert any("without a seed" in m for m in msgs)
+    assert len(msgs) == 4
+
+
+def test_determinism_silent_on_seeded_and_injected():
+    assert check("determinism", DETERMINISM_GOOD) == []
+
+
+SET_ITER_BAD = """
+def evict(holds):
+    victims = set(holds)
+    for v in victims:                    # hash-randomized order
+        v.release()
+    for u in list({h.uid for h in holds}):
+        drop(u)
+"""
+
+SET_ITER_GOOD = """
+def evict(holds):
+    victims = set(holds)
+    for v in sorted(victims, key=lambda h: h.uid):
+        v.release()
+    order = {}
+    for k in order:                      # dicts are insertion-ordered
+        pass
+"""
+
+
+def test_determinism_fires_on_set_iteration():
+    found = check("determinism", SET_ITER_BAD)
+    assert len(found) == 2
+    assert all("hash-order-randomized" in f.message for f in found)
+
+
+def test_determinism_silent_on_sorted_sets_and_dicts():
+    assert check("determinism", SET_ITER_GOOD) == []
+
+
+# -- retrace ---------------------------------------------------------------
+
+
+RETRACE_BAD = """
+import jax
+
+_prog = jax.jit(lambda x: x * scale)     # closure capture
+
+_CACHE = {}
+
+@jax.jit
+def step(params, x):
+    return _CACHE, params, x             # mutable-global read
+
+decode = jax.jit(decode_impl)
+
+def launch(tokens):
+    return decode(tokens, 4)             # scalar at dynamic position
+"""
+
+RETRACE_GOOD = """
+import functools
+import jax
+import jax.numpy as jnp
+
+_prog = jax.jit(lambda x: x * 2.0)       # no free variables
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def bucketed(x, width):
+    return x[:width]
+
+def launch(x):
+    return bucketed(x, 64)               # static position: fine
+
+def plain(tokens, engine):
+    return engine._decode_jit(tokens)    # unknown callee: silent
+"""
+
+
+def test_retrace_fires_on_closures_globals_and_scalars():
+    msgs = [f.message for f in check("retrace", RETRACE_BAD)]
+    assert any("closes over" in m and "scale" in m for m in msgs)
+    assert any("_CACHE" in m for m in msgs)
+    assert any("dynamic position 1" in m for m in msgs)
+
+
+def test_retrace_silent_on_static_positions_and_pure_lambdas():
+    assert check("retrace", RETRACE_GOOD) == []
+
+
+def test_retrace_static_argnames_resolved_through_signature():
+    src = ("import functools\n"
+           "import jax\n"
+           "@functools.partial(jax.jit, static_argnames=('bq',))\n"
+           "def attn(q, bq):\n"
+           "    return q\n"
+           "def call(q):\n"
+           "    return attn(q, 128)\n")       # bq static via name
+    assert check("retrace", src) == []
+
+
+def test_retrace_flags_fstring_arguments():
+    src = ("import jax\n"
+           "f = jax.jit(g)\n"
+           "def call(x, n):\n"
+           "    return f(x, f'{n}')\n")
+    found = check("retrace", src)
+    assert len(found) == 1 and "f-string" in found[0].message
+
+
+# -- lock-discipline -------------------------------------------------------
+
+
+LOCK_BAD = """
+import threading
+
+class OpsServer:
+    def __init__(self, server):
+        self.server = server
+        self.lock = threading.RLock()
+
+    def _request(self, uid):
+        sched = self.server.scheduler     # unguarded state read
+        return sched.running.get(uid)
+"""
+
+LOCK_GOOD = """
+import threading
+
+class OpsServer:
+    def __init__(self, server):
+        self.server = server
+        self.lock = threading.RLock()
+
+    def _request(self, uid):
+        with self.lock:
+            sched = self.server.scheduler
+            req = sched.running.get(uid)
+        return req
+"""
+
+# regression: RouterFleet.close() flipped _closed/_final_stats and
+# joined the pool with no lock (fixed in this PR — the flag mutation
+# now happens under the ops lock, teardown on captured locals)
+LOCK_FLEET_REGRESSION = """
+import contextlib
+_NO_LOCK = contextlib.nullcontext()
+
+class RouterFleet:
+    def close(self):
+        if self._closed:                  # unguarded read
+            return self._final_stats
+        self._final_stats = self.drain()  # unguarded write
+        self._closed = True               # unguarded write
+        return self._final_stats
+"""
+
+LOCK_FLEET_FIXED = """
+import contextlib
+_NO_LOCK = contextlib.nullcontext()
+
+class RouterFleet:
+    def close(self):
+        with (self._ops_lock or _NO_LOCK):
+            if self._closed:
+                return self._final_stats
+            self._closed = True
+        return self.drain()               # delegation self-locks
+"""
+
+
+def test_lock_discipline_fires_on_unguarded_handler_read():
+    found = check("lock-discipline", LOCK_BAD)
+    assert len(found) >= 1
+    assert "self.server.scheduler" in found[0].message
+
+
+def test_lock_discipline_silent_under_the_lock():
+    assert check("lock-discipline", LOCK_GOOD) == []
+
+
+def test_lock_discipline_regression_fleet_close_unlocked():
+    found = check("lock-discipline", LOCK_FLEET_REGRESSION)
+    verbs = {f.message.split(" outside")[0].rsplit(" ", 1)[-1]
+             for f in found}
+    assert {"self._closed", "self._final_stats"} <= verbs
+    assert any("write" in f.message for f in found)
+
+
+def test_lock_discipline_regression_fleet_close_fixed_is_silent():
+    assert check("lock-discipline", LOCK_FLEET_FIXED) == []
+
+
+def test_lock_discipline_nolock_boolop_spelling_counts():
+    # regression: RouterFleet.submit() checked _closed before taking
+    # the (lock or _NO_LOCK) guard; the guarded spelling must count
+    # as holding the lock or every fleet method would false-positive
+    src = ("import contextlib\n"
+           "_NO_LOCK = contextlib.nullcontext()\n"
+           "class RouterFleet:\n"
+           "    def submit(self, prompt):\n"
+           "        with (self._ops_lock or _NO_LOCK):\n"
+           "            if self._draining:\n"
+           "                return None\n"
+           "            return self.router.submit(prompt)\n")
+    assert check("lock-discipline", src) == []
+
+
+# -- donation --------------------------------------------------------------
+
+
+DONATION_BAD = """
+import jax
+
+def build(fn):
+    return jax.jit(fn, donate_argnums=(1,))   # unconditional
+"""
+
+DONATION_GOOD = """
+import jax
+
+def build(fn):
+    donate = (1,) if jax.default_backend() != "cpu" else ()
+    return jax.jit(fn, donate_argnums=donate)
+
+def build_literal_but_gated(fn):
+    if jax.default_backend() == "cpu":
+        return jax.jit(fn)
+    return jax.jit(fn, donate_argnums=(1,))
+
+def donation_off(fn):
+    return jax.jit(fn, donate_argnums=())
+"""
+
+
+def test_donation_fires_on_unconditional_literal():
+    found = check("donation", DONATION_BAD)
+    assert len(found) == 1
+    assert "donate_argnums=(1,)" in found[0].message
+
+
+def test_donation_silent_when_backend_gated_or_off():
+    assert check("donation", DONATION_GOOD) == []
+
+
+# -- pragmas & baseline ----------------------------------------------------
+
+
+def test_line_pragma_suppresses_exactly_its_line():
+    src = ("import random\n"
+           "def f():\n"
+           "    # apexlint: disable=determinism — fixture\n"
+           "    a = random.random()\n"
+           "    b = random.random()\n")
+    found = check("determinism", src)
+    assert [f.line for f in found] == [5]
+
+
+def test_def_pragma_suppresses_the_whole_function():
+    src = ("import random\n"
+           "# apexlint: disable=determinism — fixture contract\n"
+           "def f():\n"
+           "    a = random.random()\n"
+           "    return random.random()\n")
+    assert check("determinism", src) == []
+
+
+def test_file_pragma_suppresses_everything():
+    src = ("# apexlint: disable-file=determinism\n"
+           "import random\n"
+           "x = random.random()\n")
+    assert check("determinism", src) == []
+
+
+def test_pragma_tolerates_plain_dash_justifications():
+    src = ("import random\n"
+           "def f():\n"
+           "    # apexlint: disable=determinism - plain-dash reason\n"
+           "    return random.random()\n")
+    assert check("determinism", src) == []
+
+
+def test_pragma_only_silences_the_named_rule():
+    src = ("import random, time\n"
+           "def f():\n"
+           "    # apexlint: disable=host-sync\n"
+           "    return random.random()\n")
+    assert len(check("determinism", src)) == 1
+
+
+def test_baseline_matching_is_count_aware_and_line_blind():
+    f = Finding(rule="determinism", path="a.py", line=10,
+                message="msg")
+    g = Finding(rule="determinism", path="a.py", line=99,
+                message="msg")
+    bl = Baseline([{"rule": "determinism", "path": "a.py",
+                    "line": 3, "message": "msg",
+                    "justification": "why"}])
+    new, accepted, stale = bl.match([f, g])
+    assert len(accepted) == 1 and len(new) == 1 and not stale
+    new, accepted, stale = bl.match([])
+    assert stale == [("determinism", "a.py", "msg")]
+
+
+# -- repo-level gates ------------------------------------------------------
+
+
+def _repo_config():
+    return load_config(REPO)
+
+
+def test_pyproject_config_block_drives_the_run():
+    cfg = _repo_config()
+    assert set(cfg.enable) == set(RULES) == {
+        "determinism", "donation", "host-sync", "lock-discipline",
+        "retrace"}
+    assert cfg.baseline == "apex_tpu/analysis/baseline.json"
+    assert "apex_tpu/csrc/*" in cfg.exclude
+    # per-rule sub-tables override scope
+    assert cfg.options_for(RULES["host-sync"])["paths"] == [
+        "apex_tpu/serving/api.py", "apex_tpu/serving/engine.py"]
+
+
+def test_toml_subset_parser_handles_quoted_tables_and_arrays():
+    tables = parse_toml_tables(
+        '[tool.apexlint]\n'
+        'enable = [\n    "a",\n    "b",\n]\n'
+        'baseline = "x.json"  # comment\n'
+        'flag = true\n'
+        '[tool.apexlint."lock-discipline"]\n'
+        'paths = ["p/q"]\n')
+    top = tables["tool.apexlint"]
+    assert top["enable"] == ["a", "b"]
+    assert top["baseline"] == "x.json" and top["flag"] is True
+    assert tables['tool.apexlint.lock-discipline']["paths"] == ["p/q"]
+
+
+def test_every_baseline_entry_carries_a_written_justification():
+    cfg = _repo_config()
+    bl = Baseline.load(REPO / cfg.baseline)
+    assert bl.entries, "baseline exists and is exercised"
+    for e in bl.entries:
+        j = e.get("justification", "")
+        assert j and not j.startswith("TODO"), (
+            f"baseline entry without a written justification: {e}")
+
+
+def test_repo_is_clean_modulo_baseline():
+    cfg = _repo_config()
+    findings = run([REPO / "apex_tpu"], cfg, RULES)
+    bl = Baseline.load(REPO / cfg.baseline)
+    new, accepted, stale = bl.match(findings)
+    assert not new, "new apexlint findings:\n" + "\n".join(
+        f.render() for f in new)
+    assert not stale, f"stale baseline entries (fixed code — " \
+        f"delete them): {stale}"
+
+
+def test_cli_exits_zero_on_the_shipped_tree_and_one_on_bad_code(
+        tmp_path):
+    env_cmd = [sys.executable, str(REPO / "tools" / "apexlint.py")]
+    ok = subprocess.run(env_cmd + ["apex_tpu/"], cwd=REPO,
+                        capture_output=True, text=True)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    bad = tmp_path / "apex_tpu" / "serving" / "evil.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text("import random\n"
+                   "def pick():\n"
+                   "    return random.random()\n")
+    res = subprocess.run(
+        env_cmd + [str(bad), "--rule", "determinism", "--json"],
+        cwd=REPO, capture_output=True, text=True)
+    assert res.returncode == 1, res.stdout + res.stderr
+    payload = json.loads(res.stdout)
+    assert payload["findings"] and \
+        payload["findings"][0]["rule"] == "determinism"
+
+
+def test_cli_update_baseline_round_trips(tmp_path):
+    bad = tmp_path / "apex_tpu" / "serving" / "evil.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text("import random\nx = random.random()\n")
+    bl_path = tmp_path / "baseline.json"
+    cmd = [sys.executable, str(REPO / "tools" / "apexlint.py"),
+           str(bad), "--rule", "determinism",
+           "--baseline", str(bl_path)]
+    res = subprocess.run(cmd + ["--update-baseline"], cwd=REPO,
+                         capture_output=True, text=True)
+    assert res.returncode == 0, res.stdout + res.stderr
+    entries = json.loads(bl_path.read_text())["findings"]
+    assert len(entries) == 1
+    assert entries[0]["justification"].startswith("TODO")
+    # with the finding baselined the same run gates clean
+    res = subprocess.run(cmd, cwd=REPO, capture_output=True,
+                         text=True)
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_cli_is_cwd_independent_and_errors_on_missing_paths(
+        tmp_path):
+    # regression: run from a foreign cwd the default "apex_tpu"
+    # resolved to nothing and the gate silently passed on zero files
+    cmd = [sys.executable, str(REPO / "tools" / "apexlint.py")]
+    res = subprocess.run(cmd, cwd=tmp_path, capture_output=True,
+                         text=True)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "4 baselined" in res.stdout
+    res = subprocess.run(cmd + ["no/such/tree"], cwd=tmp_path,
+                         capture_output=True, text=True)
+    assert res.returncode == 2
+    assert "no such path" in res.stderr
+
+
+def test_parse_error_reported_as_finding(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    cfg = AnalysisConfig(root=tmp_path)
+    findings = run([bad], cfg, RULES)
+    assert len(findings) == 1
+    assert findings[0].rule == "parse-error"

@@ -1,0 +1,218 @@
+"""Exit-code contract of the gating CLI tools.
+
+``tests/build_matrix/run.sh`` branches on the exit codes of
+``tools/ops_probe.py --assert-healthy`` and ``tools/obs_dump.py
+trace --require`` — a failure surfacing as an uncaught traceback
+still exits nonzero by accident, but a failure that *passes* (or a
+gate that dies on a malformed artifact before judging it) silently
+un-gates an axis.  These tests pin the contract: every
+assertion-style failure exits 1 with a ``FAIL:`` line and no
+traceback; healthy inputs exit 0.
+"""
+
+import json
+import subprocess
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[2]
+
+_CONFORMANT_METRICS = (
+    "# HELP serving_tokens_total tokens produced\n"
+    "# TYPE serving_tokens_total counter\n"
+    "serving_tokens_total 5\n")
+
+_STATUSZ = {"programs": {"by_program": {}, "enabled": True},
+            "watchdog": {"stalls": 0}, "ops": {},
+            "latency": {}, "memory": {}}
+
+
+class _StubOps(BaseHTTPRequestHandler):
+    """A canned ops plane: healthy by default, corruptible per-server
+    via attributes on the HTTPServer instance."""
+
+    def do_GET(self):
+        srv = self.server
+        if self.path == "/healthz":
+            body = srv.healthz_body
+            code = 200 if b'"ok"' in body else 503
+            self._send(code, body, "application/json")
+        elif self.path == "/metrics":
+            self._send(200, srv.metrics_body, srv.metrics_ctype)
+        elif self.path == "/statusz":
+            self._send(200, srv.statusz_body, "application/json")
+        else:
+            self._send(404, b"{}", "application/json")
+
+    def _send(self, code, body, ctype):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):
+        pass
+
+
+@pytest.fixture()
+def stub_ops():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _StubOps)
+    httpd.healthz_body = json.dumps(
+        {"status": "ok", "iter": 3, "breaker": "closed",
+         "pressure": 0.1}).encode()
+    httpd.metrics_body = _CONFORMANT_METRICS.encode()
+    httpd.metrics_ctype = "text/plain; version=0.0.4; charset=utf-8"
+    httpd.statusz_body = json.dumps(_STATUSZ).encode()
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield httpd
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def _probe(port, *flags):
+    return subprocess.run(
+        [sys.executable, str(REPO / "tools" / "ops_probe.py"),
+         "--port", str(port), "--timeout", "5", *flags],
+        capture_output=True, text=True, timeout=60)
+
+
+def _no_traceback(res):
+    assert "Traceback" not in res.stderr, res.stderr
+    assert "Traceback" not in res.stdout, res.stdout
+
+
+def test_ops_probe_assert_healthy_passes_on_healthy_stub(stub_ops):
+    res = _probe(stub_ops.server_address[1], "--assert-healthy")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "OK" in res.stdout
+
+
+def test_ops_probe_gates_on_unhealthy_status(stub_ops):
+    stub_ops.healthz_body = json.dumps(
+        {"status": "draining"}).encode()
+    res = _probe(stub_ops.server_address[1], "--assert-healthy")
+    assert res.returncode == 1
+    assert "FAIL" in res.stderr
+    _no_traceback(res)
+
+
+def test_ops_probe_gates_on_nonconformant_metrics(stub_ops):
+    stub_ops.metrics_body = b"!!! not prometheus text\n"
+    res = _probe(stub_ops.server_address[1], "--assert-healthy")
+    assert res.returncode == 1
+    assert "not conformant" in res.stderr
+    _no_traceback(res)
+
+
+def test_ops_probe_gates_on_wrong_metrics_content_type(stub_ops):
+    stub_ops.metrics_ctype = "text/html"
+    res = _probe(stub_ops.server_address[1], "--assert-healthy")
+    assert res.returncode == 1
+    assert "content type" in res.stderr
+    _no_traceback(res)
+
+
+def test_ops_probe_gates_on_missing_statusz_blocks(stub_ops):
+    stub_ops.statusz_body = json.dumps({"programs": {}}).encode()
+    res = _probe(stub_ops.server_address[1], "--assert-healthy")
+    assert res.returncode == 1
+    assert "missing blocks" in res.stderr
+    _no_traceback(res)
+
+
+def test_ops_probe_clean_exit_on_connection_refused(stub_ops):
+    stub_ops.shutdown()
+    stub_ops.server_close()
+    port = stub_ops.server_address[1]
+    for flags in (("--assert-healthy",), ()):
+        res = _probe(port, *flags)
+        assert res.returncode == 1, res.stdout + res.stderr
+        assert "FAIL" in res.stderr and "unreachable" in res.stderr
+        _no_traceback(res)
+
+
+def test_ops_probe_clean_exit_on_garbage_healthz_body(stub_ops):
+    stub_ops.healthz_body = b'"status": "ok"  % garbage'
+    # default mode (no flags) parses the body too — both must gate
+    for flags in (("--assert-healthy",), ()):
+        res = _probe(stub_ops.server_address[1], *flags)
+        assert res.returncode == 1
+        assert "FAIL" in res.stderr
+        _no_traceback(res)
+
+
+# -- obs_dump --------------------------------------------------------------
+
+
+def _dump(*argv):
+    return subprocess.run(
+        [sys.executable, str(REPO / "tools" / "obs_dump.py"), *argv],
+        capture_output=True, text=True, timeout=60)
+
+
+def _trace_file(tmp_path, names=("launch", "retire")):
+    events = []
+    for i, name in enumerate(names):
+        events.append({"ph": "B", "name": name, "pid": 1, "tid": 1,
+                       "ts": i * 10.0})
+        events.append({"ph": "E", "name": name, "pid": 1, "tid": 1,
+                       "ts": i * 10.0 + 5.0})
+    path = tmp_path / "trace.json"
+    path.write_text(json.dumps({"traceEvents": events}))
+    return path
+
+
+def test_obs_dump_require_present_passes(tmp_path):
+    res = _dump("trace", str(_trace_file(tmp_path)),
+                "--require", "launch", "--require", "retire")
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_obs_dump_require_missing_gates(tmp_path):
+    res = _dump("trace", str(_trace_file(tmp_path)),
+                "--require", "launch", "--require", "no_such_span")
+    assert res.returncode == 1
+    assert "no_such_span" in res.stderr and "FAIL" in res.stderr
+    _no_traceback(res)
+
+
+def test_obs_dump_clean_exit_on_missing_file(tmp_path):
+    for sub in ("trace", "metrics"):
+        res = _dump(sub, str(tmp_path / "nope.json"))
+        assert res.returncode == 1
+        assert "FAIL" in res.stderr and "cannot read" in res.stderr
+        _no_traceback(res)
+
+
+def test_obs_dump_clean_exit_on_malformed_artifacts(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    res = _dump("trace", str(bad))
+    assert res.returncode == 1 and "FAIL" in res.stderr
+    _no_traceback(res)
+    jl = tmp_path / "bad.jsonl"
+    jl.write_text('{"ts": 1, "metrics": {}}\n{oops\n')
+    res = _dump("metrics", jl.as_posix())
+    assert res.returncode == 1 and "not JSON" in res.stderr
+    _no_traceback(res)
+    scalar = tmp_path / "scalar.json"
+    scalar.write_text('"just a string"')
+    res = _dump("trace", str(scalar))
+    assert res.returncode == 1 and "traceEvents" in res.stderr
+    _no_traceback(res)
+
+
+def test_obs_dump_empty_metrics_file_gates(tmp_path):
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    res = _dump("metrics", str(empty))
+    assert res.returncode == 1
+    _no_traceback(res)

@@ -28,6 +28,16 @@ run_axis native  APEX_TPU_NO_NATIVE=
 run_axis pyonly  APEX_TPU_NO_NATIVE=1
 run_axis x64     JAX_ENABLE_X64=1
 
+# lint axis: apexlint (docs/analysis.md) — the AST invariant rules
+# (host-sync, determinism, retrace, lock-discipline, donation) over
+# apex_tpu/ with the [tool.apexlint] pyproject config; any finding
+# not covered by the baseline (each entry carries a written
+# justification) or an inline pragma exits 1.  Runs jax-free in ~1s,
+# so it gates before the expensive axes.
+echo "=== build-matrix axis: lint ==="
+python tools/apexlint.py apex_tpu/
+results[lint]=$?
+
 # bitwise gate (the reference's strongest oracle,
 # tests/L1/common/compare.py:41,55-56: python-only vs extension installs
 # must produce EXACTLY equal losses): the native ext only touches

@@ -1,0 +1,158 @@
+"""apexlint CLI — static invariant analysis over the apex_tpu tree.
+
+The command-line front of ``apex_tpu.analysis`` (``docs/analysis.md``):
+AST-level rules for the invariants the serving stack otherwise only
+enforces dynamically — host-sync freedom in PLAN/LAUNCH, replayable
+determinism, retrace hazards, RLock discipline, backend-gated buffer
+donation.
+
+Modes:
+
+``python tools/apexlint.py [paths...]``
+    Analyze (default: ``apex_tpu/``) with the rules and excludes from
+    ``[tool.apexlint]`` in pyproject.toml.  Findings not covered by
+    the baseline or an inline ``# apexlint: disable=RULE`` pragma
+    print as ``path:line: [rule] message`` and exit 1 — the gate the
+    ``lint`` build-matrix axis and the L0 clean-repo test run.
+
+``--rule RULE`` (repeatable)
+    Restrict to the named rule(s).
+
+``--json``
+    Machine-readable output: ``{"findings": [...], "baselined": N,
+    "stale_baseline": [...], "rules": [...]}``.
+
+``--baseline PATH`` / ``--update-baseline``
+    Override the accepted-findings file (default from pyproject,
+    ``apex_tpu/analysis/baseline.json``) / rewrite it with the
+    current findings (existing justifications kept, new entries
+    stamped ``TODO: justify`` — the L0 baseline test fails until a
+    human writes the reason).
+
+``--list-rules``
+    Print the rule catalogue and exit.
+
+Stdlib-only and jax-free: the analysis package is loaded standalone
+(not through ``apex_tpu/__init__`` and its jax imports), so the lint
+axis costs milliseconds and runs on any box with a Python.
+"""
+
+import argparse
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def _load_analysis():
+    """Import ``apex_tpu/analysis`` as a standalone package so the
+    CLI never pays for (or requires) ``import apex_tpu`` → jax."""
+    if "apex_tpu.analysis" in sys.modules:
+        return sys.modules["apex_tpu.analysis"]
+    pkg_dir = REPO_ROOT / "apex_tpu" / "analysis"
+    spec = importlib.util.spec_from_file_location(
+        "apex_tpu_analysis", pkg_dir / "__init__.py",
+        submodule_search_locations=[str(pkg_dir)])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["apex_tpu_analysis"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to analyze "
+                    "(default: apex_tpu/)")
+    ap.add_argument("--rule", action="append", metavar="RULE",
+                    help="run only the named rule (repeatable)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings")
+    ap.add_argument("--baseline", default=None, metavar="PATH",
+                    help="accepted-findings file (default: "
+                    "[tool.apexlint].baseline in pyproject.toml)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline with the current "
+                    "findings (keeps existing justifications)")
+    ap.add_argument("--config", default=None, metavar="PYPROJECT",
+                    help="alternate pyproject.toml to read "
+                    "[tool.apexlint] from")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalogue and exit")
+    args = ap.parse_args(argv)
+
+    analysis = _load_analysis()
+    if args.list_rules:
+        for name in sorted(analysis.RULES):
+            rule = analysis.RULES[name]
+            print(f"{name:<16} {rule.summary}")
+            print(f"{'':<16} scope: "
+                  f"{', '.join(rule.default_options['paths'])}")
+        return 0
+
+    config = analysis.load_config(
+        REPO_ROOT,
+        Path(args.config) if args.config else None)
+    paths = []
+    for p in (args.paths or ["apex_tpu"]):
+        cand = Path(p)
+        if not cand.exists() and not cand.is_absolute() \
+                and (REPO_ROOT / cand).exists():
+            cand = REPO_ROOT / cand   # cwd-independent: the lint
+            #                           axis may run from anywhere
+        if not cand.exists():
+            print(f"apexlint: no such path: {p} (a missing tree "
+                  f"would silently lint nothing)", file=sys.stderr)
+            return 2
+        paths.append(cand)
+    try:
+        findings = analysis.run(paths, config, analysis.RULES,
+                                rule_names=args.rule)
+    except KeyError as e:
+        print(f"apexlint: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    baseline_path = Path(args.baseline) if args.baseline \
+        else config.root / config.baseline
+    baseline = analysis.Baseline.load(baseline_path)
+    if args.update_baseline:
+        baseline.write(findings, baseline_path)
+        print(f"apexlint: baseline updated with {len(findings)} "
+              f"finding(s) at {baseline_path}")
+        return 0
+    new, accepted, stale = baseline.match(findings)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_json() for f in new],
+            "baselined": len(accepted),
+            "stale_baseline": [
+                {"rule": r, "path": p, "message": m}
+                for (r, p, m) in stale],
+            "rules": (sorted(args.rule) if args.rule
+                      else config.enabled_rules(analysis.RULES)),
+        }, indent=2, sort_keys=True))
+        return 1 if new else 0
+
+    for f in new:
+        print(f.render())
+    for (r, p, m) in stale:
+        print(f"apexlint: STALE baseline entry (nothing matches it "
+              f"anymore — delete it): [{r}] {p}: {m}",
+              file=sys.stderr)
+    if new:
+        print(f"\napexlint: {len(new)} new finding(s) "
+              f"({len(accepted)} baselined); fix, pragma with a "
+              f"justification, or (last resort) --update-baseline",
+              file=sys.stderr)
+        return 1
+    print(f"apexlint: clean ({len(accepted)} baselined finding(s), "
+          f"{len(stale)} stale baseline entr"
+          f"{'y' if len(stale) == 1 else 'ies'})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,6 +27,12 @@ Two subcommands over the two export formats of
     events whose ``args`` carry every listed key with that exact
     (stringified) value — e.g. ``--require 'request_finish{reason=eos}'``.
 
+Exit-code contract (the build matrix gates on ``--require``;
+``tests/L0/test_tool_gates.py`` pins it): every assertion-style
+failure — a missing/unreadable/malformed artifact, a ``--require``
+name absent from the trace — exits 1 with a ``FAIL: ...`` line,
+never a traceback.
+
 Usage:
     python tools/obs_dump.py metrics scrape.jsonl
     python tools/obs_dump.py trace trace.json --require admit --require decode
@@ -69,13 +75,23 @@ def _series_row(key: str, desc: dict) -> str:
 
 
 def dump_metrics(args) -> int:
-    with open(args.path) as f:
-        text = f.read()
+    try:
+        with open(args.path) as f:
+            text = f.read()
+    except OSError as e:
+        print(f"FAIL: cannot read {args.path}: {e}", file=sys.stderr)
+        return 1
     records = []
-    for line in text.splitlines():
+    for i, line in enumerate(text.splitlines(), start=1):
         line = line.strip()
-        if line:
+        if not line:
+            continue
+        try:
             records.append(json.loads(line))
+        except ValueError as e:
+            print(f"FAIL: {args.path}:{i} is not JSON: {e}",
+                  file=sys.stderr)
+            return 1
     if not records:
         print(f"{args.path}: empty", file=sys.stderr)
         return 1
@@ -159,9 +175,21 @@ def require_matches(events, name: str, labels: dict) -> bool:
 
 
 def dump_trace(args) -> int:
-    with open(args.path) as f:
-        data = json.load(f)
+    try:
+        with open(args.path) as f:
+            data = json.load(f)
+    except OSError as e:
+        print(f"FAIL: cannot read {args.path}: {e}", file=sys.stderr)
+        return 1
+    except ValueError as e:
+        print(f"FAIL: {args.path} is not a JSON trace: {e}",
+              file=sys.stderr)
+        return 1
     events = data["traceEvents"] if isinstance(data, dict) else data
+    if not isinstance(events, list):
+        print(f"FAIL: {args.path} carries no traceEvents list",
+              file=sys.stderr)
+        return 1
     spans, instants, errors = summarize_trace(events)
     dropped = 0
     if isinstance(data, dict):
@@ -210,7 +238,7 @@ def dump_trace(args) -> int:
     return rc
 
 
-def main() -> int:
+def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     sub = ap.add_subparsers(dest="cmd", required=True)
     mp = sub.add_parser("metrics",
@@ -228,7 +256,7 @@ def main() -> int:
                     "(repeatable); NAME{key=value,...} additionally "
                     "matches event args")
     tp.set_defaults(fn=dump_trace)
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
     return args.fn(args)
 
 

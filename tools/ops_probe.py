@@ -30,6 +30,12 @@ live-endpoint test all judge scrapes by the same grammar: one
 line matching the metric-line grammar, and histogram buckets
 cumulative-monotonic closing at ``+Inf == count`` per series.
 
+Exit-code contract (the build matrix gates on it,
+``tests/L0/test_tool_gates.py`` pins it): every assertion-style
+failure — an unhealthy/unreachable endpoint, a transport error
+(connection refused, timeout), an unparseable body — exits 1 with a
+``FAIL: ...`` line on stderr, never a traceback.
+
 Usage:
     python tools/ops_probe.py --port 9109 --assert-healthy
     python tools/ops_probe.py --port 9109 --programs
@@ -104,14 +110,34 @@ def check_prometheus_text(text):
     return problems
 
 
+class ProbeError(Exception):
+    """A transport/parse failure the gate must turn into a clean
+    ``FAIL: ...`` line and exit 1 — never a traceback: the build
+    matrix and readiness probes branch on this exit code."""
+
+
 def fetch(base, path, timeout):
     """(status, headers, body-bytes) — HTTP errors return their
-    status instead of raising (503 IS the healthz answer)."""
+    status instead of raising (503 IS the healthz answer); transport
+    failures (refused, reset, timeout) raise :class:`ProbeError`."""
     try:
         with urllib.request.urlopen(base + path, timeout=timeout) as r:
             return r.status, dict(r.headers), r.read()
     except urllib.error.HTTPError as e:
         return e.code, dict(e.headers), e.read()
+    except (urllib.error.URLError, OSError) as e:
+        raise ProbeError(f"{base}{path} unreachable: {e}") from e
+
+
+def parse_json(body, what):
+    """JSON body or a clean :class:`ProbeError` naming the endpoint —
+    a garbage body must gate, not traceback."""
+    try:
+        return json.loads(body)
+    except ValueError as e:
+        raise ProbeError(
+            f"{what} returned unparseable JSON ({e}): "
+            f"{body[:200]!r}") from e
 
 
 def render_programs(stats) -> None:
@@ -215,7 +241,14 @@ def main(argv=None) -> int:
                     "(/debug/requests/UID)")
     args = ap.parse_args(argv)
     base = f"http://{args.host}:{args.port}"
+    try:
+        return _run(args, base)
+    except ProbeError as e:
+        print(f"FAIL: {e}", file=sys.stderr)
+        return 1
 
+
+def _run(args, base) -> int:
     if args.assert_healthy:
         rc = assert_healthy(base, args.timeout)
         if rc:
@@ -225,7 +258,7 @@ def main(argv=None) -> int:
         if code != 200:
             print(f"FAIL: /statusz {code}", file=sys.stderr)
             return 1
-        stats = json.loads(body)
+        stats = parse_json(body, "/statusz")
         if args.statusz:
             print(json.dumps(stats, indent=2, sort_keys=True))
         if args.programs:
@@ -250,12 +283,14 @@ def main(argv=None) -> int:
             print(f"FAIL: /debug/requests/{args.request} {code}: "
                   f"{body.decode()}", file=sys.stderr)
             return 1
-        print(json.dumps(json.loads(body), indent=2, sort_keys=True))
+        print(json.dumps(parse_json(body,
+                                    f"/debug/requests/{args.request}"),
+                         indent=2, sort_keys=True))
     if not any((args.assert_healthy, args.programs, args.statusz,
                 args.metrics, args.flight is not None,
                 args.request is not None)):
         code, _, body = fetch(base, "/healthz", args.timeout)
-        health = json.loads(body)
+        health = parse_json(body, "/healthz")
         print(f"{base}/healthz -> {code} "
               f"{json.dumps(health, sort_keys=True)}")
         return 0 if code == 200 else 1
