@@ -53,22 +53,35 @@ __all__ = ["SLOTargets", "SLOPolicy", "SLOTracker", "HEALTHY_REASONS"]
 HEALTHY_REASONS = frozenset({"eos", "length"})
 
 # front-door refusals: never admitted (or given up at the door), so
-# they are debt/refusal accounting, not latency-SLO misses
+# they are debt/refusal accounting, not latency-SLO misses.
+# "handoff" is a disaggregated prefill replica's local terminal for a
+# request whose decode moved to another replica (docs/serving.md,
+# "Disaggregated prefill/decode") — served elsewhere, not served late
 REFUSED_REASONS = frozenset({"rejected", "shed", "breaker_open",
-                             "draining"})
+                             "draining", "handoff"})
 
 
 @dataclasses.dataclass(frozen=True)
 class SLOTargets:
     """Latency contract of one priority class.  ``None`` disables the
     corresponding bound (the request then only needs a healthy finish
-    — and to hold its deadline — to count as attained)."""
+    — and to hold its deadline — to count as attained).
+
+    ``itl_p99_s`` bounds the request's inter-token-latency p99 — the
+    per-TOKEN tail (``Request.timeline()``'s ``itl_p99_s``, from the
+    wall gaps stamped as tokens are applied), vs ``decode_token_s``'s
+    per-request average.  This is the bound head-of-line interference
+    breaks first: one long prefill stalling the decode batch barely
+    moves the average but punches straight through the gap tail — the
+    headline metric of the disaggregated prefill/decode bench
+    (``docs/serving.md``, "Disaggregated prefill/decode")."""
 
     ttft_s: Optional[float] = None
     decode_token_s: Optional[float] = None
+    itl_p99_s: Optional[float] = None
 
     def __post_init__(self):
-        for name in ("ttft_s", "decode_token_s"):
+        for name in ("ttft_s", "decode_token_s", "itl_p99_s"):
             v = getattr(self, name)
             if v is not None and v <= 0:
                 raise ValueError(f"{name} must be > 0, got {v}")
@@ -93,7 +106,8 @@ class _ClassStats:
     """Per-priority-class tallies (plain ints — snapshot-friendly)."""
 
     __slots__ = ("requests", "attained", "ttft_met", "ttft_missed",
-                 "decode_met", "decode_missed", "deadline_missed",
+                 "decode_met", "decode_missed", "itl_met",
+                 "itl_missed", "deadline_missed",
                  "shed_requests", "shed_tokens")
 
     def __init__(self):
@@ -103,6 +117,8 @@ class _ClassStats:
         self.ttft_missed = 0
         self.decode_met = 0
         self.decode_missed = 0
+        self.itl_met = 0
+        self.itl_missed = 0
         self.deadline_missed = 0
         self.shed_requests = 0
         self.shed_tokens = 0
@@ -173,6 +189,12 @@ class SLOTracker:
             else:
                 cs.decode_missed += 1
                 met = False
+        if targets.itl_p99_s is not None and "itl_p99_s" in tl:
+            if tl["itl_p99_s"] <= targets.itl_p99_s:
+                cs.itl_met += 1
+            else:
+                cs.itl_missed += 1
+                met = False
         if met:
             cs.attained += 1
             self.goodput_tokens += tokens
@@ -220,6 +242,9 @@ class SLOTracker:
                 "decode_token_target_s": t.decode_token_s,
                 "decode_met": cs.decode_met,
                 "decode_missed": cs.decode_missed,
+                "itl_p99_target_s": t.itl_p99_s,
+                "itl_met": cs.itl_met,
+                "itl_missed": cs.itl_missed,
                 "deadline_missed": cs.deadline_missed,
                 "shed_requests": cs.shed_requests,
                 "shed_tokens": cs.shed_tokens,

@@ -63,10 +63,14 @@ TERMINAL_REASONS = frozenset({
 # whatever a request produced before being cut short is bit-exact)
 HEALTHY_REASONS = frozenset({"eos", "length"})
 
-# the router tier adds one terminal reason: a mid-stream request on a
+# the router tier adds two terminal reasons: a mid-stream request on a
 # killed replica fails "replica_failed" (its cache cannot move; its
-# partial output must still be a bit-exact prefix of the replay)
-ROUTER_TERMINAL_REASONS = TERMINAL_REASONS | {"replica_failed"}
+# partial output must still be a bit-exact prefix of the replay), and
+# a disaggregated prefill replica locally finishes "handoff" when a
+# request's decode half moved to another replica (the proxy follows
+# the new request — docs/serving.md, "Disaggregated prefill/decode")
+ROUTER_TERMINAL_REASONS = TERMINAL_REASONS | {"replica_failed",
+                                              "handoff"}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -158,6 +162,17 @@ class ChaosConfig:
     crash_every: int = 500          # one FaultPlan InjectedCrash per
     #                                 ~N iterations (0 = off)
 
+    # hand-off fault class (docs/serving.md, "Disaggregated
+    # prefill/decode"; the --disagg soak arms it): a DELAYED transfer
+    # raises before any block moves (the hand-off stays queued and
+    # retries), a TORN transfer copies only a prefix of the pairs
+    # before raising — the retry re-copies the WHOLE table, so a torn
+    # hand-off must be indistinguishable from a delayed one in the
+    # output.  Defaults 0.0 keep legacy (config, seed) schedules
+    # byte-identical (no extra RNG draws).
+    handoff_oom_rate: float = 0.0
+    handoff_torn_rate: float = 0.0
+
     # forced invariant violation (the postmortem build-matrix axis,
     # docs/observability.md): at the first iteration >= this with a
     # finished request, the soak deliberately corrupts the terminal
@@ -183,13 +198,17 @@ class ChaosSchedule:
                  arrivals: Dict[int, List[Arrival]],
                  nonfinite_iters: Set[int],
                  oom_iters: Set[int],
-                 fault_plans: List[FaultPlan]):
+                 fault_plans: List[FaultPlan],
+                 handoff_oom_iters: Optional[Set[int]] = None,
+                 handoff_torn_iters: Optional[Set[int]] = None):
         self.cfg = cfg
         self.seed = seed
         self.arrivals = arrivals
         self.nonfinite_iters = nonfinite_iters
         self.oom_iters = oom_iters
         self.fault_plans = fault_plans
+        self.handoff_oom_iters = handoff_oom_iters or set()
+        self.handoff_torn_iters = handoff_torn_iters or set()
 
     @property
     def num_arrivals(self) -> int:
@@ -238,6 +257,8 @@ class ChaosSchedule:
         arrivals: Dict[int, List[Arrival]] = {}
         nonfinite: Set[int] = set()
         oom: Set[int] = set()
+        handoff_oom: Set[int] = set()
+        handoff_torn: Set[int] = set()
         for i in range(cfg.iters):
             batch: List[Arrival] = []
             if rng.random() < cfg.arrival_rate:
@@ -256,6 +277,14 @@ class ChaosSchedule:
                 oom.update(x for x in
                            range(i, i + rng.randint(*cfg.oom_burst))
                            if x < cfg.iters)
+            # rate-0 guards: legacy (config, seed) schedules draw
+            # nothing extra and stay byte-identical
+            if cfg.handoff_oom_rate \
+                    and rng.random() < cfg.handoff_oom_rate:
+                handoff_oom.add(i)
+            if cfg.handoff_torn_rate \
+                    and rng.random() < cfg.handoff_torn_rate:
+                handoff_torn.add(i)
         # compose the EXISTING fault vocabulary: one FaultPlan per
         # scheduled crash, ticked by iteration number (crash_kind
         # "raise" — SIGKILL would end the soak process, which the
@@ -267,7 +296,9 @@ class ChaosSchedule:
                 plans.append(FaultPlan(
                     crash_step=base + rng.randint(0, step // 4),
                     crash_kind="raise"))
-        return cls(cfg, seed, arrivals, nonfinite, oom, plans)
+        return cls(cfg, seed, arrivals, nonfinite, oom, plans,
+                   handoff_oom_iters=handoff_oom,
+                   handoff_torn_iters=handoff_torn)
 
 
 class ChaosEngine:
@@ -289,17 +320,30 @@ class ChaosEngine:
       a numerically-diverged model.
     """
 
-    def __init__(self, inner, schedule: ChaosSchedule):
+    def __init__(self, inner, schedule: ChaosSchedule, *,
+                 rng_salt: int = 0x5EED, injected=None,
+                 tick_plans: bool = True):
         self.inner = inner
         self.schedule = schedule
         # runtime draws (victim rows) come from a separate stream so
-        # schedule generation and injection stay independent
-        self.rng = random.Random(schedule.seed ^ 0x5EED)
+        # schedule generation and injection stay independent.  A
+        # second wrapper (the disaggregated PREFILL pool's engine)
+        # salts its own stream and SHARES the injected tallies, so
+        # fault accounting reconciles server-wide while neither
+        # wrapper perturbs the other's draw sequence.
+        self.rng = random.Random(schedule.seed ^ rng_salt)
         self.iter = -1
-        self.injected = {"oom": 0, "nonfinite_rows": 0, "crashes": 0}
+        self.injected = injected if injected is not None else {
+            "oom": 0, "nonfinite_rows": 0, "crashes": 0,
+            "handoff_oom": 0, "handoff_torn": 0}
+        self._tick_plans = tick_plans
 
     def begin_iter(self, i: int) -> None:
         self.iter = i
+        if not self._tick_plans:
+            # a secondary wrapper must not double-tick the shared
+            # FaultPlan crash schedule
+            return
         for plan in self.schedule.fault_plans:
             if plan.crash_step == i:
                 self.injected["crashes"] += 1
@@ -323,6 +367,29 @@ class ChaosEngine:
     def copy_blocks(self, pairs):
         self._oom_gate()
         return self.inner.copy_blocks(pairs)
+
+    def copy_blocks_from(self, src_engine, pairs):
+        # the hand-off fault class (docs/serving.md, "Disaggregated
+        # prefill/decode"): a TORN transfer really moves a prefix of
+        # the blocks before failing — the server must re-copy the
+        # whole table on retry, so output stays bit-exact; a DELAYED
+        # transfer fails before anything moves.  Both surface as the
+        # MemoryError skip-and-retry the serve loop already isolates.
+        if self.iter in self.schedule.handoff_torn_iters:
+            self.injected["handoff_torn"] += 1
+            if len(pairs) > 1:
+                self.inner.copy_blocks_from(src_engine,
+                                            pairs[:len(pairs) // 2])
+            raise MemoryError(
+                f"chaos: torn hand-off transfer at iteration "
+                f"{self.iter}")
+        if self.iter in self.schedule.handoff_oom_iters:
+            self.injected["handoff_oom"] += 1
+            raise MemoryError(
+                f"chaos: delayed hand-off transfer at iteration "
+                f"{self.iter}")
+        self._oom_gate()
+        return self.inner.copy_blocks_from(src_engine, pairs)
 
     def decode(self, tokens, positions, tables):
         import numpy as np
@@ -704,8 +771,20 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
     server = make_server(lambda: clock_state["t"])
     chaos = ChaosEngine(server.engine, schedule)
     server.engine = chaos
+    # a disaggregated server's PREFILL pool soaks under the same fault
+    # schedule through its own wrapper (independent victim-draw
+    # stream, shared tallies; plans tick once, on the primary)
+    pchaos = None
+    if getattr(server, "prefill_engine", None) is not None:
+        pchaos = ChaosEngine(server.prefill_engine, schedule,
+                             rng_salt=0x9F11, injected=chaos.injected,
+                             tick_plans=False)
+        server.prefill_engine = pchaos
 
     sched = server.scheduler
+    all_scheds = [sched]
+    if getattr(server, "prefill_scheduler", None) is not None:
+        all_scheds.append(server.prefill_scheduler)
     tracked: Dict[int, object] = {}     # uid -> Request
     terminal: Dict[int, str] = {}       # uid -> finish_reason
     report = {"iters": cfg.iters, "seed": seed, "crashes_caught": 0}
@@ -749,6 +828,8 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
                 tracked[req.uid] = (req, a)
             try:
                 chaos.begin_iter(i)
+                if pchaos is not None:
+                    pchaos.begin_iter(i)
                 server.step()
             except InjectedCrash:
                 # a FaultPlan crash between engine steps: nothing was
@@ -762,14 +843,16 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
                 # bundle dump end-to-end)
                 sched.finished.append(sched.finished[0])
                 forced = True
-            sched.audit()                               # invariant 1
+            for s in all_scheds:
+                s.audit()                               # invariant 1
             absorb_finished()
-            for req in sched.waiting:
-                assert not req.finished, \
-                    f"finished request {req.uid} still waiting"
-            for req in sched.running.values():
-                assert not req.finished, \
-                    f"finished request {req.uid} still in the batch"
+            for s in all_scheds:
+                for req in s.waiting:
+                    assert not req.finished, \
+                        f"finished request {req.uid} still waiting"
+                for req in s.running.values():
+                    assert not req.finished, \
+                        f"finished request {req.uid} still in the batch"
             if i and i % 500 == 0:
                 log(f"iter {i}: {len(terminal)}/{len(tracked)} "
                     f"terminal, pressure={sched.pressure():.2f}, "
@@ -777,13 +860,17 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
 
         clock_state["t"] = float(cfg.iters)
         chaos.begin_iter(cfg.iters)  # past the schedule: drain unfaulted
+        if pchaos is not None:
+            pchaos.begin_iter(cfg.iters)
         server.drain()
-        sched.audit()
+        for s in all_scheds:
+            s.audit()
         absorb_finished()
         for uid, (req, _) in tracked.items():           # invariant 4
             assert req.finished and uid in terminal, \
                 f"request {uid} never reached a terminal state"
-        assert not sched.has_work, "drained server still has work"
+        assert not any(s.has_work for s in all_scheds), \
+            "drained server still has work"
     except AssertionError as e:
         _postmortem_and_reraise(e)
 
@@ -847,9 +934,12 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
         assert breaker_rejects == tally.get("breaker_open", 0), \
             (f"breaker counted {breaker_rejects} rejections, observed "
              f"{tally.get('breaker_open', 0)} breaker_open finishes")
-        assert stats["oom_events"] == chaos.injected["oom"], \
+        injected_oom = (chaos.injected["oom"]
+                        + chaos.injected.get("handoff_oom", 0)
+                        + chaos.injected.get("handoff_torn", 0))
+        assert stats["oom_events"] == injected_oom, \
             (f"server counted {stats['oom_events']} OOM events, chaos "
-             f"injected {chaos.injected['oom']}")
+             f"injected {injected_oom} (incl. hand-off faults)")
         assert report["crashes_caught"] == chaos.injected["crashes"]
         # an armed hang watchdog must ride the whole soak — thousands
         # of iterations of composed faults, none of them a hang —
@@ -889,5 +979,8 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
         kv_live_peak=stats["memory"]["blocks_live_peak"],
         watchdog_armed=stats["watchdog"]["enabled"],
         watchdog_stalls=stats["watchdog"]["stalls"],
+        disagg=stats["disagg"]["enabled"],
+        handoff=(stats["disagg"].get("handoff")
+                 if stats["disagg"]["enabled"] else None),
     )
     return report

@@ -89,7 +89,20 @@ serve".  Three layers, bottom-up:
   exactly-once failover (queued work re-enqueues onto survivors
   bit-identically), rolling-restart ``drain_replica()``/``revive()``,
   and Router x TP composition (each replica on its own disjoint
-  device mesh).
+  device mesh);
+- disaggregated prefill/decode (``docs/serving.md``, "Disaggregated
+  prefill/decode"): ``enable_disagg=True`` splits the server into
+  phase-separated execution pools — a dedicated prefill pool (its own
+  engine, KV pool, scheduler, and the prefix cache's home) runs every
+  chunked prefill and hands finished KV blocks to a PURE-decode pool
+  through the fixed-shape cross-pool block copy, so a 10x long-prompt
+  burst queues against prefill capacity instead of inflating the
+  decode inter-token tail; output is bit-exact vs the monolithic
+  engine, and ``RouterFleet(disagg_prefill=k)`` extends the hand-off
+  cross-replica (checksummed block payloads via
+  ``DecodeEngine.export_blocks`` / ``InferenceServer.ingest_handoff``,
+  torn transfers detected whole, failover back to monolithic
+  placement).
 
 Quick start::
 

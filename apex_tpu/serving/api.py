@@ -118,6 +118,7 @@ import faulthandler
 import os
 import time
 import warnings
+from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -237,6 +238,19 @@ class _InflightStep:
         self.launched_at = launched_at
         self.drafts = drafts
         self.lengths = lengths
+
+
+class _Handoff:
+    """One finished prefill waiting to move pools (``enable_disagg``):
+    the request, plus — under pipelining — the un-materialized
+    (token ids, finite flags) handles of its final chunk's fused
+    sampling, consumed when the hand-off processes next step."""
+
+    __slots__ = ("req", "handles")
+
+    def __init__(self, req, handles=None):
+        self.req = req
+        self.handles = handles
 
 
 class InferenceServer:
@@ -379,6 +393,35 @@ class InferenceServer:
         :meth:`step` serializes against ops reads through the ops
         lock; without it the loop takes no lock at all.
 
+      enable_disagg: disaggregated prefill/decode pools
+        (``docs/serving.md``, "Disaggregated prefill/decode"; OFF by
+        default): a second engine with its OWN KV pool runs every
+        prefill (and hosts the prefix cache), and the main engine
+        becomes a pure-decode pool — finished prefills hand their
+        blocks over through the fixed-shape cross-pool block copy one
+        step after their final chunk, so long-prompt bursts queue
+        against prefill capacity instead of inflating the decode
+        inter-token tail.  Output is bit-exact vs the monolithic
+        loop; speculation, the pipelined loop, and stochastic
+        sampling stay ON in the decode pool.
+      disagg_prefill_blocks: the prefill pool's size in blocks
+        (incl. its own garbage block 0); default
+        ``prefill_max_concurrent`` full-context prefills + 1.  This
+        is RESERVED capacity the decode batch cannot borrow — budget
+        it from the same HBM the monolithic pool would have used.
+      prefill_max_concurrent: prefill-pool scheduler slots — the
+        bound on chunk launches per step, i.e. the prefill duty
+        cycle protecting the decode cadence (default 2).
+      handoff_sink: cross-replica hand-off hook
+        (``(request, payload) -> bool``): when set, finished prefills
+        export their blocks as a checksummed host payload
+        (:meth:`DecodeEngine.export_blocks`) and the sink — normally
+        ``ReplicaRouter.handoff_sink_for`` — places the decode half
+        on another replica (:meth:`ingest_handoff`); True moves
+        ownership (this server finishes its half
+        ``finish_reason="handoff"``), False falls back to the LOCAL
+        decode pool.
+
     Example::
 
         server = InferenceServer(cfg, params, max_batch_size=8)
@@ -418,7 +461,11 @@ class InferenceServer:
                  postmortem_dir: Optional[str] = None,
                  enable_program_accounting: bool = True,
                  watchdog: Optional[HangWatchdog] = None,
-                 ops_port: Optional[int] = None):
+                 ops_port: Optional[int] = None,
+                 enable_disagg: bool = False,
+                 disagg_prefill_blocks: Optional[int] = None,
+                 prefill_max_concurrent: int = 2,
+                 handoff_sink: Optional[Callable] = None):
         self.registry = registry if registry is not None \
             else MetricsRegistry()
         self.tracer = tracer if tracer is not None else get_tracer()
@@ -460,10 +507,6 @@ class InferenceServer:
                                      label="reason")
         self.prefix = CounterMeter(registry=self.registry,
                                    name="serving_prefix", label="event")
-        self.prefix_cache = (
-            PrefixCache(self.engine.allocator, self.engine.block_size,
-                        counters=self.prefix)
-            if enable_prefix_cache else None)
         self.prefill_chunk = None
         if enable_chunked_prefill:
             self.prefill_chunk = int(
@@ -472,17 +515,89 @@ class InferenceServer:
         self.overload_policy = (
             overload_policy if overload_policy is not None
             else OverloadPolicy()) if enable_overload else None
+        # disaggregated prefill/decode pools (docs/serving.md,
+        # "Disaggregated prefill/decode"; OFF by default): a second
+        # engine with its OWN KV pool runs every prefill, and the main
+        # engine becomes a pure-decode pool — the two pools' programs
+        # share no array, so their device compute never serializes
+        # through a common pool version.  Finished prefills hand their
+        # blocks to the decode pool via the fixed-shape cross-pool
+        # block copy, one step after their final chunk launches.
+        self.disagg = bool(enable_disagg)
+        self.handoff_sink = handoff_sink
+        self.prefill_engine = None
+        self.prefill_scheduler = None
+        self._handoff: "deque" = None
+        if self.disagg:
+            if prefill_max_concurrent < 1:
+                raise ValueError(
+                    f"prefill_max_concurrent must be >= 1, got "
+                    f"{prefill_max_concurrent}")
+            if disagg_prefill_blocks is None:
+                # room for prefill_max_concurrent full-context
+                # prefills plus the garbage block — the prefill pool's
+                # slack doubles as the shared-prefix cache's home
+                disagg_prefill_blocks = (
+                    prefill_max_concurrent * self.engine.blocks_per_seq
+                    + 1)
+            if disagg_prefill_blocks < self.engine.blocks_per_seq + 1:
+                raise ValueError(
+                    f"disagg_prefill_blocks={disagg_prefill_blocks} "
+                    f"cannot hold one full-context prefill "
+                    f"({self.engine.blocks_per_seq} blocks + garbage)")
+            self.prefill_engine = DecodeEngine(
+                cfg, params, max_batch_size=1,
+                max_context=self.engine.max_context,
+                num_blocks=int(disagg_prefill_blocks),
+                block_size=block_size, cache_dtype=cache_dtype,
+                kv_quant=self.kv_quant,
+                attention_fn=attention_fn,
+                prefill_buckets=prefill_buckets,
+                tracer=self.tracer, programs=self.programs,
+                mesh=mesh, tp_rules=tp_rules, tp_axis=tp_axis)
+        # the prefix cache lives with whichever pool runs prefills:
+        # the prefill pool under disaggregation (its released blocks
+        # become the warm shared-prefix cache), the single pool
+        # otherwise
+        cache_alloc = (self.prefill_engine.allocator if self.disagg
+                       else self.engine.allocator)
+        self.prefix_cache = (
+            PrefixCache(cache_alloc, self.engine.block_size,
+                        counters=self.prefix)
+            if enable_prefix_cache else None)
         self.scheduler = Scheduler(
             self.engine.allocator,
             max_batch_size=self.engine.max_batch_size,
             block_size=self.engine.block_size,
             max_context=self.engine.max_context,
-            max_waiting=max_waiting,
+            max_waiting=None if self.disagg else max_waiting,
             counters=self.failures,
-            prefix_cache=self.prefix_cache,
+            prefix_cache=None if self.disagg else self.prefix_cache,
             chunk_size=self.prefill_chunk,
             overload=self.overload_policy,
             tracer=self.tracer)
+        if self.disagg:
+            self.prefill_scheduler = Scheduler(
+                self.prefill_engine.allocator,
+                max_batch_size=int(prefill_max_concurrent),
+                block_size=self.engine.block_size,
+                max_context=self.engine.max_context,
+                max_waiting=max_waiting,
+                counters=self.failures,
+                prefix_cache=self.prefix_cache,
+                chunk_size=self.prefill_chunk,
+                overload=self.overload_policy,
+                tracer=self.tracer)
+            # ONE terminal ledger across both pools: a request finishes
+            # exactly once, wherever it is, and every consumer of
+            # scheduler.finished (finalize, soaks, benches) sees it
+            self.prefill_scheduler.finished = self.scheduler.finished
+            self._handoff = deque()
+        self.handoffs = CounterMeter(registry=self.registry,
+                                     name="serving_handoff",
+                                     label="event")
+        self.handoff_pending = GaugeMeter(registry=self.registry,
+                                          name="serving_handoff_pending")
         self.sample_fn = sample_fn or greedy_sample
         if self.sample_fn is not greedy_sample:
             # the historical escape hatch, now a LOUD downgrade: a
@@ -572,7 +687,17 @@ class InferenceServer:
         self.ttft = hist("serving_ttft_s")
         self.queue_wait = hist("serving_queue_wait_s")
         self.decode_latency = hist("serving_decode_token_s")
+        # per-token inter-token-latency gaps (the wall gap before each
+        # token after a request's first) — the per-TOKEN tail the
+        # disaggregation bench floors, vs decode_latency's per-request
+        # average (docs/observability.md, "SLO & goodput")
+        self.itl = hist("serving_itl_s")
         self.step_time = hist("serving_step_s")
+        # per-step phase-composition counts for the flight record
+        # (prefill tokens vs decode tokens vs verify columns) — bound
+        # to a dict only while a recorder is on, so the disabled path
+        # stays allocation-free
+        self._phase: Optional[dict] = None
         # pipeline overlap split (stats()["pipeline"]): retire-wait is
         # the host blocked on device results (device-bound time); plan
         # is the host's scheduling+launch work, which the device
@@ -727,7 +852,11 @@ class InferenceServer:
         if self.breaker is not None and not self.breaker.allow():
             return self._finish_at_submit(req, "breaker_open")
         try:
-            self.scheduler.submit(req)
+            # under disaggregation every request enters through the
+            # prefill pool's queue; the decode pool only ever admits
+            # via the hand-off
+            (self.prefill_scheduler if self.disagg
+             else self.scheduler).submit(req)
         except QueueFullError:
             return self._finish_at_submit(req, "rejected")
         # a displaced victim may have finished "shed" inside
@@ -753,19 +882,49 @@ class InferenceServer:
     def _expire_deadlines(self) -> None:
         """Fail every live request whose iteration or wall budget is
         spent — waiting requests too, so a queue stall cannot hold a
-        request past its deadline."""
-        sched = self.scheduler
+        request past its deadline (both pools under disaggregation)."""
         now = self.clock()
-        for req in list(sched.waiting) + list(sched.running.values()):
-            if req.finished:
-                continue
-            over_iters = (req.deadline_iters is not None and
-                          self._iter - req.submit_iter
-                          > req.deadline_iters)
-            over_wall = (req.deadline_s is not None and
-                         now - req.submitted_at >= req.deadline_s)
-            if over_iters or over_wall:
-                sched.fail(req, "timeout")
+        for sched in self._schedulers():
+            for req in (list(sched.waiting)
+                        + list(sched.running.values())):
+                if req.finished:
+                    continue
+                over_iters = (req.deadline_iters is not None and
+                              self._iter - req.submit_iter
+                              > req.deadline_iters)
+                over_wall = (req.deadline_s is not None and
+                             now - req.submitted_at >= req.deadline_s)
+                if over_iters or over_wall:
+                    sched.fail(req, "timeout")
+
+    def _schedulers(self):
+        """Every live scheduler — ``(decode, prefill)`` under
+        disaggregation, the single one otherwise."""
+        if self.disagg:
+            return (self.scheduler, self.prefill_scheduler)
+        return (self.scheduler,)
+
+    @property
+    def has_work(self) -> bool:
+        """Queued, running, launched-but-unretired, or
+        pending-hand-off work anywhere on this server (both pools
+        under disaggregation)."""
+        if self.scheduler.has_work or self._inflight is not None:
+            return True
+        if self.disagg:
+            return (self.prefill_scheduler.has_work
+                    or bool(self._handoff))
+        return False
+
+    def pressure(self) -> float:
+        """The server-level overload signal a router balances on: the
+        max over this server's pools (``Scheduler.pressure``) — under
+        disaggregation a saturated prefill pool reads as pressure even
+        while the decode pool idles, and vice versa."""
+        p = self.scheduler.pressure()
+        if self.disagg:
+            p = max(p, self.prefill_scheduler.pressure())
+        return p
 
     def step(self) -> int:
         """One continuous-batching iteration: retire the previous
@@ -806,11 +965,14 @@ class InferenceServer:
 
     def _step(self) -> int:
         """The :meth:`step` body (see its docstring)."""
+        if self.disagg:
+            return self._step_disagg()
         sched, engine, tr = self.scheduler, self.engine, self.tracer
         rec = self.recorder
         self._iter += 1
         produced, self._pending_produced = self._pending_produced, 0
         step_start = self.clock()
+        self._phase = None
         if rec.enabled:
             # pre-step marks for the flight record's per-step deltas
             # (plain int binds — the disabled path skips even these)
@@ -821,6 +983,7 @@ class InferenceServer:
             oom0 = self.oom.total
             drafted0 = self.spec.count("drafted_tokens")
             accepted0 = self.spec.count("accepted_tokens")
+            self._phase = self._new_phase()
         # RETIRE: consume the previous iteration's launched step before
         # any host decision — deadlines, shedding, admission, and
         # drafts below then see exactly the state the synchronous loop
@@ -908,6 +1071,9 @@ class InferenceServer:
                 # next iteration, so generation stays bit-stable
                 self._note_oom("prefill")
                 continue
+            if self._phase is not None:
+                self._phase["prefill_launches"] += 1
+                self._phase["prefill_tokens"] += len(tokens)
             done = sched.chunk_done(req, len(tokens))
             if not done or not req.prefill_sample:
                 # mid-prefill, or resumed after preemption (the
@@ -1041,8 +1207,10 @@ class InferenceServer:
                     "pending": 1 if self._inflight is not None else 0,
                     "retired_tokens": retired,
                 },
+                "phase": self._phase,
                 "step_s": step_s,
             })
+            self._phase = None
         # breaker-open transition: the moment worth a black box — dump
         # a bundle while the ring still holds the steps leading up
         if self.breaker is not None:
@@ -1069,6 +1237,20 @@ class InferenceServer:
         counter = np.asarray([req.num_cached], np.int32)
         ids, _fin = sample_tokens_host(logits[None], *samp, counter)
         return int(np.asarray(ids)[0])
+
+    @staticmethod
+    def _new_phase() -> dict:
+        """A fresh per-step phase-composition record (the flight
+        record's ``phase`` block): launches issued per program family
+        this step and the tokens/columns each fed — the direct
+        interference view (prefill tokens vs decode tokens vs verify
+        columns per step) that ``tools/postmortem.py`` renders and
+        ``--assert-complete`` reconciles against
+        ``stats()["programs"]``."""
+        return {"prefill_launches": 0, "prefill_tokens": 0,
+                "decode_launches": 0, "decode_tokens": 0,
+                "verify_launches": 0, "verify_columns": 0,
+                "handoff_blocks": 0}
 
     def _decode_inputs(self, running):
         """The decode launch arrays — (tokens, positions, tables),
@@ -1102,6 +1284,9 @@ class InferenceServer:
             self._note_oom("decode")
             return 0
         self.spec.incr("decode_steps")
+        if self._phase is not None:
+            self._phase["decode_launches"] += 1
+            self._phase["decode_tokens"] += len(running)
         finite = np.all(np.isfinite(logits), axis=-1)
         samp = (self.scheduler.sampling_inputs(running)
                 if self.sample_fn is greedy_sample else None)
@@ -1142,6 +1327,9 @@ class InferenceServer:
             self._note_oom("decode")
             return False
         self.spec.incr("decode_steps")
+        if self._phase is not None:
+            self._phase["decode_launches"] += 1
+            self._phase["decode_tokens"] += len(running)
         self._inflight = _InflightStep(
             "decode", list(running), ids, fin, self.clock())
         sched.hold_inflight(running)
@@ -1271,6 +1459,10 @@ class InferenceServer:
                     sched.rollback_lookahead(req)
             return 0
         self.spec.incr("verify_steps")
+        if self._phase is not None:
+            self._phase["verify_launches"] += 1
+            self._phase["verify_columns"] += (
+                len(running) + sum(len(d) for d in drafts.values()))
         finite = np.all(np.isfinite(logits), axis=-1)      # (B, K)
         samp = (self.scheduler.sampling_inputs(running)
                 if self.sample_fn is greedy_sample else None)
@@ -1319,6 +1511,12 @@ class InferenceServer:
                     sched.rollback_lookahead(req)
             return False
         self.spec.incr("verify_steps")
+        if self._phase is not None:
+            self._phase["verify_launches"] += 1
+            # columns fed = each slot's pending token + its drafts
+            # (host ints — lengths mirrors exactly this)
+            self._phase["verify_columns"] += (
+                len(running) + sum(len(d) for d in drafts.values()))
         self._inflight = _InflightStep(
             "verify", list(running), ids, fin, self.clock(),
             drafts=drafts, lengths=lengths)
@@ -1435,6 +1633,444 @@ class InferenceServer:
             inf.running, inf.drafts, inf.lengths, toks, finite,
             now=inf.launched_at)
 
+    # -- disaggregated prefill/decode pools (docs/serving.md) --------------
+
+    def _step_disagg(self) -> int:
+        """One disaggregated iteration (``enable_disagg=True``): the
+        DECODE pool retires, plans, and launches a pure decode/verify
+        step — never a prefill — and the PREFILL pool then advances up
+        to ``prefill_max_concurrent`` chunk launches whose device
+        compute overlaps the already-in-flight decode (the two pools
+        share no array, so nothing serializes them).  Finished
+        prefills hand their blocks to the decode pool through the
+        fixed-shape cross-pool block copy at the START of the next
+        step; greedy output is bit-exact vs the monolithic loop by
+        construction (same programs, same per-request context, the
+        copy is byte-preserving)."""
+        sched, tr = self.scheduler, self.tracer
+        psched = self.prefill_scheduler
+        rec = self.recorder
+        self._iter += 1
+        produced, self._pending_produced = self._pending_produced, 0
+        step_start = self.clock()
+        self._phase = None
+        if rec.enabled:
+            preempt0 = (sched.preemption_count
+                        + psched.preemption_count)
+            lk_grant0 = sched.lookahead_granted
+            lk_roll0 = sched.lookahead_rolled_back
+            evict0 = self.prefix.count("prefix_evicted_blocks")
+            oom0 = self.oom.total
+            drafted0 = self.spec.count("drafted_tokens")
+            accepted0 = self.spec.count("accepted_tokens")
+            self._phase = self._new_phase()
+        # RETIRE the decode pool's in-flight step first — this is the
+        # inter-token edge disaggregation protects
+        retired = self._flush_window()
+        produced += retired
+        plan_start = self.clock()
+        self._expire_deadlines()
+        self.pressure_gauge.update(self.pressure())
+        shed = psched.shed_overload()
+        if shed and tr.enabled:
+            for r in shed:
+                tr.instant("request_shed", uid=r.uid,
+                           priority=r.priority)
+        # HAND-OFF: prefills that finished in an earlier step
+        # materialize their first token and move pools (the copy and
+        # this step's decode of the moved request share the decode
+        # pool's data dependency, so ordering is automatic)
+        produced += self._process_handoffs()
+        # DECODE pool: pure decode/verify over its running batch
+        if sched.running:
+            for req in list(sched.running.values()):
+                if req.running and not req.prefilling:
+                    if not sched.ensure_decode_capacity(req):
+                        sched.fail(req, "capacity")
+            # a decode-pool preemption victim must re-prefill: it
+            # re-enters through the PREFILL pool's queue front,
+            # keeping its seniority (recompute is bit-stable — the
+            # pending token continues, exactly as monolithic)
+            while sched.waiting:
+                psched.waiting.appendleft(sched.waiting.pop())
+            running = [r for r in sched.running.values()
+                       if not r.prefilling]
+            if running:
+                drafts = (self._propose_drafts(running)
+                          if self.speculating else {})
+                if self.pipelining:
+                    if drafts:
+                        self._launch_verify(running, drafts)
+                    else:
+                        self._launch_decode(running)
+                elif drafts:
+                    produced += self._verify_step(running, drafts)
+                else:
+                    produced += self._decode_step(running)
+        # PREFILL pool: admission + one chunk per prefilling request,
+        # launched AFTER the decode launch so its compute runs under
+        # the in-flight decode instead of in front of it
+        chunks, pf_produced, admitted = self._prefill_slice()
+        produced += pf_produced
+        self.chunk_iters.update(chunks)
+        if chunks:
+            self.prefix.incr("prefill_chunks", chunks)
+
+        if self.pipelining:
+            self.plan_time.record(self.clock() - plan_start)
+        self.tokens.update(produced)
+        self.queue_depth.update(psched.num_waiting)
+        self.occupancy.update(sched.num_running
+                              / self.engine.max_batch_size)
+        step_s = self.clock() - step_start
+        self.step_time.record(step_s)
+        self._finalize_finished()
+        alloc = self.engine.allocator
+        palloc = self.prefill_engine.allocator
+        self.mem_live.update(alloc.num_live)
+        self.mem_free.update(alloc.num_free)
+        self.mem_evictable.update(
+            self.prefix_cache.num_evictable
+            if self.prefix_cache is not None else 0)
+        self.mem_frag.update(sched.frag_slots() + psched.frag_slots())
+        self.handoff_pending.update(len(self._handoff))
+        if rec.enabled:
+            fin = sched.finished
+            finished_now = [
+                {"uid": r.uid, "reason": r.finish_reason,
+                 "tokens": len(r.generated)}
+                for r in fin[self._rec_cursor:]]
+            self._rec_cursor = len(fin)
+            rec.record({
+                "iter": self._iter,
+                "produced": produced,
+                "waiting": psched.num_waiting,
+                "running": [r.uid for r in sched._admit_order]
+                + [r.uid for r in psched._admit_order],
+                "prefilling": [r.uid for r in psched._admit_order
+                               if r.prefilling],
+                "admitted": [r.uid for r in admitted],
+                "shed": [{"uid": r.uid, "priority": r.priority,
+                          "debt_tokens":
+                          OverloadPolicy.slo_debt_tokens(r)}
+                         for r in shed],
+                "finished": finished_now,
+                "preemptions": (sched.preemption_count
+                                + psched.preemption_count) - preempt0,
+                "evicted_blocks":
+                    self.prefix.count("prefix_evicted_blocks") - evict0,
+                "oom": self.oom.total - oom0,
+                "spec": {
+                    "drafted":
+                        self.spec.count("drafted_tokens") - drafted0,
+                    "accepted":
+                        self.spec.count("accepted_tokens") - accepted0,
+                },
+                "pressure": round(self.pressure_gauge.val, 4),
+                "breaker": (self.breaker.state
+                            if self.breaker is not None
+                            else "disabled"),
+                "memory": {
+                    "free": alloc.num_free,
+                    "live": alloc.num_live,
+                    "evictable": (self.prefix_cache.num_evictable
+                                  if self.prefix_cache is not None
+                                  else 0),
+                    "frag_slots": (sched.frag_slots()
+                                   + psched.frag_slots()),
+                    "lookahead_granted":
+                        sched.lookahead_granted - lk_grant0,
+                    "lookahead_rolled_back":
+                        sched.lookahead_rolled_back - lk_roll0,
+                },
+                "pipeline": {
+                    "pending": 1 if self._inflight is not None else 0,
+                    "retired_tokens": retired,
+                },
+                "phase": self._phase,
+                "disagg": {
+                    "handoff_pending": len(self._handoff),
+                    "prefill_free": palloc.num_free,
+                    "prefill_live": palloc.num_live,
+                },
+                "step_s": step_s,
+            })
+            self._phase = None
+        if self.breaker is not None:
+            state = self.breaker.state
+            if state != self._last_breaker_state:
+                self._last_breaker_state = state
+                if state == "open":
+                    self._auto_postmortem("breaker_open")
+        return produced
+
+    def _prefill_slice(self):
+        """The prefill pool's share of one disaggregated step: shed /
+        admit / COW / one chunk per prefilling slot, all against the
+        PREFILL engine and scheduler.  Chunk launches are asynchronous
+        (mid-chunk results are never materialized, and the final
+        chunk's sampled token is stashed as un-materialized handles
+        under pipelining), so the slice costs the host little more
+        than dispatch.  Returns ``(chunk launches, tokens produced,
+        admitted requests)``."""
+        psched, engine, tr = (self.prefill_scheduler,
+                              self.prefill_engine, self.tracer)
+        pipelined = self.pipelining
+        with tr.span("admit"):
+            admitted = psched.admit()
+        if admitted:
+            now = self.clock()
+            for req in admitted:
+                if req.admitted_at is None:
+                    req.admitted_at = now
+                if tr.enabled:
+                    tr.instant("request_admit", uid=req.uid,
+                               cached_tokens=req.cached_prefix_tokens)
+        cows = [r for r in psched._admit_order if r.pending_cow]
+        if cows:
+            try:
+                with tr.span("cow_copy", blocks=len(cows)):
+                    engine.copy_blocks([r.pending_cow for r in cows])
+            except MemoryError:
+                self._note_oom("copy_blocks")
+            else:
+                for req in cows:
+                    psched.cow_done(req)
+        chunks = 0
+        produced = 0
+        for req in [r for r in psched._admit_order if r.prefilling]:
+            tokens, start, is_last = psched.prefill_plan(req)
+            samp1 = (psched.prefill_sampling(req)
+                     if pipelined and is_last and req.prefill_sample
+                     else None)
+            skw = {"sampling": samp1} if samp1 is not None else {}
+            try:
+                if (start == 0 and is_last
+                        and self.prefill_chunk is None):
+                    with tr.span("prefill", uid=req.uid,
+                                 tokens=len(tokens)):
+                        out = (engine.prefill_sampled(
+                            tokens, req.block_table,
+                            **skw) if pipelined
+                            else engine.prefill(tokens,
+                                                req.block_table))
+                else:
+                    with tr.span("chunk_prefill", uid=req.uid,
+                                 tokens=len(tokens), start=start):
+                        out = (engine.chunk_prefill_sampled(
+                            tokens, start, req.block_table,
+                            pad_to=self.prefill_chunk,
+                            **skw) if pipelined
+                            else engine.chunk_prefill(
+                                tokens, start, req.block_table,
+                                pad_to=self.prefill_chunk))
+                    chunks += 1
+            except MemoryError:
+                self._note_oom("prefill")
+                continue
+            if self._phase is not None:
+                self._phase["prefill_launches"] += 1
+                self._phase["prefill_tokens"] += len(tokens)
+            done = psched.chunk_done(req, len(tokens))
+            if not done:
+                continue
+            if not req.prefill_sample:
+                # resumed after preemption: the pending token
+                # continues — nothing to sample, straight to hand-off
+                self._handoff.append(_Handoff(req))
+                continue
+            if pipelined:
+                # the sampled token stays un-materialized until the
+                # hand-off processes next step (its compute will long
+                # be done) — the prefill slice never blocks on device
+                self._handoff.append(_Handoff(req, handles=out))
+                continue
+            # synchronous path: materialize now, exactly like the
+            # monolithic loop's prefill sampling
+            logits = np.asarray(out)
+            if not np.all(np.isfinite(logits)):
+                psched.fail(req, "nonfinite")
+                if self.breaker is not None:
+                    self.breaker.record_failure()
+                continue
+            tok = self._sample_prefill_host(req, logits)
+            req.record_token(tok)
+            self._note_first_token(req)
+            produced += 1
+            if req.finished:
+                psched.retire(req)
+                if self.breaker is not None:
+                    self.breaker.record_success()
+                continue
+            self._handoff.append(_Handoff(req))
+        return chunks, produced, admitted
+
+    def _process_handoffs(self) -> int:
+        """Drain the hand-off queue (FIFO): materialize each finished
+        prefill's first token (pipelined launches stashed handles a
+        step ago), then move its blocks into the decode pool via the
+        cross-pool block copy — or ship them to another replica
+        through ``handoff_sink``.  A hand-off that cannot place yet
+        (no decode slot / blocks, or a transient copy failure) stays
+        queued, blocks intact on the prefill side, and retries next
+        step — delayed, never torn: the copy is idempotent over whole
+        tables, so a partial transfer is simply re-copied.  Returns
+        tokens produced (hand-off-time first tokens)."""
+        sched, psched = self.scheduler, self.prefill_scheduler
+        q = self._handoff
+        produced = 0
+        while q:
+            ent = q[0]
+            req = ent.req
+            if req.finished or not req.running:
+                # expired / evacuated / failed while queued
+                q.popleft()
+                continue
+            if ent.handles is not None:
+                ids, fin = ent.handles
+                ent.handles = None
+                if not bool(np.asarray(fin)[0]):
+                    psched.fail(req, "nonfinite")
+                    if self.breaker is not None:
+                        self.breaker.record_failure()
+                    q.popleft()
+                    continue
+                req.record_token(int(np.asarray(ids)[0]))
+                self._note_first_token(req)
+                produced += 1
+                if req.finished:
+                    psched.retire(req)
+                    if self.breaker is not None:
+                        self.breaker.record_success()
+                    self.handoffs.incr("finished_at_prefill")
+                    q.popleft()
+                    continue
+            if self.handoff_sink is not None:
+                # cross-replica: export the blocks (+ scale sidecars)
+                # as a checksummed host payload and let the router
+                # place the decode half; True = ownership moved
+                payload = self.prefill_engine.export_blocks(
+                    req.block_table)
+                if self.handoff_sink(req, payload):
+                    psched.register_progress(req)
+                    psched.fail(req, "handoff")
+                    self.handoffs.incr("sink_delivered")
+                    q.popleft()
+                    continue
+                # nobody could take it: fall back to the LOCAL decode
+                # pool below — monolithic placement on this replica
+                self.handoffs.incr("sink_local_fallback")
+            n = len(req.block_table)
+            if not sched.has_free_slot:
+                self.handoffs.incr("deferred")
+                break
+            dst = sched._try_alloc(n)
+            if dst is None:
+                self.handoffs.incr("deferred")
+                break
+            try:
+                with self.tracer.span("handoff", uid=req.uid,
+                                      blocks=n):
+                    self.engine.copy_blocks_from(
+                        self.prefill_engine,
+                        list(zip(req.block_table, dst)))
+            except MemoryError:
+                # transient (or chaos-torn) transfer: return the
+                # destination blocks and retry the WHOLE copy next
+                # step — re-copying every block makes a torn transfer
+                # indistinguishable from a delayed one
+                sched.allocator.free(dst)
+                self._note_oom("handoff")
+                break
+            if self._phase is not None:
+                self._phase["handoff_blocks"] += n
+            psched.release_handoff(req)
+            sched.admit_handoff(req, dst)
+            self.handoffs.incr("requests")
+            self.handoffs.incr("blocks", n)
+            q.popleft()
+        return produced
+
+    def ingest_handoff(self, prompt: Sequence[int],
+                       generated: Sequence[int], payload: dict, *,
+                       max_new_tokens: int,
+                       num_cached: int,
+                       eos_id: Optional[int] = None,
+                       priority: int = 0,
+                       deadline_iters: Optional[int] = None,
+                       deadline_s: Optional[float] = None,
+                       sampling: Optional[SamplingParams] = None,
+                       submitted_at: Optional[float] = None,
+                       first_token_at: Optional[float] = None
+                       ) -> Optional[Request]:
+        """The decode half of a CROSS-REPLICA hand-off: import an
+        :meth:`DecodeEngine.export_blocks` payload into this server's
+        (decode) pool and admit the request straight into the decode
+        batch at its carried position — no prefill here, ever.
+
+        Returns the admitted :class:`Request`, or ``None`` when this
+        replica cannot take it right now (draining, no free decode
+        slot, or no blocks) — the router then falls back to monolithic
+        placement.  Raises :class:`ValueError` on a torn payload
+        (checksum mismatch): nothing was imported, the caller must
+        fall back to a fresh prefill (which is bit-identical)."""
+        with (self._ops_lock or _NO_LOCK):
+            if self._closed:
+                raise RuntimeError(
+                    "InferenceServer is closed; no further submissions")
+            if self._draining:
+                return None
+            if self._inflight is not None:
+                self._pending_produced += self._flush_window()
+            generated = [int(t) for t in generated]
+            if not generated:
+                raise ValueError(
+                    "ingest_handoff needs >= 1 generated token (the "
+                    "prefill side samples the first token before "
+                    "handing off)")
+            sched = self.scheduler
+            if not sched.has_free_slot:
+                return None
+            n = int(payload.get("num_blocks", 0))
+            blocks = sched._try_alloc(n)
+            if blocks is None:
+                return None
+            try:
+                self.engine.import_blocks(blocks, payload)
+            except ValueError:
+                sched.allocator.free(blocks)
+                raise
+            except MemoryError:
+                sched.allocator.free(blocks)
+                return None
+            req = Request(prompt=[int(t) for t in prompt],
+                          max_new_tokens=int(max_new_tokens),
+                          eos_id=eos_id,
+                          priority=int(priority),
+                          deadline_iters=deadline_iters,
+                          deadline_s=deadline_s,
+                          submit_iter=self._iter,
+                          submitted_at=(submitted_at
+                                        if submitted_at is not None
+                                        else self.clock()),
+                          sampling=sampling if sampling is not None
+                          else SamplingParams())
+            req.generated = generated
+            req.next_input = generated[-1]
+            req.num_cached = int(num_cached)
+            req.admitted_at = self.clock()
+            req.first_token_at = (first_token_at
+                                  if first_token_at is not None
+                                  else req.admitted_at)
+            self.sampling_classes.incr(req.sampling.klass)
+            sched.admit_handoff(req, blocks)
+            self.handoffs.incr("ingested")
+            self.handoffs.incr("blocks", n)
+            if self.tracer.enabled:
+                self.tracer.instant("handoff_ingest", uid=req.uid,
+                                    blocks=n)
+            return req
+
     def _note_oom(self, site: str) -> None:
         """Account one transient engine ``MemoryError``: the affected
         call was skipped (nothing mutated) and will retry next
@@ -1450,11 +2086,23 @@ class InferenceServer:
 
     def _note_first_token(self, req: Request) -> None:
         """Stamp the first-token edge of the request timeline (the
-        TTFT numerator) the moment its first token is sampled."""
+        TTFT numerator) the moment its first token is sampled, and —
+        for every later token — the inter-token gap since the previous
+        one (the ITL distribution behind
+        ``stats()["latency"]["itl_ms"]`` and the per-request p99 the
+        SLO tracker bounds).  Tokens accepted together in one verify
+        step record one real gap plus near-zero followers — exactly
+        the arrival pattern a streaming consumer sees."""
+        now = self.clock()
         if req.first_token_at is None and req.generated:
-            req.first_token_at = self.clock()
+            req.first_token_at = now
             if self.tracer.enabled:
                 self.tracer.instant("request_first_token", uid=req.uid)
+        elif req.last_token_at is not None:
+            gap = now - req.last_token_at
+            req.itl_gaps.append(gap)
+            self.itl.record(gap)
+        req.last_token_at = now
 
     def _finalize_finished(self) -> None:
         """Stamp ``finished_at`` on every request that finished since
@@ -1565,9 +2213,11 @@ class InferenceServer:
         postmortem capture: an :class:`AssertionError` auto-dumps a
         bundle (when ``postmortem_dir`` + recorder are configured)
         before re-raising, so the steps leading up to the violated
-        invariant are preserved, not just the assertion text."""
+        invariant are preserved, not just the assertion text.  Under
+        disaggregation both pools' schedulers are audited."""
         try:
-            self.scheduler.audit()
+            for sched in self._schedulers():
+                sched.audit()
         except AssertionError as e:
             self._auto_postmortem("audit_failure",
                                   extra={"error": str(e)})
@@ -1610,7 +2260,7 @@ class InferenceServer:
                             deadline_s=deadline_s,
                             sampling=s)
                 for p, s in zip(prompts, per_prompt)]
-        while self.scheduler.has_work:
+        while self.has_work:
             self.step()
         if return_requests:
             return reqs
@@ -1649,7 +2299,7 @@ class InferenceServer:
         mid-generation (pinned by ``tests/L0/test_overload.py``).
         Idempotent; returns the flushed :meth:`stats` snapshot."""
         self.begin_drain()
-        while self.scheduler.has_work:
+        while self.has_work:
             self.step()
         self._account_pending_produced()
         self._finalize_finished()
@@ -1665,6 +2315,8 @@ class InferenceServer:
         if self._inflight is not None:
             self._pending_produced += self._flush_window()
         moved = self.scheduler.withdraw_waiting()
+        if self.disagg:
+            moved += self.prefill_scheduler.withdraw_waiting()
         self._finalize_finished()
         return moved
 
@@ -1691,17 +2343,24 @@ class InferenceServer:
         Host bookkeeping (scheduler/allocator/prefix cache) is purely
         host-side, so it stays audit-clean even when the engine is
         wedged — the pool is left consistent for a later recovery."""
-        sched = self.scheduler
         self._inflight = None
-        sched.release_inflight()
+        self.scheduler.release_inflight()
+        if self.disagg:
+            # queued hand-offs' requests still live in the prefill
+            # scheduler; the pool sweep below disposes of them, so the
+            # queue entries just drop
+            self._handoff.clear()
         failed = []
-        for req in list(sched.running.values()):
-            if req.generated:
-                sched.fail(req, reason)
-                failed.append(req)
-            else:
-                sched.preempt(req)
-        requeueable = sched.withdraw_waiting()
+        for sched in self._schedulers():
+            for req in list(sched.running.values()):
+                if req.generated:
+                    sched.fail(req, reason)
+                    failed.append(req)
+                else:
+                    sched.preempt(req)
+        requeueable = []
+        for sched in self._schedulers():
+            requeueable += sched.withdraw_waiting()
         self._finalize_finished()
         return requeueable, failed
 
@@ -1750,6 +2409,8 @@ class InferenceServer:
         for h in self._queue_wait_prio.values():
             h.reset()
         self.decode_latency.reset()
+        self.itl.reset()
+        self.handoff_pending.reset()
         self.step_time.reset()
         self.retire_wait.reset()
         self.plan_time.reset()
@@ -1777,16 +2438,23 @@ class InferenceServer:
         live = alloc.num_live
         frag = sched.frag_slots()
         info = self.engine.memory_info()
+        # under disaggregation the prefix cache's evictable holds live
+        # in the PREFILL pool — the decode pool's free/live/evictable
+        # partition stays exact with evictable 0 here, and the
+        # prefill pool's own partition rides in stats()["disagg"]
+        cache_here = (self.prefix_cache
+                      if self.prefix_cache is not None
+                      and not self.disagg else None)
         out = {
             "blocks_usable": usable,
             "blocks_free": alloc.num_free,
             "blocks_live": live,
             "blocks_live_peak": alloc.live_peak,
-            "blocks_evictable": (self.prefix_cache.num_evictable
-                                 if self.prefix_cache is not None
+            "blocks_evictable": (cache_here.num_evictable
+                                 if cache_here is not None
                                  else 0),
-            "blocks_evictable_peak": (self.prefix_cache.evictable_peak
-                                      if self.prefix_cache is not None
+            "blocks_evictable_peak": (cache_here.evictable_peak
+                                      if cache_here is not None
                                       else 0),
             "occupancy": round(live / usable, 3),
             "occupancy_peak": round(alloc.live_peak / usable, 3),
@@ -1811,6 +2479,37 @@ class InferenceServer:
             "compute_dtype": info["compute_dtype"],
         }
         return out
+
+    def _disagg_stats(self) -> dict:
+        """The pinned ``stats()["disagg"]`` block: hand-off counters
+        plus the PREFILL pool's memory partition (the decode pool owns
+        ``stats()["memory"]``)."""
+        if not self.disagg:
+            return {"enabled": False}
+        palloc = self.prefill_engine.allocator
+        usable = palloc.cfg.num_blocks - 1
+        return {
+            "enabled": True,
+            "prefill_max_concurrent":
+                self.prefill_scheduler.max_batch_size,
+            "prefill_blocks_usable": usable,
+            "prefill_blocks_free": palloc.num_free,
+            "prefill_blocks_live": palloc.num_live,
+            "prefill_blocks_live_peak": palloc.live_peak,
+            "prefill_blocks_evictable": (
+                self.prefix_cache.num_evictable
+                if self.prefix_cache is not None else 0),
+            "prefill_pool_bytes":
+                self.prefill_engine.memory_info()["pool_bytes"],
+            "prefill_backlog_blocks":
+                self.prefill_scheduler.prefill_backlog_blocks(),
+            "handoff": {
+                "pending": len(self._handoff),
+                "pending_peak": int(self.handoff_pending.peak),
+                **self.handoffs.as_dict(),
+            },
+            "sink_attached": self.handoff_sink is not None,
+        }
 
     def _program_stats(self) -> dict:
         """The ``stats()["programs"]`` block: the per-compiled-program
@@ -1955,6 +2654,10 @@ class InferenceServer:
                 "ttft_ms": _hist_ms(self.ttft),
                 "queue_wait_ms": _hist_ms(self.queue_wait),
                 "decode_token_ms": _hist_ms(self.decode_latency),
+                # per-TOKEN inter-token gaps (vs decode_token_ms's
+                # per-request average): the tail the disaggregation
+                # bench floors (docs/serving.md)
+                "itl_ms": _hist_ms(self.itl),
                 "step_ms": _hist_ms(self.step_time),
                 "queue_wait_by_priority_ms": {
                     p: _hist_ms(h) for p, h in
@@ -1978,6 +2681,11 @@ class InferenceServer:
                 "port": self.ops.port if self.ops is not None else None,
                 "requests": self.ops_requests.total,
             },
+            # disaggregated prefill/decode pools (docs/serving.md,
+            # "Disaggregated prefill/decode"): the prefill pool's own
+            # free/live/evictable partition plus the hand-off
+            # counters; {enabled: False} on a monolithic server
+            "disagg": self._disagg_stats(),
             # tensor-parallel serving (docs/serving.md,
             # "Tensor-parallel serving"): mesh geometry, tp degree,
             # per-shard KV bytes, and the mesh-lowered program count —

@@ -80,6 +80,7 @@ from apex_tpu.serving.kv_cache import (
     KVCacheConfig,
     context_bias,
     copy_blocks,
+    copy_blocks_across,
     gather_context,
     gather_scales,
     init_kv_cache,
@@ -303,6 +304,16 @@ class DecodeEngine:
         self._verify_jit = _jit(self._verify_impl, (1,),
                                 (cache_sh, repl))
         self._copy_jit = _jit(self._copy_impl, (0,), cache_sh)
+        # the cross-pool hand-off programs (docs/serving.md,
+        # "Disaggregated prefill/decode").  Donation policy mirrors
+        # the sampled twins: the hand-off copy sits in the decode
+        # pool's step path, and a donated call executes synchronously
+        # on the CPU backend (BENCH_NOTES r8) — which would stall the
+        # very decode launch disaggregation exists to protect.
+        xfer_donate = (0,) if jax.default_backend() != "cpu" else ()
+        self._xfer_jit = _jit(self._xfer_impl, xfer_donate, cache_sh)
+        self._import_jit = _jit(self._import_impl, xfer_donate,
+                                cache_sh)
         # the fused on-device-sampling twins (docs/serving.md,
         # "Pipelined serve loop"): same bodies + argmax/finite-guard,
         # so a greedy server transfers token ids, never logits.
@@ -469,6 +480,22 @@ class DecodeEngine:
         """(_COPY_WIDTH,) src/dst block ids, (0, 0)-padded — the COW
         block duplication (``kv_cache.copy_blocks``)."""
         return copy_blocks(cache, src, dst, self.block_size)
+
+    def _xfer_impl(self, dst_cache, src_cache, src, dst):
+        """(_COPY_WIDTH,) src/dst block ids, (0, 0)-padded — the
+        CROSS-POOL hand-off copy (``kv_cache.copy_blocks_across``):
+        ``src`` indexes another engine's pool of identical geometry,
+        ``dst`` this one's."""
+        return copy_blocks_across(dst_cache, src_cache, src, dst,
+                                  self.block_size)
+
+    def _import_impl(self, cache, slots, leaves):
+        """Scatter a host-shipped block payload into the pool:
+        ``slots`` (W * block_size,) flat slot indices (padding rows
+        point at the garbage block), ``leaves`` a dict matching the
+        cache's leaf names with per-slot rows along axis 1."""
+        return {name: arr.at[:, slots].set(leaves[name])
+                for name, arr in cache.items()}
 
     def _decode_impl(self, params, cache, tokens, positions, tables):
         """tokens (B,) current input token per slot; positions (B,)
@@ -790,6 +817,101 @@ class DecodeEngine:
             self.cache = self._copy_jit(self.cache, *args)
             self._account(self._copy_jit, mark, "copy_blocks",
                           key=self._qkey())
+
+    # -- disaggregated hand-off (docs/serving.md) --------------------------
+
+    def copy_blocks_from(self, src_engine, pairs) -> None:
+        """Copy physical blocks ``[(src, dst), ...]`` from ANOTHER
+        engine's pool into this one — the same-host disaggregated
+        hand-off: a finished prefill's KV moves from the prefill pool
+        into the decode pool without either pool's programs ever
+        sharing an array.  Both pools must share geometry (layers,
+        heads, block size, quantization mode — the server constructs
+        them that way).  Fixed-width ``_COPY_WIDTH`` launches, exactly
+        like :meth:`copy_blocks`, so one program serves every
+        hand-off."""
+        for i in range(0, len(pairs), _COPY_WIDTH):
+            batch = pairs[i:i + _COPY_WIDTH]
+            src = np.zeros((_COPY_WIDTH,), np.int32)
+            dst = np.zeros((_COPY_WIDTH,), np.int32)
+            for j, (s, d) in enumerate(batch):
+                src[j], dst[j] = s, d
+            args = self._put(src, dst)
+            mark = self._mark(self._xfer_jit)
+            self.cache = self._xfer_jit(self.cache, src_engine.cache,
+                                        *args)
+            self._account(self._xfer_jit, mark, "handoff_copy",
+                          key=self._qkey())
+
+    def _block_slots(self, block_ids, pad_to: int) -> np.ndarray:
+        """Flat pool slots of ``block_ids``' token rows, padded with
+        the garbage block's slots to ``pad_to`` blocks."""
+        bs = self.block_size
+        ids = np.zeros((pad_to,), np.int64)
+        ids[:len(block_ids)] = block_ids
+        return (ids[:, None] * bs + np.arange(bs)[None, :]).reshape(-1)
+
+    def export_blocks(self, block_ids) -> dict:
+        """Materialize ``block_ids``' contents as a host payload — the
+        CROSS-REPLICA hand-off transfer unit (``docs/serving.md``,
+        "Disaggregated prefill/decode"): every cache leaf's rows for
+        those blocks (scale sidecars included under quantization) plus
+        a per-leaf crc32, so a torn transfer is DETECTED at import
+        instead of silently decoding garbage."""
+        import zlib
+
+        slots = self._block_slots(block_ids, len(block_ids))
+        leaves = {name: np.asarray(arr[:, slots])
+                  for name, arr in self.cache.items()}
+        return {
+            "num_blocks": len(block_ids),
+            "block_size": self.block_size,
+            "leaves": leaves,
+            "crc": {name: zlib.crc32(np.ascontiguousarray(a).tobytes())
+                    for name, a in leaves.items()},
+        }
+
+    def import_blocks(self, block_ids, payload) -> None:
+        """Scatter an :meth:`export_blocks` payload into THIS pool's
+        ``block_ids`` (same count, same geometry).  Verifies the
+        per-leaf checksums first and raises :class:`ValueError` on any
+        mismatch — a torn hand-off must be rejected whole (the caller
+        falls back to a fresh monolithic prefill, which is
+        bit-identical), never half-imported."""
+        import zlib
+
+        if payload.get("block_size") != self.block_size \
+                or payload.get("num_blocks") != len(block_ids):
+            raise ValueError(
+                f"hand-off payload geometry mismatch: payload holds "
+                f"{payload.get('num_blocks')} blocks of "
+                f"{payload.get('block_size')} slots, importing "
+                f"{len(block_ids)} blocks of {self.block_size}")
+        leaves = payload["leaves"]
+        if set(leaves) != set(self.cache):
+            raise ValueError(
+                f"hand-off payload leaves {sorted(leaves)} != pool "
+                f"leaves {sorted(self.cache)} (quantization modes "
+                f"must match across replicas)")
+        for name, arr in leaves.items():
+            got = zlib.crc32(np.ascontiguousarray(arr).tobytes())
+            if got != payload["crc"].get(name):
+                raise ValueError(
+                    f"torn hand-off payload: leaf {name!r} checksum "
+                    f"{got} != recorded {payload['crc'].get(name)}")
+        w = self.blocks_per_seq
+        slots = self._block_slots(block_ids, w).astype(np.int32)
+        padded = {}
+        for name, arr in leaves.items():
+            full = np.zeros((arr.shape[0], w * self.block_size)
+                            + arr.shape[2:], arr.dtype)
+            full[:, :arr.shape[1]] = arr
+            padded[name] = full
+        args = self._put(slots, padded)
+        mark = self._mark(self._import_jit)
+        self.cache = self._import_jit(self.cache, *args)
+        self._account(self._import_jit, mark, "import_blocks",
+                      key=self._qkey())
 
     def _decode_args(self, tokens, positions, tables, sampling=None):
         extra = tuple(sampling) if sampling is not None else ()

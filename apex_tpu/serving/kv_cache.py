@@ -366,6 +366,29 @@ def context_bias(lengths, max_context: int):
                      0.0, NEG_INF).astype(jnp.float32)
 
 
+def copy_blocks_across(dst_cache, src_cache, src, dst, block_size: int):
+    """Whole-block copy ``src[i] (in src_cache) -> dst[i] (in
+    dst_cache)`` BETWEEN two pools of identical geometry — the device
+    half of the disaggregated prefill/decode hand-off
+    (``docs/serving.md``, "Disaggregated prefill/decode"): a finished
+    prefill's blocks move from the prefill pool into the decode pool
+    as one fixed-shape gather+scatter, so the two pools' programs
+    share no array and their compute never serializes through a common
+    pool version.
+
+    src, dst: (M,) int32 physical block ids, (0, 0)-padded exactly
+    like :func:`copy_blocks` (garbage block -> garbage block is a
+    no-op by construction).  Copies EVERY leaf the two caches share —
+    under quantization the scale sidecar rows move with their int8
+    payload, so a handed-off block dequantizes bit-identically on the
+    decode side."""
+    off = jnp.arange(block_size, dtype=src.dtype)[None, :]
+    s = (src[:, None] * block_size + off).reshape(-1)
+    d = (dst[:, None] * block_size + off).reshape(-1)
+    return {name: arr.at[:, d].set(src_cache[name][:, s])
+            for name, arr in dst_cache.items()}
+
+
 def copy_blocks(cache, src, dst, block_size: int):
     """Whole-block copy ``src[i] -> dst[i]`` inside the pool — the
     device half of copy-on-write duplication (a request that must

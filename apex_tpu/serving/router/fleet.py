@@ -41,6 +41,7 @@ replica holds it.
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, List, Optional, Sequence
@@ -143,6 +144,8 @@ class RouterFleet:
                  registry: Optional[MetricsRegistry] = None,
                  tracer=None,
                  ops_port: Optional[int] = None,
+                 disagg_prefill: int = 0,
+                 disagg_prefill_threshold: Optional[int] = None,
                  **server_kwargs):
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
@@ -150,6 +153,10 @@ class RouterFleet:
             raise ValueError(
                 "pass either make_server= or tp= — a custom factory "
                 "owns its replicas' meshes")
+        if disagg_prefill and not 0 < disagg_prefill < replicas:
+            raise ValueError(
+                f"disagg_prefill={disagg_prefill} must leave at least "
+                f"one decode-capable replica (replicas={replicas})")
         self.registry = registry if registry is not None \
             else MetricsRegistry()
         self.tracer = tracer if tracer is not None else get_tracer()
@@ -175,6 +182,13 @@ class RouterFleet:
             if meshes[i] is not None:
                 kw.setdefault("mesh", meshes[i])
                 kw.setdefault("tp_axis", tp_axis)
+            if i < disagg_prefill:
+                # a prefill-role replica runs its server DISAGGREGATED
+                # so every prefill lands in the dedicated prefill pool
+                # and finished KV ships through the hand-off sink
+                # (wired below); its own decode pool stays the
+                # last-resort local fallback
+                kw.setdefault("enable_disagg", True)
             return InferenceServer(cfg, params, clock=clock, **kw)
 
         build = make_server or default_server
@@ -186,14 +200,34 @@ class RouterFleet:
                                            clock=clock))
             name = names[i] if names else None
             self.replicas.append(
-                Replica(i, srv, name=name, breaker=breaker))
+                Replica(i, srv, name=name, breaker=breaker,
+                        role="prefill" if i < disagg_prefill
+                        else "any"))
         if policy is None:
             policy = RouterPolicy(
-                affinity_block=self.replicas[0].server.engine.block_size)
+                affinity_block=self.replicas[0].server.engine.block_size,
+                disagg_prefill_threshold=(
+                    disagg_prefill_threshold if disagg_prefill
+                    else None))
         self.router = ReplicaRouter(self.replicas, policy=policy,
                                     clock=clock,
                                     registry=self.registry,
                                     tracer=self.tracer)
+        # wire each prefill-role replica's hand-off sink to the router
+        # (the server exports the blocks; the router places the decode
+        # half — docs/serving.md, "Disaggregated prefill/decode")
+        for rep in self.replicas:
+            if rep.role == "prefill" and rep.server.disagg:
+                rep.server.handoff_sink = \
+                    self.router.handoff_sink_for(rep)
+        if disagg_prefill and \
+                self.router.policy.disagg_prefill_threshold is None:
+            # default: prompts spanning >= 4 KV blocks are worth the
+            # cross-replica transfer; shorter ones stay monolithic
+            self.router.policy = dataclasses.replace(
+                self.router.policy,
+                disagg_prefill_threshold=(
+                    4 * self.replicas[0].server.engine.block_size))
         self.threaded = bool(threaded)
         self._pool = (ThreadPoolExecutor(
             max_workers=replicas,
@@ -297,10 +331,8 @@ class RouterFleet:
         """Any live (non-open) replica still holding queued, running,
         or launched-but-unretired work.  Open replicas never count:
         failover already evacuated them."""
-        return any(
-            rep.server.scheduler.has_work
-            or rep.server._inflight is not None
-            for rep in self.replicas if rep.breaker.state != "open")
+        return any(rep.server.has_work for rep in self.replicas
+                   if rep.breaker.state != "open")
 
     def generate(self, prompts: Sequence[Sequence[int]],
                  max_new_tokens: int,
@@ -341,9 +373,7 @@ class RouterFleet:
         """True once a draining replica has run all its work off —
         safe to swap (:meth:`revive`)."""
         rep = self._resolve(which)
-        return (rep.draining
-                and not rep.server.scheduler.has_work
-                and rep.server._inflight is None)
+        return rep.draining and not rep.server.has_work
 
     def revive(self, which, server=None) -> None:
         """Return a replica to the rotation, optionally swapping in a
@@ -398,7 +428,7 @@ class RouterFleet:
             pool, ops = self._pool, self.ops
         for rep in replicas:
             srv = rep.server
-            if not srv.closed and not srv.scheduler.has_work:
+            if not srv.closed and not srv.has_work:
                 srv.close()
         # teardown after the flag flip, unlocked: joining the ops
         # thread while holding its own lock would deadlock any

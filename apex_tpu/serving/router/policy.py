@@ -68,6 +68,13 @@ class RouterPolicy:
       max_entries: affinity-index bound; least-recently-touched chains
         evict first (cascading over descendants).
       seed: the ``"random"`` kind's RNG seed (deterministic benches).
+      disagg_prefill_threshold: prompts at or above this token count
+        route to a PREFILL-role replica when the fleet has one alive
+        (``docs/serving.md``, "Disaggregated prefill/decode") — the
+        prefill replica runs the prompt and ships the KV blocks to a
+        decode replica.  ``None`` (default) disables phase-aware
+        placement; short prompts always place monolithically (a
+        cross-replica hand-off costs more than a short prefill).
     """
 
     kind: str = "affinity"
@@ -75,12 +82,18 @@ class RouterPolicy:
     affinity_block: int = 16
     max_entries: int = 8192
     seed: int = 0
+    disagg_prefill_threshold: Optional[int] = None
 
     def __post_init__(self):
         if self.kind not in ("affinity", "least_pressure", "random"):
             raise ValueError(
                 f"unknown placement kind {self.kind!r} (expected "
                 f"'affinity', 'least_pressure', or 'random')")
+        if self.disagg_prefill_threshold is not None \
+                and self.disagg_prefill_threshold < 1:
+            raise ValueError(
+                f"disagg_prefill_threshold must be >= 1, got "
+                f"{self.disagg_prefill_threshold}")
         if self.affinity_block < 1:
             raise ValueError(
                 f"affinity_block must be >= 1, got {self.affinity_block}")

@@ -48,10 +48,22 @@ class Replica:
     def __init__(self, index: int, server, *,
                  name: Optional[str] = None,
                  breaker: Optional[CircuitBreaker] = None,
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 role: str = "any"):
+        if role not in ("any", "prefill", "decode"):
+            raise ValueError(
+                f"unknown replica role {role!r} (expected 'any', "
+                f"'prefill', or 'decode')")
         self.index = int(index)
         self.name = name or f"replica{index}"
         self.server = server
+        # phase role (docs/serving.md, "Disaggregated prefill/
+        # decode"): a "prefill" replica runs prefills and ships the
+        # KV to a decode-capable replica; "any" (the default) serves
+        # monolithically.  Placement prefers matching roles but NEVER
+        # strands a request — with no prefill replica alive, long
+        # prompts fall back to monolithic placement.
+        self.role = role
         self.breaker = breaker if breaker is not None else \
             CircuitBreaker(failure_threshold=3,
                            clock=clock or server.clock)
@@ -77,14 +89,19 @@ class Replica:
 
     def pressure(self) -> float:
         """The replica's PR-5 overload signal (queue fill vs pool
-        demand) — the router's balancing key."""
-        return self.server.scheduler.pressure()
+        demand, now incl. the remaining-prefill-tokens backlog) — the
+        router's balancing key.  Server-level: a disaggregated
+        replica's saturated prefill pool reads as pressure even while
+        its decode pool idles."""
+        return self.server.pressure()
 
     def live_requests(self) -> int:
         """Waiting + running requests (the ``/healthz`` occupancy
         field, read in-process)."""
-        sched = self.server.scheduler
-        return len(sched.waiting) + len(sched.running)
+        n = 0
+        for sched in self.server._schedulers():
+            n += len(sched.waiting) + len(sched.running)
+        return n
 
     def placeable(self) -> bool:
         """May this replica receive NEW work, breaker aside?  (The
@@ -141,6 +158,7 @@ class Replica:
         sched = self.server.scheduler
         return {
             "name": self.name,
+            "role": self.role,
             "alive": self.alive,
             "draining": bool(self.draining or self.server.draining),
             "pressure": round(self.pressure(), 4),

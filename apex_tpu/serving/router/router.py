@@ -142,7 +142,8 @@ class ReplicaRouter:
     # -- placement ---------------------------------------------------------
 
     def place(self, prompt: Sequence[int], *,
-              exclude: Optional[Replica] = None
+              exclude: Optional[Replica] = None,
+              role: Optional[str] = None
               ) -> Tuple[Optional[Replica], str]:
         """Pick the replica for ``prompt``: ``(replica, outcome)``
         with ``replica=None`` when nobody can take it.  Outcomes:
@@ -152,9 +153,27 @@ class ReplicaRouter:
         dead/draining/probe-exhausted), ``affinity_miss`` (no match),
         ``least_pressure`` / ``random`` (the non-affinity kinds), or
         ``unplaced``.  The chosen replica's breaker ``allow()`` is
-        consumed; merely-scanned replicas' are not."""
+        consumed; merely-scanned replicas' are not.
+
+        ``role`` is the phase preference of disaggregated placement
+        (``docs/serving.md``, "Disaggregated prefill/decode"):
+        ``"prefill"`` prefers prefill-role replicas, ``"decode"``
+        prefers decode-capable ones (role ``"any"``/``"decode"``).  A
+        preference, never a mandate — when no replica of the preferred
+        role can take the request, placement falls back to every
+        placeable replica (monolithic placement), so phase awareness
+        can only redirect work, never strand it."""
         cands = [rep for rep in self.replicas
                  if rep is not exclude and rep.placeable()]
+        if role is not None and cands:
+            if role == "prefill":
+                preferred = [r for r in cands if r.role == "prefill"
+                             and r.alive]
+            else:
+                preferred = [r for r in cands if r.role != "prefill"
+                             and r.alive]
+            if preferred:
+                cands = preferred
         if not cands:
             return None, "unplaced"
         kind = self.policy.kind
@@ -170,7 +189,8 @@ class ReplicaRouter:
                 outcome = "affinity_miss"
             else:
                 target = self.replicas[ridx]
-                if (target is exclude or not target.placeable()
+                if (target is exclude or target not in cands
+                        or not target.placeable()
                         or not target.alive):
                     outcome = "affinity_dead"
                 elif target.pressure() >= self.policy.spill_threshold:
@@ -199,12 +219,19 @@ class ReplicaRouter:
         finished ``finish_reason="breaker_open"`` — the fleet-wide
         fast-fail — without touching any replica."""
         prompt = [int(t) for t in prompt]
+        # phase-aware placement: long prompts prefer a prefill-role
+        # replica (whose hand-off ships the KV to a decode replica);
+        # short ones always place monolithically
+        role = None
+        thr = self.policy.disagg_prefill_threshold
+        if thr is not None and len(prompt) >= thr:
+            role = "prefill"
         tr = self.tracer
         if tr is not None and tr.enabled:
             with tr.span("route", tokens=len(prompt)):
-                rep, outcome = self.place(prompt)
+                rep, outcome = self.place(prompt, role=role)
         else:
-            rep, outcome = self.place(prompt)
+            rep, outcome = self.place(prompt, role=role)
         self.placements.incr(outcome)
         if rep is None:
             now = self.clock()
@@ -240,8 +267,7 @@ class ReplicaRouter:
         if rep.breaker.state == "open":
             return None
         srv = rep.server
-        had_work = (srv.scheduler.has_work
-                    or srv._inflight is not None)
+        had_work = srv.has_work
         try:
             return had_work, srv.step(), None
         except Exception as e:  # noqa: BLE001 — a replica blowing up
@@ -340,6 +366,99 @@ class ReplicaRouter:
             placed += 1
         return placed
 
+    # -- disaggregated prefill -> decode hand-off --------------------------
+
+    def handoff_sink_for(self, rep: Replica):
+        """The ``handoff_sink`` callable wired into a prefill-role
+        replica's server (``InferenceServer(handoff_sink=...)``): the
+        server calls it with ``(request, payload)`` when a prefill
+        finishes, and the router places the decode half."""
+        def sink(req, payload) -> bool:
+            return self._handoff_request(rep, req, payload)
+        return sink
+
+    def _handoff_request(self, prefill_rep: Replica, req,
+                         payload: dict) -> bool:
+        """Place one finished prefill's decode half: ingest the
+        checksummed block payload into a decode-capable replica's
+        pool, rebinding the caller's proxy to the new underlying
+        request.  On any failure — torn payload (checksum mismatch),
+        no capacity, no healthy decode replica — the request FALLS
+        BACK TO MONOLITHIC PLACEMENT: a fresh submit elsewhere re-runs
+        the prefill and regenerates the same stream (greedy /
+        counter-keyed sampling is a pure function of the prompt), so
+        failover moves work, never tokens.  Returns True when
+        ownership moved off the prefill replica (it then finishes the
+        local request ``finish_reason="handoff"``); False keeps the
+        request on the prefill replica's own decode pool — the last
+        resort when no other replica can take it."""
+        rr = self._by_uid.pop(req.uid, None)
+        now = self.clock()
+        d_s = d_iters = None
+        if req.deadline_s is not None:
+            d_s = max(0.0, req.deadline_s - (now - req.submitted_at))
+        if req.deadline_iters is not None:
+            burned = prefill_rep.server._iter - req.submit_iter
+            d_iters = max(0, req.deadline_iters - burned)
+
+        def rebind(new, rep_idx):
+            if rr is not None:
+                rr.inner = new
+                rr.replica = rep_idx
+                rr.moves += 1
+                self._by_uid[new.uid] = rr
+            else:
+                self._by_uid[new.uid] = RouterRequest(new, rep_idx)
+
+        target, _outcome = self.place(req.prompt,
+                                      exclude=prefill_rep,
+                                      role="decode")
+        if target is not None:
+            try:
+                new = target.server.ingest_handoff(
+                    req.prompt, req.generated, payload,
+                    max_new_tokens=req.max_new_tokens,
+                    num_cached=req.num_cached,
+                    eos_id=req.eos_id, priority=req.priority,
+                    deadline_iters=d_iters, deadline_s=d_s,
+                    sampling=req.sampling,
+                    submitted_at=req.submitted_at,
+                    first_token_at=req.first_token_at)
+            except ValueError:
+                # torn payload: detected whole, nothing imported
+                self.events.incr("handoff_torn")
+                new = None
+            if new is not None:
+                self.events.incr("handoffs")
+                if self.tracer is not None and self.tracer.enabled:
+                    self.tracer.instant("router_handoff",
+                                        to=target.name, uid=new.uid)
+                rebind(new, target.index)
+                if self.policy.kind == "affinity":
+                    self.affinity.record(req.prompt, target.index)
+                return True
+        # monolithic fallback: fresh prefill + decode on whichever
+        # replica can take it (bit-identical stream by construction)
+        rep2, _outcome = self.place(req.prompt, exclude=prefill_rep)
+        if rep2 is not None:
+            new = rep2.server.submit(req.prompt, req.max_new_tokens,
+                                     req.eos_id,
+                                     priority=req.priority,
+                                     deadline_iters=d_iters,
+                                     deadline_s=d_s,
+                                     sampling=req.sampling)
+            self.events.incr("handoff_fallback")
+            rebind(new, rep2.index)
+            if self.policy.kind == "affinity" and not new.finished:
+                self.affinity.record(req.prompt, rep2.index)
+            return True
+        # nobody else can take it: keep it on the prefill replica's
+        # own (small) decode pool
+        if rr is not None:
+            self._by_uid[req.uid] = rr
+        self.events.incr("handoff_kept_local")
+        return False
+
     def drain_replica(self, rep: Replica) -> int:
         """Rolling-restart drain: stop placing on ``rep`` (router-side
         flag + server ``begin_drain``), move its QUEUED work to the
@@ -361,7 +480,7 @@ class ReplicaRouter:
         old server is closed when it is safely drainable."""
         if server is not None:
             old = rep.server
-            if not old.closed and not old.scheduler.has_work:
+            if not old.closed and not old.has_work:
                 old.close()
             rep.server = server
             self.affinity.drop_replica(rep.index)
@@ -401,6 +520,15 @@ class ReplicaRouter:
             "reenqueued": self.events.count("reenqueued"),
             "failovers": self.events.count("failovers"),
             "replica_failed": self.events.count("replica_failed"),
+            # disaggregated prefill -> decode hand-offs
+            # (docs/serving.md, "Disaggregated prefill/decode")
+            "handoffs": self.events.count("handoffs"),
+            "handoff_fallback": self.events.count("handoff_fallback"),
+            "handoff_torn": self.events.count("handoff_torn"),
+            "handoff_kept_local":
+                self.events.count("handoff_kept_local"),
+            "disagg_prefill_threshold":
+                self.policy.disagg_prefill_threshold,
             "unplaced": (p.count("unplaced")
                          + self.events.count("reenqueue_unplaced")),
             "per_replica": {rep.name: rep.snapshot()

@@ -158,6 +158,16 @@ class Request:
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
 
+    # inter-token-latency accounting (``docs/observability.md``,
+    # "SLO & goodput"): the wall gap before each token after the
+    # first, stamped by the server as tokens are APPLIED — tokens
+    # accepted together in one verify step land as one real gap plus
+    # near-zero followers, which is exactly what a streaming consumer
+    # would see.  Feeds the per-request ITL p99 the SLO tracker bounds
+    # and the disaggregation bench floors.
+    itl_gaps: List[float] = dataclasses.field(default_factory=list)
+    last_token_at: Optional[float] = None
+
     # runtime state (owned by the scheduler)
     generated: List[int] = dataclasses.field(default_factory=list)
     slot: int = -1                  # decode batch slot; -1 = not running
@@ -235,6 +245,11 @@ class Request:
             out["decode_token_s"] = (
                 (self.finished_at - self.first_token_at)
                 / (len(self.generated) - 1))
+        if self.itl_gaps:
+            gaps = sorted(self.itl_gaps)
+            n = len(gaps)
+            out["itl_p99_s"] = gaps[min(n - 1, -(-99 * n // 100) - 1)]
+            out["itl_max_s"] = gaps[-1]
         return out
 
 
@@ -378,10 +393,18 @@ class Scheduler:
 
     def pressure(self) -> float:
         """The overload signal: max of the queue fill fraction and
-        ``(live blocks + queued demand) / usable blocks``.  Queued
-        demand is the sum of waiting requests' ``cost_blocks``, so a
-        burst of expensive prompts reads as pressure before the pool
-        physically fills; the value may exceed 1.0."""
+        ``(live blocks + queued demand + prefill backlog) / usable
+        blocks``.  Queued demand is the sum of waiting requests'
+        ``cost_blocks``, so a burst of expensive prompts reads as
+        pressure before the pool physically fills; the value may
+        exceed 1.0.
+
+        The prefill backlog term prices the REMAINING chunk tokens of
+        partially-prefilled running requests (their blocks are already
+        live, but the compute to fill them is still queued) — without
+        it a replica midway through a long chunked prefill looks idle
+        to the router and keeps receiving placements it cannot start
+        for many iterations (``serving.router``)."""
         q = (len(self.waiting) / self.max_waiting
              if self.max_waiting else 0.0)
         usable = self.allocator.cfg.num_blocks - 1
@@ -390,7 +413,22 @@ class Scheduler:
             if self.prefix_cache is not None else 0)
         live = usable - reclaimable
         demand = sum(r.cost_blocks for r in self.waiting)
+        demand += self.prefill_backlog_blocks()
         return max(q, (live + demand) / usable)
+
+    def prefill_backlog_blocks(self) -> int:
+        """Remaining-to-prefill tokens of running requests, in block
+        equivalents — the compute-backlog term of :meth:`pressure`
+        (those blocks are already allocated; this prices the work
+        still owed to fill them)."""
+        bs = self.block_size
+        backlog = 0
+        for r in self.running.values():
+            if r.prefill_ctx is not None:
+                rem = len(r.prefill_ctx) - r.num_cached
+                if rem > 0:
+                    backlog += -(-rem // bs)
+        return backlog
 
     def shed_overload(self) -> List[Request]:
         """Shed best-effort waiting work (priority >=
@@ -563,6 +601,53 @@ class Scheduler:
                 req._reg_blocks = _REG_STOPPED  # chain broken for good
                 break
             req._reg_blocks += 1
+
+    # -- disaggregated prefill/decode hand-off (docs/serving.md) -----------
+
+    @property
+    def has_free_slot(self) -> bool:
+        return bool(self._free_slots)
+
+    def admit_handoff(self, req: Request, block_table: List[int]) -> None:
+        """Admit a request whose context K/V is ALREADY materialized in
+        this scheduler's pool — the decode half of the disaggregated
+        prefill/decode hand-off (``docs/serving.md``, "Disaggregated
+        prefill/decode").  ``block_table`` must hold blocks allocated
+        from THIS scheduler's allocator (the caller copied the K/V in
+        via the engine's block-copy program, or imported it from
+        another replica).  The request skips the prefill state machine
+        entirely: it enters the decode batch at its carried
+        ``num_cached`` position with ``next_input`` pending — exactly
+        the state a just-finished local prefill would leave it in, so
+        greedy decode from here is bit-identical to the monolithic
+        engine's."""
+        assert self._free_slots, "admit_handoff with no free slot"
+        assert req.num_cached > 0 and req.next_input is not None, \
+            (f"handoff request {req.uid} has no carried KV position "
+             f"(num_cached={req.num_cached}, "
+             f"next_input={req.next_input})")
+        req.slot = self._free_slots.pop()
+        req.block_table = list(block_table)
+        req.prefill_ctx = None
+        req.cached_prefix_tokens = 0
+        # the handed-off blocks' contents are the request's own
+        # context, so they register into this pool's prefix index (when
+        # one exists) exactly like locally-prefilled blocks would
+        req._reg_blocks = 0 if self.prefix_cache is not None \
+            else _REG_STOPPED
+        self.running[req.slot] = req
+        self._admit_order.append(req)
+
+    def release_handoff(self, req: Request) -> None:
+        """Free a request's slot and blocks in THIS pool after its
+        context was copied out to another pool/replica — the prefill
+        half of the hand-off.  Newly full blocks register into the
+        prefix index first, so a prefill pool doubles as a warm
+        shared-prefix cache: the handed-off request's blocks survive
+        here as evictable LRU holds and the next shared-prefix
+        admission matches them instead of re-prefilling."""
+        self.register_progress(req)
+        self._release(req)
 
     def ensure_decode_capacity(self, req: Request) -> bool:
         """Grow ``req``'s block table if its next token write needs a

@@ -59,6 +59,13 @@ def parse_args():
                    "per-slot per-head fp32 scale sidecar — ~1.9x "
                    "live blocks per HBM byte at head_dim 64 "
                    "(docs/serving.md, 'Quantized KV cache')")
+    p.add_argument("--disagg", action="store_true",
+                   help="serve with DISAGGREGATED prefill/decode "
+                   "pools: every prefill runs in a dedicated prefill "
+                   "pool and hands its KV blocks to the pure-decode "
+                   "pool via the cross-pool block copy "
+                   "(docs/serving.md, 'Disaggregated prefill/"
+                   "decode')")
     p.add_argument("--eos", type=int, default=None,
                    help="stop token id (default: run to --max-new)")
     p.add_argument("--ops-port", type=int, default=None,
@@ -114,10 +121,16 @@ def main():
         cfg, params, max_batch_size=args.batch_size,
         max_context=args.max_context, block_size=args.block_size,
         kv_quant="int8" if args.kv_quant else None,
+        enable_disagg=args.disagg,
         attention_fn=attention_fn, ops_port=args.ops_port, mesh=mesh)
     if server.ops is not None:
         print(f"ops plane: http://127.0.0.1:{server.ops.port} "
               f"(/healthz /metrics /statusz /debug/flight)")
+    if args.disagg:
+        pk = server.prefill_engine.cache_cfg
+        print(f"disaggregated pools: prefill {pk.num_blocks - 1} "
+              f"blocks ({pk.bytes() / 2 ** 20:.1f} MiB) -> decode "
+              f"pool (hand-off via cross-pool block copy)")
     kv = server.engine.cache_cfg
     store = ("int8+fp32 scales" if kv.quantized
              else kv.resolved_dtype().name)

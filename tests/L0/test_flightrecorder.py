@@ -521,3 +521,110 @@ def test_reset_meters_realigns_flight_window(tiny, tmp_path):
     import postmortem as pm_cli
     assert pm_cli.main([str(tmp_path / "b"),
                         "--assert-complete"]) == 0
+
+
+# -- phase-composition split (disaggregation observability) ----------------
+
+
+_PHASE_FAMILIES = {
+    "prefill_launches": {"prefill", "prefill_sampled", "prefill_stoch",
+                         "chunk_prefill", "chunk_prefill_sampled",
+                         "chunk_prefill_stoch"},
+    "decode_launches": {"decode", "decode_sampled", "decode_stoch"},
+    "verify_launches": {"verify", "verify_sampled", "verify_stoch"},
+}
+
+
+def test_phase_split_recorded_and_reconciles_with_programs(tiny):
+    """Every recorded step carries a ``phase`` composition block
+    (prefill tokens vs decode tokens vs verify columns), and the
+    per-family launch sums reconcile EXACTLY with the per-program
+    accounting — the recorder and ``stats()["programs"]`` each saw
+    every launch once (tools/postmortem.py --assert-complete runs the
+    same check on bundles)."""
+    cfg, params = tiny
+    server = _server(cfg, params, flight_recorder=FlightRecorder())
+    prompts = [[1, 2, 3] * 6, [5, 6, 7, 8], [9] * 11]
+    server.generate(prompts, max_new_tokens=8)
+    steps = server.recorder.records()
+    assert steps and all(isinstance(r.get("phase"), dict)
+                         for r in steps)
+    # token-level sanity: every prompt token went through a prefill
+    # program exactly once (no preemption in this roomy run)
+    assert sum(r["phase"]["prefill_tokens"] for r in steps) == \
+        sum(len(p) for p in prompts)
+    table = server.programs.table()
+    for field, fams in _PHASE_FAMILIES.items():
+        flight_n = sum(r["phase"][field] for r in steps)
+        calls = sum(row["calls"] for key, row in table.items()
+                    if key.split("[")[0] in fams)
+        assert flight_n == calls, (field, flight_n, calls)
+    # decode+verify actually decoded every generated token
+    assert sum(r["phase"]["decode_tokens"] for r in steps) > 0
+
+
+def test_phase_split_off_with_null_recorder(tiny):
+    """The disabled path binds no phase dict at all (the zero-alloc
+    contract extends to the new block)."""
+    cfg, params = tiny
+    server = _server(cfg, params)
+    assert server.recorder is NULL_FLIGHT_RECORDER
+    server.generate([[1, 2, 3]], max_new_tokens=3)
+    assert server._phase is None
+
+
+# -- inter-token-latency SLO bound ----------------------------------------
+
+
+def test_slo_itl_p99_bound_classifies():
+    """The ITL attainment bound: a request whose per-token gap p99
+    exceeds its class bound misses (itl_missed), one within it
+    attains — independently of the per-request-average decode bound
+    (head-of-line interference breaks the tail first)."""
+    pol = SLOPolicy(targets={0: SLOTargets(itl_p99_s=0.1)})
+    tr = SLOTracker(pol)
+
+    def req_with_gaps(gaps):
+        r = Request(prompt=[1], max_new_tokens=4)
+        r.generated = [1, 2, 3]
+        r.finished = True
+        r.finish_reason = "length"
+        r.submitted_at, r.admitted_at = 0.0, 0.0
+        r.first_token_at, r.finished_at = 0.1, 1.0
+        r.itl_gaps = list(gaps)
+        return r
+
+    good = req_with_gaps([0.01] * 60)
+    assert "itl_p99_s" in good.timeline()
+    assert tr.observe(good) is True
+    bad = req_with_gaps([0.01] * 10 + [0.5])   # p99 == the 0.5 tail
+    assert bad.timeline()["itl_p99_s"] == pytest.approx(0.5)
+    assert tr.observe(bad) is False
+    cls = tr.as_stats()["by_priority"][0]
+    assert cls["itl_p99_target_s"] == 0.1
+    assert (cls["itl_met"], cls["itl_missed"]) == (1, 1)
+    assert cls["attained"] == 1
+    # one long gap among MANY short ones sits under p99: attains
+    ok_tail = req_with_gaps([0.01] * 199 + [0.5])
+    assert ok_tail.timeline()["itl_p99_s"] == pytest.approx(0.01)
+    assert tr.observe(ok_tail) is True
+
+
+def test_server_records_itl_and_slo_itl_attainment(tiny):
+    """End-to-end: the server stamps per-token gaps on the request
+    timeline and ``stats()`` carries both the itl_ms histogram and the
+    per-class ITL attainment against a configured bound."""
+    cfg, params = tiny
+    pol = SLOPolicy(default=SLOTargets(itl_p99_s=1e9))
+    server = _server(cfg, params, slo_policy=pol)
+    reqs = server.generate([[1, 2, 3], [4, 5, 6, 7]],
+                           max_new_tokens=6, return_requests=True)
+    for r in reqs:
+        tl = r.timeline()
+        assert "itl_p99_s" in tl and "itl_max_s" in tl
+        assert len(r.itl_gaps) == len(r.generated) - 1
+    st = server.stats()
+    assert st["latency"]["itl_ms"]["count"] == \
+        sum(len(r.itl_gaps) for r in reqs)
+    cls = st["slo"]["by_priority"][0]
+    assert cls["itl_met"] == 2 and cls["itl_missed"] == 0

@@ -408,3 +408,40 @@ def test_mini_chaos_soak_invariants_hold(tiny):
     assert report["injected"]["oom"] > 0        # fault paths fired
     assert report["injected"]["nonfinite_rows"] > 0
     assert report["bit_exact_checked"] > 0
+
+
+# -- pressure: remaining-prefill backlog ----------------------------------
+
+
+def test_pressure_counts_remaining_prefill_backlog():
+    """A partially-prefilled RUNNING request's remaining chunk tokens
+    count into the pressure demand term (block equivalents): a replica
+    midway through a long chunked prefill must read as busy to the
+    router even though its queue is empty — and the term decays to
+    zero chunk by chunk as the prefill completes."""
+    alloc = BlockAllocator(KVCacheConfig(
+        num_layers=1, num_heads=2, head_dim=4, num_blocks=33,
+        block_size=4, dtype=jnp.float32))
+    sched = Scheduler(alloc, max_batch_size=2, block_size=4,
+                      max_context=32, chunk_size=4)
+    usable = 32
+    req = sched.submit(Request(prompt=[1] * 16, max_new_tokens=4))
+    baseline = sched.pressure()           # queued demand only
+    assert baseline == pytest.approx(req.cost_blocks / usable)
+    assert sched.admit() == [req]
+    # all 5 context blocks (16 tokens + 1) are LIVE at admission, and
+    # the 16 not-yet-prefilled tokens add 4 backlog blocks of demand
+    assert sched.prefill_backlog_blocks() == 4
+    assert sched.pressure() == pytest.approx((5 + 4) / usable)
+    seen = [sched.pressure()]
+    while req.prefilling:
+        tokens, start, _last = sched.prefill_plan(req)
+        assert start == req.num_cached    # carried position
+        sched.chunk_done(req, len(tokens))
+        seen.append(sched.pressure())
+    # each completed chunk retires one backlog block: strictly
+    # decreasing pressure down to the pure-live term
+    assert seen == sorted(seen, reverse=True)
+    assert len(set(seen)) == len(seen)
+    assert sched.prefill_backlog_blocks() == 0
+    assert sched.pressure() == pytest.approx(5 / usable)

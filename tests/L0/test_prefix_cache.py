@@ -388,3 +388,53 @@ def test_preempted_resume_is_a_cache_hit(tiny):
     assert req.generated == want
     # the resume re-matched registered blocks rather than re-prefilling
     assert srv.prefix.count("prefix_hit_tokens") >= hits_before + 8
+
+
+# -- chunk/preemption interleaving ----------------------------------------
+
+
+@pytest.mark.parametrize("cache_on", [True, False])
+def test_preemption_between_prefill_chunks_resumes_carried_position(
+        tiny, cache_on):
+    """A request preempted BETWEEN chunks of its prefill (only
+    forced-preemption-during-decode had an oracle before): its blocks
+    free cleanly mid-chunk-sequence, re-admission resumes at the
+    correct carried KV position — the registered full blocks match
+    back as a cache hit when the cache is on, position 0 otherwise —
+    and the final stream is bit-exact vs an undisturbed server, with
+    refcounts audited every step."""
+    cfg, params = tiny
+    rng = np.random.RandomState(11)
+    prompt = list(rng.randint(0, VOCAB, size=40))
+    def mk():
+        return InferenceServer(
+            cfg, params, max_batch_size=2, max_context=128,
+            block_size=8, cache_dtype=jnp.float32,
+            enable_prefix_cache=cache_on,
+            enable_chunked_prefill=True, prefill_chunk=8,
+            enable_speculation=False)
+    want = _audited_generate(mk(), [prompt], 8)[0]
+
+    server = mk()
+    req = server.submit(prompt, 8)
+    server.step()
+    server.scheduler.audit()
+    assert req.prefilling and req.num_cached == 8   # one chunk landed
+    server.scheduler.preempt(req)
+    server.scheduler.audit()
+    assert req.num_cached == 0 and not req.block_table
+    server.step()                                   # re-admits
+    server.scheduler.audit()
+    assert req.running and req.prefilling
+    if cache_on:
+        # the first chunk's registered block matched back: the resume
+        # position carries the already-materialized KV
+        assert req.cached_prefix_tokens == 8
+        assert req.num_cached >= 8
+    else:
+        assert req.cached_prefix_tokens == 0
+    while server.scheduler.has_work:
+        server.step()
+        server.scheduler.audit()
+    assert list(req.generated) == want
+    assert req.preemptions == 1

@@ -545,6 +545,8 @@ def test_pinned_router_stats_block(tiny):
     assert set(r) == {
         "replicas", "alive", "policy", "placements", "affinity",
         "reenqueued", "failovers", "replica_failed", "unplaced",
+        "handoffs", "handoff_fallback", "handoff_torn",
+        "handoff_kept_local", "disagg_prefill_threshold",
         "per_replica", "steps", "threaded"}
     assert set(r["policy"]) == {"kind", "spill_threshold",
                                 "affinity_block", "index_entries"}
@@ -555,9 +557,9 @@ def test_pinned_router_stats_block(tiny):
     assert st["tokens_generated"] == 2 * 6
     row = r["per_replica"]["replica0"]
     assert set(row) == {
-        "name", "alive", "draining", "pressure", "live_requests",
-        "waiting", "running", "finished", "steps", "step_failures",
-        "last_error", "breaker"}
+        "name", "role", "alive", "draining", "pressure",
+        "live_requests", "waiting", "running", "finished", "steps",
+        "step_failures", "last_error", "breaker"}
     assert set(row["breaker"]) == {
         "state", "failure_streak", "failure_threshold", "probes_out",
         "probe_ok", "probe_quota", "recovery_time", "transitions"}

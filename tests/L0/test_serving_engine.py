@@ -256,7 +256,8 @@ def test_stats_keys_are_backward_compatible(tiny):
         st["memory"]["pool_bytes"]
     lat = st["latency"]
     assert set(lat) == {"ttft_ms", "queue_wait_ms", "decode_token_ms",
-                        "step_ms", "queue_wait_by_priority_ms"}
+                        "itl_ms", "step_ms",
+                        "queue_wait_by_priority_ms"}
     # both requests ran at the default priority class
     assert set(lat["queue_wait_by_priority_ms"]) == {0}
     assert lat["queue_wait_by_priority_ms"][0]["count"] == 2

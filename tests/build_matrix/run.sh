@@ -241,6 +241,30 @@ env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
       --iters 800 --sampling
 results[sampling]=$?
 
+# disaggregated prefill/decode: the phase-separation axis
+# (docs/serving.md, "Disaggregated prefill/decode") — three gates:
+#   1. the L0 disagg tier (slow tier included — this axis owns it):
+#      bit-exact parity disagg vs monolithic across chunked prefill /
+#      COW hits / forced preemption / hand-off deferral / torn and
+#      delayed cross-pool transfers, the export->ingest cross-replica
+#      roundtrip with checksum torn-detection, and the prefill-role /
+#      decode-role fleet with torn-payload monolithic fallback;
+#   2. serving_bench --disagg: decode ITL p99 under 10x long-prompt
+#      pressure — the monolithic arm must SHOW the interference
+#      (>= 1.5x solo), disaggregation must cut the tail (>= 1.25x
+#      reduction), and the <= 1.1x-of-solo flatness floor arms on
+#      >= 2-core hosts (phase_overlap_capable — the PR-8 precedent);
+#      greedy parity across all three arms ALWAYS;
+#   3. an 800-iteration seed-0 chaos soak with enable_disagg=True and
+#      the hand-off fault class armed (torn + delayed transfers)
+#      against a MONOLITHIC replay oracle — bit-exact replay proves
+#      phase separation moves placement, never tokens.
+echo "=== build-matrix axis: disagg ==="
+env JAX_PLATFORMS=cpu python -m pytest tests/L0/test_disagg.py -q -x --no-header \
+  && env JAX_PLATFORMS=cpu python tools/serving_bench.py --smoke --disagg --out - \
+  && env JAX_PLATFORMS=cpu python tools/chaos_soak.py --seed 0 --iters 800 --disagg
+results[disagg]=$?
+
 # chaos soak: the overload-robustness axis (docs/resilience.md,
 # "Overload policy & lifecycle") — the full serving stack (prefix
 # cache + chunked prefill + overload control + circuit breaker, small

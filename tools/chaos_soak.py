@@ -203,6 +203,17 @@ def main(argv=None) -> int:
                         "bit-exact-replay oracle holds unchanged "
                         "(counter-keyed draws make every stream a "
                         "pure function of (prompt, params, seed))")
+    parser.add_argument("--disagg", action="store_true",
+                        help="soak the DISAGGREGATED prefill/decode "
+                        "server (docs/serving.md, 'Disaggregated "
+                        "prefill/decode'): every prefill runs in a "
+                        "separate prefill pool and hands its KV "
+                        "blocks to the decode pool via the cross-pool "
+                        "block copy, with the hand-off fault class "
+                        "armed (torn + delayed transfers).  The "
+                        "replay oracle stays MONOLITHIC, so bit-exact "
+                        "replay proves disaggregation moved "
+                        "placement, never tokens")
     parser.add_argument("--kv-quant", dest="kv_quant",
                         action="store_true",
                         help="soak the int8-QUANTIZED KV pool: the "
@@ -337,6 +348,11 @@ def main(argv=None) -> int:
             cache_dtype=jnp.float32, max_waiting=8, clock=clock,
             mesh=mesh,
             kv_quant="int8" if args.kv_quant else None,
+            # --disagg: a small prefill pool (2 concurrent full-
+            # context prefills) beside the 39-block decode pool, so
+            # hand-off deferral, prefill-pool eviction, and the torn/
+            # delayed transfer faults all actually fire
+            enable_disagg=args.disagg,
             enable_speculation=args.speculative,
             enable_pipeline=args.pipeline,
             flight_recorder=FlightRecorder(
@@ -354,10 +370,14 @@ def main(argv=None) -> int:
         # --kv-quant the oracle is a QUANT-ON replica — the invariant
         # then proves quantized blocks survive every lifecycle path
         # bit-consistently, not that quantization is lossless
+        # the oracle stays MONOLITHIC even under --disagg: bit-exact
+        # replay then proves phase separation moved placement, never
+        # tokens (enable_disagg pinned False — PR-6/12/13 precedent)
         return InferenceServer(
             cfg, params, max_batch_size=4, max_context=64,
             block_size=4, cache_dtype=jnp.float32, clock=clock,
             kv_quant="int8" if args.kv_quant else None,
+            enable_disagg=False,
             enable_speculation=args.speculative,
             enable_pipeline=args.pipeline)
 
@@ -372,6 +392,11 @@ def main(argv=None) -> int:
         # while the rest stay greedy, so mixed batches run both the
         # argmax lane and the stochastic lane in one launch
         stochastic_rate=0.4 if args.sampling else 0.0,
+        # --disagg arms the hand-off fault class: delayed transfers
+        # (the copy raises before moving anything) and torn ones (a
+        # prefix of the blocks really moves before the failure)
+        handoff_oom_rate=0.03 if args.disagg else 0.0,
+        handoff_torn_rate=0.02 if args.disagg else 0.0,
         force_violation_iter=args.force_violation)
     t0 = time.perf_counter()
     report = run_soak(make_server, chaos_cfg, args.seed,
@@ -381,6 +406,7 @@ def main(argv=None) -> int:
     report["tp"] = args.tp or 1
     report["kv_quant"] = "int8" if args.kv_quant else None
     report["sampling_traffic"] = bool(args.sampling)
+    report["disagg_mode"] = bool(args.disagg)
 
     line = json.dumps(report, indent=2, sort_keys=True)
     if args.out == "-":

@@ -138,9 +138,44 @@ def request_events(steps):
     return ev
 
 
+# launch families for the phase-composition reconcile: flight "phase"
+# launch counters vs the serving_program_calls{program=...} counters
+# (logits + sampled + stochastic twins count together, exactly like
+# the engine's compile audits; bucket/width keys like "prefill[64q8]"
+# strip to their family)
+_PHASE_FAMILIES = {
+    "prefill_launches": ("prefill", "prefill_sampled", "prefill_stoch",
+                         "chunk_prefill", "chunk_prefill_sampled",
+                         "chunk_prefill_stoch"),
+    "decode_launches": ("decode", "decode_sampled", "decode_stoch"),
+    "verify_launches": ("verify", "verify_sampled", "verify_stoch"),
+}
+
+
+def _phase_cell(rec) -> str:
+    """Compact phase-composition cell: prefill tokens / decode tokens
+    / verify columns this step (the interference view)."""
+    ph = rec.get("phase")
+    if not isinstance(ph, dict):
+        return ""
+    parts = []
+    if ph.get("prefill_tokens"):
+        parts.append(f"pf:{ph['prefill_tokens']}")
+    if ph.get("decode_tokens"):
+        parts.append(f"dec:{ph['decode_tokens']}")
+    if ph.get("verify_columns"):
+        parts.append(f"ver:{ph['verify_columns']}")
+    if ph.get("handoff_blocks"):
+        parts.append(f"hof:{ph['handoff_blocks']}")
+    return "+".join(parts) if parts else "idle"
+
+
 def _step_row(rec) -> str:
     mem = rec.get("memory", {})
     decisions = []
+    cell = _phase_cell(rec)
+    if cell:
+        decisions.append(f"phase={cell}")
     if rec.get("admitted"):
         decisions.append(f"admit={rec['admitted']}")
     if rec.get("shed"):
@@ -269,6 +304,30 @@ def assert_complete(bundle) -> int:
             if runs and not admits:
                 return fail(f"request {uid} runs at iter {min(runs)} "
                             f"with no admission in a complete window")
+    # phase-composition reconcile: when the window is complete from
+    # the server's first step AND every record carries a phase block,
+    # the per-family launch counts summed over the flight log must
+    # equal the per-program call counters in the metrics snapshot —
+    # the recorder and the program accounting each saw every launch
+    # exactly once (docs/observability.md)
+    if (complete and steps and steps[0].get("iter") == 1
+            and all(isinstance(r.get("phase"), dict) for r in steps)):
+        prog_calls = {}
+        prefix = "serving_program_calls{"
+        for key, desc in metrics.items():
+            if not key.startswith(prefix):
+                continue
+            prog = key[len(prefix):].split("=", 1)[-1].strip('"}')
+            prog_calls.setdefault(prog.split("[")[0], 0)
+            prog_calls[prog.split("[")[0]] += desc.get("value", 0)
+        for field, families in _PHASE_FAMILIES.items():
+            flight_n = sum(r["phase"].get(field, 0) for r in steps)
+            metric_n = sum(prog_calls.get(f, 0) for f in families)
+            if prog_calls and flight_n != metric_n:
+                return fail(
+                    f"phase split does not reconcile: flight counts "
+                    f"{flight_n} {field} but the program counters "
+                    f"saw {metric_n} ({'+'.join(families)})")
     # watchdog bundles: the stall record and the thread-stack
     # attachment are the capture's payload — a bundle without them is
     # a detector that fired blind

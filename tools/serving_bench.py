@@ -159,7 +159,7 @@ def run_continuous(cfg, params, prompts, args):
         cfg, params, max_batch_size=args.batch_size,
         max_context=args.max_context,
         block_size=args.block_size, cache_dtype=jnp.float32,
-        kv_quant="off",       # the quant axis has its own mode
+        kv_quant="off", enable_disagg=False,       # the quant axis has its own mode
         # speculation and pipelining are measured by their own modes
         # (--speculative / --pipeline); the continuous-vs-naive record
         # keeps comparing the same synchronous one-token decode it
@@ -258,7 +258,7 @@ def _build_prefix_servers(cfg, params, args):
         return InferenceServer(
             cfg, params, max_batch_size=args.batch_size,
             max_context=args.max_context, block_size=args.block_size,
-            cache_dtype=jnp.float32, kv_quant="off",
+            cache_dtype=jnp.float32, kv_quant="off", enable_disagg=False,
             enable_prefix_cache=cache,
             enable_chunked_prefill=chunk is not None,
             prefill_chunk=chunk,
@@ -387,7 +387,7 @@ def _spec_server(cfg, params, args, spec):
     return InferenceServer(
         cfg, params, max_batch_size=args.batch_size,
         max_context=args.max_context, block_size=args.block_size,
-        cache_dtype=jnp.float32, kv_quant="off",
+        cache_dtype=jnp.float32, kv_quant="off", enable_disagg=False,
         enable_speculation=spec,
         spec_tokens=args.spec_tokens,
         # the speculation A/B isolates drafting from loop overlap
@@ -533,7 +533,7 @@ def _pipeline_server(cfg, params, args, on):
     return InferenceServer(
         cfg, params, max_batch_size=args.batch_size,
         max_context=args.max_context, block_size=args.block_size,
-        cache_dtype=jnp.float32, kv_quant="off",
+        cache_dtype=jnp.float32, kv_quant="off", enable_disagg=False,
         enable_pipeline=on,
         # one-token decode in both arms: the pipeline axis measures
         # loop overlap, not speculation
@@ -661,6 +661,238 @@ def run_pipeline_mode(args):
     return rc
 
 
+def _disagg_server(cfg, params, args, disagg):
+    """The disaggregation A/B arms at EQUAL total HBM: the disagg arm
+    splits ``--disagg-blocks`` + ``--disagg-prefill-blocks`` between
+    its two pools; the monolithic arm gets their sum as one pool.  The
+    decode pool keeps the full default fast-path stack (speculation +
+    pipeline) — phase separation must protect the decode tail without
+    turning anything off."""
+    import jax.numpy as jnp
+    from apex_tpu.serving import InferenceServer
+
+    total = args.disagg_blocks + args.disagg_prefill_blocks
+    return InferenceServer(
+        cfg, params, max_batch_size=args.batch_size,
+        max_context=args.max_context, block_size=args.block_size,
+        num_blocks=args.disagg_blocks if disagg else total,
+        cache_dtype=jnp.float32, kv_quant="off",
+        prefill_chunk=args.chunk,
+        enable_disagg=disagg,
+        disagg_prefill_blocks=(args.disagg_prefill_blocks
+                               if disagg else None),
+        prefill_max_concurrent=args.disagg_prefill_concurrent)
+
+
+def _run_disagg_arm(server, decode_prompts, long_prompts, args,
+                    interference):
+    """Drive one arm: ``decode_prompts`` settle into steady decode,
+    meters reset, then (under ``interference``) one long prompt
+    submits per step until ``long_prompts`` is exhausted — 10x the
+    decode arrival rate on the stock shapes — while the decoders run
+    to completion.  Long prompts carry ``max_new_tokens=1`` (pure
+    prefill traffic), so the ITL histogram measured over the window
+    contains EXACTLY the decoders' inter-token gaps.  Every step is
+    audited (both pools under disaggregation).  Returns (window
+    record, decoder outputs, long outputs)."""
+    from apex_tpu.serving import SamplingParams
+
+    greedy = SamplingParams()
+    # warmup compiles every program the arm touches: the decode
+    # bucket, the long prompt's chunk ladder, decode, verify (the
+    # repetitive prompt makes drafts fire), and — under
+    # disaggregation — the cross-pool hand-off copy.  A compile
+    # landing inside one arm's measured window but not another's
+    # would fake (or hide) the very tail the A/B measures.
+    server.generate([decode_prompts[0], long_prompts[0],
+                     [1, 2] * (args.prompt_tokens // 2 + 1)],
+                    max_new_tokens=8, sampling=greedy)
+    server.engine.reset_cache()
+    if server.disagg:
+        server.prefill_engine.reset_cache()
+    server.reset_meters()
+
+    decoders = [server.submit(p, args.max_new, sampling=greedy)
+                for p in decode_prompts]
+    # settle PAST the first decode steps (not just the prefill-sampled
+    # token): the prefill->decode transition costs differently across
+    # arms, and the window must compare steady decode against steady
+    # decode
+    while any(len(r.generated) < 3 for r in decoders):
+        server.step()
+        server.audit()
+    server.reset_meters()       # the measured window: steady decode
+    t0 = time.perf_counter()
+    longs = []
+    next_long = 0
+    while any(not r.finished for r in decoders):
+        if interference:
+            for _ in range(args.disagg_arrival):
+                if next_long >= len(long_prompts):
+                    break
+                longs.append(server.submit(long_prompts[next_long], 1,
+                                           sampling=greedy))
+                next_long += 1
+        server.step()
+        server.audit()
+    window_s = time.perf_counter() - t0
+    st_window = server.stats()
+    # drain the long-prompt tail OUTSIDE the measured window (the
+    # decoders are done; no further ITL samples can record)
+    while interference and next_long < len(long_prompts):
+        longs.append(server.submit(long_prompts[next_long], 1,
+                                   sampling=greedy))
+        next_long += 1
+    while server.has_work:
+        server.step()
+        server.audit()
+    itl = st_window["latency"]["itl_ms"]
+    rec = {
+        "itl_ms": itl,
+        "itl_p99_ms": itl.get("p99", 0.0),
+        "itl_p50_ms": itl.get("p50", 0.0),
+        "window_s": round(window_s, 3),
+        "step_ms": st_window["latency"]["step_ms"],
+        "longs_submitted_in_window": len(longs),
+        "disagg": st_window["disagg"],
+    }
+    return (rec, [list(r.generated) for r in decoders],
+            [list(r.generated) for r in longs])
+
+
+def run_disagg_mode(args):
+    """Disaggregated prefill/decode interference A/B
+    (``docs/serving.md``, "Disaggregated prefill/decode"; one JSON
+    record to ``BENCH_serving_disagg.json``), extending the PR-3
+    stall-ratio methodology from one long prompt to sustained 10x
+    long-prompt pressure:
+
+    - *solo decode*: the disagg server serving only the decoders —
+      the ITL p99 floor everything is measured against;
+    - *interference, disagg ON*: one long (pure-prefill) request
+      submitted per step while the decoders run — the prefill pool
+      absorbs them and the decode pool never yields a step;
+    - *interference, disagg OFF*: the same schedule into a monolithic
+      server of EQUAL total HBM — chunk prefills crowd every step.
+
+    Parity is ALWAYS asserted (decoder streams identical across all
+    three arms, long outputs identical across the two interference
+    arms).  ``--smoke`` floors: the monolithic arm must SHOW the
+    interference (ITL p99 >= 1.5x solo), disaggregation must beat it
+    (disagg p99 strictly below mono p99), and — on hosts with a
+    second core, where prefill compute can actually run under the
+    in-flight decode — the headline floor: disagg ITL p99 <= 1.1x
+    solo.  Single-core hosts record ``phase_overlap_capable: false``
+    and assert the interference-reduction floor only (the PR-8
+    ``overlap_capable`` precedent)."""
+    cfg, m, params = build_model(args)
+    rng = np.random.RandomState(args.seed + 7)
+    decode_prompts = [list(rng.randint(0, args.vocab,
+                                       size=args.prompt_tokens))
+                      for _ in range(args.disagg_decoders)]
+    long_prompts = [list(rng.randint(0, args.vocab,
+                                     size=args.long_prompt))
+                    for _ in range(10 * args.disagg_decoders)]
+
+    solo, outs_solo, _ = _run_disagg_arm(
+        _disagg_server(cfg, params, args, True),
+        decode_prompts, long_prompts, args, interference=False)
+    on, outs_on, longs_on = _run_disagg_arm(
+        _disagg_server(cfg, params, args, True),
+        decode_prompts, long_prompts, args, interference=True)
+    off, outs_off, longs_off = _run_disagg_arm(
+        _disagg_server(cfg, params, args, False),
+        decode_prompts, long_prompts, args, interference=True)
+
+    mismatches = (
+        sum(a != b for a, b in zip(outs_solo, outs_on))
+        + sum(a != b for a, b in zip(outs_solo, outs_off))
+        + sum(a != b for a, b in zip(longs_on, longs_off)))
+    overlap_capable = (os.cpu_count() or 1) >= 2
+    p99_solo = max(solo["itl_p99_ms"], 1e-6)
+    record = {
+        "bench": "serving_disagg",
+        "mode": "smoke" if args.smoke else "full",
+        "phase_overlap_capable": overlap_capable,
+        "cpu_count": os.cpu_count() or 1,
+        "config": {"decoders": args.disagg_decoders,
+                   "max_new": args.max_new,
+                   "batch_size": args.batch_size,
+                   "block_size": args.block_size,
+                   "chunk": args.chunk,
+                   "long_prompt": args.long_prompt,
+                   "long_requests": len(long_prompts),
+                   "decode_blocks": args.disagg_blocks,
+                   "prefill_blocks": args.disagg_prefill_blocks,
+                   "prefill_max_concurrent":
+                       args.disagg_prefill_concurrent,
+                   "hidden": args.hidden, "layers": args.layers,
+                   "heads": args.heads,
+                   "max_context": args.max_context,
+                   "vocab": args.vocab,
+                   "prompt_tokens": args.prompt_tokens},
+        "solo": solo,
+        "disagg_on": on,
+        "disagg_off": off,
+        # the headline ratios: decode ITL p99 under 10x long-prompt
+        # pressure, relative to the solo-decode floor
+        "itl_p99_ratio_disagg": round(on["itl_p99_ms"] / p99_solo, 3),
+        "itl_p99_ratio_monolithic": round(
+            off["itl_p99_ms"] / p99_solo, 3),
+        "interference_reduction": round(
+            off["itl_p99_ms"] / max(on["itl_p99_ms"], 1e-6), 3),
+        "parity_mismatches": mismatches,
+    }
+    print(json.dumps(record))
+
+    out = args.out
+    if out != "-":
+        if out is None:
+            out = os.path.join(
+                os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+                "BENCH_serving_disagg.json")
+        with open(out, "w") as f:
+            json.dump(record, f, indent=1)
+            f.write("\n")
+
+    rc = 0
+    if mismatches:
+        print(f"FAIL: {mismatches} streams diverged across the "
+              "disagg/monolithic/solo arms (greedy outputs must be "
+              "bit-exact)", file=sys.stderr)
+        rc = 1
+    if args.smoke:
+        if record["itl_p99_ratio_monolithic"] < 1.5:
+            print(f"FAIL: the monolithic arm shows no interference "
+                  f"(ITL p99 ratio "
+                  f"{record['itl_p99_ratio_monolithic']} < 1.5x solo "
+                  f"under 10x long-prompt traffic) — the A/B is not "
+                  f"measuring the problem", file=sys.stderr)
+            rc = 1
+        if record["interference_reduction"] < 1.25:
+            print(f"FAIL: disaggregation reduced the interference "
+                  f"tail only {record['interference_reduction']}x "
+                  f"(< 1.25x floor; disagg "
+                  f"{record['itl_p99_ratio_disagg']}x vs monolithic "
+                  f"{record['itl_p99_ratio_monolithic']}x solo)",
+                  file=sys.stderr)
+            rc = 1
+        if overlap_capable and record["itl_p99_ratio_disagg"] > 1.1:
+            print(f"FAIL: disagg decode ITL p99 "
+                  f"{record['itl_p99_ratio_disagg']}x solo exceeds "
+                  f"the 1.1x flatness floor under 10x long-prompt "
+                  f"traffic", file=sys.stderr)
+            rc = 1
+        if not overlap_capable:
+            print("note: single-core host — prefill compute cannot "
+                  "run under the in-flight decode, so the 1.1x "
+                  "flatness floor is asserted only on >= 2 cores; "
+                  "the interference-reduction floors still hold",
+                  file=sys.stderr)
+    return rc
+
+
 def _sampling_server(cfg, params, args, pipeline, speculation):
     import jax.numpy as jnp
     from apex_tpu.serving import InferenceServer
@@ -676,7 +908,7 @@ def _sampling_server(cfg, params, args, pipeline, speculation):
     return InferenceServer(
         cfg, params, max_batch_size=args.batch_size,
         max_context=args.max_context, block_size=args.block_size,
-        cache_dtype=jnp.float32, kv_quant="off",
+        cache_dtype=jnp.float32, kv_quant="off", enable_disagg=False,
         enable_pipeline=pipeline, enable_speculation=speculation,
         spec_tokens=args.spec_tokens)
 
@@ -894,7 +1126,7 @@ def _tp_server(cfg, params, args, mesh):
     return InferenceServer(
         cfg, params, max_batch_size=args.batch_size,
         max_context=args.max_context, block_size=args.block_size,
-        cache_dtype=jnp.float32, kv_quant="off", mesh=mesh)
+        cache_dtype=jnp.float32, kv_quant="off", enable_disagg=False, mesh=mesh)
 
 
 def _run_tp_workload(server, prompts, args):
@@ -1067,6 +1299,7 @@ def _kvq_server(cfg, params, args, quant, num_blocks=None,
         cache_dtype=(cache_dtype if cache_dtype is not None
                      else jnp.float32),
         kv_quant="int8" if quant else "off",
+        enable_disagg=False,
         num_blocks=num_blocks)
 
 
@@ -1272,7 +1505,7 @@ def _router_fleet(cfg, params, args, kind):
         max_batch_size=args.batch_size,
         max_context=args.max_context, block_size=args.block_size,
         num_blocks=args.router_blocks, cache_dtype=jnp.float32,
-        kv_quant="off")
+        kv_quant="off", enable_disagg=False)
 
 
 def _run_router_arm(cfg, params, args, kind, groups):
@@ -1503,6 +1736,26 @@ def main():
                     "parity always asserted, --smoke floors the "
                     "step-throughput ratio (BENCH_serving_sampling."
                     "json)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="disaggregated prefill/decode interference "
+                    "A/B: decode ITL p99 under 10x long-prompt "
+                    "pressure, disagg on/off vs a solo-decode floor "
+                    "(BENCH_serving_disagg.json, docs/serving.md)")
+    ap.add_argument("--disagg-decoders", type=int, default=4,
+                    help="steady-decode requests in the disagg A/B")
+    ap.add_argument("--disagg-blocks", type=int, default=None,
+                    help="decode-pool blocks in the disagg arm (the "
+                    "monolithic arm gets decode+prefill blocks as "
+                    "one pool — equal total HBM)")
+    ap.add_argument("--disagg-prefill-blocks", type=int, default=None,
+                    help="prefill-pool blocks in the disagg arm")
+    ap.add_argument("--disagg-prefill-concurrent", type=int, default=2,
+                    help="prefill-pool concurrency (chunk launches "
+                    "per step bound)")
+    ap.add_argument("--disagg-arrival", type=int, default=2,
+                    help="long-prompt submissions per step during the "
+                    "interference window (keeps the monolithic arm's "
+                    "prefill slots saturated)")
     ap.add_argument("--pipeline", action="store_true",
                     help="run the pipelined-vs-synchronous step-loop "
                     "A/B (decode-heavy traffic, >= 1.25x "
@@ -1637,6 +1890,24 @@ def main():
             args.layers = 2
             args.heads = 2
             args.max_context = 128
+        if args.disagg:
+            # a steady decode batch with free slots left for long
+            # prompts to prefill through (the monolithic arm must be
+            # ABLE to interleave prefills — slots-full would hide the
+            # interference, not prevent it), and long prompts several
+            # chunks deep so the chunk machinery is what interferes
+            args.disagg_decoders = 4
+            args.max_new = 48
+            args.batch_size = 8
+            args.block_size = 8
+            args.vocab = 61
+            args.hidden = 64
+            args.layers = 2
+            args.heads = 2
+            args.max_context = 128
+            args.prompt_tokens = 8
+            args.chunk = 32
+            args.long_prompt = 96
         if args.shared_prefix:
             # the prefix workloads need room for a long shared prefix
             # and a near-max-context prompt; still toy-model CPU-safe
@@ -1679,6 +1950,21 @@ def main():
                 + args.batch_size * (
                     -(-args.max_context // args.block_size)) + 1)
         return run_router_mode(args)
+
+    if args.disagg:
+        if args.prompt_tokens is None:
+            args.prompt_tokens = max(4, args.max_context // 8)
+        if args.long_prompt is None:
+            args.long_prompt = args.max_context * 3 // 4
+        bps = -(-args.max_context // args.block_size)
+        if args.disagg_prefill_blocks is None:
+            args.disagg_prefill_blocks = (
+                args.disagg_prefill_concurrent * bps + 1)
+        if args.disagg_blocks is None:
+            # every decode slot can hold a full-context request (the
+            # solo floor must measure decode, not preemption)
+            args.disagg_blocks = args.batch_size * bps + 1
+        return run_disagg_mode(args)
 
     if args.kv_quant:
         return run_kv_quant_mode(args)
