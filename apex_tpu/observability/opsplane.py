@@ -34,6 +34,18 @@ Endpoints:
   triggers into :meth:`InferenceServer.drain` /
   :meth:`~InferenceServer.dump_postmortem` (non-loopback peers get
   403; the listener is loopback-bound anyway — defense in depth).
+- ``POST /generate`` + ``GET /stream/<id>`` — the streaming front
+  door (``docs/serving.md``, "Streaming & cancellation"): the POST
+  submits ``{"prompt": [...], "max_new_tokens": N, ...}`` and
+  returns the stream id; the GET serves that request's tokens as
+  Server-Sent Events (``event: token`` per retired token, one
+  ``event: end`` carrying the ``finish_reason``).  The SSE loop
+  blocks on the stream broker's OWN lock — never the ops lock — and
+  a broken client socket **cancels** the request
+  (``finish_reason="cancelled"``), freeing its blocks mid-decode.
+  Hosted by both a single server's ops plane and the fleet's
+  aggregate one (``RouterFleet(ops_port=)`` — streams there survive
+  failover and hand-off).
 
 Mutating reads (``/statusz``, ``/debug/*``) and the POST triggers
 serialize against the serve loop through :attr:`OpsServer.lock` —
@@ -104,6 +116,9 @@ class OpsServer:
         self.counters = counters
         self._clock = clock if clock is not None else server.clock
         self._started_at = self._clock()
+        # SSE heartbeat cadence: bounds both disconnect detection and
+        # how long a stream handler can block between wakeups
+        self._sse_ping_s = 10.0
         self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.ops = self
@@ -156,6 +171,8 @@ class OpsServer:
                     return self._count_send(
                         h, "debug_requests",
                         *self._request(path.rsplit("/", 1)[1]))
+                if path.startswith("/stream/"):
+                    return self._stream(h, path.rsplit("/", 1)[1])
             elif method == "POST":
                 if h.client_address[0] not in _LOOPBACK:
                     return self._count_send(h, "forbidden", *_json(
@@ -170,6 +187,9 @@ class OpsServer:
                 if path == "/postmortem":
                     return self._count_send(h, "postmortem",
                                             *self._postmortem())
+                if path == "/generate":
+                    return self._count_send(h, "generate",
+                                            *self._generate(body))
             self._count_send(h, "unknown", *_json(
                 404, {"error": f"no such endpoint: {method} {path}"}))
         except (BrokenPipeError, ConnectionResetError):
@@ -236,6 +256,13 @@ class OpsServer:
             "watchdog_stalls": srv.watchdog.stalls,
             "uptime_s": round(self._clock() - self._started_at, 3),
         }
+        # streaming gauges ride the same probe (broker-locked, not
+        # ops-locked — still safe while the serve loop is wedged)
+        broker = getattr(srv, "stream_broker", None)
+        body["active_streams"] = (broker.active
+                                  if broker is not None else 0)
+        body["stream_backpressure_drops"] = (
+            broker.backpressure_drops if broker is not None else 0)
         return _json(200 if status == "ok" else 503, body)
 
     def _flight(self, query) -> Tuple[int, bytes, str]:
@@ -282,6 +309,90 @@ class OpsServer:
         return _json(200, {
             "status": "drained",
             "requests_finished": stats["requests_finished"]})
+
+    # -- streaming front door (docs/serving.md) ----------------------------
+
+    def _generate(self, body: bytes) -> Tuple[int, bytes, str]:
+        """Submit one request from a JSON body; returns the id to
+        ``GET /stream/<id>`` (the router-level ``rid`` on a fleet ops
+        plane, the request ``uid`` on a single server's)."""
+        try:
+            payload = json.loads(body or b"{}")
+            prompt = [int(t) for t in payload["prompt"]]
+            max_new = int(payload["max_new_tokens"])
+        except (ValueError, TypeError, KeyError) as e:
+            return _json(400, {"error": f"bad generate body: {e!r}"})
+        eos_id = payload.get("eos_id")
+        priority = int(payload.get("priority", 0))
+        srv = self.server
+        if getattr(srv, "stream_broker", None) is None:
+            return _json(409, {"error": "streaming disabled "
+                                        "(enable_streaming=False)"})
+        try:
+            # apexlint: disable=lock-discipline — documented lock-free: submit() takes the ops lock itself (both server kinds); taking self.lock here would deadlock a non-reentrant configuration and serialize admission behind slow scrapes
+            req = srv.submit(prompt, max_new,
+                             eos_id if eos_id is None else int(eos_id),
+                             priority=priority)
+        except (ValueError, TypeError, RuntimeError) as e:
+            return _json(400, {"error": str(e)})
+        sid = getattr(req, "rid", None)
+        if sid is None:
+            sid = req.uid
+        out = {"id": sid, "finished": bool(req.finished)}
+        if req.finished:       # turned away at the front door
+            out["finish_reason"] = req.finish_reason
+        return _json(200, out)
+
+    def _stream(self, h, id_text: str) -> None:
+        """Serve one request's tokens as SSE.  The setup (stream
+        lookup) serializes on the ops lock; the delivery loop blocks
+        only on the broker's own condition variable, so a slow or
+        stalled consumer thread never holds the ops lock.  A broken
+        client socket cancels the request — the disconnect-
+        cancellation contract the chaos soak fires faults at."""
+        try:
+            sid = int(id_text)
+        except ValueError:
+            return self._count_send(h, "stream", *_json(
+                400, {"error": f"bad stream id: {id_text!r}"}))
+        srv = self.server
+        if getattr(srv, "stream_broker", None) is None:
+            return self._count_send(h, "stream", *_json(
+                409, {"error": "streaming disabled"}))
+        try:
+            # apexlint: disable=lock-discipline — documented lock-free: stream() takes the ops lock itself; the delivery loop below must NOT hold self.lock (it blocks on the broker condition for seconds at a time)
+            stream = srv.stream(sid)
+        except KeyError:
+            return self._count_send(h, "stream", *_json(
+                404, {"error": f"unknown stream id {sid}"}))
+        if self.counters is not None:
+            self.counters.incr("stream")
+        h.send_response(200)
+        h.send_header("Content-Type", "text/event-stream")
+        h.send_header("Cache-Control", "no-cache")
+        h.end_headers()
+        try:
+            while True:
+                toks = stream.take(timeout=self._sse_ping_s)
+                for tok in toks:
+                    h.wfile.write(
+                        f"event: token\ndata: {tok}\n\n".encode())
+                if stream.done:
+                    h.wfile.write(
+                        f"event: end\ndata: "
+                        f"{stream.finish_reason}\n\n".encode())
+                    h.wfile.flush()
+                    return
+                if not toks:
+                    # heartbeat comment: the only way a one-way SSE
+                    # pipe learns the client hung up between tokens
+                    h.wfile.write(b": ping\n\n")
+                h.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # client disconnected mid-stream: free its blocks NOW
+            stream.close()
+            # apexlint: disable=lock-discipline — documented lock-free: cancel() takes the ops lock itself; holding self.lock across it would nest the locks in the opposite order of /statusz
+            srv.cancel(sid)
 
     def _postmortem(self) -> Tuple[int, bytes, str]:
         """Bundle-path choice AND the dump run under one lock hold:

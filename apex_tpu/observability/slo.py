@@ -48,8 +48,12 @@ from typing import Dict, Optional
 
 __all__ = ["SLOTargets", "SLOPolicy", "SLOTracker", "HEALTHY_REASONS"]
 
-# the two healthy terminals (mirrors resilience.chaos.HEALTHY_REASONS,
-# duplicated here so observability never imports resilience)
+# pinned MIRRORS of :mod:`apex_tpu.serving.reasons` (the canonical
+# finish-reason constants module).  Observability sits BELOW serving
+# in the import graph — ``serving.api`` imports this package while it
+# is still initializing — so a module-level import of serving here
+# would cycle; ``tests/L0/test_reasons.py`` asserts these mirrors
+# never drift from the canonical values.
 HEALTHY_REASONS = frozenset({"eos", "length"})
 
 # front-door refusals: never admitted (or given up at the door), so
@@ -59,6 +63,10 @@ HEALTHY_REASONS = frozenset({"eos", "length"})
 # "Disaggregated prefill/decode") — served elsewhere, not served late
 REFUSED_REASONS = frozenset({"rejected", "shed", "breaker_open",
                              "draining", "handoff"})
+
+# mirror singletons used in classification below (same drift pin)
+SHED = "shed"
+TIMEOUT = "timeout"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -163,7 +171,7 @@ class SLOTracker:
         (shed / rejected / breaker_open / draining) route to the debt
         side instead and return False."""
         if req.finish_reason in REFUSED_REASONS:
-            if req.finish_reason == "shed":
+            if req.finish_reason == SHED:
                 self.note_shed(req)
             return False
         cs = self._class(req.priority)
@@ -175,7 +183,7 @@ class SLOTracker:
         targets = self.policy.for_priority(req.priority)
         tl = req.timeline()
         met = req.finish_reason in HEALTHY_REASONS
-        if req.finish_reason == "timeout":
+        if req.finish_reason == TIMEOUT:
             cs.deadline_missed += 1
         if targets.ttft_s is not None and "ttft_s" in tl:
             if tl["ttft_s"] <= targets.ttft_s:

@@ -33,9 +33,13 @@ intact.  This module is the composition harness:
   same quantized grid, and the invariant then proves quantized
   blocks+scales survive every composed fault path bit-consistently.
 
-This module never imports :mod:`apex_tpu.serving` at module scope
-(``serving.api`` imports :mod:`resilience.breaker`; a top-level
-import back would cycle) — the server is passed in via factories.
+This module never imports the :mod:`apex_tpu.serving` *stack* at
+module scope (``serving.api`` imports :mod:`resilience.breaker`; a
+top-level import back would cycle) — the server is passed in via
+factories.  The one exception is :mod:`apex_tpu.serving.reasons`,
+the finish-reason constants module, which by contract imports
+NOTHING and is therefore cycle-safe even while either package is
+mid-init (``tests/L0/test_reasons.py`` pins both import directions).
 """
 
 from __future__ import annotations
@@ -46,31 +50,16 @@ import random
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from apex_tpu.resilience.faults import FaultPlan, InjectedCrash
+from apex_tpu.serving.reasons import (
+    CANCELLED,
+    HEALTHY_REASONS,
+    ROUTER_TERMINAL_REASONS,
+    TERMINAL_REASONS,
+)
 
 __all__ = ["Arrival", "ChaosConfig", "ChaosEngine", "ChaosSchedule",
            "ReplicaKillSwitch", "ROUTER_TERMINAL_REASONS",
            "TERMINAL_REASONS", "run_router_soak", "run_soak"]
-
-# every legal way a request's life can end; any other value is a bug
-TERMINAL_REASONS = frozenset({
-    "eos", "length",                       # healthy
-    "capacity", "timeout", "nonfinite",    # isolated failures
-    "rejected", "shed", "breaker_open", "draining",  # front door
-})
-
-# reasons with zero or partial output whose tokens must still be a
-# prefix of the unfaulted replay (greedy decoding is deterministic, so
-# whatever a request produced before being cut short is bit-exact)
-HEALTHY_REASONS = frozenset({"eos", "length"})
-
-# the router tier adds two terminal reasons: a mid-stream request on a
-# killed replica fails "replica_failed" (its cache cannot move; its
-# partial output must still be a bit-exact prefix of the replay), and
-# a disaggregated prefill replica locally finishes "handoff" when a
-# request's decode half moved to another replica (the proxy follows
-# the new request — docs/serving.md, "Disaggregated prefill/decode")
-ROUTER_TERMINAL_REASONS = TERMINAL_REASONS | {"replica_failed",
-                                              "handoff"}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -173,6 +162,17 @@ class ChaosConfig:
     handoff_oom_rate: float = 0.0
     handoff_torn_rate: float = 0.0
 
+    # client-disconnect fault class (docs/serving.md, "Streaming &
+    # cancellation"; the --streaming soak arms it): on each scheduled
+    # iteration one live streamed request's consumer "hangs up" —
+    # its stream closes and the server cancels it mid-whatever it was
+    # doing (mid-prefill-chunk, mid-speculation-window, mid-pipelined
+    # launch), which must free its blocks/holds with audit() clean
+    # and leave its delivered tokens a bit-exact prefix of the
+    # replay.  Default 0.0 keeps legacy (config, seed) schedules
+    # byte-identical (no extra RNG draws).
+    disconnect_rate: float = 0.0
+
     # forced invariant violation (the postmortem build-matrix axis,
     # docs/observability.md): at the first iteration >= this with a
     # finished request, the soak deliberately corrupts the terminal
@@ -200,7 +200,8 @@ class ChaosSchedule:
                  oom_iters: Set[int],
                  fault_plans: List[FaultPlan],
                  handoff_oom_iters: Optional[Set[int]] = None,
-                 handoff_torn_iters: Optional[Set[int]] = None):
+                 handoff_torn_iters: Optional[Set[int]] = None,
+                 disconnect_iters: Optional[Set[int]] = None):
         self.cfg = cfg
         self.seed = seed
         self.arrivals = arrivals
@@ -209,6 +210,7 @@ class ChaosSchedule:
         self.fault_plans = fault_plans
         self.handoff_oom_iters = handoff_oom_iters or set()
         self.handoff_torn_iters = handoff_torn_iters or set()
+        self.disconnect_iters = disconnect_iters or set()
 
     @property
     def num_arrivals(self) -> int:
@@ -259,6 +261,7 @@ class ChaosSchedule:
         oom: Set[int] = set()
         handoff_oom: Set[int] = set()
         handoff_torn: Set[int] = set()
+        disconnect: Set[int] = set()
         for i in range(cfg.iters):
             batch: List[Arrival] = []
             if rng.random() < cfg.arrival_rate:
@@ -285,6 +288,9 @@ class ChaosSchedule:
             if cfg.handoff_torn_rate \
                     and rng.random() < cfg.handoff_torn_rate:
                 handoff_torn.add(i)
+            if cfg.disconnect_rate \
+                    and rng.random() < cfg.disconnect_rate:
+                disconnect.add(i)
         # compose the EXISTING fault vocabulary: one FaultPlan per
         # scheduled crash, ticked by iteration number (crash_kind
         # "raise" — SIGKILL would end the soak process, which the
@@ -298,7 +304,8 @@ class ChaosSchedule:
                     crash_kind="raise"))
         return cls(cfg, seed, arrivals, nonfinite, oom, plans,
                    handoff_oom_iters=handoff_oom,
-                   handoff_torn_iters=handoff_torn)
+                   handoff_torn_iters=handoff_torn,
+                   disconnect_iters=disconnect)
 
 
 class ChaosEngine:
@@ -765,6 +772,22 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
          the real clock) recorded ZERO stalls — composed faults are
          not hangs, and a soak is the strongest false-positive trial
          the detector gets.
+
+    Streaming (``docs/serving.md``, "Streaming & cancellation"): when
+    the soaked server has a :class:`~serving.streaming.StreamBroker`
+    (``enable_streaming=True``), every tracked request ALSO gets a
+    token stream opened at submit time and drained every iteration,
+    and two more invariants ride the whole soak:
+      8. delivered tokens are byte-identical to ``req.generated`` for
+         every finished request (greedy AND counter-keyed
+         stochastic), and the stream's terminal event carries exactly
+         the request's ``finish_reason``;
+      9. a ``disconnect_rate`` fault (client hangs up: stream closed,
+         request cancelled mid-decode — mid-chunk, mid-speculation-
+         window, or mid-pipelined-launch, whatever the iteration
+         composed) leaves the delivered prefix bit-exact vs the
+         replay, the terminal ``"cancelled"``, and the pool
+         audit-clean — cancellation must actually free the blocks.
     """
     schedule = ChaosSchedule.generate(cfg, seed)
     clock_state = {"t": 0.0}
@@ -787,7 +810,18 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
         all_scheds.append(server.prefill_scheduler)
     tracked: Dict[int, object] = {}     # uid -> Request
     terminal: Dict[int, str] = {}       # uid -> finish_reason
-    report = {"iters": cfg.iters, "seed": seed, "crashes_caught": 0}
+    # streaming delivery (invariants 8 + 9): a stream per tracked
+    # request, drained every iteration like a well-behaved consumer;
+    # disconnect faults draw their victims from their own salted
+    # stream so arming them never perturbs the schedule's draws
+    streaming = getattr(server, "stream_broker", None) is not None
+    streams: Dict[int, object] = {}     # uid -> TokenStream
+    delivered: Dict[int, List[int]] = {}
+    disconnected: Set[int] = set()
+    cancelled_uids: Set[int] = set()    # cancel() actually landed
+    drng = random.Random(seed ^ 0xD15C)
+    report = {"iters": cfg.iters, "seed": seed, "crashes_caught": 0,
+              "streaming": streaming, "disconnects": 0}
 
     def absorb_finished():
         """Walk newly finished requests (invariants 2 + 3)."""
@@ -826,6 +860,9 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
                                     sampling=_sampling_params(
                                         a.sampling))
                 tracked[req.uid] = (req, a)
+                if streaming:
+                    streams[req.uid] = server.stream(req)
+                    delivered[req.uid] = []
             try:
                 chaos.begin_iter(i)
                 if pchaos is not None:
@@ -835,6 +872,27 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
                 # a FaultPlan crash between engine steps: nothing was
                 # half-applied, so the very next iteration carries on
                 report["crashes_caught"] += 1
+            if streaming:
+                if i in schedule.disconnect_iters:
+                    # one live consumer hangs up: RIGHT after a step,
+                    # with the pipelined window still in flight, so
+                    # the cancel exercises the flush-then-free path
+                    # mid-whatever this iteration composed
+                    live = sorted(
+                        uid for uid, (req, _a) in tracked.items()
+                        if not req.finished
+                        and uid not in disconnected)
+                    if live:
+                        uid = drng.choice(live)
+                        delivered[uid].extend(streams[uid].drain())
+                        streams[uid].close()
+                        if server.cancel(uid):
+                            cancelled_uids.add(uid)
+                        disconnected.add(uid)
+                        report["disconnects"] += 1
+                for uid, s in streams.items():
+                    if uid not in disconnected and not s.done:
+                        delivered[uid].extend(s.drain())
             if (cfg.force_violation_iter is not None and not forced
                     and i >= cfg.force_violation_iter and sched.finished):
                 # deliberately corrupt the terminal bookkeeping: the
@@ -871,6 +929,38 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
                 f"request {uid} never reached a terminal state"
         assert not any(s.has_work for s in all_scheds), \
             "drained server still has work"
+        if streaming:                                   # invariant 8
+            for uid, (req, _a) in tracked.items():
+                s, d = streams[uid], delivered[uid]
+                if uid in disconnected:
+                    # the consumer left early: whatever it saw must
+                    # be a byte-exact prefix of the request's output
+                    assert d == list(req.generated)[:len(d)], \
+                        (f"disconnected stream {uid} delivered "
+                         f"tokens that are not a prefix of its own "
+                         f"output")
+                    continue
+                d.extend(s.drain())
+                assert d == list(req.generated), \
+                    (f"stream {uid} delivered {len(d)} token(s) != "
+                     f"request output {len(req.generated)} — "
+                     f"delivery must be byte-identical")
+                assert s.finish_reason == req.finish_reason, \
+                    (f"stream {uid} terminal "
+                     f"{s.finish_reason!r} != request "
+                     f"{req.finish_reason!r}")
+            assert server.stream_broker.active == 0, \
+                (f"{server.stream_broker.active} stream(s) still "
+                 f"active after every request reached a terminal — "
+                 f"the broker must self-prune")
+            for uid in sorted(disconnected):            # invariant 9
+                # a hang-up whose cancel landed MUST end "cancelled";
+                # one that lost the race (the window flush finished
+                # the request first) keeps whatever terminal it won
+                if uid in cancelled_uids:
+                    assert terminal[uid] == CANCELLED, \
+                        (f"cancelled request {uid} ended "
+                         f"{terminal[uid]!r}, not {CANCELLED!r}")
     except AssertionError as e:
         _postmortem_and_reraise(e)
 
@@ -983,4 +1073,12 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
         handoff=(stats["disagg"].get("handoff")
                  if stats["disagg"]["enabled"] else None),
     )
+    if streaming:
+        bst = server.stream_broker.stats()
+        report.update(
+            streams_opened=bst["opened"],
+            stream_published_tokens=bst["published_tokens"],
+            stream_backpressure_drops=bst["backpressure_drops"],
+            cancelled=tally.get(CANCELLED, 0),
+        )
     return report

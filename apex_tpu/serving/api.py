@@ -145,8 +145,10 @@ from apex_tpu.serving.engine import DecodeEngine
 from apex_tpu.serving.kv_cache import KV_QUANT_ENV, resolve_kv_quant
 from apex_tpu.serving.overload import OverloadPolicy
 from apex_tpu.serving.prefix_cache import PrefixCache
+from apex_tpu.serving import reasons
 from apex_tpu.serving.scheduler import QueueFullError, Request, Scheduler
 from apex_tpu.serving.speculation import DraftSource, NgramDraft
+from apex_tpu.serving.streaming import StreamBroker, TokenStream
 from apex_tpu.utils import CounterMeter, GaugeMeter, RateMeter
 
 # the stats() window for "tokens/s right now" (RateMeter.rate_over) —
@@ -421,6 +423,16 @@ class InferenceServer:
         ownership (this server finishes its half
         ``finish_reason="handoff"``), False falls back to the LOCAL
         decode pool.
+      enable_streaming: per-token delivery (docs/serving.md,
+        "Streaming & cancellation"): a :class:`StreamBroker` fans
+        every retired token out to :meth:`stream` consumers at the
+        point it is applied, and :meth:`cancel` frees a request's
+        blocks/holds mid-decode with ``finish_reason="cancelled"``
+        (cancel works even with streaming disabled).  Default on —
+        the broker is O(1) no-op work per token when nobody streams.
+      stream_queue_tokens: per-stream bounded queue depth; a slower
+        consumer drops the oldest queued notification (backfilled on
+        the next read) instead of ever stalling ``step()``.
 
     Example::
 
@@ -465,7 +477,9 @@ class InferenceServer:
                  enable_disagg: bool = False,
                  disagg_prefill_blocks: Optional[int] = None,
                  prefill_max_concurrent: int = 2,
-                 handoff_sink: Optional[Callable] = None):
+                 handoff_sink: Optional[Callable] = None,
+                 enable_streaming: bool = True,
+                 stream_queue_tokens: int = 256):
         self.registry = registry if registry is not None \
             else MetricsRegistry()
         self.tracer = tracer if tracer is not None else get_tracer()
@@ -739,6 +753,13 @@ class InferenceServer:
         if self.watchdog.enabled:
             self.watchdog.on_stall = self._on_watchdog_stall
             self.watchdog.start()
+        # streaming delivery (docs/serving.md, "Streaming &
+        # cancellation"): the broker fans retired tokens out to
+        # per-request bounded queues on ITS OWN lock — never the ops
+        # lock — so a slow consumer can't stall step()
+        self.stream_broker: Optional[StreamBroker] = (
+            StreamBroker(queue_tokens=stream_queue_tokens)
+            if enable_streaming else None)
         # embedded HTTP ops plane: resolved off unless a port is
         # given (kwarg wins over APEX_TPU_OPS_PORT; 0 = ephemeral).
         # While attached, step()/stats() serialize through its lock.
@@ -848,9 +869,9 @@ class InferenceServer:
                                 prompt_tokens=len(prompt),
                                 priority=req.priority)
         if self._draining:
-            return self._finish_at_submit(req, "draining")
+            return self._finish_at_submit(req, reasons.DRAINING)
         if self.breaker is not None and not self.breaker.allow():
-            return self._finish_at_submit(req, "breaker_open")
+            return self._finish_at_submit(req, reasons.BREAKER_OPEN)
         try:
             # under disaggregation every request enters through the
             # prefill pool's queue; the decode pool only ever admits
@@ -858,7 +879,7 @@ class InferenceServer:
             (self.prefill_scheduler if self.disagg
              else self.scheduler).submit(req)
         except QueueFullError:
-            return self._finish_at_submit(req, "rejected")
+            return self._finish_at_submit(req, reasons.REJECTED)
         # a displaced victim may have finished "shed" inside
         # scheduler.submit: stamp its finished_at at submission time
         if self._finalized < len(self.scheduler.finished):
@@ -895,7 +916,7 @@ class InferenceServer:
                 over_wall = (req.deadline_s is not None and
                              now - req.submitted_at >= req.deadline_s)
                 if over_iters or over_wall:
-                    sched.fail(req, "timeout")
+                    sched.fail(req, reasons.TIMEOUT)
 
     def _schedulers(self):
         """Every live scheduler — ``(decode, prefill)`` under
@@ -1087,7 +1108,7 @@ class InferenceServer:
             if pipelined:
                 ids, fin = out
                 if not bool(np.asarray(fin)[0]):
-                    sched.fail(req, "nonfinite")
+                    sched.fail(req, reasons.NONFINITE)
                     if self.breaker is not None:
                         self.breaker.record_failure()
                     continue
@@ -1095,7 +1116,7 @@ class InferenceServer:
             else:
                 logits = np.asarray(out)
                 if not np.all(np.isfinite(logits)):
-                    sched.fail(req, "nonfinite")
+                    sched.fail(req, reasons.NONFINITE)
                     if self.breaker is not None:
                         self.breaker.record_failure()
                     continue
@@ -1119,7 +1140,7 @@ class InferenceServer:
                     # victim left — it fails alone instead of raising
                     # into the batch
                     if not sched.ensure_decode_capacity(req):
-                        sched.fail(req, "capacity")
+                        sched.fail(req, reasons.CAPACITY)
             running = [r for r in sched.running.values()
                        if not r.prefilling]
             if running:
@@ -1354,7 +1375,7 @@ class InferenceServer:
             if req.finished or not req.running:
                 continue      # failed between launch and retire
             if not finite[req.slot]:
-                sched.fail(req, "nonfinite")
+                sched.fail(req, reasons.NONFINITE)
                 if self.breaker is not None:
                     self.breaker.record_failure(now)
                 continue
@@ -1542,7 +1563,7 @@ class InferenceServer:
                 continue      # failed between launch and retire
             n = int(lengths[req.slot])
             if not np.all(finite[req.slot, :n]):
-                sched.fail(req, "nonfinite")
+                sched.fail(req, reasons.NONFINITE)
                 if self.breaker is not None:
                     self.breaker.record_failure(now)
                 continue
@@ -1686,7 +1707,7 @@ class InferenceServer:
             for req in list(sched.running.values()):
                 if req.running and not req.prefilling:
                     if not sched.ensure_decode_capacity(req):
-                        sched.fail(req, "capacity")
+                        sched.fail(req, reasons.CAPACITY)
             # a decode-pool preemption victim must re-prefill: it
             # re-enters through the PREFILL pool's queue front,
             # keeping its seniority (recompute is bit-stable — the
@@ -1889,7 +1910,7 @@ class InferenceServer:
             # monolithic loop's prefill sampling
             logits = np.asarray(out)
             if not np.all(np.isfinite(logits)):
-                psched.fail(req, "nonfinite")
+                psched.fail(req, reasons.NONFINITE)
                 if self.breaker is not None:
                     self.breaker.record_failure()
                 continue
@@ -1930,7 +1951,7 @@ class InferenceServer:
                 ids, fin = ent.handles
                 ent.handles = None
                 if not bool(np.asarray(fin)[0]):
-                    psched.fail(req, "nonfinite")
+                    psched.fail(req, reasons.NONFINITE)
                     if self.breaker is not None:
                         self.breaker.record_failure()
                     q.popleft()
@@ -1953,7 +1974,7 @@ class InferenceServer:
                     req.block_table)
                 if self.handoff_sink(req, payload):
                     psched.register_progress(req)
-                    psched.fail(req, "handoff")
+                    psched.fail(req, reasons.HANDOFF)
                     self.handoffs.incr("sink_delivered")
                     q.popleft()
                     continue
@@ -2103,6 +2124,12 @@ class InferenceServer:
             req.itl_gaps.append(gap)
             self.itl.record(gap)
         req.last_token_at = now
+        # streaming fan-out rides the same edge: every applied token
+        # funnels through here, so this is THE retire-time publish
+        # point (docs/serving.md, "Streaming & cancellation")
+        if self.stream_broker is not None:
+            self.stream_broker.publish(req.uid, len(req.generated) - 1,
+                                       req.generated[-1])
 
     def _finalize_finished(self) -> None:
         """Stamp ``finished_at`` on every request that finished since
@@ -2138,6 +2165,12 @@ class InferenceServer:
             # "SLO & goodput"): served terminals count toward
             # attainment, shed work toward the debt counters
             self.slo.observe(req)
+            # terminal stream event: delivery backfills any tokens the
+            # bounded queue never carried, so the consumer's stream is
+            # complete the moment it sees the finish_reason
+            if self.stream_broker is not None:
+                self.stream_broker.finish(req.uid,
+                                          req.finish_reason or "")
 
     def _queue_wait_for(self, priority: int):
         """The per-priority-class queue-wait histogram (a labeled
@@ -2320,7 +2353,103 @@ class InferenceServer:
         self._finalize_finished()
         return moved
 
-    def evacuate(self, reason: str = "replica_failed") -> tuple:
+    # -- streaming & cancellation (docs/serving.md) ------------------------
+
+    def _find_request(self, uid: int) -> Optional[Request]:
+        """The live-or-finished request with ``uid``, or None.
+        ``scheduler.running`` is keyed by SLOT, so uid lookups scan
+        values; the finished list is shared across pools."""
+        for sched in self._schedulers():
+            for req in sched.running.values():
+                if req.uid == uid:
+                    return req
+            for req in sched.waiting:
+                if req.uid == uid:
+                    return req
+        for req in self.scheduler.finished:
+            if req.uid == uid:
+                return req
+        return None
+
+    def stream(self, req_or_uid, callback: Optional[Callable] = None
+               ) -> TokenStream:
+        """The per-token delivery stream for a submitted request
+        (docs/serving.md, "Streaming & cancellation").
+
+        Iterate it (``for tok in server.stream(req.uid)``), poll it
+        (``drain()`` / ``take(timeout=)``), or pass ``callback`` to
+        get ``callback("token", tok)`` at each retire plus one
+        ``callback("end", finish_reason)``.  Opening late is fine —
+        the stream backfills everything already generated.  Requires
+        ``enable_streaming``; unknown uids raise ``KeyError``."""
+        with (self._ops_lock or _NO_LOCK):
+            if self.stream_broker is None:
+                raise RuntimeError(
+                    "streaming is disabled (enable_streaming=False)")
+            if isinstance(req_or_uid, Request):
+                req = req_or_uid
+            else:
+                req = self._find_request(int(req_or_uid))
+                if req is None:
+                    raise KeyError(f"no request with uid "
+                                   f"{req_or_uid} on this server")
+            return self.stream_broker.open(req.uid, req, callback)
+
+    def cancel(self, uid: int) -> bool:
+        """Cancel one request by uid — the client hung up (the SSE
+        front door calls this on a broken socket) or explicitly
+        abandoned it.  Frees its blocks / lookahead / in-flight holds
+        immediately with ``finish_reason="cancelled"``; a queued
+        request simply leaves the queue.  Returns True if a live
+        request was cancelled, False if the uid is unknown or already
+        terminal (double-cancel is an idempotent no-op).
+
+        Safe mid-pipeline: the launched-but-unretired window is
+        flushed FIRST (the ``submit()`` write-safety idiom), so the
+        device step that may still reference the request's blocks has
+        fully retired before ``fail()`` releases them; a cancel
+        arriving between a later launch and its retire is handled by
+        the apply-side discard guards (``req.finished`` requests'
+        retired tokens are dropped)."""
+        with (self._ops_lock or _NO_LOCK):
+            return self._cancel(uid)
+
+    def _cancel(self, uid: int) -> bool:
+        # the flush can retire final tokens and FINISH requests —
+        # possibly the victim itself (the lost-race path) — so the
+        # finalize below must run even when nothing is failed
+        if self._inflight is not None:
+            self._pending_produced += self._flush_window()
+        cancelled = False
+        for sched in self._schedulers():
+            for req in (list(sched.running.values())
+                        + list(sched.waiting)):
+                if req.uid == uid and not req.finished:
+                    sched.fail(req, reasons.CANCELLED)
+                    if self.tracer.enabled:
+                        self.tracer.instant("request_cancel",
+                                            uid=uid,
+                                            tokens=len(req.generated))
+                    cancelled = True
+                    break
+            if cancelled:
+                break
+        self._finalize_finished()
+        return cancelled
+
+    def _stream_stats(self) -> dict:
+        """The ``stats()["streams"]`` block — broker counters plus the
+        cancellation tally (meaningful even with streaming off)."""
+        st = {"enabled": self.stream_broker is not None,
+              "cancelled":
+                  self.failures.count("requests_failed_cancelled")}
+        if self.stream_broker is not None:
+            st.update(self.stream_broker.stats())
+            # bounded per-stream rows (``ops_probe --streams``)
+            st["per_stream"] = self.stream_broker.snapshot()
+        return st
+
+    def evacuate(self, reason: str = reasons.REPLICA_FAILED) -> tuple:
         """Failover surgery for a server whose ENGINE is presumed dead
         (the router's circuit breaker tripped on repeated step
         failures — ``serving.router``).  Returns
@@ -2681,6 +2810,9 @@ class InferenceServer:
                 "port": self.ops.port if self.ops is not None else None,
                 "requests": self.ops_requests.total,
             },
+            # streaming delivery (docs/serving.md, "Streaming &
+            # cancellation"): broker fan-out counters + cancellations
+            "streams": self._stream_stats(),
             # disaggregated prefill/decode pools (docs/serving.md,
             # "Disaggregated prefill/decode"): the prefill pool's own
             # free/live/evictable partition plus the hand-off

@@ -1,0 +1,55 @@
+"""Finish-reason vocabulary — the single source of truth.
+
+Every terminal ``Request.finish_reason`` the stack can assign lives
+here as a named constant, together with the frozensets the chaos
+invariants gate on.  Scheduler, server, router, and the resilience
+layer all import from this module, so a new reason is a one-line
+change that the exhaustiveness test (``tests/L0/test_reasons.py``)
+and the soak's exactly-one-terminal invariant pick up automatically —
+a literal typo'd at an assignment site can no longer silently open a
+reason the invariants don't know about.
+
+This module imports NOTHING (stdlib included): it must be importable
+from :mod:`apex_tpu.resilience.chaos` while ``apex_tpu.serving``'s
+package ``__init__`` is still mid-import (chaos is reachable from the
+resilience package ``__init__``, which ``serving.api`` pulls in via
+the breaker), so it can carry no imports that re-enter either
+package.
+"""
+
+# healthy terminals — the request ran to its natural end
+EOS = "eos"                      # sampled the eos id
+LENGTH = "length"                # hit max_new_tokens
+
+# server-side failure terminals
+CAPACITY = "capacity"            # could never fit the KV pool
+TIMEOUT = "timeout"              # deadline expired
+NONFINITE = "nonfinite"          # non-finite logits isolated
+REJECTED = "rejected"            # invalid at submit (bad prompt/params)
+SHED = "shed"                    # overload policy dropped it
+BREAKER_OPEN = "breaker_open"    # circuit breaker refused submit
+DRAINING = "draining"            # submitted into a draining server
+CANCELLED = "cancelled"          # client disconnected / cancel(uid)
+HANDOFF = "handoff"              # exported to another replica's pool
+
+# router-level terminals
+REPLICA_FAILED = "replica_failed"  # replica died mid-stream
+
+#: reasons that end a request without anything having gone wrong
+HEALTHY_REASONS = frozenset({EOS, LENGTH})
+
+#: every terminal a single server can assign (the soak's
+#: exactly-one-terminal invariant gates membership)
+TERMINAL_REASONS = HEALTHY_REASONS | frozenset({
+    CAPACITY, TIMEOUT, NONFINITE, REJECTED, SHED, BREAKER_OPEN,
+    DRAINING, CANCELLED,
+})
+
+#: the router soak's superset: replica failover and cross-replica
+#: hand-off add their own terminals
+ROUTER_TERMINAL_REASONS = TERMINAL_REASONS | frozenset({
+    REPLICA_FAILED, HANDOFF,
+})
+
+#: the full vocabulary (what the exhaustiveness test scans source for)
+ALL_REASONS = ROUTER_TERMINAL_REASONS

@@ -55,11 +55,13 @@ from apex_tpu.observability import (
     write_postmortem,
 )
 from apex_tpu.resilience.breaker import CircuitBreaker
+from apex_tpu.serving import reasons
 from apex_tpu.serving.api import InferenceServer
 from apex_tpu.serving.router.policy import RouterPolicy
 from apex_tpu.serving.router.replica import Replica
 from apex_tpu.serving.router.router import ReplicaRouter, RouterRequest
 from apex_tpu.serving.scheduler import Request
+from apex_tpu.serving.streaming import StreamBroker, TokenStream
 from apex_tpu.utils import GaugeMeter
 
 __all__ = ["RouterFleet"]
@@ -146,6 +148,8 @@ class RouterFleet:
                  ops_port: Optional[int] = None,
                  disagg_prefill: int = 0,
                  disagg_prefill_threshold: Optional[int] = None,
+                 enable_streaming: bool = True,
+                 stream_queue_tokens: int = 256,
                  **server_kwargs):
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
@@ -255,6 +259,17 @@ class RouterFleet:
         self.breaker = None
         self.scheduler = _FleetSchedView(self)
         self._postmortem_dir = None
+        # fleet-level streaming front door (docs/serving.md,
+        # "Streaming & cancellation"): streams key on the STABLE
+        # ``rid`` and read through the RouterRequest proxy, so a
+        # stream survives failover re-enqueue and hand-off rebinds;
+        # the cursor pump republishes from the proxy's token list and
+        # the broker's index dedup drops anything already delivered
+        self.stream_broker: Optional[StreamBroker] = (
+            StreamBroker(queue_tokens=stream_queue_tokens)
+            if enable_streaming else None)
+        self._stream_reqs: dict = {}     # rid -> RouterRequest
+        self._stream_cursors: dict = {}  # rid -> publish high-water
         self.ops: Optional[OpsServer] = None
         self._ops_lock = None
         if ops_port is not None:
@@ -285,7 +300,7 @@ class RouterFleet:
                                 priority=int(priority),
                                 submitted_at=now)
                 inner.finished = True
-                inner.finish_reason = "draining"
+                inner.finish_reason = reasons.DRAINING
                 inner.finished_at = now
                 rr = RouterRequest(inner, None)
                 self.router.requests.append(rr)
@@ -324,7 +339,94 @@ class RouterFleet:
             if rep.alive and p > peak:
                 peak = p
         self.pressure_gauge.update(peak)
+        self._pump_streams()
         return produced
+
+    # -- streaming & cancellation (docs/serving.md) ------------------------
+
+    def _pump_streams(self) -> None:
+        """Fan this fleet step's tokens out to open streams.  Reads go
+        through the RouterRequest proxy, so a rebind (failover
+        re-enqueue, hand-off, monolithic fallback) is transparent:
+        the moved request regenerates its stream bit-identically, the
+        publish cursor only ever advances, and the broker's index
+        dedup discards the already-delivered prefix."""
+        b = self.stream_broker
+        if b is None or not self._stream_reqs:
+            return
+        for rid, rr in list(self._stream_reqs.items()):
+            gen = rr.generated
+            cur = self._stream_cursors.get(rid, 0)
+            for i in range(cur, len(gen)):
+                b.publish(rid, i, gen[i])
+            if len(gen) > cur:
+                self._stream_cursors[rid] = len(gen)
+            if rr.finished:
+                b.finish(rid, rr.finish_reason or "")
+                self._stream_reqs.pop(rid, None)
+                self._stream_cursors.pop(rid, None)
+
+    def _resolve_request(self, which) -> Optional[RouterRequest]:
+        """The RouterRequest for a proxy or rid (None if unknown)."""
+        if isinstance(which, RouterRequest):
+            return which
+        rid = int(which)
+        for rr in self.router.requests:
+            if rr.rid == rid:
+                return rr
+        return None
+
+    def stream(self, req_or_rid, callback: Optional[Callable] = None
+               ) -> TokenStream:
+        """The per-token stream for a routed request — the fleet
+        front door's delivery surface (same contract as
+        :meth:`InferenceServer.stream`, keyed by the stable ``rid``).
+        Opening late backfills; the stream survives failover and
+        hand-off and ends with a terminal event carrying the
+        ``finish_reason``."""
+        with (self._ops_lock or _NO_LOCK):
+            if self.stream_broker is None:
+                raise RuntimeError(
+                    "streaming is disabled (enable_streaming=False)")
+            rr = self._resolve_request(req_or_rid)
+            if rr is None:
+                raise KeyError(
+                    f"no routed request with rid {req_or_rid}")
+            s = self.stream_broker.open(rr.rid, rr, callback)
+            if not rr.finished:
+                self._stream_reqs[rr.rid] = rr
+                self._pump_streams()
+            return s
+
+    def cancel(self, req_or_rid) -> bool:
+        """Cancel a routed request wherever it currently lives (the
+        SSE front door's disconnect hook).  Scans the replicas by the
+        CURRENT inner uid, so a request that moved since submission is
+        still found; idempotent — False for unknown/terminal."""
+        with (self._ops_lock or _NO_LOCK):
+            rr = self._resolve_request(req_or_rid)
+            if rr is None or rr.finished:
+                return False
+            uid = rr.inner.uid
+            for rep in self.replicas:
+                if rep.server.cancel(uid):
+                    self._pump_streams()
+                    return True
+            return False
+
+    def _stream_stats(self) -> dict:
+        """The fleet ``stats()["streams"]`` block: front-door broker
+        counters + fleet-wide cancellation tally."""
+        cancelled = sum(
+            rep.server.failures.count("requests_failed_cancelled")
+            for rep in self.replicas)
+        st = {"enabled": self.stream_broker is not None,
+              "cancelled": cancelled}
+        if self.stream_broker is not None:
+            st.update(self.stream_broker.stats())
+            # bounded per-stream rows (``ops_probe --streams``)
+            st["per_stream"] = self.stream_broker.snapshot()
+        return st
 
     @property
     def has_work(self) -> bool:
@@ -489,4 +591,5 @@ class RouterFleet:
             "pressure": round(self.pressure_gauge.val, 3),
             "pressure_peak": round(self.pressure_gauge.peak, 3),
             "draining": self._draining,
+            "streams": self._stream_stats(),
         }

@@ -40,6 +40,7 @@ import itertools
 import random
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from apex_tpu.serving import reasons
 from apex_tpu.serving.router.policy import AffinityIndex, RouterPolicy
 from apex_tpu.serving.router.replica import Replica
 from apex_tpu.serving.scheduler import Request
@@ -240,7 +241,7 @@ class ReplicaRouter:
                             eos_id=eos_id, priority=int(priority),
                             submitted_at=now)
             inner.finished = True
-            inner.finish_reason = "breaker_open"
+            inner.finish_reason = reasons.BREAKER_OPEN
             inner.finished_at = now
             rr = RouterRequest(inner, None)
             self.requests.append(rr)
@@ -309,9 +310,9 @@ class ReplicaRouter:
         self.events.incr("failovers")
         if self.tracer is not None and self.tracer.enabled:
             self.tracer.instant("router_failover", replica=rep.name)
-        moved, failed = rep.server.evacuate("replica_failed")
+        moved, failed = rep.server.evacuate(reasons.REPLICA_FAILED)
         if failed:
-            self.events.incr("replica_failed", len(failed))
+            self.events.incr(reasons.REPLICA_FAILED, len(failed))
         self.reenqueue(moved, exclude=rep)
 
     def reenqueue(self, reqs: Sequence[Request], *,
@@ -330,7 +331,7 @@ class ReplicaRouter:
             rep, _outcome = self.place(old.prompt, exclude=exclude)
             if rep is None:
                 old.finished = True
-                old.finish_reason = "breaker_open"
+                old.finish_reason = reasons.BREAKER_OPEN
                 old.finished_at = now
                 self.events.incr("reenqueue_unplaced")
                 if rr is not None:
@@ -519,7 +520,7 @@ class ReplicaRouter:
             },
             "reenqueued": self.events.count("reenqueued"),
             "failovers": self.events.count("failovers"),
-            "replica_failed": self.events.count("replica_failed"),
+            "replica_failed": self.events.count(reasons.REPLICA_FAILED),
             # disaggregated prefill -> decode hand-offs
             # (docs/serving.md, "Disaggregated prefill/decode")
             "handoffs": self.events.count("handoffs"),

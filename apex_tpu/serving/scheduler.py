@@ -96,6 +96,7 @@ import numpy as np
 from apex_tpu.observability import NULL_TRACER
 from apex_tpu.ops.sampling import SamplingParams
 from apex_tpu.serving.kv_cache import BlockAllocator
+from apex_tpu.serving import reasons
 from apex_tpu.serving.overload import OverloadPolicy
 from apex_tpu.serving.prefix_cache import ROOT, PrefixCache
 
@@ -214,10 +215,10 @@ class Request:
         self.next_input = int(token)
         if self.eos_id is not None and int(token) == self.eos_id:
             self.finished = True
-            self.finish_reason = "eos"
+            self.finish_reason = reasons.EOS
         elif len(self.generated) >= self.max_new_tokens:
             self.finished = True
-            self.finish_reason = "length"
+            self.finish_reason = reasons.LENGTH
 
     def timeline(self) -> dict:
         """The request's lifecycle timestamps (server clock seconds)
@@ -351,7 +352,7 @@ class Scheduler:
                 raise QueueFullError(
                     f"waiting queue full ({self.max_waiting} "
                     f"requests); request {req.uid} rejected")
-            self.fail(victim, "shed")
+            self.fail(victim, reasons.SHED)
         self.waiting.append(req)
         return req
 
@@ -446,7 +447,7 @@ class Scheduler:
             if not candidates:
                 break
             victim = max(candidates, key=lambda r: (r.priority, r.uid))
-            self.fail(victim, "shed")
+            self.fail(victim, reasons.SHED)
             shed.append(victim)
         return shed
 
@@ -490,7 +491,7 @@ class Scheduler:
             ctx = self._prefill_context(req)
             need = BlockAllocator.blocks_for(len(ctx) + 1, bs)
             if need > pool_blocks:
-                self.fail(req, "capacity")
+                self.fail(req, reasons.CAPACITY)
                 continue
             if self.prefix_cache is not None:
                 with self.tracer.span("prefix_match", uid=req.uid,

@@ -1,0 +1,29 @@
+"""apex_tpu.serving.streaming — per-token delivery (docs/serving.md,
+"Streaming & cancellation").
+
+The server retires each pipelined ``(B,)`` transfer host-side and
+applies tokens request-by-request; :class:`StreamBroker` fans that
+same retire edge out to per-request bounded queues, giving three
+consumer surfaces over one contract (delivered tokens are always a
+byte-identical prefix of the non-streaming ``Request.output``):
+
+- iterator: ``for tok in server.stream(uid): ...`` — blocking, with
+  non-blocking ``drain()`` / bounded ``take(timeout=)`` underneath;
+- callback: ``server.stream(uid, callback=fn)`` — ``fn("token", t)``
+  per token at retire time plus one ``fn("end", finish_reason)``;
+- SSE over HTTP: the ops plane's ``POST /generate`` +
+  ``GET /stream/<uid>`` front door (:mod:`observability.opsplane`),
+  where a broken client socket cancels the request mid-decode
+  (``finish_reason="cancelled"``).
+
+Backpressure contract: queues are bounded (``stream_queue_tokens``);
+a slow consumer drops the OLDEST queued notification instead of ever
+stalling ``step()``, and the stream backfills the dropped range from
+the request's own token list on the next read — so delivery stays
+byte-identical and only the broker's ``backpressure_drops`` counter
+records the lag.
+"""
+
+from apex_tpu.serving.streaming.broker import StreamBroker, TokenStream
+
+__all__ = ["StreamBroker", "TokenStream"]

@@ -138,6 +138,11 @@ def test_ops_endpoints_serve_live_data(tiny, tmp_path):
         assert isinstance(health["pressure"], float)
         assert health["draining"] is False
         assert health["live_requests"] == 0      # idle post-generate
+        # the streaming tier's probe pair (docs/serving.md,
+        # "Streaming & cancellation"): open-stream gauge + lifetime
+        # backpressure drop counter ride the cheap endpoint too
+        assert health["active_streams"] == 0
+        assert health["stream_backpressure_drops"] == 0
 
         code, headers, body = _get(base, "/metrics")
         assert code == 200
@@ -529,3 +534,16 @@ def test_stats_programs_watchdog_ops_blocks_pinned(tiny):
     ops = st["ops"]
     assert set(ops) == {"enabled", "port", "requests"}
     assert ops == {"enabled": False, "port": None, "requests": 0}
+    # the streaming delivery tier (docs/serving.md, "Streaming &
+    # cancellation"): broker counters + bounded per-stream rows on
+    # by default; a disabled server keeps the two-key stub so
+    # dashboards never KeyError on the block
+    streams = st["streams"]
+    assert set(streams) == {"enabled", "cancelled", "active",
+                            "opened", "published_tokens",
+                            "backpressure_drops", "finished",
+                            "queue_tokens", "per_stream"}
+    assert streams["enabled"] is True
+    assert streams["cancelled"] == 0 and streams["active"] == 0
+    off = _server(cfg, params, enable_streaming=False).stats()["streams"]
+    assert off == {"enabled": False, "cancelled": 0}

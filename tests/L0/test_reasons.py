@@ -1,0 +1,154 @@
+"""Finish-reason constants: one module, exhaustive, drift-pinned.
+
+``apex_tpu.serving.reasons`` is the canonical constants module for
+every terminal ``finish_reason`` the stack can assign (it imports
+NOTHING, so any layer — serving, resilience, observability-adjacent
+tools — can name a reason without an import cycle).  These tests keep
+it honest:
+
+- set algebra: healthy ⊂ terminal ⊂ router-terminal == all, values
+  unique and lower_snake;
+- exhaustiveness: an AST scan of the whole ``apex_tpu`` tree finds NO
+  stray finish-reason string literal at an assignment / ``fail()`` /
+  comparison site outside the constants module and its documented
+  mirrors — new reasons must land in ``reasons.py`` first;
+- re-export identity: ``resilience.chaos`` re-exports the canonical
+  frozensets (the soak's invariants and the constants can never
+  disagree);
+- mirror pins: ``observability.slo`` cannot import serving (it sits
+  below it in the import graph), so its duplicated sets/singletons
+  are asserted equal to the canonical values here.
+"""
+
+import ast
+import os
+
+import pytest
+
+from apex_tpu.observability import slo
+from apex_tpu.resilience import chaos
+from apex_tpu.serving import reasons
+
+pytestmark = pytest.mark.serving
+
+APEX = os.path.join(
+    os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "apex_tpu")
+
+CONSTANT_NAMES = [
+    "EOS", "LENGTH", "CAPACITY", "TIMEOUT", "NONFINITE", "REJECTED",
+    "SHED", "BREAKER_OPEN", "DRAINING", "CANCELLED", "HANDOFF",
+    "REPLICA_FAILED",
+]
+
+
+def test_set_algebra_and_values():
+    values = [getattr(reasons, n) for n in CONSTANT_NAMES]
+    assert len(set(values)) == len(values), "duplicate reason values"
+    for v in values:
+        assert v == v.lower() and " " not in v, v
+    assert reasons.HEALTHY_REASONS == {reasons.EOS, reasons.LENGTH}
+    assert reasons.HEALTHY_REASONS < reasons.TERMINAL_REASONS
+    assert reasons.TERMINAL_REASONS < reasons.ROUTER_TERMINAL_REASONS
+    assert reasons.ROUTER_TERMINAL_REASONS == reasons.ALL_REASONS
+    assert set(values) == set(reasons.ALL_REASONS), (
+        "every named constant is a member of ALL_REASONS and "
+        "vice versa")
+
+
+def test_reasons_module_imports_nothing():
+    # the cycle-safety contract: the module must stay import-free so
+    # ANY layer can use it (chaos <-> serving both directions)
+    path = os.path.join(APEX, "serving", "reasons.py")
+    with open(path) as f:
+        tree = ast.parse(f.read())
+    imports = [n for n in ast.walk(tree)
+               if isinstance(n, (ast.Import, ast.ImportFrom))]
+    assert not imports, "reasons.py must import nothing"
+
+
+def test_chaos_reexports_are_the_canonical_objects():
+    assert chaos.HEALTHY_REASONS is reasons.HEALTHY_REASONS
+    assert chaos.TERMINAL_REASONS is reasons.TERMINAL_REASONS
+    assert chaos.ROUTER_TERMINAL_REASONS is \
+        reasons.ROUTER_TERMINAL_REASONS
+
+
+def test_slo_mirrors_pinned_to_canonical_values():
+    # slo.py documents WHY it cannot import serving; this is the pin
+    # that keeps the duplicates from drifting
+    assert slo.HEALTHY_REASONS == reasons.HEALTHY_REASONS
+    assert slo.SHED == reasons.SHED
+    assert slo.TIMEOUT == reasons.TIMEOUT
+    assert slo.REFUSED_REASONS <= reasons.ROUTER_TERMINAL_REASONS
+
+
+def _literal_reason_sites(path):
+    """Finish-reason string literals at decision sites in one file:
+    ``x.finish_reason = "lit"``, ``x.finish_reason == "lit"`` (or
+    ``in ("lit", ...)``), and ``*.fail(req, "lit")``."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+
+    def is_fr(node):
+        return (isinstance(node, ast.Attribute)
+                and node.attr == "finish_reason")
+
+    sites = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            if any(is_fr(t) for t in node.targets) and \
+                    isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, str):
+                sites.append((node.lineno, node.value.value))
+        elif isinstance(node, ast.Compare):
+            if is_fr(node.left):
+                for comp in node.comparators:
+                    if isinstance(comp, ast.Constant) and \
+                            isinstance(comp.value, str):
+                        sites.append((node.lineno, comp.value))
+                    elif isinstance(comp, (ast.Tuple, ast.List,
+                                           ast.Set)):
+                        for el in comp.elts:
+                            if isinstance(el, ast.Constant) and \
+                                    isinstance(el.value, str):
+                                sites.append((node.lineno, el.value))
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr == "fail":
+                for arg in node.args[1:2]:
+                    if isinstance(arg, ast.Constant) and \
+                            isinstance(arg.value, str):
+                        sites.append((node.lineno, arg.value))
+    return sites
+
+
+def test_no_stray_finish_reason_literals_in_product_code():
+    """Exhaustiveness: every finish-reason decision site in apex_tpu
+    names a constant, not a string — except the constants module
+    itself and slo.py's documented (and pinned, above) mirrors."""
+    exempt = {
+        os.path.join(APEX, "serving", "reasons.py"),
+        os.path.join(APEX, "observability", "slo.py"),
+    }
+    offenders = []
+    for root, _dirs, files in os.walk(APEX):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            if path in exempt:
+                continue
+            for lineno, lit in _literal_reason_sites(path):
+                offenders.append(f"{path}:{lineno}: {lit!r}")
+    assert not offenders, (
+        "finish-reason string literal(s) outside "
+        "apex_tpu/serving/reasons.py — use the constants module:\n"
+        + "\n".join(offenders))
+
+
+def test_slo_mirror_literals_are_members():
+    """Even the exempt mirror file may only use KNOWN reasons."""
+    path = os.path.join(APEX, "observability", "slo.py")
+    for lineno, lit in _literal_reason_sites(path):
+        assert lit in reasons.ALL_REASONS, f"{path}:{lineno}: {lit!r}"

@@ -540,7 +540,8 @@ def test_pinned_router_stats_block(tiny):
     assert set(st) == {
         "router", "requests_finished", "requests_unplaced",
         "tokens_generated", "prefix_hit_tokens", "prefix_miss_tokens",
-        "prefix_hit_rate", "pressure", "pressure_peak", "draining"}
+        "prefix_hit_rate", "pressure", "pressure_peak", "draining",
+        "streams"}
     r = st["router"]
     assert set(r) == {
         "replicas", "alive", "policy", "placements", "affinity",

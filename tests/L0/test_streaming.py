@@ -1,0 +1,640 @@
+"""Streaming delivery & disconnect cancellation (docs/serving.md,
+"Streaming & cancellation").
+
+The acceptance oracles of the streaming subsystem:
+
+- **byte-identity**: the delivered stream — bounded queue, drops,
+  late opens, failover moves and all — equals ``Request.output``
+  exactly, for greedy AND counter-keyed stochastic traffic;
+- **cancellation**: a client hang-up mid-decode frees every KV
+  block, lookahead grant, and in-flight hold immediately
+  (``finish_reason="cancelled"``), audit-clean at every step, at
+  every point of the request lifecycle (queued, mid-prefill-chunk,
+  launched-but-unretired, already-terminal);
+- **front door**: ``POST /generate`` + ``GET /stream/<id>`` serve
+  SSE over real HTTP, and a broken client socket cancels;
+- the broker itself: bounded fan-out with drop-oldest + backfill,
+  index dedup, terminal absorption, self-pruning.
+
+Tier budget: the tier-1 suite's 870 s wall budget is saturated, so
+the costliest non-acceptance-critical tests here (the fleet trio,
+stochastic identity, the iterator/error surfaces) are ``slow``-marked
+— the build-matrix ``streaming`` axis runs this file WITHOUT the
+marker filter, so they gate every build anyway.
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import models
+from apex_tpu.resilience.chaos import ReplicaKillSwitch
+from apex_tpu.serving import (
+    InferenceServer,
+    RouterFleet,
+    SamplingParams,
+    reasons,
+)
+from apex_tpu.serving.streaming import StreamBroker
+
+pytestmark = pytest.mark.serving
+
+VOCAB = 61
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = models.GPTConfig(
+        vocab_size=VOCAB, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=128, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+    m = models.GPTLMHeadModel(cfg)
+    params = m.init(jax.random.PRNGKey(1),
+                    jnp.ones((1, 8), jnp.int32))["params"]
+    return cfg, params
+
+
+def _server(cfg, params, **kw):
+    kw.setdefault("cache_dtype", jnp.float32)
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_context", 128)
+    kw.setdefault("block_size", 8)
+    return InferenceServer(cfg, params, **kw)
+
+
+def _prompts(seed, n, lo=4, hi=12):
+    rng = np.random.RandomState(seed)
+    return [list(rng.randint(0, VOCAB, size=int(rng.randint(lo, hi))))
+            for _ in range(n)]
+
+
+def _run_audited(server):
+    while server.has_work:
+        server.step()
+        server.audit()
+
+
+# -- the broker alone (no model) -------------------------------------------
+
+
+class FakeReq:
+    def __init__(self):
+        self.generated = []
+        self.finished = False
+        self.finish_reason = None
+
+
+def test_broker_order_dedup_and_terminal():
+    b = StreamBroker()
+    req = FakeReq()
+    s = b.open(7, req)
+    assert b.open(7, req) is s, "re-open returns the same cursor"
+    for i, tok in enumerate([10, 11, 12]):
+        req.generated.append(tok)
+        b.publish(7, i, tok)
+    b.publish(7, 0, 10)            # failover replay: already fanned out
+    b.publish(7, 1, 11)
+    assert s.drain() == [10, 11, 12]
+    assert b.published_tokens == 3, "dedup'd replays never count"
+    req.finished, req.finish_reason = True, reasons.LENGTH
+    b.finish(7, reasons.LENGTH)
+    assert s.drain() == [] and s.done
+    assert s.finish_reason == reasons.LENGTH
+    assert b.active == 0, "delivered-terminal streams self-prune"
+
+
+def test_broker_bounded_queue_drops_oldest_and_backfills():
+    b = StreamBroker(queue_tokens=2)
+    req = FakeReq()
+    s = b.open(1, req)
+    for i in range(6):             # nobody draining: 4 must drop
+        req.generated.append(30 + i)
+        b.publish(1, i, 30 + i)
+    assert b.backpressure_drops == 4 and s.drops == 4
+    # delivery backfills the dropped gap from the request itself:
+    # the stream is STILL byte-identical
+    assert s.drain() == [30, 31, 32, 33, 34, 35]
+
+
+def test_broker_late_open_backfills_everything():
+    b = StreamBroker()
+    req = FakeReq()
+    req.generated = [5, 6, 7]
+    req.finished, req.finish_reason = True, reasons.EOS
+    s = b.open(3, req)             # opened after the request finished
+    assert s.drain() == [5, 6, 7]
+    assert s.finish_reason == reasons.EOS
+
+
+def test_broker_callback_streams_never_drop():
+    b = StreamBroker(queue_tokens=1)
+    req = FakeReq()
+    events = []
+    b.open(9, req, callback=lambda kind, v: events.append((kind, v)))
+    for i in range(5):
+        req.generated.append(40 + i)
+        b.publish(9, i, 40 + i)    # delivered inline: bound bypassed
+    req.finished, req.finish_reason = True, reasons.LENGTH
+    b.finish(9, reasons.LENGTH)
+    assert events == [("token", 40), ("token", 41), ("token", 42),
+                      ("token", 43), ("token", 44),
+                      ("end", reasons.LENGTH)]
+    assert b.backpressure_drops == 0
+
+
+def test_broker_close_detaches_and_snapshot_rows():
+    b = StreamBroker()
+    req = FakeReq()
+    s = b.open(4, req)
+    req.generated.append(1)
+    b.publish(4, 0, 1)
+    rows = b.snapshot()
+    assert rows == [{"key": 4, "delivered": 0, "queued": 1,
+                     "drops": 0, "terminal": None}]
+    s.close()
+    s.close()                      # idempotent
+    assert b.active == 0
+    b.publish(4, 1, 2)             # post-close publish: no-op
+    assert b.published_tokens == 1
+
+
+# -- single server: delivery byte-identity ---------------------------------
+
+
+def test_stream_byte_identity_greedy(tiny):
+    cfg, params = tiny
+    server = _server(cfg, params)
+    reqs = [server.submit(p, 24) for p in _prompts(0, 6)]
+    streams = [server.stream(r.uid) for r in reqs]
+    got = [[] for _ in reqs]
+    while server.has_work:
+        server.step()
+        server.audit()
+        for i, s in enumerate(streams):
+            got[i].extend(s.drain())
+    for i, (r, s) in enumerate(zip(reqs, streams)):
+        got[i].extend(s.drain())
+        assert got[i] == list(r.generated), f"stream {r.uid} diverged"
+        assert s.finish_reason == r.finish_reason
+    assert server.stream_broker.active == 0
+
+
+@pytest.mark.slow
+def test_stream_byte_identity_stochastic(tiny):
+    """Counter-keyed draws make every sampled stream a pure function
+    of (prompt, params, seed) — delivery must not disturb that."""
+    cfg, params = tiny
+    server = _server(cfg, params)
+    prompts = _prompts(1, 4)
+    samp = [SamplingParams(temperature=0.8, top_p=0.9, seed=i + 1)
+            for i in range(len(prompts))]
+    ref = server.generate(prompts, max_new_tokens=20, sampling=samp)
+    reqs = [server.submit(p, 20, sampling=sp)
+            for p, sp in zip(prompts, samp)]
+    streams = [server.stream(r.uid) for r in reqs]
+    got = [[] for _ in reqs]
+    while server.has_work:
+        server.step()
+        server.audit()
+        for i, s in enumerate(streams):
+            got[i].extend(s.drain())
+    for i, (r, s) in enumerate(zip(reqs, streams)):
+        got[i].extend(s.drain())
+        assert got[i] == list(r.generated) == ref[i], \
+            "sampled stream must replay bit-identically"
+
+
+def test_stream_backpressure_still_byte_identical(tiny):
+    """A consumer that never drains until the end overflows the tiny
+    queue — drops are counted, and the final drain backfills to the
+    exact output anyway (the bounded-delivery contract)."""
+    cfg, params = tiny
+    server = _server(cfg, params, stream_queue_tokens=2)
+    req = server.submit([1, 2, 3], 24)
+    s = server.stream(req)
+    _run_audited(server)
+    assert len(req.generated) > 2
+    got = s.drain()
+    assert got == list(req.generated)
+    assert s.drops > 0
+    assert server.stats()["streams"]["backpressure_drops"] == s.drops
+
+
+@pytest.mark.slow
+def test_stream_iterator_surface_from_consumer_thread(tiny):
+    cfg, params = tiny
+    server = _server(cfg, params)
+    req = server.submit([3, 1, 4, 1], 16)
+    stream = server.stream(req.uid)
+    got, done = [], threading.Event()
+
+    def consume():
+        for tok in stream:
+            got.append(tok)
+        done.set()
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    _run_audited(server)
+    assert done.wait(timeout=30.0), "iterator never saw the terminal"
+    t.join(timeout=5.0)
+    assert got == list(req.generated)
+    assert stream.finish_reason == req.finish_reason
+
+
+def test_stream_callback_surface(tiny):
+    cfg, params = tiny
+    server = _server(cfg, params)
+    req = server.submit([9, 8, 7], 12)
+    events = []
+    server.stream(req, callback=lambda k, v: events.append((k, v)))
+    _run_audited(server)
+    assert events[-1] == ("end", req.finish_reason)
+    assert [v for k, v in events if k == "token"] \
+        == list(req.generated)
+
+
+@pytest.mark.slow
+def test_stream_unknown_uid_and_disabled(tiny):
+    cfg, params = tiny
+    server = _server(cfg, params)
+    with pytest.raises(KeyError):
+        server.stream(10**9)
+    off = _server(cfg, params, enable_streaming=False)
+    r = off.submit([1, 2], 4)
+    with pytest.raises(RuntimeError, match="enable_streaming"):
+        off.stream(r.uid)
+    _run_audited(off)
+
+
+# -- cancellation edges (every step audited) -------------------------------
+
+
+def test_cancel_while_queued_holds_nothing(tiny):
+    """A queued request owns no blocks; cancel just removes it —
+    and the running batch is untouched."""
+    cfg, params = tiny
+    server = _server(cfg, params, max_batch_size=2)
+    reqs = [server.submit(p, 16) for p in _prompts(2, 4)]
+    server.step()                  # admit 2, leave 2 queued
+    server.audit()
+    queued = [r for r in reqs if not r.running and not r.finished]
+    assert queued, "expected queued overflow"
+    victim = queued[0]
+    assert len(victim.generated) == 0
+    assert server.cancel(victim.uid) is True
+    server.audit()
+    assert victim.finished and \
+        victim.finish_reason == reasons.CANCELLED
+    _run_audited(server)
+    for r in reqs:
+        if r is not victim:
+            assert r.finish_reason in reasons.HEALTHY_REASONS
+
+
+def test_cancel_between_prefill_chunks_frees_partial_blocks(tiny):
+    """Mid-chunked-prefill the request holds blocks but has sampled
+    nothing; cancel must free the partial prefix immediately."""
+    cfg, params = tiny
+    server = _server(cfg, params, prefill_chunk=8,
+                     enable_pipeline=False)
+    long_prompt = list(np.random.RandomState(3).randint(
+        0, VOCAB, size=40))
+    req = server.submit(long_prompt, 8)
+    server.step()                  # first chunk only (40 > 8)
+    server.audit()
+    assert not req.finished and len(req.generated) == 0, \
+        "must still be mid-prefill"
+    assert server.stats()["memory"]["blocks_live"] > 0
+    assert server.cancel(req.uid) is True
+    server.audit()
+    assert req.finish_reason == reasons.CANCELLED
+    assert server.stats()["memory"]["blocks_live"] == 0, \
+        "partial prefill blocks must free at cancel"
+    _run_audited(server)
+
+
+def test_cancel_during_inflight_launch(tiny):
+    """Cancel with a launched-but-unretired pipeline window: the
+    window flushes first (write-safety), then the request fails and
+    frees — no token of it may apply afterwards."""
+    cfg, params = tiny
+    server = _server(cfg, params, enable_pipeline=True)
+    req = server.submit([2, 7, 1, 8], 100)
+    for _ in range(2):
+        server.step()
+        server.audit()
+    assert not req.finished
+    assert server.cancel(req.uid) is True
+    server.audit()
+    assert req.finish_reason == reasons.CANCELLED
+    n = len(req.generated)
+    _run_audited(server)
+    assert len(req.generated) == n, \
+        "no token may apply after cancellation"
+    assert server.failures.count("requests_failed_cancelled") == 1
+
+
+def test_double_cancel_is_idempotent(tiny):
+    cfg, params = tiny
+    server = _server(cfg, params)
+    req = server.submit([5, 5, 5], 100)
+    server.step()
+    server.audit()
+    assert server.cancel(req.uid) is True
+    assert server.cancel(req.uid) is False, \
+        "second cancel: idempotent no-op"
+    server.audit()
+    assert req.finish_reason == reasons.CANCELLED
+    assert server.cancel(10**9) is False, "unknown uid: False"
+    assert server.failures.count("requests_failed_cancelled") == 1
+    _run_audited(server)
+
+
+def test_cancel_reclaims_capacity_for_new_work(tiny):
+    """The bench's cancellation arm at L0 scale: fill a small pool,
+    hang up on everything, and a fresh batch must run to a healthy
+    finish on the reclaimed blocks."""
+    cfg, params = tiny
+    bps = -(-128 // 8)
+    server = _server(cfg, params, max_batch_size=2,
+                     num_blocks=2 * bps + 1)
+    first = [server.submit(p, 60) for p in _prompts(4, 2)]
+    streams = [server.stream(r) for r in first]
+    for _ in range(3):
+        server.step()
+        server.audit()
+    for s, r in zip(streams, first):
+        s.close()
+        assert server.cancel(r.uid) is True
+    server.audit()
+    assert server.stats()["memory"]["blocks_live"] == 0
+    second = [server.submit(p, 16) for p in _prompts(5, 2)]
+    _run_audited(server)
+    for r in second:
+        assert r.finish_reason in reasons.HEALTHY_REASONS, \
+            f"reclaimed pool must serve new work, got " \
+            f"{r.finish_reason}"
+
+
+def test_cancel_mid_prefill_on_disagg_server(tiny):
+    """Cancellation reaches the PREFILL pool too: a request still
+    prefilling in the separate pool cancels and frees there."""
+    cfg, params = tiny
+    server = _server(cfg, params, enable_disagg=True,
+                     prefill_chunk=8, enable_pipeline=False)
+    long_prompt = list(np.random.RandomState(6).randint(
+        0, VOCAB, size=40))
+    req = server.submit(long_prompt, 8)
+    server.step()
+    server.audit()
+    assert not req.finished
+    assert server.cancel(req.uid) is True
+    server.audit()
+    assert req.finish_reason == reasons.CANCELLED
+    st = server.stats()
+    assert st["memory"]["blocks_live"] == 0
+    assert st["disagg"]["prefill_blocks_live"] == 0, \
+        "prefill-pool blocks must free at cancel"
+    _run_audited(server)
+
+
+# -- fleet front door ------------------------------------------------------
+
+
+def _fleet(cfg, params, n=3, **kw):
+    kw.setdefault("cache_dtype", jnp.float32)
+    kw.setdefault("max_batch_size", 2)
+    kw.setdefault("max_context", 128)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("enable_speculation", False)
+    return RouterFleet(cfg, params, replicas=n, **kw)
+
+
+def _run_fleet_audited(fleet):
+    while fleet.has_work:
+        fleet.step()
+        for rep in fleet.replicas:
+            rep.server.scheduler.audit()
+
+
+@pytest.mark.slow
+def test_fleet_stream_byte_identity(tiny):
+    cfg, params = tiny
+    fleet = _fleet(cfg, params)
+    rrs = [fleet.submit(p, 24) for p in _prompts(7, 5)]
+    streams = [fleet.stream(rr) for rr in rrs]
+    got = [[] for _ in rrs]
+    while fleet.has_work:
+        fleet.step()
+        for rep in fleet.replicas:
+            rep.server.scheduler.audit()
+        for i, s in enumerate(streams):
+            got[i].extend(s.drain())
+    for i, (rr, s) in enumerate(zip(rrs, streams)):
+        got[i].extend(s.drain())
+        assert got[i] == list(rr.generated), \
+            f"fleet stream {rr.rid} diverged"
+        assert s.finish_reason == rr.finish_reason
+    assert fleet.stream_broker.active == 0
+    late = fleet.stream(rrs[0].rid)   # re-open by rid, post-terminal
+    assert late.drain() == list(rrs[0].generated), \
+        "late re-open by rid backfills the whole output"
+    assert late.finish_reason == rrs[0].finish_reason
+    fleet.close()
+
+
+@pytest.mark.slow
+def test_fleet_stream_survives_failover_deduplicated(tiny):
+    """The front-door contract: streams key on the stable rid, so a
+    replica kill mid-stream re-enqueues the request, the survivor
+    regenerates its prefix bit-identically, and the broker's index
+    dedup means the CONSUMER sees every token exactly once."""
+    cfg, params = tiny
+    fleet = _fleet(cfg, params)
+    kills = []
+    for rep in fleet.replicas:
+        kill = ReplicaKillSwitch(rep.server.engine)
+        rep.server.engine = kill
+        kills.append(kill)
+    rrs = [fleet.submit(p, 32) for p in _prompts(8, 9, lo=5, hi=14)]
+    streams = [fleet.stream(rr) for rr in rrs]
+    got = [[] for _ in rrs]
+    for _ in range(3):
+        fleet.step()
+        for i, s in enumerate(streams):
+            got[i].extend(s.drain())
+    victim = next(i for i, rep in enumerate(fleet.replicas)
+                  if rep.server.scheduler.num_waiting
+                  and rep.server.scheduler.num_running)
+    kills[victim].dead = True
+    while fleet.has_work:
+        fleet.step()
+        for rep in fleet.replicas:
+            rep.server.scheduler.audit()
+        for i, s in enumerate(streams):
+            got[i].extend(s.drain())
+    assert fleet.stats()["router"]["failovers"] >= 1
+    moved = 0
+    for i, (rr, s) in enumerate(zip(rrs, streams)):
+        got[i].extend(s.drain())
+        assert got[i] == list(rr.generated), \
+            (f"stream {rr.rid} ({rr.finish_reason}) delivered "
+             f"{len(got[i])} != output {len(rr.generated)} — "
+             f"failover must not duplicate or lose tokens")
+        assert s.finish_reason == rr.finish_reason
+        if rr.moves and rr.finish_reason == reasons.LENGTH:
+            moved += 1
+    assert moved >= 1, "no stream actually survived a move"
+    fleet.close()
+
+
+@pytest.mark.slow
+def test_fleet_cancel_by_rid(tiny):
+    cfg, params = tiny
+    fleet = _fleet(cfg, params, n=2)
+    rrs = [fleet.submit(p, 100) for p in _prompts(9, 3)]
+    fleet.stream(rrs[0])
+    for _ in range(2):
+        fleet.step()
+    assert fleet.cancel(rrs[0].rid) is True
+    assert rrs[0].finish_reason == reasons.CANCELLED
+    assert fleet.cancel(rrs[0].rid) is False, "idempotent"
+    assert fleet.cancel(10**9) is False
+    _run_fleet_audited(fleet)
+    st = fleet.stats()["streams"]
+    assert st["cancelled"] == 1
+    fleet.close()
+
+
+# -- the SSE front door over real HTTP -------------------------------------
+
+
+def _post(base, path, payload):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_sse_generate_stream_and_disconnect_cancel(tiny):
+    cfg, params = tiny
+    server = _server(cfg, params, ops_port=0)
+    try:
+        port = server.ops.port
+        base = f"http://127.0.0.1:{port}"
+
+        # -- happy path: POST /generate then consume the SSE stream
+        code, out = _post(base, "/generate",
+                          {"prompt": [1, 2, 3], "max_new_tokens": 12})
+        assert code == 200 and out["finished"] is False
+        sid = out["id"]
+        events, done = [], threading.Event()
+
+        def consume():
+            with urllib.request.urlopen(f"{base}/stream/{sid}",
+                                        timeout=30) as r:
+                kind = None
+                for raw in r:
+                    line = raw.decode().strip()
+                    if line.startswith("event: "):
+                        kind = line[7:]
+                    elif line.startswith("data: "):
+                        events.append((kind, line[6:]))
+                        if kind == "end":
+                            done.set()
+                            return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 30
+        while not done.is_set() and time.monotonic() < deadline:
+            if server.has_work:
+                server.step()
+                server.audit()
+            else:
+                time.sleep(0.01)
+        assert done.is_set(), "SSE consumer never saw the end event"
+        t.join(timeout=5.0)
+        req = server._find_request(sid)
+        toks = [int(v) for k, v in events if k == "token"]
+        assert toks == list(req.generated), \
+            "SSE delivery must be byte-identical"
+        assert events[-1] == ("end", req.finish_reason)
+
+        # -- disconnect mid-stream cancels the request
+        code, out = _post(base, "/generate",
+                          {"prompt": [4, 5, 6],
+                           "max_new_tokens": 100})
+        sid2 = out["id"]
+        req2 = server._find_request(sid2)
+        sock = socket.create_connection(("127.0.0.1", port),
+                                        timeout=10)
+        sock.sendall(f"GET /stream/{sid2} HTTP/1.1\r\n"
+                     f"Host: 127.0.0.1\r\n\r\n".encode())
+        for _ in range(3):          # a few tokens flow to the client
+            server.step()
+            server.audit()
+        sock.recv(4096)
+        sock.close()                # the client hangs up
+        deadline = time.monotonic() + 30
+        while not req2.finished and time.monotonic() < deadline:
+            if server.has_work:
+                server.step()
+                server.audit()
+            else:
+                time.sleep(0.01)
+        assert req2.finished and \
+            req2.finish_reason == reasons.CANCELLED, \
+            (f"disconnect must cancel, got {req2.finish_reason!r}")
+        server.audit()
+        _run_audited(server)
+    finally:
+        server.close()
+
+
+def test_sse_stream_error_statuses(tiny):
+    cfg, params = tiny
+    server = _server(cfg, params, ops_port=0)
+    try:
+        base = f"http://127.0.0.1:{server.ops.port}"
+
+        def get_code(path):
+            try:
+                return urllib.request.urlopen(base + path,
+                                              timeout=10).status
+            except urllib.error.HTTPError as e:
+                return e.code
+
+        assert get_code("/stream/999999") == 404
+        assert get_code("/stream/abc") == 400
+        code, _ = _post(base, "/generate", {"max_new_tokens": 4})
+        assert code == 400, "missing prompt"
+    finally:
+        server.close()
+    off = _server(cfg, params, enable_streaming=False, ops_port=0)
+    try:
+        base = f"http://127.0.0.1:{off.ops.port}"
+        code, _ = _post(base, "/generate",
+                        {"prompt": [1], "max_new_tokens": 4})
+        assert code == 409, "streaming disabled gates /generate"
+        try:
+            code = urllib.request.urlopen(f"{base}/stream/1",
+                                          timeout=10).status
+        except urllib.error.HTTPError as e:
+            code = e.code
+        assert code == 409
+    finally:
+        off.close()

@@ -265,6 +265,33 @@ env JAX_PLATFORMS=cpu python -m pytest tests/L0/test_disagg.py -q -x --no-header
   && env JAX_PLATFORMS=cpu python tools/chaos_soak.py --seed 0 --iters 800 --disagg
 results[disagg]=$?
 
+# streaming delivery & disconnect cancellation (docs/serving.md,
+# "Streaming & cancellation") — three gates:
+#   1. the L0 streaming tier: broker order/dedup/bounding/backfill,
+#      byte-identical delivery greedy + counter-keyed stochastic,
+#      every cancellation edge (queued / between-prefill-chunks /
+#      inflight-launch / double-cancel) audit-clean, fleet streams
+#      deduplicated across a forced failover, the SSE front door +
+#      disconnect-cancel over real HTTP, and the finish-reason
+#      constants exhaustiveness scan;
+#   2. serving_bench --streaming: delivered-ITL p99 within 1.1x of
+#      the polling baseline (delivery fan-out must be noise), plus
+#      the cancellation capacity arm — hang up on a full pool
+#      mid-decode, blocks_live must hit 0, and a fresh batch must
+#      finish healthy on the reclaimed blocks;
+#   3. an 800-iteration seed-0 chaos soak with streams opened per
+#      request and the client-disconnect fault class armed, against
+#      the non-streaming bit-exact replay oracle — disconnected
+#      streams deliver an exact prefix and end "cancelled",
+#      everything else byte-identical (legacy arms above pin
+#      enable_streaming=False, so their seeds stay valid).
+echo "=== build-matrix axis: streaming ==="
+env JAX_PLATFORMS=cpu python -m pytest tests/L0/test_streaming.py \
+      tests/L0/test_reasons.py -q -x --no-header \
+  && env JAX_PLATFORMS=cpu python tools/serving_bench.py --smoke --streaming --out - \
+  && env JAX_PLATFORMS=cpu python tools/chaos_soak.py --seed 0 --iters 800 --streaming
+results[streaming]=$?
+
 # chaos soak: the overload-robustness axis (docs/resilience.md,
 # "Overload policy & lifecycle") — the full serving stack (prefix
 # cache + chunked prefill + overload control + circuit breaker, small

@@ -38,6 +38,16 @@ quantized grid, and any divergence means a quantized block's bytes or
 scales were corrupted by a lifecycle path (COW, eviction, rollback,
 preemption re-prefill) rather than by the quantization itself.
 
+``--streaming`` soaks the streaming delivery tier (``docs/serving.md``,
+"Streaming & cancellation"): every submitted request gets a per-token
+stream opened at submit and drained each iteration, the delivered
+sequence must be byte-identical to the request's final output, and the
+client-DISCONNECT fault class is armed — a live stream is torn down
+mid-decode and its request cancelled, which must free every KV block
+and scheduler hold audit-clean and retire the request ``cancelled``.
+Legacy arms pin ``enable_streaming=False`` (and the replay oracle
+never streams), so their per-seed reports stay byte-identical.
+
 The soaked server always runs with a step-level ``FlightRecorder``
 (``docs/observability.md``, "Flight recorder & postmortems") —
 recording never feeds back into scheduler decisions, so the soak's
@@ -222,6 +232,19 @@ def main(argv=None) -> int:
                         "proves quantized blocks survive every "
                         "composed fault (docs/serving.md, "
                         "'Quantized KV cache')")
+    parser.add_argument("--streaming", action="store_true",
+                        help="soak the STREAMING delivery tier "
+                        "(docs/serving.md, 'Streaming & "
+                        "cancellation'): every submitted request "
+                        "gets a per-token stream opened at submit, "
+                        "drained every iteration, and checked "
+                        "byte-identical against the request's final "
+                        "output — with the client-DISCONNECT fault "
+                        "class armed (streams torn down mid-decode "
+                        "cancel their requests, which must free "
+                        "every block and hold audit-clean).  Legacy "
+                        "arms pin enable_streaming=False so their "
+                        "seed-0 reports stay byte-identical")
     parser.add_argument("--tp", type=int, default=None, metavar="N",
                         help="soak a TENSOR-PARALLEL server: shard "
                         "the soaked server over an N-device mesh "
@@ -355,6 +378,9 @@ def main(argv=None) -> int:
             enable_disagg=args.disagg,
             enable_speculation=args.speculative,
             enable_pipeline=args.pipeline,
+            # --streaming soaks the delivery tier; legacy arms pin it
+            # OFF so their per-seed reports stay byte-identical
+            enable_streaming=args.streaming,
             flight_recorder=FlightRecorder(
                 capacity=max(4096, 2 * args.iters)),
             watchdog=HangWatchdog(deadline_s=args.watchdog_deadline,
@@ -379,7 +405,10 @@ def main(argv=None) -> int:
             kv_quant="int8" if args.kv_quant else None,
             enable_disagg=False,
             enable_speculation=args.speculative,
-            enable_pipeline=args.pipeline)
+            enable_pipeline=args.pipeline,
+            # the oracle never streams: delivery is observation-only,
+            # so replayed tokens must match with the tier absent
+            enable_streaming=False)
 
     chaos_cfg = ChaosConfig(
         iters=args.iters, vocab=VOCAB,
@@ -397,6 +426,9 @@ def main(argv=None) -> int:
         # prefix of the blocks really moves before the failure)
         handoff_oom_rate=0.03 if args.disagg else 0.0,
         handoff_torn_rate=0.02 if args.disagg else 0.0,
+        # --streaming arms the client-disconnect fault class: a live
+        # stream is torn down mid-decode and its request cancelled
+        disconnect_rate=0.03 if args.streaming else 0.0,
         force_violation_iter=args.force_violation)
     t0 = time.perf_counter()
     report = run_soak(make_server, chaos_cfg, args.seed,
@@ -407,6 +439,7 @@ def main(argv=None) -> int:
     report["kv_quant"] = "int8" if args.kv_quant else None
     report["sampling_traffic"] = bool(args.sampling)
     report["disagg_mode"] = bool(args.disagg)
+    report["streaming_mode"] = bool(args.streaming)
 
     line = json.dumps(report, indent=2, sort_keys=True)
     if args.out == "-":

@@ -18,6 +18,14 @@ Pure stdlib, so it runs anywhere a shell does:
     compiles, total/compile wall ms, and the steady-state per-call
     ms per program key ("where does the step go").
 
+``--streams``
+    Render the streaming tier's ``/statusz`` block
+    (``docs/serving.md``, "Streaming & cancellation"): the broker
+    counters (opened / published / backpressure drops / cancelled)
+    and a per-open-stream table — delivered cursor, queued tokens,
+    drops, terminal flag.  A server without the streams block FAILs
+    (exit 1); streaming disabled prints one summary line.
+
 ``--flight N`` / ``--request UID`` / ``--statusz`` / ``--metrics``
     Raw views of the corresponding endpoints.
 
@@ -164,6 +172,39 @@ def render_programs(stats) -> None:
           f"compile {prog.get('total_compile_ms')}ms")
 
 
+def render_streams(stats) -> int:
+    """The streaming-tier view: broker counters + per-stream rows
+    (``stats()["streams"]``).  A missing block means the endpoint
+    predates (or never built) the streaming tier — that gates."""
+    st = stats.get("streams")
+    if st is None:
+        print("FAIL: /statusz has no 'streams' block (server "
+              "predates the streaming tier?)", file=sys.stderr)
+        return 1
+    if not st.get("enabled"):
+        print(f"streaming disabled (cancelled={st.get('cancelled')})")
+        return 0
+    print(f"streams: active={st.get('active')} "
+          f"opened={st.get('opened')} "
+          f"published={st.get('published_tokens')} "
+          f"drops={st.get('backpressure_drops')} "
+          f"finished={st.get('finished')} "
+          f"cancelled={st.get('cancelled')} "
+          f"(queue_tokens={st.get('queue_tokens')})")
+    rows = st.get("per_stream", [])
+    if not rows:
+        print("no open streams")
+        return 0
+    w = max(max(len(str(r.get("key"))) for r in rows), len("stream"))
+    print(f"{'stream':<{w}} {'delivered':>9} {'queued':>6} "
+          f"{'drops':>5} {'terminal':>8}")
+    for r in rows:
+        print(f"{str(r.get('key')):<{w}} {r.get('delivered'):>9} "
+              f"{r.get('queued'):>6} {r.get('drops'):>5} "
+              f"{str(bool(r.get('terminal'))):>8}")
+    return 0
+
+
 def assert_healthy(base, timeout) -> int:
     """The gate: healthz ok + conformant metrics + pinned statusz
     blocks.  Prints what failed; 0 only when everything holds."""
@@ -229,6 +270,9 @@ def main(argv=None) -> int:
     ap.add_argument("--programs", action="store_true",
                     help="render /statusz's per-compiled-program "
                     "table")
+    ap.add_argument("--streams", action="store_true",
+                    help="render the streaming tier: broker counters "
+                    "+ per-open-stream delivery cursors")
     ap.add_argument("--statusz", action="store_true",
                     help="print the full /statusz JSON")
     ap.add_argument("--metrics", action="store_true",
@@ -253,7 +297,7 @@ def _run(args, base) -> int:
         rc = assert_healthy(base, args.timeout)
         if rc:
             return rc
-    if args.programs or args.statusz:
+    if args.programs or args.statusz or args.streams:
         code, _, body = fetch(base, "/statusz", args.timeout)
         if code != 200:
             print(f"FAIL: /statusz {code}", file=sys.stderr)
@@ -263,6 +307,10 @@ def _run(args, base) -> int:
             print(json.dumps(stats, indent=2, sort_keys=True))
         if args.programs:
             render_programs(stats)
+        if args.streams:
+            rc = render_streams(stats)
+            if rc:
+                return rc
     if args.metrics:
         code, _, body = fetch(base, "/metrics", args.timeout)
         if code != 200:
@@ -287,7 +335,7 @@ def _run(args, base) -> int:
                                     f"/debug/requests/{args.request}"),
                          indent=2, sort_keys=True))
     if not any((args.assert_healthy, args.programs, args.statusz,
-                args.metrics, args.flight is not None,
+                args.streams, args.metrics, args.flight is not None,
                 args.request is not None)):
         code, _, body = fetch(base, "/healthz", args.timeout)
         health = parse_json(body, "/healthz")

@@ -159,7 +159,8 @@ def run_continuous(cfg, params, prompts, args):
         cfg, params, max_batch_size=args.batch_size,
         max_context=args.max_context,
         block_size=args.block_size, cache_dtype=jnp.float32,
-        kv_quant="off", enable_disagg=False,       # the quant axis has its own mode
+        kv_quant="off", enable_disagg=False,   # quant axis is its own mode
+        enable_streaming=False,                # so is --streaming
         # speculation and pipelining are measured by their own modes
         # (--speculative / --pipeline); the continuous-vs-naive record
         # keeps comparing the same synchronous one-token decode it
@@ -259,6 +260,7 @@ def _build_prefix_servers(cfg, params, args):
             cfg, params, max_batch_size=args.batch_size,
             max_context=args.max_context, block_size=args.block_size,
             cache_dtype=jnp.float32, kv_quant="off", enable_disagg=False,
+        enable_streaming=False,
             enable_prefix_cache=cache,
             enable_chunked_prefill=chunk is not None,
             prefill_chunk=chunk,
@@ -388,6 +390,7 @@ def _spec_server(cfg, params, args, spec):
         cfg, params, max_batch_size=args.batch_size,
         max_context=args.max_context, block_size=args.block_size,
         cache_dtype=jnp.float32, kv_quant="off", enable_disagg=False,
+        enable_streaming=False,
         enable_speculation=spec,
         spec_tokens=args.spec_tokens,
         # the speculation A/B isolates drafting from loop overlap
@@ -534,6 +537,7 @@ def _pipeline_server(cfg, params, args, on):
         cfg, params, max_batch_size=args.batch_size,
         max_context=args.max_context, block_size=args.block_size,
         cache_dtype=jnp.float32, kv_quant="off", enable_disagg=False,
+        enable_streaming=False,
         enable_pipeline=on,
         # one-token decode in both arms: the pipeline axis measures
         # loop overlap, not speculation
@@ -677,6 +681,7 @@ def _disagg_server(cfg, params, args, disagg):
         max_context=args.max_context, block_size=args.block_size,
         num_blocks=args.disagg_blocks if disagg else total,
         cache_dtype=jnp.float32, kv_quant="off",
+        enable_streaming=False,
         prefill_chunk=args.chunk,
         enable_disagg=disagg,
         disagg_prefill_blocks=(args.disagg_prefill_blocks
@@ -893,6 +898,263 @@ def run_disagg_mode(args):
     return rc
 
 
+def _streaming_server(cfg, params, args, streaming, num_blocks=None):
+    """The streaming A/B arms: one shape, only the delivery tier
+    differs.  The pool is roomy (every slot can hold a full-context
+    request) so the gap tail measures decode cadence, not preemption;
+    the cancellation arm passes its own deliberately small pool."""
+    import jax.numpy as jnp
+    from apex_tpu.serving import InferenceServer
+
+    bps = -(-args.max_context // args.block_size)
+    return InferenceServer(
+        cfg, params, max_batch_size=args.batch_size,
+        max_context=args.max_context, block_size=args.block_size,
+        num_blocks=(num_blocks if num_blocks is not None
+                    else args.batch_size * bps + 1),
+        cache_dtype=jnp.float32, kv_quant="off", enable_disagg=False,
+        enable_streaming=streaming)
+
+
+def _run_streaming_arm(server, prompts, args, streaming):
+    """Drive one arm and measure when tokens become VISIBLE to a
+    client: the streaming arm drains each request's ``TokenStream``
+    after every step and timestamps each delivered token; the baseline
+    arm polls ``req.generated`` growth on the identical loop.  Both
+    arms therefore measure the same thing — the wall-clock gap between
+    consecutive token arrivals per request — so their p99 ratio
+    isolates the delivery tier's cost.  Every step is audited.
+    Returns (gaps_ms, outputs, engine-ITL block)."""
+    from apex_tpu.serving import SamplingParams
+
+    greedy = SamplingParams()
+    # warmup compiles the prefill bucket + decode before the window
+    server.generate([prompts[0]], max_new_tokens=8, sampling=greedy)
+    server.engine.reset_cache()
+    server.reset_meters()
+
+    reqs = [server.submit(p, args.max_new, sampling=greedy)
+            for p in prompts]
+    streams = ({r.uid: server.stream(r) for r in reqs}
+               if streaming else None)
+    delivered = {r.uid: [] for r in reqs}
+    last_at = {}
+    gaps = []
+    while any(not r.finished for r in reqs):
+        server.step()
+        server.audit()
+        now = time.perf_counter()
+        for r in reqs:
+            if streaming:
+                new = streams[r.uid].drain()
+            else:
+                new = list(r.generated)[len(delivered[r.uid]):]
+            for tok in new:
+                if r.uid in last_at:
+                    gaps.append((now - last_at[r.uid]) * 1e3)
+                last_at[r.uid] = now
+                delivered[r.uid].append(tok)
+    if streaming:
+        # terminal events: every stream must close with the request's
+        # finish reason and the delivered bytes must equal the output
+        for r in reqs:
+            s = streams[r.uid]
+            delivered[r.uid].extend(s.drain())
+            assert s.done and s.finish_reason == r.finish_reason, (
+                r.uid, s.finish_reason, r.finish_reason)
+        assert server.stream_broker.active == 0
+    for r in reqs:
+        assert delivered[r.uid] == list(r.generated), (
+            "delivered stream diverged from Request.output "
+            f"(uid {r.uid})")
+    gaps.sort()
+    st = server.stats()
+    rec = {
+        "gap_p50_ms": round(gaps[int(0.50 * (len(gaps) - 1))], 3),
+        "gap_p99_ms": round(gaps[int(0.99 * (len(gaps) - 1))], 3),
+        "gap_samples": len(gaps),
+        "engine_itl_ms": st["latency"]["itl_ms"],
+    }
+    if streaming:
+        rec["streams"] = st["streams"]
+    return rec, [list(r.generated) for r in reqs]
+
+
+def _run_streaming_cancel_arm(cfg, params, args):
+    """The cancellation-reclaims-capacity arm: a pool sized for
+    exactly ``batch_size`` full-context requests is filled with
+    long-running streamed decoders, every stream is torn down
+    mid-decode (client disconnect -> ``cancel``), and a SECOND full
+    batch must then run to a healthy finish on the reclaimed blocks —
+    with the allocator audited every step.  A leaked block or
+    lookahead hold would starve the second batch or trip the audit."""
+    from apex_tpu.serving import SamplingParams
+
+    greedy = SamplingParams()
+    bps = -(-args.max_context // args.block_size)
+    server = _streaming_server(cfg, params, args, True,
+                               num_blocks=args.batch_size * bps + 1)
+    rng = np.random.RandomState(args.seed + 11)
+    prompts = [list(rng.randint(0, args.vocab, size=args.prompt_tokens))
+               for _ in range(args.batch_size)]
+    server.generate([prompts[0]], max_new_tokens=8, sampling=greedy)
+    server.engine.reset_cache()
+    server.reset_meters()
+
+    max_new = min(args.max_context - args.prompt_tokens - 1, 48)
+    first = [server.submit(p, max_new, sampling=greedy)
+             for p in prompts]
+    streams = {r.uid: server.stream(r) for r in first}
+    for _ in range(4):                    # into steady mid-decode
+        server.step()
+        server.audit()
+    live_before = server.stats()["memory"]["blocks_live"]
+    cancelled = 0
+    for r in first:
+        streams[r.uid].close()            # the client hangs up...
+        if server.cancel(r.uid):          # ...and the SSE tier cancels
+            cancelled += 1
+    server.audit()
+    while server.has_work:
+        server.step()
+        server.audit()
+    live_after = server.stats()["memory"]["blocks_live"]
+
+    second = [server.submit(p, max_new, sampling=greedy)
+              for p in prompts]
+    while server.has_work:
+        server.step()
+        server.audit()
+    tally = {}
+    for r in second:
+        tally[r.finish_reason] = tally.get(r.finish_reason, 0) + 1
+    healthy_after = sum(tally.get(k, 0) for k in ("eos", "length"))
+    return {
+        "pool_blocks": args.batch_size * bps + 1,
+        "first_batch": len(first),
+        "cancelled": cancelled,
+        "blocks_live_mid_decode": live_before,
+        "blocks_live_after_cancel": live_after,
+        "second_batch_finished": tally,
+        "second_batch_healthy": healthy_after,
+    }
+
+
+def run_streaming_mode(args):
+    """Streaming delivery A/B + cancellation capacity arm
+    (``docs/serving.md``, "Streaming & cancellation"; one JSON record
+    to ``BENCH_serving_streaming.json``):
+
+    - *baseline*: ``enable_streaming=False`` server, token visibility
+      measured by polling ``req.generated`` each step — the
+      non-streaming gap tail everything is measured against;
+    - *streaming*: the same traffic with a ``TokenStream`` per
+      request drained each step; delivered sequences are asserted
+      byte-identical to ``Request.output`` and every stream must
+      close with the request's finish reason;
+    - *cancellation*: a full pool of streamed decoders is disconnected
+      mid-decode; the freed blocks must carry a second full batch to
+      a healthy finish (audit-clean throughout).
+
+    ``--smoke`` floors: delivered-ITL p99 <= 1.1x the baseline gap
+    tail (retire-time fan-out must not add a scheduling stall), zero
+    parity mismatches, every cancel reclaimed (``blocks_live`` back
+    to zero), and the post-cancel batch 100% healthy."""
+    cfg, m, params = build_model(args)
+    rng = np.random.RandomState(args.seed + 5)
+    prompts = [list(rng.randint(0, args.vocab, size=args.prompt_tokens))
+               for _ in range(args.requests)]
+
+    # wall-clock gap tails are jittery on a shared CPU host, so the
+    # A/B interleaves ``--repeats`` baseline/streaming pairs and
+    # takes the MIN of the per-pair p99 ratios (the existing repeats
+    # precedent): delivery fan-out can only ADD latency, so the
+    # least-jittered pair is the honest estimate of its true cost
+    mismatches = 0
+    ratios = []
+    base = stream = None
+    for _ in range(max(1, args.repeats)):
+        b, outs_base = _run_streaming_arm(
+            _streaming_server(cfg, params, args, False), prompts,
+            args, streaming=False)
+        s, outs_stream = _run_streaming_arm(
+            _streaming_server(cfg, params, args, True), prompts,
+            args, streaming=True)
+        mismatches += sum(x != y
+                          for x, y in zip(outs_base, outs_stream))
+        ratios.append(
+            round(s["gap_p99_ms"] / max(b["gap_p99_ms"], 1e-6), 3))
+        if base is None or ratios[-1] == min(ratios):
+            base, stream = b, s
+    cancel = _run_streaming_cancel_arm(cfg, params, args)
+    record = {
+        "bench": "serving_streaming",
+        "mode": "smoke" if args.smoke else "full",
+        "config": {"requests": args.requests, "max_new": args.max_new,
+                   "batch_size": args.batch_size,
+                   "block_size": args.block_size,
+                   "hidden": args.hidden, "layers": args.layers,
+                   "heads": args.heads,
+                   "max_context": args.max_context,
+                   "vocab": args.vocab,
+                   "prompt_tokens": args.prompt_tokens},
+        "baseline": base,
+        "streaming": stream,
+        # the headline: wall-clock inter-token delivery tail with the
+        # streaming tier on, relative to polling the same server shape
+        "delivered_itl_p99_ratio": min(ratios),
+        "delivered_itl_p99_ratio_repeats": ratios,
+        "cancellation": cancel,
+        "parity_mismatches": mismatches,
+    }
+    print(json.dumps(record))
+
+    out = args.out
+    if out != "-":
+        if out is None:
+            out = os.path.join(
+                os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+                "BENCH_serving_streaming.json")
+        with open(out, "w") as f:
+            json.dump(record, f, indent=1)
+            f.write("\n")
+
+    rc = 0
+    if mismatches:
+        print(f"FAIL: {mismatches} streams diverged between the "
+              "baseline and streaming arms (delivery is observation-"
+              "only; greedy outputs must be bit-exact)",
+              file=sys.stderr)
+        rc = 1
+    if args.smoke:
+        if record["delivered_itl_p99_ratio"] > 1.1:
+            print(f"FAIL: delivered-ITL p99 "
+                  f"{record['delivered_itl_p99_ratio']}x the "
+                  f"non-streaming gap tail exceeds the 1.1x floor "
+                  f"(retire-time fan-out must not stall the step "
+                  f"loop)", file=sys.stderr)
+            rc = 1
+        if cancel["cancelled"] != cancel["first_batch"]:
+            print(f"FAIL: only {cancel['cancelled']} of "
+                  f"{cancel['first_batch']} mid-decode disconnects "
+                  f"cancelled", file=sys.stderr)
+            rc = 1
+        if cancel["blocks_live_after_cancel"] != 0:
+            print(f"FAIL: {cancel['blocks_live_after_cancel']} KV "
+                  f"blocks still live after every stream was "
+                  f"disconnected and cancelled (leak)",
+                  file=sys.stderr)
+            rc = 1
+        if cancel["second_batch_healthy"] != cancel["first_batch"]:
+            print(f"FAIL: post-cancel batch finished "
+                  f"{cancel['second_batch_finished']} — the reclaimed "
+                  f"pool must carry a full healthy batch",
+                  file=sys.stderr)
+            rc = 1
+    return rc
+
+
 def _sampling_server(cfg, params, args, pipeline, speculation):
     import jax.numpy as jnp
     from apex_tpu.serving import InferenceServer
@@ -909,6 +1171,7 @@ def _sampling_server(cfg, params, args, pipeline, speculation):
         cfg, params, max_batch_size=args.batch_size,
         max_context=args.max_context, block_size=args.block_size,
         cache_dtype=jnp.float32, kv_quant="off", enable_disagg=False,
+        enable_streaming=False,
         enable_pipeline=pipeline, enable_speculation=speculation,
         spec_tokens=args.spec_tokens)
 
@@ -1126,7 +1389,8 @@ def _tp_server(cfg, params, args, mesh):
     return InferenceServer(
         cfg, params, max_batch_size=args.batch_size,
         max_context=args.max_context, block_size=args.block_size,
-        cache_dtype=jnp.float32, kv_quant="off", enable_disagg=False, mesh=mesh)
+        cache_dtype=jnp.float32, kv_quant="off", enable_disagg=False,
+        enable_streaming=False, mesh=mesh)
 
 
 def _run_tp_workload(server, prompts, args):
@@ -1299,7 +1563,7 @@ def _kvq_server(cfg, params, args, quant, num_blocks=None,
         cache_dtype=(cache_dtype if cache_dtype is not None
                      else jnp.float32),
         kv_quant="int8" if quant else "off",
-        enable_disagg=False,
+        enable_disagg=False, enable_streaming=False,
         num_blocks=num_blocks)
 
 
@@ -1505,7 +1769,8 @@ def _router_fleet(cfg, params, args, kind):
         max_batch_size=args.batch_size,
         max_context=args.max_context, block_size=args.block_size,
         num_blocks=args.router_blocks, cache_dtype=jnp.float32,
-        kv_quant="off", enable_disagg=False)
+        kv_quant="off", enable_disagg=False,
+        enable_streaming=False)
 
 
 def _run_router_arm(cfg, params, args, kind, groups):
@@ -1756,6 +2021,16 @@ def main():
                     help="long-prompt submissions per step during the "
                     "interference window (keeps the monolithic arm's "
                     "prefill slots saturated)")
+    ap.add_argument("--streaming", action="store_true",
+                    help="streaming delivery A/B (docs/serving.md, "
+                    "'Streaming & cancellation'): wall-clock token-"
+                    "arrival gap tail with per-request TokenStreams "
+                    "drained each step vs polling the identical "
+                    "non-streaming server, plus the cancellation-"
+                    "reclaims-capacity arm; delivered bytes always "
+                    "asserted identical to Request.output, --smoke "
+                    "floors delivered-ITL p99 <= 1.1x baseline "
+                    "(BENCH_serving_streaming.json)")
     ap.add_argument("--pipeline", action="store_true",
                     help="run the pipelined-vs-synchronous step-loop "
                     "A/B (decode-heavy traffic, >= 1.25x "
@@ -1843,6 +2118,21 @@ def main():
             args.hidden = 128
             args.layers = 2
             args.heads = 4
+            args.max_context = 64
+            args.prompt_tokens = 8
+        if args.streaming:
+            # decode-heavy steady state: enough concurrent streams
+            # that per-step fan-out work would show in the gap tail
+            # if it stalled the loop, completions long enough for a
+            # stable per-request gap series
+            args.requests = 16
+            args.max_new = 32
+            args.batch_size = 8
+            args.block_size = 8
+            args.vocab = 61
+            args.hidden = 32
+            args.layers = 2
+            args.heads = 2
             args.max_context = 64
             args.prompt_tokens = 8
         if args.sampling:
@@ -1965,6 +2255,11 @@ def main():
             # solo floor must measure decode, not preemption)
             args.disagg_blocks = args.batch_size * bps + 1
         return run_disagg_mode(args)
+
+    if args.streaming:
+        if args.prompt_tokens is None:
+            args.prompt_tokens = max(4, args.max_context // 8)
+        return run_streaming_mode(args)
 
     if args.kv_quant:
         return run_kv_quant_mode(args)
