@@ -34,6 +34,7 @@ rejection for ``stats()`` reconciliation.
 
 from __future__ import annotations
 
+import random
 import time
 from typing import Callable, Optional
 
@@ -57,6 +58,18 @@ class CircuitBreaker:
       probe_quota: how many half-open probes may be admitted per
         episode before further :meth:`allow` calls are rejected while
         the probes resolve (default: ``probe_successes``).
+      half_open_backoff: optional cap (seconds) for a decaying probe
+        cadence.  A half-open probe failure normally restarts the
+        SAME ``recovery_time`` cooldown, so a flapping replica gets
+        probed at a fixed interval forever; with a cap set, each
+        half-open re-trip grows the effective cooldown by
+        decorrelated jitter (``resilience/retry.py`` math:
+        ``uniform(recovery_time, cooldown * 3)`` clamped to the cap)
+        and any close resets it to ``recovery_time``.  ``None``
+        (default) keeps the legacy fixed cadence byte-identical.
+      rng: jitter source for ``half_open_backoff`` — injectable so
+        tests (and seeded soaks) get deterministic decay; default
+        ``random.Random(0)``.
       clock: monotonic-seconds source — injectable so tests drive the
         open -> half-open transition without sleeping.
       counters: optional :class:`apex_tpu.utils.CounterMeter`; gets
@@ -68,6 +81,8 @@ class CircuitBreaker:
                  recovery_time: float = 30.0,
                  probe_successes: int = 1,
                  probe_quota: Optional[int] = None,
+                 half_open_backoff: Optional[float] = None,
+                 rng: Optional[random.Random] = None,
                  clock: Callable[[], float] = time.monotonic,
                  counters=None):
         if failure_threshold < 1:
@@ -79,11 +94,22 @@ class CircuitBreaker:
         if probe_successes < 1:
             raise ValueError(
                 f"probe_successes must be >= 1, got {probe_successes}")
+        if half_open_backoff is not None \
+                and half_open_backoff < recovery_time:
+            raise ValueError(
+                f"half_open_backoff cap {half_open_backoff} must be >= "
+                f"recovery_time {recovery_time}")
         self.failure_threshold = failure_threshold
         self.recovery_time = float(recovery_time)
         self.probe_successes = probe_successes
         self.probe_quota = (probe_quota if probe_quota is not None
                             else probe_successes)
+        self.half_open_backoff = (None if half_open_backoff is None
+                                  else float(half_open_backoff))
+        self._rng = rng if rng is not None else random.Random(0)
+        # effective open -> half-open cooldown; grows under
+        # half_open_backoff, always == recovery_time without it
+        self._cooldown = float(recovery_time)
         self.clock = clock
         self.counters = counters
         self._state = CLOSED
@@ -103,7 +129,7 @@ class CircuitBreaker:
         """Current state, advancing open -> half-open when the
         cooldown has elapsed (reading the state IS the probe timer)."""
         if self._state == OPEN and \
-                self.clock() - self._opened_at >= self.recovery_time:
+                self.clock() - self._opened_at >= self._cooldown:
             self._transition(HALF_OPEN)
             self._probes_out = 0
             self._probe_ok = 0
@@ -120,8 +146,20 @@ class CircuitBreaker:
             self.counters.incr(self._TRANSITION_KEYS[state])
 
     def _trip(self, now: "float | None" = None) -> None:
+        reopened = self._state == HALF_OPEN
         self._opened_at = self.clock() if now is None else now
         self._streak = 0
+        if self.half_open_backoff is not None:
+            if reopened:
+                # flapping: decorrelated jitter (retry.py's formula)
+                # decays the probe cadence toward the cap
+                self._cooldown = min(
+                    self.half_open_backoff,
+                    self._rng.uniform(
+                        self.recovery_time,
+                        max(self.recovery_time, self._cooldown * 3.0)))
+            else:
+                self._cooldown = self.recovery_time
         self._transition(OPEN)
 
     # -- the caller-facing protocol ---------------------------------------
@@ -147,6 +185,7 @@ class CircuitBreaker:
             self._probe_ok += 1
             if self._probe_ok >= self.probe_successes:
                 self._streak = 0
+                self._cooldown = self.recovery_time
                 self._transition(CLOSED)
         else:
             self._streak = 0
@@ -187,6 +226,7 @@ class CircuitBreaker:
             "probe_ok": self._probe_ok,
             "probe_quota": self.probe_quota,
             "recovery_time": self.recovery_time,
+            "current_backoff": self._cooldown,
             "transitions": {
                 "opened": self._transitions[OPEN],
                 "half_open": self._transitions[HALF_OPEN],
@@ -200,3 +240,4 @@ class CircuitBreaker:
         self._streak = 0
         self._probes_out = 0
         self._probe_ok = 0
+        self._cooldown = self.recovery_time

@@ -59,7 +59,8 @@ from apex_tpu.serving.reasons import (
 
 __all__ = ["Arrival", "ChaosConfig", "ChaosEngine", "ChaosSchedule",
            "ReplicaKillSwitch", "ROUTER_TERMINAL_REASONS",
-           "TERMINAL_REASONS", "run_router_soak", "run_soak"]
+           "TERMINAL_REASONS", "run_elastic_soak", "run_router_soak",
+           "run_soak"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -173,6 +174,18 @@ class ChaosConfig:
     # byte-identical (no extra RNG draws).
     disconnect_rate: float = 0.0
 
+    # flash-crowd arrival class (``serving/elastic``; the --elastic
+    # soak and bench arm arm it): for ``flash_crowd_len`` iterations
+    # starting at ``flash_crowd_iter``, EVERY iteration adds
+    # ``randint(*flash_crowd_arrivals)`` extra arrivals on top of the
+    # Bernoulli/burst baseline — the sustained thundering herd an
+    # autoscaler exists for, as opposed to ``burst_rate``'s one-shot
+    # spikes.  ``None`` (the default) draws no RNG, so legacy
+    # (config, seed) schedules stay byte-identical.
+    flash_crowd_iter: Optional[int] = None
+    flash_crowd_len: int = 0
+    flash_crowd_arrivals: Tuple[int, int] = (2, 4)
+
     # forced invariant violation (the postmortem build-matrix axis,
     # docs/observability.md): at the first iteration >= this with a
     # finished request, the soak deliberately corrupts the terminal
@@ -269,6 +282,13 @@ class ChaosSchedule:
             if rng.random() < cfg.burst_rate:
                 batch.extend(one_arrival(i)
                              for _ in range(rng.randint(*cfg.burst_size)))
+            # rate-None guard first: legacy schedules draw nothing
+            if cfg.flash_crowd_iter is not None \
+                    and cfg.flash_crowd_iter <= i \
+                    < cfg.flash_crowd_iter + cfg.flash_crowd_len:
+                batch.extend(
+                    one_arrival(i) for _ in
+                    range(rng.randint(*cfg.flash_crowd_arrivals)))
             if batch:
                 arrivals[i] = batch
             if rng.random() < cfg.nonfinite_rate:
@@ -724,6 +744,284 @@ def run_router_soak(make_fleet: Callable, cfg: ChaosConfig, seed: int,
             per_replica_finished[vic.name]
             - victim_finished_at_recovery),
         affinity=router["affinity"],
+        pressure_peak=stats["pressure_peak"],
+    )
+    return report
+
+
+def run_elastic_soak(make_fleet: Callable, cfg: ChaosConfig, seed: int,
+                     *, rollout_iter: int, expect_final_size: int = 1,
+                     make_replay: Optional[Callable] = None,
+                     log: Callable[[str], None] = lambda s: None,
+                     postmortem_dir: Optional[str] = None) -> dict:
+    """The ELASTIC fleet's chaos soak (``docs/serving.md``, "Elastic
+    fleet"): seeded traffic with a sustained ``flash_crowd`` arrival
+    window routed through an autoscaling ``RouterFleet``, with a
+    zero-downtime weight ROLLOUT fired mid-crowd — the worst
+    realistic composition: membership churn, rolling drains, and a
+    version swap all while the queue is the deepest.  Invariants:
+
+      1. per-replica scheduler/allocator/prefix-cache ``audit()``
+         passes every step, across every membership change;
+      2. exactly-once terminals: every routed request reaches ONE
+         terminal state with a legal reason — across scale-ups,
+         rolling scale-down drains, and the rollout's drain/swap/
+         revive cycles, requests neither vanish nor double-finish
+         (zero healthy-request loss);
+      3. the sum of finished counts over live AND retired replicas
+         equals the number injected, and nothing went unplaced;
+      4. the flash crowd forced at least one scale-UP, and after the
+         crowd passed the fleet converged back to
+         ``expect_final_size`` replicas;
+      5. the mid-crowd rollout reported ``"ok"`` and the fleet ends
+         on a SINGLE weights version — the rollout's, on every
+         surviving replica;
+      6. SLO debt is BOUNDED: once the crowd has passed and capacity
+         caught up, the shed-token debt stops growing (zero growth
+         over the soak's final fifth);
+      7. surviving outputs are bit-exact vs a single-replica
+         unfaulted replay oracle (cut-short ones bit-exact prefixes)
+         — scaling and rolling weights that pass the parity gate may
+         move work but never change tokens;
+      8. failure counters reconcile with the observed terminal
+         reasons (retired replicas included).
+
+    ``make_fleet(clock)`` must build the fleet with
+    ``enable_elastic=True``; the rollout checkpoint is the fleet's
+    OWN params published to a temp dir (output-equivalent by
+    construction — the parity gate's happy path), so the soak needs
+    no external checkpoint.  ``cfg.flash_crowd_iter`` must be set and
+    ``rollout_iter`` must land inside the crowd window."""
+    if cfg.flash_crowd_iter is None or cfg.flash_crowd_len <= 0:
+        raise ValueError(
+            "elastic soak needs cfg.flash_crowd_iter/_len set — the "
+            "crowd IS the scenario")
+    if not (cfg.flash_crowd_iter <= rollout_iter
+            < cfg.flash_crowd_iter + cfg.flash_crowd_len):
+        raise ValueError(
+            f"rollout_iter {rollout_iter} must land inside the flash "
+            f"crowd [{cfg.flash_crowd_iter}, "
+            f"{cfg.flash_crowd_iter + cfg.flash_crowd_len})")
+    import shutil
+    import tempfile
+
+    from apex_tpu.utils import checkpoint as _ckpt
+
+    schedule = ChaosSchedule.generate(cfg, seed)
+    clock_state = {"t": 0.0}
+    fleet = make_fleet(lambda: clock_state["t"])
+    if fleet.autoscaler is None:
+        raise ValueError(
+            "make_fleet must build with enable_elastic=True")
+
+    tracked: Dict[int, Tuple] = {}      # rid -> (RouterRequest, Arrival)
+    terminal: Dict[int, str] = {}       # rid -> finish_reason
+    seen_uids: Set[int] = set()
+    # membership changes mid-soak: cursors are keyed by replica NAME
+    # (stable across scale churn), not list position
+    cursors: Dict[str, int] = {}
+    crowd_end = cfg.flash_crowd_iter + cfg.flash_crowd_len
+    tail_start = cfg.iters - max(1, cfg.iters // 5)
+    size_peak = len(fleet.replicas)
+    debt_at_tail = None
+    rollout_report = None
+    report = {"iters": cfg.iters, "seed": seed,
+              "start_replicas": len(fleet.replicas),
+              "flash_crowd": [cfg.flash_crowd_iter, crowd_end],
+              "rollout_iter": rollout_iter}
+
+    def all_reps():
+        return fleet.replicas + fleet.retired_replicas
+
+    def absorb_finished():
+        for rep in all_reps():
+            fin = rep.server.scheduler.finished
+            for req in fin[cursors.get(rep.name, 0):]:
+                assert req.uid not in seen_uids, \
+                    f"request uid {req.uid} finished twice"
+                seen_uids.add(req.uid)
+                assert req.finished and \
+                    req.finish_reason in ROUTER_TERMINAL_REASONS, \
+                    (f"request {req.uid} finished with bad reason "
+                     f"{req.finish_reason!r} on {rep.name}")
+            cursors[rep.name] = len(fin)
+        for rid, (rr, _a) in tracked.items():
+            if rr.finished and rid not in terminal:
+                terminal[rid] = rr.finish_reason
+
+    def _postmortem_and_reraise(e: AssertionError):
+        if postmortem_dir is None:
+            raise e
+        bundle = os.path.join(postmortem_dir,
+                              "elastic_invariant_violation")
+        fleet.dump_postmortem(bundle, reason="invariant_violation",
+                              extra={"error": str(e), "seed": seed})
+        log(f"postmortem bundle written: {bundle}")
+        raise AssertionError(f"{e} [postmortem: {bundle}]") from e
+
+    # the rollout checkpoint: the fleet's own params, published
+    # atomically — output-equivalent by construction, so the parity
+    # gate must pass and the soak exercises the FULL promote path
+    ckpt_dir = tempfile.mkdtemp(prefix="elastic_soak_ckpt_")
+    try:
+        _ckpt.CheckpointManager(ckpt_dir).save(1, fleet.params)
+        try:
+            for i in range(cfg.iters):
+                clock_state["t"] = float(i)
+                if i == rollout_iter:
+                    pre = len(fleet.replicas)
+                    rollout_report = fleet.rollout(ckpt_dir)
+                    log(f"iter {i}: mid-crowd rollout -> "
+                        f"{rollout_report['status']} "
+                        f"({rollout_report['replicas_rolled']} "
+                        f"replicas)")
+                    assert rollout_report["status"] == "ok", \
+                        (f"mid-crowd rollout failed: "
+                         f"{rollout_report}")
+                    assert rollout_report["replicas_rolled"] == pre, \
+                        (f"rollout promoted "
+                         f"{rollout_report['replicas_rolled']} of "
+                         f"{pre} replicas")
+                for a in schedule.arrivals.get(i, ()):
+                    rr = fleet.submit(list(a.prompt),
+                                      a.max_new_tokens,
+                                      priority=a.priority,
+                                      deadline_iters=a.deadline_iters,
+                                      deadline_s=a.deadline_s)
+                    tracked[rr.rid] = (rr, a)
+                fleet.step()
+                for rep in fleet.replicas:          # invariant 1
+                    rep.server.scheduler.audit()
+                absorb_finished()
+                size_peak = max(size_peak, len(fleet.replicas))
+                if i == tail_start:
+                    debt_at_tail = fleet.shed_debt_tokens()
+                if i and i % 200 == 0:
+                    log(f"iter {i}: {len(terminal)}/{len(tracked)} "
+                        f"terminal, {len(fleet.replicas)} replicas, "
+                        f"debt={fleet.shed_debt_tokens()}")
+
+            # convergence is judged BEFORE the final drain (draining
+            # parks the autoscaler)
+            elastic = fleet.stats()["elastic"]      # invariant 4
+            assert elastic["scale_ups"] >= 1, \
+                "the flash crowd passed without a single scale-up"
+            assert len(fleet.replicas) == expect_final_size, \
+                (f"fleet ended at {len(fleet.replicas)} replicas, "
+                 f"expected convergence to {expect_final_size}")
+            versions = elastic["weights_versions"]  # invariant 5
+            assert rollout_report is not None
+            want_v = rollout_report["version"]
+            assert set(versions) == {want_v}, \
+                (f"fleet ends on versions {versions}, expected only "
+                 f"{want_v!r}")
+            debt_end = fleet.shed_debt_tokens()     # invariant 6
+            assert debt_at_tail is not None
+            assert debt_end == debt_at_tail, \
+                (f"SLO debt still growing after the crowd: "
+                 f"{debt_at_tail} -> {debt_end} over the final "
+                 f"fifth")
+
+            clock_state["t"] = float(cfg.iters)
+            fleet.drain()
+            for rep in fleet.replicas:
+                rep.server.scheduler.audit()
+            absorb_finished()
+
+            router = fleet.stats()["router"]
+            for rid, (rr, _a) in tracked.items():   # invariant 2
+                assert rr.finished and rid in terminal, \
+                    (f"routed request {rid} never reached a "
+                     f"terminal state")
+                assert terminal[rid] == rr.finish_reason, \
+                    (f"routed request {rid} changed terminal reason "
+                     f"{terminal[rid]!r} -> {rr.finish_reason!r}")
+            per_replica_finished = {
+                rep.name: len(rep.server.scheduler.finished)
+                for rep in all_reps()}
+            assert router["unplaced"] == 0, \
+                (f"{router['unplaced']} requests went unplaced")
+            assert sum(per_replica_finished.values()) \
+                == len(tracked), \
+                (f"per-replica finished {per_replica_finished} sums "
+                 f"to {sum(per_replica_finished.values())} != "
+                 f"{len(tracked)} injected")        # invariant 3
+
+            tally: Dict[str, int] = {}
+            for reason in terminal.values():
+                tally[reason] = tally.get(reason, 0) + 1
+            for reason, n in tally.items():         # invariant 8
+                if reason in HEALTHY_REASONS:
+                    continue
+                got = sum(rep.server.failures.count(
+                    f"requests_failed_{reason}")
+                    for rep in all_reps())
+                assert got == n, \
+                    (f"counter requests_failed_{reason}={got} != "
+                     f"{n} observed")
+        except AssertionError as e:
+            _postmortem_and_reraise(e)
+
+        # invariant 7: bit-exact survivors / prefixes vs a
+        # single-replica unfaulted replay
+        if make_replay is None:
+            raise ValueError(
+                "elastic soak needs make_replay (a single-server "
+                "factory — the fleet factory autoscales and cannot "
+                "be the oracle)")
+        replay = make_replay(lambda: 0.0)
+        outputs: Dict[Tuple, List[int]] = {}
+        by_budget: Dict[int, List[Tuple]] = {}
+        for rr, a in tracked.values():
+            key = (a.prompt, rr.max_new_tokens)
+            if key not in outputs:
+                outputs[key] = None
+                by_budget.setdefault(rr.max_new_tokens,
+                                     []).append(key)
+        for budget, keys in sorted(by_budget.items()):
+            outs = replay.generate([list(k[0]) for k in keys],
+                                   budget)
+            for key, out in zip(keys, outs):
+                outputs[key] = out
+        checked = prefix_checked = 0
+        try:
+            for rr, a in tracked.values():
+                ref = outputs[(a.prompt, rr.max_new_tokens)]
+                if rr.finish_reason in HEALTHY_REASONS:
+                    assert list(rr.generated) == ref, \
+                        (f"surviving request {rr.rid} diverged from "
+                         f"the replay: {rr.generated} != {ref}")
+                    checked += 1
+                elif rr.generated:
+                    assert list(rr.generated) \
+                        == ref[:len(rr.generated)], \
+                        (f"{rr.finish_reason} request {rr.rid}'s "
+                         f"partial output is not a prefix of the "
+                         f"replay")
+                    prefix_checked += 1
+        except AssertionError as e:
+            _postmortem_and_reraise(e)
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+    stats = fleet.stats()
+    elastic = stats["elastic"]
+    report.update(
+        submitted=len(tracked),
+        finished=dict(sorted(tally.items())),
+        per_replica_finished=per_replica_finished,
+        bit_exact_checked=checked,
+        prefix_checked=prefix_checked,
+        size_peak=size_peak,
+        final_replicas=len(fleet.replicas),
+        retired_replicas=len(fleet.retired_replicas),
+        scale_ups=elastic["scale_ups"],
+        scale_downs=elastic["scale_downs"],
+        weights_versions=elastic["weights_versions"],
+        rollout=rollout_report,
+        shed_debt_tokens=fleet.shed_debt_tokens(),
+        reenqueued=stats["router"]["reenqueued"],
+        unplaced=stats["router"]["unplaced"],
         pressure_peak=stats["pressure_peak"],
     )
     return report

@@ -143,7 +143,7 @@ from apex_tpu.ops.sampling import SamplingParams, sample_tokens_host
 from apex_tpu.resilience.breaker import CircuitBreaker
 from apex_tpu.serving.engine import DecodeEngine
 from apex_tpu.serving.kv_cache import KV_QUANT_ENV, resolve_kv_quant
-from apex_tpu.serving.overload import OverloadPolicy
+from apex_tpu.serving.overload import AdmissionEstimator, OverloadPolicy
 from apex_tpu.serving.prefix_cache import PrefixCache
 from apex_tpu.serving import reasons
 from apex_tpu.serving.scheduler import QueueFullError, Request, Scheduler
@@ -529,6 +529,17 @@ class InferenceServer:
         self.overload_policy = (
             overload_policy if overload_policy is not None
             else OverloadPolicy()) if enable_overload else None
+        # predictive admission (docs/resilience.md): learns service
+        # rates from finished timelines and sheds provably
+        # deadline-doomed arrivals at the front door.  Gated on the
+        # policy flag so the default server carries no estimator at
+        # all — cold-start behavior is byte-identical either way.
+        self.admission = (
+            AdmissionEstimator(
+                min_history=self.overload_policy.admission_min_history,
+                margin=self.overload_policy.admission_margin)
+            if self.overload_policy is not None
+            and self.overload_policy.predictive_admission else None)
         # disaggregated prefill/decode pools (docs/serving.md,
         # "Disaggregated prefill/decode"; OFF by default): a second
         # engine with its OWN KV pool runs every prefill, and the main
@@ -872,6 +883,12 @@ class InferenceServer:
             return self._finish_at_submit(req, reasons.DRAINING)
         if self.breaker is not None and not self.breaker.allow():
             return self._finish_at_submit(req, reasons.BREAKER_OPEN)
+        # predictive admission: a wall-deadlined arrival that cannot
+        # meet its deadline even at the fastest service ever observed
+        # for its class is shed HERE, before any prefill is spent on
+        # it (docs/resilience.md, "Overload policy & lifecycle")
+        if self.admission is not None and self.admission.doomed(req):
+            return self._finish_at_submit(req, reasons.SHED)
         try:
             # under disaggregation every request enters through the
             # prefill pool's queue; the decode pool only ever admits
@@ -2165,6 +2182,8 @@ class InferenceServer:
             # "SLO & goodput"): served terminals count toward
             # attainment, shed work toward the debt counters
             self.slo.observe(req)
+            if self.admission is not None:
+                self.admission.observe(req)
             # terminal stream event: delivery backfills any tokens the
             # bounded queue never carried, so the consumer's stream is
             # complete the moment it sees the finish_reason
@@ -2321,6 +2340,18 @@ class InferenceServer:
         in-flight generation is bit-identical either way (the same
         scheduler/engine steps run on the same state)."""
         self._draining = True
+
+    def end_drain(self) -> None:
+        """Reopen admissions after :meth:`begin_drain` WITHOUT
+        replacing the server — the in-place weight-rollout shape
+        (``serving/elastic``): a drained server keeps its compiled
+        programs and swaps params in place, so "restart" is just
+        flipping admissions back on.  Idempotent on a non-draining
+        server; a CLOSED server cannot reopen (close released its
+        pools)."""
+        if self._closed:
+            raise RuntimeError("cannot end_drain a closed server")
+        self._draining = False
 
     def drain(self) -> dict:
         """Graceful shutdown, phase one: stop admissions (subsequent
@@ -2827,6 +2858,12 @@ class InferenceServer:
             # SLO attainment + goodput-vs-throughput
             # (docs/observability.md, "SLO & goodput")
             "slo": self.slo.as_stats(),
+            # predictive admission (docs/resilience.md): learned
+            # per-class service floors + submit-time shed tally;
+            # {enabled: False} unless the policy armed it
+            "admission": (self.admission.as_stats()
+                          if self.admission is not None
+                          else {"enabled": False}),
             # KV memory occupancy, high-watermarks, fragmentation
             # (docs/observability.md, "Memory accounting")
             "memory": self._memory_stats(),

@@ -1,0 +1,32 @@
+"""Elastic fleet — the control loop over ``RouterFleet``'s actuators.
+
+The router subsystem already carries every SIGNAL an operator would
+scale on (per-replica pressure with prefill backlog, per-priority SLO
+attainment and shed debt, breaker snapshots) and every ACTUATOR a
+scale action needs (``drain_replica()``/``revive()`` rolling drain,
+checksummed cross-pool block transfer, ``CheckpointManager`` atomic
+publish/restore) — this package closes the loop between them:
+
+- :class:`Autoscaler` (``autoscaler.py``): a deterministic,
+  injectable-clock controller stepped once per fleet iteration.
+  Pressure + SLO-debt trend against a hysteresis band decide
+  scale-up (new replica from the fleet's factory, prefix cache
+  warmed from a donor over the checksummed block path) and
+  scale-down (rolling drain, then retire); cooldowns keep it from
+  flapping, and every decision lands in the pinned
+  ``stats()["elastic"]`` block + the flight recorder.
+- zero-downtime weight rollout (``rollout.py``):
+  ``fleet.rollout(checkpoint_dir)`` rolls a published checkpoint
+  replica-by-replica through drain -> in-place param swap ->
+  revive, gated per replica by an A/B output-parity audit on probe
+  prompts; a failed gate halts and rolls back, so a partial rollout
+  always converges to ONE weight version.
+
+``docs/serving.md`` ("Elastic fleet") has the control-loop diagram,
+the knob tables, and the when-NOT-to-autoscale discussion.
+"""
+
+from apex_tpu.serving.elastic.autoscaler import Autoscaler, AutoscalerConfig
+from apex_tpu.serving.elastic.rollout import rollout_fleet
+
+__all__ = ["Autoscaler", "AutoscalerConfig", "rollout_fleet"]

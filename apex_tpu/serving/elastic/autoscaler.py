@@ -1,0 +1,233 @@
+"""SLO-driven autoscaler — the fleet's size as a control variable.
+
+The controller is deliberately boring: one observation per fleet
+step, one score, one hysteresis band, one action in flight at a time.
+
+- **Score**: the windowed average of the fleet pressure gauge (max
+  over alive replicas — one saturated replica IS a capacity problem,
+  however idle its peers) plus ``debt_weight`` times the SLO-debt
+  growth over the same window (``SLOTracker``'s shed-token counters:
+  work the fleet already refused).  Pressure says "about to be
+  late"; debt growth says "already turning work away" — either alone
+  can be noise, together they cross the band exactly when capacity,
+  not placement, is the binding constraint.
+- **Hysteresis + cooldowns**: scale up at ``score >= up_pressure``,
+  down at ``score <= down_pressure``, with the dead band between
+  them and per-direction cooldowns (measured on the injected clock)
+  absorbing oscillation.  A scale-up also re-arms the DOWN cooldown:
+  the fresh replica must get a full window to absorb load before it
+  can be judged idle.
+- **One action at a time**: a scale-down is a rolling drain — the
+  victim (always the LAST replica: the affinity index stores
+  positional indices, so only tail removal keeps every stored index
+  valid) stops placing, its queued work moves to survivors, and only
+  when it runs dry is it retired.  While that drain converges the
+  controller takes no other action.
+
+Everything is deterministic for a (schedule, seed) pair: the clock is
+injected, the signals are pure functions of fleet state, and there is
+no randomness anywhere in the loop — the chaos soak replays the same
+scaling trajectory every run.
+
+Scale-up warms the NEW replica's prefix cache from a donor (the alive
+replica with the most registered blocks): the donor's radix tree is
+exported parent-before-child (``PrefixCache.export_nodes``), the KV
+bytes travel over the engine's CHECKSUMMED ``export_blocks`` /
+``import_blocks`` path (a torn transfer is rejected whole, exactly
+like a decode hand-off), and the imported blocks are registered +
+parked as evictable holds — so the first flash-crowd request the new
+replica sees can already hit cache.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Callable, Optional
+
+__all__ = ["Autoscaler", "AutoscalerConfig"]
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalerConfig:
+    """Hysteresis band, cooldowns, and bounds for one fleet.
+
+    ``up_pressure`` / ``down_pressure`` bracket the dead band on the
+    score (module docstring); ``debt_weight`` converts shed tokens
+    per window into score units (0 = pressure-only scaling);
+    ``window`` is the smoothing horizon in fleet steps;
+    ``up_cooldown_s`` / ``down_cooldown_s`` are per-direction action
+    spacings on the fleet clock; ``warm_blocks`` bounds the donor
+    prefix-cache transfer per scale-up (0 = cold start);
+    ``max_decisions`` bounds the decision log in ``stats()``."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    up_pressure: float = 0.85
+    down_pressure: float = 0.25
+    debt_weight: float = 0.01
+    window: int = 8
+    up_cooldown_s: float = 20.0
+    down_cooldown_s: float = 60.0
+    warm_blocks: int = 16
+    max_decisions: int = 64
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError(
+                f"min_replicas must be >= 1, got {self.min_replicas}")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"max_replicas={self.max_replicas} must be >= "
+                f"min_replicas={self.min_replicas}")
+        if not 0.0 <= self.down_pressure < self.up_pressure:
+            raise ValueError(
+                f"need 0 <= down_pressure < up_pressure (the "
+                f"hysteresis dead band), got down={self.down_pressure} "
+                f"up={self.up_pressure}")
+        if self.debt_weight < 0:
+            raise ValueError(
+                f"debt_weight must be >= 0, got {self.debt_weight}")
+        if self.window < 1:
+            raise ValueError(f"window must be >= 1, got {self.window}")
+        if self.up_cooldown_s < 0 or self.down_cooldown_s < 0:
+            raise ValueError("cooldowns must be >= 0")
+        if self.warm_blocks < 0:
+            raise ValueError(
+                f"warm_blocks must be >= 0, got {self.warm_blocks}")
+
+
+class Autoscaler:
+    """The per-fleet controller instance (one per ``RouterFleet``,
+    created by ``enable_elastic=True``).  :meth:`observe` runs at the
+    END of every fleet step, under the fleet's ops lock — it
+    therefore calls the fleet's UNLOCKED actuators (``_add_replica``
+    and friends), never the public locking wrappers."""
+
+    def __init__(self, fleet, cfg: Optional[AutoscalerConfig] = None,
+                 *, clock: Optional[Callable[[], float]] = None):
+        self.fleet = fleet
+        self.cfg = cfg if cfg is not None else AutoscalerConfig()
+        self.clock = clock if clock is not None else fleet.clock
+        self._pressure_win: deque = deque(maxlen=self.cfg.window)
+        # one extra slot so [-1] - [0] spans exactly `window` steps
+        self._debt_win: deque = deque(maxlen=self.cfg.window + 1)
+        self._last_up_t: Optional[float] = None
+        self._last_down_t: Optional[float] = None
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.retiring = None            # Replica mid-rolling-drain
+        self.decisions: deque = deque(maxlen=self.cfg.max_decisions)
+        self._last_action = "none"
+        self._score = 0.0
+        self._pressure_avg = 0.0
+        self._debt_delta = 0
+
+    # -- the control loop --------------------------------------------------
+
+    def observe(self) -> None:
+        """One controller tick (end of ``RouterFleet._step``)."""
+        fleet, cfg = self.fleet, self.cfg
+        now = self.clock()
+        self._pressure_win.append(fleet.pressure_gauge.val)
+        self._debt_win.append(fleet.shed_debt_tokens())
+        self._pressure_avg = (sum(self._pressure_win)
+                              / len(self._pressure_win))
+        self._debt_delta = self._debt_win[-1] - self._debt_win[0]
+        self._score = (self._pressure_avg
+                       + cfg.debt_weight * self._debt_delta)
+
+        # an in-flight scale-down converges before anything else may
+        # happen — one actuator at a time keeps the trajectory
+        # attributable (and the replica list stable per action)
+        if self.retiring is not None:
+            if fleet.replica_drained(self.retiring):
+                victim = self.retiring
+                self.retiring = None
+                fleet._remove_replica()
+                self._last_down_t = now
+                self.scale_downs += 1
+                self._decide("scale_down", now,
+                             replica=victim.name)
+            return
+
+        size = len(fleet.replicas)
+        if (self._score >= cfg.up_pressure
+                and size < cfg.max_replicas
+                and self._ready(self._last_up_t, cfg.up_cooldown_s,
+                                now)):
+            rep, warmed = fleet._add_replica(
+                warm_blocks=cfg.warm_blocks)
+            self._last_up_t = now
+            self._last_down_t = now     # fresh capacity gets a grace
+            self.scale_ups += 1         # window before any cull
+            self._decide("scale_up", now, replica=rep.name,
+                         warmed_blocks=warmed)
+            return
+
+        if (self._score <= cfg.down_pressure
+                and size > cfg.min_replicas
+                and self._ready(self._last_down_t,
+                                cfg.down_cooldown_s, now)):
+            victim = fleet.replicas[-1]
+            if victim.draining:
+                return                  # already leaving the fleet
+            fleet.router.drain_replica(victim)
+            self.retiring = victim
+            self._decide("drain", now, replica=victim.name)
+
+    @staticmethod
+    def _ready(last: Optional[float], cooldown: float,
+               now: float) -> bool:
+        return last is None or now - last >= cooldown
+
+    def _decide(self, action: str, now: float, **signals) -> None:
+        """Pin one decision everywhere it is postmortem-visible: the
+        bounded decision log (``stats()["elastic"]``), the fleet's
+        flight recorder, and a tracer instant."""
+        fleet = self.fleet
+        rec = {"kind": "elastic", "action": action,
+               "iter": fleet._iter, "t": now,
+               "pressure_avg": round(self._pressure_avg, 4),
+               "debt_delta": int(self._debt_delta),
+               "score": round(self._score, 4),
+               "replicas": len(fleet.replicas)}
+        rec.update(signals)
+        self.decisions.append(rec)
+        self._last_action = action
+        fleet.recorder.record(rec)
+        if fleet.tracer.enabled:
+            fleet.tracer.instant(f"elastic_{action}", **{
+                k: v for k, v in rec.items()
+                if isinstance(v, (int, float, str))})
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> dict:
+        """The pinned ``stats()["elastic"]`` block body."""
+        now = self.clock()
+        cfg = self.cfg
+        return {
+            "enabled": True,
+            "replicas": len(self.fleet.replicas),
+            "retired": len(self.fleet.retired_replicas),
+            "min_replicas": cfg.min_replicas,
+            "max_replicas": cfg.max_replicas,
+            "pressure_avg": round(self._pressure_avg, 4),
+            "debt_delta": int(self._debt_delta),
+            "score": round(self._score, 4),
+            "band": {"up": cfg.up_pressure,
+                     "down": cfg.down_pressure},
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
+            "retiring": (self.retiring.name
+                         if self.retiring is not None else None),
+            "cooldown": {
+                "up_ready": self._ready(self._last_up_t,
+                                        cfg.up_cooldown_s, now),
+                "down_ready": self._ready(self._last_down_t,
+                                          cfg.down_cooldown_s, now),
+            },
+            "last_action": self._last_action,
+            "decisions": list(self.decisions),
+        }

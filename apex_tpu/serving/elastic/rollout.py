@@ -1,0 +1,211 @@
+"""Zero-downtime weight rollout — a published checkpoint, one replica
+at a time, behind a parity gate.
+
+Params are an ARGUMENT to every compiled serving program, never a
+captured constant (``DecodeEngine.swap_params``), so swapping a
+replica's weights recompiles NOTHING — the only thing a rollout has
+to manage is WHEN each replica switches and what happens to state
+computed under the old weights.  The procedure per replica:
+
+1. **Parity gate** (before anything is drained): the probe prompts
+   replay on two STANDALONE servers — one holding the fleet's
+   current params, one holding the restored checkpoint — and their
+   outputs must match bit-for-bit.  The gate encodes what
+   "zero-downtime rollout" is for: output-equivalent re-publishes
+   (requantized, defragmented, re-exported weights).  A checkpoint
+   that CHANGES behavior must not silently mix versions inside one
+   fleet mid-traffic — it fails the gate, the rollout halts, and any
+   already-swapped replica rolls back, so the fleet always converges
+   to ONE version.  Probe servers are standalone on purpose: probes
+   through live replicas would pollute the fleet's finished ledgers
+   and break the soak's exactly-once accounting.
+2. **Drain**: the replica stops placing, queued work moves to the
+   survivors (the existing rolling-drain actuator), and the fleet
+   steps until the replica runs dry — in-flight requests ALWAYS
+   finish under the weights they started with.
+3. **Swap + purge**: ``engine.swap_params`` (both pools under
+   disaggregation), then the replica's prefix cache is evicted and
+   cleared — cached KV was computed under the old weights and must
+   never serve a post-swap request.
+4. **Verify + revive**: the swapped tree's per-leaf checksums are
+   compared against the checkpoint manifest
+   (``utils.checkpoint.tree_checksums``) — a torn swap is caught
+   before the replica takes traffic — then ``revive()`` returns it
+   to the rotation stamped with the new ``weights_version``.
+
+Any failure (parity mismatch, drain that will not converge, checksum
+mismatch) rolls the already-swapped replicas BACK through the same
+drain/swap/revive cycle, so partial rollouts are impossible to
+observe from outside: the fleet ends on exactly one version either
+way, and the report says which.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from apex_tpu.utils import checkpoint as ckpt
+
+__all__ = ["rollout_fleet"]
+
+_PROBE_TOKENS = 8
+_STEP_BUDGET = 512
+
+
+def _default_probes(cfg) -> List[List[int]]:
+    """Two deterministic probe prompts drawn from the model's vocab
+    (no RNG — the same fleet always probes the same prompts)."""
+    vocab = int(getattr(cfg, "vocab_size", 61))
+    return [[(3 + 7 * i + j) % vocab for j in range(6)]
+            for i in range(2)]
+
+
+def _probe_outputs(server, prompts: Sequence[Sequence[int]],
+                   tokens: int) -> List[List[int]]:
+    return server.generate(prompts, max_new_tokens=tokens)
+
+
+def _swap_replica(fleet, rep, params, version: Optional[str],
+                  step_budget: int) -> bool:
+    """Drain -> swap -> purge -> revive for one replica.  Returns
+    False when the drain did not converge within ``step_budget``
+    fleet steps (the replica is revived UNSWAPPED in that case)."""
+    fleet.drain_replica(rep)
+    for _ in range(step_budget):
+        if fleet.replica_drained(rep):
+            break
+        fleet.step()
+    else:
+        fleet.revive(rep)       # un-drain; still on its old weights
+        return False
+    srv = rep.server
+    srv.engine.swap_params(params)
+    if srv.prefill_engine is not None:
+        srv.prefill_engine.swap_params(params)
+    pc = srv.prefix_cache
+    if pc is not None:
+        # every cached block was computed under the OLD weights;
+        # drained means they are all ref-0 evictable holds
+        pc.evict(pc.num_evictable)
+        pc.clear()
+        # router-side affinity entries now point at a cold cache
+        fleet.router.affinity.drop_replica(rep.index)
+    fleet.revive(rep)
+    rep.weights_version = version
+    return True
+
+
+def rollout_fleet(fleet, checkpoint_dir: str, *,
+                  probe_prompts: Optional[Sequence[Sequence[int]]]
+                  = None,
+                  probe_tokens: int = _PROBE_TOKENS,
+                  step_budget: int = _STEP_BUDGET) -> dict:
+    """Roll the newest checkpoint under ``checkpoint_dir`` across
+    ``fleet`` (module docstring).  Returns a report dict — never
+    raises for an unhealthy rollout; ``status`` says what happened:
+
+    - ``"ok"``: every replica serves the new version.
+    - ``"no_checkpoint"``: nothing restorable under the directory.
+    - ``"unavailable"``: the fleet is draining or closed.
+    - ``"parity_mismatch"`` / ``"drain_stuck"`` /
+      ``"swap_corrupt"``: the rollout halted and rolled back; every
+      replica serves the OLD version.
+    """
+    if fleet.draining or fleet.closed:
+        return {"status": "unavailable", "step": None,
+                "version": None, "replicas_rolled": 0,
+                "rolled_back": 0, "detail": "fleet draining/closed"}
+    mgr = ckpt.CheckpointManager(checkpoint_dir)
+    res = mgr.restore_latest(target=fleet.params)
+    if res is None:
+        return {"status": "no_checkpoint", "step": None,
+                "version": None, "replicas_rolled": 0,
+                "rolled_back": 0,
+                "detail": f"no restorable checkpoint in "
+                          f"{checkpoint_dir}"}
+    new_params, step = res
+    version = f"step_{int(step)}"
+    want_sums = mgr.read_manifest(step)["leaf_checksums"]
+    old_params = fleet.params
+    prompts = (list(probe_prompts) if probe_prompts is not None
+               else _default_probes(fleet.cfg))
+
+    # standalone A/B probe pair — compiled once, replayed before each
+    # replica's promotion.  The autoscaler stands down while the
+    # rollout owns the replica list (one lifecycle driver at a time).
+    fleet._rollout_active = True
+    report = {"status": "ok", "step": int(step), "version": version,
+              "probes": len(prompts), "replicas_rolled": 0,
+              "rolled_back": 0, "detail": ""}
+    swapped = []
+    prev_version = {rep.name: rep.weights_version
+                    for rep in fleet.replicas}
+    try:
+        old_srv = fleet._probe_server(old_params)
+        new_srv = fleet._probe_server(new_params)
+        try:
+            for rep in list(fleet.replicas):
+                old_out = _probe_outputs(old_srv, prompts,
+                                         probe_tokens)
+                new_out = _probe_outputs(new_srv, prompts,
+                                         probe_tokens)
+                if old_out != new_out:
+                    report["status"] = "parity_mismatch"
+                    report["detail"] = (
+                        f"probe outputs diverged before promoting "
+                        f"{rep.name}; halting")
+                    break
+                if not _swap_replica(fleet, rep, new_params,
+                                     version, step_budget):
+                    report["status"] = "drain_stuck"
+                    report["detail"] = (
+                        f"{rep.name} did not drain within "
+                        f"{step_budget} steps")
+                    break
+                got = ckpt.tree_checksums(rep.server.engine.params)
+                if got != want_sums:
+                    report["status"] = "swap_corrupt"
+                    report["detail"] = (
+                        f"{rep.name} post-swap checksums do not "
+                        f"match the step {step} manifest")
+                    break
+                swapped.append(rep)
+                report["replicas_rolled"] += 1
+                _note(fleet, "rollout_replica", replica=rep.name,
+                      version=version)
+        finally:
+            old_srv.close()
+            new_srv.close()
+
+        if report["status"] != "ok":
+            # converge DOWN to the old version: re-swap everything
+            # that already promoted (same drain discipline — no
+            # in-flight request ever crosses a version boundary)
+            for rep in swapped:
+                _swap_replica(fleet, rep, old_params,
+                              prev_version[rep.name], step_budget)
+                report["rolled_back"] += 1
+        else:
+            # future scale-ups must build on the NEW weights, or the
+            # fleet would fork versions at the next flash crowd
+            fleet.params = new_params
+            fleet._weights_version = version
+    finally:
+        fleet._rollout_active = False
+    fleet._last_rollout = {"status": report["status"],
+                           "version": report["version"],
+                           "replicas_rolled": report["replicas_rolled"],
+                           "rolled_back": report["rolled_back"]}
+    _note(fleet, "rollout_done", status=report["status"],
+          version=report["version"] or "",
+          rolled=report["replicas_rolled"])
+    return report
+
+
+def _note(fleet, name: str, **fields) -> None:
+    rec = {"kind": "elastic", "action": name,
+           "iter": fleet._iter}
+    rec.update(fields)
+    fleet.recorder.record(rec)
+    if fleet.tracer.enabled:
+        fleet.tracer.instant(name, **fields)

@@ -235,6 +235,7 @@ class DecodeEngine:
             if tp_rules is None:
                 tp_rules = gpt_tp_rules(tp_axis)
             params = shard_params(params, mesh, tp_rules)
+            self._tp_rules = tp_rules
             self._repl = NamedSharding(mesh, P())
             self._pool_shard = NamedSharding(
                 mesh, P(None, None, tp_axis, None))
@@ -759,6 +760,22 @@ class DecodeEngine:
         self._account(jit_fn, mark, name, key=self._qkey(sb),
                       bucket=sb)
         return ids, fin
+
+    def swap_params(self, params) -> None:
+        """In-place weight swap: rebind ``self.params`` to a new
+        pytree WITHOUT touching any compiled program.  Params are an
+        ARGUMENT to every jitted call here (never a captured
+        constant), so as long as the new tree has the same structure,
+        shapes, and dtypes, the next launch simply traces nothing and
+        runs the existing executable with the new weights — this is
+        what makes a zero-downtime rollout (``serving/elastic``)
+        possible.  Under a mesh the new tree is resharded through the
+        same ``shard_params`` rules as construction, so placement is
+        identical too."""
+        if self.mesh is not None:
+            from apex_tpu.parallel.tensor_parallel import shard_params
+            params = shard_params(params, self.mesh, self._tp_rules)
+        self.params = params
 
     def chunk_prefill(self, tokens, start: int, block_table,
                       pad_to: Optional[int] = None) -> jax.Array:

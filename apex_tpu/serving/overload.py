@@ -48,6 +48,16 @@ Policy knobs, all with safe defaults (the layer is ON by default in
   priorities equal this degenerates to the historical
   youngest-first choice — preemption bit-stability tests are
   unaffected.
+- predictive **admission** (``predictive_admission=True``, OFF by
+  default): :class:`AdmissionEstimator` learns per-priority service
+  rates from finished requests' timelines and sheds a
+  wall-deadlined arrival at SUBMIT time when even the
+  fastest-ever-observed service for its class provably cannot beat
+  its ``deadline_s`` — the prefill such a request would burn is pure
+  waste, it times out regardless.  The bound is deliberately
+  one-sided (fastest observed TTFT/decode, never the mean) and armed
+  only after ``admission_min_history`` observations per class, so an
+  empty-history server behaves byte-identically to today.
 
 ``docs/resilience.md`` ("Overload policy & lifecycle") has the full
 shed / reject / breaker decision table.
@@ -56,8 +66,9 @@ shed / reject / breaker decision table.
 from __future__ import annotations
 
 import dataclasses
+from typing import Dict, Optional
 
-__all__ = ["OverloadPolicy"]
+__all__ = ["OverloadPolicy", "AdmissionEstimator"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -71,11 +82,24 @@ class OverloadPolicy:
     cliff.  ``best_effort_priority``: the priority class at which
     work becomes sheddable (default 1: every non-default class).
     ``displace``: whether queue-full arrivals may displace
-    lower-priority queued work."""
+    lower-priority queued work.
+
+    ``predictive_admission``: arm submit-time shedding of provably
+    deadline-doomed work (module docstring; OFF by default — the
+    cold-start path is byte-identical to a server without it).
+    ``admission_min_history``: finished-request observations a
+    priority class needs before its estimate is trusted.
+    ``admission_margin``: multiplier on the fastest-observed service
+    time before comparing against the deadline; ``1.0`` (default)
+    sheds only what the best case cannot save, larger values shed
+    earlier."""
 
     shed_threshold: float = 0.9
     best_effort_priority: int = 1
     displace: bool = True
+    predictive_admission: bool = False
+    admission_min_history: int = 8
+    admission_margin: float = 1.0
 
     def __post_init__(self):
         if self.shed_threshold <= 0:
@@ -86,6 +110,15 @@ class OverloadPolicy:
                 "best_effort_priority must be >= 1 (priority 0 is the "
                 f"never-shed default class), got "
                 f"{self.best_effort_priority}")
+        if self.admission_min_history < 1:
+            raise ValueError(
+                f"admission_min_history must be >= 1, got "
+                f"{self.admission_min_history}")
+        if self.admission_margin < 1.0:
+            raise ValueError(
+                "admission_margin must be >= 1.0 (below the "
+                "fastest-observed bound the shed is no longer "
+                f"provable), got {self.admission_margin}")
 
     def sheddable(self, priority: int) -> bool:
         return priority >= self.best_effort_priority
@@ -99,3 +132,93 @@ class OverloadPolicy:
         protecting the SLO cost" is a counter per priority class, not
         a guess (``docs/observability.md``, "SLO & goodput")."""
         return max(0, req.max_new_tokens - len(req.generated))
+
+
+class _ClassTrack:
+    """Fastest-observed service profile for one priority class."""
+
+    __slots__ = ("observed", "min_ttft_s", "min_decode_token_s")
+
+    def __init__(self):
+        self.observed = 0
+        self.min_ttft_s: Optional[float] = None
+        self.min_decode_token_s: Optional[float] = None
+
+
+class AdmissionEstimator:
+    """Per-priority service-rate learner behind predictive admission.
+
+    Feeds on finished requests' :meth:`Request.timeline` (only ones
+    that actually produced a first token — front-door rejections and
+    queue-only timeouts carry no service evidence) and keeps, per
+    priority class, the FASTEST observed submit-to-first-token and
+    per-token decode times.  :meth:`doomed` then answers one
+    question: can this arrival's ``deadline_s`` be met even if the
+    server serves it as fast as it has EVER served that class?  "No"
+    is a proof, not a prediction — the minimum over history is a
+    lower bound on service time — so shedding on it never discards a
+    request the live server could have saved.  Two conservative
+    guards keep false sheds out:
+
+    - with ``eos_id`` set (or fewer than ``min_history``
+      observations) only the first-token bound applies — the model
+      may stop after one token, so the full-budget bound is not a
+      proof;
+    - without a wall deadline nothing is ever predicted.
+    """
+
+    def __init__(self, *, min_history: int = 8, margin: float = 1.0):
+        self.min_history = int(min_history)
+        self.margin = float(margin)
+        self._tracks: Dict[int, _ClassTrack] = {}
+        self.predicted_sheds = 0
+
+    def observe(self, req) -> None:
+        """Fold one finished request's timeline into its class."""
+        tl = req.timeline()
+        ttft = tl.get("ttft_s")
+        if ttft is None:
+            return
+        tr = self._tracks.get(req.priority)
+        if tr is None:
+            tr = self._tracks[req.priority] = _ClassTrack()
+        tr.observed += 1
+        if tr.min_ttft_s is None or ttft < tr.min_ttft_s:
+            tr.min_ttft_s = ttft
+        dec = tl.get("decode_token_s")
+        if dec is not None and (tr.min_decode_token_s is None
+                                or dec < tr.min_decode_token_s):
+            tr.min_decode_token_s = dec
+
+    def doomed(self, req) -> bool:
+        """True iff ``req`` provably cannot meet its wall deadline."""
+        if req.deadline_s is None:
+            return False
+        tr = self._tracks.get(req.priority)
+        if tr is None or tr.observed < self.min_history \
+                or tr.min_ttft_s is None:
+            return False    # cold start: admit exactly as today
+        best = tr.min_ttft_s
+        if req.eos_id is None and req.max_new_tokens > 1 \
+                and tr.min_decode_token_s is not None:
+            # no early stop possible: the full token budget must land
+            best = best + (req.max_new_tokens - 1) \
+                * tr.min_decode_token_s
+        if best * self.margin > req.deadline_s:
+            self.predicted_sheds += 1
+            return True
+        return False
+
+    def as_stats(self) -> dict:
+        """The ``stats()["admission"]`` block (JSON-safe)."""
+        return {
+            "enabled": True,
+            "min_history": self.min_history,
+            "margin": self.margin,
+            "predicted_sheds": self.predicted_sheds,
+            "by_priority": {
+                p: {"observed": tr.observed,
+                    "min_ttft_s": tr.min_ttft_s,
+                    "min_decode_token_s": tr.min_decode_token_s}
+                for p, tr in sorted(self._tracks.items())},
+        }

@@ -179,6 +179,63 @@ class PrefixCache:
         self._children.setdefault(parent, set()).add(blk)
         return True
 
+    # -- cross-replica warm-up (serving/elastic) ---------------------------
+
+    def export_nodes(self, max_blocks: Optional[int] = None
+                     ) -> List[Tuple[int, Tuple[int, ...], int]]:
+        """The registered radix tree as ``(parent, chunk, block)``
+        rows in parent-before-child order (BFS from ``ROOT``,
+        children sorted by chunk tokens — deterministic for a given
+        index state).  A scale-up warms a NEW replica's cache from a
+        donor with this: rows bound by ``max_blocks`` always form a
+        valid tree prefix, so the importer can remap parent ids
+        row-by-row and never dangles a chain."""
+        budget = (len(self._key_of) if max_blocks is None
+                  else max(0, int(max_blocks)))
+        out: List[Tuple[int, Tuple[int, ...], int]] = []
+        frontier = [ROOT]
+        while frontier and len(out) < budget:
+            nxt: List[int] = []
+            for parent in frontier:
+                for blk in sorted(
+                        self._children.get(parent, ()),
+                        key=lambda b: self._key_of[b][1]):
+                    if len(out) >= budget:
+                        return out
+                    out.append((parent, self._key_of[blk][1], blk))
+                    nxt.append(blk)
+            frontier = nxt
+        return out
+
+    def seed_nodes(self, nodes, id_map: Dict[int, int]) -> int:
+        """Register imported donor nodes under THIS cache's block ids
+        and park them as evictable LRU holds.  ``nodes`` is a donor
+        :meth:`export_nodes` listing; ``id_map`` maps donor block id
+        -> local block id (freshly allocated, refcount 1, KV bytes
+        already imported via the checksummed ``import_blocks`` path).
+        A node whose key is already taken (or whose parent failed to
+        seed) frees its local block back to the pool.  Returns how
+        many blocks were seeded."""
+        seeded = 0
+        for parent, chunk, src_blk in nodes:
+            dst = id_map[src_blk]
+            dst_parent = ROOT if parent == ROOT \
+                else id_map.get(parent, -1)
+            ok = False
+            if dst_parent != -1 and (dst_parent == ROOT
+                                     or dst_parent in self._key_of):
+                ok = self.register(dst_parent, tuple(chunk), dst)
+            if ok:
+                seeded += 1
+                # drop our alloc ref: the release hook parks the
+                # registered block in the evictable LRU — warm, free
+                # to reclaim, exactly like a finished request's prefix
+                self.allocator.free([dst])
+            else:
+                del id_map[src_blk]     # descendants must not chain
+                self.allocator.free([dst])  # unregistered -> free list
+        return seeded
+
     # -- eviction ---------------------------------------------------------
 
     def evict(self, n: int = 1) -> int:

@@ -57,6 +57,8 @@ from apex_tpu.observability import (
 from apex_tpu.resilience.breaker import CircuitBreaker
 from apex_tpu.serving import reasons
 from apex_tpu.serving.api import InferenceServer
+from apex_tpu.serving.elastic import Autoscaler, AutoscalerConfig
+from apex_tpu.serving.elastic.rollout import rollout_fleet
 from apex_tpu.serving.router.policy import RouterPolicy
 from apex_tpu.serving.router.replica import Replica
 from apex_tpu.serving.router.router import ReplicaRouter, RouterRequest
@@ -150,6 +152,8 @@ class RouterFleet:
                  disagg_prefill_threshold: Optional[int] = None,
                  enable_streaming: bool = True,
                  stream_queue_tokens: int = 256,
+                 enable_elastic: bool = False,
+                 elastic: Optional[AutoscalerConfig] = None,
                  **server_kwargs):
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
@@ -165,6 +169,18 @@ class RouterFleet:
             else MetricsRegistry()
         self.tracer = tracer if tracer is not None else get_tracer()
         self.clock = clock
+        # the fleet keeps its construction recipe: scale-up builds
+        # new replicas from the same factory/kwargs, and rollout
+        # rebinds self.params so post-rollout scale-ups serve the
+        # NEW weights (serving/elastic)
+        self.cfg = cfg
+        self.params = params
+        self._server_kwargs = dict(server_kwargs)
+        self._breaker_factory = breaker_factory
+        self._weights_version: Optional[str] = None
+        self._last_rollout: Optional[dict] = None
+        self._rollout_active = False
+        self.retired_replicas: List[Replica] = []
         meshes: List = [None] * replicas
         if tp:
             import jax
@@ -183,7 +199,11 @@ class RouterFleet:
 
         def default_server(i: int) -> InferenceServer:
             kw = dict(server_kwargs)
-            if meshes[i] is not None:
+            # scaled-up replicas (i beyond the construction-time
+            # fleet) are meshless "any"-role; reading self.params
+            # (not the closure arg) keeps them on the rolled-out
+            # weight version
+            if i < len(meshes) and meshes[i] is not None:
                 kw.setdefault("mesh", meshes[i])
                 kw.setdefault("tp_axis", tp_axis)
             if i < disagg_prefill:
@@ -193,9 +213,11 @@ class RouterFleet:
                 # (wired below); its own decode pool stays the
                 # last-resort local fallback
                 kw.setdefault("enable_disagg", True)
-            return InferenceServer(cfg, params, clock=clock, **kw)
+            return InferenceServer(cfg, self.params, clock=clock,
+                                   **kw)
 
         build = make_server or default_server
+        self._build = build
         self.replicas: List[Replica] = []
         for i in range(replicas):
             srv = build(i)
@@ -270,6 +292,15 @@ class RouterFleet:
             if enable_streaming else None)
         self._stream_reqs: dict = {}     # rid -> RouterRequest
         self._stream_cursors: dict = {}  # rid -> publish high-water
+        # elastic control loop (docs/serving.md, "Elastic fleet"):
+        # OFF by default — a fleet without it is byte-identical to
+        # the pre-elastic fleet.  Scaled-up replicas take serial
+        # names (replicaN, N ever-increasing) so a retire + regrow
+        # never aliases stats rows.
+        self._replica_serial = replicas
+        self.autoscaler: Optional[Autoscaler] = (
+            Autoscaler(self, elastic, clock=clock)
+            if enable_elastic else None)
         self.ops: Optional[OpsServer] = None
         self._ops_lock = None
         if ops_port is not None:
@@ -340,7 +371,146 @@ class RouterFleet:
                 peak = p
         self.pressure_gauge.update(peak)
         self._pump_streams()
+        # the control loop ticks last, on this step's fresh gauges;
+        # it stands down while a drain or rollout owns the replica
+        # list (one lifecycle driver at a time)
+        if self.autoscaler is not None and not self._draining \
+                and not self._rollout_active:
+            self.autoscaler.observe()
         return produced
+
+    # -- elastic fleet (docs/serving.md, "Elastic fleet") ------------------
+
+    def shed_debt_tokens(self) -> int:
+        """Cumulative SLO debt (shed tokens) across the fleet —
+        retired replicas included, so the autoscaler's trend signal
+        never jumps backwards on a scale-down."""
+        return sum(
+            rep.server.slo.as_stats()["debt"]["shed_tokens"]
+            for rep in self.replicas + self.retired_replicas)
+
+    def add_replica(self, *, warm_blocks: int = 0) -> Replica:
+        """Grow the fleet by one replica built from the construction
+        recipe (factory or default kwargs), optionally warming its
+        prefix cache from a donor.  Manual actuator — the autoscaler
+        calls the unlocked body."""
+        with (self._ops_lock or _NO_LOCK):
+            rep, _ = self._add_replica(warm_blocks=warm_blocks)
+            return rep
+
+    def _add_replica(self, *, warm_blocks: int = 0):
+        i = len(self.replicas)
+        srv = self._build(i)
+        breaker = (self._breaker_factory(i)
+                   if self._breaker_factory is not None
+                   else CircuitBreaker(failure_threshold=3,
+                                       clock=self.clock))
+        name = f"replica{self._replica_serial}"
+        self._replica_serial += 1
+        rep = Replica(i, srv, name=name, breaker=breaker, role="any")
+        rep.weights_version = self._weights_version
+        # append-at-end ONLY: the affinity index stores positional
+        # replica indices, so any other insertion point would remap
+        # every existing entry under the router's feet
+        self.replicas.append(rep)
+        self.router.add_replica(rep)
+        self._replica_pressure.append(
+            GaugeMeter(registry=self.registry,
+                       name="router_replica_pressure",
+                       replica=rep.name))
+        warmed = self._warm_replica(rep, warm_blocks) \
+            if warm_blocks > 0 else 0
+        return rep, warmed
+
+    def _warm_replica(self, rep: Replica, max_blocks: int) -> int:
+        """Seed the new replica's prefix cache from the best donor
+        over the checksummed block-transfer path.  Best-effort: any
+        failure (no donor, no spare blocks, torn payload) leaves the
+        replica cold, never broken."""
+        dst_srv = rep.server
+        dst_pc = dst_srv.prefix_cache
+        if dst_pc is None:
+            return 0
+        donor, best = None, 0
+        for cand in self.replicas:
+            if cand is rep or not cand.alive or cand.draining:
+                continue
+            pc = cand.server.prefix_cache
+            if pc is not None and pc.num_cached_blocks > best:
+                best = pc.num_cached_blocks
+                donor = cand
+        if donor is None:
+            return 0
+        src_srv = donor.server
+        nodes = src_srv.prefix_cache.export_nodes(max_blocks)
+        if not nodes:
+            return 0
+        # the engines that OWN the prefix pool (the prefill pool
+        # under disaggregation)
+        src_eng = src_srv.prefill_engine or src_srv.engine
+        dst_eng = dst_srv.prefill_engine or dst_srv.engine
+        # warm only into genuinely spare capacity: the new replica
+        # must still admit a full-context request immediately
+        spare = dst_eng.allocator.num_free - dst_eng.blocks_per_seq
+        n = min(len(nodes), max(0, spare))
+        if n <= 0:
+            return 0
+        nodes = nodes[:n]
+        src_ids = [blk for _, _, blk in nodes]
+        try:
+            payload = src_eng.export_blocks(src_ids)
+        except Exception:
+            return 0
+        dst_ids = dst_eng.allocator.alloc(n)
+        try:
+            dst_eng.import_blocks(dst_ids, payload)
+        except ValueError:
+            # torn transfer: the checksum rejected it whole — free
+            # the staging blocks and start cold
+            dst_eng.allocator.free(dst_ids)
+            return 0
+        return dst_pc.seed_nodes(nodes, dict(zip(src_ids, dst_ids)))
+
+    def remove_replica(self) -> Replica:
+        """Retire the LAST replica (it must already be drained dry —
+        ``drain_replica`` + stepping first).  The server closes; the
+        replica moves to ``retired_replicas`` so its finished ledger
+        keeps counting in fleet aggregates."""
+        with (self._ops_lock or _NO_LOCK):
+            return self._remove_replica()
+
+    def _remove_replica(self) -> Replica:
+        rep = self.replicas[-1]
+        if not (rep.draining and not rep.server.has_work):
+            raise RuntimeError(
+                f"{rep.name} still has work or is not draining; "
+                f"drain it dry before remove_replica()")
+        self.replicas.pop()
+        self.router.remove_replica(rep)
+        gauge = self._replica_pressure.pop()
+        gauge.update(0.0)
+        rep.server.close()
+        self.retired_replicas.append(rep)
+        return rep
+
+    def _probe_server(self, params) -> InferenceServer:
+        """A standalone (never-routed) server for the rollout parity
+        audit — same model kwargs as a default replica, its own
+        private registry, NO entry in any fleet ledger, so probe
+        traffic can never pollute the soaks' exactly-once
+        accounting."""
+        return InferenceServer(self.cfg, params, clock=self.clock,
+                               **self._server_kwargs)
+
+    def rollout(self, checkpoint_dir: str, **kwargs) -> dict:
+        """Zero-downtime weight rollout of the newest checkpoint
+        under ``checkpoint_dir`` (``serving/elastic/rollout.py``:
+        per-replica drain -> swap -> verify -> revive behind an A/B
+        output-parity gate; halt + rollback on any failure).  Runs
+        UNLOCKED like :meth:`drain` — every fleet call it makes
+        self-locks, and holding the ops lock across a multi-step
+        drain would starve the handlers."""
+        return rollout_fleet(self, checkpoint_dir, **kwargs)
 
     # -- streaming & cancellation (docs/serving.md) ------------------------
 
@@ -568,12 +738,30 @@ class RouterFleet:
         with (self._ops_lock or _NO_LOCK):
             return self._stats()
 
+    def _elastic_stats(self) -> dict:
+        """The pinned ``stats()["elastic"]`` block: the autoscaler's
+        decision table when the control loop is on, the minimal
+        shape otherwise — plus the rollout/version fields either
+        way (rollout works on non-autoscaled fleets too)."""
+        st = (self.autoscaler.stats() if self.autoscaler is not None
+              else {"enabled": False})
+        census: dict = {}
+        for rep in self.replicas:
+            v = rep.weights_version or "initial"
+            census[v] = census.get(v, 0) + 1
+        st["weights_versions"] = census
+        st["last_rollout"] = self._last_rollout
+        return st
+
     def _stats(self) -> dict:
         router = self.router.router_stats()
         router["steps"] = self._iter
         router["threaded"] = self.threaded
         hit = miss = finished = tokens = 0
-        for rep in self.replicas:
+        # retired replicas stay in the ledger: a scale-down must not
+        # make finished work or generated tokens vanish from the
+        # fleet's aggregates (the soak reconciles on these)
+        for rep in self.replicas + self.retired_replicas:
             srv = rep.server
             hit += srv.prefix.count("prefix_hit_tokens")
             miss += srv.prefix.count("prefix_miss_tokens")
@@ -592,4 +780,5 @@ class RouterFleet:
             "pressure_peak": round(self.pressure_gauge.peak, 3),
             "draining": self._draining,
             "streams": self._stream_stats(),
+            "elastic": self._elastic_stats(),
         }

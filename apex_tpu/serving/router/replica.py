@@ -74,6 +74,9 @@ class Replica:
         self.steps = 0
         self.step_failures = 0
         self.last_error: Optional[str] = None
+        # which published weights this replica serves (None until a
+        # rollout stamps it — "initial" in stats; serving/elastic)
+        self.weights_version: Optional[str] = None
         # breaker-state edge detection: the router fails over exactly
         # once per closed/half_open -> open transition
         self.last_breaker_state = self.breaker.state
@@ -115,12 +118,20 @@ class Replica:
     # -- health ------------------------------------------------------------
 
     def health(self, *, via_http: bool = False,
-               timeout: float = 2.0) -> dict:
+               timeout: float = 0.5, retries: int = 1) -> dict:
         """The replica's health view — status / pressure / draining /
         live_requests.  In-process reads by default; ``via_http=True``
         scrapes the attached ops plane's ``GET /healthz`` (the wire
         contract a cross-process router uses), raising
-        :class:`RuntimeError` when no ops plane is attached."""
+        :class:`RuntimeError` when no ops plane is attached.
+
+        The HTTP scrape is BOUNDED: ``timeout`` caps both connect and
+        read per attempt and a connect/read failure gets exactly
+        ``retries`` more attempts before the probe gives up with
+        ``{"status": "unreachable"}`` instead of raising — a wedged
+        replica (accepts the socket, never answers) costs the caller
+        at most ``timeout * (1 + retries)`` seconds and can never
+        stall a fleet ``step()`` loop on an exception path."""
         if via_http:
             ops = getattr(self.server, "ops", None)
             if ops is None:
@@ -128,12 +139,20 @@ class Replica:
                     f"{self.name} has no ops plane attached "
                     f"(ops_port=) to scrape /healthz from")
             url = f"http://{ops.host}:{ops.port}/healthz"
-            try:
-                with urllib.request.urlopen(url,
-                                            timeout=timeout) as r:
-                    return json.loads(r.read())
-            except urllib.error.HTTPError as e:      # 503 still has
-                return json.loads(e.read())          # a JSON body
+            last_err = "unknown"
+            for _ in range(1 + max(0, int(retries))):
+                try:
+                    with urllib.request.urlopen(url,
+                                                timeout=timeout) as r:
+                        return json.loads(r.read())
+                except urllib.error.HTTPError as e:  # 503 still has
+                    return json.loads(e.read())      # a JSON body
+                except (urllib.error.URLError, OSError,
+                        ValueError) as e:
+                    last_err = str(e) or type(e).__name__
+            return {"status": "unreachable", "error": last_err,
+                    "pressure": None, "draining": None,
+                    "live_requests": None}
         srv = self.server
         if srv.closed:
             status = "closed"
@@ -169,5 +188,6 @@ class Replica:
             "steps": self.steps,
             "step_failures": self.step_failures,
             "last_error": self.last_error,
+            "weights_version": self.weights_version or "initial",
             "breaker": self.breaker.state_snapshot(),
         }

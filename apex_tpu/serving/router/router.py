@@ -485,10 +485,44 @@ class ReplicaRouter:
                 old.close()
             rep.server = server
             self.affinity.drop_replica(rep.index)
+        elif rep.server.draining and not rep.server.closed:
+            # same-server revive (in-place weight rollout): reopen
+            # the admissions ``begin_drain()`` closed — the server
+            # kept its compiled programs, only its params moved
+            rep.server.end_drain()
         rep.draining = False
         rep.breaker.reset()
         rep.last_breaker_state = rep.breaker.state
         self.events.incr("revives")
+
+    # -- elastic membership (serving/elastic) ------------------------------
+
+    def add_replica(self, rep: Replica) -> None:
+        """Admit a new replica to the rotation.  Append-at-end ONLY:
+        the router holds its own copy of the replica list and the
+        affinity index stores positional indices into it, so the new
+        replica's ``index`` must equal its position here AND in the
+        fleet's list."""
+        if rep.index != len(self.replicas):
+            raise ValueError(
+                f"replica index {rep.index} must equal its position "
+                f"{len(self.replicas)} (affinity indices are "
+                f"positional)")
+        self.replicas.append(rep)
+        self.events.incr("scale_ups")
+
+    def remove_replica(self, rep: Replica) -> None:
+        """Retire a replica from the rotation — the TAIL one only
+        (removing any other position would shift every index the
+        affinity map stores).  Its affinity chains are dropped so no
+        placement ever resolves to the retired position."""
+        if not self.replicas or self.replicas[-1] is not rep:
+            raise ValueError(
+                f"only the tail replica may be removed (got "
+                f"{rep.name}); drain + remove from the end")
+        self.replicas.pop()
+        self.affinity.drop_replica(rep.index)
+        self.events.incr("scale_downs")
 
     # -- stats -------------------------------------------------------------
 

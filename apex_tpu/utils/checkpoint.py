@@ -139,6 +139,16 @@ def leaf_checksum(leaf) -> str:
     return f"{crc:08x}:{a.dtype.str}:{'x'.join(map(str, a.shape))}"
 
 
+def tree_checksums(state: Pytree) -> List[str]:
+    """Per-leaf :func:`leaf_checksum` fingerprints of ``state``, in
+    ``tree_flatten`` order — the same order a :class:`CheckpointManager`
+    manifest records them in, so a live pytree can be verified against
+    a published checkpoint without re-reading the payload (the serving
+    rollout's per-replica swap audit does exactly this)."""
+    return [leaf_checksum(x)
+            for x in jax.tree_util.tree_leaves(jax.device_get(state))]
+
+
 def _fsync_path(path: str) -> None:
     fd = os.open(path, os.O_RDONLY)
     try:

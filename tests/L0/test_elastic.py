@@ -1,0 +1,539 @@
+"""Elastic fleet: capacity may change, tokens may not.
+
+The ``serving/elastic`` tier (docs/serving.md, "Elastic fleet") has
+three moving parts — the SLO-driven autoscaler, predictive admission,
+and the zero-downtime weight rollout — and every one of them is a
+way to lose or corrupt work if its lifecycle is wrong.  The tests
+here pin the contracts the flash-crowd chaos soak
+(``resilience.chaos.run_elastic_soak``) judges at scale:
+
+- the autoscaler's hysteresis loop actually scales up under sustained
+  pressure and rolls back down when idle, with every decision pinned
+  into ``stats()["elastic"]`` alongside the signal values it fired
+  on, and with zero healthy-request loss across membership churn;
+- a scale-up's prefix warm really seeds the newcomer's cache from the
+  donor (checksummed block import, not a cold start);
+- ``fleet.rollout()`` swaps weights replica-by-replica behind the A/B
+  output-parity gate — a parity-identical checkpoint converges every
+  replica to one version bit-exactly, a behavior-changing checkpoint
+  halts and rolls back to the old weights everywhere;
+- predictive admission sheds provably deadline-doomed arrivals at
+  submit once it has history, and behaves byte-identically to a
+  policy without it before it has any;
+- the breaker's ``half_open_backoff`` decorrelated jitter slows
+  probes into a flapping replica and resets on recovery, with
+  ``None`` keeping the legacy fixed cadence;
+- ``Replica.health(via_http=True)`` is BOUNDED against a wedged ops
+  endpoint (accepts the socket, never answers): ``timeout * (1 +
+  retries)`` wall-clock worst case, an ``unreachable`` answer, never
+  an exception;
+- ``router.revive(rep, server=...)`` with a server rebuilt from
+  ``CheckpointManager.restore_latest`` weights is bit-exact with the
+  never-drained baseline.
+
+Tier budget: the tier-1 wall budget is saturated, so the tests that
+pay probe-server compiles (rollout, warm, restore-revive, the mini
+soak) are ``slow``-marked — the build-matrix ``elastic`` axis runs
+this file WITHOUT the marker filter, so they gate every build anyway.
+"""
+
+import socket
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import models
+from apex_tpu.resilience.breaker import CircuitBreaker
+from apex_tpu.resilience.chaos import ChaosConfig, run_elastic_soak
+from apex_tpu.serving import InferenceServer, RouterFleet
+from apex_tpu.serving.elastic import AutoscalerConfig
+from apex_tpu.serving.overload import AdmissionEstimator, OverloadPolicy
+from apex_tpu.serving.reasons import HEALTHY_REASONS, SHED
+from apex_tpu.utils import checkpoint as ckpt
+
+pytestmark = pytest.mark.serving
+
+VOCAB = 64
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = models.GPTConfig(
+        vocab_size=VOCAB, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=160, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+    m = models.GPTLMHeadModel(cfg)
+    params = m.init(jax.random.PRNGKey(1),
+                    jnp.ones((1, 8), jnp.int32))["params"]
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def oracle(tiny):
+    """ONE shared single-replica reference server: the parity
+    baseline for every rollout/revive test without re-paying its
+    compiles per test."""
+    cfg, params = tiny
+    server = _single(cfg, params)
+
+    def ref(prompts, n):
+        return server.generate(prompts, max_new_tokens=n)
+
+    return ref
+
+
+def _prompts(seed, n, lo=4, hi=16):
+    rng = np.random.RandomState(seed)
+    return [list(rng.randint(0, VOCAB, size=int(rng.randint(lo, hi))))
+            for _ in range(n)]
+
+
+def _single(cfg, params, **kw):
+    kw.setdefault("cache_dtype", jnp.float32)
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_context", 128)
+    kw.setdefault("block_size", 8)
+    return InferenceServer(cfg, params, **kw)
+
+
+def _fleet(cfg, params, n=1, **kw):
+    kw.setdefault("cache_dtype", jnp.float32)
+    kw.setdefault("max_batch_size", 2)
+    kw.setdefault("max_context", 128)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("enable_speculation", False)
+    return RouterFleet(cfg, params, replicas=n, **kw)
+
+
+def _elastic_cfg(**kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 2)
+    kw.setdefault("up_pressure", 0.6)
+    kw.setdefault("down_pressure", 0.2)
+    kw.setdefault("window", 2)
+    kw.setdefault("up_cooldown_s", 0.0)
+    kw.setdefault("down_cooldown_s", 5.0)
+    kw.setdefault("warm_blocks", 4)
+    return AutoscalerConfig(**kw)
+
+
+# -- autoscaler ------------------------------------------------------------
+
+
+def test_autoscaler_scales_up_then_down_zero_loss(tiny):
+    """Sustained pressure grows the fleet, idle shrinks it back; the
+    churn loses no healthy request, and every decision lands in
+    ``stats()["elastic"]`` with the signals it fired on."""
+    cfg, params = tiny
+    t = {"t": 0.0}
+    fleet = _fleet(cfg, params, num_blocks=24, max_waiting=8,
+                   clock=lambda: t["t"], enable_elastic=True,
+                   elastic=_elastic_cfg())
+    reqs = []
+    try:
+        for i in range(120):
+            t["t"] = float(i)
+            if i < 25:
+                reqs.append(fleet.submit(
+                    _prompts(100 + i, 1, lo=6, hi=12)[0], 12,
+                    priority=0))
+            fleet.step()
+            for rep in fleet.replicas:
+                rep.server.scheduler.audit()
+            if not fleet.has_work and i > 25:
+                break
+        st = fleet.stats()["elastic"]
+        assert st["enabled"] is True
+        assert st["scale_ups"] >= 1, st
+        assert st["scale_downs"] >= 1, st
+        assert len(fleet.replicas) == 1
+        assert len(fleet.retired_replicas) >= 1
+        # retirement is rolling-drain: the victim left the fleet dry
+        for rep in fleet.retired_replicas:
+            assert rep.server.closed
+        # decision log carries action + trigger signals
+        actions = [d["action"] for d in st["decisions"]]
+        assert "scale_up" in actions and "scale_down" in actions
+        for d in st["decisions"]:
+            assert d["kind"] == "elastic"
+            assert {"iter", "t", "pressure_avg", "debt_delta",
+                    "score", "replicas"} <= d.keys()
+        up = next(d for d in st["decisions"]
+                  if d["action"] == "scale_up")
+        assert up["score"] >= 0.6
+        # zero healthy-request loss across the churn
+        assert all(r.finish_reason in HEALTHY_REASONS for r in reqs)
+    finally:
+        fleet.close()
+
+
+def test_autoscaler_respects_cooldown_and_bounds(tiny):
+    """Back-to-back pressure must not blow past ``max_replicas`` or
+    the up-cooldown spacing."""
+    cfg, params = tiny
+    t = {"t": 0.0}
+    fleet = _fleet(cfg, params, num_blocks=16, max_waiting=4,
+                   clock=lambda: t["t"], enable_elastic=True,
+                   elastic=_elastic_cfg(max_replicas=2,
+                                        up_cooldown_s=1000.0))
+    try:
+        for i in range(40):
+            t["t"] = float(i)
+            try:
+                fleet.submit(_prompts(i, 1, lo=8, hi=16)[0], 16,
+                             priority=1)
+            except RuntimeError:
+                pass                    # queue full IS the pressure
+            fleet.step()
+        st = fleet.stats()["elastic"]
+        assert len(fleet.replicas) <= 2
+        # one scale-up max: the second would need the 1000 s cooldown
+        assert st["scale_ups"] <= 1
+        assert st["cooldown"]["up_ready"] is False
+        fleet.drain()
+    finally:
+        fleet.close()
+
+
+@pytest.mark.slow
+def test_scale_up_warms_prefix_cache_from_donor(tiny):
+    """A warm scale-up imports checksummed donor blocks — the
+    newcomer starts with cache hits, not a cold start — and the
+    warmed replica serves bit-identically."""
+    cfg, params = tiny
+    fleet = _fleet(cfg, params, num_blocks=32, enable_elastic=False)
+    try:
+        shared = _prompts(7, 1, lo=16, hi=17)[0]
+        prompts = [shared + p for p in _prompts(8, 4, lo=2, hi=6)]
+        base = fleet.generate(prompts, max_new_tokens=8)
+        rep, warmed = fleet._add_replica(warm_blocks=8)
+        assert warmed > 0
+        pc = rep.server.scheduler.prefix_cache
+        assert pc.num_cached_blocks >= warmed
+        # the warmed newcomer answers bit-identically to the fleet
+        out = rep.server.generate(prompts, max_new_tokens=8)
+        assert out == base
+    finally:
+        fleet.close()
+
+
+# -- rollout ---------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_rollout_ok_converges_bit_exact(tiny, oracle, tmp_path):
+    """A parity-identical checkpoint rolls every replica to the new
+    version with zero downtime and bit-exact outputs."""
+    cfg, params = tiny
+    fleet = _fleet(cfg, params, n=2, num_blocks=32,
+                   enable_elastic=False)
+    try:
+        prompts = _prompts(21, 4)
+        before = fleet.generate(prompts, max_new_tokens=12)
+        mgr = ckpt.CheckpointManager(str(tmp_path / "pub"))
+        mgr.save(1, fleet.params)
+        report = fleet.rollout(str(tmp_path / "pub"))
+        assert report["status"] == "ok", report
+        assert report["replicas_rolled"] == 2
+        st = fleet.stats()["elastic"]
+        assert set(st["weights_versions"]) == {"step_1"}
+        assert st["last_rollout"]["status"] == "ok"
+        # zero downtime: the fleet serves right through, bit-exact
+        after = fleet.generate(prompts, max_new_tokens=12)
+        want = oracle(prompts, 12)
+        assert after == before == want
+    finally:
+        fleet.close()
+
+
+@pytest.mark.slow
+def test_rollout_parity_mismatch_halts_and_rolls_back(tiny, oracle,
+                                                      tmp_path):
+    """A behavior-changing checkpoint must FAIL CLOSED: parity gate
+    trips, no replica keeps the new weights, the fleet still serves
+    the old version bit-exactly."""
+    cfg, params = tiny
+    fleet = _fleet(cfg, params, n=2, num_blocks=32,
+                   enable_elastic=False)
+    try:
+        # no checkpoint at all: judged, not tracebacked
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert fleet.rollout(str(empty))["status"] == "no_checkpoint"
+
+        bad = jax.tree_util.tree_map(lambda x: x * 1.5, fleet.params)
+        mgr = ckpt.CheckpointManager(str(tmp_path / "bad"))
+        mgr.save(1, bad)
+        report = fleet.rollout(str(tmp_path / "bad"))
+        assert report["status"] == "parity_mismatch", report
+        assert report["replicas_rolled"] == 0
+        st = fleet.stats()["elastic"]
+        assert set(st["weights_versions"]) == {"initial"}
+        assert st["last_rollout"]["status"] == "parity_mismatch"
+        prompts = _prompts(33, 3)
+        got = fleet.generate(prompts, max_new_tokens=10)
+        assert got == oracle(prompts, 10)
+    finally:
+        fleet.close()
+
+
+# -- predictive admission --------------------------------------------------
+
+
+def test_admission_estimator_learning_and_proof_bound():
+    """The estimator's ``doomed`` is a proof on the fastest-observed
+    bound: unarmed before ``min_history``, never fires without a wall
+    deadline, fires only when even the best case cannot win."""
+
+    class _Req:
+        def __init__(self, deadline_s=None, max_new_tokens=8,
+                     eos_id=None, priority=0):
+            self.deadline_s = deadline_s
+            self.max_new_tokens = max_new_tokens
+            self.eos_id = eos_id
+            self.priority = priority
+            self.generated = []
+
+        def timeline(self):
+            # the derived view the estimator feeds on: fastest
+            # submit-to-first-token 2 s, 1 s per decode token
+            return {"ttft_s": 2.0, "decode_token_s": 1.0}
+
+    est = AdmissionEstimator(min_history=3, margin=1.0)
+    probe = _Req(deadline_s=0.5, max_new_tokens=1)
+    assert not est.doomed(probe)        # no history yet: admit
+    for _ in range(3):
+        done = _Req()
+        done.generated = [1] * 8
+        est.observe(done)
+    # fastest TTFT ever seen is 2 s — a 0.5 s deadline is provably
+    # dead, a 60 s one is fine, and no wall deadline never predicts
+    assert est.doomed(_Req(deadline_s=0.5, max_new_tokens=1))
+    assert not est.doomed(_Req(deadline_s=60.0))
+    assert not est.doomed(_Req(deadline_s=None))
+    st = est.as_stats()
+    assert st["enabled"] and st["by_priority"][0]["observed"] == 3
+
+
+def test_predictive_admission_sheds_doomed_at_submit(tiny):
+    """End-to-end: a server with history sheds a deadline-doomed
+    arrival at SUBMIT (finish_reason ``shed``, counted in
+    ``stats()["admission"]``), while a pre-history server admits the
+    identical arrival — the cold-start contract."""
+    cfg, params = tiny
+    t = {"t": 0.0}
+    srv = _single(
+        cfg, params, clock=lambda: t["t"],
+        overload_policy=OverloadPolicy(predictive_admission=True,
+                                       admission_min_history=2))
+    try:
+        doomed_prompt = _prompts(50, 1)[0]
+        # cold start: no history, the doomed-looking arrival admits
+        r0 = srv.submit(doomed_prompt, 4, priority=0,
+                        deadline_s=1e-6)
+        assert r0.finish_reason != SHED
+        # build history (each decode iteration advances the clock, so
+        # observed TTFT is strictly positive)
+        reqs = [srv.submit(p, 6, priority=0, deadline_s=600.0)
+                for p in _prompts(51, 3)]
+        while srv.has_work:
+            t["t"] += 1.0
+            srv.step()
+        assert all(r.finish_reason in HEALTHY_REASONS for r in reqs)
+        st = srv.stats()["admission"]
+        assert st["by_priority"][0]["observed"] >= 2
+        # now the same impossible deadline is a proof: shed at submit
+        r1 = srv.submit(doomed_prompt, 4, priority=0,
+                        deadline_s=1e-6)
+        assert r1.finish_reason == SHED
+        assert srv.stats()["admission"]["predicted_sheds"] >= 1
+        # a roomy deadline still admits and finishes healthy
+        r2 = srv.submit(doomed_prompt, 4, priority=0,
+                        deadline_s=600.0)
+        while srv.has_work:
+            t["t"] += 1.0
+            srv.step()
+        assert r2.finish_reason in HEALTHY_REASONS
+    finally:
+        srv.close()
+
+
+# -- breaker half-open backoff ---------------------------------------------
+
+
+def _trip(br):
+    for _ in range(br.failure_threshold):
+        br.record_failure()
+
+
+def test_breaker_half_open_backoff_grows_and_resets():
+    import random
+
+    t = {"t": 0.0}
+    br = CircuitBreaker(failure_threshold=2, recovery_time=10.0,
+                        half_open_backoff=200.0,
+                        rng=random.Random(7), clock=lambda: t["t"])
+    _trip(br)
+    seen = [br.state_snapshot()["current_backoff"]]
+    assert seen[0] == 10.0
+    for _ in range(6):
+        # +0.5 absorbs float accumulation across the growing cadence
+        t["t"] += seen[-1] + 0.5
+        assert br.state == "half_open"  # reading state IS the timer
+        br.record_failure()             # probe fails: re-trip
+        cur = br.state_snapshot()["current_backoff"]
+        assert 10.0 <= cur <= 200.0
+        seen.append(cur)
+    # decorrelated jitter: the cadence moved (not the fixed legacy
+    # interval) and respected the cap; the EXPECTED drift is upward
+    assert len(set(seen)) > 1
+    assert max(seen) > 10.0
+    # recovery resets the cadence to recovery_time
+    t["t"] += seen[-1] + 0.5
+    assert br.allow()
+    br.record_success()
+    assert br.state == "closed"
+    assert br.state_snapshot()["current_backoff"] == 10.0
+
+
+def test_breaker_without_backoff_keeps_legacy_fixed_cadence():
+    t = {"t": 0.0}
+    br = CircuitBreaker(failure_threshold=2, recovery_time=10.0,
+                        clock=lambda: t["t"])
+    _trip(br)
+    for _ in range(4):
+        assert br.state_snapshot()["current_backoff"] == 10.0
+        t["t"] += 10.0
+        assert br.state == "half_open"
+        br.record_failure()
+    with pytest.raises(ValueError):
+        CircuitBreaker(recovery_time=30.0, half_open_backoff=5.0)
+
+
+# -- bounded health probe --------------------------------------------------
+
+
+def test_replica_health_http_bounded_on_hanging_server(tiny):
+    """A wedged ops endpoint (accepts the connection, never answers)
+    must cost at most ``timeout * (1 + retries)`` and come back as
+    ``unreachable`` — never an exception, never a stall."""
+    cfg, params = tiny
+    hang = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    hang.bind(("127.0.0.1", 0))
+    hang.listen(4)
+    accepted = []
+
+    def _accept_and_sit():
+        try:
+            while True:
+                conn, _ = hang.accept()
+                accepted.append(conn)  # hold it open, say nothing
+        except OSError:
+            pass
+
+    th = threading.Thread(target=_accept_and_sit, daemon=True)
+    th.start()
+    fleet = _fleet(cfg, params, enable_elastic=False)
+    try:
+        rep = fleet.replicas[0]
+        # no ops plane attached: that is a caller bug, not a probe
+        with pytest.raises(RuntimeError):
+            rep.health(via_http=True)
+
+        class _Ops:
+            host, port = hang.getsockname()
+
+        rep.server.ops = _Ops()
+        t0 = time.monotonic()
+        h = rep.health(via_http=True, timeout=0.3, retries=1)
+        wall = time.monotonic() - t0
+        assert h["status"] == "unreachable"
+        assert h["live_requests"] is None
+        assert wall < 0.3 * 2 + 2.0     # bounded: 2 attempts + slack
+        # in-process health still answers regardless
+        assert rep.health()["status"] == "ok"
+    finally:
+        rep.server.ops = None
+        fleet.close()
+        hang.close()
+        for c in accepted:
+            c.close()
+
+
+# -- restore-latest revive -------------------------------------------------
+
+
+@pytest.mark.slow
+def test_revive_with_restore_latest_server_bit_exact(tiny, oracle,
+                                                     tmp_path):
+    """The DR loop the elastic tier leans on: drain a replica, build
+    its replacement from ``CheckpointManager.restore_latest`` weights,
+    revive — the revived fleet is bit-exact with the never-drained
+    baseline."""
+    cfg, params = tiny
+    mgr = ckpt.CheckpointManager(str(tmp_path / "dr"))
+    mgr.save(3, params)
+    fleet = _fleet(cfg, params, n=2, num_blocks=32,
+                   enable_elastic=False)
+    try:
+        prompts = _prompts(61, 4)
+        victim = fleet.replicas[0]
+        fleet.router.drain_replica(victim)
+        while not fleet.replica_drained(victim):
+            fleet.step()
+        restored, step = mgr.restore_latest()
+        assert step == 3
+        fresh = _single(cfg, params=restored, max_batch_size=2)
+        fleet.router.revive(victim, server=fresh)
+        got = fleet.generate(prompts, max_new_tokens=12)
+        assert got == oracle(prompts, 12)
+        # both replicas took work again after the revive
+        per_rep = [r["finished"] for r in
+                   fleet.stats()["router"]["per_replica"].values()]
+        assert all(n > 0 for n in per_rep), per_rep
+    finally:
+        fleet.close()
+
+
+# -- the mini flash-crowd soak ---------------------------------------------
+
+
+@pytest.mark.slow
+def test_mini_elastic_soak_with_midcrowd_rollout(tiny):
+    """The headline invariants at mini scale (the build-matrix axis
+    runs the 800-iteration CLI soak): flash crowd -> scale-up,
+    mid-crowd rollout converges to one version, SLO debt bounded,
+    exactly-once terminals, bit-exact replay."""
+    cfg, params = tiny
+
+    def make_fleet(clock):
+        return _fleet(cfg, params, num_blocks=40, max_waiting=8,
+                      max_context=64, clock=clock,
+                      enable_elastic=True,
+                      elastic=_elastic_cfg(
+                          max_replicas=2, window=4,
+                          up_cooldown_s=10.0, down_cooldown_s=30.0))
+
+    def make_replay(clock):
+        return _single(cfg, params, max_batch_size=8,
+                       max_context=64, num_blocks=128, clock=clock)
+
+    soak_cfg = ChaosConfig(
+        iters=160, vocab=VOCAB, arrival_rate=0.2, burst_rate=0.0,
+        prompt_len=(2, 10), max_new=(1, 10),
+        nonfinite_rate=0.0, oom_rate=0.0, crash_every=0,
+        flash_crowd_iter=40, flash_crowd_len=40,
+        flash_crowd_arrivals=(2, 3))
+    report = run_elastic_soak(
+        make_fleet, soak_cfg, seed=0, rollout_iter=60,
+        expect_final_size=1, make_replay=make_replay)
+    assert report["scale_ups"] >= 1
+    assert report["rollout"]["status"] == "ok"
+    assert report["final_replicas"] == 1
+    assert len(set(report["weights_versions"].values())) == 1
+    assert report["bit_exact_checked"] > 0

@@ -541,7 +541,12 @@ def test_pinned_router_stats_block(tiny):
         "router", "requests_finished", "requests_unplaced",
         "tokens_generated", "prefix_hit_tokens", "prefix_miss_tokens",
         "prefix_hit_rate", "pressure", "pressure_peak", "draining",
-        "streams"}
+        "streams", "elastic"}
+    # elastic OFF: the minimal pinned shape (no autoscaler state)
+    assert set(st["elastic"]) == {"enabled", "weights_versions",
+                                  "last_rollout"}
+    assert st["elastic"]["enabled"] is False
+    assert st["elastic"]["weights_versions"] == {"initial": 1}
     r = st["router"]
     assert set(r) == {
         "replicas", "alive", "policy", "placements", "affinity",
@@ -560,10 +565,11 @@ def test_pinned_router_stats_block(tiny):
     assert set(row) == {
         "name", "role", "alive", "draining", "pressure",
         "live_requests", "waiting", "running", "finished", "steps",
-        "step_failures", "last_error", "breaker"}
+        "step_failures", "last_error", "weights_version", "breaker"}
     assert set(row["breaker"]) == {
         "state", "failure_streak", "failure_threshold", "probes_out",
-        "probe_ok", "probe_quota", "recovery_time", "transitions"}
+        "probe_ok", "probe_quota", "recovery_time", "current_backoff",
+        "transitions"}
     assert set(row["breaker"]["transitions"]) == {
         "opened", "half_open", "closed"}
     # placements partition the submissions

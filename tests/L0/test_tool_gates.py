@@ -149,6 +149,70 @@ def test_ops_probe_clean_exit_on_garbage_healthz_body(stub_ops):
         _no_traceback(res)
 
 
+# -- ops_probe --elastic ---------------------------------------------------
+
+
+_ELASTIC_BLOCK = {
+    "enabled": True, "replicas": 2, "retired": 1,
+    "min_replicas": 1, "max_replicas": 3,
+    "pressure_avg": 0.91, "debt_delta": 12, "score": 1.03,
+    "band": {"up": 0.85, "down": 0.25},
+    "scale_ups": 1, "scale_downs": 1, "retiring": None,
+    "cooldown": {"up_ready": False, "down_ready": True},
+    "last_action": "scale_up",
+    "weights_versions": {"initial": 2},
+    "last_rollout": None,
+    "decisions": [
+        {"kind": "elastic", "action": "scale_up", "iter": 40,
+         "t": 40.0, "pressure_avg": 0.91, "debt_delta": 12,
+         "score": 1.03, "replicas": 2, "replica": "replica1",
+         "warmed_blocks": 8},
+    ],
+}
+
+
+def test_ops_probe_elastic_renders_decision_table(stub_ops):
+    statusz = dict(_STATUSZ)
+    statusz["elastic"] = _ELASTIC_BLOCK
+    stub_ops.statusz_body = json.dumps(statusz).encode()
+    res = _probe(stub_ops.server_address[1], "--elastic")
+    assert res.returncode == 0, res.stdout + res.stderr
+    # the decision table carries the action AND its trigger signals
+    assert "scale_up" in res.stdout
+    assert "replica=replica1" in res.stdout
+    assert "warmed_blocks=8" in res.stdout
+    assert "1.03" in res.stdout          # the score it fired on
+
+
+def test_ops_probe_elastic_gates_on_missing_block(stub_ops):
+    res = _probe(stub_ops.server_address[1], "--elastic")
+    assert res.returncode == 1
+    assert "FAIL" in res.stderr and "elastic" in res.stderr
+    _no_traceback(res)
+
+
+def test_ops_probe_elastic_gates_on_disabled_autoscaler(stub_ops):
+    statusz = dict(_STATUSZ)
+    statusz["elastic"] = dict(_ELASTIC_BLOCK, enabled=False)
+    stub_ops.statusz_body = json.dumps(statusz).encode()
+    res = _probe(stub_ops.server_address[1], "--elastic")
+    assert res.returncode == 1
+    assert "FAIL" in res.stderr and "disabled" in res.stderr
+    _no_traceback(res)
+
+
+def test_elastic_flags_advertised_by_gating_tools():
+    """The build-matrix ``elastic`` axis invokes every tool below
+    with ``--elastic`` — a dropped flag would fail the axis with an
+    argparse error instead of a judged result."""
+    for tool in ("chaos_soak.py", "serving_bench.py", "ops_probe.py"):
+        res = subprocess.run(
+            [sys.executable, str(REPO / "tools" / tool), "--help"],
+            capture_output=True, text=True, timeout=60)
+        assert res.returncode == 0, res.stderr
+        assert "--elastic" in res.stdout, tool
+
+
 # -- obs_dump --------------------------------------------------------------
 
 

@@ -292,6 +292,34 @@ env JAX_PLATFORMS=cpu python -m pytest tests/L0/test_streaming.py \
   && env JAX_PLATFORMS=cpu python tools/chaos_soak.py --seed 0 --iters 800 --streaming
 results[streaming]=$?
 
+# elastic fleet: the capacity axis (docs/serving.md, "Elastic
+# fleet") — three gates:
+#   1. the L0 elastic tier (slow tier included — this axis owns it):
+#      the autoscaler's hysteresis up/down loop with zero
+#      healthy-request loss, cooldown/bound enforcement, the
+#      prefix-warmed scale-up, rollout ok-converges / parity-
+#      mismatch-rolls-back, predictive admission (cold-start admit +
+#      learned submit-time shed), breaker half-open backoff decay +
+#      legacy cadence, the bounded hanging-ops health probe, the
+#      restore_latest revive parity, and the mini mid-crowd soak;
+#   2. serving_bench --elastic: the goodput A/B — the same
+#      deadline-carrying flash-crowd schedule through the autoscaling
+#      fleet vs the fleet pinned at one replica (>= 1.25x goodput
+#      floor, scale-up observed, token parity on commonly-served
+#      requests ALWAYS);
+#   3. an 800-iteration seed-0 elastic chaos soak: sustained flash
+#      crowd + a zero-downtime weight rollout fired MID-crowd —
+#      exactly-once terminals across membership churn, scale-up +
+#      reconvergence, single final weights version, SLO debt bounded
+#      in the final fifth, bit-exact single-replica replay (legacy
+#      bench/chaos arms above pin enable_elastic=False, so their
+#      seeds stay valid).
+echo "=== build-matrix axis: elastic ==="
+env JAX_PLATFORMS=cpu python -m pytest tests/L0/test_elastic.py -q -x --no-header \
+  && env JAX_PLATFORMS=cpu python tools/serving_bench.py --smoke --elastic --out - \
+  && env JAX_PLATFORMS=cpu python tools/chaos_soak.py --seed 0 --iters 800 --elastic
+results[elastic]=$?
+
 # chaos soak: the overload-robustness axis (docs/resilience.md,
 # "Overload policy & lifecycle") — the full serving stack (prefix
 # cache + chunked prefill + overload control + circuit breaker, small

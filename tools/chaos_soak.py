@@ -88,6 +88,18 @@ Usage:
         [--speculative] [--postmortem-dir DIR] [--force-violation N]
     python tools/chaos_soak.py --replicas 3 [--iters 800]
         [--kill-iter N] [--recover-iter N]
+    python tools/chaos_soak.py --elastic [--iters 800]
+
+``--elastic`` soaks the AUTOSCALING fleet (``docs/serving.md``,
+"Elastic fleet"): a sustained flash-crowd arrival window hits a
+one-replica fleet whose autoscaler must grow it, a zero-downtime
+weight rollout fires mid-crowd, and the idle tail must converge the
+fleet back to one replica on a single weights version — with zero
+healthy-request loss, exactly-once terminals, bounded SLO debt, and
+bit-exact survivors vs the replay oracle
+(:func:`resilience.chaos.run_elastic_soak`).  Legacy arms pin
+``enable_elastic=False`` so their per-seed reports stay
+byte-identical.
 """
 
 import argparse
@@ -145,6 +157,9 @@ def run_router(args) -> int:
             max_batch_size=4, max_context=64, block_size=4,
             num_blocks=40, cache_dtype=jnp.float32, max_waiting=8,
             clock=clock,
+            # the elastic axis has its own arm (--elastic); pinned
+            # OFF here so legacy per-seed reports stay byte-identical
+            enable_elastic=False,
             breaker_factory=lambda i: CircuitBreaker(
                 failure_threshold=3, recovery_time=25.0,
                 clock=clock))
@@ -187,6 +202,91 @@ def run_router(args) -> int:
           f"reenqueued={report['reenqueued']}, "
           f"replica_failed={report['replica_failed']}, "
           f"per_replica={report['per_replica_finished']} "
+          f"({report['wall_s']}s)")
+    return 0
+
+
+def run_elastic(args) -> int:
+    """The ``--elastic`` arm: a flash crowd against an AUTOSCALING
+    one-replica fleet with a zero-downtime weight rollout fired
+    mid-crowd (``resilience.chaos.run_elastic_soak``; docs/serving.md
+    "Elastic fleet").  The crowd occupies the second quarter of the
+    run, the rollout lands at its midpoint, and the long idle tail
+    lets the scale-down cooldowns converge the fleet back to one
+    replica — so convergence, single-version, and debt-bounded are
+    all judged, not just churn survival."""
+    import jax.numpy as jnp
+
+    from apex_tpu.resilience import CircuitBreaker
+    from apex_tpu.resilience.chaos import ChaosConfig, run_elastic_soak
+    from apex_tpu.serving import InferenceServer, RouterFleet
+    from apex_tpu.serving.elastic import AutoscalerConfig
+
+    cfg, params = build_model()
+    crowd_start = args.iters // 4
+    crowd_len = max(1, args.iters // 4)
+    rollout_iter = crowd_start + crowd_len // 2
+
+    def make_fleet(clock):
+        # starts at ONE small-pool replica: the crowd must force the
+        # scale-ups.  Cooldowns are sized to the soak's iteration
+        # clock (1s per iter): up quickly while the crowd builds,
+        # down slowly enough that one idle gap mid-crowd cannot
+        # flap the fleet.
+        return RouterFleet(
+            cfg, params, replicas=1,
+            max_batch_size=4, max_context=64, block_size=4,
+            num_blocks=40, cache_dtype=jnp.float32, max_waiting=8,
+            clock=clock,
+            enable_elastic=True,
+            elastic=AutoscalerConfig(
+                min_replicas=1, max_replicas=3,
+                up_pressure=0.85, down_pressure=0.2,
+                window=8, up_cooldown_s=25.0, down_cooldown_s=60.0,
+                warm_blocks=8),
+            breaker_factory=lambda i: CircuitBreaker(
+                failure_threshold=3, recovery_time=25.0,
+                clock=clock))
+
+    def make_replay(clock):
+        # ONE roomy replica, never scaled, never rolled: equality
+        # proves elasticity moved capacity, not tokens
+        return InferenceServer(
+            cfg, params, max_batch_size=4, max_context=64,
+            block_size=4, cache_dtype=jnp.float32, clock=clock)
+
+    chaos_cfg = ChaosConfig(
+        iters=args.iters, vocab=VOCAB,
+        # calm baseline + a sustained crowd: the engine-fault classes
+        # stay on their own axes — this soak's faults are the crowd,
+        # the membership churn it forces, and the mid-crowd rollout
+        arrival_rate=0.25, burst_rate=0.0,
+        nonfinite_rate=0.0, oom_rate=0.0, crash_every=0,
+        flash_crowd_iter=crowd_start, flash_crowd_len=crowd_len,
+        flash_crowd_arrivals=(1, 3))
+    t0 = time.perf_counter()
+    report = run_elastic_soak(make_fleet, chaos_cfg, args.seed,
+                              rollout_iter=rollout_iter,
+                              expect_final_size=1,
+                              make_replay=make_replay, log=print,
+                              postmortem_dir=args.postmortem_dir)
+    report["wall_s"] = round(time.perf_counter() - t0, 2)
+
+    line = json.dumps(report, indent=2, sort_keys=True)
+    if args.out == "-":
+        print(line)
+    elif args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    print(f"elastic chaos soak PASS: {report['submitted']} requests "
+          f"over {args.iters} iterations, size "
+          f"{report['start_replicas']} -> peak {report['size_peak']} "
+          f"-> {report['final_replicas']}, "
+          f"rollout={report['rollout']['status']} "
+          f"v={report['rollout']['version']}, "
+          f"{report['bit_exact_checked']} bit-exact + "
+          f"{report['prefix_checked']} prefix-checked vs replay, "
+          f"debt={report['shed_debt_tokens']} "
           f"({report['wall_s']}s)")
     return 0
 
@@ -291,6 +391,17 @@ def main(argv=None) -> int:
                         "N-replica RouterFleet with one replica "
                         "killed mid-run then recovered "
                         "(docs/serving.md, 'Multi-replica routing')")
+    parser.add_argument("--elastic", action="store_true",
+                        help="soak the ELASTIC fleet instead "
+                        "(docs/serving.md, 'Elastic fleet'): a "
+                        "sustained flash-crowd arrival window hits "
+                        "an autoscaling one-replica fleet while a "
+                        "zero-downtime weight rollout fires "
+                        "mid-crowd — asserting zero healthy-request "
+                        "loss, exactly-once terminals, bounded SLO "
+                        "debt, convergence back to one replica on a "
+                        "single weights version, and bit-exact "
+                        "survivors vs the replay oracle")
     parser.add_argument("--kill-iter", type=int, default=None,
                         help="router soak: iteration the victim dies "
                         "(default iters // 4)")
@@ -302,6 +413,9 @@ def main(argv=None) -> int:
                         "fleet's thread pool (routing decisions are "
                         "identical either way)")
     args = parser.parse_args(argv)
+
+    if args.elastic:
+        return run_elastic(args)
 
     if args.replicas:
         return run_router(args)

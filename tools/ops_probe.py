@@ -26,6 +26,17 @@ Pure stdlib, so it runs anywhere a shell does:
     drops, terminal flag.  A server without the streams block FAILs
     (exit 1); streaming disabled prints one summary line.
 
+``--elastic``
+    Render the elastic-fleet controller's ``/statusz`` block
+    (``docs/serving.md``, "Elastic fleet"): the current control
+    signals (windowed pressure, debt delta, score vs the hysteresis
+    band, per-direction cooldown readiness), the weights-version
+    census + last rollout, and the bounded decision table — every
+    scale-up / drain / scale-down with the trigger signal values it
+    fired on.  A fleet without the elastic block FAILs (exit 1), as
+    does one with the autoscaler disabled — probe a single server's
+    port for non-elastic deployments.
+
 ``--flight N`` / ``--request UID`` / ``--statusz`` / ``--metrics``
     Raw views of the corresponding endpoints.
 
@@ -205,6 +216,57 @@ def render_streams(stats) -> int:
     return 0
 
 
+def render_elastic(stats) -> int:
+    """The elastic-fleet controller view: control signals + decision
+    table (``stats()["elastic"]``).  A missing block means the
+    endpoint is a bare server, not a fleet front door — that gates,
+    and so does a fleet with the autoscaler off: an SLO dashboard
+    wired to this view must never silently watch a controller that
+    is not running."""
+    el = stats.get("elastic")
+    if el is None:
+        print("FAIL: /statusz has no 'elastic' block (single server, "
+              "not a fleet front door?)", file=sys.stderr)
+        return 1
+    if not el.get("enabled"):
+        print("FAIL: elastic block present but the autoscaler is "
+              "disabled (enable_elastic=False)", file=sys.stderr)
+        return 1
+    band = el.get("band", {})
+    cool = el.get("cooldown", {})
+    print(f"elastic: replicas={el.get('replicas')} "
+          f"(retired={el.get('retired')}, "
+          f"min={el.get('min_replicas')}, "
+          f"max={el.get('max_replicas')}) "
+          f"score={el.get('score')} "
+          f"band=[{band.get('down')}, {band.get('up')}] "
+          f"pressure_avg={el.get('pressure_avg')} "
+          f"debt_delta={el.get('debt_delta')}")
+    print(f"counters: scale_ups={el.get('scale_ups')} "
+          f"scale_downs={el.get('scale_downs')} "
+          f"retiring={el.get('retiring')} "
+          f"last_action={el.get('last_action')} "
+          f"cooldown(up_ready={cool.get('up_ready')}, "
+          f"down_ready={cool.get('down_ready')})")
+    print(f"weights: versions={el.get('weights_versions')} "
+          f"last_rollout={el.get('last_rollout')}")
+    decisions = el.get("decisions", [])
+    if not decisions:
+        print("no decisions yet")
+        return 0
+    print(f"{'iter':>6} {'t':>9} {'action':<10} {'score':>7} "
+          f"{'p_avg':>7} {'debt':>5} {'reps':>4} detail")
+    for d in decisions:
+        detail = " ".join(
+            f"{k}={d[k]}" for k in ("replica", "warmed_blocks")
+            if k in d)
+        print(f"{d.get('iter'):>6} {d.get('t'):>9} "
+              f"{d.get('action'):<10} {d.get('score'):>7} "
+              f"{d.get('pressure_avg'):>7} {d.get('debt_delta'):>5} "
+              f"{d.get('replicas'):>4} {detail}")
+    return 0
+
+
 def assert_healthy(base, timeout) -> int:
     """The gate: healthz ok + conformant metrics + pinned statusz
     blocks.  Prints what failed; 0 only when everything holds."""
@@ -273,6 +335,11 @@ def main(argv=None) -> int:
     ap.add_argument("--streams", action="store_true",
                     help="render the streaming tier: broker counters "
                     "+ per-open-stream delivery cursors")
+    ap.add_argument("--elastic", action="store_true",
+                    help="render the elastic-fleet controller: "
+                    "control signals, weights-version census, and "
+                    "the decision table (FAILs when the endpoint "
+                    "has no enabled autoscaler)")
     ap.add_argument("--statusz", action="store_true",
                     help="print the full /statusz JSON")
     ap.add_argument("--metrics", action="store_true",
@@ -297,7 +364,7 @@ def _run(args, base) -> int:
         rc = assert_healthy(base, args.timeout)
         if rc:
             return rc
-    if args.programs or args.statusz or args.streams:
+    if args.programs or args.statusz or args.streams or args.elastic:
         code, _, body = fetch(base, "/statusz", args.timeout)
         if code != 200:
             print(f"FAIL: /statusz {code}", file=sys.stderr)
@@ -309,6 +376,10 @@ def _run(args, base) -> int:
             render_programs(stats)
         if args.streams:
             rc = render_streams(stats)
+            if rc:
+                return rc
+        if args.elastic:
+            rc = render_elastic(stats)
             if rc:
                 return rc
     if args.metrics:
@@ -335,8 +406,8 @@ def _run(args, base) -> int:
                                     f"/debug/requests/{args.request}"),
                          indent=2, sort_keys=True))
     if not any((args.assert_healthy, args.programs, args.statusz,
-                args.streams, args.metrics, args.flight is not None,
-                args.request is not None)):
+                args.streams, args.elastic, args.metrics,
+                args.flight is not None, args.request is not None)):
         code, _, body = fetch(base, "/healthz", args.timeout)
         health = parse_json(body, "/healthz")
         print(f"{base}/healthz -> {code} "

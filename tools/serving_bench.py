@@ -1770,7 +1770,10 @@ def _router_fleet(cfg, params, args, kind):
         max_context=args.max_context, block_size=args.block_size,
         num_blocks=args.router_blocks, cache_dtype=jnp.float32,
         kv_quant="off", enable_disagg=False,
-        enable_streaming=False)
+        enable_streaming=False,
+        # the elastic axis has its own arm (--elastic); pinned OFF
+        # here so the placement A/B keeps a fixed-geometry fleet
+        enable_elastic=False)
 
 
 def _run_router_arm(cfg, params, args, kind, groups):
@@ -1893,6 +1896,169 @@ def run_router_mode(args):
                   f"{record['hit_ratio_affinity_over_random']} < "
                   "1.5x floor", file=sys.stderr)
             rc = 1
+    return rc
+
+
+def _run_elastic_arm(cfg, params, args, schedule, elastic_on):
+    """Drive one arm (autoscaling or fixed one-replica fleet) through
+    the identical seeded flash-crowd schedule on an injected
+    iteration clock (1 s per iteration — wall-clock independent, so
+    the A/B is deterministic per seed).  Every arrival carries a
+    ``deadline_s``; GOODPUT is the tokens of requests that finished
+    HEALTHY — a deadline miss finishes ``timeout`` and earns nothing,
+    a shed earns nothing, so goodput is exactly "useful tokens
+    delivered within deadline"."""
+    import jax.numpy as jnp
+
+    from apex_tpu.serving import RouterFleet
+    from apex_tpu.serving.elastic import AutoscalerConfig
+    from apex_tpu.serving.reasons import HEALTHY_REASONS
+
+    clock_state = {"t": 0.0}
+    fleet = RouterFleet(
+        cfg, params, replicas=1,
+        max_batch_size=args.batch_size, max_context=args.max_context,
+        block_size=args.block_size, num_blocks=args.router_blocks,
+        cache_dtype=jnp.float32, max_waiting=8,
+        clock=lambda: clock_state["t"],
+        enable_elastic=elastic_on,
+        elastic=AutoscalerConfig(
+            min_replicas=1, max_replicas=3,
+            up_pressure=0.85, down_pressure=0.2, window=8,
+            up_cooldown_s=25.0, down_cooldown_s=60.0,
+            warm_blocks=8) if elastic_on else None)
+    tracked = []
+    size_peak = len(fleet.replicas)
+    t0 = time.perf_counter()
+    for i in range(schedule.cfg.iters):
+        clock_state["t"] = float(i)
+        for a in schedule.arrivals.get(i, ()):
+            rr = fleet.submit(list(a.prompt), a.max_new_tokens,
+                              priority=a.priority,
+                              deadline_iters=a.deadline_iters,
+                              deadline_s=a.deadline_s)
+            tracked.append((rr, a))
+        fleet.step()
+        for rep in fleet.replicas:
+            rep.server.scheduler.audit()
+        size_peak = max(size_peak, len(fleet.replicas))
+    clock_state["t"] = float(schedule.cfg.iters)
+    fleet.drain()
+    wall = time.perf_counter() - t0
+
+    goodput = 0
+    healthy = {}
+    tally = {}
+    for idx, (rr, _a) in enumerate(tracked):
+        tally[rr.finish_reason] = tally.get(rr.finish_reason, 0) + 1
+    for idx, (rr, _a) in enumerate(tracked):
+        if rr.finish_reason in HEALTHY_REASONS:
+            goodput += len(rr.generated)
+            healthy[idx] = list(rr.generated)
+    st = fleet.stats()
+    arm = {
+        "goodput_tokens": goodput,
+        "submitted": len(tracked),
+        "finished": dict(sorted(tally.items())),
+        "size_peak": size_peak,
+        "final_replicas": len(fleet.replicas),
+        "scale_ups": st["elastic"].get("scale_ups", 0),
+        "scale_downs": st["elastic"].get("scale_downs", 0),
+        "shed_debt_tokens": fleet.shed_debt_tokens(),
+        "wall_s": round(wall, 2),
+    }
+    fleet.close()
+    return arm, healthy
+
+
+def run_elastic_mode(args):
+    """The elastic-fleet goodput A/B (docs/serving.md, "Elastic
+    fleet"): the IDENTICAL seeded flash-crowd schedule — every
+    arrival deadline-carrying — through (a) a one-replica fleet whose
+    autoscaler may grow it to three, and (b) the same fleet pinned
+    FIXED at one replica.  Measured axis: goodput (tokens of requests
+    that finished healthy, i.e. within deadline).  ``--smoke`` floors
+    elastic/fixed >= 1.25x; token-for-token parity on requests
+    healthy in BOTH arms is ALWAYS asserted — capacity may change who
+    gets served, never what a served request reads."""
+    from apex_tpu.resilience.chaos import ChaosConfig, ChaosSchedule
+
+    cfg, m, params = build_model(args)
+    iters = args.elastic_iters
+    crowd_start = iters // 4
+    crowd_len = max(1, iters // 4)
+    chaos_cfg = ChaosConfig(
+        iters=iters, vocab=args.vocab,
+        # calm baseline + a sustained crowd; every arrival carries a
+        # wall deadline on the injected clock (1 s per iteration), so
+        # the fixed arm's queue waits convert directly to timeouts
+        arrival_rate=0.2, burst_rate=0.0,
+        prompt_len=(2, 12), max_new=(4, args.max_new),
+        deadline_iters_rate=0.0,
+        deadline_s_rate=1.0, deadline_s=(12.0, 30.0),
+        nonfinite_rate=0.0, oom_rate=0.0, crash_every=0,
+        flash_crowd_iter=crowd_start, flash_crowd_len=crowd_len,
+        flash_crowd_arrivals=(2, 4))
+    schedule = ChaosSchedule.generate(chaos_cfg, args.seed)
+
+    elastic, healthy_e = _run_elastic_arm(cfg, params, args,
+                                          schedule, True)
+    fixed, healthy_f = _run_elastic_arm(cfg, params, args,
+                                        schedule, False)
+
+    both = sorted(set(healthy_e) & set(healthy_f))
+    mismatches = sum(healthy_e[i] != healthy_f[i] for i in both)
+    ratio = (elastic["goodput_tokens"]
+             / max(fixed["goodput_tokens"], 1e-9))
+
+    record = {
+        "bench": "serving_elastic",
+        "mode": "smoke" if args.smoke else "full",
+        "config": {"iters": iters,
+                   "flash_crowd": [crowd_start,
+                                   crowd_start + crowd_len],
+                   "max_new": args.max_new,
+                   "batch_size": args.batch_size,
+                   "block_size": args.block_size,
+                   "num_blocks": args.router_blocks,
+                   "hidden": args.hidden, "layers": args.layers,
+                   "heads": args.heads,
+                   "max_context": args.max_context,
+                   "vocab": args.vocab, "seed": args.seed},
+        "elastic": elastic,
+        "fixed": fixed,
+        "goodput_ratio_elastic_over_fixed": round(ratio, 2),
+        "parity_checked": len(both),
+        "parity_mismatches": mismatches,
+    }
+    print(json.dumps(record))
+
+    out = args.out
+    if out != "-":
+        if out is None:
+            out = os.path.join(
+                os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+                "BENCH_serving_elastic.json")
+        with open(out, "w") as f:
+            json.dump(record, f, indent=1)
+            f.write("\n")
+
+    rc = 0
+    if mismatches:
+        print(f"FAIL: {mismatches} requests healthy in both arms "
+              "diverged — capacity must never change tokens",
+              file=sys.stderr)
+        rc = 1
+    if elastic["scale_ups"] < 1:
+        print("FAIL: the flash crowd never triggered a scale-up in "
+              "the elastic arm", file=sys.stderr)
+        rc = 1
+    if args.smoke and ratio < 1.25:
+        print(f"FAIL: elastic/fixed goodput ratio "
+              f"{record['goodput_ratio_elastic_over_fixed']} < "
+              "1.25x floor", file=sys.stderr)
+        rc = 1
     return rc
 
 
@@ -2060,6 +2226,20 @@ def main():
                     "RouterFleet; aggregate prefix-hit ratio floored "
                     ">= 1.5x under --smoke, parity always) instead "
                     "of the continuous-vs-naive compare")
+    ap.add_argument("--elastic", action="store_true",
+                    help="run the elastic-fleet goodput A/B "
+                    "(docs/serving.md, 'Elastic fleet'): an "
+                    "identical seeded flash-crowd schedule with "
+                    "deadline-carrying arrivals through an "
+                    "autoscaling fleet vs the same fleet pinned at "
+                    "one replica; goodput = tokens delivered within "
+                    "deadline, elastic/fixed floored >= 1.25x under "
+                    "--smoke, parity on both-healthy requests "
+                    "always (BENCH_serving_elastic.json)")
+    ap.add_argument("--elastic-iters", type=int, default=None,
+                    help="elastic mode: schedule length in "
+                    "iterations (default: 240 under --smoke, else "
+                    "900)")
     ap.add_argument("--router-groups", type=int, default=6,
                     help="router mode: shared-prefix session groups")
     ap.add_argument("--router-rounds", type=int, default=3,
@@ -2209,6 +2389,19 @@ def main():
             args.tail_len = 7
             args.chunk = 32
             args.long_prompt = 448
+        if args.elastic:
+            # the soak's small-pool replica shape: a one-replica
+            # fleet a sustained crowd genuinely overwhelms, so the
+            # fixed arm's deadline misses are real and the
+            # autoscaler's extra capacity is what goodput measures
+            args.max_new = 12
+            args.batch_size = 4
+            args.block_size = 8
+            args.vocab = 61
+            args.hidden = 32
+            args.layers = 2
+            args.heads = 2
+            args.max_context = 64
         if args.router:
             # grouped multi-session traffic: few rounds keep the
             # random arm's accidental same-replica revisits rare (the
@@ -2225,6 +2418,15 @@ def main():
             args.max_context = 128
             args.prefix_len = 48
             args.tail_len = 7
+
+    if args.elastic:
+        if args.elastic_iters is None:
+            args.elastic_iters = 240 if args.smoke else 900
+        if args.router_blocks is None:
+            # the soak's small-pool shape: enough for the live batch
+            # plus a little cache, NOT enough to absorb a crowd
+            args.router_blocks = 40
+        return run_elastic_mode(args)
 
     if args.router:
         if args.prefix_len is None:
