@@ -174,6 +174,30 @@ class ChaosConfig:
     # byte-identical (no extra RNG draws).
     disconnect_rate: float = 0.0
 
+    # session-continuation traffic class (docs/serving.md,
+    # "Hierarchical KV offload"; the --kv-offload soak arms it): a
+    # prior arrival's prompt is resubmitted after a gap of at least
+    # ``resume_min_gap`` iterations — the returning-session shape
+    # whose prefix the offload tiers exist to keep warm (same prompt,
+    # same sampling tuple, fresh token budget/priority).  Default 0.0
+    # keeps legacy (config, seed) schedules byte-identical (no extra
+    # RNG draws) — precedent: stochastic_rate, disconnect_rate.
+    resume_rate: float = 0.0
+    resume_min_gap: int = 20
+
+    # hierarchical-offload fault classes (docs/serving.md,
+    # "Hierarchical KV offload"; the --kv-offload soak arms them): a
+    # TORN SPILL corrupts a demoted payload after its crc was
+    # recorded (import must reject it whole -> cold prefill,
+    # bit-identical), and PROMOTE-AT-CAPACITY makes import_blocks
+    # raise a transient MemoryError (the payload goes back to the
+    # store; the admission cold-prefills).  Neither is engine-OOM
+    # accounted — offload failures degrade to slow, never to the
+    # serve loop's fault isolation.  Defaults 0.0 keep legacy
+    # (config, seed) schedules byte-identical.
+    offload_torn_rate: float = 0.0
+    offload_capacity_rate: float = 0.0
+
     # flash-crowd arrival class (``serving/elastic``; the --elastic
     # soak and bench arm arm it): for ``flash_crowd_len`` iterations
     # starting at ``flash_crowd_iter``, EVERY iteration adds
@@ -214,7 +238,9 @@ class ChaosSchedule:
                  fault_plans: List[FaultPlan],
                  handoff_oom_iters: Optional[Set[int]] = None,
                  handoff_torn_iters: Optional[Set[int]] = None,
-                 disconnect_iters: Optional[Set[int]] = None):
+                 disconnect_iters: Optional[Set[int]] = None,
+                 offload_torn_iters: Optional[Set[int]] = None,
+                 offload_capacity_iters: Optional[Set[int]] = None):
         self.cfg = cfg
         self.seed = seed
         self.arrivals = arrivals
@@ -224,6 +250,8 @@ class ChaosSchedule:
         self.handoff_oom_iters = handoff_oom_iters or set()
         self.handoff_torn_iters = handoff_torn_iters or set()
         self.disconnect_iters = disconnect_iters or set()
+        self.offload_torn_iters = offload_torn_iters or set()
+        self.offload_capacity_iters = offload_capacity_iters or set()
 
     @property
     def num_arrivals(self) -> int:
@@ -275,6 +303,9 @@ class ChaosSchedule:
         handoff_oom: Set[int] = set()
         handoff_torn: Set[int] = set()
         disconnect: Set[int] = set()
+        offload_torn: Set[int] = set()
+        offload_capacity: Set[int] = set()
+        prior: List[Arrival] = []
         for i in range(cfg.iters):
             batch: List[Arrival] = []
             if rng.random() < cfg.arrival_rate:
@@ -289,8 +320,24 @@ class ChaosSchedule:
                 batch.extend(
                     one_arrival(i) for _ in
                     range(rng.randint(*cfg.flash_crowd_arrivals)))
+            # rate-0 guard: legacy schedules draw nothing.  A resumed
+            # SESSION replays an earlier arrival's exact prompt (and
+            # sampling tuple — same seeded stream) after a cool-down
+            # gap, so its prefix has had time to evict and demote; a
+            # fresh token budget/priority makes it a new request, not
+            # a duplicate.
+            if cfg.resume_rate and rng.random() < cfg.resume_rate:
+                pool = [a for a in prior
+                        if a.iter <= i - cfg.resume_min_gap]
+                if pool:
+                    src = pool[rng.randrange(len(pool))]
+                    batch.append(dataclasses.replace(
+                        src, iter=i,
+                        max_new_tokens=rng.randint(*cfg.max_new),
+                        priority=rng.randint(0, cfg.priority_max)))
             if batch:
                 arrivals[i] = batch
+                prior.extend(batch)
             if rng.random() < cfg.nonfinite_rate:
                 nonfinite.add(i)
             if rng.random() < cfg.oom_rate:
@@ -311,6 +358,12 @@ class ChaosSchedule:
             if cfg.disconnect_rate \
                     and rng.random() < cfg.disconnect_rate:
                 disconnect.add(i)
+            if cfg.offload_torn_rate \
+                    and rng.random() < cfg.offload_torn_rate:
+                offload_torn.add(i)
+            if cfg.offload_capacity_rate \
+                    and rng.random() < cfg.offload_capacity_rate:
+                offload_capacity.add(i)
         # compose the EXISTING fault vocabulary: one FaultPlan per
         # scheduled crash, ticked by iteration number (crash_kind
         # "raise" — SIGKILL would end the soak process, which the
@@ -325,7 +378,9 @@ class ChaosSchedule:
         return cls(cfg, seed, arrivals, nonfinite, oom, plans,
                    handoff_oom_iters=handoff_oom,
                    handoff_torn_iters=handoff_torn,
-                   disconnect_iters=disconnect)
+                   disconnect_iters=disconnect,
+                   offload_torn_iters=offload_torn,
+                   offload_capacity_iters=offload_capacity)
 
 
 class ChaosEngine:
@@ -362,7 +417,8 @@ class ChaosEngine:
         self.iter = -1
         self.injected = injected if injected is not None else {
             "oom": 0, "nonfinite_rows": 0, "crashes": 0,
-            "handoff_oom": 0, "handoff_torn": 0}
+            "handoff_oom": 0, "handoff_torn": 0,
+            "offload_torn": 0, "offload_capacity": 0}
         self._tick_plans = tick_plans
 
     def begin_iter(self, i: int) -> None:
@@ -492,6 +548,40 @@ class ChaosEngine:
             fin = fin.at[row].set(False)
             self.injected["nonfinite_rows"] += 1
         return ids, fin
+
+    # -- hierarchical-offload fault twins ----------------------------------
+    # (docs/serving.md, "Hierarchical KV offload").  Neither calls
+    # _oom_gate(): offload failures are contained inside the prefix
+    # cache's promote/demote paths (cold prefill, never _note_oom), so
+    # they must stay OUT of the engine-OOM reconciliation invariant.
+
+    def export_blocks(self, block_ids, **kwargs):
+        # a TORN SPILL: the demote really happens, but one leaf's
+        # bytes rot after the crc was recorded — the checksummed
+        # import path must reject the payload whole on promote, and
+        # the admission must cold-prefill bit-identically
+        payload = self.inner.export_blocks(block_ids, **kwargs)
+        if self.iter in self.schedule.offload_torn_iters:
+            import numpy as np
+
+            name = min(payload["leaves"])
+            arr = payload["leaves"][name].copy()
+            arr.view(np.uint8).flat[0] ^= 0xFF
+            payload = dict(payload,
+                           leaves=dict(payload["leaves"], **{name: arr}))
+            self.injected["offload_torn"] += 1
+        return payload
+
+    def import_blocks(self, block_ids, payload):
+        # PROMOTE-AT-CAPACITY: the device-side scatter fails
+        # transiently — the store keeps the payload (put-back) and
+        # the admission cold-prefills this once
+        if self.iter in self.schedule.offload_capacity_iters:
+            self.injected["offload_capacity"] += 1
+            raise MemoryError(
+                f"chaos: injected promote-at-capacity at iteration "
+                f"{self.iter}")
+        return self.inner.import_blocks(block_ids, payload)
 
     def __getattr__(self, name):
         return getattr(self.inner, name)
@@ -1329,6 +1419,18 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
             (f"server counted {stats['oom_events']} OOM events, chaos "
              f"injected {injected_oom} (incl. hand-off faults)")
         assert report["crashes_caught"] == chaos.injected["crashes"]
+        # invariant 7: every offload crc reject traces to an injected
+        # torn spill — a reject WITHOUT an injection would mean the
+        # demote/promote path corrupts payloads on its own.  (<=, not
+        # ==: a torn payload only rejects if a resumed session
+        # actually tries to promote it before the host LRU drops it.)
+        if stats["offload"]["enabled"]:
+            assert stats["offload"]["crc_rejects"] <= \
+                chaos.injected.get("offload_torn", 0), \
+                (f"offload rejected {stats['offload']['crc_rejects']} "
+                 f"payload(s) but chaos only injected "
+                 f"{chaos.injected.get('offload_torn', 0)} torn spills "
+                 f"— the offload path corrupted data on its own")
         # an armed hang watchdog must ride the whole soak — thousands
         # of iterations of composed faults, none of them a hang —
         # without a single false positive (docs/observability.md,
@@ -1370,6 +1472,12 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
         disagg=stats["disagg"]["enabled"],
         handoff=(stats["disagg"].get("handoff")
                  if stats["disagg"]["enabled"] else None),
+        kv_offload=stats["offload"]["enabled"],
+        offload=({k: stats["offload"][k] for k in
+                  ("demotes", "promotes_host", "promotes_disk",
+                   "spills", "crc_rejects", "capacity_skips",
+                   "disk_torn")}
+                 if stats["offload"]["enabled"] else None),
     )
     if streaming:
         bst = server.stream_broker.stats()

@@ -102,7 +102,18 @@ serve".  Three layers, bottom-up:
   cross-replica (checksummed block payloads via
   ``DecodeEngine.export_blocks`` / ``InferenceServer.ingest_handoff``,
   torn transfers detected whole, failover back to monolithic
-  placement).
+  placement);
+- hierarchical KV offload (``docs/serving.md``, "Hierarchical KV
+  offload"): ``enable_kv_offload=True`` (env twin
+  ``APEX_TPU_KV_OFFLOAD``) backs the prefix cache with a bounded
+  host-RAM tier and an optional checksummed disk spill tier
+  (:class:`~serving.offload.OffloadStore`) — cold evictable blocks
+  DEMOTE (``DecodeEngine.export_blocks``) instead of dying, and
+  admission-time radix hits PROMOTE them back through the
+  checksummed ``import_blocks`` path into fresh device blocks, so a
+  cache hit spans device -> host -> disk at fixed HBM; every
+  integrity/capacity failure on the offload path falls back to cold
+  prefill bit-identically.
 
 Quick start::
 
@@ -128,6 +139,7 @@ from apex_tpu.serving.kv_cache import (
     resolve_cache_dtype,
     resolve_kv_quant,
 )
+from apex_tpu.serving.offload import OffloadStore, resolve_kv_offload
 from apex_tpu.serving.overload import OverloadPolicy
 from apex_tpu.serving.prefix_cache import PrefixCache
 from apex_tpu.serving.router import (
@@ -146,6 +158,7 @@ __all__ = [
     "InferenceServer",
     "KVCacheConfig",
     "NgramDraft",
+    "OffloadStore",
     "OverloadPolicy",
     "PrefixCache",
     "QueueFullError",
@@ -162,5 +175,6 @@ __all__ = [
     "init_kv_cache",
     "quantize_kv",
     "resolve_cache_dtype",
+    "resolve_kv_offload",
     "resolve_kv_quant",
 ]

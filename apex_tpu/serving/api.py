@@ -143,6 +143,11 @@ from apex_tpu.ops.sampling import SamplingParams, sample_tokens_host
 from apex_tpu.resilience.breaker import CircuitBreaker
 from apex_tpu.serving.engine import DecodeEngine
 from apex_tpu.serving.kv_cache import KV_QUANT_ENV, resolve_kv_quant
+from apex_tpu.serving.offload import (
+    KV_OFFLOAD_ENV,
+    OffloadStore,
+    resolve_kv_offload,
+)
 from apex_tpu.serving.overload import AdmissionEstimator, OverloadPolicy
 from apex_tpu.serving.prefix_cache import PrefixCache
 from apex_tpu.serving import reasons
@@ -433,6 +438,21 @@ class InferenceServer:
       stream_queue_tokens: per-stream bounded queue depth; a slower
         consumer drops the oldest queued notification (backfilled on
         the next read) instead of ever stalling ``step()``.
+      enable_kv_offload: hierarchical KV offload (docs/serving.md,
+        "Hierarchical KV offload"; OFF by default, env twin
+        ``APEX_TPU_KV_OFFLOAD``): cold evictable prefix-cache blocks
+        demote into a bounded host-RAM store — optionally spilling
+        to ``kv_offload_dir`` with checksummed atomic writes —
+        instead of dying at eviction, and promote back into fresh
+        device blocks (checksummed ``import_blocks``) when a later
+        admission's radix walk wants them, so a cache hit spans
+        device -> host -> disk at fixed HBM.  Every integrity or
+        capacity failure on the offload path falls back to cold
+        prefill bit-identically.
+      kv_offload_host_bytes: the host-RAM tier's byte bound
+        (default 64 MiB); coldest entries past it spill or drop.
+      kv_offload_dir: optional disk spill tier directory; surviving
+        entries are re-adopted on construction (content-addressed).
 
     Example::
 
@@ -479,7 +499,10 @@ class InferenceServer:
                  prefill_max_concurrent: int = 2,
                  handoff_sink: Optional[Callable] = None,
                  enable_streaming: bool = True,
-                 stream_queue_tokens: int = 256):
+                 stream_queue_tokens: int = 256,
+                 enable_kv_offload: Optional[bool] = None,
+                 kv_offload_host_bytes: int = 64 << 20,
+                 kv_offload_dir: Optional[str] = None):
         self.registry = registry if registry is not None \
             else MetricsRegistry()
         self.tracer = tracer if tracer is not None else get_tracer()
@@ -590,6 +613,51 @@ class InferenceServer:
             PrefixCache(cache_alloc, self.engine.block_size,
                         counters=self.prefix)
             if enable_prefix_cache else None)
+        # hierarchical KV offload (docs/serving.md, "Hierarchical KV
+        # offload"; OFF by default): cold evictable prefix blocks
+        # demote into a bounded host-RAM store (optionally spilling
+        # to disk) instead of dying, and promote back through the
+        # checksummed import_blocks path at admission-time cache
+        # hits.  The APEX_TPU_KV_OFFLOAD env twin turns it on
+        # fleet-wide; a PROVIDED kwarg wins (None = defer to env), so
+        # legacy bench/chaos arms pin enable_kv_offload=False.  The
+        # meters exist unconditionally (stats()/flight records are
+        # shape-stable offload-on or -off); the store and the cache
+        # attachment only when enabled.
+        if enable_kv_offload is None:
+            enable_kv_offload = os.environ.get(KV_OFFLOAD_ENV)
+        self.kv_offload = resolve_kv_offload(enable_kv_offload)
+        self.offload = CounterMeter(registry=self.registry,
+                                    name="serving_offload",
+                                    label="event")
+        self.offload_promote = self.registry.histogram(
+            "serving_offload_promote_s")
+        self.offload_store: Optional[OffloadStore] = None
+        if self.kv_offload:
+            if self.prefix_cache is None:
+                raise ValueError(
+                    "enable_kv_offload requires the prefix cache "
+                    "(enable_prefix_cache=True) — the offload tiers "
+                    "extend its radix index")
+            self.offload_store = OffloadStore(
+                host_bytes=kv_offload_host_bytes,
+                spill_dir=kv_offload_dir,
+                counters=self.offload)
+            # export/import closures resolve the cache-home engine at
+            # CALL time: under disagg the prefill pool is the cache
+            # home, and chaos wrappers installed post-construction
+            # (server.engine = ChaosEngine(...)) must intercept
+            self.prefix_cache.attach_offload(
+                self.offload_store,
+                lambda ids: (self.prefill_engine if self.disagg
+                             else self.engine).export_blocks(
+                                 ids, per_block_crc=True),
+                lambda ids, payload: (
+                    self.prefill_engine if self.disagg
+                    else self.engine).import_blocks(ids, payload),
+                counters=self.offload,
+                promote_hist=self.offload_promote,
+                clock=clock)
         self.scheduler = Scheduler(
             self.engine.allocator,
             max_batch_size=self.engine.max_batch_size,
@@ -1021,6 +1089,7 @@ class InferenceServer:
             oom0 = self.oom.total
             drafted0 = self.spec.count("drafted_tokens")
             accepted0 = self.spec.count("accepted_tokens")
+            off0 = self._offload_marks()
             self._phase = self._new_phase()
         # RETIRE: consume the previous iteration's launched step before
         # any host decision — deadlines, shedding, admission, and
@@ -1245,6 +1314,7 @@ class InferenceServer:
                     "pending": 1 if self._inflight is not None else 0,
                     "retired_tokens": retired,
                 },
+                "offload": self._offload_delta(off0),
                 "phase": self._phase,
                 "step_s": step_s,
             })
@@ -1289,6 +1359,21 @@ class InferenceServer:
                 "decode_launches": 0, "decode_tokens": 0,
                 "verify_launches": 0, "verify_columns": 0,
                 "handoff_blocks": 0}
+
+    # per-step offload deltas for the flight record (docs/serving.md,
+    # "Hierarchical KV offload") — the tier-crossing view per
+    # iteration, same mark/delta pattern as evicted_blocks/oom above
+    _OFFLOAD_EVENTS = ("demotes", "promotes_host", "promotes_disk",
+                       "spills", "crc_rejects")
+
+    def _offload_marks(self) -> tuple:
+        c = self.offload.count
+        return tuple(c(k) for k in self._OFFLOAD_EVENTS)
+
+    def _offload_delta(self, marks: tuple) -> dict:
+        c = self.offload.count
+        return {k: c(k) - m
+                for k, m in zip(self._OFFLOAD_EVENTS, marks)}
 
     def _decode_inputs(self, running):
         """The decode launch arrays — (tokens, positions, tables),
@@ -1701,6 +1786,7 @@ class InferenceServer:
             oom0 = self.oom.total
             drafted0 = self.spec.count("drafted_tokens")
             accepted0 = self.spec.count("accepted_tokens")
+            off0 = self._offload_marks()
             self._phase = self._new_phase()
         # RETIRE the decode pool's in-flight step first — this is the
         # inter-token edge disaggregation protects
@@ -1825,6 +1911,7 @@ class InferenceServer:
                     "pending": 1 if self._inflight is not None else 0,
                     "retired_tokens": retired,
                 },
+                "offload": self._offload_delta(off0),
                 "phase": self._phase,
                 "disagg": {
                     "handoff_pending": len(self._handoff),
@@ -2576,6 +2663,7 @@ class InferenceServer:
         self.plan_time.reset()
         self.spec_drafted_hist.reset()
         self.spec_accepted_hist.reset()
+        self.offload_promote.reset()
         self.scheduler.finished.clear()
         self._finalized = 0
         self._rec_cursor = 0
@@ -2616,6 +2704,13 @@ class InferenceServer:
             "blocks_evictable_peak": (cache_here.evictable_peak
                                       if cache_here is not None
                                       else 0),
+            # the evictable holds PRICED in pool bytes (same
+            # bytes_per_block math as pool_bytes, scale sidecars
+            # included): the warm-but-reclaimable capacity an offload
+            # sizing decision trades against host_bytes
+            "evictable_bytes": (cache_here.num_evictable
+                                if cache_here is not None else 0)
+            * info["bytes_per_block"],
             "occupancy": round(live / usable, 3),
             "occupancy_peak": round(alloc.live_peak / usable, 3),
             "frag_slots": frag,
@@ -2659,6 +2754,10 @@ class InferenceServer:
             "prefill_blocks_evictable": (
                 self.prefix_cache.num_evictable
                 if self.prefix_cache is not None else 0),
+            "prefill_evictable_bytes": (
+                self.prefix_cache.num_evictable
+                if self.prefix_cache is not None else 0)
+            * self.prefill_engine.memory_info()["bytes_per_block"],
             "prefill_pool_bytes":
                 self.prefill_engine.memory_info()["pool_bytes"],
             "prefill_backlog_blocks":
@@ -2669,6 +2768,40 @@ class InferenceServer:
                 **self.handoffs.as_dict(),
             },
             "sink_attached": self.handoff_sink is not None,
+        }
+
+    def _offload_stats(self) -> dict:
+        """The pinned ``stats()["offload"]`` block (docs/serving.md,
+        "Hierarchical KV offload"): demote/promote/spill/reject
+        counters from the ``serving_offload`` meter, the store's tier
+        occupancy, and the promote-latency histogram.  Counter keys
+        are present (zero) even before the first event — and with
+        offload disabled — so dashboards and the flight recorder
+        never key-miss."""
+        c = self.offload.count
+        store = self.offload_store
+        return {
+            "enabled": self.kv_offload,
+            "demotes": c("demotes"),
+            "demote_failed": c("demote_failed"),
+            "promotes_host": c("promotes_host"),
+            "promotes_disk": c("promotes_disk"),
+            "spills": c("spills"),
+            "crc_rejects": c("crc_rejects"),
+            "disk_torn": c("disk_torn"),
+            "capacity_skips": c("capacity_skips"),
+            "host_dropped": c("host_dropped"),
+            "host_entries": (store.host_entries
+                             if store is not None else 0),
+            "host_bytes": (store.host_used_bytes
+                           if store is not None else 0),
+            "host_bytes_cap": (store.host_bytes
+                               if store is not None else 0),
+            "disk_entries": (store.disk_entries
+                             if store is not None else 0),
+            "spill_dir": (store.spill_dir
+                          if store is not None else None),
+            "promote_ms": _hist_ms(self.offload_promote),
         }
 
     def _program_stats(self) -> dict:
@@ -2849,6 +2982,13 @@ class InferenceServer:
             # free/live/evictable partition plus the hand-off
             # counters; {enabled: False} on a monolithic server
             "disagg": self._disagg_stats(),
+            # hierarchical KV offload (docs/serving.md, "Hierarchical
+            # KV offload"): tier-crossing counters (demote / promote
+            # by hit tier / spill / integrity rejects), store
+            # occupancy, and the promote-latency histogram;
+            # {"enabled": False} with zeroed counters when off —
+            # shape-stable either way
+            "offload": self._offload_stats(),
             # tensor-parallel serving (docs/serving.md,
             # "Tensor-parallel serving"): mesh geometry, tp degree,
             # per-shard KV bytes, and the mesh-lowered program count —

@@ -868,25 +868,42 @@ class DecodeEngine:
         ids[:len(block_ids)] = block_ids
         return (ids[:, None] * bs + np.arange(bs)[None, :]).reshape(-1)
 
-    def export_blocks(self, block_ids) -> dict:
+    def export_blocks(self, block_ids, *,
+                      per_block_crc: bool = False) -> dict:
         """Materialize ``block_ids``' contents as a host payload — the
         CROSS-REPLICA hand-off transfer unit (``docs/serving.md``,
         "Disaggregated prefill/decode"): every cache leaf's rows for
         those blocks (scale sidecars included under quantization) plus
         a per-leaf crc32, so a torn transfer is DETECTED at import
-        instead of silently decoding garbage."""
+        instead of silently decoding garbage.
+
+        ``per_block_crc=True`` additionally records a crc32 PER BLOCK
+        per leaf: the offload tier demotes blocks in one batched
+        export and re-verifies each block against these at promote
+        time (``offload.split_payload``), so rot between demote and
+        promote is still caught per block even though the device
+        gather ran once.  The hot hand-off path leaves it off — the
+        whole-leaf crc already covers a one-shot transfer."""
         import zlib
 
         slots = self._block_slots(block_ids, len(block_ids))
-        leaves = {name: np.asarray(arr[:, slots])
+        leaves = {name: np.ascontiguousarray(np.asarray(arr[:, slots]))
                   for name, arr in self.cache.items()}
-        return {
+        bs = self.block_size
+        payload = {
             "num_blocks": len(block_ids),
-            "block_size": self.block_size,
+            "block_size": bs,
             "leaves": leaves,
-            "crc": {name: zlib.crc32(np.ascontiguousarray(a).tobytes())
+            "crc": {name: zlib.crc32(a.tobytes())
                     for name, a in leaves.items()},
         }
+        if per_block_crc:
+            payload["block_crc"] = {
+                name: [zlib.crc32(np.ascontiguousarray(
+                    a[:, i * bs:(i + 1) * bs]).tobytes())
+                    for i in range(len(block_ids))]
+                for name, a in leaves.items()}
+        return payload
 
     def import_blocks(self, block_ids, payload) -> None:
         """Scatter an :meth:`export_blocks` payload into THIS pool's
@@ -912,10 +929,18 @@ class DecodeEngine:
                 f"must match across replicas)")
         for name, arr in leaves.items():
             got = zlib.crc32(np.ascontiguousarray(arr).tobytes())
-            if got != payload["crc"].get(name):
+            want = payload["crc"].get(name)
+            if got != want:
+                # name the culprit: which leaf, which destination
+                # blocks, and both crcs — a torn payload in a
+                # postmortem must not read as "rejected whole, no
+                # idea where" (the offload promote path and the
+                # cross-replica hand-off both route through here)
                 raise ValueError(
-                    f"torn hand-off payload: leaf {name!r} checksum "
-                    f"{got} != recorded {payload['crc'].get(name)}")
+                    f"torn hand-off payload: leaf {name!r} for "
+                    f"block(s) {list(map(int, block_ids))} has "
+                    f"checksum {got} (actual) != {want} (expected); "
+                    f"payload rejected whole")
         w = self.blocks_per_seq
         slots = self._block_slots(block_ids, w).astype(np.int32)
         padded = {}

@@ -1,0 +1,373 @@
+"""Hierarchical KV offload — host-RAM/disk tiers behind the prefix cache.
+
+The prefix cache (``serving/prefix_cache``) only lives in device pool
+bytes, so HBM capacity — not compute — caps how many conversations
+stay warm: at million-session scale a returning user almost always
+cold-prefills.  This module adds the missing tiers.  An
+:class:`OffloadStore` holds exported KV block payloads
+(:meth:`DecodeEngine.export_blocks` dicts — host numpy leaves plus
+per-leaf crc32s) in a bounded host-RAM LRU, spilling the coldest
+entries to an optional disk tier; the prefix cache **demotes** a cold
+evictable block into the store at the moment eviction would have
+destroyed it, and **promotes** it back through the checksummed
+``import_blocks`` path into a fresh device block when a later
+admission's radix walk wants it — a cache hit now spans three tiers
+(device -> host -> disk) at fixed HBM.
+
+Keys are the radix index's chain hashes (``blake2b`` over
+``parent_hash + chunk tokens``): a pure function of token CONTENT, so
+they survive block-id reuse, allocator resets, and — for the disk
+tier — process restarts.  Payload integrity is defended twice: the
+disk tier writes a per-leaf checksum manifest and verifies it on
+load (a torn or bit-rotted spill is deleted whole and reads as a
+miss), and ``import_blocks`` re-verifies the export-time crc32s
+against the bytes it is about to scatter into the pool (a corrupt
+host payload is rejected whole).  Either failure falls back to cold
+prefill — bit-identical output, just slower — so the offload tier can
+NEVER corrupt generation, only decline to accelerate it.
+
+Disk writes follow the ``CheckpointManager`` atomic-publish pattern:
+every entry is staged under a ``.tmp-`` sibling, fsynced, and
+``os.rename``d into place — a crash mid-spill leaves a stale temp
+directory (swept at startup), never a half-readable entry.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from apex_tpu.utils.checkpoint import leaf_checksum
+from apex_tpu.utils.meters import CounterMeter
+
+__all__ = ["KV_OFFLOAD_ENV", "OffloadStore", "resolve_kv_offload"]
+
+# fleet-wide enable twin of the ``enable_kv_offload=`` kwarg
+# (precedent: APEX_TPU_KV_QUANT) — a provided kwarg wins; the env
+# only fills in a None ("not provided") kwarg
+KV_OFFLOAD_ENV = "APEX_TPU_KV_OFFLOAD"
+
+MANIFEST_FILE = "manifest.json"
+_TMP_PREFIX = ".tmp-"
+
+
+def resolve_kv_offload(value) -> bool:
+    """Normalize an ``enable_kv_offload`` kwarg/env value to a bool.
+
+    ``None``, ``""``, ``"0"``, ``"off"``, ``"none"``, ``"false"`` and
+    ``"no"`` disable; ``"1"``, ``"on"``, ``"true"`` and ``"yes"``
+    enable; anything else raises (a typo'd env var must not silently
+    run the fleet without its offload tier)."""
+    if value is None:
+        return False
+    if isinstance(value, bool):
+        return value
+    v = str(value).strip().lower()
+    if v in ("", "0", "off", "none", "false", "no"):
+        return False
+    if v in ("1", "on", "true", "yes"):
+        return True
+    raise ValueError(
+        f"unknown KV offload mode {value!r} (from kwarg or "
+        f"{KV_OFFLOAD_ENV}): use '1'/'on' or '0'/'off'")
+
+
+def payload_nbytes(payload: dict) -> int:
+    """Host bytes one exported payload occupies (every cache leaf)."""
+    return sum(int(np.asarray(a).nbytes)
+               for a in payload["leaves"].values())
+
+
+def verify_payload(payload: dict) -> None:
+    """Host-side integrity check of one exported payload against its
+    RECORDED per-leaf crc32s — the same test ``import_blocks`` runs,
+    hoisted out so the promote walk can reject a torn payload before
+    any device or radix state moves.  Raises :class:`ValueError`
+    naming the first rotten leaf."""
+    import zlib
+
+    for name, arr in payload["leaves"].items():
+        got = zlib.crc32(np.ascontiguousarray(arr).tobytes())
+        want = payload["crc"].get(name)
+        if got != want:
+            raise ValueError(
+                f"torn offload payload: leaf {name!r} has checksum "
+                f"{got} (actual) != {want} (expected); payload "
+                f"rejected whole")
+
+
+def merge_payloads(payloads: List[dict]) -> dict:
+    """Concatenate per-block exported payloads into one multi-block
+    payload for a single batched ``import_blocks`` launch.  The
+    merged crcs are RECOMPUTED from the concatenated bytes — callers
+    must have verified each input against its stored checksums first
+    (:func:`verify_payload`); this merge is dispatch economy, not an
+    integrity step."""
+    import zlib
+
+    if len(payloads) == 1:
+        return payloads[0]
+    leaves = {name: np.concatenate(
+        [p["leaves"][name] for p in payloads], axis=1)
+        for name in payloads[0]["leaves"]}
+    return {
+        "num_blocks": sum(p["num_blocks"] for p in payloads),
+        "block_size": payloads[0]["block_size"],
+        "leaves": leaves,
+        "crc": {name: zlib.crc32(np.ascontiguousarray(a).tobytes())
+                for name, a in leaves.items()},
+    }
+
+
+def split_payload(payload: dict) -> List[dict]:
+    """Slice one batched :meth:`DecodeEngine.export_blocks` payload
+    into per-block payloads — the demote path's dual of
+    :func:`merge_payloads`: eviction gathers a whole victim batch off
+    the device in ONE launch, then stores each block under its own
+    content hash.  Each slice carries the crc the ENGINE recorded for
+    that block at export time (``block_crc``), so per-block integrity
+    survives the batching; a payload without ``block_crc`` (not
+    engine-built) falls back to checksumming the slice here."""
+    import zlib
+
+    n = payload["num_blocks"]
+    if n == 1:
+        return [payload]
+    bs = payload["block_size"]
+    bc = payload.get("block_crc")
+    out = []
+    for i in range(n):
+        leaves = {name: np.ascontiguousarray(
+            arr[:, i * bs:(i + 1) * bs])
+            for name, arr in payload["leaves"].items()}
+        out.append({
+            "num_blocks": 1,
+            "block_size": bs,
+            "leaves": leaves,
+            "crc": ({name: bc[name][i] for name in leaves}
+                    if bc is not None else
+                    {name: zlib.crc32(a.tobytes())
+                     for name, a in leaves.items()}),
+        })
+    return out
+
+
+def _fsync_path(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+class OffloadStore:
+    """Bounded host-RAM tier + optional disk spill tier for exported
+    KV block payloads, keyed by the prefix cache's chain hashes.
+
+    - :meth:`put` inserts at the hot end of the host LRU; when the
+      tier exceeds ``host_bytes`` the coldest entries spill to
+      ``spill_dir`` (atomic write-tmp -> rename, per-leaf checksum
+      manifest) or, with no disk tier, are dropped and counted.
+    - :meth:`take` pops an entry (host first, then disk) — tiers are
+      exclusive, so a promoted payload leaves the store entirely; a
+      disk entry failing manifest verification is deleted whole and
+      reads as a miss (``disk_torn``).
+    - keys are content hashes, so surviving disk entries are adopted
+      on construction (a restarted server keeps its cold tier).
+
+    ``counters`` (normally the server's ``serving_offload`` meter)
+    accumulates ``spills`` / ``host_dropped`` / ``disk_torn``; the
+    demote/promote counts live with the prefix cache, which drives
+    this store.
+    """
+
+    def __init__(self, host_bytes: int = 64 << 20,
+                 spill_dir: Optional[str] = None,
+                 counters: Optional[CounterMeter] = None):
+        if int(host_bytes) < 0:
+            raise ValueError(
+                f"host_bytes must be >= 0, got {host_bytes}")
+        self.host_bytes = int(host_bytes)
+        self.spill_dir = spill_dir
+        self.counters = (counters if counters is not None
+                         else CounterMeter())
+        self._host: "OrderedDict[bytes, dict]" = OrderedDict()
+        self._host_used = 0
+        self._disk: Dict[bytes, None] = {}
+        if spill_dir is not None:
+            os.makedirs(spill_dir, exist_ok=True)
+            for name in sorted(os.listdir(spill_dir)):
+                path = os.path.join(spill_dir, name)
+                if name.startswith(_TMP_PREFIX):
+                    # a crash mid-spill: never renamed, never valid
+                    shutil.rmtree(path, ignore_errors=True)
+                    continue
+                try:
+                    key = bytes.fromhex(name)
+                except ValueError:
+                    continue    # foreign file; not ours to manage
+                if os.path.isfile(os.path.join(path, MANIFEST_FILE)):
+                    self._disk[key] = None
+
+    # -- introspection ----------------------------------------------------
+
+    @property
+    def host_entries(self) -> int:
+        return len(self._host)
+
+    @property
+    def host_used_bytes(self) -> int:
+        return self._host_used
+
+    @property
+    def disk_entries(self) -> int:
+        return len(self._disk)
+
+    def __contains__(self, key: bytes) -> bool:
+        return key in self._host or key in self._disk
+
+    def __len__(self) -> int:
+        return len(self._host) + len(self._disk)
+
+    def stats(self) -> dict:
+        return {
+            "host_entries": len(self._host),
+            "host_bytes": self._host_used,
+            "host_bytes_cap": self.host_bytes,
+            "disk_entries": len(self._disk),
+            "spill_dir": self.spill_dir,
+        }
+
+    # -- the store --------------------------------------------------------
+
+    def put(self, key: bytes, payload: dict) -> None:
+        """Insert ``payload`` at the hot end of the host tier,
+        spilling (or dropping) the coldest entries past the byte
+        bound.  Content-addressed: re-putting a present key only
+        refreshes its recency."""
+        if key in self._host:
+            self._host.move_to_end(key)
+            return
+        if key in self._disk:
+            return
+        nbytes = payload_nbytes(payload)
+        if nbytes > self.host_bytes:
+            # would never fit the host tier: straight to disk (or
+            # dropped — an oversized payload must not wedge the LRU)
+            if not self._spill(key, payload):
+                self.counters.incr("host_dropped")
+            return
+        self._host[key] = payload
+        self._host_used += nbytes
+        while self._host_used > self.host_bytes and self._host:
+            vkey, victim = self._host.popitem(last=False)
+            self._host_used -= payload_nbytes(victim)
+            if not self._spill(vkey, victim):
+                self.counters.incr("host_dropped")
+
+    def take(self, key: bytes) -> Optional[Tuple[dict, str]]:
+        """Pop ``key``'s payload and the tier it came from (``"host"``
+        / ``"disk"``), or None on miss.  A disk entry that fails its
+        manifest verification is deleted and reads as a miss."""
+        payload = self._host.pop(key, None)
+        if payload is not None:
+            self._host_used -= payload_nbytes(payload)
+            return payload, "host"
+        if key in self._disk:
+            payload = self._load(key)
+            if payload is not None:
+                return payload, "disk"
+        return None
+
+    def clear(self) -> None:
+        """Drop the host tier (disk entries stay — content-addressed,
+        they remain valid across allocator resets)."""
+        self._host.clear()
+        self._host_used = 0
+
+    # -- disk tier --------------------------------------------------------
+
+    def _spill(self, key: bytes, payload: dict) -> bool:
+        """Atomically publish ``payload`` as ``spill_dir/<key.hex()>/``
+        (write-tmp -> fsync -> rename, per the CheckpointManager
+        pattern) with a per-leaf checksum manifest.  False = no disk
+        tier configured (the caller counts the drop)."""
+        if self.spill_dir is None:
+            return False
+        hexkey = key.hex()
+        final = os.path.join(self.spill_dir, hexkey)
+        if key in self._disk and os.path.isdir(final):
+            return True         # content-addressed: already published
+        tmp = os.path.join(self.spill_dir, _TMP_PREFIX + hexkey)
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        manifest = {
+            "num_blocks": int(payload["num_blocks"]),
+            "block_size": int(payload["block_size"]),
+            "crc": {name: int(c)
+                    for name, c in payload["crc"].items()},
+            "leaves": {},
+        }
+        for i, name in enumerate(sorted(payload["leaves"])):
+            arr = np.ascontiguousarray(
+                np.asarray(payload["leaves"][name]))
+            fname = f"leaf{i}.npy"
+            fpath = os.path.join(tmp, fname)
+            with open(fpath, "wb") as f:
+                np.save(f, arr)
+                f.flush()
+                os.fsync(f.fileno())
+            manifest["leaves"][name] = {
+                "file": fname, "checksum": leaf_checksum(arr)}
+        mpath = os.path.join(tmp, MANIFEST_FILE)
+        with open(mpath, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_path(tmp)
+        os.rename(tmp, final)
+        _fsync_path(self.spill_dir)
+        self._disk[key] = None
+        self.counters.incr("spills")
+        return True
+
+    def _load(self, key: bytes) -> Optional[dict]:
+        """Read one disk entry back, verifying every leaf against the
+        MANIFEST-recorded checksum (recorded at write time — the only
+        reference that can convict torn bytes).  Any failure deletes
+        the entry whole and returns None; success also deletes it
+        (tiers are exclusive — the payload is leaving the store)."""
+        root = os.path.join(self.spill_dir, key.hex())
+        try:
+            with open(os.path.join(root, MANIFEST_FILE)) as f:
+                manifest = json.load(f)
+            leaves = {}
+            for name, ent in manifest["leaves"].items():
+                arr = np.load(os.path.join(root, ent["file"]))
+                got = leaf_checksum(arr)
+                if got != ent["checksum"]:
+                    raise ValueError(
+                        f"offload spill {key.hex()} leaf {name!r}: "
+                        f"checksum {got} != recorded "
+                        f"{ent['checksum']}")
+                leaves[name] = arr
+            payload = {
+                "num_blocks": int(manifest["num_blocks"]),
+                "block_size": int(manifest["block_size"]),
+                "leaves": leaves,
+                "crc": {name: int(c)
+                        for name, c in manifest["crc"].items()},
+            }
+        except (OSError, ValueError, KeyError) as _:
+            self.counters.incr("disk_torn")
+            self._disk.pop(key, None)
+            shutil.rmtree(root, ignore_errors=True)
+            return None
+        self._disk.pop(key, None)
+        shutil.rmtree(root, ignore_errors=True)
+        return payload

@@ -45,15 +45,38 @@ byte-for-byte for quantized blocks as well.
 
 from __future__ import annotations
 
+import hashlib
+import time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Set, Tuple
 
 from apex_tpu.serving.kv_cache import BlockAllocator
+from apex_tpu.serving.offload import (
+    merge_payloads,
+    split_payload,
+    verify_payload,
+)
 from apex_tpu.utils.meters import CounterMeter
 
 # chain parent of a sequence's first block — the reserved garbage
 # block's id, which is never allocated and so never collides
 ROOT = 0
+
+# chain hash of ROOT — the seed of every sequence's content-hash
+# chain (serving/offload): block i's hash covers its whole prefix by
+# induction, like the (parent id, chunk) key covers it by id chaining
+_ROOT_HASH = b"\x00" * 16
+
+
+def _chunk_hash(parent_hash: bytes, chunk) -> bytes:
+    """Content hash of a chain node: ``blake2b(parent_hash || chunk
+    tokens)`` — a pure function of token content (NOT block ids), so
+    it stays valid across block-id reuse and process restarts, which
+    is what lets it key the offload store's host/disk tiers."""
+    h = hashlib.blake2b(parent_hash, digest_size=16)
+    for t in chunk:
+        h.update(int(t).to_bytes(8, "little", signed=True))
+    return h.digest()
 
 
 class PrefixCache:
@@ -81,8 +104,43 @@ class PrefixCache:
         self._children: Dict[int, Set[int]] = {}       # block -> blocks
         self._lru: "OrderedDict[int, None]" = OrderedDict()  # evictable
         self.evictable_peak = 0     # high-watermark of LRU holds
+        # hierarchical offload (serving/offload; attached by the
+        # server when enable_kv_offload= is on): chain content hashes
+        # per registered block, the store, and the engine's
+        # export/import closures — all None when offload is off, and
+        # every offload branch below guards on the store
+        self._hash_of: Dict[int, bytes] = {}
+        self._demote_pending: List[Tuple[int, bytes]] = []
+        self._offload = None
+        self._exporter = None
+        self._importer = None
+        self._off_counters: Optional[CounterMeter] = None
+        self._promote_hist = None
+        self._clock = time.monotonic
         allocator.release_hook = self._on_release
         allocator.reset_hooks.append(self.clear)
+
+    def attach_offload(self, store, exporter, importer, *,
+                       counters: Optional[CounterMeter] = None,
+                       promote_hist=None, clock=None) -> None:
+        """Wire the host/disk offload tiers in (docs/serving.md,
+        "Hierarchical KV offload").  ``exporter`` / ``importer`` are
+        the cache-home engine's ``export_blocks`` / ``import_blocks``
+        (as closures, so chaos wrappers installed later still
+        intercept); must be attached before any block registers —
+        chain hashes are computed at registration time."""
+        if self._key_of:
+            raise RuntimeError(
+                "attach_offload must run before any block registers "
+                "(chain hashes are computed at registration)")
+        self._offload = store
+        self._exporter = exporter
+        self._importer = importer
+        self._off_counters = (counters if counters is not None
+                              else CounterMeter())
+        self._promote_hist = promote_hist
+        if clock is not None:
+            self._clock = clock
 
     # -- allocator hooks --------------------------------------------------
 
@@ -103,6 +161,10 @@ class PrefixCache:
         self._key_of.clear()
         self._children.clear()
         self._lru.clear()
+        self._hash_of.clear()
+        # dropped, not demoted: a reset means every stored id is
+        # dangling, so there is nothing coherent left to export
+        self._demote_pending.clear()
         self.evictable_peak = 0
 
     # -- introspection ----------------------------------------------------
@@ -177,6 +239,11 @@ class PrefixCache:
         self._map[key] = blk
         self._key_of[blk] = key
         self._children.setdefault(parent, set()).add(blk)
+        if self._offload is not None:
+            ph = (_ROOT_HASH if parent == ROOT
+                  else self._hash_of.get(parent))
+            if ph is not None:
+                self._hash_of[blk] = _chunk_hash(ph, key[1])
         return True
 
     # -- cross-replica warm-up (serving/elastic) ---------------------------
@@ -236,6 +303,113 @@ class PrefixCache:
                 self.allocator.free([dst])  # unregistered -> free list
         return seeded
 
+    # -- promotion (serving/offload) ---------------------------------------
+
+    def promote(self, tokens: List[int], matched: List[int],
+                alloc_fn) -> int:
+        """Extend a :meth:`match` run with blocks re-materialized
+        from the offload store — the host/disk -> device tier
+        crossing, called by the scheduler at admission time right
+        after the device-tier walk stops.  Continues the radix walk
+        by CONTENT hash: each missing chunk's chain hash is probed in
+        the store, imported through the checksummed ``import_blocks``
+        path into a fresh device block (``alloc_fn``, the scheduler's
+        evicting allocator — colder LRU holds may demote to make
+        room), registered, and appended to ``matched`` with the same
+        one-ref-per-block contract :meth:`match` gives.
+
+        Every failure mode degrades to cold prefill, never to wrong
+        output: a store miss or full pool stops the walk; a checksum
+        reject discards the corrupt payload whole (``crc_rejects``);
+        a transient import OOM puts every payload back for next time
+        (``capacity_skips``).  Returns how many blocks promoted.
+
+        The walk is two-staged for dispatch economy: stage 1 probes /
+        integrity-checks / allocates per chunk host-side (crc32 over a
+        few KB each — the torn-spill reject happens HERE, before any
+        device or radix state moves), stage 2 scatters the whole
+        collected run through ONE batched ``import_blocks`` launch —
+        a 20-block promote costs one device dispatch, not 20."""
+        if self._offload is None:
+            return 0
+        bs = self.block_size
+        total = len(tokens) // bs
+        if len(matched) >= total:
+            return 0
+        parent = matched[-1] if matched else ROOT
+        ph = (_ROOT_HASH if parent == ROOT
+              else self._hash_of.get(parent))
+        if ph is None:
+            return 0
+        t0 = self._clock()
+        # -- stage 1: walk the chain, collect verified payloads ------
+        pending = []            # (hash, chunk, payload, tier)
+        for i in range(len(matched), total):
+            chunk = tuple(tokens[i * bs:(i + 1) * bs])
+            h = _chunk_hash(ph, chunk)
+            hit = self._offload.take(h)
+            if hit is None:
+                break
+            payload, tier = hit
+            try:
+                verify_payload(payload)
+            except ValueError:
+                # checksum reject: the payload is corrupt — discard
+                # it WHOLE (re-storing it would re-fail forever) and
+                # fall back to cold prefill, bit-identically
+                self._off_counters.incr("crc_rejects")
+                break
+            pending.append((h, chunk, payload, tier))
+            ph = h
+        if not pending:
+            return 0
+        # -- stage 2: one bulk alloc (one batched demote-eviction on
+        # the way, when the pool is tight), one batched import ------
+        fresh = alloc_fn(len(pending))
+        if fresh is None:
+            # pool dry even after eviction: keep the payloads warm
+            # for a later admission, cold-prefill this one
+            for h, _, payload, _ in pending:
+                self._offload.put(h, payload)
+            self._off_counters.incr("capacity_skips")
+            return 0
+        try:
+            self._importer(fresh, merge_payloads(
+                [p[2] for p in pending]))
+        except MemoryError:
+            # transient device OOM mid-import: the payloads are still
+            # good — put them all back and retry next admission
+            self.allocator.free(fresh)
+            for h, _, payload, _ in pending:
+                self._offload.put(h, payload)
+            self._off_counters.incr("capacity_skips")
+            return 0
+        except ValueError:
+            # belt-and-braces: stage 1 already verified the stored
+            # checksums, so a reject here means the bytes rotted
+            # in-flight — discard, cold-prefill
+            self.allocator.free(fresh)
+            self._off_counters.incr("crc_rejects")
+            return 0
+        promoted = 0
+        parent = matched[-1] if matched else ROOT
+        for j, (_, chunk, _, tier) in enumerate(pending):
+            blk = fresh[j]
+            if not self.register(parent, chunk, blk):
+                # cannot happen on a single-threaded walk (the chain
+                # was missing moments ago), but never leak: free this
+                # block and every unregistered one behind it
+                self.allocator.free(fresh[j:])
+                break
+            matched.append(blk)
+            self._off_counters.incr(
+                "promotes_host" if tier == "host" else "promotes_disk")
+            promoted += 1
+            parent = blk
+        if promoted and self._promote_hist is not None:
+            self._promote_hist.record(self._clock() - t0)
+        return promoted
+
     # -- eviction ---------------------------------------------------------
 
     def evict(self, n: int = 1) -> int:
@@ -247,6 +421,7 @@ class PrefixCache:
         while freed < n and self._lru:
             blk = next(iter(self._lru))
             freed += self._evict_subtree(blk)
+        self._flush_demotes()
         if freed:
             self.counters.incr("prefix_evicted_blocks", freed)
         return freed
@@ -258,14 +433,42 @@ class PrefixCache:
         freed = 0
         for child in list(self._children.get(blk, ())):
             freed += self._evict_subtree(child)
+        h = self._hash_of.get(blk)    # before _unregister drops it
         self._unregister(blk)
         if blk in self._lru:
             del self._lru[blk]
+            if self._offload is not None and h is not None:
+                self._demote_pending.append((blk, h))
             self.allocator.release_to_free(blk)
             freed += 1
         return freed
 
+    def _flush_demotes(self) -> None:
+        """Export every block the eviction pass just victimized into
+        the offload store in ONE batched device gather — the device
+        -> host tier crossing (docs/serving.md, "Hierarchical KV
+        offload").  Safe after ``release_to_free``: freed slots'
+        KV bytes stay untouched until an engine call re-writes them,
+        and the flush runs before :meth:`evict` returns the ids to
+        the allocator's caller.  Each block is stored under its own
+        content hash with the crc the engine recorded for it
+        (``offload.split_payload``).  A transient export OOM drops
+        the whole batch (the blocks die exactly as they did before
+        offload existed — never an error path)."""
+        pending, self._demote_pending = self._demote_pending, []
+        if not pending:
+            return
+        try:
+            payload = self._exporter([blk for blk, _ in pending])
+        except MemoryError:
+            self._off_counters.incr("demote_failed", len(pending))
+            return
+        for (_, h), sub in zip(pending, split_payload(payload)):
+            self._offload.put(h, sub)
+        self._off_counters.incr("demotes", len(pending))
+
     def _unregister(self, blk: int):
+        self._hash_of.pop(blk, None)
         key = self._key_of.pop(blk, None)
         if key is None:
             return
@@ -298,3 +501,8 @@ class PrefixCache:
         for blk in self._key_of:
             assert blk not in self.allocator._free_set, \
                 f"registered block {blk} is on the free list"
+        for blk in self._hash_of:
+            assert blk in self._key_of, \
+                f"chain hash held for unregistered block {blk}"
+        assert not self._demote_pending, \
+            "demote batch not flushed by the evict pass"

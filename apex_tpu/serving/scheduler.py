@@ -460,10 +460,15 @@ class Scheduler:
         if n <= 0:
             return []
         while not self.allocator.can_alloc(n):
-            if self.prefix_cache is None or not self.prefix_cache.evict(1):
+            # reclaim the whole deficit in ONE evict call: victims
+            # demote to the offload tier (when attached) as a single
+            # batched export, not one device gather per block
+            deficit = n - self.allocator.num_free
+            if self.prefix_cache is None \
+                    or not self.prefix_cache.evict(max(1, deficit)):
                 return None
             if self.tracer.enabled:
-                self.tracer.instant("evict", blocks=1)
+                self.tracer.instant("evict", blocks=max(1, deficit))
         return self.allocator.alloc(n)
 
     # -- iteration-level decisions ---------------------------------------
@@ -497,6 +502,16 @@ class Scheduler:
                 with self.tracer.span("prefix_match", uid=req.uid,
                                       ctx_tokens=len(ctx)):
                     matched = self.prefix_cache.match(ctx)
+                # hierarchical offload (docs/serving.md,
+                # "Hierarchical KV offload"): where the device-tier
+                # walk stopped, continue by content hash through the
+                # host/disk store — promoted blocks re-materialize
+                # into fresh device blocks (checksummed import) and
+                # extend `matched` in place BEFORE the hit/cow/fresh
+                # math below, so a three-tier hit plans its prefill
+                # exactly like a device-tier hit of the same depth
+                self.prefix_cache.promote(ctx, matched,
+                                          self._try_alloc)
             else:
                 matched = []
             hit = len(matched) * bs

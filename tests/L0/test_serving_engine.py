@@ -254,6 +254,23 @@ def test_stats_keys_are_backward_compatible(tiny):
     # unsharded: the per-device pool IS the logical pool
     assert st["memory"]["pool_bytes_per_device"] == \
         st["memory"]["pool_bytes"]
+    # hierarchical KV offload block (docs/serving.md, "Hierarchical
+    # KV offload"): pinned even with the tier off — ops_probe
+    # --offload and capacity dashboards key on these
+    off = {"enabled", "demotes", "demote_failed", "promotes_host",
+           "promotes_disk", "spills", "crc_rejects", "disk_torn",
+           "capacity_skips", "host_dropped", "host_entries",
+           "host_bytes", "host_bytes_cap", "disk_entries",
+           "spill_dir", "promote_ms"}
+    assert not off - st["offload"].keys(), \
+        f"stats() lost offload keys: {off - st['offload'].keys()}"
+    assert st["offload"]["enabled"] is False       # off by default
+    # evictable bytes price the cold reclaimable tier of the device
+    # pool (blocks_evictable * bytes_per_block) — the offload bench
+    # and ops_probe --offload render this
+    assert st["memory"]["evictable_bytes"] == \
+        st["memory"]["blocks_evictable"] \
+        * st["memory"]["bytes_per_block"]
     lat = st["latency"]
     assert set(lat) == {"ttft_ms", "queue_wait_ms", "decode_token_ms",
                         "itl_ms", "step_ms",

@@ -213,6 +213,73 @@ def test_elastic_flags_advertised_by_gating_tools():
         assert "--elastic" in res.stdout, tool
 
 
+# -- ops_probe --offload ---------------------------------------------------
+
+
+_OFFLOAD_BLOCK = {
+    "enabled": True,
+    "demotes": 912, "demote_failed": 0,
+    "promotes_host": 640, "promotes_disk": 32,
+    "spills": 4, "crc_rejects": 1, "disk_torn": 0,
+    "capacity_skips": 2, "host_dropped": 7,
+    "host_entries": 233, "host_bytes": 1908736,
+    "host_bytes_cap": 67108864,
+    "disk_entries": 4, "spill_dir": "/tmp/kv-spill",
+    "promote_ms": {"count": 12, "p50": 7.6, "p90": 16.0,
+                   "p99": 106.1, "max": 106.1},
+}
+
+
+def test_ops_probe_offload_renders_tier_table(stub_ops):
+    statusz = dict(_STATUSZ)
+    statusz["offload"] = _OFFLOAD_BLOCK
+    statusz["memory"] = {"blocks_evictable": 19,
+                         "evictable_bytes": 77824,
+                         "pool_bytes": 135168}
+    stub_ops.statusz_body = json.dumps(statusz).encode()
+    res = _probe(stub_ops.server_address[1], "--offload")
+    assert res.returncode == 0, res.stdout + res.stderr
+    # all three tiers, the crossing counters, and the device pool's
+    # reclaimable bytes must appear
+    for needle in ("device", "host", "disk", "77824",
+                   "demotes=912", "promotes_host=640",
+                   "promotes_disk=32", "crc_rejects=1",
+                   "capacity_skips=2", "/tmp/kv-spill", "p50=7.6"):
+        assert needle in res.stdout, (needle, res.stdout)
+
+
+def test_ops_probe_offload_gates_on_missing_block(stub_ops):
+    res = _probe(stub_ops.server_address[1], "--offload")
+    assert res.returncode == 1
+    assert "FAIL" in res.stderr and "offload" in res.stderr
+    _no_traceback(res)
+
+
+def test_ops_probe_offload_gates_on_disabled_tier(stub_ops):
+    statusz = dict(_STATUSZ)
+    statusz["offload"] = dict(_OFFLOAD_BLOCK, enabled=False)
+    stub_ops.statusz_body = json.dumps(statusz).encode()
+    res = _probe(stub_ops.server_address[1], "--offload")
+    assert res.returncode == 1
+    assert "FAIL" in res.stderr and "disabled" in res.stderr
+    _no_traceback(res)
+
+
+def test_kv_offload_flags_advertised_by_gating_tools():
+    """The build-matrix ``kv_offload`` axis invokes chaos_soak and
+    serving_bench with ``--kv-offload`` and ops_probe with
+    ``--offload`` — a dropped flag would fail the axis with an
+    argparse error instead of a judged result."""
+    for tool, flag in (("chaos_soak.py", "--kv-offload"),
+                       ("serving_bench.py", "--kv-offload"),
+                       ("ops_probe.py", "--offload")):
+        res = subprocess.run(
+            [sys.executable, str(REPO / "tools" / tool), "--help"],
+            capture_output=True, text=True, timeout=60)
+        assert res.returncode == 0, res.stderr
+        assert flag in res.stdout, tool
+
+
 # -- obs_dump --------------------------------------------------------------
 
 

@@ -320,6 +320,37 @@ env JAX_PLATFORMS=cpu python -m pytest tests/L0/test_elastic.py -q -x --no-heade
   && env JAX_PLATFORMS=cpu python tools/chaos_soak.py --seed 0 --iters 800 --elastic
 results[elastic]=$?
 
+# hierarchical KV offload: the host-RAM/disk tier axis
+# (docs/serving.md, "Hierarchical KV offload") — three gates:
+#   1. the L0 offload tier: the OffloadStore unit oracles (LRU byte
+#      bound, spill-or-drop, atomic write-tmp -> rename publish,
+#      manifest verification deleting torn entries whole, startup
+#      sweep + adoption), the promote failure-semantics unit oracles
+#      (capacity put-back, import-OOM put-back, corrupt-payload
+#      whole-rejection), the named-leaf import_blocks checksum
+#      rejection, and server-level bit-exact parity (greedy AND
+#      counter-keyed stochastic) vs an offload-off oracle across
+#      demote / host-promote / disk-spill / corrupt-spill / disagg
+#      traffic with per-step scheduler audits;
+#   2. serving_bench --kv-offload: the session-continuation A/B at
+#      fixed device pool bytes — resumed-session TTFT >= 2x faster
+#      than the offload-off cold re-prefill (promotes and demotes
+#      both observed), cold-pass AND resumed-pass token parity plus
+#      stochastic-stream parity ALWAYS;
+#   3. an 800-iteration seed-0 chaos soak with the offload tier ON
+#      (resume traffic class + torn-spill + promote-at-capacity
+#      fault twins armed, a real disk spill dir, a host tier small
+#      enough to force spills) — bit-exact replay vs an offload-OFF
+#      oracle proves the tier never changes tokens, and the
+#      crc-reject <= injected-torn reconciliation proves corrupt
+#      payloads are rejected, never decoded (legacy bench/chaos arms
+#      above pin enable_kv_offload=False, so their seeds stay valid).
+echo "=== build-matrix axis: kv-offload ==="
+env JAX_PLATFORMS=cpu python -m pytest tests/L0/test_offload.py -q -x --no-header \
+  && env JAX_PLATFORMS=cpu python tools/serving_bench.py --smoke --kv-offload --out - \
+  && env JAX_PLATFORMS=cpu python tools/chaos_soak.py --seed 0 --iters 800 --kv-offload
+results[kv_offload]=$?
+
 # chaos soak: the overload-robustness axis (docs/resilience.md,
 # "Overload policy & lifecycle") — the full serving stack (prefix
 # cache + chunked prefill + overload control + circuit breaker, small

@@ -38,6 +38,20 @@ quantized grid, and any divergence means a quantized block's bytes or
 scales were corrupted by a lifecycle path (COW, eviction, rollback,
 preemption re-prefill) rather than by the quantization itself.
 
+``--kv-offload`` soaks the HIERARCHICAL KV OFFLOAD tier
+(``docs/serving.md``, "Hierarchical KV offload"): the soaked server
+backs its prefix cache with a deliberately tiny host-RAM tier plus a
+disk spill directory, the session-continuation traffic class is armed
+(finished prompts resubmitted after a cool-down gap, so their demoted
+prefixes must PROMOTE back through the checksummed import path), and
+both offload fault classes fire — torn spills (a demoted payload's
+bytes rot; import must reject it whole and the admission cold-prefill
+bit-identically) and promote-at-capacity (``import_blocks`` raises a
+transient ``MemoryError``; the payload goes back to the store).  The
+replay oracle pins ``enable_kv_offload=False``, so bit-exact replay
+proves the offload tiers moved bytes, never tokens; legacy arms pin
+it ``False`` too, keeping their per-seed reports byte-identical.
+
 ``--streaming`` soaks the streaming delivery tier (``docs/serving.md``,
 "Streaming & cancellation"): every submitted request gets a per-token
 stream opened at submit and drained each iteration, the delivered
@@ -332,6 +346,19 @@ def main(argv=None) -> int:
                         "proves quantized blocks survive every "
                         "composed fault (docs/serving.md, "
                         "'Quantized KV cache')")
+    parser.add_argument("--kv-offload", dest="kv_offload",
+                        action="store_true",
+                        help="soak the HIERARCHICAL KV OFFLOAD tier "
+                        "(docs/serving.md, 'Hierarchical KV "
+                        "offload'): a tiny host-RAM tier + disk "
+                        "spill directory behind the prefix cache, "
+                        "with the session-continuation traffic class "
+                        "and BOTH offload fault classes armed (torn "
+                        "spills rejected whole by the checksummed "
+                        "import, promote-at-capacity put back).  The "
+                        "replay oracle pins enable_kv_offload=False, "
+                        "so bit-exact replay proves the tiers moved "
+                        "bytes, never tokens")
     parser.add_argument("--streaming", action="store_true",
                         help="soak the STREAMING delivery tier "
                         "(docs/serving.md, 'Streaming & "
@@ -459,6 +486,16 @@ def main(argv=None) -> int:
             return 2
         mesh = Mesh(_np.asarray(jax.devices()[:args.tp]), ("model",))
 
+    spill_root = None
+    if args.kv_offload:
+        import tempfile
+
+        # a real spill directory so the disk tier (atomic publish,
+        # manifest verification, torn-spill rejection) soaks too; the
+        # host tier is sized to a handful of blocks so spills and
+        # host-LRU drops actually fire under this pool's churn
+        spill_root = tempfile.mkdtemp(prefix="chaos-kv-offload-")
+
     def make_server(clock):
         # small pool + bounded queue: preemption, eviction, capacity,
         # displacement, and pressure shedding all actually fire.  The
@@ -492,6 +529,12 @@ def main(argv=None) -> int:
             enable_disagg=args.disagg,
             enable_speculation=args.speculative,
             enable_pipeline=args.pipeline,
+            # --kv-offload backs the prefix cache with the host/disk
+            # tiers; legacy arms pin it OFF so their per-seed reports
+            # stay byte-identical
+            enable_kv_offload=args.kv_offload,
+            kv_offload_host_bytes=32 << 10,
+            kv_offload_dir=spill_root,
             # --streaming soaks the delivery tier; legacy arms pin it
             # OFF so their per-seed reports stay byte-identical
             enable_streaming=args.streaming,
@@ -518,6 +561,9 @@ def main(argv=None) -> int:
             block_size=4, cache_dtype=jnp.float32, clock=clock,
             kv_quant="int8" if args.kv_quant else None,
             enable_disagg=False,
+            # the oracle never offloads: equality then proves the
+            # demote/promote tiers moved bytes, never tokens
+            enable_kv_offload=False,
             enable_speculation=args.speculative,
             enable_pipeline=args.pipeline,
             # the oracle never streams: delivery is observation-only,
@@ -543,6 +589,13 @@ def main(argv=None) -> int:
         # --streaming arms the client-disconnect fault class: a live
         # stream is torn down mid-decode and its request cancelled
         disconnect_rate=0.03 if args.streaming else 0.0,
+        # --kv-offload arms the session-continuation traffic class
+        # (resumed prompts must promote their demoted prefixes back)
+        # and both offload fault classes (torn spills + transient
+        # promote-at-capacity)
+        resume_rate=0.15 if args.kv_offload else 0.0,
+        offload_torn_rate=0.03 if args.kv_offload else 0.0,
+        offload_capacity_rate=0.03 if args.kv_offload else 0.0,
         force_violation_iter=args.force_violation)
     t0 = time.perf_counter()
     report = run_soak(make_server, chaos_cfg, args.seed,
@@ -554,6 +607,7 @@ def main(argv=None) -> int:
     report["sampling_traffic"] = bool(args.sampling)
     report["disagg_mode"] = bool(args.disagg)
     report["streaming_mode"] = bool(args.streaming)
+    report["kv_offload_mode"] = bool(args.kv_offload)
 
     line = json.dumps(report, indent=2, sort_keys=True)
     if args.out == "-":

@@ -37,6 +37,18 @@ Pure stdlib, so it runs anywhere a shell does:
     does one with the autoscaler disabled — probe a single server's
     port for non-elastic deployments.
 
+``--offload``
+    Render the hierarchical KV-offload tier's ``/statusz`` block
+    (``docs/serving.md``, "Hierarchical KV offload"): a
+    device/host/disk tier table (entries, bytes, capacity), the
+    tier-crossing counters (demotes / promotes per tier / spills /
+    crc rejects / capacity skips), and the promote-latency
+    histogram, plus the device pool's ``evictable_bytes`` — the
+    bytes a demote pass could reclaim right now.  A server without
+    the offload block FAILs (exit 1), and so does one with the tier
+    disabled: a capacity dashboard wired to this view must never
+    silently watch a store that is not running.
+
 ``--flight N`` / ``--request UID`` / ``--statusz`` / ``--metrics``
     Raw views of the corresponding endpoints.
 
@@ -267,6 +279,52 @@ def render_elastic(stats) -> int:
     return 0
 
 
+def render_offload(stats) -> int:
+    """The hierarchical-offload tier view: tier table + crossing
+    counters + promote latency (``stats()["offload"]``).  A missing
+    block means the endpoint predates the offload tier — that gates,
+    and so does a server with the tier disabled: probing a store
+    that is not running must alarm, not print an empty table."""
+    off = stats.get("offload")
+    if off is None:
+        print("FAIL: /statusz has no 'offload' block (server "
+              "predates the hierarchical KV offload tier?)",
+              file=sys.stderr)
+        return 1
+    if not off.get("enabled"):
+        print("FAIL: offload block present but the tier is disabled "
+              "(enable_kv_offload=False)", file=sys.stderr)
+        return 1
+    mem = stats.get("memory", {})
+    print(f"{'tier':<6} {'entries':>8} {'bytes':>12} {'cap':>12}")
+    print(f"{'device':<6} {mem.get('blocks_evictable', 0):>8} "
+          f"{mem.get('evictable_bytes', 0):>12} "
+          f"{mem.get('pool_bytes', 0):>12}")
+    print(f"{'host':<6} {off.get('host_entries'):>8} "
+          f"{off.get('host_bytes'):>12} "
+          f"{off.get('host_bytes_cap'):>12}")
+    disk_cap = "-" if off.get("spill_dir") else "off"
+    print(f"{'disk':<6} {off.get('disk_entries'):>8} "
+          f"{'-':>12} {disk_cap:>12}  {off.get('spill_dir') or ''}")
+    print(f"crossings: demotes={off.get('demotes')} "
+          f"(failed={off.get('demote_failed')}) "
+          f"promotes_host={off.get('promotes_host')} "
+          f"promotes_disk={off.get('promotes_disk')} "
+          f"spills={off.get('spills')} "
+          f"host_dropped={off.get('host_dropped')}")
+    print(f"integrity: crc_rejects={off.get('crc_rejects')} "
+          f"disk_torn={off.get('disk_torn')} "
+          f"capacity_skips={off.get('capacity_skips')}")
+    pm = off.get("promote_ms", {})
+    if pm.get("count"):
+        print(f"promote_ms: count={pm.get('count')} "
+              f"p50={pm.get('p50')} p90={pm.get('p90')} "
+              f"p99={pm.get('p99')} max={pm.get('max')}")
+    else:
+        print("promote_ms: no promotes yet")
+    return 0
+
+
 def assert_healthy(base, timeout) -> int:
     """The gate: healthz ok + conformant metrics + pinned statusz
     blocks.  Prints what failed; 0 only when everything holds."""
@@ -340,6 +398,11 @@ def main(argv=None) -> int:
                     "control signals, weights-version census, and "
                     "the decision table (FAILs when the endpoint "
                     "has no enabled autoscaler)")
+    ap.add_argument("--offload", action="store_true",
+                    help="render the hierarchical KV-offload tier: "
+                    "device/host/disk table, tier-crossing counters, "
+                    "promote latency (FAILs when the endpoint has no "
+                    "enabled offload store)")
     ap.add_argument("--statusz", action="store_true",
                     help="print the full /statusz JSON")
     ap.add_argument("--metrics", action="store_true",
@@ -364,7 +427,8 @@ def _run(args, base) -> int:
         rc = assert_healthy(base, args.timeout)
         if rc:
             return rc
-    if args.programs or args.statusz or args.streams or args.elastic:
+    if args.programs or args.statusz or args.streams \
+            or args.elastic or args.offload:
         code, _, body = fetch(base, "/statusz", args.timeout)
         if code != 200:
             print(f"FAIL: /statusz {code}", file=sys.stderr)
@@ -380,6 +444,10 @@ def _run(args, base) -> int:
                 return rc
         if args.elastic:
             rc = render_elastic(stats)
+            if rc:
+                return rc
+        if args.offload:
+            rc = render_offload(stats)
             if rc:
                 return rc
     if args.metrics:
@@ -406,8 +474,9 @@ def _run(args, base) -> int:
                                     f"/debug/requests/{args.request}"),
                          indent=2, sort_keys=True))
     if not any((args.assert_healthy, args.programs, args.statusz,
-                args.streams, args.elastic, args.metrics,
-                args.flight is not None, args.request is not None)):
+                args.streams, args.elastic, args.offload,
+                args.metrics, args.flight is not None,
+                args.request is not None)):
         code, _, body = fetch(base, "/healthz", args.timeout)
         health = parse_json(body, "/healthz")
         print(f"{base}/healthz -> {code} "

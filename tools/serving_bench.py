@@ -89,6 +89,17 @@ Gumbel-max coupling makes the fast paths invisible to outputs);
 (PR-8 shape) and the speculation contribution on
 decoded-tokens-per-engine-step (PR-6 shape, hardware-independent).
 
+``--kv-offload`` switches to the hierarchical-KV-offload
+session-continuation A/B of docs/serving.md's "Hierarchical KV
+offload" section (one JSON record to
+``BENCH_serving_kvoffload.json``): N sessions' prefixes are forced
+out of a fixed-size device pool, then every session resumes — median
+resumed-session TTFT with the evicted blocks PROMOTED back from the
+host tier vs paid as cold prefill, at the same device pool bytes.
+Cross-arm parity (greedy + counter-keyed stochastic) is always
+asserted; ``--smoke`` floors the resumed-TTFT speedup at >= 2x and
+requires the offload arm to have actually demoted and promoted.
+
 Usage:
     python tools/serving_bench.py --smoke
     python tools/serving_bench.py --smoke --shared-prefix
@@ -161,6 +172,7 @@ def run_continuous(cfg, params, prompts, args):
         block_size=args.block_size, cache_dtype=jnp.float32,
         kv_quant="off", enable_disagg=False,   # quant axis is its own mode
         enable_streaming=False,                # so is --streaming
+        enable_kv_offload=False,               # and --kv-offload
         # speculation and pipelining are measured by their own modes
         # (--speculative / --pipeline); the continuous-vs-naive record
         # keeps comparing the same synchronous one-token decode it
@@ -260,7 +272,7 @@ def _build_prefix_servers(cfg, params, args):
             cfg, params, max_batch_size=args.batch_size,
             max_context=args.max_context, block_size=args.block_size,
             cache_dtype=jnp.float32, kv_quant="off", enable_disagg=False,
-        enable_streaming=False,
+        enable_streaming=False, enable_kv_offload=False,
             enable_prefix_cache=cache,
             enable_chunked_prefill=chunk is not None,
             prefill_chunk=chunk,
@@ -390,7 +402,7 @@ def _spec_server(cfg, params, args, spec):
         cfg, params, max_batch_size=args.batch_size,
         max_context=args.max_context, block_size=args.block_size,
         cache_dtype=jnp.float32, kv_quant="off", enable_disagg=False,
-        enable_streaming=False,
+        enable_streaming=False, enable_kv_offload=False,
         enable_speculation=spec,
         spec_tokens=args.spec_tokens,
         # the speculation A/B isolates drafting from loop overlap
@@ -537,7 +549,7 @@ def _pipeline_server(cfg, params, args, on):
         cfg, params, max_batch_size=args.batch_size,
         max_context=args.max_context, block_size=args.block_size,
         cache_dtype=jnp.float32, kv_quant="off", enable_disagg=False,
-        enable_streaming=False,
+        enable_streaming=False, enable_kv_offload=False,
         enable_pipeline=on,
         # one-token decode in both arms: the pipeline axis measures
         # loop overlap, not speculation
@@ -681,7 +693,7 @@ def _disagg_server(cfg, params, args, disagg):
         max_context=args.max_context, block_size=args.block_size,
         num_blocks=args.disagg_blocks if disagg else total,
         cache_dtype=jnp.float32, kv_quant="off",
-        enable_streaming=False,
+        enable_streaming=False, enable_kv_offload=False,
         prefill_chunk=args.chunk,
         enable_disagg=disagg,
         disagg_prefill_blocks=(args.disagg_prefill_blocks
@@ -913,6 +925,7 @@ def _streaming_server(cfg, params, args, streaming, num_blocks=None):
         num_blocks=(num_blocks if num_blocks is not None
                     else args.batch_size * bps + 1),
         cache_dtype=jnp.float32, kv_quant="off", enable_disagg=False,
+        enable_kv_offload=False,
         enable_streaming=streaming)
 
 
@@ -1171,7 +1184,7 @@ def _sampling_server(cfg, params, args, pipeline, speculation):
         cfg, params, max_batch_size=args.batch_size,
         max_context=args.max_context, block_size=args.block_size,
         cache_dtype=jnp.float32, kv_quant="off", enable_disagg=False,
-        enable_streaming=False,
+        enable_streaming=False, enable_kv_offload=False,
         enable_pipeline=pipeline, enable_speculation=speculation,
         spec_tokens=args.spec_tokens)
 
@@ -1390,7 +1403,7 @@ def _tp_server(cfg, params, args, mesh):
         cfg, params, max_batch_size=args.batch_size,
         max_context=args.max_context, block_size=args.block_size,
         cache_dtype=jnp.float32, kv_quant="off", enable_disagg=False,
-        enable_streaming=False, mesh=mesh)
+        enable_streaming=False, enable_kv_offload=False, mesh=mesh)
 
 
 def _run_tp_workload(server, prompts, args):
@@ -1564,6 +1577,7 @@ def _kvq_server(cfg, params, args, quant, num_blocks=None,
                      else jnp.float32),
         kv_quant="int8" if quant else "off",
         enable_disagg=False, enable_streaming=False,
+        enable_kv_offload=False,
         num_blocks=num_blocks)
 
 
@@ -1754,6 +1768,192 @@ def run_kv_quant_mode(args):
     return rc
 
 
+def _kvoff_server(cfg, params, args, offload, num_blocks):
+    import jax.numpy as jnp
+
+    from apex_tpu.serving import InferenceServer
+
+    # both arms: identical DEVICE pool (the fixed byte budget the
+    # whole mode is about), prefix cache + chunked prefill on, every
+    # other axis pinned to its own mode — they differ ONLY in whether
+    # evicted cache blocks demote to the host tier or die
+    return InferenceServer(
+        cfg, params, max_batch_size=args.batch_size,
+        max_context=args.max_context, block_size=args.block_size,
+        num_blocks=num_blocks,
+        cache_dtype=jnp.float32, kv_quant="off", enable_disagg=False,
+        enable_streaming=False,
+        enable_prefix_cache=True,
+        enable_chunked_prefill=True, prefill_chunk=args.chunk,
+        enable_speculation=False, enable_pipeline=False,
+        enable_kv_offload=offload)
+
+
+def _kvoff_pass(server, prompts, args, sampling=None):
+    """One pass over the session set, one request at a time (TTFT
+    isolated from batching — the PR-3 methodology): returns
+    (per-request TTFT seconds, outputs)."""
+    ttfts, outs = [], []
+    for i, p in enumerate(prompts):
+        req = server.submit(p, args.max_new,
+                            sampling=sampling[i] if sampling else None)
+        ttft = 0.0
+        while not req.generated and not req.finished:
+            ttft += _step_audited(server)
+        while not req.finished:
+            _step_audited(server)
+        ttfts.append(ttft)
+        outs.append(list(req.generated))
+    return ttfts, outs
+
+
+def run_kv_offload_mode(args):
+    """The hierarchical-KV-offload session-continuation A/B
+    (docs/serving.md, "Hierarchical KV offload"; one JSON record to
+    ``BENCH_serving_kvoffload.json``).
+
+    The workload is the returning-session shape the offload tiers
+    exist for: N sessions, each a distinct long prefix + short tail,
+    over a device pool deliberately sized to hold only ~2.5 sessions'
+    blocks — so by the time the last cold session finishes, the first
+    sessions' cached prefixes have been EVICTED under pool pressure.
+    Then every session RESUMES (same prompt resubmitted) and the
+    median resumed-session TTFT is compared across two arms at the
+    SAME device pool bytes:
+
+    - *offload on*: eviction demoted the blocks to the host tier, so
+      the resume promotes them back through the checksummed
+      ``import_blocks`` path and prefills only what is missing;
+    - *offload off*: eviction destroyed the blocks, so the resume
+      pays the full cold chunked prefill.
+
+    Token-for-token parity (greedy AND counter-keyed stochastic) is
+    ALWAYS asserted across arms and across passes — promotion must
+    move bytes, never tokens.  ``--smoke`` additionally asserts the
+    >= 2x resumed-TTFT floor, that the offload arm actually promoted,
+    and that the off arm's resumes were genuinely cold."""
+    from apex_tpu.ops.sampling import SamplingParams
+
+    cfg, m, params = build_model(args)
+    rng = np.random.RandomState(args.seed + 7)
+    sessions = [list(rng.randint(0, args.vocab,
+                                 size=args.prefix_len + args.tail_len))
+                for _ in range(args.requests)]
+
+    # the fixed byte budget: ~2.5 sessions' prefix blocks (plus the
+    # active request's own headroom), far below what the whole
+    # session set needs — eviction MUST fire between cold passes
+    session_blocks = -(-(args.prefix_len + args.tail_len)
+                       // args.block_size)
+    req_blocks = -(-(args.prefix_len + args.tail_len + args.max_new)
+                   // args.block_size) + 2
+    num_blocks = max(session_blocks * 5 // 2, req_blocks
+                     + session_blocks) + 1
+    assert args.requests * session_blocks > num_blocks, \
+        "pool roomy enough to hold every session — nothing can evict"
+
+    def run_arm(offload):
+        server = _kvoff_server(cfg, params, args, offload, num_blocks)
+        server.generate([sessions[0][:8]], max_new_tokens=2)
+        server.reset_meters()
+        ttft_cold, outs_cold = _kvoff_pass(server, sessions, args)
+        ttft_resume, outs_resume = _kvoff_pass(server, sessions, args)
+        # the stochastic rider: counter-keyed streams are pure
+        # functions of (prompt, params, seed), so cross-arm parity
+        # must hold through promote exactly as it does for greedy
+        sampling = [SamplingParams(temperature=0.8, top_k=13,
+                                   top_p=0.9, seed=args.seed + i)
+                    for i in range(len(sessions))]
+        _, outs_stoch = _kvoff_pass(server, sessions, args,
+                                    sampling=sampling)
+        return (ttft_cold, ttft_resume, outs_cold, outs_resume,
+                outs_stoch, server.stats())
+
+    (cold_on, res_on, outs_cold_on, outs_res_on,
+     outs_st_on, stats_on) = run_arm(True)
+    (cold_off, res_off, outs_cold_off, outs_res_off,
+     outs_st_off, stats_off) = run_arm(False)
+
+    parity = (
+        sum(a != b for a, b in zip(outs_cold_on, outs_cold_off))
+        + sum(a != b for a, b in zip(outs_res_on, outs_res_off))
+        # greedy resume must also equal its own cold pass — the
+        # promoted blocks ARE the cold prefill's bytes
+        + sum(a != b for a, b in zip(outs_res_on, outs_cold_on)))
+    stoch_parity = sum(a != b
+                       for a, b in zip(outs_st_on, outs_st_off))
+
+    t_on, t_off = _median(res_on), _median(res_off)
+    off = stats_on["offload"]
+    record = {
+        "bench": "serving_kvoffload",
+        "mode": "smoke" if args.smoke else "full",
+        "config": {"sessions": args.requests,
+                   "prefix_len": args.prefix_len,
+                   "tail_len": args.tail_len,
+                   "max_new": args.max_new,
+                   "block_size": args.block_size,
+                   "device_pool_blocks": num_blocks,
+                   "session_blocks": session_blocks,
+                   "chunk": args.chunk,
+                   "hidden": args.hidden, "layers": args.layers,
+                   "heads": args.heads,
+                   "max_context": args.max_context,
+                   "vocab": args.vocab, "seed": args.seed},
+        "ttft_ms_resumed_offload": round(t_on * 1e3, 2),
+        "ttft_ms_resumed_cold": round(t_off * 1e3, 2),
+        "resume_speedup": round(t_off / max(t_on, 1e-9), 2),
+        # cold-pass medians: the two arms must START equal — offload
+        # costs nothing until eviction has something to demote
+        "ttft_ms_first_pass_offload": round(_median(cold_on) * 1e3, 2),
+        "ttft_ms_first_pass_cold": round(_median(cold_off) * 1e3, 2),
+        "parity_mismatches": parity,
+        "stochastic_parity_mismatches": stoch_parity,
+        "offload": off,
+        "evictable_bytes_peak_priced": (
+            stats_on["memory"]["evictable_bytes"]),
+        "cold_arm_resume_prefix_hits":
+            stats_off.get("prefix_hit_requests", 0),
+    }
+    print(json.dumps(record))
+
+    out = args.out
+    if out != "-":
+        if out is None:
+            out = os.path.join(
+                os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+                "BENCH_serving_kvoffload.json")
+        with open(out, "w") as f:
+            json.dump(record, f, indent=1)
+            f.write("\n")
+
+    rc = 0
+    # parity is ALWAYS the gate — a fast promote that changes tokens
+    # is a corruption, not a win (the BENCH_NOTES decision table)
+    if parity or stoch_parity:
+        print(f"FAIL: {parity} greedy + {stoch_parity} stochastic "
+              "parity mismatches across the offload A/B",
+              file=sys.stderr)
+        rc = 1
+    if args.smoke:
+        if record["resume_speedup"] < 2.0:
+            print(f"FAIL: resumed-session TTFT speedup "
+                  f"{record['resume_speedup']} < 2.0x floor at fixed "
+                  f"device pool bytes", file=sys.stderr)
+            rc = 1
+        if not (off["promotes_host"] + off["promotes_disk"]):
+            print("FAIL: offload arm never promoted — the workload "
+                  "did not exercise the tier it measures",
+                  file=sys.stderr)
+            rc = 1
+        if off["demotes"] == 0:
+            print("FAIL: offload arm never demoted — pool pressure "
+                  "never reached the cache", file=sys.stderr)
+            rc = 1
+    return rc
+
+
 def _router_fleet(cfg, params, args, kind):
     from apex_tpu.serving import RouterFleet, RouterPolicy
 
@@ -1770,7 +1970,7 @@ def _router_fleet(cfg, params, args, kind):
         max_context=args.max_context, block_size=args.block_size,
         num_blocks=args.router_blocks, cache_dtype=jnp.float32,
         kv_quant="off", enable_disagg=False,
-        enable_streaming=False,
+        enable_streaming=False, enable_kv_offload=False,
         # the elastic axis has its own arm (--elastic); pinned OFF
         # here so the placement A/B keeps a fixed-geometry fleet
         enable_elastic=False)
@@ -1921,6 +2121,7 @@ def _run_elastic_arm(cfg, params, args, schedule, elastic_on):
         block_size=args.block_size, num_blocks=args.router_blocks,
         cache_dtype=jnp.float32, max_waiting=8,
         clock=lambda: clock_state["t"],
+        enable_kv_offload=False,
         enable_elastic=elastic_on,
         elastic=AutoscalerConfig(
             min_replicas=1, max_replicas=3,
@@ -2219,6 +2420,17 @@ def main():
                     "net of the scale sidecar; docs/serving.md, "
                     "'Quantized KV cache') instead of the "
                     "continuous-vs-naive compare")
+    ap.add_argument("--kv-offload", dest="kv_offload",
+                    action="store_true",
+                    help="run the hierarchical-KV-offload "
+                    "session-continuation A/B (docs/serving.md, "
+                    "'Hierarchical KV offload'): resumed-session "
+                    "TTFT with evicted prefixes promoted from the "
+                    "host tier vs paid as cold prefill, at the SAME "
+                    "device pool bytes; parity (greedy + "
+                    "counter-keyed stochastic) always, >= 2x "
+                    "resumed-TTFT floor under --smoke "
+                    "(BENCH_serving_kvoffload.json)")
     ap.add_argument("--router", type=int, default=None, metavar="N",
                     help="run the multi-replica placement A/B "
                     "(affinity vs seeded-random routing of grouped "
@@ -2378,6 +2590,23 @@ def main():
             args.prompt_tokens = 8
             args.chunk = 32
             args.long_prompt = 96
+        if args.kv_offload:
+            # the session-continuation shape: prefixes long enough
+            # that a promote (host->device scatter) is decisively
+            # cheaper than re-prefilling them, a pool ~2.5 sessions
+            # deep so cold passes genuinely evict, still CPU-safe
+            args.requests = 6
+            args.max_new = 8
+            args.batch_size = 4
+            args.block_size = 8
+            args.vocab = 61
+            args.hidden = 64
+            args.layers = 2
+            args.heads = 2
+            args.max_context = 512
+            args.prefix_len = 448
+            args.tail_len = 7
+            args.chunk = 32
         if args.shared_prefix:
             # the prefix workloads need room for a long shared prefix
             # and a near-max-context prompt; still toy-model CPU-safe
@@ -2465,6 +2694,11 @@ def main():
 
     if args.kv_quant:
         return run_kv_quant_mode(args)
+
+    if args.kv_offload:
+        if args.prefix_len is None:
+            args.prefix_len = args.max_context // 2
+        return run_kv_offload_mode(args)
 
     if args.shared_prefix:
         if args.prefix_len is None:
