@@ -59,6 +59,19 @@ from apex_tpu.observability.flightrecorder import (
     NullFlightRecorder,
     write_postmortem,
 )
+from apex_tpu.observability.journey import (
+    JOURNEYS_ENV,
+    NULL_JOURNEY_LOG,
+    Journey,
+    JourneyContext,
+    JourneyLog,
+    NullJourneyLog,
+    dump_journeys,
+    journeys_census,
+    merge_exemplars,
+    merge_journeys,
+    resolve_journeys,
+)
 from apex_tpu.observability.opsplane import OPS_PORT_ENV, OpsServer
 from apex_tpu.observability.programs import (
     NULL_PROGRAM_ACCOUNTING,
@@ -72,6 +85,7 @@ from apex_tpu.observability.registry import (
     MetricsRegistry,
     PROMETHEUS_CONTENT_TYPE,
     escape_label_value,
+    fleet_prometheus_text,
     series_key,
     snapshot_diff,
 )
@@ -97,12 +111,18 @@ __all__ = [
     "Gauge",
     "HangWatchdog",
     "HistogramMeter",
+    "JOURNEYS_ENV",
+    "Journey",
+    "JourneyContext",
+    "JourneyLog",
     "MetricsRegistry",
     "NULL_FLIGHT_RECORDER",
+    "NULL_JOURNEY_LOG",
     "NULL_PROGRAM_ACCOUNTING",
     "NULL_TRACER",
     "NULL_WATCHDOG",
     "NullFlightRecorder",
+    "NullJourneyLog",
     "NullProgramAccounting",
     "NullTracer",
     "NullWatchdog",
@@ -116,9 +136,15 @@ __all__ = [
     "SLOTracker",
     "SpanTracer",
     "TRACE_ENV",
+    "dump_journeys",
     "enable_tracing",
     "escape_label_value",
+    "fleet_prometheus_text",
     "get_tracer",
+    "journeys_census",
+    "merge_exemplars",
+    "merge_journeys",
+    "resolve_journeys",
     "series_key",
     "set_tracer",
     "snapshot_diff",

@@ -51,6 +51,10 @@ MANIFEST_NAME = "manifest.json"
 FLIGHT_NAME = "flight.jsonl"
 METRICS_NAME = "metrics.json"
 TRACE_NAME = "trace.json"
+# optional member (only when the dumping server has journeys enabled;
+# tools/journey.py renders and gates it — docs/observability.md,
+# "Request journeys & exemplars")
+JOURNEYS_NAME = "journeys.json"
 
 
 class NullFlightRecorder:
@@ -134,7 +138,8 @@ class FlightRecorder:
 
 def write_postmortem(dirpath: str, *, recorder, registry=None,
                      tracer=None, reason: str = "on_demand",
-                     extra: Optional[Dict[str, Any]] = None) -> dict:
+                     extra: Optional[Dict[str, Any]] = None,
+                     journeys: Optional[Dict[str, Any]] = None) -> dict:
     """Write a postmortem bundle into ``dirpath`` (created if needed)
     and return its manifest dict.
 
@@ -150,6 +155,12 @@ def write_postmortem(dirpath: str, *, recorder, registry=None,
       (``steps_recorded`` / ``steps_in_bundle`` / ``steps_dropped``),
       the member file names, and any caller ``extra`` (chaos injection
       counts, the violated invariant, ...).
+
+    ``journeys`` (``observability.journey.dump_journeys`` output)
+    adds a FIFTH, optional member — ``journeys.json`` — and its
+    manifest ``files`` entry.  Journey-less bundles keep the legacy
+    four-file shape byte-for-byte, so ``tools/postmortem.py
+    --assert-complete`` gates old and new bundles identically.
     """
     os.makedirs(dirpath, exist_ok=True)
     recorder.dump_jsonl(os.path.join(dirpath, FLIGHT_NAME))
@@ -164,13 +175,19 @@ def write_postmortem(dirpath: str, *, recorder, registry=None,
         with open(trace_path, "w") as f:
             json.dump({"traceEvents": [], "displayTimeUnit": "ms"}, f)
             f.write("\n")
+    files = {"flight": FLIGHT_NAME, "metrics": METRICS_NAME,
+             "trace": TRACE_NAME}
+    if journeys is not None:
+        with open(os.path.join(dirpath, JOURNEYS_NAME), "w") as f:
+            json.dump(journeys, f, sort_keys=True)
+            f.write("\n")
+        files["journeys"] = JOURNEYS_NAME
     manifest = {
         "reason": reason,
         "steps_recorded": recorder.steps_recorded,
         "steps_in_bundle": len(recorder.records()),
         "steps_dropped": recorder.dropped,
-        "files": {"flight": FLIGHT_NAME, "metrics": METRICS_NAME,
-                  "trace": TRACE_NAME},
+        "files": files,
     }
     if extra:
         manifest["extra"] = extra

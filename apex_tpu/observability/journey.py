@@ -1,0 +1,405 @@
+"""Fleet-wide request journeys — cross-replica hop correlation.
+
+PRs 15-18 spread one request's life across many components: router
+placement, failover re-enqueue, disaggregated prefill/decode
+hand-off, hierarchical KV offload, streaming delivery.  Observability
+stayed per-server — each replica has its own ``SpanTracer``,
+``FlightRecorder`` and ``stats()`` — so the operator question *"why
+was THIS request slow?"* needed a manual join across artifacts.  This
+module is that join, made first-class:
+
+- :class:`JourneyContext` — the correlation token.  One per request,
+  created at the fleet front door (journey id = the router ``rid``)
+  or at a bare server's ``submit`` (journey id = the request ``uid``),
+  carried by the ``RouterRequest`` across failover and hand-off and by
+  ``Request.journey`` inside each server.  It holds the id plus a hop
+  counter: every recorded hop draws the next sequence number from the
+  context, so the hop order is CAUSAL BY CONSTRUCTION — the counter
+  travels with the request, and two hops can never race it because a
+  request is only ever live on one replica at a time (the router's
+  exactly-once terminal invariant).
+
+- :class:`JourneyLog` — the per-replica recording plane.  Each server
+  (and the router itself) owns one, labeled with its replica name and
+  wired to the owner's injected iteration counter and clock — hops
+  carry ``(replica, iter, seq, t)`` with NO wall-clock reads of their
+  own, so journeys are byte-deterministic wherever the soak clocks
+  are.  Recording never draws randomness and never feeds back into
+  scheduling: seed-0 chaos schedules are byte-identical journeys-on.
+
+- :class:`NullJourneyLog` / :data:`NULL_JOURNEY_LOG` — the disabled
+  path, mirroring ``NULL_TRACER`` / ``NULL_FLIGHT_RECORDER``: every
+  stamping site guards on ``journeys.enabled`` (and request contexts
+  stay ``None``), so a server built without journeys allocates
+  NOTHING per token (``tests/L0/test_journey.py`` pins it with
+  tracemalloc).
+
+- :func:`merge_journeys` — the reconciliation: per-replica hop
+  records merged into one causally-ordered :class:`Journey` per rid.
+  The merge sorts by the context-issued ``seq`` alone — equivalent to
+  the (replica-visit, iter, hop-seq) order but needing no clock
+  comparison across replicas — so a journey whose request moved
+  replicas mid-stream (failover) or mid-hand-off (torn transfer
+  retried) still reads front-to-back, exactly once.  A COMPLETE
+  journey has exactly one ``finish`` hop and a contiguous ``1..N``
+  sequence — the property ``tools/journey.py --assert-complete``
+  gates and the chaos soaks assert per finished rid.
+
+- SLO exemplars: :meth:`JourneyLog.exemplar` keeps, per histogram
+  bucket of a metric (TTFT / ITL), the WORST observation's value and
+  rid — so an SLO-miss p99 bucket links directly to a renderable
+  journey instead of a number with no story.
+
+Surfaces: ``stats()["journeys"]`` (pinned census), ``journey(rid)``
+on both ``InferenceServer`` and ``RouterFleet``, the ops plane's
+``GET /debug/journey/<rid>``, the postmortem bundle's
+``journeys.json`` member, and ``tools/journey.py`` (``--rid``,
+``--slowest``, ``--assert-complete``).  See ``docs/observability.md``,
+"Request journeys & exemplars".
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Optional
+
+__all__ = [
+    "HOP_FINISH",
+    "JOURNEYS_ENV",
+    "Journey",
+    "JourneyContext",
+    "JourneyLog",
+    "NULL_JOURNEY_LOG",
+    "NullJourneyLog",
+    "dump_journeys",
+    "journeys_census",
+    "merge_exemplars",
+    "merge_journeys",
+    "resolve_journeys",
+]
+
+# the terminal hop kind — exactly one per complete journey
+HOP_FINISH = "finish"
+
+# env twin of ``enable_journeys=`` (the KV_OFFLOAD_ENV pattern): turns
+# the journey plane on fleet-wide without touching call sites; a
+# PROVIDED kwarg wins
+JOURNEYS_ENV = "APEX_TPU_JOURNEYS"
+
+
+def resolve_journeys(value) -> bool:
+    """Normalize an ``enable_journeys`` kwarg/env value to a bool.
+    ``None`` / ``""`` / ``"0"`` / ``"off"`` / ``"none"`` / ``"false"``
+    / ``"no"`` disable; ``"1"`` / ``"on"`` / ``"true"`` / ``"yes"``
+    enable; anything else raises — a typo'd env var must not silently
+    run the fleet without its correlation plane."""
+    if value is None:
+        return False
+    if isinstance(value, bool):
+        return value
+    v = str(value).strip().lower()
+    if v in ("", "0", "off", "none", "false", "no"):
+        return False
+    if v in ("1", "on", "true", "yes"):
+        return True
+    raise ValueError(
+        f"unrecognized enable_journeys / {JOURNEYS_ENV} value: "
+        f"{value!r}")
+
+# pinned census shape (``stats()["journeys"]``): present and
+# shape-stable whether the plane is enabled or not, like the
+# ``flight`` / ``offload`` blocks (``tests/L0/test_journey.py``)
+_CENSUS_KEYS = ("enabled", "started", "finished", "open", "hops",
+                "dropped", "exemplars")
+
+
+class JourneyContext:
+    """The correlation token carried by one request: a stable journey
+    id (router ``rid``, or ``uid`` on a bare server) plus the hop
+    counter every recording site draws from.  Tiny and slotted — one
+    lives on every in-flight request while journeys are enabled."""
+
+    __slots__ = ("rid", "seq")
+
+    def __init__(self, rid: int):
+        self.rid = int(rid)
+        self.seq = 0
+
+    def next_hop(self) -> int:
+        self.seq += 1
+        return self.seq
+
+    def __repr__(self) -> str:
+        return f"JourneyContext(rid={self.rid}, seq={self.seq})"
+
+
+class JourneyLog:
+    """One replica's journey hop store.
+
+    Args:
+      replica: the label stamped on every hop this log records —
+        ``"router"`` at the fleet front door, the replica name inside
+        each server.
+      iter_source: zero-arg callable returning the owner's current
+        iteration (the server/fleet ``_iter``); hops are ordered on
+        these injected counters, never on wall clocks.
+      clock: the owner's injected seconds source — used only for
+        rendering/latency math, never for ordering.
+      capacity: bound on distinct rids retained; the OLDEST journey
+        is dropped past it (``dropped`` counts them).  Recording is
+        observation-only: no randomness, no feedback into scheduling.
+    """
+
+    enabled = True
+
+    def __init__(self, *, replica: str = "server",
+                 iter_source: Optional[Callable[[], int]] = None,
+                 clock: Optional[Callable[[], float]] = None,
+                 capacity: int = 4096):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.replica = replica
+        self.capacity = capacity
+        self._iter_source = iter_source or (lambda: 0)
+        self._clock = clock or (lambda: 0.0)
+        self._hops: Dict[int, List[dict]] = {}   # rid -> hop records
+        self._order: List[int] = []              # rid insertion order
+        self.started = 0
+        self.finished = 0
+        self.hops_recorded = 0
+        self.dropped = 0
+        # metric -> {bucket_index: (value, rid)} — worst value wins
+        self._exemplars: Dict[str, Dict[int, tuple]] = {}
+
+    # -- recording ---------------------------------------------------------
+
+    def start(self, rid: int) -> JourneyContext:
+        """Open a journey and return its traveling context."""
+        self.started += 1
+        return JourneyContext(rid)
+
+    def hop(self, ctx: JourneyContext, kind: str, **detail) -> None:
+        """Record one hop for ``ctx``'s journey: the context issues
+        the sequence number, this log stamps its replica label and the
+        injected iteration/clock.  ``kind == "finish"`` closes the
+        journey (census ``finished``)."""
+        rec = {"rid": ctx.rid, "seq": ctx.next_hop(),
+               "replica": self.replica,
+               "iter": int(self._iter_source()),
+               "t": float(self._clock()), "kind": kind}
+        if detail:
+            rec.update(detail)
+        bucket = self._hops.get(ctx.rid)
+        if bucket is None:
+            bucket = self._hops[ctx.rid] = []
+            self._order.append(ctx.rid)
+            while len(self._order) > self.capacity:
+                victim = self._order.pop(0)
+                self._hops.pop(victim, None)
+                self.dropped += 1
+        bucket.append(rec)
+        self.hops_recorded += 1
+        if kind == HOP_FINISH:
+            self.finished += 1
+
+    def exemplar(self, metric: str, bucket: int, value: float,
+                 rid: int) -> None:
+        """Keep the worst (largest) observation per histogram bucket
+        of ``metric``, with the rid that produced it — the SLO-miss ->
+        journey link."""
+        slots = self._exemplars.setdefault(metric, {})
+        cur = slots.get(bucket)
+        if cur is None or value > cur[0]:
+            slots[bucket] = (float(value), int(rid))
+
+    # -- reads -------------------------------------------------------------
+
+    def hops_for(self, rid: int) -> List[dict]:
+        return list(self._hops.get(rid, ()))
+
+    def rids(self) -> List[int]:
+        return list(self._order)
+
+    def exemplars(self) -> Dict[str, Dict[str, dict]]:
+        """JSON-shaped exemplar view: metric -> bucket-index (str) ->
+        ``{"value", "rid"}``."""
+        return {metric: {str(b): {"value": v, "rid": rid}
+                         for b, (v, rid) in sorted(slots.items())}
+                for metric, slots in sorted(self._exemplars.items())}
+
+    def census(self) -> dict:
+        return {"enabled": True, "started": self.started,
+                "finished": self.finished,
+                "open": max(0, self.started - self.finished),
+                "hops": self.hops_recorded, "dropped": self.dropped,
+                "exemplars": self.exemplars()}
+
+    def clear(self) -> None:
+        self._hops.clear()
+        self._order.clear()
+        self._exemplars.clear()
+        self.started = self.finished = 0
+        self.hops_recorded = self.dropped = 0
+
+
+class NullJourneyLog:
+    """Journeys OFF: the zero-allocation stand-in (``NULL_TRACER`` /
+    ``NullFlightRecorder`` precedent).  Every method is a no-op;
+    ``start`` returns None so requests carry no context and every
+    per-hop site short-circuits on ``enabled`` / ``ctx is None``."""
+
+    enabled = False
+    replica = "null"
+    started = 0
+    finished = 0
+    hops_recorded = 0
+    dropped = 0
+
+    def start(self, rid: int) -> None:
+        return None
+
+    def hop(self, ctx, kind: str, **detail) -> None:
+        pass
+
+    def exemplar(self, metric: str, bucket: int, value: float,
+                 rid: int) -> None:
+        pass
+
+    def hops_for(self, rid: int) -> List[dict]:
+        return []
+
+    def rids(self) -> List[int]:
+        return []
+
+    def exemplars(self) -> dict:
+        return {}
+
+    def census(self) -> dict:
+        return {"enabled": False, "started": 0, "finished": 0,
+                "open": 0, "hops": 0, "dropped": 0,
+                "exemplars": {}}
+
+    def clear(self) -> None:
+        pass
+
+
+NULL_JOURNEY_LOG = NullJourneyLog()
+
+
+class Journey:
+    """One request's merged, causally-ordered hop sequence."""
+
+    __slots__ = ("rid", "hops")
+
+    def __init__(self, rid: int, hops: List[dict]):
+        self.rid = rid
+        # the ordering argument (docs/observability.md): ``seq`` is
+        # issued by the ONE context object that travels with the
+        # request, so sorting on it alone is the (replica-visit,
+        # iter, hop-seq) causal order with no cross-replica clock
+        # comparison — wall clocks never participate
+        self.hops = sorted(hops, key=lambda h: h["seq"])
+
+    @property
+    def complete(self) -> bool:
+        """Exactly one terminal hop AND a gap-free ``1..N`` sequence —
+        the exactly-once reconciliation the chaos soaks assert."""
+        seqs = [h["seq"] for h in self.hops]
+        return (sum(h["kind"] == HOP_FINISH for h in self.hops) == 1
+                and seqs == list(range(1, len(seqs) + 1)))
+
+    @property
+    def finish_reason(self) -> Optional[str]:
+        for h in reversed(self.hops):
+            if h["kind"] == HOP_FINISH:
+                return h.get("reason")
+        return None
+
+    @property
+    def replicas(self) -> List[str]:
+        """Replicas visited, in first-touch order."""
+        seen: List[str] = []
+        for h in self.hops:
+            if h["replica"] not in seen:
+                seen.append(h["replica"])
+        return seen
+
+    def duration(self) -> float:
+        """Last-hop minus first-hop time on the injected clocks (0.0
+        for an empty/single-hop journey)."""
+        if len(self.hops) < 2:
+            return 0.0
+        return self.hops[-1]["t"] - self.hops[0]["t"]
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for h in self.hops:
+            out[h["kind"]] = out.get(h["kind"], 0) + 1
+        return out
+
+    def as_dict(self) -> dict:
+        return {"rid": self.rid, "complete": self.complete,
+                "finish_reason": self.finish_reason,
+                "replicas": self.replicas,
+                "duration": self.duration(),
+                "hop_counts": self.counts(), "hops": list(self.hops)}
+
+
+def merge_journeys(logs: Iterable, *,
+                   rid: Optional[int] = None) -> Dict[int, Journey]:
+    """Merge per-replica :class:`JourneyLog`\\ s into ``rid ->
+    Journey`` (or just one rid's when ``rid`` is given).  Disabled /
+    null logs contribute nothing.  Deterministic under any clock
+    values: ordering rides the context-issued sequence numbers."""
+    pools: Dict[int, List[dict]] = {}
+    for log in logs:
+        if not getattr(log, "enabled", False):
+            continue
+        targets = [rid] if rid is not None else log.rids()
+        for r in targets:
+            hops = log.hops_for(r)
+            if hops:
+                pools.setdefault(r, []).extend(hops)
+    return {r: Journey(r, hops) for r, hops in sorted(pools.items())}
+
+
+def merge_exemplars(logs: Iterable) -> Dict[str, Dict[str, dict]]:
+    """Worst-per-bucket union of per-replica exemplar tables."""
+    out: Dict[str, Dict[str, dict]] = {}
+    for log in logs:
+        if not getattr(log, "enabled", False):
+            continue
+        for metric, slots in log.exemplars().items():
+            mine = out.setdefault(metric, {})
+            for b, obs in slots.items():
+                cur = mine.get(b)
+                if cur is None or obs["value"] > cur["value"]:
+                    mine[b] = dict(obs)
+    return out
+
+
+def journeys_census(logs: Iterable) -> dict:
+    """Aggregate census over per-replica logs — the fleet-level
+    ``stats()["journeys"]`` block.  Shape-stable with the single-log
+    census (same pinned keys); all-disabled collapses to the null
+    census."""
+    logs = [log for log in logs if getattr(log, "enabled", False)]
+    if not logs:
+        return NullJourneyLog().census()
+    started = sum(log.started for log in logs)
+    finished = sum(log.finished for log in logs)
+    return {"enabled": True, "started": started, "finished": finished,
+            "open": max(0, started - finished),
+            "hops": sum(log.hops_recorded for log in logs),
+            "dropped": sum(log.dropped for log in logs),
+            "exemplars": merge_exemplars(logs)}
+
+
+def dump_journeys(logs: Iterable) -> dict:
+    """The postmortem-bundle member (``journeys.json``): every merged
+    journey (as dicts) plus the aggregate census — what
+    ``tools/journey.py`` renders and gates offline."""
+    logs = list(logs)
+    merged = merge_journeys(logs)
+    return {"census": journeys_census(logs),
+            "journeys": {str(r): j.as_dict()
+                         for r, j in merged.items()}}

@@ -30,6 +30,12 @@ Endpoints:
 - ``GET /debug/requests/<uid>`` — one request's ``timeline()`` (the
   slice ``tools/postmortem.py --request`` renders from bundles, but
   live) plus its current state; 404 for unknown uids.
+- ``GET /debug/journey/<rid>`` — one request's merged cross-replica
+  journey (``docs/observability.md``, "Request journeys &
+  exemplars"); 409 when journeys are disabled, 404 for unknown rids.
+- ``GET /metrics/fleet`` — fleet-wide Prometheus exposition with a
+  ``replica=<name>`` label per replica series (fleet ops plane only;
+  404 on a single server's).
 - ``POST /drain`` / ``POST /postmortem`` — authenticated-by-loopback
   triggers into :meth:`InferenceServer.drain` /
   :meth:`~InferenceServer.dump_postmortem` (non-loopback peers get
@@ -159,6 +165,8 @@ class OpsServer:
                     return self._count_send(
                         h, "metrics", 200, text.encode(),
                         PROMETHEUS_CONTENT_TYPE)
+                if path == "/metrics/fleet":
+                    return self._metrics_fleet(h)
                 if path == "/statusz":
                     with self.lock:
                         stats = self.server.stats()
@@ -171,6 +179,10 @@ class OpsServer:
                     return self._count_send(
                         h, "debug_requests",
                         *self._request(path.rsplit("/", 1)[1]))
+                if path.startswith("/debug/journey/"):
+                    return self._count_send(
+                        h, "debug_journey",
+                        *self._journey(path.rsplit("/", 1)[1]))
                 if path.startswith("/stream/"):
                     return self._stream(h, path.rsplit("/", 1)[1])
             elif method == "POST":
@@ -302,6 +314,42 @@ class OpsServer:
                 return _json(404, {"error": f"unknown request {uid}"})
             body = {"state": state, "timeline": req.timeline()}
         return _json(200, body)
+
+    def _metrics_fleet(self, h) -> None:
+        """Fleet-wide exposition (``fleet_metrics_text``): every
+        replica's series under a ``replica=<name>`` label in one
+        conformant page.  404 on a single server's ops plane — the
+        plain ``/metrics`` already is the whole story there."""
+        fm = getattr(self.server, "fleet_metrics_text", None)
+        if fm is None:
+            return self._count_send(h, "metrics_fleet", *_json(
+                404, {"error": "not a fleet ops plane"}))
+        # apexlint: disable=lock-discipline — documented lock-free: same scrape contract as /metrics (the registries serialize internally)
+        text = fm()
+        return self._count_send(h, "metrics_fleet", 200,
+                                text.encode(),
+                                PROMETHEUS_CONTENT_TYPE)
+
+    def _journey(self, rid_text: str) -> Tuple[int, bytes, str]:
+        """One request's merged journey (``docs/observability.md``,
+        "Request journeys & exemplars"): the fleet ops plane merges
+        hops across every replica the rid touched; a single server's
+        serves its local log.  409 when the correlation plane is not
+        armed — distinct from 404 (armed, rid unknown), so a prober
+        can tell "turn it on" from "no such request"."""
+        try:
+            rid = int(rid_text)
+        except ValueError:
+            return _json(400, {"error": f"bad rid: {rid_text!r}"})
+        jlog = getattr(self.server, "journeys", None)
+        if jlog is None or not jlog.enabled:
+            return _json(409, {"error": "journeys disabled "
+                                        "(enable_journeys=False)"})
+        with self.lock:
+            j = self.server.journey(rid)
+        if j is None:
+            return _json(404, {"error": f"unknown journey rid {rid}"})
+        return _json(200, j)
 
     def _drain(self) -> Tuple[int, bytes, str]:
         with self.lock:

@@ -386,6 +386,65 @@ class MetricsRegistry:
         return "\n".join(lines) + ("\n" if lines else "")
 
 
+def fleet_prometheus_text(sources) -> str:
+    """Merged Prometheus exposition over several registries —
+    ``sources`` is an iterable of ``(extra_labels, registry)`` pairs,
+    each registry's series re-emitted with ``extra_labels`` prepended
+    (the fleet passes ``{"replica": name}`` per replica and ``{}`` for
+    the router's own registry).
+
+    One exposition must carry exactly one ``# HELP``/``# TYPE`` pair
+    per family, so naive concatenation of per-replica
+    :meth:`MetricsRegistry.prometheus_text` outputs is malformed the
+    moment two replicas share a metric name (they all do — each
+    replica has a private registry with the same families).  This
+    merges by family instead: the first registry to define a name
+    wins the kind/help line, and every series gets its source's extra
+    labels so identically-named per-replica series never collide.
+    Served by the fleet ops plane's ``GET /metrics/fleet``."""
+    fams: Dict[str, list] = {}
+    kinds: Dict[str, str] = {}
+    helps: Dict[str, str] = {}
+    for extra, reg in sources:
+        items = _label_items(extra or {})
+        for m in reg.metrics():
+            if m.name not in kinds:
+                kinds[m.name] = reg._kinds[m.name]
+                helps[m.name] = reg._help.get(
+                    m.name, f"apex_tpu {kinds[m.name]} {m.name}")
+            fams.setdefault(m.name, []).append((items, m))
+    lines = []
+    for name in sorted(fams):
+        kind = kinds[name]
+        help_text = (helps[name].replace("\\", r"\\")
+                     .replace("\n", r"\n"))
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {kind}")
+        for extra, m in sorted(fams[name],
+                               key=lambda em: (em[0], em[1].labels)):
+            labels = extra + m.labels
+            if kind == "counter":
+                lines.append(f"{series_key(name, labels)} {m.value}")
+            elif kind == "gauge":
+                lines.append(f"{series_key(name, labels)} {m.val}")
+            else:
+                cum = 0
+                for bound, c in zip(m.bounds, m.bucket_counts):
+                    cum += c
+                    le = labels + (("le", repr(bound)),)
+                    lines.append(
+                        f"{series_key(name + '_bucket', le)} {cum}")
+                inf = labels + (("le", "+Inf"),)
+                lines.append(
+                    f"{series_key(name + '_bucket', inf)} {m.count}")
+                lines.append(
+                    f"{series_key(name + '_sum', labels)} {m.sum}")
+                lines.append(
+                    f"{series_key(name + '_count', labels)} "
+                    f"{m.count}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
 def snapshot_diff(old: Dict[str, Dict[str, Any]],
                   new: Dict[str, Dict[str, Any]],
                   ) -> Dict[str, Dict[str, Any]]:

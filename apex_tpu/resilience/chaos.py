@@ -652,7 +652,18 @@ def run_router_soak(make_fleet: Callable, cfg: ChaosConfig, seed: int,
          terminal reasons, and the router failed over at least once
          (the kill window is not allowed to pass silently);
       6. the killed replica RECOVERED: its router-side breaker is
-         closed again at the end and the replica is back in rotation.
+         closed again at the end and the replica is back in rotation;
+      7. (only when ``make_fleet`` arms ``enable_journeys=True``)
+         journey reconciliation: every routed rid merges to exactly
+         one COMPLETE journey — one finish hop, contiguous hop seqs
+         across every replica it touched — the failover hop pair
+         (evacuate -> reenqueue, causally adjacent) appears exactly
+         once per re-enqueue, and hop tallies equal the router's
+         reenqueued/handoffs/handoff_fallback counters.  The report
+         grows a ``"journeys"`` key (and, with ``postmortem_dir``, a
+         ``<postmortem_dir>/router_soak`` success bundle for
+         ``tools/journey.py --assert-complete``); journeys-off
+         reports stay byte-identical to pre-journey ones.
 
     ``make_fleet(clock)`` builds the ``RouterFleet`` on the soak's
     deterministic iteration clock (per-replica breakers must run on
@@ -781,6 +792,73 @@ def run_router_soak(make_fleet: Callable, cfg: ChaosConfig, seed: int,
             assert got == n, \
                 (f"counter requests_failed_{reason}={got} != {n} "
                  f"observed")
+
+        # invariant 7 (journey reconciliation, armed only when
+        # make_fleet built with enable_journeys=True — legacy
+        # (config, seed) reports stay byte-identical without it;
+        # docs/observability.md, "Request journeys & exemplars"):
+        # every routed rid merges to EXACTLY ONE complete journey
+        # (one finish hop, contiguous hop seqs across every replica
+        # it touched), the failover hop pair (evacuate -> reenqueue,
+        # consecutive seqs) appears once per re-enqueue, and the hop
+        # tallies reconcile with the router's own counters.
+        jreport = None
+        if fleet.journeys.enabled:
+            from apex_tpu.observability import merge_journeys
+
+            jcensus = fleet.stats()["journeys"]
+            assert jcensus["dropped"] == 0, \
+                (f"journey ring dropped {jcensus['dropped']} hop(s) "
+                 f"— raise the log capacity for this soak length")
+            journeys = merge_journeys(fleet._journey_logs())
+            hop_counts: Dict[str, int] = {}
+            pairs = 0
+            for rid in tracked:
+                j = journeys.get(rid)
+                assert j is not None, \
+                    f"finished rid {rid} never opened a journey"
+                assert j.complete, \
+                    (f"rid {rid}'s journey is incomplete: "
+                     f"{[ (h['seq'], h['kind']) for h in j.hops ]}")
+                for kind, n in j.counts().items():
+                    hop_counts[kind] = hop_counts.get(kind, 0) + n
+                for a_h, b_h in zip(j.hops, j.hops[1:]):
+                    if a_h["kind"] == "evacuate" \
+                            and b_h["kind"] == "reenqueue":
+                        pairs += 1
+            assert len(journeys) == len(tracked), \
+                (f"{len(journeys)} journeys merged != {len(tracked)} "
+                 f"routed requests — phantom or lost rids")
+            assert hop_counts.get("reenqueue", 0) \
+                == router["reenqueued"], \
+                (f"{hop_counts.get('reenqueue', 0)} reenqueue hop(s) "
+                 f"!= router reenqueued={router['reenqueued']}")
+            assert hop_counts.get("evacuate", 0) \
+                >= hop_counts.get("reenqueue", 0), \
+                "a reenqueue hop without its evacuate half"
+            assert pairs == hop_counts.get("reenqueue", 0), \
+                (f"{pairs} consecutive evacuate->reenqueue pair(s) "
+                 f"!= {hop_counts.get('reenqueue', 0)} reenqueue "
+                 f"hop(s) — the failover pair must be causally "
+                 f"adjacent")
+            assert hop_counts.get("handoff_ingest", 0) \
+                == router["handoffs"], \
+                (f"{hop_counts.get('handoff_ingest', 0)} ingest "
+                 f"hop(s) != router handoffs={router['handoffs']}")
+            assert hop_counts.get("handoff_fallback", 0) \
+                == router["handoff_fallback"], \
+                (f"{hop_counts.get('handoff_fallback', 0)} fallback "
+                 f"hop(s) != router "
+                 f"handoff_fallback={router['handoff_fallback']}")
+            jreport = {
+                "complete": len(tracked),
+                "hops": jcensus["hops"],
+                "evacuate_hops": hop_counts.get("evacuate", 0),
+                "reenqueue_hops": hop_counts.get("reenqueue", 0),
+                "failover_pairs": pairs,
+                "handoff_ingest_hops":
+                    hop_counts.get("handoff_ingest", 0),
+            }
     except AssertionError as e:
         _postmortem_and_reraise(e)
 
@@ -836,6 +914,18 @@ def run_router_soak(make_fleet: Callable, cfg: ChaosConfig, seed: int,
         affinity=router["affinity"],
         pressure_peak=stats["pressure_peak"],
     )
+    if jreport is not None:
+        report["journeys"] = jreport
+        if postmortem_dir is not None:
+            # success bundle: the soak's merged journeys, written so
+            # tools/journey.py --assert-complete can gate the SAME
+            # artifact CI would pull after a failure (the journey
+            # build-matrix axis consumes this)
+            bundle = os.path.join(postmortem_dir, "router_soak")
+            fleet.dump_postmortem(bundle, reason="soak_complete",
+                                  extra={"seed": seed})
+            jreport["bundle"] = bundle
+            log(f"journey bundle written: {bundle}")
     return report
 
 
@@ -1176,6 +1266,15 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
          composed) leaves the delivered prefix bit-exact vs the
          replay, the terminal ``"cancelled"``, and the pool
          audit-clean — cancellation must actually free the blocks.
+
+    Journeys (``docs/observability.md``, "Request journeys &
+    exemplars"): when ``make_server`` arms ``enable_journeys=True``
+    (``tools/chaos_soak.py --journeys``), every submitted uid must
+    merge to exactly one COMPLETE journey (one finish hop, contiguous
+    hop seqs) through every composed fault, with preempt hops equal
+    to the preemption ledger and offload_promote block sums equal to
+    the promote counters; the report grows a ``"journeys"`` key.
+    Journeys-off reports (the default) stay byte-identical.
     """
     schedule = ChaosSchedule.generate(cfg, seed)
     clock_state = {"t": 0.0}
@@ -1440,6 +1539,58 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
                 (f"watchdog fired {stats['watchdog']['stalls']} "
                  f"time(s) on a healthy soak (deadline "
                  f"{stats['watchdog']['deadline_s']}s)")
+        # journey reconciliation, single-server half (armed only by
+        # --journeys; docs/observability.md, "Request journeys &
+        # exemplars"): without a router the rid IS the uid, and every
+        # tracked uid must merge to exactly one complete journey —
+        # exactly one finish hop, contiguous seqs across enqueue /
+        # admit / preempt / offload-promote / hand-off / finish,
+        # through every composed fault.  Hop tallies reconcile with
+        # the pinned counters: preempt hops against the preemption
+        # ledger, offload_promote block sums against the promote
+        # counters.
+        jreport = None
+        if server.journeys.enabled:
+            from apex_tpu.observability import merge_journeys
+
+            jcensus = stats["journeys"]
+            assert jcensus["dropped"] == 0, \
+                (f"journey ring dropped {jcensus['dropped']} hop(s) "
+                 f"— raise the log capacity for this soak length")
+            journeys = merge_journeys([server.journeys])
+            hop_counts: Dict[str, int] = {}
+            for uid in tracked:
+                j = journeys.get(uid)
+                assert j is not None, \
+                    f"finished uid {uid} never opened a journey"
+                assert j.complete, \
+                    (f"uid {uid}'s journey is incomplete: "
+                     f"{[(h['seq'], h['kind']) for h in j.hops]}")
+                for kind, n in j.counts().items():
+                    hop_counts[kind] = hop_counts.get(kind, 0) + n
+            assert len(journeys) == len(tracked), \
+                (f"{len(journeys)} journeys merged != {len(tracked)} "
+                 f"submitted requests — phantom or lost uids")
+            assert hop_counts.get("preempt", 0) \
+                == stats["preemptions"], \
+                (f"{hop_counts.get('preempt', 0)} preempt hop(s) != "
+                 f"stats preemptions={stats['preemptions']}")
+            if stats["offload"]["enabled"]:
+                promoted_blocks = sum(
+                    h.get("blocks", 0) for j in journeys.values()
+                    for h in j.hops if h["kind"] == "offload_promote")
+                counted = (stats["offload"]["promotes_host"]
+                           + stats["offload"]["promotes_disk"])
+                assert promoted_blocks == counted, \
+                    (f"offload_promote hops carry {promoted_blocks} "
+                     f"block(s) != {counted} counted promotes")
+            jreport = {
+                "complete": len(tracked),
+                "hops": jcensus["hops"],
+                "preempt_hops": hop_counts.get("preempt", 0),
+                "offload_promote_hops":
+                    hop_counts.get("offload_promote", 0),
+            }
     except AssertionError as e:
         _postmortem_and_reraise(e)
 
@@ -1479,6 +1630,8 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
                    "disk_torn")}
                  if stats["offload"]["enabled"] else None),
     )
+    if jreport is not None:
+        report["journeys"] = jreport
     if streaming:
         bst = server.stream_broker.stats()
         report.update(

@@ -124,19 +124,25 @@ from typing import Callable, Dict, List, Optional, Sequence, Union
 import numpy as np
 
 from apex_tpu.observability import (
+    JOURNEYS_ENV,
     NULL_FLIGHT_RECORDER,
+    NULL_JOURNEY_LOG,
     NULL_PROGRAM_ACCOUNTING,
     NULL_WATCHDOG,
     OPS_PORT_ENV,
     POSTMORTEM_ENV,
     FlightRecorder,
     HangWatchdog,
+    JourneyLog,
     MetricsRegistry,
     OpsServer,
     ProgramAccounting,
     SLOPolicy,
     SLOTracker,
+    dump_journeys,
     get_tracer,
+    merge_journeys,
+    resolve_journeys,
     write_postmortem,
 )
 from apex_tpu.ops.sampling import SamplingParams, sample_tokens_host
@@ -502,7 +508,9 @@ class InferenceServer:
                  stream_queue_tokens: int = 256,
                  enable_kv_offload: Optional[bool] = None,
                  kv_offload_host_bytes: int = 64 << 20,
-                 kv_offload_dir: Optional[str] = None):
+                 kv_offload_dir: Optional[str] = None,
+                 enable_journeys: Optional[bool] = None,
+                 journey_replica: str = "server"):
         self.registry = registry if registry is not None \
             else MetricsRegistry()
         self.tracer = tracer if tracer is not None else get_tracer()
@@ -658,6 +666,22 @@ class InferenceServer:
                 counters=self.offload,
                 promote_hist=self.offload_promote,
                 clock=clock)
+        # journey correlation plane (docs/observability.md, "Request
+        # journeys & exemplars"; OFF by default): one JourneyLog per
+        # server, labeled with this replica's name and wired to the
+        # injected iteration counter + clock — hop ordering rides the
+        # traveling JourneyContext, never wall clocks.  The
+        # APEX_TPU_JOURNEYS env twin arms it fleet-wide; a PROVIDED
+        # kwarg wins (None = defer to env).  Disabled keeps the
+        # zero-allocation NULL log (tests/L0/test_journey.py pins it
+        # with tracemalloc).
+        if enable_journeys is None:
+            enable_journeys = os.environ.get(JOURNEYS_ENV)
+        self.journeys = (
+            JourneyLog(replica=journey_replica,
+                       iter_source=lambda: self._iter, clock=clock)
+            if resolve_journeys(enable_journeys)
+            else NULL_JOURNEY_LOG)
         self.scheduler = Scheduler(
             self.engine.allocator,
             max_batch_size=self.engine.max_batch_size,
@@ -668,7 +692,7 @@ class InferenceServer:
             prefix_cache=None if self.disagg else self.prefix_cache,
             chunk_size=self.prefill_chunk,
             overload=self.overload_policy,
-            tracer=self.tracer)
+            tracer=self.tracer, journeys=self.journeys)
         if self.disagg:
             self.prefill_scheduler = Scheduler(
                 self.prefill_engine.allocator,
@@ -680,7 +704,7 @@ class InferenceServer:
                 prefix_cache=self.prefix_cache,
                 chunk_size=self.prefill_chunk,
                 overload=self.overload_policy,
-                tracer=self.tracer)
+                tracer=self.tracer, journeys=self.journeys)
             # ONE terminal ledger across both pools: a request finishes
             # exactly once, wherever it is, and every consumer of
             # scheduler.finished (finalize, soaks, benches) sees it
@@ -864,7 +888,8 @@ class InferenceServer:
                priority: int = 0,
                deadline_iters: Optional[int] = None,
                deadline_s: Optional[float] = None,
-               sampling: Optional[SamplingParams] = None) -> Request:
+               sampling: Optional[SamplingParams] = None,
+               journey=None) -> Request:
         """Enqueue one request.
 
         ``max_new_tokens`` must be >= 1 and a prompt that leaves no
@@ -895,16 +920,25 @@ class InferenceServer:
         :meth:`close` began).  Submitting to a closed server raises
         :class:`RuntimeError`.  A queue-full submission may instead
         displace a lower-priority queued request, which then finishes
-        ``"shed"`` during this call."""
+        ``"shed"`` during this call.
+
+        ``journey``: an existing :class:`JourneyContext` to continue —
+        the router passes the fleet-level context here on placement,
+        failover re-enqueue, and torn-hand-off fallback so the
+        request's hops keep one rid across replicas.  None (the
+        default) starts a fresh journey keyed by the request ``uid``
+        when journeys are enabled, and carries nothing when they are
+        off."""
         with (self._ops_lock or _NO_LOCK):
             return self._submit(prompt, max_new_tokens, eos_id,
                                 priority=priority,
                                 deadline_iters=deadline_iters,
                                 deadline_s=deadline_s,
-                                sampling=sampling)
+                                sampling=sampling, journey=journey)
 
     def _submit(self, prompt, max_new_tokens, eos_id, *, priority,
-                deadline_iters, deadline_s, sampling=None) -> Request:
+                deadline_iters, deadline_s, sampling=None,
+                journey=None) -> Request:
         """The :meth:`submit` body (runs under the ops lock when the
         HTTP ops plane is attached)."""
         if self._closed:
@@ -943,10 +977,27 @@ class InferenceServer:
                       sampling=sampling if sampling is not None
                       else SamplingParams())
         self.sampling_classes.incr(req.sampling.klass)
+        if self.journeys.enabled:
+            # continue the router's context when one travels in, else
+            # open a fresh journey keyed by this request's uid (the
+            # bare-server case); the front-door hop lands even for
+            # submissions turned away below — their journey is just
+            # enqueue -> finish
+            req.journey = (journey if journey is not None
+                           else self.journeys.start(req.uid))
+            self.journeys.hop(req.journey, "enqueue", uid=req.uid,
+                              prompt_tokens=len(prompt),
+                              priority=req.priority)
         if self.tracer.enabled:
-            self.tracer.instant("request_enqueue", uid=req.uid,
-                                prompt_tokens=len(prompt),
-                                priority=req.priority)
+            if req.journey is not None:
+                self.tracer.instant("request_enqueue", uid=req.uid,
+                                    prompt_tokens=len(prompt),
+                                    priority=req.priority,
+                                    rid=req.journey.rid)
+            else:
+                self.tracer.instant("request_enqueue", uid=req.uid,
+                                    prompt_tokens=len(prompt),
+                                    priority=req.priority)
         if self._draining:
             return self._finish_at_submit(req, reasons.DRAINING)
         if self.breaker is not None and not self.breaker.allow():
@@ -1266,12 +1317,13 @@ class InferenceServer:
         self.mem_frag.update(sched.frag_slots())
         if rec.enabled:
             fin = sched.finished
+            new_fin = fin[self._rec_cursor:]
             finished_now = [
                 {"uid": r.uid, "reason": r.finish_reason,
                  "tokens": len(r.generated)}
-                for r in fin[self._rec_cursor:]]
+                for r in new_fin]
             self._rec_cursor = len(fin)
-            rec.record({
+            step_rec = {
                 "iter": self._iter,
                 "produced": produced,
                 "waiting": sched.num_waiting,
@@ -1317,7 +1369,18 @@ class InferenceServer:
                 "offload": self._offload_delta(off0),
                 "phase": self._phase,
                 "step_s": step_s,
-            })
+            }
+            if self.journeys.enabled:
+                # journey correlation: uid -> rid for every request
+                # this step touched (admitted or finished), so a
+                # flight record joins onto journeys/traces without a
+                # per-uid search.  Conditional — journey-less flight
+                # records keep the legacy shape byte-for-byte.
+                step_rec["rids"] = {
+                    str(r.uid): r.journey.rid
+                    for r in list(admitted) + new_fin
+                    if r.journey is not None}
+            rec.record(step_rec)
             self._phase = None
         # breaker-open transition: the moment worth a black box — dump
         # a bundle while the ring still holds the steps leading up
@@ -1860,12 +1923,13 @@ class InferenceServer:
         self.handoff_pending.update(len(self._handoff))
         if rec.enabled:
             fin = sched.finished
+            new_fin = fin[self._rec_cursor:]
             finished_now = [
                 {"uid": r.uid, "reason": r.finish_reason,
                  "tokens": len(r.generated)}
-                for r in fin[self._rec_cursor:]]
+                for r in new_fin]
             self._rec_cursor = len(fin)
-            rec.record({
+            step_rec = {
                 "iter": self._iter,
                 "produced": produced,
                 "waiting": psched.num_waiting,
@@ -1919,7 +1983,15 @@ class InferenceServer:
                     "prefill_live": palloc.num_live,
                 },
                 "step_s": step_s,
-            })
+            }
+            if self.journeys.enabled:
+                # same conditional uid -> rid join as the monolithic
+                # step record
+                step_rec["rids"] = {
+                    str(r.uid): r.journey.rid
+                    for r in list(admitted) + new_fin
+                    if r.journey is not None}
+            rec.record(step_rec)
             self._phase = None
         if self.breaker is not None:
             state = self.breaker.state
@@ -2126,8 +2198,8 @@ class InferenceServer:
                        deadline_s: Optional[float] = None,
                        sampling: Optional[SamplingParams] = None,
                        submitted_at: Optional[float] = None,
-                       first_token_at: Optional[float] = None
-                       ) -> Optional[Request]:
+                       first_token_at: Optional[float] = None,
+                       journey=None) -> Optional[Request]:
         """The decode half of a CROSS-REPLICA hand-off: import an
         :meth:`DecodeEngine.export_blocks` payload into this server's
         (decode) pool and admit the request straight into the decode
@@ -2188,6 +2260,14 @@ class InferenceServer:
                                   if first_token_at is not None
                                   else req.admitted_at)
             self.sampling_classes.incr(req.sampling.klass)
+            if self.journeys.enabled and journey is not None:
+                # the hand-off carries the journey context across
+                # replicas: ingest hop here, then admit_handoff's
+                # handoff=True admit hop — one rid, causal order
+                req.journey = journey
+                self.journeys.hop(journey, "handoff_ingest",
+                                  uid=req.uid, blocks=n,
+                                  carried_tokens=req.num_cached)
             sched.admit_handoff(req, blocks)
             self.handoffs.incr("ingested")
             self.handoffs.incr("blocks", n)
@@ -2223,10 +2303,21 @@ class InferenceServer:
             req.first_token_at = now
             if self.tracer.enabled:
                 self.tracer.instant("request_first_token", uid=req.uid)
+            if self.journeys.enabled and req.journey is not None:
+                self.journeys.hop(req.journey, "first_token",
+                                  uid=req.uid,
+                                  ttft_s=now - req.submitted_at)
         elif req.last_token_at is not None:
             gap = now - req.last_token_at
             req.itl_gaps.append(gap)
             self.itl.record(gap)
+            if self.journeys.enabled and req.journey is not None:
+                # ITL exemplar: the worst gap per histogram bucket
+                # remembers which rid produced it, so an SLO-miss
+                # bucket resolves to a renderable journey
+                self.journeys.exemplar("itl",
+                                       self.itl.bucket_index(gap),
+                                       gap, req.journey.rid)
         req.last_token_at = now
         # streaming fan-out rides the same edge: every applied token
         # funnels through here, so this is THE retire-time publish
@@ -2263,8 +2354,22 @@ class InferenceServer:
                     tl["queue_wait_s"])
             if "ttft_s" in tl:
                 self.ttft.record(tl["ttft_s"])
+                if self.journeys.enabled and req.journey is not None:
+                    # TTFT exemplar: worst observation per bucket
+                    # keeps its rid (the SLO-miss -> journey link)
+                    self.journeys.exemplar(
+                        "ttft", self.ttft.bucket_index(tl["ttft_s"]),
+                        tl["ttft_s"], req.journey.rid)
             if "decode_token_s" in tl:
                 self.decode_latency.record(tl["decode_token_s"])
+            if (self.journeys.enabled and req.journey is not None
+                    and req.finish_reason != reasons.HANDOFF):
+                # HANDOFF is not a journey terminal: ownership moved
+                # to the ingesting replica, which records the real
+                # finish — a hop here would double-finish the journey
+                self.journeys.hop(req.journey, "finish", uid=req.uid,
+                                  reason=req.finish_reason or "",
+                                  tokens=len(req.generated))
             # SLO/goodput classification (docs/observability.md,
             # "SLO & goodput"): served terminals count toward
             # attainment, shed work toward the debt counters
@@ -2306,7 +2411,17 @@ class InferenceServer:
         return write_postmortem(path, recorder=self.recorder,
                                 registry=self.registry,
                                 tracer=self.tracer, reason=reason,
-                                extra=merged)
+                                extra=merged,
+                                journeys=dump_journeys([self.journeys])
+                                if self.journeys.enabled else None)
+
+    def journey(self, rid: int) -> Optional[dict]:
+        """One merged journey by rid (``Journey.as_dict()`` shape), or
+        None when unknown / journeys disabled — the programmatic twin
+        of ``GET /debug/journey/<rid>`` (``tools/journey.py`` renders
+        the bundle-side view)."""
+        j = merge_journeys([self.journeys], rid=int(rid)).get(int(rid))
+        return j.as_dict() if j is not None else None
 
     def _auto_postmortem(self, reason: str,
                          extra: Optional[dict] = None) -> Optional[str]:
@@ -2511,6 +2626,11 @@ class InferenceServer:
                 if req is None:
                     raise KeyError(f"no request with uid "
                                    f"{req_or_uid} on this server")
+            if self.journeys.enabled and req.journey is not None \
+                    and not req.finished:
+                self.journeys.hop(req.journey, "stream_open",
+                                  uid=req.uid,
+                                  backfill=len(req.generated))
             return self.stream_broker.open(req.uid, req, callback)
 
     def cancel(self, uid: int) -> bool:
@@ -2664,6 +2784,10 @@ class InferenceServer:
         self.spec_drafted_hist.reset()
         self.spec_accepted_hist.reset()
         self.offload_promote.reset()
+        # journeys reset with the latency histograms their exemplars
+        # index into — a bucket index only means anything within one
+        # measurement window
+        self.journeys.clear()
         self.scheduler.finished.clear()
         self._finalized = 0
         self._rec_cursor = 0
@@ -3015,6 +3139,10 @@ class InferenceServer:
                 "steps_recorded": self.recorder.steps_recorded,
                 "dropped": self.recorder.dropped,
             },
+            # journey correlation plane (docs/observability.md,
+            # "Request journeys & exemplars"): pinned census —
+            # shape-stable enabled or not, like flight/offload
+            "journeys": self.journeys.census(),
         }
         if self.prefix_cache is not None:
             out.update({

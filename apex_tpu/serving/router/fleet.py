@@ -42,16 +42,25 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import os
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, List, Optional, Sequence
 
 from apex_tpu.observability import (
+    JOURNEYS_ENV,
     NULL_FLIGHT_RECORDER,
+    NULL_JOURNEY_LOG,
     NULL_WATCHDOG,
+    JourneyLog,
     MetricsRegistry,
     OpsServer,
+    dump_journeys,
+    fleet_prometheus_text,
     get_tracer,
+    journeys_census,
+    merge_journeys,
+    resolve_journeys,
     write_postmortem,
 )
 from apex_tpu.resilience.breaker import CircuitBreaker
@@ -154,6 +163,7 @@ class RouterFleet:
                  stream_queue_tokens: int = 256,
                  enable_elastic: bool = False,
                  elastic: Optional[AutoscalerConfig] = None,
+                 enable_journeys: Optional[bool] = None,
                  **server_kwargs):
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
@@ -169,6 +179,19 @@ class RouterFleet:
             else MetricsRegistry()
         self.tracer = tracer if tracer is not None else get_tracer()
         self.clock = clock
+        # journey correlation plane (docs/observability.md, "Request
+        # journeys & exemplars"; OFF by default): the fleet arms one
+        # log per replica (replica=<name>) plus a router-level one
+        # (replica="router") for route/failover/hand-off hops, and
+        # journey(rid) merges them causally by hop seq
+        if enable_journeys is None:
+            enable_journeys = os.environ.get(JOURNEYS_ENV)
+        self._enable_journeys = resolve_journeys(enable_journeys)
+        self.journeys = (
+            JourneyLog(replica="router",
+                       iter_source=lambda: self._iter, clock=clock)
+            if self._enable_journeys else NULL_JOURNEY_LOG)
+        self._journey_name_next: Optional[str] = None
         # the fleet keeps its construction recipe: scale-up builds
         # new replicas from the same factory/kwargs, and rollout
         # rebinds self.params so post-rollout scale-ups serve the
@@ -213,6 +236,17 @@ class RouterFleet:
                 # (wired below); its own decode pool stays the
                 # last-resort local fallback
                 kw.setdefault("enable_disagg", True)
+            if self._enable_journeys:
+                # each replica's log is labeled with its fleet name so
+                # merged journeys read replica0 -> replica2, not
+                # server/server (scale-ups pass their serial name via
+                # _journey_name_next)
+                kw.setdefault("enable_journeys", True)
+                kw.setdefault(
+                    "journey_replica",
+                    self._journey_name_next
+                    or (names[i] if names and i < len(names)
+                        else f"replica{i}"))
             return InferenceServer(cfg, self.params, clock=clock,
                                    **kw)
 
@@ -238,7 +272,8 @@ class RouterFleet:
         self.router = ReplicaRouter(self.replicas, policy=policy,
                                     clock=clock,
                                     registry=self.registry,
-                                    tracer=self.tracer)
+                                    tracer=self.tracer,
+                                    journeys=self.journeys)
         # wire each prefill-role replica's hand-off sink to the router
         # (the server exports the blocks; the router places the decode
         # half — docs/serving.md, "Disaggregated prefill/decode")
@@ -400,13 +435,20 @@ class RouterFleet:
 
     def _add_replica(self, *, warm_blocks: int = 0):
         i = len(self.replicas)
-        srv = self._build(i)
+        name = f"replica{self._replica_serial}"
+        self._replica_serial += 1
+        # the default factory reads the serial name for its journey
+        # log label (the positional default would alias a retired
+        # replica's rows after a scale-down + regrow)
+        self._journey_name_next = name
+        try:
+            srv = self._build(i)
+        finally:
+            self._journey_name_next = None
         breaker = (self._breaker_factory(i)
                    if self._breaker_factory is not None
                    else CircuitBreaker(failure_threshold=3,
                                        clock=self.clock))
-        name = f"replica{self._replica_serial}"
-        self._replica_serial += 1
         rep = Replica(i, srv, name=name, breaker=breaker, role="any")
         rep.weights_version = self._weights_version
         # append-at-end ONLY: the affinity index stores positional
@@ -713,12 +755,45 @@ class RouterFleet:
 
     # -- observability -----------------------------------------------------
 
+    def _journey_logs(self) -> list:
+        """Every journey log in the fleet: the router's own (route /
+        failover / hand-off hops) plus each replica's — retired
+        replicas included, so a journey that finished on a since-
+        removed replica still merges complete."""
+        return [self.journeys] + [
+            rep.server.journeys
+            for rep in self.replicas + self.retired_replicas]
+
+    def journey(self, rid: int) -> Optional[dict]:
+        """One request's merged cross-replica journey (None if the
+        rid never opened one).  Hops from every replica it touched
+        — submit/route at the router, enqueue/admit/first-token/
+        finish on the servers, evacuate/reenqueue and hand-off hops
+        wherever they fired — causally ordered by the hop sequence
+        the traveling context issued, never by wall clock."""
+        with (self._ops_lock or _NO_LOCK):
+            j = merge_journeys(self._journey_logs(),
+                               rid=int(rid)).get(int(rid))
+            return j.as_dict() if j is not None else None
+
+    def fleet_metrics_text(self) -> str:
+        """Fleet-wide Prometheus exposition: the router registry's
+        series as-is plus every replica's private registry with a
+        ``replica=<name>`` label — one HELP/TYPE per family across
+        the whole fleet (``GET /metrics/fleet``).  Lock-free like
+        ``/metrics``: registries serialize internally."""
+        sources = [({}, self.registry)]
+        sources += [({"replica": rep.name}, rep.server.registry)
+                    for rep in self.replicas + self.retired_replicas]
+        return fleet_prometheus_text(sources)
+
     def dump_postmortem(self, path: str, *, reason: str = "on_demand",
                         extra: Optional[dict] = None) -> dict:
         """The aggregate ops plane's postmortem hook: the router
         registry snapshot + trace + a manifest carrying the router
         block (per-replica flight rings live behind each replica's
-        own ops plane)."""
+        own ops plane), plus the merged journeys member when the
+        correlation plane is armed."""
         merged = {"iter": self._iter,
                   "router": self.router.router_stats()}
         if extra:
@@ -726,7 +801,10 @@ class RouterFleet:
         return write_postmortem(path, recorder=self.recorder,
                                 registry=self.registry,
                                 tracer=self.tracer, reason=reason,
-                                extra=merged)
+                                extra=merged,
+                                journeys=(
+                                    dump_journeys(self._journey_logs())
+                                    if self.journeys.enabled else None))
 
     def stats(self) -> dict:
         """Fleet aggregates + the pinned ``stats()["router"]`` block
@@ -781,4 +859,5 @@ class RouterFleet:
             "draining": self._draining,
             "streams": self._stream_stats(),
             "elastic": self._elastic_stats(),
+            "journeys": journeys_census(self._journey_logs()),
         }

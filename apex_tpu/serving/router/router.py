@@ -40,6 +40,7 @@ import itertools
 import random
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from apex_tpu.observability import NULL_JOURNEY_LOG
 from apex_tpu.serving import reasons
 from apex_tpu.serving.router.policy import AffinityIndex, RouterPolicy
 from apex_tpu.serving.router.replica import Replica
@@ -60,15 +61,25 @@ class RouterRequest:
     request is re-enqueued onto another replica.  ``rid`` is the
     router-level id (underlying ``uid`` changes on a move);
     ``replica`` is the index currently serving it (None = never
-    placed); ``moves`` counts re-enqueues."""
+    placed); ``moves`` counts re-enqueues.
 
-    __slots__ = ("rid", "inner", "replica", "moves")
+    ``rid`` doubles as the fleet-stable JOURNEY id
+    (``observability.journey``): when journeys are enabled the router
+    draws it up front, opens a :class:`JourneyContext` on it, and the
+    context (held here as ``journey``) travels with the request across
+    failover and hand-off — the ``uid`` changes on a move, the ``rid``
+    never does.  Exactly one ``next(_rid)`` draw per request either
+    way, so the rid sequence is byte-identical journeys on or off."""
 
-    def __init__(self, inner: Request, replica: Optional[int]):
-        self.rid = next(_rid)
+    __slots__ = ("rid", "inner", "replica", "moves", "journey")
+
+    def __init__(self, inner: Request, replica: Optional[int],
+                 rid: Optional[int] = None, journey=None):
+        self.rid = next(_rid) if rid is None else rid
         self.inner = inner
         self.replica = replica
         self.moves = 0
+        self.journey = journey
 
     @property
     def prompt(self) -> List[int]:
@@ -121,7 +132,8 @@ class ReplicaRouter:
 
     def __init__(self, replicas: Sequence[Replica], *,
                  policy: Optional[RouterPolicy] = None,
-                 clock=None, registry=None, tracer=None):
+                 clock=None, registry=None, tracer=None,
+                 journeys=None):
         if not replicas:
             raise ValueError("ReplicaRouter needs >= 1 replica")
         self.replicas = list(replicas)
@@ -129,6 +141,13 @@ class ReplicaRouter:
         self.clock = clock if clock is not None \
             else self.replicas[0].server.clock
         self.tracer = tracer
+        # journey correlation (``observability.journey``): the
+        # ROUTER's own hop log — front-door submit/route, failover
+        # evacuate/re-enqueue, and hand-off outcomes record here with
+        # replica label "router"; per-replica hops land in each
+        # server's log and the fleet merges them by rid
+        self.journeys = journeys if journeys is not None \
+            else NULL_JOURNEY_LOG
         self.affinity = AffinityIndex(self.policy.affinity_block,
                                       self.policy.max_entries)
         self._rng = random.Random(self.policy.seed)
@@ -220,6 +239,18 @@ class ReplicaRouter:
         finished ``finish_reason="breaker_open"`` — the fleet-wide
         fast-fail — without touching any replica."""
         prompt = [int(t) for t in prompt]
+        # the journey opens at the FRONT DOOR: the rid is drawn here
+        # (the one next(_rid) call this request ever makes — the
+        # RouterRequest below is handed the same rid, so the draw
+        # count and hence the rid sequence is identical journeys on or
+        # off), and the context's hops start before placement so the
+        # route decision itself is part of the story
+        rid = next(_rid)
+        jlog = self.journeys
+        ctx = jlog.start(rid) if jlog.enabled else None
+        if ctx is not None:
+            jlog.hop(ctx, "submit", prompt_tokens=len(prompt),
+                     priority=int(priority))
         # phase-aware placement: long prompts prefer a prefill-role
         # replica (whose hand-off ships the KV to a decode replica);
         # short ones always place monolithically
@@ -229,7 +260,12 @@ class ReplicaRouter:
             role = "prefill"
         tr = self.tracer
         if tr is not None and tr.enabled:
-            with tr.span("route", tokens=len(prompt)):
+            # rid lands in the span only when journeys are armed, so
+            # journey-less traces keep their legacy args
+            span = (tr.span("route", tokens=len(prompt), rid=rid)
+                    if ctx is not None
+                    else tr.span("route", tokens=len(prompt)))
+            with span:
                 rep, outcome = self.place(prompt, role=role)
         else:
             rep, outcome = self.place(prompt, role=role)
@@ -243,14 +279,22 @@ class ReplicaRouter:
             inner.finished = True
             inner.finish_reason = reasons.BREAKER_OPEN
             inner.finished_at = now
-            rr = RouterRequest(inner, None)
+            if ctx is not None:
+                # router-terminal: no server ever saw this request, so
+                # the router closes the journey itself
+                jlog.hop(ctx, "finish", uid=inner.uid,
+                         reason=reasons.BREAKER_OPEN, tokens=0)
+            rr = RouterRequest(inner, None, rid=rid, journey=ctx)
             self.requests.append(rr)
             return rr
+        if ctx is not None:
+            jlog.hop(ctx, "route", to=rep.name, outcome=outcome)
         inner = rep.server.submit(prompt, max_new_tokens, eos_id,
                                   priority=priority,
                                   deadline_iters=deadline_iters,
-                                  deadline_s=deadline_s)
-        rr = RouterRequest(inner, rep.index)
+                                  deadline_s=deadline_s,
+                                  journey=ctx)
+        rr = RouterRequest(inner, rep.index, rid=rid, journey=ctx)
         self.requests.append(rr)
         self._by_uid[inner.uid] = rr
         if self.policy.kind == "affinity" and not inner.finished:
@@ -325,15 +369,31 @@ class ReplicaRouter:
         nobody can take finishes ``breaker_open`` at the router.
         Returns the number successfully re-placed."""
         now = self.clock()
+        jlog = self.journeys
         placed = 0
         for old in reqs:
             rr = self._by_uid.pop(old.uid, None)
+            # the context travels on the inner request; the failover
+            # hop PAIR (evacuate -> reenqueue) both record here at the
+            # router — consecutive seqs whichever replica dies when
+            ctx = getattr(old, "journey", None)
+            if jlog.enabled and ctx is not None:
+                jlog.hop(ctx, "evacuate", uid=old.uid,
+                         src=exclude.name if exclude is not None
+                         else None)
             rep, _outcome = self.place(old.prompt, exclude=exclude)
             if rep is None:
                 old.finished = True
                 old.finish_reason = reasons.BREAKER_OPEN
                 old.finished_at = now
                 self.events.incr("reenqueue_unplaced")
+                if jlog.enabled and ctx is not None:
+                    # router-terminal: the old server withdrew the
+                    # request unfinished and nobody can take it, so
+                    # the router closes the journey
+                    jlog.hop(ctx, "finish", uid=old.uid,
+                             reason=reasons.BREAKER_OPEN,
+                             tokens=len(old.generated))
                 if rr is not None:
                     rr.replica = None
                 continue
@@ -346,11 +406,14 @@ class ReplicaRouter:
                 d_iters = max(0, old.deadline_iters - burned)
             elif old.deadline_iters is not None:
                 d_iters = old.deadline_iters
+            if jlog.enabled and ctx is not None:
+                jlog.hop(ctx, "reenqueue", to=rep.name)
             new = rep.server.submit(old.prompt, old.max_new_tokens,
                                     old.eos_id,
                                     priority=old.priority,
                                     deadline_iters=d_iters,
-                                    deadline_s=d_s)
+                                    deadline_s=d_s,
+                                    journey=ctx)
             self.events.incr("reenqueued")
             if self.tracer is not None and self.tracer.enabled:
                 self.tracer.instant("router_reenqueue",
@@ -361,7 +424,8 @@ class ReplicaRouter:
                 rr.moves += 1
                 self._by_uid[new.uid] = rr
             else:
-                self._by_uid[new.uid] = RouterRequest(new, rep.index)
+                self._by_uid[new.uid] = RouterRequest(new, rep.index,
+                                                      journey=ctx)
             if self.policy.kind == "affinity" and not new.finished:
                 self.affinity.record(old.prompt, rep.index)
             placed += 1
@@ -395,6 +459,8 @@ class ReplicaRouter:
         resort when no other replica can take it."""
         rr = self._by_uid.pop(req.uid, None)
         now = self.clock()
+        jlog = self.journeys
+        ctx = getattr(req, "journey", None)
         d_s = d_iters = None
         if req.deadline_s is not None:
             d_s = max(0.0, req.deadline_s - (now - req.submitted_at))
@@ -409,12 +475,19 @@ class ReplicaRouter:
                 rr.moves += 1
                 self._by_uid[new.uid] = rr
             else:
-                self._by_uid[new.uid] = RouterRequest(new, rep_idx)
+                self._by_uid[new.uid] = RouterRequest(new, rep_idx,
+                                                      journey=ctx)
 
         target, _outcome = self.place(req.prompt,
                                       exclude=prefill_rep,
                                       role="decode")
         if target is not None:
+            if jlog.enabled and ctx is not None:
+                # export records at the router (not the prefill
+                # replica) so the local-fallback path keeps its single
+                # export hop from scheduler.release_handoff
+                jlog.hop(ctx, "handoff_export", to=target.name,
+                         blocks=int(payload.get("num_blocks", 0)))
             try:
                 new = target.server.ingest_handoff(
                     req.prompt, req.generated, payload,
@@ -424,10 +497,13 @@ class ReplicaRouter:
                     deadline_iters=d_iters, deadline_s=d_s,
                     sampling=req.sampling,
                     submitted_at=req.submitted_at,
-                    first_token_at=req.first_token_at)
+                    first_token_at=req.first_token_at,
+                    journey=ctx)
             except ValueError:
                 # torn payload: detected whole, nothing imported
                 self.events.incr("handoff_torn")
+                if jlog.enabled and ctx is not None:
+                    jlog.hop(ctx, "handoff_torn", to=target.name)
                 new = None
             if new is not None:
                 self.events.incr("handoffs")
@@ -442,12 +518,15 @@ class ReplicaRouter:
         # replica can take it (bit-identical stream by construction)
         rep2, _outcome = self.place(req.prompt, exclude=prefill_rep)
         if rep2 is not None:
+            if jlog.enabled and ctx is not None:
+                jlog.hop(ctx, "handoff_fallback", to=rep2.name)
             new = rep2.server.submit(req.prompt, req.max_new_tokens,
                                      req.eos_id,
                                      priority=req.priority,
                                      deadline_iters=d_iters,
                                      deadline_s=d_s,
-                                     sampling=req.sampling)
+                                     sampling=req.sampling,
+                                     journey=ctx)
             self.events.incr("handoff_fallback")
             rebind(new, rep2.index)
             if self.policy.kind == "affinity" and not new.finished:

@@ -93,7 +93,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from apex_tpu.observability import NULL_TRACER
+from apex_tpu.observability import NULL_JOURNEY_LOG, NULL_TRACER
 from apex_tpu.ops.sampling import SamplingParams
 from apex_tpu.serving.kv_cache import BlockAllocator
 from apex_tpu.serving import reasons
@@ -199,6 +199,12 @@ class Request:
     cached_prefix_tokens: int = 0
     _reg_blocks: int = 0
 
+    # journey correlation (``observability.journey``): the
+    # :class:`JourneyContext` traveling with this request across
+    # replicas — None when journeys are off, so every stamping site
+    # can guard on it and the disabled path allocates nothing
+    journey: Optional[object] = None
+
     @property
     def running(self) -> bool:
         return self.slot >= 0 and not self.finished
@@ -251,6 +257,11 @@ class Request:
             n = len(gaps)
             out["itl_p99_s"] = gaps[min(n - 1, -(-99 * n // 100) - 1)]
             out["itl_max_s"] = gaps[-1]
+        if self.journey is not None:
+            # journey correlation: the fleet-stable rid this timeline
+            # belongs to (absent when journeys are off, so the legacy
+            # timeline shape is untouched)
+            out["rid"] = self.journey.rid
         return out
 
 
@@ -284,9 +295,15 @@ class Scheduler:
                  prefix_cache: Optional[PrefixCache] = None,
                  chunk_size: Optional[int] = None,
                  overload: Optional[OverloadPolicy] = None,
-                 tracer=None):
+                 tracer=None, journeys=None):
         self.allocator = allocator
         self.tracer = tracer if tracer is not None else NULL_TRACER
+        # journey correlation plane (``observability.journey``): the
+        # server's hop log; scheduler decisions (admit / preempt /
+        # hand-off / offload promote) stamp hops for requests carrying
+        # a JourneyContext.  NULL by default — zero cost when off.
+        self.journeys = journeys if journeys is not None \
+            else NULL_JOURNEY_LOG
         self.max_batch_size = max_batch_size
         self.block_size = block_size
         self.max_context = max_context
@@ -510,10 +527,11 @@ class Scheduler:
                 # extend `matched` in place BEFORE the hit/cow/fresh
                 # math below, so a three-tier hit plans its prefill
                 # exactly like a device-tier hit of the same depth
-                self.prefix_cache.promote(ctx, matched,
-                                          self._try_alloc)
+                promoted = self.prefix_cache.promote(ctx, matched,
+                                                     self._try_alloc)
             else:
                 matched = []
+                promoted = 0
             hit = len(matched) * bs
             # a whole-context match (len(ctx) block-aligned and every
             # block cached) still must recompute the last token's
@@ -545,6 +563,17 @@ class Scheduler:
             self.running[req.slot] = req
             self._admit_order.append(req)
             admitted.append(req)
+            if self.journeys.enabled and req.journey is not None:
+                # offload promotion is part of THIS admission's story:
+                # blocks re-materialized from the host/disk tier to
+                # satisfy the prefix match (0 when the device tier
+                # covered it) — recorded before the admit hop so the
+                # journey reads promote -> admit in causal order
+                if promoted:
+                    self.journeys.hop(req.journey, "offload_promote",
+                                      uid=req.uid, blocks=promoted)
+                self.journeys.hop(req.journey, "admit", uid=req.uid,
+                                  cached=req.cached_prefix_tokens)
             if self.prefix_cache is not None:
                 c = self.prefix_cache.counters
                 c.incr("prefix_hit_tokens", req.cached_prefix_tokens)
@@ -653,6 +682,10 @@ class Scheduler:
             else _REG_STOPPED
         self.running[req.slot] = req
         self._admit_order.append(req)
+        if self.journeys.enabled and req.journey is not None:
+            self.journeys.hop(req.journey, "admit", uid=req.uid,
+                              handoff=True,
+                              carried_tokens=req.num_cached)
 
     def release_handoff(self, req: Request) -> None:
         """Free a request's slot and blocks in THIS pool after its
@@ -663,6 +696,10 @@ class Scheduler:
         here as evictable LRU holds and the next shared-prefix
         admission matches them instead of re-prefilling."""
         self.register_progress(req)
+        if self.journeys.enabled and req.journey is not None:
+            self.journeys.hop(req.journey, "handoff_export",
+                              uid=req.uid,
+                              carried_tokens=req.num_cached)
         self._release(req)
 
     def ensure_decode_capacity(self, req: Request) -> bool:
@@ -829,6 +866,9 @@ class Scheduler:
         if self.tracer.enabled:
             self.tracer.instant("preempt", uid=req.uid,
                                 blocks=len(req.block_table))
+        if self.journeys.enabled and req.journey is not None:
+            self.journeys.hop(req.journey, "preempt", uid=req.uid,
+                              blocks=len(req.block_table))
         self._release(req)
         req.num_cached = 0
         self.waiting.appendleft(req)

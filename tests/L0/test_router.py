@@ -541,7 +541,10 @@ def test_pinned_router_stats_block(tiny):
         "router", "requests_finished", "requests_unplaced",
         "tokens_generated", "prefix_hit_tokens", "prefix_miss_tokens",
         "prefix_hit_rate", "pressure", "pressure_peak", "draining",
-        "streams", "elastic"}
+        "streams", "elastic", "journeys"}
+    # journeys OFF: the census stays shape-stable but reads disabled
+    assert st["journeys"]["enabled"] is False
+    assert st["journeys"]["started"] == 0
     # elastic OFF: the minimal pinned shape (no autoscaler state)
     assert set(st["elastic"]) == {"enabled", "weights_versions",
                                   "last_rollout"}

@@ -45,6 +45,13 @@ class _StubOps(BaseHTTPRequestHandler):
             self._send(200, srv.metrics_body, srv.metrics_ctype)
         elif self.path == "/statusz":
             self._send(200, srv.statusz_body, "application/json")
+        elif self.path.startswith("/debug/journey/"):
+            body = getattr(srv, "journey_body", None)
+            if body is None:
+                self._send(404, b'{"error": "unknown rid"}',
+                           "application/json")
+            else:
+                self._send(200, body, "application/json")
         else:
             self._send(404, b"{}", "application/json")
 
@@ -280,6 +287,186 @@ def test_kv_offload_flags_advertised_by_gating_tools():
         assert flag in res.stdout, tool
 
 
+# -- ops_probe --journeys / --journey --------------------------------------
+
+
+_JOURNEYS_BLOCK = {
+    "enabled": True, "started": 12, "finished": 11, "open": 1,
+    "hops": 61, "dropped": 0,
+    "exemplars": {"ttft": {"20": {"value": 1.5, "rid": 7}},
+                  "itl": {"18": {"value": 0.8, "rid": 3}}},
+}
+
+_JOURNEY_BODY = {
+    "rid": 7, "complete": True, "finish_reason": "eos",
+    "replicas": ["router", "replica0", "replica1"],
+    "duration": 6.0,
+    "hop_counts": {"submit": 1, "route": 1, "enqueue": 2, "admit": 2,
+                   "evacuate": 1, "reenqueue": 1, "first_token": 1,
+                   "finish": 1},
+    "hops": [
+        {"rid": 7, "seq": 1, "replica": "router", "iter": 2,
+         "t": 2.0, "kind": "submit"},
+        {"rid": 7, "seq": 2, "replica": "router", "iter": 2,
+         "t": 2.0, "kind": "route", "to": "replica0"},
+        {"rid": 7, "seq": 3, "replica": "replica0", "iter": 2,
+         "t": 2.0, "kind": "enqueue", "uid": 0},
+        {"rid": 7, "seq": 4, "replica": "router", "iter": 4,
+         "t": 4.0, "kind": "evacuate", "src": "replica0", "uid": 0},
+        {"rid": 7, "seq": 5, "replica": "router", "iter": 4,
+         "t": 4.0, "kind": "reenqueue", "to": "replica1", "uid": 0},
+        {"rid": 7, "seq": 6, "replica": "replica1", "iter": 8,
+         "t": 8.0, "kind": "finish", "reason": "eos", "tokens": 5},
+    ],
+}
+
+
+def test_ops_probe_journeys_renders_census_and_exemplars(stub_ops):
+    statusz = dict(_STATUSZ)
+    statusz["journeys"] = _JOURNEYS_BLOCK
+    stub_ops.statusz_body = json.dumps(statusz).encode()
+    res = _probe(stub_ops.server_address[1], "--journeys")
+    assert res.returncode == 0, res.stdout + res.stderr
+    # the census counters and the worst-rid-per-bucket exemplar rows
+    for needle in ("started=12", "finished=11", "open=1",
+                   "dropped=0", "ttft", "itl"):
+        assert needle in res.stdout, (needle, res.stdout)
+    # the exemplar rid is the whole point of the table
+    assert "7" in res.stdout and "1.5" in res.stdout
+
+
+def test_ops_probe_journeys_gates_on_missing_block(stub_ops):
+    res = _probe(stub_ops.server_address[1], "--journeys")
+    assert res.returncode == 1
+    assert "FAIL" in res.stderr and "journeys" in res.stderr
+    _no_traceback(res)
+
+
+def test_ops_probe_journeys_gates_on_disabled_plane(stub_ops):
+    statusz = dict(_STATUSZ)
+    statusz["journeys"] = dict(_JOURNEYS_BLOCK, enabled=False)
+    stub_ops.statusz_body = json.dumps(statusz).encode()
+    res = _probe(stub_ops.server_address[1], "--journeys")
+    assert res.returncode == 1
+    assert "FAIL" in res.stderr and "disabled" in res.stderr
+    _no_traceback(res)
+
+
+def test_ops_probe_journey_renders_merged_hops(stub_ops):
+    stub_ops.journey_body = json.dumps(_JOURNEY_BODY).encode()
+    res = _probe(stub_ops.server_address[1], "--journey", "7")
+    assert res.returncode == 0, res.stdout + res.stderr
+    # the cross-replica path, front-to-back, with detail keys
+    for needle in ("rid=7", "complete", "router", "replica0",
+                   "replica1", "evacuate", "reenqueue",
+                   "src=replica0", "to=replica1", "reason=eos"):
+        assert needle in res.stdout, (needle, res.stdout)
+
+
+def test_ops_probe_journey_gates_on_unknown_rid(stub_ops):
+    res = _probe(stub_ops.server_address[1], "--journey", "99")
+    assert res.returncode == 1
+    assert "FAIL" in res.stderr and "/debug/journey/99" in res.stderr
+    _no_traceback(res)
+
+
+def test_journey_flags_advertised_by_gating_tools():
+    """The build-matrix ``journey`` axis invokes chaos_soak with
+    ``--journeys`` and ops_probe with ``--journeys`` / ``--journey``
+    — a dropped flag would fail the axis with an argparse error
+    instead of a judged result."""
+    for tool, flags in (("chaos_soak.py", ("--journeys",)),
+                        ("ops_probe.py", ("--journeys", "--journey"))):
+        res = subprocess.run(
+            [sys.executable, str(REPO / "tools" / tool), "--help"],
+            capture_output=True, text=True, timeout=60)
+        assert res.returncode == 0, res.stderr
+        for flag in flags:
+            assert flag in res.stdout, (tool, flag)
+
+
+# -- tools/journey.py ------------------------------------------------------
+
+
+def _journey_tool(*argv):
+    return subprocess.run(
+        [sys.executable, str(REPO / "tools" / "journey.py"), *argv],
+        capture_output=True, text=True, timeout=60)
+
+
+def _journey_bundle(tmp_path, complete=True, dropped=0):
+    """A minimal journeys-bearing bundle directory."""
+    j = json.loads(json.dumps(_JOURNEY_BODY))
+    if not complete:
+        # tear the sequence: drop the finish hop
+        j["hops"] = j["hops"][:-1]
+        j["hop_counts"].pop("finish")
+        j["complete"] = False
+        j["finish_reason"] = None
+    payload = {
+        "census": {"enabled": True, "started": 1,
+                   "finished": 1 if complete else 0,
+                   "open": 0 if complete else 1,
+                   "hops": len(j["hops"]), "dropped": dropped,
+                   "exemplars": {}},
+        "journeys": {"7": j},
+    }
+    d = tmp_path / "bundle"
+    d.mkdir()
+    (d / "manifest.json").write_text(json.dumps({"reason": "test"}))
+    (d / "journeys.json").write_text(json.dumps(payload))
+    return d
+
+
+def test_journey_tool_assert_complete_passes(tmp_path):
+    d = _journey_bundle(tmp_path, complete=True)
+    res = _journey_tool(str(d), "--assert-complete")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "OK" in res.stdout
+
+
+def test_journey_tool_assert_complete_gates_on_torn_journey(tmp_path):
+    d = _journey_bundle(tmp_path, complete=False)
+    res = _journey_tool(str(d), "--assert-complete")
+    assert res.returncode == 1
+    assert "FAIL" in res.stderr and "incomplete" in res.stderr
+    _no_traceback(res)
+
+
+def test_journey_tool_assert_complete_gates_on_drops(tmp_path):
+    d = _journey_bundle(tmp_path, complete=True, dropped=3)
+    res = _journey_tool(str(d), "--assert-complete")
+    assert res.returncode == 1
+    assert "FAIL" in res.stderr and "dropped" in res.stderr
+    _no_traceback(res)
+
+
+def test_journey_tool_rid_and_slowest_render(tmp_path):
+    d = _journey_bundle(tmp_path)
+    res = _journey_tool(str(d), "--rid", "7")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "evacuate" in res.stdout and "replica1" in res.stdout
+    res = _journey_tool(str(d), "--slowest", "3")
+    assert res.returncode == 0
+    assert "complete" in res.stdout
+    res = _journey_tool(str(d), "--rid", "999")
+    assert res.returncode == 1 and "FAIL" in res.stderr
+    _no_traceback(res)
+
+
+def test_journey_tool_gates_on_journeyless_bundle(tmp_path):
+    d = tmp_path / "plain"
+    d.mkdir()
+    (d / "manifest.json").write_text(json.dumps({"reason": "test"}))
+    res = _journey_tool(str(d), "--assert-complete")
+    assert res.returncode == 1
+    assert "FAIL" in res.stderr and "journeys.json" in res.stderr
+    _no_traceback(res)
+    res = _journey_tool(str(tmp_path / "nowhere"))
+    assert res.returncode == 1 and "FAIL" in res.stderr
+    _no_traceback(res)
+
+
 # -- obs_dump --------------------------------------------------------------
 
 
@@ -347,3 +534,31 @@ def test_obs_dump_empty_metrics_file_gates(tmp_path):
     res = _dump("metrics", str(empty))
     assert res.returncode == 1
     _no_traceback(res)
+
+
+def test_obs_dump_merges_replica_traces_onto_distinct_tids(tmp_path):
+    """Per-replica tracers in one process stamp the SAME (pid, tid)
+    — the multi-path trace mode must renamespace them so Perfetto
+    gets one track per (replica, thread) with a naming metadata
+    event, and --require judges the union."""
+    a = _trace_file(tmp_path, names=("launch",))
+    b = tmp_path / "b.json"
+    b.write_text(json.dumps({"traceEvents": [
+        {"ph": "B", "name": "retire", "pid": 1, "tid": 1, "ts": 0.0},
+        {"ph": "E", "name": "retire", "pid": 1, "tid": 1, "ts": 5.0},
+    ]}))
+    out = tmp_path / "merged.json"
+    res = _dump("trace", str(a), str(b), "--merge", str(out),
+                "--require", "launch", "--require", "retire")
+    assert res.returncode == 0, res.stdout + res.stderr
+    merged = json.loads(out.read_text())["traceEvents"]
+    real = [ev for ev in merged if ev["ph"] != "M"]
+    metas = [ev for ev in merged if ev["ph"] == "M"]
+    # colliding (pid=1, tid=1) from the two files land on two tracks
+    assert {ev["tid"] for ev in real} == {0, 1}
+    assert sorted(ev["args"]["name"] for ev in metas) == \
+        ["replica0/tid1", "replica1/tid1"]
+    # a single path stays un-renamespaced (byte-identical summaries)
+    res = _dump("trace", str(a))
+    assert res.returncode == 0
+    assert str(a) + ":" in res.stdout

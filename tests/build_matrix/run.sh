@@ -351,6 +351,38 @@ env JAX_PLATFORMS=cpu python -m pytest tests/L0/test_offload.py -q -x --no-heade
   && env JAX_PLATFORMS=cpu python tools/chaos_soak.py --seed 0 --iters 800 --kv-offload
 results[kv_offload]=$?
 
+# request journeys: the fleet-correlation axis (docs/observability.md,
+# "Request journeys & exemplars") — three gates under the emulated
+# 8-device mesh flags (the L0 tier's fleet tests route through a
+# 3-replica front door):
+#   1. the L0 journey tier (slow tier included — this axis owns it):
+#      hop-seq causal merge ordering under adversarial fake clocks,
+#      completeness gap/double-finish detection, the failover
+#      evacuate->reenqueue hop pair, torn-handoff reconciliation,
+#      offload-promote block accounting, exemplar->journey linkage,
+#      the pinned stats()["journeys"] census, the ops-plane
+#      /debug/journey + /metrics/fleet endpoints, and the
+#      zero-allocation disabled path (tracemalloc-pinned);
+#   2. an 800-iteration seed-0 router chaos soak with journeys ON —
+#      the in-process reconciliation invariant (exactly one complete
+#      causally-ordered journey per finished rid, kill victims showing
+#      the failover hop pair) plus byte-identical legacy report fields
+#      vs the journeys-off run of the same seed;
+#   3. tools/journey.py --assert-complete over the soak's success
+#      bundle — the offline merge of the per-replica journey logs
+#      must reconcile every rid exactly once, zero drops.
+echo "=== build-matrix axis: journey ==="
+jrn_dir=$(mktemp -d)
+env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/L0/test_journey.py -q -x --no-header \
+  && env JAX_PLATFORMS=cpu \
+      XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+      python tools/chaos_soak.py --seed 0 --iters 800 --replicas 3 \
+      --journeys --postmortem-dir "$jrn_dir" \
+  && python tools/journey.py "$jrn_dir/router_soak" --assert-complete
+results[journey]=$?
+rm -rf "$jrn_dir"
+
 # chaos soak: the overload-robustness axis (docs/resilience.md,
 # "Overload policy & lifecycle") — the full serving stack (prefix
 # cache + chunked prefill + overload control + circuit breaker, small

@@ -174,6 +174,11 @@ def run_router(args) -> int:
             # the elastic axis has its own arm (--elastic); pinned
             # OFF here so legacy per-seed reports stay byte-identical
             enable_elastic=False,
+            # --journeys arms the correlation plane fleet-wide;
+            # recording is observation-only, so routing decisions and
+            # the per-seed report stay byte-identical either way —
+            # journeys just add their own report block + bundle member
+            enable_journeys=args.journeys,
             breaker_factory=lambda i: CircuitBreaker(
                 failure_threshold=3, recovery_time=25.0,
                 clock=clock))
@@ -253,6 +258,9 @@ def run_elastic(args) -> int:
             num_blocks=40, cache_dtype=jnp.float32, max_waiting=8,
             clock=clock,
             enable_elastic=True,
+            # observation-only; scale-ups label their logs with the
+            # new replica's serial name (docs/observability.md)
+            enable_journeys=args.journeys,
             elastic=AutoscalerConfig(
                 min_replicas=1, max_replicas=3,
                 up_pressure=0.85, down_pressure=0.2,
@@ -391,6 +399,22 @@ def main(argv=None) -> int:
                         action="store_false",
                         help="soak the strictly synchronous step "
                         "loop instead")
+    parser.add_argument("--journeys", action="store_true",
+                        help="arm the JOURNEY correlation plane "
+                        "(docs/observability.md, 'Request journeys & "
+                        "exemplars') on the soaked server/fleet: "
+                        "every hop of every request is recorded and "
+                        "the soak asserts the reconciliation "
+                        "invariant — exactly one COMPLETE merged "
+                        "journey per finished rid, hop counts "
+                        "reconciling with the failover/preempt/"
+                        "offload counters — and the router arm "
+                        "writes a journeys-bearing success bundle "
+                        "under --postmortem-dir for "
+                        "tools/journey.py --assert-complete.  "
+                        "Recording is observation-only: the per-seed "
+                        "report numbers are byte-identical either "
+                        "way (the replay oracle never journeys)")
     parser.add_argument("--postmortem-dir", default=None,
                         help="dump a postmortem bundle here on any "
                         "invariant violation (docs/observability.md)")
@@ -538,6 +562,10 @@ def main(argv=None) -> int:
             # --streaming soaks the delivery tier; legacy arms pin it
             # OFF so their per-seed reports stay byte-identical
             enable_streaming=args.streaming,
+            # --journeys arms the correlation plane (the replay
+            # oracle never does: journeys are observation-only, so
+            # oracle outputs are identical with the plane absent)
+            enable_journeys=args.journeys,
             flight_recorder=FlightRecorder(
                 capacity=max(4096, 2 * args.iters)),
             watchdog=HangWatchdog(deadline_s=args.watchdog_deadline,

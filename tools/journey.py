@@ -1,0 +1,210 @@
+"""Render, slice, and gate the journeys member of a postmortem bundle.
+
+A journeys-enabled server/fleet writes ``journeys.json`` into its
+postmortem bundles (``apex_tpu.observability.dump_journeys``,
+``docs/observability.md`` "Request journeys & exemplars"): the
+aggregate census plus every merged cross-replica :class:`Journey` —
+one causally-ordered hop sequence per rid, ordered by the
+context-issued hop sequence numbers (never wall clocks).
+
+Modes:
+
+``BUNDLE``
+    Summary: census line (started/finished/open, hops, dropped),
+    completeness tally, hop-kind totals, replicas visited, and the
+    SLO exemplar table (worst rid per histogram bucket).
+
+``BUNDLE --rid N``
+    Render one journey front-to-back: every hop with its seq,
+    replica, iteration, injected-clock time, kind, and detail — the
+    "why was THIS request slow?" view.
+
+``BUNDLE --slowest N``
+    The top-N journeys by duration (last-hop minus first-hop on the
+    injected clocks), one summary row each — the p99 shortlist.
+
+``BUNDLE --assert-complete``
+    The build-matrix gate: the member parses, the census reconciles
+    with the journeys actually present (``dropped`` must be 0 for the
+    gate to be meaningful), and EVERY journey is complete — exactly
+    one ``finish`` hop and a gap-free ``1..N`` sequence.  Exit 1 with
+    the failing rid otherwise.
+
+Usage:
+    python tools/journey.py /tmp/pm/router_soak
+    python tools/journey.py BUNDLE --rid 17
+    python tools/journey.py BUNDLE --slowest 5
+    python tools/journey.py BUNDLE --assert-complete
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from apex_tpu.observability.flightrecorder import (  # noqa: E402
+    JOURNEYS_NAME,
+    MANIFEST_NAME,
+)
+
+# core hop fields rendered in fixed columns; everything else in the
+# record is site detail (to=/src=/blocks=/reason=/...) shown trailing
+_CORE = ("rid", "seq", "replica", "iter", "t", "kind")
+
+
+def fail(msg: str) -> int:
+    print(f"FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def load_journeys(dirpath: str):
+    """Parse the bundle's journeys member; returns ``(payload, None)``
+    or ``(None, error-exit-code)`` after printing the failure."""
+    path = os.path.join(dirpath, JOURNEYS_NAME)
+    if not os.path.exists(path):
+        # distinguish "not a bundle" from "bundle without journeys"
+        manifest = os.path.join(dirpath, MANIFEST_NAME)
+        if os.path.exists(manifest):
+            return None, fail(
+                f"{dirpath}: bundle carries no {JOURNEYS_NAME} — was "
+                f"the source running with journeys enabled "
+                f"(enable_journeys=True / APEX_TPU_JOURNEYS=1)?")
+        return None, fail(f"{dirpath}: not a postmortem bundle "
+                          f"(no {MANIFEST_NAME})")
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, ValueError) as e:
+        return None, fail(f"{path}: {e}")
+    if not isinstance(payload, dict) or \
+            not isinstance(payload.get("journeys"), dict) or \
+            not isinstance(payload.get("census"), dict):
+        return None, fail(f"{path}: no census/journeys members")
+    return payload, None
+
+
+def _detail(hop: dict) -> str:
+    extra = {k: v for k, v in hop.items() if k not in _CORE}
+    return " ".join(f"{k}={extra[k]}" for k in sorted(extra))
+
+
+def _row(j: dict) -> str:
+    counts = j.get("hop_counts", {})
+    kinds = " ".join(f"{k}:{counts[k]}" for k in sorted(counts))
+    flag = "complete" if j.get("complete") else "INCOMPLETE"
+    return (f"{j.get('rid', '?'):>6} {flag:<10} "
+            f"{j.get('duration', 0.0):>9.3f}s "
+            f"{j.get('finish_reason') or '-':<14} "
+            f"{'>'.join(j.get('replicas', ())):<24} {kinds}")
+
+
+def render_journey(j: dict) -> None:
+    print(f"journey rid={j['rid']}: "
+          f"{'complete' if j.get('complete') else 'INCOMPLETE'}, "
+          f"finish={j.get('finish_reason')!r}, "
+          f"duration={j.get('duration', 0.0):.3f}s, "
+          f"replicas={'>'.join(j.get('replicas', ()))}")
+    print(f"  {'seq':>4} {'replica':<12} {'iter':>6} {'t':>9} "
+          f"{'kind':<16} detail")
+    for h in j.get("hops", ()):
+        print(f"  {h.get('seq', '?'):>4} {h.get('replica', '?'):<12} "
+              f"{h.get('iter', '?'):>6} {h.get('t', 0.0):>9.3f} "
+              f"{h.get('kind', '?'):<16} {_detail(h)}")
+
+
+def summarize(payload: dict) -> int:
+    census, journeys = payload["census"], payload["journeys"]
+    complete = sum(1 for j in journeys.values() if j.get("complete"))
+    print(f"census: started={census.get('started')} "
+          f"finished={census.get('finished')} "
+          f"open={census.get('open')} hops={census.get('hops')} "
+          f"dropped={census.get('dropped')}")
+    print(f"journeys: {len(journeys)} merged, {complete} complete, "
+          f"{len(journeys) - complete} incomplete")
+    kinds = {}
+    for j in journeys.values():
+        for k, n in j.get("hop_counts", {}).items():
+            kinds[k] = kinds.get(k, 0) + n
+    if kinds:
+        print("hop kinds: " + " ".join(
+            f"{k}:{kinds[k]}" for k in sorted(kinds)))
+    exemplars = census.get("exemplars") or {}
+    for metric in sorted(exemplars):
+        print(f"exemplars[{metric}]: worst rid per bucket:")
+        for b in sorted(exemplars[metric], key=int):
+            obs = exemplars[metric][b]
+            print(f"  bucket {b:>3}: value={obs['value']:.6g} "
+                  f"rid={obs['rid']}")
+    return 0
+
+
+def slowest(payload: dict, n: int) -> int:
+    ranked = sorted(payload["journeys"].values(),
+                    key=lambda j: -j.get("duration", 0.0))[:n]
+    print(f"{'rid':>6} {'state':<10} {'duration':>10} "
+          f"{'finish':<14} {'replicas':<24} hops")
+    for j in ranked:
+        print(_row(j))
+    return 0
+
+
+def assert_complete(payload: dict) -> int:
+    """The gate: census reconciles and every journey is complete."""
+    census, journeys = payload["census"], payload["journeys"]
+    if not census.get("enabled"):
+        return fail("journeys member written with the plane disabled")
+    if census.get("dropped"):
+        return fail(f"{census['dropped']} journeys dropped from the "
+                    f"log ring — the gate cannot see them; raise the "
+                    f"JourneyLog capacity for this run")
+    hops = sum(len(j.get("hops", ())) for j in journeys.values())
+    if hops != census.get("hops"):
+        return fail(f"census counts {census.get('hops')} hops but the "
+                    f"merged journeys carry {hops}")
+    for rid in sorted(journeys, key=int):
+        j = journeys[rid]
+        if j.get("complete"):
+            continue
+        seqs = [h.get("seq") for h in j.get("hops", ())]
+        finishes = j.get("hop_counts", {}).get("finish", 0)
+        return fail(f"journey {rid} is incomplete: {finishes} finish "
+                    f"hop(s), seqs={seqs}")
+    print(f"OK: {len(journeys)} journeys all complete "
+          f"({census.get('hops')} hops, 0 dropped)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("bundle", help="postmortem bundle directory "
+                    "(must carry journeys.json)")
+    ap.add_argument("--rid", type=int, default=None, metavar="N",
+                    help="render one journey's merged hop sequence")
+    ap.add_argument("--slowest", type=int, default=None, metavar="N",
+                    help="the top-N journeys by duration")
+    ap.add_argument("--assert-complete", action="store_true",
+                    help="gate mode: exit 1 unless the census "
+                    "reconciles and every journey is complete")
+    args = ap.parse_args(argv)
+    payload, err = load_journeys(args.bundle)
+    if payload is None:
+        return err
+    if args.assert_complete:
+        return assert_complete(payload)
+    if args.rid is not None:
+        j = payload["journeys"].get(str(args.rid))
+        if j is None:
+            return fail(f"rid {args.rid} not in the bundle "
+                        f"({len(payload['journeys'])} journeys)")
+        render_journey(j)
+        return 0
+    if args.slowest is not None:
+        return slowest(payload, args.slowest)
+    return summarize(payload)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

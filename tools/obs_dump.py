@@ -11,11 +11,21 @@ Two subcommands over the two export formats of
     gauges as value/peak/avg, histograms as count + p50/p90/p99/max
     in milliseconds-if-seconds-suffixed (``*_s`` series) else raw.
 
-``trace PATH [--require NAME ...]``
-    PATH is a Chrome trace-event JSON (``SpanTracer.export_chrome`` /
-    ``APEX_TPU_TRACE``).  Prints a per-span-name summary (count,
-    total/mean/max wall) built by matching B/E pairs per thread, and
-    an instant-event count table.  When the tracer's ring buffer
+``trace PATH [PATH ...] [--require NAME ...] [--merge OUT]``
+    Each PATH is a Chrome trace-event JSON
+    (``SpanTracer.export_chrome`` / ``APEX_TPU_TRACE``).  Prints a
+    per-span-name summary (count, total/mean/max wall) built by
+    matching B/E pairs per thread, and an instant-event count table.
+    With MULTIPLE paths (one per fleet replica), events are merged
+    with each file's thread ids renamespaced to a dense map keyed by
+    ``(file, pid, tid)`` — per-replica tracers all stamp the same
+    OS thread ids from one process, so a naive concat interleaves
+    different replicas' spans onto one Perfetto track and B/E pairing
+    breaks; the remap keeps every replica's threads on distinct
+    tracks, labeled ``replica{i}/tid{old}`` via ``thread_name``
+    metadata events.  ``--merge OUT`` additionally writes the merged,
+    renamespaced trace to OUT (Perfetto-loadable).  A single PATH is
+    summarized as-is — no remap, byte-identical output to before.  When the tracer's ring buffer
     dropped events the summary is a truncated window, so a LOUD
     warning goes to stderr — a silently shortened trace reads as "the
     server did less", which is worse than no trace.  Each
@@ -37,6 +47,7 @@ Usage:
     python tools/obs_dump.py metrics scrape.jsonl
     python tools/obs_dump.py trace trace.json --require admit --require decode
     python tools/obs_dump.py trace trace.json --require 'engine_oom{site=decode}'
+    python tools/obs_dump.py trace rep0.json rep1.json rep2.json --merge fleet.json
 """
 
 import argparse
@@ -174,27 +185,74 @@ def require_matches(events, name: str, labels: dict) -> bool:
     return False
 
 
+def merge_traces(loaded):
+    """Merge ``(path, events)`` files into one event list with thread
+    ids renamespaced densely by ``(file, pid, tid)`` — the fleet view.
+    Per-replica tracers run in ONE process, so their raw traces carry
+    the SAME OS thread ids; concatenating them would interleave
+    different replicas' B/E spans on a single Perfetto track (pairing
+    garbage).  Each new track gets a ``thread_name`` metadata event
+    naming its origin, ``replica{i}/tid{old}``."""
+    tids = {}
+    merged = []
+    for i, (path, events) in enumerate(loaded):
+        for ev in events:
+            key = (i, ev.get("pid"), ev.get("tid"))
+            tid = tids.get(key)
+            if tid is None:
+                tid = tids[key] = len(tids)
+                merged.append(
+                    {"ph": "M", "name": "thread_name", "ts": 0,
+                     "pid": ev.get("pid", 0), "tid": tid,
+                     "args": {"name": f"replica{i}/tid{key[2]}"}})
+            ev = dict(ev)
+            ev["tid"] = tid
+            merged.append(ev)
+    return merged
+
+
 def dump_trace(args) -> int:
-    try:
-        with open(args.path) as f:
-            data = json.load(f)
-    except OSError as e:
-        print(f"FAIL: cannot read {args.path}: {e}", file=sys.stderr)
-        return 1
-    except ValueError as e:
-        print(f"FAIL: {args.path} is not a JSON trace: {e}",
-              file=sys.stderr)
-        return 1
-    events = data["traceEvents"] if isinstance(data, dict) else data
-    if not isinstance(events, list):
-        print(f"FAIL: {args.path} carries no traceEvents list",
-              file=sys.stderr)
-        return 1
-    spans, instants, errors = summarize_trace(events)
+    loaded = []
     dropped = 0
-    if isinstance(data, dict):
-        dropped = data.get("otherData", {}).get("dropped_events", 0)
-    print(f"{args.path}: {len(events)} events, {len(spans)} span "
+    for path in args.path:
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except OSError as e:
+            print(f"FAIL: cannot read {path}: {e}", file=sys.stderr)
+            return 1
+        except ValueError as e:
+            print(f"FAIL: {path} is not a JSON trace: {e}",
+                  file=sys.stderr)
+            return 1
+        events = data["traceEvents"] if isinstance(data, dict) else data
+        if not isinstance(events, list):
+            print(f"FAIL: {path} carries no traceEvents list",
+                  file=sys.stderr)
+            return 1
+        if isinstance(data, dict):
+            dropped += data.get("otherData", {}).get(
+                "dropped_events", 0)
+        loaded.append((path, events))
+    if len(loaded) == 1:
+        # one file: no remap, output identical to the pre-merge tool
+        label, events = loaded[0]
+    else:
+        label = f"{len(loaded)} traces merged"
+        events = merge_traces(loaded)
+    if args.merge is not None:
+        try:
+            with open(args.merge, "w") as f:
+                json.dump({"traceEvents": events,
+                           "otherData": {"dropped_events": dropped}},
+                          f)
+        except OSError as e:
+            print(f"FAIL: cannot write {args.merge}: {e}",
+                  file=sys.stderr)
+            return 1
+        print(f"merged trace -> {args.merge}")
+    spans, instants, errors = summarize_trace(events)
+    print(f"{label}: {len(events)} events, {len(spans)} span "
           f"names, {sum(instants.values())} instants"
           + (f", {dropped} dropped by the ring buffer" if dropped
              else ""))
@@ -249,12 +307,18 @@ def main(argv=None) -> int:
                     help="print every scrape line, not just the last")
     mp.set_defaults(fn=dump_metrics)
     tp = sub.add_parser("trace",
-                        help="summarize a Chrome trace-event JSON")
-    tp.add_argument("path")
+                        help="summarize Chrome trace-event JSON "
+                        "file(s); several (one per replica) are "
+                        "merged with thread ids renamespaced per "
+                        "file")
+    tp.add_argument("path", nargs="+")
     tp.add_argument("--require", action="append", metavar="NAME",
                     help="exit 1 unless a span/instant NAME exists "
                     "(repeatable); NAME{key=value,...} additionally "
                     "matches event args")
+    tp.add_argument("--merge", default=None, metavar="OUT",
+                    help="write the merged, tid-renamespaced trace "
+                    "to OUT (Perfetto-loadable)")
     tp.set_defaults(fn=dump_trace)
     args = ap.parse_args(argv)
     return args.fn(args)

@@ -49,6 +49,23 @@ Pure stdlib, so it runs anywhere a shell does:
     disabled: a capacity dashboard wired to this view must never
     silently watch a store that is not running.
 
+``--journeys``
+    Render the journey plane's ``/statusz`` census
+    (``docs/observability.md``, "Request journeys & exemplars"):
+    started / finished / open journeys, hops recorded, ring drops,
+    and the SLO exemplar table — the worst-observed rid per TTFT/ITL
+    histogram bucket, i.e. which request to pull when a bucket
+    breaches.  A server without the journeys block FAILs (exit 1),
+    and so does one with the plane disabled: a dashboard wired to
+    this view must never silently watch a plane that is not
+    recording.
+
+``--journey RID``
+    Fetch ``GET /debug/journey/RID`` and render that request's
+    merged cross-replica hop sequence front-to-back (seq, replica,
+    iter, t, kind, detail).  Non-200 answers (unknown rid, journeys
+    disabled) FAIL with the server's error body.
+
 ``--flight N`` / ``--request UID`` / ``--statusz`` / ``--metrics``
     Raw views of the corresponding endpoints.
 
@@ -325,6 +342,59 @@ def render_offload(stats) -> int:
     return 0
 
 
+def render_journeys(stats) -> int:
+    """The journey-plane census view: lifecycle counters + the
+    per-bucket SLO exemplar table (``stats()["journeys"]``,
+    docs/observability.md "Request journeys & exemplars").  A missing
+    block means the endpoint predates the journey plane — that gates,
+    and so does a server with the plane disabled: a correlation
+    dashboard must never silently watch a plane that is not
+    recording."""
+    jn = stats.get("journeys")
+    if jn is None:
+        print("FAIL: /statusz has no 'journeys' block (server "
+              "predates the journey plane?)", file=sys.stderr)
+        return 1
+    if not jn.get("enabled"):
+        print("FAIL: journeys block present but the plane is "
+              "disabled (enable_journeys=False)", file=sys.stderr)
+        return 1
+    print(f"journeys: started={jn.get('started')} "
+          f"finished={jn.get('finished')} open={jn.get('open')} "
+          f"hops={jn.get('hops')} dropped={jn.get('dropped')}")
+    exemplars = jn.get("exemplars") or {}
+    if not exemplars:
+        print("no exemplars yet")
+        return 0
+    print(f"{'metric':<10} {'bucket':>6} {'worst':>12} {'rid':>8}")
+    for metric in sorted(exemplars):
+        for b in sorted(exemplars[metric], key=int):
+            obs = exemplars[metric][b]
+            print(f"{metric:<10} {b:>6} {obs.get('value'):>12.6g} "
+                  f"{obs.get('rid'):>8}")
+    return 0
+
+
+def render_journey(j: dict) -> None:
+    """One merged journey, front-to-back (the /debug/journey/RID
+    body — ``Journey.as_dict()`` shape)."""
+    print(f"journey rid={j.get('rid')}: "
+          f"{'complete' if j.get('complete') else 'INCOMPLETE'}, "
+          f"finish={j.get('finish_reason')!r}, "
+          f"duration={j.get('duration', 0.0):.3f}s, "
+          f"replicas={'>'.join(j.get('replicas', ()))}")
+    core = ("rid", "seq", "replica", "iter", "t", "kind")
+    print(f"  {'seq':>4} {'replica':<12} {'iter':>6} {'t':>9} "
+          f"{'kind':<16} detail")
+    for h in j.get("hops", ()):
+        detail = " ".join(f"{k}={h[k]}" for k in sorted(h)
+                          if k not in core)
+        print(f"  {h.get('seq', '?'):>4} "
+              f"{h.get('replica', '?'):<12} "
+              f"{h.get('iter', '?'):>6} {h.get('t', 0.0):>9.3f} "
+              f"{h.get('kind', '?'):<16} {detail}")
+
+
 def assert_healthy(base, timeout) -> int:
     """The gate: healthz ok + conformant metrics + pinned statusz
     blocks.  Prints what failed; 0 only when everything holds."""
@@ -403,6 +473,14 @@ def main(argv=None) -> int:
                     "device/host/disk table, tier-crossing counters, "
                     "promote latency (FAILs when the endpoint has no "
                     "enabled offload store)")
+    ap.add_argument("--journeys", action="store_true",
+                    help="render the journey-plane census + the SLO "
+                    "exemplar table (worst rid per TTFT/ITL bucket; "
+                    "FAILs when the endpoint has no enabled journey "
+                    "plane)")
+    ap.add_argument("--journey", type=int, default=None, metavar="RID",
+                    help="render one request's merged cross-replica "
+                    "hop sequence (/debug/journey/RID)")
     ap.add_argument("--statusz", action="store_true",
                     help="print the full /statusz JSON")
     ap.add_argument("--metrics", action="store_true",
@@ -428,7 +506,7 @@ def _run(args, base) -> int:
         if rc:
             return rc
     if args.programs or args.statusz or args.streams \
-            or args.elastic or args.offload:
+            or args.elastic or args.offload or args.journeys:
         code, _, body = fetch(base, "/statusz", args.timeout)
         if code != 200:
             print(f"FAIL: /statusz {code}", file=sys.stderr)
@@ -450,6 +528,19 @@ def _run(args, base) -> int:
             rc = render_offload(stats)
             if rc:
                 return rc
+        if args.journeys:
+            rc = render_journeys(stats)
+            if rc:
+                return rc
+    if args.journey is not None:
+        code, _, body = fetch(base, f"/debug/journey/{args.journey}",
+                              args.timeout)
+        if code != 200:
+            print(f"FAIL: /debug/journey/{args.journey} {code}: "
+                  f"{body.decode()}", file=sys.stderr)
+            return 1
+        render_journey(
+            parse_json(body, f"/debug/journey/{args.journey}"))
     if args.metrics:
         code, _, body = fetch(base, "/metrics", args.timeout)
         if code != 200:
@@ -475,6 +566,7 @@ def _run(args, base) -> int:
                          indent=2, sort_keys=True))
     if not any((args.assert_healthy, args.programs, args.statusz,
                 args.streams, args.elastic, args.offload,
+                args.journeys, args.journey is not None,
                 args.metrics, args.flight is not None,
                 args.request is not None)):
         code, _, body = fetch(base, "/healthz", args.timeout)
