@@ -58,9 +58,9 @@ from apex_tpu.serving.reasons import (
 )
 
 __all__ = ["Arrival", "ChaosConfig", "ChaosEngine", "ChaosSchedule",
-           "ReplicaKillSwitch", "ROUTER_TERMINAL_REASONS",
-           "TERMINAL_REASONS", "run_elastic_soak", "run_router_soak",
-           "run_soak"]
+           "ChaosTransport", "ReplicaKillSwitch",
+           "ROUTER_TERMINAL_REASONS", "TERMINAL_REASONS",
+           "run_elastic_soak", "run_router_soak", "run_soak"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -198,6 +198,24 @@ class ChaosConfig:
     offload_torn_rate: float = 0.0
     offload_capacity_rate: float = 0.0
 
+    # transport fault classes (docs/serving.md, "KV transport"; the
+    # --transport-faults soak arms them) — the network-grade fault
+    # model on the KV transport envelope.  RESET drops the connection
+    # before delivery (first attempt only; the retry lands), RESET
+    # AFTER drops it after the handler ran but before the ack (the
+    # retry must dedup against the ledger — exactly-once's hard
+    # case), STALL blows the per-transfer deadline
+    # (deadline_exceeded, not retried), DUP delivers the same
+    # transfer id twice (the second must answer from the ledger), and
+    # CORRUPT flips one byte of one leaf in flight (the checksummed
+    # import must reject it whole).  Defaults 0.0 keep legacy
+    # (config, seed) schedules byte-identical (no extra RNG draws).
+    transport_reset_rate: float = 0.0
+    transport_reset_after_rate: float = 0.0
+    transport_stall_rate: float = 0.0
+    transport_dup_rate: float = 0.0
+    transport_corrupt_rate: float = 0.0
+
     # flash-crowd arrival class (``serving/elastic``; the --elastic
     # soak and bench arm arm it): for ``flash_crowd_len`` iterations
     # starting at ``flash_crowd_iter``, EVERY iteration adds
@@ -240,7 +258,12 @@ class ChaosSchedule:
                  handoff_torn_iters: Optional[Set[int]] = None,
                  disconnect_iters: Optional[Set[int]] = None,
                  offload_torn_iters: Optional[Set[int]] = None,
-                 offload_capacity_iters: Optional[Set[int]] = None):
+                 offload_capacity_iters: Optional[Set[int]] = None,
+                 transport_reset_iters: Optional[Set[int]] = None,
+                 transport_reset_after_iters: Optional[Set[int]] = None,
+                 transport_stall_iters: Optional[Set[int]] = None,
+                 transport_dup_iters: Optional[Set[int]] = None,
+                 transport_corrupt_iters: Optional[Set[int]] = None):
         self.cfg = cfg
         self.seed = seed
         self.arrivals = arrivals
@@ -252,6 +275,12 @@ class ChaosSchedule:
         self.disconnect_iters = disconnect_iters or set()
         self.offload_torn_iters = offload_torn_iters or set()
         self.offload_capacity_iters = offload_capacity_iters or set()
+        self.transport_reset_iters = transport_reset_iters or set()
+        self.transport_reset_after_iters = \
+            transport_reset_after_iters or set()
+        self.transport_stall_iters = transport_stall_iters or set()
+        self.transport_dup_iters = transport_dup_iters or set()
+        self.transport_corrupt_iters = transport_corrupt_iters or set()
 
     @property
     def num_arrivals(self) -> int:
@@ -305,6 +334,11 @@ class ChaosSchedule:
         disconnect: Set[int] = set()
         offload_torn: Set[int] = set()
         offload_capacity: Set[int] = set()
+        transport_reset: Set[int] = set()
+        transport_reset_after: Set[int] = set()
+        transport_stall: Set[int] = set()
+        transport_dup: Set[int] = set()
+        transport_corrupt: Set[int] = set()
         prior: List[Arrival] = []
         for i in range(cfg.iters):
             batch: List[Arrival] = []
@@ -364,6 +398,21 @@ class ChaosSchedule:
             if cfg.offload_capacity_rate \
                     and rng.random() < cfg.offload_capacity_rate:
                 offload_capacity.add(i)
+            if cfg.transport_reset_rate \
+                    and rng.random() < cfg.transport_reset_rate:
+                transport_reset.add(i)
+            if cfg.transport_reset_after_rate \
+                    and rng.random() < cfg.transport_reset_after_rate:
+                transport_reset_after.add(i)
+            if cfg.transport_stall_rate \
+                    and rng.random() < cfg.transport_stall_rate:
+                transport_stall.add(i)
+            if cfg.transport_dup_rate \
+                    and rng.random() < cfg.transport_dup_rate:
+                transport_dup.add(i)
+            if cfg.transport_corrupt_rate \
+                    and rng.random() < cfg.transport_corrupt_rate:
+                transport_corrupt.add(i)
         # compose the EXISTING fault vocabulary: one FaultPlan per
         # scheduled crash, ticked by iteration number (crash_kind
         # "raise" — SIGKILL would end the soak process, which the
@@ -380,7 +429,12 @@ class ChaosSchedule:
                    handoff_torn_iters=handoff_torn,
                    disconnect_iters=disconnect,
                    offload_torn_iters=offload_torn,
-                   offload_capacity_iters=offload_capacity)
+                   offload_capacity_iters=offload_capacity,
+                   transport_reset_iters=transport_reset,
+                   transport_reset_after_iters=transport_reset_after,
+                   transport_stall_iters=transport_stall,
+                   transport_dup_iters=transport_dup,
+                   transport_corrupt_iters=transport_corrupt)
 
 
 class ChaosEngine:
@@ -418,7 +472,10 @@ class ChaosEngine:
         self.injected = injected if injected is not None else {
             "oom": 0, "nonfinite_rows": 0, "crashes": 0,
             "handoff_oom": 0, "handoff_torn": 0,
-            "offload_torn": 0, "offload_capacity": 0}
+            "offload_torn": 0, "offload_capacity": 0,
+            "transport_reset": 0, "transport_reset_after": 0,
+            "transport_stall": 0, "transport_dup": 0,
+            "transport_corrupt": 0}
         self._tick_plans = tick_plans
 
     def begin_iter(self, i: int) -> None:
@@ -587,6 +644,118 @@ class ChaosEngine:
         return getattr(self.inner, name)
 
 
+class _TransportFaultPlan:
+    """One transfer's injected fault, handed to the transport's send
+    envelope (``KVTransport.chaos`` seam).  ``before(payload)`` runs
+    at the top of EVERY attempt (it may raise, or return a corrupted
+    copy); ``after(redeliver)`` runs after a successful delivery (it
+    may re-deliver the same transfer id, or drop the ack on the
+    floor).  ``_fired`` makes each fault one-shot, so a retried
+    attempt sees a healthy wire — exactly a transient network fault."""
+
+    def __init__(self, kind: str, injected: Dict[str, int]):
+        self.kind = kind
+        self.injected = injected
+        self._fired = False
+
+    def before(self, payload):
+        from apex_tpu.serving.transport.base import (
+            TransportConnectionError, TransportTimeoutError)
+
+        if self._fired or self.kind in ("dup", "reset_after"):
+            return payload
+        self._fired = True
+        if self.kind == "reset":
+            # connection reset mid-frame, before anything ingested:
+            # retried by the envelope; the retry lands
+            self.injected["transport_reset"] += 1
+            raise TransportConnectionError(
+                "chaos: connection reset mid-frame")
+        if self.kind == "stall":
+            # stall past the per-transfer deadline: NOT retried —
+            # the consumer's degradation path must fire
+            self.injected["transport_stall"] += 1
+            raise TransportTimeoutError(
+                "chaos: transfer stalled past its deadline")
+        if self.kind == "corrupt":
+            # one byte of one leaf flips in flight AFTER the payload
+            # crc was recorded — the checksummed import must reject
+            # the payload whole (the ChaosEngine torn-spill idiom)
+            import numpy as np
+
+            self.injected["transport_corrupt"] += 1
+            name = min(payload["leaves"])
+            arr = np.asarray(payload["leaves"][name]).copy()
+            arr.view(np.uint8).flat[0] ^= 0xFF
+            return dict(payload,
+                        leaves=dict(payload["leaves"], **{name: arr}))
+        return payload
+
+    def after(self, redeliver) -> None:
+        from apex_tpu.serving.transport.base import \
+            TransportConnectionError
+
+        if self._fired:
+            return
+        if self.kind == "dup":
+            # duplicated delivery: the same transfer id arrives twice;
+            # the receiver ledger must answer the second from cache
+            # (dedup_hits) without re-importing a single block
+            self._fired = True
+            self.injected["transport_dup"] += 1
+            redeliver()
+        elif self.kind == "reset_after":
+            # the HARD exactly-once case: the handler ran (blocks
+            # imported, ack recorded) but the ack died on the wire —
+            # the envelope retries, and the retry MUST dedup against
+            # the ledger instead of double-importing
+            self._fired = True
+            self.injected["transport_reset_after"] += 1
+            raise TransportConnectionError(
+                "chaos: connection reset after dispatch, ack lost")
+
+
+class ChaosTransport:
+    """The transport half of the chaos plane: attach via
+    ``transport.chaos = ChaosTransport(schedule, injected)`` and call
+    :meth:`begin_iter` alongside the engine wrappers'.  Each scheduled
+    fault kind arms once per scheduled iteration and STAYS armed until
+    a send consumes it (one fault per send, in arming order) — sends
+    are much sparser than iterations on real traffic, and a
+    fire-only-if-coincident model would leave whole fault classes
+    untested on short soaks.  Faults still waiting at the end of the
+    run fire nothing: the ``injected`` tallies count FIRED faults
+    only, which is what the soak invariants reconcile against."""
+
+    _KINDS = ("reset", "reset_after", "stall", "dup", "corrupt")
+
+    def __init__(self, schedule: ChaosSchedule,
+                 injected: Dict[str, int]):
+        self.schedule = schedule
+        self.injected = injected
+        self.iter = -1
+        self._armed: List[str] = []
+
+    def begin_iter(self, i: int) -> None:
+        self.iter = i
+        sch = self.schedule
+        self._armed.extend(kind for kind, iters in (
+            ("reset", sch.transport_reset_iters),
+            ("reset_after", sch.transport_reset_after_iters),
+            ("stall", sch.transport_stall_iters),
+            ("dup", sch.transport_dup_iters),
+            ("corrupt", sch.transport_corrupt_iters),
+        ) if i in iters)
+
+    def plan_send(self, peer: str):
+        """One fault plan per armed kind, consumed in arming order by
+        successive sends; ``None`` once the backlog is spent (the
+        common case with the default 0.0 rates)."""
+        if not self._armed:
+            return None
+        return _TransportFaultPlan(self._armed.pop(0), self.injected)
+
+
 class ReplicaKillSwitch:
     """Engine wrapper that makes EVERY device call raise while armed —
     the router chaos arm's replica kill (``docs/serving.md``,
@@ -684,6 +853,15 @@ def run_router_soak(make_fleet: Callable, cfg: ChaosConfig, seed: int,
     vic = fleet.replicas[victim]
     kill = ReplicaKillSwitch(vic.server.engine)
     vic.server.engine = kill
+    # transport faults ride the fleet's shared KV transport (hand-off
+    # and warm sends); with the transport_* rates at their 0.0
+    # defaults nothing arms and legacy (config, seed) runs are
+    # untouched
+    tinjected = {"transport_reset": 0, "transport_reset_after": 0,
+                 "transport_stall": 0, "transport_dup": 0,
+                 "transport_corrupt": 0}
+    tchaos = ChaosTransport(schedule, tinjected)
+    fleet.kv_transport.chaos = tchaos
 
     tracked: Dict[int, Tuple] = {}      # rid -> (RouterRequest, Arrival)
     terminal: Dict[int, str] = {}       # rid -> finish_reason
@@ -726,6 +904,7 @@ def run_router_soak(make_fleet: Callable, cfg: ChaosConfig, seed: int,
     try:
         for i in range(cfg.iters):
             clock_state["t"] = float(i)
+            tchaos.begin_iter(i)
             if i == kill_iter:
                 kill.dead = True
                 log(f"iter {i}: KILLED {vic.name}")
@@ -750,12 +929,35 @@ def run_router_soak(make_fleet: Callable, cfg: ChaosConfig, seed: int,
                     f"{vic.breaker.state}")
 
         clock_state["t"] = float(cfg.iters)
+        tchaos.begin_iter(cfg.iters)
         fleet.drain()
         for rep in fleet.replicas:
             rep.server.scheduler.audit()
         absorb_finished()
 
         router = fleet.stats()["router"]
+        # transport-fault reconciliation (trivially 0 == 0 with the
+        # default rates): every fired fault left its exact fingerprint
+        # on the shared transport, and every failed send degraded to
+        # the monolithic fallback — which invariants 2-4 then prove
+        # produced the same tokens
+        tstats = fleet.stats()["transport"]
+        assert tstats["dedup_hits"] == (
+            tinjected["transport_dup"]
+            + tinjected["transport_reset_after"]), \
+            (f"dedup_hits={tstats['dedup_hits']} != injected "
+             f"dup={tinjected['transport_dup']} + reset_after="
+             f"{tinjected['transport_reset_after']}")
+        assert tstats["deadline_exceeded"] == \
+            tinjected["transport_stall"], \
+            (f"deadline_exceeded={tstats['deadline_exceeded']} != "
+             f"injected stalls={tinjected['transport_stall']}")
+        assert tstats["retries"] == (
+            tinjected["transport_reset"]
+            + tinjected["transport_reset_after"]), \
+            (f"retries={tstats['retries']} != injected reset="
+             f"{tinjected['transport_reset']} + reset_after="
+             f"{tinjected['transport_reset_after']}")
         for rid, (rr, _a) in tracked.items():       # invariant 2
             assert rr.finished and rid in terminal, \
                 f"routed request {rid} never reached a terminal state"
@@ -913,6 +1115,10 @@ def run_router_soak(make_fleet: Callable, cfg: ChaosConfig, seed: int,
             - victim_finished_at_recovery),
         affinity=router["affinity"],
         pressure_peak=stats["pressure_peak"],
+        transport={k: stats["transport"][k] for k in (
+            "backend", "attempts", "retries", "delivered", "rejects",
+            "failures", "deadline_exceeded", "breaker_fastfail",
+            "ingested", "dedup_hits")},
     )
     if jreport is not None:
         report["journeys"] = jreport
@@ -1290,6 +1496,12 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
                              rng_salt=0x9F11, injected=chaos.injected,
                              tick_plans=False)
         server.prefill_engine = pchaos
+    # the transport fault class rides the server's KV transport
+    # envelope (docs/serving.md, "KV transport") and shares the
+    # injected tallies; with every transport_*_rate at 0 it arms
+    # nothing and the envelope's chaos seam short-circuits
+    tchaos = ChaosTransport(schedule, chaos.injected)
+    server.kv_transport.chaos = tchaos
 
     sched = server.scheduler
     all_scheds = [sched]
@@ -1354,6 +1566,7 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
                 chaos.begin_iter(i)
                 if pchaos is not None:
                     pchaos.begin_iter(i)
+                tchaos.begin_iter(i)
                 server.step()
             except InjectedCrash:
                 # a FaultPlan crash between engine steps: nothing was
@@ -1407,6 +1620,7 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
         chaos.begin_iter(cfg.iters)  # past the schedule: drain unfaulted
         if pchaos is not None:
             pchaos.begin_iter(cfg.iters)
+        tchaos.begin_iter(cfg.iters)
         server.drain()
         for s in all_scheds:
             s.audit()
@@ -1519,17 +1733,53 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
              f"injected {injected_oom} (incl. hand-off faults)")
         assert report["crashes_caught"] == chaos.injected["crashes"]
         # invariant 7: every offload crc reject traces to an injected
-        # torn spill — a reject WITHOUT an injection would mean the
-        # demote/promote path corrupts payloads on its own.  (<=, not
-        # ==: a torn payload only rejects if a resumed session
-        # actually tries to promote it before the host LRU drops it.)
+        # corruption — a torn spill or an in-flight transport corrupt;
+        # a reject WITHOUT an injection would mean the demote/promote
+        # path corrupts payloads on its own.  (<=, not ==: a torn
+        # payload only rejects if a resumed session actually tries to
+        # promote it before the host LRU drops it.)
+        inj_corruptions = (chaos.injected.get("offload_torn", 0)
+                           + chaos.injected.get("transport_corrupt", 0))
         if stats["offload"]["enabled"]:
-            assert stats["offload"]["crc_rejects"] <= \
-                chaos.injected.get("offload_torn", 0), \
+            assert stats["offload"]["crc_rejects"] <= inj_corruptions, \
                 (f"offload rejected {stats['offload']['crc_rejects']} "
                  f"payload(s) but chaos only injected "
-                 f"{chaos.injected.get('offload_torn', 0)} torn spills "
-                 f"— the offload path corrupted data on its own")
+                 f"{inj_corruptions} corruption(s) (torn spills + "
+                 f"in-flight corrupts) — the offload path corrupted "
+                 f"data on its own")
+        # invariant 10: the transport envelope reconciles EXACTLY
+        # against the injected network faults (docs/serving.md, "KV
+        # transport").  Exactly-once: every duplicated delivery and
+        # every retry-behind-a-lost-ack answered from the dedup
+        # ledger, never by a second import; every stall became one
+        # deadline_exceeded (not retried); every reset became exactly
+        # one retry; every envelope give-up degraded the consumer
+        # (promote is this soak's only transport consumer) — no more,
+        # no fewer.
+        t = stats["transport"]
+        inj = chaos.injected
+        assert t["dedup_hits"] == (inj.get("transport_dup", 0)
+                                   + inj.get("transport_reset_after", 0)), \
+            (f"transport answered {t['dedup_hits']} duplicate(s) from "
+             f"the ledger, chaos injected "
+             f"{inj.get('transport_dup', 0)} dup(s) + "
+             f"{inj.get('transport_reset_after', 0)} lost ack(s) — "
+             f"exactly-once bookkeeping leaked")
+        assert t["deadline_exceeded"] == inj.get("transport_stall", 0), \
+            (f"transport counted {t['deadline_exceeded']} deadline "
+             f"expiries, chaos injected "
+             f"{inj.get('transport_stall', 0)} stall(s)")
+        assert t["retries"] == (inj.get("transport_reset", 0)
+                                + inj.get("transport_reset_after", 0)), \
+            (f"transport retried {t['retries']} time(s), chaos "
+             f"injected {inj.get('transport_reset', 0)} reset(s) + "
+             f"{inj.get('transport_reset_after', 0)} lost ack(s)")
+        if stats["offload"]["enabled"]:
+            assert stats["offload"]["transport_skips"] == t["failures"], \
+                (f"promote skipped {stats['offload']['transport_skips']} "
+                 f"transfer(s) on transport failure but the envelope "
+                 f"counted {t['failures']} — a failed transfer leaked "
+                 f"past its degradation path")
         # an armed hang watchdog must ride the whole soak — thousands
         # of iterations of composed faults, none of them a hang —
         # without a single false positive (docs/observability.md,
@@ -1627,8 +1877,12 @@ def run_soak(make_server: Callable, cfg: ChaosConfig, seed: int, *,
         offload=({k: stats["offload"][k] for k in
                   ("demotes", "promotes_host", "promotes_disk",
                    "spills", "crc_rejects", "capacity_skips",
-                   "disk_torn")}
+                   "transport_skips", "disk_torn")}
                  if stats["offload"]["enabled"] else None),
+        transport={k: stats["transport"][k] for k in
+                   ("backend", "attempts", "retries", "delivered",
+                    "rejects", "failures", "deadline_exceeded",
+                    "breaker_fastfail", "ingested", "dedup_hits")},
     )
     if jreport is not None:
         report["journeys"] = jreport

@@ -113,7 +113,20 @@ serve".  Three layers, bottom-up:
   checksummed ``import_blocks`` path into fresh device blocks, so a
   cache hit spans device -> host -> disk at fixed HBM; every
   integrity/capacity failure on the offload path falls back to cold
-  prefill bit-identically.
+  prefill bit-identically;
+- :mod:`serving.transport` — the KV transport layer
+  (``docs/serving.md``, "KV transport"): every cross-pool block
+  movement above (disagg hand-off, elastic prefix warm, offload
+  promote) rides a :class:`~serving.transport.KVTransport` backend —
+  :class:`~serving.transport.InProcessTransport` (the direct copy,
+  default, behavior-identical) or
+  :class:`~serving.transport.SocketTransport` (crc-framed payloads
+  over loopback TCP) — under one
+  :class:`~serving.transport.TransportPolicy` robustness envelope:
+  per-transfer deadline, bounded retry with decorrelated jitter,
+  per-peer circuit breaker fast-failing into each consumer's existing
+  degradation path, and exactly-once ingest via monotonic transfer
+  ids + a bounded receiver dedup ledger.
 
 Quick start::
 
@@ -150,13 +163,22 @@ from apex_tpu.serving.router import (
 )
 from apex_tpu.serving.scheduler import QueueFullError, Request, Scheduler
 from apex_tpu.serving.speculation import DraftSource, NgramDraft
+from apex_tpu.serving.transport import (
+    InProcessTransport,
+    KVTransport,
+    SocketTransport,
+    TransportError,
+    TransportPolicy,
+)
 
 __all__ = [
     "BlockAllocator",
     "DecodeEngine",
     "DraftSource",
+    "InProcessTransport",
     "InferenceServer",
     "KVCacheConfig",
+    "KVTransport",
     "NgramDraft",
     "OffloadStore",
     "OverloadPolicy",
@@ -169,6 +191,9 @@ __all__ = [
     "RouterRequest",
     "SamplingParams",
     "Scheduler",
+    "SocketTransport",
+    "TransportError",
+    "TransportPolicy",
     "default_prefill_buckets",
     "dequantize_kv",
     "greedy_sample",

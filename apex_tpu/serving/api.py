@@ -160,6 +160,11 @@ from apex_tpu.serving import reasons
 from apex_tpu.serving.scheduler import QueueFullError, Request, Scheduler
 from apex_tpu.serving.speculation import DraftSource, NgramDraft
 from apex_tpu.serving.streaming import StreamBroker, TokenStream
+from apex_tpu.serving.transport import (
+    InProcessTransport,
+    KVTransport,
+    TransportPolicy,
+)
 from apex_tpu.utils import CounterMeter, GaugeMeter, RateMeter
 
 # the stats() window for "tokens/s right now" (RateMeter.rate_over) —
@@ -459,6 +464,14 @@ class InferenceServer:
         (default 64 MiB); coldest entries past it spill or drop.
       kv_offload_dir: optional disk spill tier directory; surviving
         entries are re-adopted on construction (content-addressed).
+      kv_transport: the KV transport backend (``docs/serving.md``,
+        "KV transport") the offload promote path rides — a
+        :class:`~apex_tpu.serving.transport.KVTransport`; default a
+        fresh :class:`~apex_tpu.serving.transport.InProcessTransport`
+        on this server's clock (behavior-identical to the direct
+        import call it wraps).  The server registers its ``"offload"``
+        ingest peer on it; ``stats()["transport"]`` reports the
+        envelope counters either way.
 
     Example::
 
@@ -509,6 +522,7 @@ class InferenceServer:
                  enable_kv_offload: Optional[bool] = None,
                  kv_offload_host_bytes: int = 64 << 20,
                  kv_offload_dir: Optional[str] = None,
+                 kv_transport: Optional[KVTransport] = None,
                  enable_journeys: Optional[bool] = None,
                  journey_replica: str = "server"):
         self.registry = registry if registry is not None \
@@ -635,6 +649,18 @@ class InferenceServer:
         if enable_kv_offload is None:
             enable_kv_offload = os.environ.get(KV_OFFLOAD_ENV)
         self.kv_offload = resolve_kv_offload(enable_kv_offload)
+        # KV transport (docs/serving.md, "KV transport"): the offload
+        # promote path — the one cross-pool block movement a bare
+        # server owns — rides the policy envelope (deadline / retry /
+        # breaker / exactly-once dedup).  The default in-process
+        # backend on the server's clock is behavior-identical to the
+        # direct import call it wraps: zero extra RNG draws, zero
+        # extra branches on the healthy path.  The ingest handler
+        # resolves the cache-home engine at CALL time so chaos
+        # wrappers installed post-construction intercept.
+        self.kv_transport = kv_transport if kv_transport is not None \
+            else InProcessTransport(policy=TransportPolicy(clock=clock))
+        self.kv_transport.register_peer("offload", self._offload_ingest)
         self.offload = CounterMeter(registry=self.registry,
                                     name="serving_offload",
                                     label="event")
@@ -660,9 +686,11 @@ class InferenceServer:
                 lambda ids: (self.prefill_engine if self.disagg
                              else self.engine).export_blocks(
                                  ids, per_block_crc=True),
-                lambda ids, payload: (
-                    self.prefill_engine if self.disagg
-                    else self.engine).import_blocks(ids, payload),
+                lambda ids, payload: self.kv_transport.send(
+                    "offload",
+                    {"op": "promote",
+                     "blocks": [int(b) for b in ids]},
+                    payload),
                 counters=self.offload,
                 promote_hist=self.offload_promote,
                 clock=clock)
@@ -2149,14 +2177,25 @@ class InferenceServer:
                 payload = self.prefill_engine.export_blocks(
                     req.block_table)
                 if self.handoff_sink(req, payload):
-                    psched.register_progress(req)
-                    psched.fail(req, reasons.HANDOFF)
+                    # a cancel() racing the sink call may have
+                    # terminalized req already (freeing its prefill
+                    # blocks on the standard fail path) — failing it
+                    # AGAIN would double-free; the sink side handles
+                    # the orphaned ingest
+                    if not req.finished:
+                        psched.register_progress(req)
+                        psched.fail(req, reasons.HANDOFF)
                     self.handoffs.incr("sink_delivered")
                     q.popleft()
                     continue
                 # nobody could take it: fall back to the LOCAL decode
                 # pool below — monolithic placement on this replica
                 self.handoffs.incr("sink_local_fallback")
+                if req.finished:
+                    # cancelled mid-sink and the sink declined: its
+                    # blocks are already freed — nothing to place
+                    q.popleft()
+                    continue
             n = len(req.block_table)
             if not sched.has_free_slot:
                 self.handoffs.incr("deferred")
@@ -2275,6 +2314,19 @@ class InferenceServer:
                 self.tracer.instant("handoff_ingest", uid=req.uid,
                                     blocks=n)
             return req
+
+    def _offload_ingest(self, meta: dict, payload: dict) -> dict:
+        """Receiver half of the offload-promote transfer: import the
+        checksummed payload into the blocks the sender reserved.  The
+        cache-home engine is resolved at call time (prefill pool under
+        disagg, else the monolithic engine) so the handler survives a
+        server reconfiguration.  A torn payload raises
+        :class:`ValueError` natively — the transport reports it to the
+        sender un-retried and caches nothing."""
+        eng = self.prefill_engine if self.disagg else self.engine
+        blocks = [int(b) for b in meta["blocks"]]
+        eng.import_blocks(blocks, payload)
+        return {"blocks": len(blocks)}
 
     def _note_oom(self, site: str) -> None:
         """Account one transient engine ``MemoryError``: the affected
@@ -2756,6 +2808,7 @@ class InferenceServer:
             self.watchdog.stop()
         if self.ops is not None:
             self.ops.stop()
+        self.kv_transport.close()
         return self._final_stats
 
     def reset_meters(self) -> None:
@@ -2914,6 +2967,7 @@ class InferenceServer:
             "crc_rejects": c("crc_rejects"),
             "disk_torn": c("disk_torn"),
             "capacity_skips": c("capacity_skips"),
+            "transport_skips": c("transport_skips"),
             "host_dropped": c("host_dropped"),
             "host_entries": (store.host_entries
                              if store is not None else 0),
@@ -3113,6 +3167,11 @@ class InferenceServer:
             # {"enabled": False} with zeroed counters when off —
             # shape-stable either way
             "offload": self._offload_stats(),
+            # KV transport (docs/serving.md, "KV transport"): the
+            # retry/deadline/breaker envelope every cross-pool block
+            # movement rides — totals plus per-peer counters and
+            # breaker state; shape-stable, backend-tagged
+            "transport": self.kv_transport.stats(),
             # tensor-parallel serving (docs/serving.md,
             # "Tensor-parallel serving"): mesh geometry, tp degree,
             # per-shard KV bytes, and the mesh-lowered program count —

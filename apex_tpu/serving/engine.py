@@ -941,6 +941,11 @@ class DecodeEngine:
                     f"block(s) {list(map(int, block_ids))} has "
                     f"checksum {got} (actual) != {want} (expected); "
                     f"payload rejected whole")
+        if not len(block_ids):
+            # an empty (but geometry-consistent) transfer is a no-op:
+            # launching the scatter anyway would pad the id list with
+            # zeros and overwrite block 0's slots with zero bytes
+            return
         w = self.blocks_per_seq
         slots = self._block_slots(block_ids, w).astype(np.int32)
         padded = {}

@@ -51,6 +51,7 @@ from collections import OrderedDict
 from typing import Dict, List, Optional, Set, Tuple
 
 from apex_tpu.serving.kv_cache import BlockAllocator
+from apex_tpu.serving.transport.base import TransportError
 from apex_tpu.serving.offload import (
     merge_payloads,
     split_payload,
@@ -390,6 +391,16 @@ class PrefixCache:
             # in-flight — discard, cold-prefill
             self.allocator.free(fresh)
             self._off_counters.incr("crc_rejects")
+            return 0
+        except TransportError:
+            # the transport exhausted its envelope (retries, deadline,
+            # or an open breaker): the payloads are still good — put
+            # them back for a later admission and cold-prefill this
+            # one, exactly like the capacity path
+            self.allocator.free(fresh)
+            for h, _, payload, _ in pending:
+                self._offload.put(h, payload)
+            self._off_counters.incr("transport_skips")
             return 0
         promoted = 0
         parent = matched[-1] if matched else ROOT

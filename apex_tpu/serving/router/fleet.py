@@ -73,6 +73,12 @@ from apex_tpu.serving.router.replica import Replica
 from apex_tpu.serving.router.router import ReplicaRouter, RouterRequest
 from apex_tpu.serving.scheduler import Request
 from apex_tpu.serving.streaming import StreamBroker, TokenStream
+from apex_tpu.serving.transport import (
+    InProcessTransport,
+    KVTransport,
+    TransportError,
+    TransportPolicy,
+)
 from apex_tpu.utils import GaugeMeter
 
 __all__ = ["RouterFleet"]
@@ -164,6 +170,7 @@ class RouterFleet:
                  enable_elastic: bool = False,
                  elastic: Optional[AutoscalerConfig] = None,
                  enable_journeys: Optional[bool] = None,
+                 kv_transport: Optional[KVTransport] = None,
                  **server_kwargs):
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
@@ -269,11 +276,19 @@ class RouterFleet:
                 disagg_prefill_threshold=(
                     disagg_prefill_threshold if disagg_prefill
                     else None))
+        # cross-replica KV transport (docs/serving.md, "KV
+        # transport"): hand-off and warm payloads ride this backend;
+        # the router registers every replica as a peer (elastic
+        # scale-ups included) and the in-process default is
+        # behavior-identical to the historical direct calls
+        self.kv_transport = kv_transport if kv_transport is not None \
+            else InProcessTransport(policy=TransportPolicy(clock=clock))
         self.router = ReplicaRouter(self.replicas, policy=policy,
                                     clock=clock,
                                     registry=self.registry,
                                     tracer=self.tracer,
-                                    journeys=self.journeys)
+                                    journeys=self.journeys,
+                                    transport=self.kv_transport)
         # wire each prefill-role replica's hand-off sink to the router
         # (the server exports the blocks; the router places the decode
         # half — docs/serving.md, "Disaggregated prefill/decode")
@@ -503,13 +518,20 @@ class RouterFleet:
             payload = src_eng.export_blocks(src_ids)
         except Exception:
             return 0
-        dst_ids = dst_eng.allocator.alloc(n)
+        # the bulk KV bytes ride the transport (alloc + import happen
+        # in the peer handler — the receiver owns its pool); the
+        # control plane (donor choice, spare-capacity read, radix
+        # seeding below) stays in-process
         try:
-            dst_eng.import_blocks(dst_ids, payload)
-        except ValueError:
-            # torn transfer: the checksum rejected it whole — free
-            # the staging blocks and start cold
-            dst_eng.allocator.free(dst_ids)
+            ack = self.kv_transport.send(rep.name, {"op": "warm"},
+                                         payload)
+        except (ValueError, MemoryError, TransportError):
+            # torn transfer (checksum rejected whole), receiver OOM,
+            # or an exhausted envelope: the handler freed its staging
+            # blocks — start cold, never broken
+            return 0
+        dst_ids = ack.get("blocks")
+        if not dst_ids:
             return 0
         return dst_pc.seed_nodes(nodes, dict(zip(src_ids, dst_ids)))
 
@@ -751,6 +773,13 @@ class RouterFleet:
             pool.shutdown(wait=True)
         if ops is not None:
             ops.stop()
+        # the transport join rides the same unlocked teardown: the
+        # _closed flag already fenced new sends, and the socket
+        # backend's server thread synchronizes on the TRANSPORT lock,
+        # not the fleet ops lock — joining it under _ops_lock would
+        # only stall late ops handlers for the join timeout
+        # apexlint: disable=lock-discipline
+        self.kv_transport.close()
         return final
 
     # -- observability -----------------------------------------------------
@@ -860,4 +889,8 @@ class RouterFleet:
             "streams": self._stream_stats(),
             "elastic": self._elastic_stats(),
             "journeys": journeys_census(self._journey_logs()),
+            # cross-replica KV transport (docs/serving.md, "KV
+            # transport"): envelope totals + per-peer counters and
+            # breaker state for hand-off / warm transfers
+            "transport": self.kv_transport.stats(),
         }

@@ -41,10 +41,16 @@ import random
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from apex_tpu.observability import NULL_JOURNEY_LOG
+from apex_tpu.ops.sampling import SamplingParams
 from apex_tpu.serving import reasons
 from apex_tpu.serving.router.policy import AffinityIndex, RouterPolicy
 from apex_tpu.serving.router.replica import Replica
 from apex_tpu.serving.scheduler import Request
+from apex_tpu.serving.transport import (
+    InProcessTransport,
+    TransportError,
+    TransportPolicy,
+)
 from apex_tpu.utils import CounterMeter
 
 __all__ = ["ReplicaRouter", "RouterRequest"]
@@ -133,13 +139,23 @@ class ReplicaRouter:
     def __init__(self, replicas: Sequence[Replica], *,
                  policy: Optional[RouterPolicy] = None,
                  clock=None, registry=None, tracer=None,
-                 journeys=None):
+                 journeys=None, transport=None):
         if not replicas:
             raise ValueError("ReplicaRouter needs >= 1 replica")
         self.replicas = list(replicas)
         self.policy = policy if policy is not None else RouterPolicy()
         self.clock = clock if clock is not None \
             else self.replicas[0].server.clock
+        # cross-replica KV transport (docs/serving.md, "KV
+        # transport"): every hand-off / warm payload rides this
+        # backend under the retry/deadline/breaker envelope; the
+        # default in-process backend is behavior-identical to the
+        # historical direct call
+        self.transport = transport if transport is not None \
+            else InProcessTransport(
+                policy=TransportPolicy(clock=self.clock))
+        for rep in self.replicas:
+            self._register_transport_peer(rep)
         self.tracer = tracer
         # journey correlation (``observability.journey``): the
         # ROUTER's own hop log — front-door submit/route, failover
@@ -431,6 +447,60 @@ class ReplicaRouter:
             placed += 1
         return placed
 
+    # -- KV transport (docs/serving.md, "KV transport") --------------------
+
+    def _register_transport_peer(self, rep: Replica) -> None:
+        """Register ``rep`` as a transport peer.  The handler is the
+        RECEIVER half of every cross-replica block movement: it
+        dispatches on ``meta["op"]`` — ``"handoff"`` ingests a
+        finished prefill's decode half, ``"warm"`` imports donor
+        prefix blocks into spare pool capacity.  Meta is plain JSON
+        data (the socket backend serializes it); only an in-process
+        backend (``carries_objects``) may carry the journey context
+        object through."""
+        def handle(meta: dict, payload: dict) -> dict:
+            op = meta.get("op")
+            if op == "handoff":
+                s = meta["sampling"]
+                sampling = None if s is None else SamplingParams(
+                    temperature=s[0], top_k=s[1], top_p=s[2],
+                    seed=s[3])
+                new = rep.server.ingest_handoff(
+                    meta["prompt"], meta["generated"], payload,
+                    max_new_tokens=meta["max_new_tokens"],
+                    num_cached=meta["num_cached"],
+                    eos_id=meta["eos_id"],
+                    priority=meta["priority"],
+                    deadline_iters=meta["deadline_iters"],
+                    deadline_s=meta["deadline_s"],
+                    sampling=sampling,
+                    submitted_at=meta["submitted_at"],
+                    first_token_at=meta["first_token_at"],
+                    journey=meta.get("journey"))
+                return {"uid": None if new is None else int(new.uid)}
+            if op == "warm":
+                eng = rep.server.prefill_engine or rep.server.engine
+                n = int(payload.get("num_blocks", 0))
+                if n <= 0:
+                    return {"blocks": None}
+                # warm only into genuinely spare capacity: the
+                # replica must still admit a full-context request
+                # immediately after seeding
+                spare = eng.allocator.num_free - eng.blocks_per_seq
+                if spare < n:
+                    return {"blocks": None}
+                dst = eng.allocator.alloc(n)
+                if dst is None:
+                    return {"blocks": None}
+                try:
+                    eng.import_blocks(dst, payload)
+                except Exception:
+                    eng.allocator.free(dst)
+                    raise
+                return {"blocks": [int(b) for b in dst]}
+            raise ValueError(f"unknown transport op {op!r}")
+        self.transport.register_peer(rep.name, handle)
+
     # -- disaggregated prefill -> decode hand-off --------------------------
 
     def handoff_sink_for(self, rep: Replica):
@@ -488,24 +558,59 @@ class ReplicaRouter:
                 # export hop from scheduler.release_handoff
                 jlog.hop(ctx, "handoff_export", to=target.name,
                          blocks=int(payload.get("num_blocks", 0)))
+            s = req.sampling
+            meta = {
+                "op": "handoff",
+                "prompt": [int(t) for t in req.prompt],
+                "generated": [int(t) for t in req.generated],
+                "max_new_tokens": int(req.max_new_tokens),
+                "num_cached": int(req.num_cached),
+                "eos_id": (None if req.eos_id is None
+                           else int(req.eos_id)),
+                "priority": int(req.priority),
+                "deadline_iters": d_iters,
+                "deadline_s": d_s,
+                "sampling": (None if s is None else
+                             [s.temperature, s.top_k, s.top_p,
+                              s.seed]),
+                "submitted_at": req.submitted_at,
+                "first_token_at": req.first_token_at,
+            }
+            if self.transport.carries_objects and ctx is not None:
+                # only an in-process backend may carry the live
+                # journey context; over a wire the hand-off keeps
+                # its per-replica hops and the fleet merge still
+                # correlates by rid
+                meta["journey"] = ctx
+            new = None
             try:
-                new = target.server.ingest_handoff(
-                    req.prompt, req.generated, payload,
-                    max_new_tokens=req.max_new_tokens,
-                    num_cached=req.num_cached,
-                    eos_id=req.eos_id, priority=req.priority,
-                    deadline_iters=d_iters, deadline_s=d_s,
-                    sampling=req.sampling,
-                    submitted_at=req.submitted_at,
-                    first_token_at=req.first_token_at,
-                    journey=ctx)
+                ack = self.transport.send(target.name, meta, payload)
             except ValueError:
                 # torn payload: detected whole, nothing imported
                 self.events.incr("handoff_torn")
                 if jlog.enabled and ctx is not None:
                     jlog.hop(ctx, "handoff_torn", to=target.name)
-                new = None
+            except TransportError:
+                # the envelope gave up (retries exhausted, deadline,
+                # or open breaker): exactly-once ingest means nothing
+                # half-landed on the target — degrade to monolithic
+                self.events.incr("handoff_transport_failed")
+                if jlog.enabled and ctx is not None:
+                    jlog.hop(ctx, "handoff_transport_failed",
+                             to=target.name)
+            else:
+                if ack.get("uid") is not None:
+                    new = target.server._find_request(int(ack["uid"]))
             if new is not None:
+                if req.finished:
+                    # a cancel() raced the transfer: the prefill side
+                    # already terminalized the request, so the
+                    # freshly-ingested decode half must not live on —
+                    # cancel it on the target (frees its imported
+                    # blocks) and report ownership moved
+                    target.server.cancel(new.uid)
+                    self.events.incr("handoff_cancelled")
+                    return True
                 self.events.incr("handoffs")
                 if self.tracer is not None and self.tracer.enabled:
                     self.tracer.instant("router_handoff",
@@ -516,6 +621,11 @@ class ReplicaRouter:
                 return True
         # monolithic fallback: fresh prefill + decode on whichever
         # replica can take it (bit-identical stream by construction)
+        if req.finished:
+            # cancelled while placing: nothing to resubmit — the
+            # request reached its terminal on the prefill replica
+            self.events.incr("handoff_cancelled")
+            return True
         rep2, _outcome = self.place(req.prompt, exclude=prefill_rep)
         if rep2 is not None:
             if jlog.enabled and ctx is not None:
@@ -588,6 +698,7 @@ class ReplicaRouter:
                 f"{len(self.replicas)} (affinity indices are "
                 f"positional)")
         self.replicas.append(rep)
+        self._register_transport_peer(rep)
         self.events.incr("scale_ups")
 
     def remove_replica(self, rep: Replica) -> None:
@@ -641,6 +752,10 @@ class ReplicaRouter:
             "handoff_torn": self.events.count("handoff_torn"),
             "handoff_kept_local":
                 self.events.count("handoff_kept_local"),
+            "handoff_transport_failed":
+                self.events.count("handoff_transport_failed"),
+            "handoff_cancelled":
+                self.events.count("handoff_cancelled"),
             "disagg_prefill_threshold":
                 self.policy.disagg_prefill_threshold,
             "unplaced": (p.count("unplaced")

@@ -1,0 +1,31 @@
+"""KV transport layer (``docs/serving.md``, "KV transport").
+
+The interchangeable-backend abstraction every KV block movement rides
+— disagg hand-off, elastic prefix warm, offload promote — with a
+retry/deadline/breaker robustness envelope and exactly-once ingest.
+"""
+
+from .base import (KVTransport, ReceiverLedger,
+                   TransportConnectionError, TransportError,
+                   TransportFrameError, TransportPolicy,
+                   TransportTimeoutError)
+from .inprocess import InProcessTransport
+from .sockets import (MAX_FRAME_BYTES, FrameReader, SocketTransport,
+                      decode_payload, encode_frame, encode_payload)
+
+__all__ = [
+    "FrameReader",
+    "InProcessTransport",
+    "KVTransport",
+    "MAX_FRAME_BYTES",
+    "ReceiverLedger",
+    "SocketTransport",
+    "TransportConnectionError",
+    "TransportError",
+    "TransportFrameError",
+    "TransportPolicy",
+    "TransportTimeoutError",
+    "decode_payload",
+    "encode_frame",
+    "encode_payload",
+]

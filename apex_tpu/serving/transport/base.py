@@ -1,0 +1,359 @@
+"""KV transport — the robustness envelope around block movement.
+
+Every serving tier that moves KV blocks between pools — the disagg
+hand-off (``docs/serving.md``, "Disaggregated prefill/decode"), the
+elastic scale-up prefix warm ("Elastic fleet"), the hierarchical
+offload promote ("Hierarchical KV offload") — used to call the
+checksummed ``export_blocks``/``import_blocks`` pair directly.  That
+path verifies payload integrity (torn payloads rejected WHOLE) but has
+no deadline, no retry policy, no duplicate suppression, and no fault
+model beyond corruption; the first real socket adds connection resets,
+stalls, duplicated delivery, and reordering.  :class:`KVTransport` is
+the promotion of that path into a first-class interface with
+interchangeable backends:
+
+- :class:`~apex_tpu.serving.transport.InProcessTransport` — the
+  direct call, byte- and schedule-identical to the historical path;
+  the default everywhere.
+- :class:`~apex_tpu.serving.transport.SocketTransport` —
+  length-prefixed crc-framed payloads over a loopback TCP stream with
+  a stdlib server thread; the codebase's first true cross-process
+  network surface, and the template the multi-host topology
+  (ROADMAP.md) composes on.
+
+Both backends run under the same :class:`TransportPolicy` envelope:
+
+- **per-transfer deadline** — a send is bounded by
+  ``policy.deadline_s`` of (injected) clock across all attempts;
+- **bounded retry with decorrelated jitter** — transport-level
+  failures (:class:`TransportConnectionError`) retry through
+  :func:`apex_tpu.resilience.retry.retry`; application-level
+  rejections by the receiving handler (``ValueError`` for a torn
+  payload, ``MemoryError`` for a full pool) are NOT retried — they
+  re-raise natively so every consumer's existing degradation path
+  (monolithic fallback / cold prefill / skip warm) fires unchanged;
+- **per-peer circuit breaker** — a dead endpoint fast-fails new
+  sends (:class:`~apex_tpu.resilience.breaker.CircuitBreaker`)
+  instead of burning the full retry budget per transfer;
+- **exactly-once ingest** — each send carries a monotonic transfer
+  id; the receiver keeps a bounded :class:`ReceiverLedger` of
+  completed transfers, so a duplicated delivery (or a
+  retried-after-partial-ack transfer whose first attempt DID land)
+  returns the cached ack instead of double-importing blocks.
+
+The exactly-once argument, precisely: the ledger records a transfer
+id *only after* its handler returned (blocks imported, ack computed).
+A transfer that failed before the handler ran leaves no ledger entry,
+so its retry imports normally; a transfer whose ack was lost in
+flight finds its ledger entry on retry and returns the recorded ack
+without touching the handler — the import happened exactly once
+either way.  The ledger is bounded (``policy.dedup_window``), which
+is sound because transfer ids are monotonic and retries are bounded:
+a duplicate can only arrive within ``policy.attempts`` sends of the
+original, far inside the window.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+from ...resilience.breaker import CircuitBreaker
+from ...resilience.retry import RetryError, retry
+
+__all__ = [
+    "KVTransport",
+    "ReceiverLedger",
+    "TransportConnectionError",
+    "TransportError",
+    "TransportFrameError",
+    "TransportPolicy",
+    "TransportTimeoutError",
+]
+
+
+class TransportError(RuntimeError):
+    """A transfer failed at the TRANSPORT level (never an
+    application-level rejection — those re-raise natively as
+    ``ValueError``/``MemoryError`` so consumer degradation paths stay
+    unchanged)."""
+
+
+class TransportConnectionError(TransportError):
+    """Connection-class failure: refused, reset mid-frame, closed
+    before the ack.  Retried by the policy envelope."""
+
+
+class TransportTimeoutError(TransportError):
+    """The transfer stalled past its deadline.  NOT retried — the
+    deadline already bounds the whole send; the consumer degrades."""
+
+
+class TransportFrameError(TransportError):
+    """A malformed wire frame: bad magic, oversized, crc mismatch.
+    The receiving side closes the connection without ingesting
+    anything (torn frames are rejected whole, like torn payloads)."""
+
+
+@dataclass
+class TransportPolicy:
+    """The robustness envelope both backends run under.  Everything
+    time-like is injectable (``clock``/``sleep``/``rng``) so chaos
+    soaks and unit tests replay byte-identically with zero real
+    sleeping — the :func:`~apex_tpu.resilience.retry.retry`
+    convention."""
+
+    deadline_s: float = 5.0        # total wall budget per send
+    attempts: int = 3              # tries per send, incl. the first
+    backoff: float = 0.01          # decorrelated-jitter base delay
+    max_backoff: float = 0.25      # per-delay cap
+    breaker_failures: int = 3      # consecutive failures to open
+    breaker_recovery_s: float = 30.0
+    dedup_window: int = 256        # receiver ledger entries per peer
+    clock: Callable[[], float] = time.monotonic
+    sleep: Callable[[float], None] = time.sleep
+    rng: Optional[random.Random] = None   # default: seeded per transport
+
+
+class ReceiverLedger:
+    """Bounded memory of completed transfers — the receiver half of
+    exactly-once.  Records ``tid -> ack`` only for transfers whose
+    handler SUCCEEDED; a duplicate of a recorded tid is answered from
+    the ledger (``dedup_hits``) without re-running the handler."""
+
+    def __init__(self, window: int):
+        self.window = max(1, int(window))
+        self._acks: "OrderedDict[int, Any]" = OrderedDict()
+        self.dedup_hits = 0
+
+    def lookup(self, tid: int):
+        """``(hit, ack)`` — a hit counts toward ``dedup_hits``."""
+        if tid in self._acks:
+            self.dedup_hits += 1
+            return True, self._acks[tid]
+        return False, None
+
+    def record(self, tid: int, ack) -> None:
+        self._acks[tid] = ack
+        while len(self._acks) > self.window:
+            self._acks.popitem(last=False)
+
+    def __len__(self) -> int:
+        return len(self._acks)
+
+
+_PEER_COUNTER_KEYS = (
+    "attempts", "retries", "delivered", "rejects", "failures",
+    "deadline_exceeded", "breaker_fastfail", "ingested")
+
+
+@dataclass
+class _PeerState:
+    """Everything the envelope tracks per registered peer."""
+
+    name: str
+    handler: Optional[Callable[[dict, dict], Any]]
+    breaker: CircuitBreaker
+    ledger: ReceiverLedger
+    address: Optional[tuple] = None      # socket backend routes
+    counters: Dict[str, int] = field(default_factory=dict)
+
+    def __post_init__(self):
+        for k in _PEER_COUNTER_KEYS:
+            self.counters.setdefault(k, 0)
+
+
+class KVTransport:
+    """The backend-agnostic half: peer registry, transfer-id counter,
+    retry/deadline/breaker envelope, and the exactly-once receiver.
+    Subclasses implement :meth:`_deliver` (move one framed payload to
+    the peer and return its ack).
+
+    ``chaos`` is the fault-injection seam
+    (:class:`apex_tpu.resilience.chaos.ChaosTransport`): ``None`` (the
+    default) short-circuits to zero overhead and zero extra RNG draws,
+    so default-on transport is behavior-identical to the direct-call
+    path it replaced.
+    """
+
+    backend = "abstract"
+    # whether meta may carry non-serializable objects (journey
+    # contexts); only the in-process backend can
+    carries_objects = False
+
+    def __init__(self, policy: Optional[TransportPolicy] = None):
+        self.policy = policy or TransportPolicy()
+        # guards the peer registry, ledgers, and counters against the
+        # socket backend's server threads (lock-discipline scope,
+        # pyproject [tool.apexlint."lock-discipline"]); RLock because
+        # _dispatch -> _ingest nests
+        self._lock = threading.RLock()
+        self._peers: Dict[str, _PeerState] = {}
+        self._next_tid = 0
+        # retry jitter: seeded per transport, independent of global
+        # random state (the resilience/retry convention)
+        self._rng = self.policy.rng if self.policy.rng is not None \
+            else random.Random(0)
+        self.chaos = None
+
+    # -- registry ----------------------------------------------------------
+
+    def register_peer(self, name: str,
+                      handler: Callable[[dict, dict], Any]) -> None:
+        """Register a locally-served peer: ``handler(meta, payload)``
+        ingests one transfer and returns its ack.  Handler exceptions
+        are application-level: they propagate to the sender natively
+        and are never cached in the dedup ledger."""
+        pol = self.policy
+        with self._lock:
+            self._peers[name] = _PeerState(
+                name=name, handler=handler,
+                breaker=CircuitBreaker(
+                    failure_threshold=pol.breaker_failures,
+                    recovery_time=pol.breaker_recovery_s,
+                    clock=pol.clock),
+                ledger=ReceiverLedger(pol.dedup_window))
+
+    def peers(self):
+        with self._lock:
+            return sorted(self._peers)
+
+    # -- the send envelope -------------------------------------------------
+
+    def send(self, peer: str, meta: dict, payload: dict):
+        """Move one checksummed payload to ``peer`` under the policy
+        envelope; returns the peer handler's ack.
+
+        Raises :class:`TransportError` subclasses for transport-level
+        failures (after retries / deadline / breaker), and re-raises
+        the handler's ``ValueError``/``MemoryError`` natively so the
+        consumer's torn-payload and at-capacity degradation paths are
+        indistinguishable from the direct-call era."""
+        with self._lock:
+            st = self._peers.get(peer)
+            if st is None:
+                raise TransportError(
+                    f"unknown transport peer {peer!r} "
+                    f"(registered: {sorted(self._peers)})")
+            tid = self._next_tid
+            self._next_tid += 1
+        if not st.breaker.allow():
+            with self._lock:
+                st.counters["breaker_fastfail"] += 1
+                st.counters["failures"] += 1
+            raise TransportConnectionError(
+                f"peer {peer!r} circuit open — transfer {tid} "
+                f"fast-failed (degrade, don't wait)")
+        plan = self.chaos.plan_send(peer) if self.chaos is not None \
+            else None
+        pol = self.policy
+
+        def _attempt():
+            with self._lock:
+                st.counters["attempts"] += 1
+            p = payload
+            if plan is not None:
+                p = plan.before(p)       # may raise / corrupt a copy
+            ack = self._deliver(st, tid, meta, p)
+            if plan is not None:
+                plan.after(lambda: self._deliver(st, tid, meta,
+                                                 payload))
+            return ack
+
+        def _on_retry(attempt, err):
+            with self._lock:
+                st.counters["retries"] += 1
+
+        try:
+            ack = retry(_attempt,
+                        attempts=pol.attempts,
+                        backoff=pol.backoff,
+                        max_backoff=pol.max_backoff,
+                        deadline=pol.deadline_s,
+                        retry_on=(TransportConnectionError,),
+                        sleep=pol.sleep, clock=pol.clock,
+                        rng=self._rng, on_retry=_on_retry)
+        except (ValueError, MemoryError):
+            # application-level rejection: the peer answered, so it is
+            # HEALTHY — the payload (or its capacity) is the problem
+            st.breaker.record_success()
+            with self._lock:
+                st.counters["rejects"] += 1
+            raise
+        except TransportTimeoutError:
+            st.breaker.record_failure()
+            with self._lock:
+                st.counters["deadline_exceeded"] += 1
+                st.counters["failures"] += 1
+            raise
+        except RetryError as e:
+            st.breaker.record_failure()
+            with self._lock:
+                st.counters["failures"] += 1
+            raise TransportConnectionError(
+                f"transfer {tid} to {peer!r} failed: {e}") from e
+        except TransportError:
+            st.breaker.record_failure()
+            with self._lock:
+                st.counters["failures"] += 1
+            raise
+        st.breaker.record_success()
+        with self._lock:
+            st.counters["delivered"] += 1
+        return ack
+
+    # -- the receive side --------------------------------------------------
+
+    def _ingest(self, st: _PeerState, tid: int, meta: dict,
+                payload: dict):
+        """Exactly-once ingest: answer duplicates from the ledger,
+        otherwise run the handler and record its ack.  Handler
+        exceptions are NOT recorded — the transfer did not happen, so
+        its retry must import for real."""
+        with self._lock:
+            hit, cached = st.ledger.lookup(tid)
+            if hit:
+                return cached
+            if st.handler is None:
+                raise TransportError(
+                    f"peer {st.name!r} has no local handler")
+            ack = st.handler(meta, payload)
+            st.counters["ingested"] += 1
+            st.ledger.record(tid, ack)
+            return ack
+
+    def _deliver(self, st: _PeerState, tid: int, meta: dict,
+                 payload: dict):
+        raise NotImplementedError
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> dict:
+        """The pinned ``stats()["transport"]`` shape
+        (``docs/serving.md``, "KV transport"): aggregate counters plus
+        a per-peer table with breaker state.  Key set is shape-stable
+        — dashboards and ``ops_probe --transport`` rely on it."""
+        with self._lock:
+            totals = {k: 0 for k in _PEER_COUNTER_KEYS}
+            totals["dedup_hits"] = 0
+            per_peer = {}
+            for name, st in sorted(self._peers.items()):
+                row = dict(st.counters)
+                row["dedup_hits"] = st.ledger.dedup_hits
+                row["breaker"] = st.breaker.state
+                per_peer[name] = row
+                for k in _PEER_COUNTER_KEYS:
+                    totals[k] += st.counters[k]
+                totals["dedup_hits"] += st.ledger.dedup_hits
+            out = {"backend": self.backend, "peers": len(per_peer)}
+            out.update(totals)
+            out["per_peer"] = per_peer
+            return out
+
+    def close(self) -> None:
+        """Release backend resources (the socket backend's server
+        thread); the in-process backend has none."""

@@ -1,0 +1,34 @@
+"""The in-process KV transport backend — the historical direct copy.
+
+``_deliver`` is a function call into the receiver's handler on the
+sender's thread: no serialization, no copy, no extra RNG draws, and
+(with ``chaos=None``) not a single branch the direct-call era didn't
+take — which is why it is the DEFAULT backend everywhere and why the
+legacy seed-0 chaos soak reports stay byte-identical with transport
+on (``docs/serving.md``, "KV transport").
+
+It still runs the full :class:`~.base.TransportPolicy` envelope —
+deadline, bounded retry, per-peer breaker, exactly-once dedup ledger
+— so the fault model is testable without a socket: the chaos plane
+injects resets/stalls/duplicates at the ``_deliver`` seam and every
+consumer's degradation path exercises for real.
+"""
+
+from __future__ import annotations
+
+from .base import KVTransport
+
+__all__ = ["InProcessTransport"]
+
+
+class InProcessTransport(KVTransport):
+    """Direct-call backend: ``send`` == ``handler(meta, payload)``
+    under the policy envelope.  ``carries_objects`` is True — meta may
+    carry live objects (journey contexts) because nothing is ever
+    serialized."""
+
+    backend = "inprocess"
+    carries_objects = True
+
+    def _deliver(self, st, tid, meta, payload):
+        return self._ingest(st, tid, meta, payload)

@@ -539,3 +539,29 @@ def test_server_parity_disagg_prefill_pool_is_cache_home(tiny):
     off = _server(cfg, params, False, 13)
     want = _session_traffic(off, prompts)
     _assert_parity(got, want, "disagg")
+
+
+def test_promote_with_nothing_to_promote_is_clean():
+    """A promote walk that finds nothing — store miss on the first
+    missing chunk, or a run the device tier already fully matched —
+    returns 0 WITHOUT allocating, importing, or a spurious
+    ``capacity_skips`` (an empty block list is a no-op, not a
+    failure)."""
+    cache, alloc, store, off, tokens = _chain_fixture()
+    free_before = alloc.num_free
+    # chunks that were never demoted: the store probe misses at once
+    cold = [100 + t for t in range(len(tokens))]
+    matched = []
+    assert cache.promote(cold, matched, alloc.alloc) == 0
+    assert matched == []
+    assert off.count("capacity_skips") == 0, \
+        "an empty walk is not an at-capacity skip"
+    assert off.count("crc_rejects") == 0
+    assert alloc.num_free == free_before, \
+        "no device blocks may be reserved for an empty run"
+    # a run the device tier already covers short-circuits the same way
+    full = list(range(len(tokens) // 4))
+    assert cache.promote(tokens, full, alloc.alloc) == 0
+    assert off.count("capacity_skips") == 0
+    assert len(store) == 2               # payloads untouched
+    cache.audit()

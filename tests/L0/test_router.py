@@ -541,7 +541,7 @@ def test_pinned_router_stats_block(tiny):
         "router", "requests_finished", "requests_unplaced",
         "tokens_generated", "prefix_hit_tokens", "prefix_miss_tokens",
         "prefix_hit_rate", "pressure", "pressure_peak", "draining",
-        "streams", "elastic", "journeys"}
+        "streams", "elastic", "journeys", "transport"}
     # journeys OFF: the census stays shape-stable but reads disabled
     assert st["journeys"]["enabled"] is False
     assert st["journeys"]["started"] == 0
@@ -555,13 +555,23 @@ def test_pinned_router_stats_block(tiny):
         "replicas", "alive", "policy", "placements", "affinity",
         "reenqueued", "failovers", "replica_failed", "unplaced",
         "handoffs", "handoff_fallback", "handoff_torn",
-        "handoff_kept_local", "disagg_prefill_threshold",
+        "handoff_kept_local", "handoff_transport_failed",
+        "handoff_cancelled", "disagg_prefill_threshold",
         "per_replica", "steps", "threaded"}
     assert set(r["policy"]) == {"kind", "spill_threshold",
                                 "affinity_block", "index_entries"}
     assert set(r["affinity"]) == {"hits", "misses", "spills", "dead",
                                   "hit_rate"}
     assert r["replicas"] == 1 and r["alive"] == 1
+    # KV transport: backend-tagged, one peer per replica, envelope
+    # totals present (zero on an idle in-process fleet)
+    t = st["transport"]
+    assert t["backend"] == "inprocess"
+    assert t["peers"] == 1
+    assert "replica0" in t["per_peer"]
+    for key in ("attempts", "retries", "delivered", "failures",
+                "dedup_hits", "deadline_exceeded", "breaker_fastfail"):
+        assert t[key] == 0
     assert st["requests_finished"] == 2
     assert st["tokens_generated"] == 2 * 6
     row = r["per_replica"]["replica0"]

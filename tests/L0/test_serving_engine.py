@@ -265,6 +265,18 @@ def test_stats_keys_are_backward_compatible(tiny):
     assert not off - st["offload"].keys(), \
         f"stats() lost offload keys: {off - st['offload'].keys()}"
     assert st["offload"]["enabled"] is False       # off by default
+    assert st["offload"]["transport_skips"] == 0
+    # KV transport block (docs/serving.md, "KV transport"): pinned
+    # even on the default in-process backend — ops_probe --transport
+    # and the chaos soak's envelope invariants key on these
+    tr = {"backend", "peers", "attempts", "retries", "delivered",
+          "rejects", "failures", "deadline_exceeded",
+          "breaker_fastfail", "ingested", "dedup_hits", "per_peer"}
+    assert not tr - st["transport"].keys(), \
+        f"stats() lost transport keys: {tr - st['transport'].keys()}"
+    assert st["transport"]["backend"] == "inprocess"
+    assert "offload" in st["transport"]["per_peer"]
+    assert st["transport"]["per_peer"]["offload"]["breaker"] == "closed"
     # evictable bytes price the cold reclaimable tier of the device
     # pool (blocks_evictable * bytes_per_block) — the offload bench
     # and ops_probe --offload render this

@@ -638,3 +638,59 @@ def test_sse_stream_error_statuses(tiny):
         assert code == 409
     finally:
         off.close()
+
+
+# -- cancel racing an in-flight cross-replica hand-off ---------------------
+
+
+def test_cancel_racing_inflight_handoff_leaks_nothing(tiny):
+    """A client hang-up that lands WHILE the router is shipping the
+    request's KV to a decode replica: the prefill side terminalizes
+    ``cancelled`` (freeing its blocks on the standard fail path), the
+    freshly-ingested decode half is cancelled on the target (freeing
+    the imported blocks), ``handoff_cancelled`` counts the race — and
+    every OTHER stream is untouched, bit-identical to the monolithic
+    baseline.  Audit-clean on every replica; nothing leaks on either
+    side of the transfer."""
+    cfg, params = tiny
+    rng = np.random.RandomState(21)
+    prompts = [list(rng.randint(0, VOCAB, size=60)) for _ in range(4)]
+    want = _server(cfg, params).generate(prompts, max_new_tokens=8,
+                                         eos_id=7)
+    fleet = RouterFleet(cfg, params, replicas=2, disagg_prefill=1,
+                        max_batch_size=4, max_context=128,
+                        block_size=8, cache_dtype=jnp.float32)
+    router = fleet.router
+    real = router._handoff_request
+    raced = {}
+
+    def racing(rep, req, payload):
+        if not raced:
+            raced["prompt"] = list(req.prompt)
+            assert rep.server.cancel(req.uid) is True, \
+                "the in-flight request must still be cancellable"
+        return real(rep, req, payload)
+
+    router._handoff_request = racing
+    try:
+        got = fleet.generate(prompts, max_new_tokens=8, eos_id=7)
+        assert raced, "no hand-off fired — the race never armed"
+        r = fleet.stats()["router"]
+        assert r["handoff_cancelled"] >= 1, \
+            "the raced transfer must be accounted as cancelled"
+        cancelled = sum(
+            rep.server.failures.count("requests_failed_cancelled")
+            for rep in fleet.replicas)
+        assert cancelled >= 1
+        idx = prompts.index(raced["prompt"])
+        for i, (g, w) in enumerate(zip(got, want)):
+            if i != idx:
+                assert g == w, \
+                    f"stream {i} must be untouched by the race"
+        # nothing leaks on either side: every replica audit-clean
+        # with no stranded work
+        for rep in fleet.replicas:
+            assert not rep.server.has_work
+            rep.server.audit()
+    finally:
+        fleet.close()

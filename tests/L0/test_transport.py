@@ -1,0 +1,823 @@
+"""KV transport: retrying, deadline-bounded, exactly-once block
+movement (docs/serving.md, "KV transport").
+
+Three layers of pinning:
+
+- **frame codec** — the socket backend's wire format: split reads
+  across frame boundaries reassemble, oversized declared lengths are
+  rejected with a messaged error and NOTHING partially ingested, crc
+  mismatches reject the frame whole, manifests must tile the body
+  exactly;
+- **policy envelope** — backend-agnostic send semantics on the
+  in-process backend with injected clock/sleep: transport-class
+  failures retry and land, stalls degrade without retrying,
+  application-level rejections (``ValueError``/``MemoryError``)
+  re-raise natively and never trip the breaker, a dead peer fast
+  fails through the open breaker, duplicated transfer ids answer
+  from the dedup ledger without re-running the handler;
+- **backend parity** — the headline oracle: the socket backend moves
+  the same bytes the in-process backend moves, leaf-for-leaf, int8
+  scale sidecars included, and a full disagg fleet over loopback TCP
+  generates token-for-token what the monolithic engine generates.
+"""
+
+import socket
+import types
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import models
+from apex_tpu.resilience.chaos import ChaosTransport, _TransportFaultPlan
+from apex_tpu.serving import InferenceServer, RouterFleet
+from apex_tpu.serving.transport import (
+    FrameReader,
+    InProcessTransport,
+    MAX_FRAME_BYTES,
+    ReceiverLedger,
+    SocketTransport,
+    TransportConnectionError,
+    TransportError,
+    TransportFrameError,
+    TransportPolicy,
+    TransportTimeoutError,
+    decode_payload,
+    encode_frame,
+    encode_payload,
+)
+from apex_tpu.serving.transport.sockets import KIND_ACK, KIND_REQ
+
+pytestmark = pytest.mark.serving
+
+VOCAB = 61
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = models.GPTConfig(
+        vocab_size=VOCAB, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+    m = models.GPTLMHeadModel(cfg)
+    params = m.init(jax.random.PRNGKey(1),
+                    jnp.ones((1, 8), jnp.int32))["params"]
+    return cfg, params
+
+
+def _server(cfg, params, **kw):
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("max_context", 64)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("cache_dtype", jnp.float32)
+    return InferenceServer(cfg, params, **kw)
+
+
+def _payload(seed=0, blocks=3, bs=4):
+    """A synthetic export_blocks payload: float leaves plus an int8
+    scale sidecar (every leaf must ride), true per-leaf crcs."""
+    rng = np.random.RandomState(seed)
+    leaves = {
+        "k0": rng.rand(2, blocks * bs, 3).astype(np.float32),
+        "v0": rng.rand(2, blocks * bs, 3).astype(np.float32),
+        "k0_scale": rng.randint(-128, 127, size=(2, blocks * bs),
+                                dtype=np.int8),
+    }
+    return {"num_blocks": blocks, "block_size": bs, "leaves": leaves,
+            "crc": {n: zlib.crc32(np.ascontiguousarray(a).tobytes())
+                    for n, a in leaves.items()}}
+
+
+def _crc_checking_handler(calls):
+    """The consumer-shaped sink: verifies the payload checksums like
+    ``import_blocks`` does and raises ``ValueError`` on a torn
+    payload; records each ingested payload in ``calls``."""
+    def handler(meta, payload):
+        for name, arr in payload["leaves"].items():
+            got = zlib.crc32(np.ascontiguousarray(arr).tobytes())
+            if got != payload["crc"][name]:
+                raise ValueError(
+                    f"torn hand-off payload: leaf {name!r}; payload "
+                    f"rejected whole")
+        calls.append(payload)
+        return {"n": int(payload["num_blocks"])}
+    return handler
+
+
+class _Clock:
+    """Injected monotonic time: ``sleep`` advances it, nothing ever
+    really waits."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+
+def _policy(clock=None, **kw):
+    clock = clock or _Clock()
+    kw.setdefault("deadline_s", 10.0)
+    return TransportPolicy(clock=clock, sleep=clock.sleep, **kw)
+
+
+class _Chaos:
+    """A hand-armed chaos seam: pops one scripted fault plan per
+    send, exactly the ``ChaosTransport.plan_send`` contract."""
+
+    KEYS = ("transport_reset", "transport_reset_after",
+            "transport_stall", "transport_dup", "transport_corrupt")
+
+    def __init__(self, kinds):
+        self.kinds = list(kinds)
+        self.injected = {k: 0 for k in self.KEYS}
+
+    def plan_send(self, peer):
+        if not self.kinds:
+            return None
+        return _TransportFaultPlan(self.kinds.pop(0), self.injected)
+
+
+class _Flaky(InProcessTransport):
+    """In-process backend whose wire fails ``fail`` times before
+    recovering — the transport-level (not handler-level) fault."""
+
+    def __init__(self, policy, fail=0, exc=TransportConnectionError):
+        super().__init__(policy)
+        self.fail = fail
+        self.exc = exc
+
+    def _deliver(self, st, tid, meta, payload):
+        if self.fail:
+            self.fail -= 1
+            raise self.exc("injected wire fault")
+        return super()._deliver(st, tid, meta, payload)
+
+
+# -- frame codec -----------------------------------------------------------
+
+def test_frame_roundtrip_split_reads_byte_by_byte():
+    """A frame fed one byte at a time reassembles exactly once, at
+    the final byte — the incremental-parser contract."""
+    frame = encode_frame(KIND_REQ, {"peer": "p", "tid": 0},
+                         b"\x01\x02\x03")
+    reader = FrameReader()
+    for b in frame[:-1]:
+        assert reader.feed(bytes([b])) == []
+    frames = reader.feed(frame[-1:])
+    assert frames == [(KIND_REQ, {"peer": "p", "tid": 0},
+                       b"\x01\x02\x03")]
+
+
+def test_frame_reader_handles_two_frames_one_feed():
+    a = encode_frame(KIND_REQ, {"tid": 1}, b"a")
+    b = encode_frame(KIND_ACK, {"tid": 1, "ack": None}, b"")
+    frames = FrameReader().feed(a + b)
+    assert [f[0] for f in frames] == [KIND_REQ, KIND_ACK]
+    assert frames[0][2] == b"a"
+
+
+def test_frame_split_across_frame_boundary():
+    """A read that ends mid-second-frame yields the first frame and
+    buffers the partial remainder."""
+    a = encode_frame(KIND_REQ, {"tid": 1}, b"aaaa")
+    b = encode_frame(KIND_REQ, {"tid": 2}, b"bbbb")
+    reader = FrameReader()
+    frames = reader.feed(a + b[:7])
+    assert [h["tid"] for _, h, _ in frames] == [1]
+    frames = reader.feed(b[7:])
+    assert [h["tid"] for _, h, _ in frames] == [2]
+
+
+def test_frame_oversized_rejected_with_messaged_error():
+    reader = FrameReader(max_frame_bytes=64)
+    frame = encode_frame(KIND_REQ, {"tid": 0}, b"x" * 256)
+    with pytest.raises(TransportFrameError) as ei:
+        reader.feed(frame)
+    msg = str(ei.value)
+    assert "64-byte ceiling" in msg
+    assert "rejected whole" in msg
+
+
+def test_frame_crc_mismatch_rejected_whole():
+    frame = bytearray(encode_frame(KIND_REQ, {"tid": 0}, b"payload"))
+    frame[-1] ^= 0xFF                     # torn in flight
+    with pytest.raises(TransportFrameError, match="crc mismatch"):
+        FrameReader().feed(bytes(frame))
+
+
+def test_frame_bad_magic_and_version_rejected():
+    frame = bytearray(encode_frame(KIND_REQ, {"tid": 0}, b""))
+    bad_magic = bytes(frame)
+    bad_magic = b"XXXX" + bad_magic[4:]
+    with pytest.raises(TransportFrameError, match="magic"):
+        FrameReader().feed(bad_magic)
+    frame[4] = 99                         # version byte
+    with pytest.raises(TransportFrameError, match="version"):
+        FrameReader().feed(bytes(frame))
+
+
+def test_frame_header_must_be_json_serializable():
+    with pytest.raises(TransportError, match="JSON-serializable"):
+        encode_frame(KIND_REQ, {"obj": object()})
+
+
+def test_payload_codec_round_trips_every_leaf():
+    """encode/decode round-trips all leaves — dtypes, shapes, the
+    int8 scale sidecar, and the crc dict — bit-exactly."""
+    p = _payload(3)
+    fields, body = encode_payload(p)
+    back = decode_payload(dict(fields), body)
+    assert back["num_blocks"] == p["num_blocks"]
+    assert back["block_size"] == p["block_size"]
+    assert sorted(back["leaves"]) == sorted(p["leaves"])
+    for name, arr in p["leaves"].items():
+        assert back["leaves"][name].dtype == arr.dtype
+        assert np.array_equal(back["leaves"][name], arr)
+    assert back["crc"] == p["crc"]
+
+
+def test_payload_codec_carries_block_crc_sidecar():
+    p = _payload(4)
+    p["block_crc"] = {"k0": [1, 2, 3]}
+    fields, body = encode_payload(p)
+    assert decode_payload(dict(fields), body)["block_crc"] == \
+        {"k0": [1, 2, 3]}
+
+
+def test_payload_codec_round_trips_bfloat16_leaves():
+    """bfloat16 — the DEFAULT cache dtype — registers as a numpy void
+    record whose ``.str`` is ``<V2``; the manifest must carry it by
+    NAME so the far side rebuilds a numeric array, not raw void bytes
+    that ``jax.device_put`` rejects."""
+    import ml_dtypes
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    rng = np.random.RandomState(9)
+    arr = rng.randn(2, 3, 8, 4).astype(np.float32).astype(bf16)
+    p = {"num_blocks": 2, "block_size": 8,
+         "leaves": {"k0": arr},
+         "crc": {"k0": zlib.crc32(arr.tobytes())}}
+    fields, body = encode_payload(p)
+    tag = [row[1] for row in fields["manifest"] if row[0] == "k0"][0]
+    assert tag == "bfloat16"              # by name, not "<V2"
+    back = decode_payload(dict(fields), body)
+    assert back["leaves"]["k0"].dtype == bf16
+    assert np.array_equal(back["leaves"]["k0"], arr)
+
+
+def test_payload_unknown_dtype_tag_is_frame_error():
+    p = _payload(8, blocks=1)
+    fields, body = encode_payload(p)
+    fields = dict(fields)
+    fields["manifest"] = [list(r) for r in fields["manifest"]]
+    fields["manifest"][0][1] = "float7_e9"
+    with pytest.raises(TransportFrameError, match="unknown leaf dtype"):
+        decode_payload(fields, body)
+
+
+def test_payload_manifest_overrun_and_trailing_bytes_rejected():
+    p = _payload(5)
+    fields, body = encode_payload(p)
+    with pytest.raises(TransportFrameError, match="overruns"):
+        decode_payload(dict(fields), body[:-4])
+    with pytest.raises(TransportFrameError, match="trailing"):
+        decode_payload(dict(fields), body + b"\x00\x00")
+
+
+# -- policy envelope (in-process backend, injected time) -------------------
+
+def test_send_delivers_and_counts():
+    t = InProcessTransport(_policy())
+    calls = []
+    t.register_peer("sink", _crc_checking_handler(calls))
+    ack = t.send("sink", {"op": "test"}, _payload())
+    assert ack == {"n": 3}
+    assert len(calls) == 1
+    s = t.stats()
+    assert s["backend"] == "inprocess"
+    assert (s["attempts"], s["delivered"], s["ingested"]) == (1, 1, 1)
+    assert s["failures"] == s["rejects"] == s["dedup_hits"] == 0
+
+
+def test_unknown_peer_is_messaged():
+    t = InProcessTransport(_policy())
+    t.register_peer("a", lambda m, p: None)
+    with pytest.raises(TransportError, match="unknown transport peer"):
+        t.send("b", {}, _payload())
+
+
+def test_reset_is_retried_and_lands_exactly_once():
+    """A connection reset before ingest retries through the envelope;
+    the retry lands and the handler ran exactly once."""
+    t = InProcessTransport(_policy())
+    chaos = _Chaos(["reset"])
+    t.chaos = chaos
+    calls = []
+    t.register_peer("sink", _crc_checking_handler(calls))
+    assert t.send("sink", {}, _payload()) == {"n": 3}
+    assert len(calls) == 1
+    assert chaos.injected["transport_reset"] == 1
+    s = t.stats()
+    assert (s["attempts"], s["retries"], s["delivered"]) == (2, 1, 1)
+    assert s["ingested"] == 1 and s["dedup_hits"] == 0
+
+
+def test_reset_after_dispatch_dedups_on_retry():
+    """The HARD exactly-once case: the handler ran but the ack died
+    on the wire.  The retry must answer from the receiver ledger —
+    one ingest, one dedup hit, zero double-imported blocks."""
+    t = InProcessTransport(_policy())
+    chaos = _Chaos(["reset_after"])
+    t.chaos = chaos
+    calls = []
+    t.register_peer("sink", _crc_checking_handler(calls))
+    assert t.send("sink", {}, _payload()) == {"n": 3}
+    assert len(calls) == 1, "retry must not re-run the handler"
+    s = t.stats()
+    assert s["dedup_hits"] == 1
+    assert (s["ingested"], s["retries"], s["delivered"]) == (1, 1, 1)
+
+
+def test_duplicate_delivery_answered_from_ledger():
+    t = InProcessTransport(_policy())
+    t.chaos = _Chaos(["dup"])
+    calls = []
+    t.register_peer("sink", _crc_checking_handler(calls))
+    assert t.send("sink", {}, _payload()) == {"n": 3}
+    assert len(calls) == 1
+    s = t.stats()
+    assert s["dedup_hits"] == 1 and s["ingested"] == 1
+    assert s["retries"] == 0              # a dup is not a retry
+
+
+def test_stall_degrades_without_retry():
+    t = InProcessTransport(_policy())
+    t.chaos = _Chaos(["stall"])
+    calls = []
+    t.register_peer("sink", _crc_checking_handler(calls))
+    with pytest.raises(TransportTimeoutError):
+        t.send("sink", {}, _payload())
+    assert calls == []
+    s = t.stats()
+    assert (s["attempts"], s["retries"]) == (1, 0)
+    assert s["deadline_exceeded"] == 1 and s["failures"] == 1
+
+
+def test_corrupt_in_flight_rejected_whole_as_native_valueerror():
+    """A byte flipped after the crc was recorded: the crc-checking
+    sink rejects WHOLE with a native ValueError — not retried, not a
+    breaker failure (the peer is healthy; the payload is not)."""
+    t = InProcessTransport(_policy())
+    t.chaos = _Chaos(["corrupt"])
+    calls = []
+    t.register_peer("sink", _crc_checking_handler(calls))
+    with pytest.raises(ValueError, match="rejected whole"):
+        t.send("sink", {}, _payload())
+    assert calls == []
+    s = t.stats()
+    assert s["rejects"] == 1 and s["failures"] == 0
+    assert s["per_peer"]["sink"]["breaker"] == "closed"
+    # the peer stays usable: a clean send goes straight through
+    assert t.send("sink", {}, _payload()) == {"n": 3}
+
+
+def test_memoryerror_reraises_natively_unretried():
+    t = InProcessTransport(_policy())
+
+    def full(meta, payload):
+        raise MemoryError("pool full")
+
+    t.register_peer("sink", full)
+    with pytest.raises(MemoryError, match="pool full"):
+        t.send("sink", {}, _payload())
+    s = t.stats()
+    assert (s["attempts"], s["rejects"]) == (1, 1)
+    assert s["per_peer"]["sink"]["breaker"] == "closed"
+
+
+def test_rejected_transfer_is_not_cached_in_the_ledger():
+    """A handler exception leaves no ledger entry, so its retry (a
+    NEW send here) imports for real — rejection is not completion."""
+    t = InProcessTransport(_policy())
+    state = {"fail": True}
+    calls = []
+
+    def flaky(meta, payload):
+        if state["fail"]:
+            state["fail"] = False
+            raise MemoryError("transient")
+        calls.append(payload)
+        return "ok"
+
+    t.register_peer("sink", flaky)
+    with pytest.raises(MemoryError):
+        t.send("sink", {}, _payload())
+    assert t.send("sink", {}, _payload()) == "ok"
+    assert len(calls) == 1
+    assert t.stats()["dedup_hits"] == 0
+
+
+def test_retry_exhaustion_wraps_as_connection_error():
+    clock = _Clock()
+    t = _Flaky(_policy(clock, attempts=3), fail=99)
+    t.register_peer("sink", lambda m, p: "ok")
+    with pytest.raises(TransportConnectionError, match="failed"):
+        t.send("sink", {}, _payload())
+    s = t.stats()
+    assert (s["attempts"], s["retries"], s["failures"]) == (3, 2, 1)
+
+
+def test_deadline_bounds_the_whole_send():
+    """The deadline caps ALL attempts: with backoff longer than the
+    budget the envelope gives up early instead of burning the full
+    attempt count."""
+    clock = _Clock()
+    t = _Flaky(_policy(clock, deadline_s=0.5, attempts=50,
+                       backoff=1.0, max_backoff=1.0), fail=99)
+    t.register_peer("sink", lambda m, p: "ok")
+    with pytest.raises(TransportConnectionError):
+        t.send("sink", {}, _payload())
+    s = t.stats()
+    assert s["attempts"] < 50
+    assert s["failures"] == 1
+
+
+def test_breaker_opens_then_fast_fails_then_recovers():
+    """Consecutive transport failures open the per-peer breaker; new
+    sends fast-fail WITHOUT an attempt; after the recovery window a
+    probe goes through and the peer heals."""
+    clock = _Clock()
+    t = _Flaky(_policy(clock, breaker_failures=2,
+                       breaker_recovery_s=30.0, attempts=1),
+               fail=2, exc=TransportTimeoutError)
+    t.register_peer("sink", lambda m, p: "ok")
+    for _ in range(2):
+        with pytest.raises(TransportTimeoutError):
+            t.send("sink", {}, _payload())
+    assert t.stats()["per_peer"]["sink"]["breaker"] == "open"
+    with pytest.raises(TransportConnectionError, match="circuit open"):
+        t.send("sink", {}, _payload())
+    s = t.stats()
+    assert s["breaker_fastfail"] == 1
+    assert s["attempts"] == 2, "fast-fail must not touch the wire"
+    clock.t += 31.0                       # past the recovery window
+    assert t.send("sink", {}, _payload()) == "ok"
+    assert t.stats()["delivered"] == 1
+
+
+def test_receiver_ledger_is_bounded():
+    led = ReceiverLedger(2)
+    for tid in (1, 2, 3):
+        led.record(tid, f"ack{tid}")
+    assert len(led) == 2
+    hit, ack = led.lookup(3)
+    assert hit and ack == "ack3" and led.dedup_hits == 1
+    hit, _ = led.lookup(1)                # evicted: a miss, not a hit
+    assert not hit and led.dedup_hits == 1
+
+
+def test_stats_shape_is_pinned():
+    """The ``stats()["transport"]`` key set dashboards and
+    ``ops_probe --transport`` rely on — shape-stable."""
+    t = InProcessTransport(_policy())
+    t.register_peer("sink", lambda m, p: None)
+    s = t.stats()
+    assert set(s) == {
+        "backend", "peers", "attempts", "retries", "delivered",
+        "rejects", "failures", "deadline_exceeded",
+        "breaker_fastfail", "ingested", "dedup_hits", "per_peer"}
+    assert set(s["per_peer"]["sink"]) == {
+        "attempts", "retries", "delivered", "rejects", "failures",
+        "deadline_exceeded", "breaker_fastfail", "ingested",
+        "dedup_hits", "breaker"}
+
+
+def test_chaos_transport_sticky_arming_fires_in_order():
+    """Armed fault kinds persist until a send consumes them (sends
+    are sparser than iterations); one fault per send, arming order;
+    ``None`` once the backlog is spent."""
+    sch = types.SimpleNamespace(
+        transport_reset_iters={0}, transport_reset_after_iters=set(),
+        transport_stall_iters=set(), transport_dup_iters={0, 1},
+        transport_corrupt_iters={1})
+    inj = {k: 0 for k in _Chaos.KEYS}
+    ct = ChaosTransport(sch, inj)
+    ct.begin_iter(0)
+    ct.begin_iter(1)
+    kinds = [ct.plan_send("p").kind for _ in range(4)]
+    assert kinds == ["reset", "dup", "dup", "corrupt"]
+    assert ct.plan_send("p") is None
+    assert sum(inj.values()) == 0, "arming alone fires nothing"
+
+
+# -- socket backend --------------------------------------------------------
+
+def test_socket_roundtrip_moves_every_leaf():
+    """register_peer on the socket backend loops back through the
+    real TCP listener: the handler receives bit-identical leaves
+    (int8 sidecar included) and its JSON ack returns to the sender."""
+    t = SocketTransport(_policy())
+    try:
+        calls = []
+        t.register_peer("sink", _crc_checking_handler(calls))
+        p = _payload(7)
+        assert t.send("sink", {"op": "warm"}, p) == {"n": 3}
+        assert len(calls) == 1
+        for name, arr in p["leaves"].items():
+            got = calls[0]["leaves"][name]
+            assert got.dtype == arr.dtype
+            assert np.array_equal(got, arr)
+        s = t.stats()
+        assert s["backend"] == "socket"
+        assert (s["delivered"], s["ingested"]) == (1, 1)
+        assert s["failures"] == 0
+    finally:
+        t.close()
+
+
+def test_socket_native_rejections_cross_the_wire():
+    """ValueError / MemoryError from the handler arrive at the
+    sender as their NATIVE types with the message intact — consumer
+    degradation paths cannot tell the backends apart."""
+    t = SocketTransport(_policy())
+    try:
+        def torn(meta, payload):
+            if meta["mode"] == "torn":
+                raise ValueError("torn hand-off payload: leaf 'k0'; "
+                                 "payload rejected whole")
+            raise MemoryError("pool at capacity")
+
+        t.register_peer("sink", torn)
+        with pytest.raises(ValueError, match="rejected whole"):
+            t.send("sink", {"mode": "torn"}, _payload())
+        with pytest.raises(MemoryError, match="at capacity"):
+            t.send("sink", {"mode": "oom"}, _payload())
+        s = t.stats()
+        assert s["rejects"] == 2 and s["failures"] == 0
+        assert s["per_peer"]["sink"]["breaker"] == "closed"
+    finally:
+        t.close()
+
+
+def test_socket_handler_crash_answers_error_not_silence():
+    """An UNEXPECTED handler exception (a bug, not a modeled
+    rejection) must answer the sender as a messaged ERR frame — not
+    kill the server thread and leave the sender waiting out its whole
+    deadline on a silent connection.  The connection stays usable."""
+    t = SocketTransport(_policy())
+    try:
+        def buggy(meta, payload):
+            if meta.get("mode") == "crash":
+                raise TypeError("Dtype |V2 is not a valid JAX array "
+                                "type")
+            return {"ok": True}
+
+        t.register_peer("sink", buggy)
+        with pytest.raises(TransportError, match="TypeError") as ei:
+            t.send("sink", {"mode": "crash"}, _payload())
+        assert not isinstance(
+            ei.value, (TransportTimeoutError, TransportConnectionError))
+        # same transport still serves the next transfer
+        assert t.send("sink", {"mode": "ok"}, _payload()) == {"ok": True}
+        s = t.stats()
+        assert s["delivered"] == 1 and s["deadline_exceeded"] == 0
+    finally:
+        t.close()
+
+
+def test_socket_moves_default_bf16_cache_leaves():
+    """The DEFAULT cache dtype is bfloat16: a payload of bf16 leaves
+    must land bit-exactly over the wire (regression: the manifest
+    used to carry ``<V2`` and the far side rebuilt void bytes)."""
+    import ml_dtypes
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    rng = np.random.RandomState(11)
+    arr = rng.randn(3, 2, 8, 4).astype(np.float32).astype(bf16)
+    p = {"num_blocks": 3, "block_size": 8,
+         "leaves": {"k0": arr},
+         "crc": {"k0": zlib.crc32(arr.tobytes())}}
+    t = SocketTransport(_policy())
+    try:
+        landed = []
+        t.register_peer("sink", lambda m, pl: landed.append(pl)
+                        or {"n": pl["num_blocks"]})
+        assert t.send("sink", {"op": "handoff"}, p) == {"n": 3}
+        got = landed[0]["leaves"]["k0"]
+        assert got.dtype == bf16
+        assert np.array_equal(got, arr)
+    finally:
+        t.close()
+
+
+def test_socket_oversized_frame_closes_with_nothing_ingested():
+    """A frame past the ceiling is refused WHOLE: the server answers
+    a messaged frame error, the handler never runs, and the send
+    surfaces as a (non-retried) transport failure."""
+    t = SocketTransport(_policy(), max_frame_bytes=4096)
+    try:
+        calls = []
+        t.register_peer("sink", _crc_checking_handler(calls))
+        big = _payload(1, blocks=64, bs=16)   # ~400 KB of leaves
+        with pytest.raises(TransportError, match="ceiling") as ei:
+            t.send("sink", {}, big)
+        assert not isinstance(ei.value, TransportConnectionError), \
+            "a deterministic frame reject must not burn retries"
+        assert calls == [], "nothing may partially ingest"
+        s = t.stats()
+        assert s["ingested"] == 0 and s["failures"] == 1
+    finally:
+        t.close()
+
+
+def test_socket_duplicate_tid_suppressed_over_the_wire():
+    """A duplicated delivery (same transfer id, second connection)
+    answers from the server-side ledger: one handler run."""
+    t = SocketTransport(_policy())
+    try:
+        t.chaos = _Chaos(["dup"])
+        calls = []
+        t.register_peer("sink", _crc_checking_handler(calls))
+        assert t.send("sink", {}, _payload()) == {"n": 3}
+        assert len(calls) == 1
+        assert t.stats()["dedup_hits"] == 1
+    finally:
+        t.close()
+
+
+def test_socket_routes_between_two_transports():
+    """The cross-process shape in miniature: transport A routes
+    ``sink`` to transport B's listener; B's handler ingests, B's
+    ledger dedups, A's envelope counts the delivery."""
+    a, b = SocketTransport(_policy()), SocketTransport(_policy())
+    try:
+        calls = []
+        b.register_peer("sink", _crc_checking_handler(calls))
+        a.register_route("sink", b.address)
+        p = _payload(9)
+        assert a.send("sink", {}, p) == {"n": 3}
+        assert len(calls) == 1
+        assert a.stats()["delivered"] == 1
+        assert b.stats()["ingested"] == 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_socket_connection_refused_retries_then_fails():
+    """A dead endpoint: every attempt is refused, the retry budget
+    burns, and the send fails connection-class (which then feeds the
+    breaker — the fast-fail path is pinned above)."""
+    dead = socket.create_server(("127.0.0.1", 0))
+    addr = dead.getsockname()
+    dead.close()
+    t = SocketTransport(_policy(attempts=3))
+    try:
+        t.register_route("sink", addr)
+        with pytest.raises(TransportConnectionError):
+            t.send("sink", {}, _payload())
+        s = t.stats()
+        assert (s["attempts"], s["retries"], s["failures"]) == (3, 2, 1)
+    finally:
+        t.close()
+
+
+# -- backend parity: the headline oracle -----------------------------------
+
+def _engine_sink(server, captured):
+    """The consumer-shaped ingest: reserve blocks, run the payload
+    through the real checksummed ``import_blocks``, re-export and
+    remember the landed bytes, ack the leaf crcs (JSON-able, so the
+    same handler serves both backends)."""
+    def handler(meta, payload):
+        ids = server.engine.allocator.alloc(int(meta["n"]))
+        if ids is None:
+            raise MemoryError("sink pool at capacity")
+        try:
+            server.engine.import_blocks(ids, payload)
+            back = server.engine.export_blocks(ids)
+        finally:
+            server.engine.allocator.free(ids)
+        captured.append(back)
+        return {"crc": {k: int(v) for k, v in back["crc"].items()}}
+    return handler
+
+
+def test_socket_matches_inprocess_byte_parity(tiny):
+    """The backend-parity oracle: KV exported from a server that
+    decoded real tokens, moved through BOTH backends into a second
+    server's pool, re-exported — every leaf byte-identical to the
+    source and to each other."""
+    cfg, params = tiny
+    src = _server(cfg, params)
+    rng = np.random.RandomState(11)
+    prompts = [list(rng.randint(0, VOCAB, size=12)) for _ in range(4)]
+    src.generate(prompts, max_new_tokens=12)   # real bytes in the pool
+    n = 6
+    ids = src.engine.allocator.alloc(n)
+    payload = src.engine.export_blocks(ids)
+    src.engine.allocator.free(ids)
+
+    landed = {}
+    for make in (InProcessTransport, SocketTransport):
+        sink = _server(cfg, params, num_blocks=3 * n)
+        captured = []
+        t = make(_policy())
+        try:
+            t.register_peer("sink", _engine_sink(sink, captured))
+            ack = t.send("sink", {"n": n}, payload)
+        finally:
+            t.close()
+        assert ack["crc"] == {k: int(v)
+                              for k, v in payload["crc"].items()}, \
+            f"{t.backend}: landed crcs must equal the source's"
+        assert t.stats()["failures"] == 0
+        landed[t.backend] = captured[0]
+
+    for name, arr in payload["leaves"].items():
+        for backend, back in landed.items():
+            assert np.array_equal(back["leaves"][name],
+                                  np.asarray(arr)), \
+                f"{backend}: leaf {name!r} must land bit-exactly"
+
+
+@pytest.mark.slow
+def test_fleet_handoff_over_socket_token_parity(tiny):
+    """End-to-end: a disagg fleet whose hand-offs ride loopback TCP
+    generates token-for-token what the monolithic engine generates —
+    the 64-token oracle on the socket backend."""
+    cfg, params = tiny
+    rng = np.random.RandomState(12)
+    longs = [list(rng.randint(0, VOCAB, size=30)) for _ in range(4)]
+    shorts = [list(rng.randint(0, VOCAB, size=5)) for _ in range(4)]
+    prompts = [p for pair in zip(longs, shorts) for p in pair]
+    want = _server(cfg, params, block_size=4).generate(
+        prompts, max_new_tokens=10, eos_id=7)
+    fleet = RouterFleet(cfg, params, replicas=3, disagg_prefill=1,
+                        max_batch_size=4, max_context=64,
+                        block_size=4, cache_dtype=jnp.float32,
+                        kv_transport=SocketTransport(_policy()))
+    try:
+        got = fleet.generate(prompts, max_new_tokens=10, eos_id=7)
+        assert got == want
+        st = fleet.stats()
+        assert st["transport"]["backend"] == "socket"
+        assert st["router"]["handoffs"] >= 1
+        assert st["transport"]["delivered"] >= \
+            st["router"]["handoffs"]
+        for rep in fleet.replicas:
+            rep.server.audit()
+    finally:
+        fleet.close()
+
+
+# -- empty transfers (satellite: no zero-shape launches) -------------------
+
+def test_empty_import_is_a_noop_not_a_zero_shape_launch(tiny):
+    """An empty (geometry-consistent) transfer must return cleanly
+    WITHOUT launching the scatter — the padded id list would
+    otherwise overwrite block 0's slots with zero bytes."""
+    cfg, params = tiny
+    server = _server(cfg, params)
+    server.generate([[1, 2, 3, 4, 5, 6, 7, 8, 9]], max_new_tokens=4)
+    eng = server.engine
+    before = {n: np.asarray(a).tobytes()
+              for n, a in eng.cache.items()}
+    empty = eng.export_blocks([])
+    assert empty["num_blocks"] == 0
+    eng.import_blocks([], empty)
+    after = {n: np.asarray(a).tobytes() for n, a in eng.cache.items()}
+    assert after == before, \
+        "an empty import must not touch a single pool byte"
+    # geometry still enforced: an empty id list cannot absorb a
+    # non-empty payload
+    with pytest.raises(ValueError, match="geometry mismatch"):
+        eng.import_blocks([], eng.export_blocks([1]))
+
+
+def test_empty_transfer_through_transport_is_clean(tiny):
+    """The transport path with zero blocks: delivered, ingested,
+    acked — no error, no retry, no dedup entry consumed wrongly."""
+    cfg, params = tiny
+    server = _server(cfg, params)
+    server.generate([[1, 2, 3, 4]], max_new_tokens=2)
+    captured = []
+    t = InProcessTransport(_policy())
+    t.register_peer("sink", _engine_sink(server, captured))
+    # alloc(0) is not the consumer shape; an empty transfer imports
+    # into an empty reservation
+    payload = server.engine.export_blocks([])
+
+    def empty_sink(meta, payload):
+        server.engine.import_blocks([], payload)
+        return {"blocks": 0}
+
+    t.register_peer("empty", empty_sink)
+    assert t.send("empty", {"blocks": []}, payload) == {"blocks": 0}
+    s = t.stats()
+    assert s["failures"] == 0 and s["rejects"] == 0

@@ -351,6 +351,36 @@ env JAX_PLATFORMS=cpu python -m pytest tests/L0/test_offload.py -q -x --no-heade
   && env JAX_PLATFORMS=cpu python tools/chaos_soak.py --seed 0 --iters 800 --kv-offload
 results[kv_offload]=$?
 
+# KV transport: the block-movement robustness axis (docs/serving.md,
+# "KV transport") — three gates:
+#   1. the L0 transport tier: the frame codec units (split reads
+#      across frame boundaries, oversized-frame messaged rejection
+#      with nothing partially ingested, crc-mismatch whole-rejection,
+#      manifest/body tiling), the policy-envelope units on injected
+#      clocks (reset retried-and-landed, stall degraded un-retried,
+#      breaker open -> fast-fail -> recovery, duplicate transfer ids
+#      answered from the dedup ledger, native ValueError/MemoryError
+#      pass-through), the socket-vs-inprocess byte-parity oracle, and
+#      the cancel-racing-hand-off leak regression (slow tier included
+#      — this axis owns the fleet-over-TCP token-parity gate);
+#   2. serving_bench --transport: blocks/s + hand-off-latency A/B
+#      across direct / in-process / socket arms — landed-crc parity
+#      on every arm ALWAYS, zero failures on the healthy loopback,
+#      >= 0.9x in-process-vs-direct no-regression floor
+#      (BENCH_serving_transport.json);
+#   3. an 800-iteration seed-0 chaos soak with the transport fault
+#      class armed (connection reset, reset-after-dispatch, stall
+#      past deadline, duplicated delivery, corrupt frame) over the
+#      offload-promote consumer — bit-exact replay vs the fault-free
+#      oracle plus the exactly-once reconciliations (dedup_hits ==
+#      injected duplicates, deadline_exceeded == injected stalls,
+#      transport_skips == transport failures).
+echo "=== build-matrix axis: transport ==="
+env JAX_PLATFORMS=cpu python -m pytest tests/L0/test_transport.py -q -x --no-header \
+  && env JAX_PLATFORMS=cpu python tools/serving_bench.py --smoke --transport --out - \
+  && env JAX_PLATFORMS=cpu python tools/chaos_soak.py --seed 0 --iters 800 --transport-faults
+results[transport]=$?
+
 # request journeys: the fleet-correlation axis (docs/observability.md,
 # "Request journeys & exemplars") — three gates under the emulated
 # 8-device mesh flags (the L0 tier's fleet tests route through a

@@ -52,6 +52,24 @@ replay oracle pins ``enable_kv_offload=False``, so bit-exact replay
 proves the offload tiers moved bytes, never tokens; legacy arms pin
 it ``False`` too, keeping their per-seed reports byte-identical.
 
+``--transport-faults`` soaks the generalized KV TRANSPORT layer
+(``docs/serving.md``, "KV transport"): implies ``--kv-offload`` (the
+offload promote path is the single-server transport consumer, so its
+resumed-session traffic is what generates sends) and arms all five
+transport fault classes on the server's ``KVTransport`` — connection
+reset before delivery (the bounded retry must land it), reset AFTER
+delivery (the retry must be absorbed exactly-once by the receiver's
+dedup ledger), stall past the per-transfer deadline (fails fast, the
+consumer degrades to its no-transport path), duplicated delivery
+(suppressed by transfer-id), and a corrupt frame (the checksummed
+ingest rejects the payload WHOLE).  ``run_soak`` then asserts the
+exact fingerprints: ``dedup_hits`` equals injected duplicates,
+``deadline_exceeded`` equals injected stalls, ``retries`` equals
+injected resets, the offload tier's ``transport_skips`` equals the
+transport's ``failures`` — and the bit-exact-replay invariant holds
+throughout, proving the fault envelope moved (or refused to move)
+bytes, never tokens.
+
 ``--streaming`` soaks the streaming delivery tier (``docs/serving.md``,
 "Streaming & cancellation"): every submitted request gets a per-token
 stream opened at submit and drained each iteration, the delivered
@@ -367,6 +385,20 @@ def main(argv=None) -> int:
                         "replay oracle pins enable_kv_offload=False, "
                         "so bit-exact replay proves the tiers moved "
                         "bytes, never tokens")
+    parser.add_argument("--transport-faults", dest="transport_faults",
+                        action="store_true",
+                        help="soak the generalized KV TRANSPORT layer "
+                        "(docs/serving.md, 'KV transport'): implies "
+                        "--kv-offload (promote is the single-server "
+                        "transport consumer) and arms all five "
+                        "transport fault classes — reset before/after "
+                        "delivery, stall past deadline, duplicated "
+                        "delivery, corrupt frame — asserting the "
+                        "exactly-once fingerprints (dedup_hits == "
+                        "injected duplicates, retries == injected "
+                        "resets, deadline_exceeded == injected "
+                        "stalls, offload transport_skips == transport "
+                        "failures) plus bit-exact replay throughout")
     parser.add_argument("--streaming", action="store_true",
                         help="soak the STREAMING delivery tier "
                         "(docs/serving.md, 'Streaming & "
@@ -498,6 +530,12 @@ def main(argv=None) -> int:
     if args.sampling:
         args.speculative = True
 
+    # the transport axis needs sends to fault: the offload promote
+    # path is the single-server transport consumer, so its resumed-
+    # session traffic (and tiny host tier) comes along for the ride
+    if args.transport_faults:
+        args.kv_offload = True
+
     mesh = None
     if args.tp:
         import jax
@@ -624,6 +662,17 @@ def main(argv=None) -> int:
         resume_rate=0.15 if args.kv_offload else 0.0,
         offload_torn_rate=0.03 if args.kv_offload else 0.0,
         offload_capacity_rate=0.03 if args.kv_offload else 0.0,
+        # --transport-faults arms all five transport fault classes on
+        # the server's KVTransport (promote sends); rates are per-
+        # iteration arm probabilities — a fault only FIRES (and only
+        # counts) if a send happens that iteration
+        transport_reset_rate=0.03 if args.transport_faults else 0.0,
+        transport_reset_after_rate=(
+            0.02 if args.transport_faults else 0.0),
+        transport_stall_rate=0.02 if args.transport_faults else 0.0,
+        transport_dup_rate=0.03 if args.transport_faults else 0.0,
+        transport_corrupt_rate=(
+            0.02 if args.transport_faults else 0.0),
         force_violation_iter=args.force_violation)
     t0 = time.perf_counter()
     report = run_soak(make_server, chaos_cfg, args.seed,
@@ -636,6 +685,7 @@ def main(argv=None) -> int:
     report["disagg_mode"] = bool(args.disagg)
     report["streaming_mode"] = bool(args.streaming)
     report["kv_offload_mode"] = bool(args.kv_offload)
+    report["transport_faults_mode"] = bool(args.transport_faults)
 
     line = json.dumps(report, indent=2, sort_keys=True)
     if args.out == "-":

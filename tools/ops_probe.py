@@ -49,6 +49,18 @@ Pure stdlib, so it runs anywhere a shell does:
     disabled: a capacity dashboard wired to this view must never
     silently watch a store that is not running.
 
+``--transport``
+    Render the KV transport layer's ``/statusz`` block
+    (``docs/serving.md``, "KV transport"): the backend name, the
+    transport-wide totals (attempts / retries / delivered / rejects /
+    failures / deadline_exceeded / breaker_fastfail / ingested /
+    dedup_hits), and a per-peer table with each peer's counters plus
+    its circuit-breaker state — which destination is being retried
+    into, which one's breaker is open, and whether the receiver's
+    dedup ledger is absorbing replays.  A server without the
+    transport block FAILs (exit 1): a transfer dashboard wired to
+    this view must never silently watch a layer that is not there.
+
 ``--journeys``
     Render the journey plane's ``/statusz`` census
     (``docs/observability.md``, "Request journeys & exemplars"):
@@ -342,6 +354,49 @@ def render_offload(stats) -> int:
     return 0
 
 
+def render_transport(stats) -> int:
+    """The KV-transport view: backend + totals + per-peer counter/
+    breaker table (``stats()["transport"]``, docs/serving.md "KV
+    transport").  A missing block means the endpoint predates the
+    transport layer — that gates: every server owns a transport (the
+    in-process backend is the default), so its absence is a version
+    skew, not a disabled feature."""
+    tr = stats.get("transport")
+    if tr is None:
+        print("FAIL: /statusz has no 'transport' block (server "
+              "predates the KV transport layer?)", file=sys.stderr)
+        return 1
+    print(f"transport: backend={tr.get('backend')} "
+          f"peers={tr.get('peers')} attempts={tr.get('attempts')} "
+          f"retries={tr.get('retries')} "
+          f"delivered={tr.get('delivered')} "
+          f"ingested={tr.get('ingested')} "
+          f"dedup_hits={tr.get('dedup_hits')}")
+    print(f"failures: rejects={tr.get('rejects')} "
+          f"failures={tr.get('failures')} "
+          f"deadline_exceeded={tr.get('deadline_exceeded')} "
+          f"breaker_fastfail={tr.get('breaker_fastfail')}")
+    per = tr.get("per_peer") or {}
+    if not per:
+        print("no peers registered")
+        return 0
+    w = max(max(len(str(p)) for p in per), len("peer"))
+    print(f"{'peer':<{w}} {'attempts':>8} {'retries':>7} "
+          f"{'delivered':>9} {'rejects':>7} {'failures':>8} "
+          f"{'deadline':>8} {'fastfail':>8} {'ingested':>8} "
+          f"{'dedup':>5} breaker")
+    for name in sorted(per):
+        row = per[name]
+        print(f"{name:<{w}} {row.get('attempts'):>8} "
+              f"{row.get('retries'):>7} {row.get('delivered'):>9} "
+              f"{row.get('rejects'):>7} {row.get('failures'):>8} "
+              f"{row.get('deadline_exceeded'):>8} "
+              f"{row.get('breaker_fastfail'):>8} "
+              f"{row.get('ingested'):>8} {row.get('dedup_hits'):>5} "
+              f"{row.get('breaker')}")
+    return 0
+
+
 def render_journeys(stats) -> int:
     """The journey-plane census view: lifecycle counters + the
     per-bucket SLO exemplar table (``stats()["journeys"]``,
@@ -473,6 +528,11 @@ def main(argv=None) -> int:
                     "device/host/disk table, tier-crossing counters, "
                     "promote latency (FAILs when the endpoint has no "
                     "enabled offload store)")
+    ap.add_argument("--transport", action="store_true",
+                    help="render the KV transport layer: backend, "
+                    "transfer totals, and the per-peer counter + "
+                    "circuit-breaker table (FAILs when the endpoint "
+                    "has no transport block)")
     ap.add_argument("--journeys", action="store_true",
                     help="render the journey-plane census + the SLO "
                     "exemplar table (worst rid per TTFT/ITL bucket; "
@@ -506,7 +566,8 @@ def _run(args, base) -> int:
         if rc:
             return rc
     if args.programs or args.statusz or args.streams \
-            or args.elastic or args.offload or args.journeys:
+            or args.elastic or args.offload or args.transport \
+            or args.journeys:
         code, _, body = fetch(base, "/statusz", args.timeout)
         if code != 200:
             print(f"FAIL: /statusz {code}", file=sys.stderr)
@@ -526,6 +587,10 @@ def _run(args, base) -> int:
                 return rc
         if args.offload:
             rc = render_offload(stats)
+            if rc:
+                return rc
+        if args.transport:
+            rc = render_transport(stats)
             if rc:
                 return rc
         if args.journeys:
@@ -566,6 +631,7 @@ def _run(args, base) -> int:
                          indent=2, sort_keys=True))
     if not any((args.assert_healthy, args.programs, args.statusz,
                 args.streams, args.elastic, args.offload,
+                args.transport,
                 args.journeys, args.journey is not None,
                 args.metrics, args.flight is not None,
                 args.request is not None)):

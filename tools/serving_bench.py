@@ -1954,6 +1954,190 @@ def run_kv_offload_mode(args):
     return rc
 
 
+def _transport_sink(eng):
+    """The bench's receiver handler: alloc -> checksummed import ->
+    re-export -> free, acking the re-exported leaf checksums so the
+    sender can prove byte parity WITHOUT shipping the bytes back
+    (socket acks carry JSON only).  This is exactly the consumer
+    shape of the real hand-off/warm/promote handlers."""
+
+    def handler(meta, payload):
+        n = int(meta["n"])
+        ids = eng.allocator.alloc(n)
+        if ids is None:
+            raise MemoryError("transport bench pool exhausted")
+        try:
+            eng.import_blocks(ids, payload)
+            back = eng.export_blocks(ids)
+        finally:
+            eng.allocator.free(ids)
+        return {"crc": {k: int(v) for k, v in back["crc"].items()}}
+
+    return handler
+
+
+def _run_transport_arm(send, payload, n, repeats):
+    """Time ``repeats`` transfers of the same ``n``-block payload
+    through ``send`` (one warmup transfer outside the window).
+    Returns (blocks/s, per-transfer latency p50/p99 ms, final ack)."""
+    meta = {"op": "bench", "n": n}
+    ack = send(meta, payload)                  # warmup / compile
+    lats = []
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        s0 = time.perf_counter()
+        ack = send(meta, payload)
+        lats.append((time.perf_counter() - s0) * 1e3)
+    dt = time.perf_counter() - t0
+    lats.sort()
+    return ({
+        "blocks_s": round(n * repeats / max(dt, 1e-9), 1),
+        "transfers": repeats,
+        "handoff_ms": {
+            "p50": round(lats[int(0.50 * (len(lats) - 1))], 3),
+            "p99": round(lats[int(0.99 * (len(lats) - 1))], 3),
+        },
+        "wall_s": round(dt, 3),
+    }, ack)
+
+
+def run_transport_mode(args):
+    """The KV-transport backend A/B (docs/serving.md, "KV transport";
+    one JSON record to ``BENCH_serving_transport.json``): the same
+    ``n``-block checksummed payload is moved repeatedly through three
+    paths —
+
+    - *direct*: the receiver handler called as a plain function (the
+      pre-refactor copy: no envelope, no policy) — the baseline the
+      abstraction must not tax;
+    - *inprocess*: ``InProcessTransport.send`` (the default backend
+      everywhere) — envelope, retry policy, breaker, and dedup ledger
+      all engaged;
+    - *socket*: ``SocketTransport.send`` over loopback TCP — frame
+      encode, length-prefix + crc verify, decode, and the server
+      thread round trip.
+
+    Every arm's receiver re-exports what it ingested and acks the
+    leaf checksums; all three acks must equal the source payload's
+    (byte parity is ALWAYS asserted — a fast transport that rots
+    bytes is a corruption, not a win).  ``--smoke`` floors
+    inprocess/direct blocks/s >= 0.9x (the abstraction-overhead
+    no-regression bar); the socket ratio is reported, never floored —
+    framing and syscalls are its documented price."""
+    from apex_tpu.serving import InferenceServer
+    from apex_tpu.serving.transport import (InProcessTransport,
+                                            SocketTransport,
+                                            TransportPolicy)
+
+    import jax.numpy as jnp
+
+    cfg, m, params = build_model(args)
+    n = args.transport_blocks
+    repeats = args.transport_repeats
+
+    def mk_server():
+        # a roomy pool on both sides: the bench times block movement,
+        # never allocator pressure
+        return InferenceServer(
+            cfg, params, max_batch_size=args.batch_size,
+            max_context=args.max_context, block_size=args.block_size,
+            num_blocks=3 * n + 2,
+            cache_dtype=jnp.float32, kv_quant="off",
+            enable_disagg=False, enable_streaming=False,
+            enable_kv_offload=False, enable_speculation=False,
+            enable_pipeline=False)
+
+    rng = np.random.RandomState(args.seed + 13)
+    src_server, dst_server = mk_server(), mk_server()
+    # one real generate writes KV bytes into the source pool so the
+    # exported payload carries live-looking data, not zeros
+    src_server.generate(
+        [list(rng.randint(0, args.vocab, size=args.block_size * 2))],
+        max_new_tokens=8)
+    src = src_server.engine
+    ids = src.allocator.alloc(n)
+    payload = src.export_blocks(ids)
+    src.allocator.free(ids)
+    handler = _transport_sink(dst_server.engine)
+
+    direct, ack_direct = _run_transport_arm(
+        lambda meta, p: handler(meta, p), payload, n, repeats)
+
+    inproc_tr = InProcessTransport(policy=TransportPolicy())
+    inproc_tr.register_peer("sink", handler)
+    inproc, ack_inproc = _run_transport_arm(
+        lambda meta, p: inproc_tr.send("sink", meta, p),
+        payload, n, repeats)
+    inproc_stats = inproc_tr.stats()
+    inproc_tr.close()
+
+    sock_tr = SocketTransport(policy=TransportPolicy())
+    sock_tr.register_peer("sink", handler)     # loops back via TCP
+    sock, ack_sock = _run_transport_arm(
+        lambda meta, p: sock_tr.send("sink", meta, p),
+        payload, n, repeats)
+    sock_stats = sock_tr.stats()
+    sock_tr.close()
+
+    want = {k: int(v) for k, v in payload["crc"].items()}
+    parity = sum(ack["crc"] != want
+                 for ack in (ack_direct, ack_inproc, ack_sock))
+
+    record = {
+        "bench": "serving_transport",
+        "mode": "smoke" if args.smoke else "full",
+        "config": {"blocks_per_transfer": n, "transfers": repeats,
+                   "block_size": args.block_size,
+                   "hidden": args.hidden, "layers": args.layers,
+                   "heads": args.heads,
+                   "max_context": args.max_context,
+                   "vocab": args.vocab, "seed": args.seed,
+                   "payload_bytes": int(sum(
+                       a.nbytes for a in payload["leaves"].values()))},
+        "direct": direct,
+        "inprocess": dict(inproc, stats=inproc_stats),
+        "socket": dict(sock, stats=sock_stats),
+        # the headline ratios: the abstraction's own tax (floored
+        # under --smoke) and the socket backend's documented price
+        "inprocess_vs_direct": round(
+            inproc["blocks_s"] / max(direct["blocks_s"], 1e-9), 3),
+        "socket_vs_inprocess": round(
+            sock["blocks_s"] / max(inproc["blocks_s"], 1e-9), 3),
+        "parity_mismatches": parity,
+    }
+    print(json.dumps(record))
+
+    out = args.out
+    if out != "-":
+        if out is None:
+            out = os.path.join(
+                os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+                "BENCH_serving_transport.json")
+        with open(out, "w") as f:
+            json.dump(record, f, indent=1)
+            f.write("\n")
+
+    rc = 0
+    if parity:
+        print(f"FAIL: {parity} backend(s) acked checksums diverging "
+              "from the source payload (block movement must be "
+              "byte-exact on every backend)", file=sys.stderr)
+        rc = 1
+    if (inproc_stats["failures"] or sock_stats["failures"]
+            or inproc_stats["rejects"] or sock_stats["rejects"]):
+        print("FAIL: transfers failed or were rejected on a healthy "
+              f"loopback (inprocess={inproc_stats}, "
+              f"socket={sock_stats})", file=sys.stderr)
+        rc = 1
+    if args.smoke and record["inprocess_vs_direct"] < 0.9:
+        print(f"FAIL: in-process transport moved blocks at "
+              f"{record['inprocess_vs_direct']}x the direct copy "
+              f"(< 0.9x no-regression floor)", file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def _router_fleet(cfg, params, args, kind):
     from apex_tpu.serving import RouterFleet, RouterPolicy
 
@@ -2431,6 +2615,23 @@ def main():
                     "counter-keyed stochastic) always, >= 2x "
                     "resumed-TTFT floor under --smoke "
                     "(BENCH_serving_kvoffload.json)")
+    ap.add_argument("--transport", action="store_true",
+                    help="run the KV-transport backend A/B "
+                    "(docs/serving.md, 'KV transport'): the same "
+                    "checksummed block payload moved through the "
+                    "direct copy, the in-process transport envelope, "
+                    "and the loopback-TCP socket backend — blocks/s "
+                    "and per-transfer hand-off latency per arm, byte "
+                    "parity via re-exported checksums always, "
+                    "inprocess/direct >= 0.9x floored under --smoke "
+                    "(BENCH_serving_transport.json)")
+    ap.add_argument("--transport-blocks", type=int, default=None,
+                    help="transport mode: KV blocks per transfer "
+                    "(default: min(24, max_context // block_size) — "
+                    "one import launch, the real consumers' bound)")
+    ap.add_argument("--transport-repeats", type=int, default=None,
+                    help="transport mode: timed transfers per arm "
+                    "(default: 40 under --smoke, else 200)")
     ap.add_argument("--router", type=int, default=None, metavar="N",
                     help="run the multi-replica placement A/B "
                     "(affinity vs seeded-random routing of grouped "
@@ -2631,6 +2832,19 @@ def main():
             args.layers = 2
             args.heads = 2
             args.max_context = 64
+        if args.transport:
+            # block movement, not model compute, is the measured
+            # axis: a toy model keeps the one warmup generate cheap
+            # while block_size x heads x hidden sizes a realistic
+            # per-block byte payload
+            args.max_new = 8
+            args.batch_size = 4
+            args.block_size = 8
+            args.vocab = 61
+            args.hidden = 64
+            args.layers = 2
+            args.heads = 2
+            args.max_context = 64
         if args.router:
             # grouped multi-session traffic: few rounds keep the
             # random arm's accidental same-replica revisits rare (the
@@ -2656,6 +2870,17 @@ def main():
             # plus a little cache, NOT enough to absorb a crowd
             args.router_blocks = 40
         return run_elastic_mode(args)
+
+    if args.transport:
+        if args.transport_blocks is None:
+            # import_blocks scatters through the blocks_per_seq-wide
+            # program, so one transfer is bounded by it — exactly the
+            # bound the real consumers (hand-off, warm, promote) obey
+            args.transport_blocks = min(
+                24, args.max_context // args.block_size)
+        if args.transport_repeats is None:
+            args.transport_repeats = 40 if args.smoke else 200
+        return run_transport_mode(args)
 
     if args.router:
         if args.prefix_len is None:
